@@ -1,0 +1,24 @@
+"""dynamo-tpu: TPU-native distributed LLM inference-serving framework.
+
+A ground-up JAX/XLA/Pallas re-design with the capabilities of NVIDIA Dynamo
+(see SURVEY.md at the repo root): OpenAI-compatible frontend, KV-cache-aware
+routing over a global radix index, disaggregated prefill/decode serving on
+separate TPU slices, a multi-tier KV block manager (HBM -> host DRAM -> disk),
+SLA-driven autoscaling, request migration and health-based fault tolerance,
+and a mock-engine test harness.
+
+Layering (mirrors reference layer map, SURVEY.md section 1):
+  runtime/   - distributed runtime: components, endpoints, transports, hub
+  tokens.py  - token block hashing primitives (ref: lib/tokens, lib/llm/src/tokens.rs)
+  kv_router/ - KV-cache-aware routing (ref: lib/llm/src/kv_router/)
+  mocker/    - simulated engine for infra tests (ref: lib/llm/src/mocker/)
+  frontend/  - OpenAI HTTP frontend + preprocessor pipeline (ref: lib/llm/src/http, preprocessor.rs)
+  engine/    - the JAX inference engine (genuinely new: paged attention, continuous batching)
+  models/    - model definitions (llama, MoE) with mesh shardings
+  ops/       - Pallas TPU kernels + pure-JAX references
+  parallel/  - mesh construction, ring attention, KV transfer over ICI/DCN
+  kvbm/      - tiered KV block manager (ref: lib/llm/src/block_manager/)
+  planner/   - SLA autoscaler (ref: components/src/dynamo/planner/)
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,35 @@
+"""dynamo-tpu CLI: single entry wiring inputs to engines.
+
+Equivalent of the reference's ``dynamo-run`` binary (launch/dynamo-run/
+src/main.rs:29, opt.rs:7-25): ``dynamo-tpu <subcommand>`` launches the hub,
+a frontend, a worker, or utility tools. Subcommands grow with the framework;
+``hub`` is available from M2.
+"""
+
+from __future__ import annotations
+
+import sys
+
+
+def main(argv: list[str] | None = None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if not argv or argv[0] in ("-h", "--help"):
+        print(
+            "usage: dynamo-tpu <command> [args]\n"
+            "commands:\n"
+            "  hub        run the coordination service (hub)\n"
+        )
+        return 0
+    cmd, rest = argv[0], argv[1:]
+    if cmd == "hub":
+        from dynamo_tpu.runtime import hub_server
+
+        sys.argv = ["dynamo-tpu hub", *rest]
+        hub_server.main()
+        return 0
+    print(f"unknown command: {cmd!r}", file=sys.stderr)
+    return 2
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

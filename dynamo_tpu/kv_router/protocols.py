@@ -1,0 +1,136 @@
+"""Wire types for KV routing (ref lib/llm/src/kv_router/protocols.rs).
+
+Everything here crosses process boundaries (hub pub/sub), so types are plain
+dicts-on-the-wire with dataclass views.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any
+
+# hub pub/sub subjects
+KV_EVENT_SUBJECT = "kv_events.{component}"  # worker cache events -> routers
+KV_METRICS_SUBJECT = "kv_metrics.{component}"  # worker load metrics -> routers
+
+
+@dataclass(frozen=True)
+class BlockStored:
+    """One KV block became resident on a worker.
+
+    ``sequence_hash`` is the chained prefix identity (tokens.py), which is
+    what the radix index is keyed on; ``parent_sequence_hash`` links it into
+    the prefix tree; ``block_hash`` is the content hash (kept for debugging /
+    cross-checking).
+    """
+
+    sequence_hash: int
+    parent_sequence_hash: int
+    block_hash: int = 0
+
+
+@dataclass(frozen=True)
+class KvCacheEvent:
+    """A batch of cache mutations from one worker's engine.
+
+    kind: "stored" | "removed" | "cleared"
+    """
+
+    kind: str
+    stored: tuple[BlockStored, ...] = ()
+    removed: tuple[int, ...] = ()  # sequence hashes
+
+    def to_dict(self) -> dict[str, Any]:
+        return {
+            "kind": self.kind,
+            "stored": [
+                {
+                    "sequence_hash": b.sequence_hash,
+                    "parent_sequence_hash": b.parent_sequence_hash,
+                    "block_hash": b.block_hash,
+                }
+                for b in self.stored
+            ],
+            "removed": list(self.removed),
+        }
+
+    @classmethod
+    def from_dict(cls, d: dict[str, Any]) -> "KvCacheEvent":
+        return cls(
+            kind=d["kind"],
+            stored=tuple(
+                BlockStored(
+                    sequence_hash=b["sequence_hash"],
+                    parent_sequence_hash=b["parent_sequence_hash"],
+                    block_hash=b.get("block_hash", 0),
+                )
+                for b in d.get("stored", ())
+            ),
+            removed=tuple(d.get("removed", ())),
+        )
+
+
+@dataclass(frozen=True)
+class RouterEvent:
+    """KvCacheEvent tagged with its source worker (ref indexer.rs:175)."""
+
+    worker_id: int
+    event: KvCacheEvent
+    event_id: int = 0
+
+    def to_dict(self) -> dict[str, Any]:
+        return {
+            "worker_id": self.worker_id,
+            "event_id": self.event_id,
+            "event": self.event.to_dict(),
+        }
+
+    @classmethod
+    def from_dict(cls, d: dict[str, Any]) -> "RouterEvent":
+        return cls(
+            worker_id=d["worker_id"],
+            event=KvCacheEvent.from_dict(d["event"]),
+            event_id=d.get("event_id", 0),
+        )
+
+
+@dataclass
+class ForwardPassMetrics:
+    """Per-worker load snapshot (ref kv_router/protocols.rs:48).
+
+    Published by workers on every scheduler iteration (or change); consumed
+    by the router's scheduler as the ``decode_blocks`` / queueing signals.
+    """
+
+    worker_id: int = 0
+    active_kv_blocks: int = 0
+    total_kv_blocks: int = 1
+    waiting_requests: int = 0
+    running_requests: int = 0
+    prefill_tokens_queued: int = 0
+    data_parallel_rank: int = 0
+
+    @property
+    def kv_usage(self) -> float:
+        return self.active_kv_blocks / max(self.total_kv_blocks, 1)
+
+    def to_dict(self) -> dict[str, Any]:
+        return self.__dict__.copy()
+
+    @classmethod
+    def from_dict(cls, d: dict[str, Any]) -> "ForwardPassMetrics":
+        return cls(**{k: d[k] for k in cls().__dict__ if k in d})
+
+
+@dataclass
+class RouterConfig:
+    """Scheduler knobs (ref kv_router.rs:116-126, scheduler.rs:519)."""
+
+    overlap_weight: float = 1.0
+    temperature: float = 0.0  # 0 => deterministic argmin
+    block_size: int = 64
+    # replica sync / snapshots
+    snapshot_threshold: int = 1_000_000  # events between radix snapshots
+    # approx indexer
+    approx_ttl_s: float = 120.0
+    use_approx: bool = False

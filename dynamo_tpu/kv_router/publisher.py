@@ -1,0 +1,170 @@
+"""Worker-side publishers: KV cache events + load metrics to the hub.
+
+Ref: lib/llm/src/kv_router/publisher.rs (KvEventPublisher :92,
+WorkerMetricsPublisher :684). The engine (real or mocker) calls
+``block_stored``/``blocks_removed`` from its scheduler loop; events batch and
+flush to the hub pub/sub subject tagged with this worker's instance id.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from typing import Any, Iterable
+
+from dynamo_tpu.kv_router.protocols import (
+    KV_EVENT_SUBJECT,
+    KV_METRICS_SUBJECT,
+    BlockStored,
+    ForwardPassMetrics,
+    KvCacheEvent,
+    RouterEvent,
+)
+from dynamo_tpu.runtime.hub import Hub
+
+log = logging.getLogger("dynamo.kv.publisher")
+
+
+class KvEventPublisher:
+    def __init__(
+        self,
+        hub: Hub,
+        component_path: str,
+        worker_id: int,
+        *,
+        flush_interval_s: float = 0.05,
+        max_batch: int = 256,
+    ):
+        self.hub = hub
+        self.subject = KV_EVENT_SUBJECT.format(component=component_path)
+        self.worker_id = worker_id
+        self.flush_interval_s = flush_interval_s
+        self.max_batch = max_batch
+        # single ordered op log: ("stored", BlockStored) | ("removed", int).
+        # Order matters: remove-then-restore of the same block within one
+        # flush window must not be reordered into restore-then-remove.
+        self._ops: list[tuple[str, Any]] = []
+        self._event_id = 0
+        self._task: asyncio.Task | None = None
+        self._dirty = asyncio.Event()
+        self._closed = False
+
+    def start(self) -> "KvEventPublisher":
+        if self._task is None:
+            self._task = asyncio.get_running_loop().create_task(self._flush_loop())
+        return self
+
+    # engine-facing (sync, callable from the scheduler loop) ---------------
+
+    def block_stored(
+        self, sequence_hash: int, parent_sequence_hash: int, block_hash: int = 0
+    ) -> None:
+        self._ops.append(
+            ("stored", BlockStored(sequence_hash, parent_sequence_hash, block_hash))
+        )
+        self._mark_dirty()
+
+    def blocks_removed(self, sequence_hashes: Iterable[int]) -> None:
+        self._ops.extend(("removed", sh) for sh in sequence_hashes)
+        self._mark_dirty()
+
+    def _mark_dirty(self) -> None:
+        self._dirty.set()
+        if len(self._ops) >= self.max_batch:
+            # batch full: flush immediately rather than waiting the interval
+            asyncio.ensure_future(self.flush())
+
+    def cache_cleared(self) -> None:
+        self._ops.clear()
+        self._event_id += 1
+        asyncio.ensure_future(
+            self._publish(RouterEvent(self.worker_id, KvCacheEvent("cleared"), self._event_id))
+        )
+
+    # internals ------------------------------------------------------------
+
+    async def _flush_loop(self) -> None:
+        try:
+            while not self._closed:
+                await self._dirty.wait()
+                await asyncio.sleep(self.flush_interval_s)
+                self._dirty.clear()
+                await self.flush()
+        except asyncio.CancelledError:
+            pass
+
+    async def flush(self) -> None:
+        """Publish queued ops as batches, preserving stored/removed order."""
+        ops, self._ops = self._ops, []
+        i = 0
+        while i < len(ops):
+            kind = ops[i][0]
+            j = i
+            while j < len(ops) and ops[j][0] == kind:
+                j += 1
+            run = [op[1] for op in ops[i:j]]
+            self._event_id += 1
+            if kind == "stored":
+                ev = KvCacheEvent("stored", stored=tuple(run))
+            else:
+                ev = KvCacheEvent("removed", removed=tuple(run))
+            await self._publish(RouterEvent(self.worker_id, ev, self._event_id))
+            i = j
+
+    async def _publish(self, ev: RouterEvent) -> None:
+        try:
+            await self.hub.publish(self.subject, ev.to_dict())
+        except ConnectionError:
+            log.warning("hub publish failed (kv event dropped)")
+
+    async def close(self) -> None:
+        self._closed = True
+        await self.flush()
+        if self._task is not None:
+            self._task.cancel()
+
+
+class WorkerMetricsPublisher:
+    """Publishes ForwardPassMetrics on change/interval (ref publisher.rs:684)."""
+
+    def __init__(
+        self,
+        hub: Hub,
+        component_path: str,
+        worker_id: int,
+        *,
+        interval_s: float = 0.25,
+    ):
+        self.hub = hub
+        self.subject = KV_METRICS_SUBJECT.format(component=component_path)
+        self.worker_id = worker_id
+        self.interval_s = interval_s
+        self._latest: ForwardPassMetrics | None = None
+        self._task: asyncio.Task | None = None
+        self._closed = False
+
+    def start(self) -> "WorkerMetricsPublisher":
+        if self._task is None:
+            self._task = asyncio.get_running_loop().create_task(self._loop())
+        return self
+
+    def publish(self, metrics: ForwardPassMetrics) -> None:
+        metrics.worker_id = self.worker_id
+        self._latest = metrics
+
+    async def _loop(self) -> None:
+        try:
+            while not self._closed:
+                if self._latest is not None:
+                    try:
+                        await self.hub.publish(self.subject, self._latest.to_dict())
+                    except ConnectionError:
+                        pass
+                await asyncio.sleep(self.interval_s)
+        except asyncio.CancelledError:
+            pass
+
+    async def close(self) -> None:
+        self._closed = True
+        if self._task is not None:
+            self._task.cancel()

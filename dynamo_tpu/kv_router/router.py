@@ -1,0 +1,219 @@
+"""KvRouter + KvPushRouter: the KV-aware routing engines.
+
+``KvRouter`` (ref lib/llm/src/kv_router/kv_router.rs:202) owns the radix
+index (fed by worker events off the hub), the scheduler (fed by worker
+metrics), and active-sequence tracking; ``find_best_match`` is the routing
+decision. ``KvPushRouter`` (:476) wraps it as an AsyncEngine operator that
+routes preprocessed requests to a specific instance through a PushRouter and
+maintains sequence lifecycle around the stream.
+
+Radix state snapshots persist to the hub object store so a restarting router
+warm-starts instead of replaying history (ref RADIX_STATE_BUCKET
+kv_router.rs:66-71).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+from typing import Any, AsyncIterator
+
+from dynamo_tpu.kv_router.indexer import ApproxKvIndexer, OverlapScores, RadixTree
+from dynamo_tpu.kv_router.protocols import (
+    KV_EVENT_SUBJECT,
+    KV_METRICS_SUBJECT,
+    ForwardPassMetrics,
+    RouterConfig,
+    RouterEvent,
+)
+from dynamo_tpu.kv_router.scheduler import KvScheduler
+from dynamo_tpu.kv_router.sequence import ActiveSequencesMultiWorker
+from dynamo_tpu.runtime.context import Context
+from dynamo_tpu.runtime.hub import Hub
+from dynamo_tpu.tokens import compute_sequence_hashes
+
+log = logging.getLogger("dynamo.kv.router")
+
+RADIX_STATE_BUCKET = "kv-router-state"
+
+
+class KvRouter:
+    """KV-cache-aware worker selection for one component."""
+
+    def __init__(
+        self,
+        hub: Hub,
+        component_path: str,
+        config: RouterConfig | None = None,
+    ):
+        self.hub = hub
+        self.component_path = component_path
+        self.config = config or RouterConfig()
+        self.tree = RadixTree()
+        self.approx = ApproxKvIndexer(self.config.approx_ttl_s)
+        self.scheduler = KvScheduler(self.config)
+        self.sequences = ActiveSequencesMultiWorker()
+        self._tasks: list[asyncio.Task] = []
+        self._started = False
+
+    async def start(self) -> "KvRouter":
+        if self._started:
+            return self
+        self._started = True
+        loop = asyncio.get_running_loop()
+        self._tasks.append(loop.create_task(self._consume_events()))
+        self._tasks.append(loop.create_task(self._consume_metrics()))
+        return self
+
+    # -- event/metrics consumption ----------------------------------------
+
+    async def _consume_events(self) -> None:
+        subject = KV_EVENT_SUBJECT.format(component=self.component_path)
+        try:
+            # replay: catch up on events published before this router started
+            async for _subj, payload in self.hub.subscribe(subject, replay=True):
+                try:
+                    ev = RouterEvent.from_dict(payload)
+                    self.tree.apply_event(ev.worker_id, ev.event)
+                except (KeyError, ValueError, TypeError):
+                    # one malformed event must not kill the consumer
+                    log.warning("dropping malformed kv event: %r", payload)
+        except asyncio.CancelledError:
+            pass
+        except ConnectionError:
+            log.warning("kv event subscription lost")
+
+    async def _consume_metrics(self) -> None:
+        subject = KV_METRICS_SUBJECT.format(component=self.component_path)
+        try:
+            async for _subj, payload in self.hub.subscribe(subject):
+                try:
+                    self.scheduler.update_metrics(ForwardPassMetrics.from_dict(payload))
+                except (KeyError, ValueError, TypeError):
+                    log.warning("dropping malformed metrics: %r", payload)
+        except asyncio.CancelledError:
+            pass
+        except ConnectionError:
+            log.warning("kv metrics subscription lost")
+
+    # -- membership --------------------------------------------------------
+
+    def update_workers(self, worker_ids) -> None:
+        live = set(worker_ids)
+        for gone in self.tree.workers() - live:
+            self.tree.remove_worker(gone)
+            self.approx.remove_worker(gone)
+        self.scheduler.update_workers(worker_ids)
+        self.sequences.update_workers(worker_ids)
+
+    # -- the routing decision ---------------------------------------------
+
+    def find_best_match(
+        self, request_id: str, token_ids: list[int], *, salt: str | None = None
+    ) -> tuple[int, int]:
+        """Pick a worker for ``token_ids``; returns (worker_id, overlap_blocks).
+
+        Registers the request in active-sequence tracking; callers MUST pair
+        with ``free(request_id)`` when the stream ends.
+        """
+        bs = self.config.block_size
+        seq_hashes = compute_sequence_hashes(token_ids, bs, salt)
+        request_blocks = max(len(token_ids) // bs, 1)
+
+        overlaps = self.tree.find_matches(seq_hashes)
+        if self.config.use_approx:
+            approx_overlaps = self.approx.find_matches(seq_hashes)
+            for wid, score in approx_overlaps.scores.items():
+                overlaps.scores[wid] = max(overlaps.scores.get(wid, 0), score)
+
+        # fold local predictions into scheduler state
+        for wid, (blocks, ptok) in self.sequences.loads().items():
+            self.scheduler.set_predicted_load(wid, blocks, ptok)
+
+        worker_id, overlap = self.scheduler.schedule(request_blocks, overlaps)
+        self.sequences.add_request(
+            request_id,
+            worker_id,
+            blocks=request_blocks - overlap,
+            prefill_tokens=max(len(token_ids) - overlap * bs, 0),
+        )
+        if self.config.use_approx:
+            parents = [0] + seq_hashes[:-1]
+            self.approx.process_routing_decision(worker_id, seq_hashes, parents)
+        return worker_id, overlap
+
+    def mark_prefill_done(self, request_id: str) -> None:
+        self.sequences.mark_prefill_done(request_id)
+
+    def free(self, request_id: str) -> None:
+        self.sequences.free(request_id)
+
+    # -- snapshots ---------------------------------------------------------
+
+    async def save_snapshot(self) -> None:
+        data = json.dumps(self.tree.snapshot()).encode()
+        await self.hub.put_object(
+            RADIX_STATE_BUCKET, self.component_path.replace("/", "_"), data
+        )
+
+    async def load_snapshot(self) -> bool:
+        data = await self.hub.get_object(
+            RADIX_STATE_BUCKET, self.component_path.replace("/", "_")
+        )
+        if not data:
+            return False
+        self.tree = RadixTree.restore(json.loads(data))
+        return True
+
+    async def close(self) -> None:
+        for t in self._tasks:
+            t.cancel()
+
+
+class KvPushRouter:
+    """AsyncEngine operator: KV-route then stream from the chosen instance.
+
+    Wraps a PushRouter (direct mode) around KvRouter decisions; keeps the
+    router's active-sequence state in sync with stream lifecycle. Ref:
+    kv_router.rs:476-491 KvPushRouter.
+    """
+
+    def __init__(self, push_router, kv_router: KvRouter, *, salt: str | None = None):
+        self.push_router = push_router
+        self.kv_router = kv_router
+        self.salt = salt
+
+    async def generate(
+        self, request: dict[str, Any], context: Context
+    ) -> AsyncIterator[Any]:
+        token_ids = request.get("token_ids") or []
+        # live membership reconciliation before deciding
+        self.kv_router.update_workers(self.push_router.client.instance_ids())
+
+        pinned = request.get("backend_instance_id")
+        if pinned is not None:
+            worker_id, overlap = pinned, 0
+        else:
+            worker_id, overlap = self.kv_router.find_best_match(
+                context.id, token_ids, salt=self.salt
+            )
+        request = dict(request)
+        request["estimated_prefix_hit_num_blocks"] = overlap
+        first = True
+        try:
+            async for item in self.push_router.generate(
+                request, context, instance_id=worker_id
+            ):
+                if first:
+                    first = False
+                    self.kv_router.mark_prefill_done(context.id)
+                yield item
+        finally:
+            self.kv_router.free(context.id)
+
+    def best_worker_id(self, token_ids: list[int], request_id: str = "probe") -> tuple[int, int]:
+        """Routing decision without dispatch (standalone router service API)."""
+        wid, overlap = self.kv_router.find_best_match(request_id, token_ids, salt=self.salt)
+        self.kv_router.free(request_id)
+        return wid, overlap

@@ -1,0 +1,157 @@
+"""Worker selection: cost model + sampling (ref lib/llm/src/kv_router/scheduler.rs).
+
+Default cost per worker (scheduler.rs:494-539):
+
+    potential_prefill_blocks = request_blocks - overlap_blocks(worker)
+    decode_blocks            = worker's active blocks (published + predicted)
+    logit = overlap_weight * potential_prefill_blocks + decode_blocks
+
+Lower is better. With temperature 0 the argmin wins (ties broken by fewest
+waiting requests, then lowest worker id for determinism); otherwise workers
+are softmax-sampled over ``-logit / temperature``, which spreads load when
+costs are close.
+"""
+
+from __future__ import annotations
+
+import math
+import random
+from dataclasses import dataclass
+from typing import Callable, Mapping, Protocol, Sequence
+
+from dynamo_tpu.kv_router.indexer import OverlapScores
+from dynamo_tpu.kv_router.protocols import ForwardPassMetrics, RouterConfig
+
+__all__ = ["WorkerSelector", "DefaultWorkerSelector", "KvScheduler", "softmax_sample"]
+
+
+def softmax_sample(
+    logits: Mapping[int, float],
+    temperature: float,
+    rng: random.Random | None = None,
+) -> int:
+    """Pick a worker id by softmax over negated costs (ref scheduler.rs:389).
+
+    ``logits`` are COSTS (lower = better). temperature<=0 => deterministic
+    argmin with stable tie-breaking on worker id.
+    """
+    if not logits:
+        raise ValueError("no workers to sample from")
+    if temperature <= 0.0:
+        return min(logits.items(), key=lambda kv: (kv[1], kv[0]))[0]
+    rng = rng or random
+    items = sorted(logits.items())
+    mx = max(-cost / temperature for _, cost in items)
+    weights = [math.exp(-cost / temperature - mx) for _, cost in items]
+    total = sum(weights)
+    r = rng.random() * total
+    acc = 0.0
+    for (wid, _), w in zip(items, weights):
+        acc += w
+        if r <= acc:
+            return wid
+    return items[-1][0]
+
+
+@dataclass
+class WorkerState:
+    """Scheduler's view of one worker = published metrics + local predictions."""
+
+    worker_id: int
+    metrics: ForwardPassMetrics
+    predicted_active_blocks: int = 0  # from ActiveSequences tracking
+    predicted_prefill_tokens: int = 0
+
+
+class WorkerSelector(Protocol):
+    """Pluggable selection policy (ref kv_router.rs:74)."""
+
+    def select(
+        self,
+        workers: Sequence[WorkerState],
+        request_blocks: int,
+        overlaps: OverlapScores,
+        config: RouterConfig,
+    ) -> tuple[int, int]:  # pragma: no cover - protocol
+        """Returns (worker_id, overlap_blocks_on_that_worker)."""
+        ...
+
+
+class DefaultWorkerSelector:
+    """The reference cost function (scheduler.rs:461 DefaultWorkerSelector)."""
+
+    def __init__(self, rng: random.Random | None = None):
+        self.rng = rng or random.Random()
+        self.last_logits: dict[int, float] = {}  # observability
+
+    def select(
+        self,
+        workers: Sequence[WorkerState],
+        request_blocks: int,
+        overlaps: OverlapScores,
+        config: RouterConfig,
+    ) -> tuple[int, int]:
+        logits: dict[int, float] = {}
+        for w in workers:
+            overlap = overlaps.scores.get(w.worker_id, 0)
+            prefill_blocks = max(request_blocks - overlap, 0)
+            decode_blocks = max(
+                w.metrics.active_kv_blocks, w.predicted_active_blocks
+            )
+            # normalize decode load to blocks of this request's size domain
+            logits[w.worker_id] = (
+                config.overlap_weight * prefill_blocks
+                + decode_blocks
+                + 0.5 * w.metrics.waiting_requests
+            )
+        self.last_logits = logits
+        wid = softmax_sample(logits, config.temperature, self.rng)
+        return wid, overlaps.scores.get(wid, 0)
+
+
+class KvScheduler:
+    """Maintains WorkerStates from published metrics; applies the selector."""
+
+    def __init__(
+        self,
+        config: RouterConfig | None = None,
+        selector: WorkerSelector | None = None,
+    ):
+        self.config = config or RouterConfig()
+        self.selector = selector or DefaultWorkerSelector()
+        self._states: dict[int, WorkerState] = {}
+
+    def update_metrics(self, metrics: ForwardPassMetrics) -> None:
+        state = self._states.get(metrics.worker_id)
+        if state is None:
+            self._states[metrics.worker_id] = WorkerState(metrics.worker_id, metrics)
+        else:
+            state.metrics = metrics
+
+    def update_workers(self, worker_ids: Sequence[int]) -> None:
+        """Reconcile with live instance set (lease-expiry removal)."""
+        live = set(worker_ids)
+        for wid in list(self._states):
+            if wid not in live:
+                del self._states[wid]
+        for wid in live:
+            if wid not in self._states:
+                self._states[wid] = WorkerState(wid, ForwardPassMetrics(worker_id=wid))
+
+    def set_predicted_load(self, worker_id: int, active_blocks: int, prefill_tokens: int) -> None:
+        state = self._states.get(worker_id)
+        if state is not None:
+            state.predicted_active_blocks = active_blocks
+            state.predicted_prefill_tokens = prefill_tokens
+
+    def workers(self) -> list[WorkerState]:
+        return list(self._states.values())
+
+    def schedule(
+        self, request_blocks: int, overlaps: OverlapScores
+    ) -> tuple[int, int]:
+        """Pick (worker_id, overlap_blocks); raises if no workers known."""
+        workers = self.workers()
+        if not workers:
+            raise LookupError("no workers registered with scheduler")
+        return self.selector.select(workers, request_blocks, overlaps, self.config)

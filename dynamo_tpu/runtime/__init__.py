@@ -1,0 +1,51 @@
+"""Distributed runtime: the foundation layer.
+
+TPU-first re-design of the reference ``dynamo-runtime`` crate
+(lib/runtime/src/): a single asyncio process runtime instead of dual tokio
+runtimes; a self-hosted "hub" service (lease-based KV store + prefix watches +
+pub/sub + object store) instead of requiring etcd + NATS; and a direct-TCP
+request/response data plane instead of NATS push + call-home TCP.
+
+Public surface:
+  Runtime / DistributedRuntime  - process + cluster handles (ref lib.rs:72,:184)
+  Namespace / Component / Endpoint / Instance / Client (ref component.rs)
+  AsyncEngine protocol + Context cancellation (ref engine.rs:201,:112)
+  PushRouter with RouterMode (ref pipeline/network/egress/push_router.rs:33)
+  Hub implementations: InMemoryHub, RemoteHub + hub server (ref transports/{etcd,nats}.rs)
+"""
+
+from dynamo_tpu.runtime.config import RuntimeConfig
+from dynamo_tpu.runtime.context import Context, StreamError
+from dynamo_tpu.runtime.engine import AsyncEngine, Annotated
+from dynamo_tpu.runtime.hub import Hub, InMemoryHub, WatchEvent
+from dynamo_tpu.runtime.hub_client import RemoteHub
+from dynamo_tpu.runtime.component import (
+    Client,
+    Component,
+    Endpoint,
+    Instance,
+    Namespace,
+)
+from dynamo_tpu.runtime.distributed import DistributedRuntime, Runtime
+from dynamo_tpu.runtime.push import PushRouter, RouterMode
+
+__all__ = [
+    "RuntimeConfig",
+    "Context",
+    "StreamError",
+    "AsyncEngine",
+    "Annotated",
+    "Hub",
+    "InMemoryHub",
+    "RemoteHub",
+    "WatchEvent",
+    "Namespace",
+    "Component",
+    "Endpoint",
+    "Instance",
+    "Client",
+    "Runtime",
+    "DistributedRuntime",
+    "PushRouter",
+    "RouterMode",
+]

@@ -1,0 +1,96 @@
+"""Layered runtime configuration.
+
+Precedence (low to high): dataclass defaults < YAML file at ``DYN_CONFIG`` <
+``DYN_*`` environment variables. Mirrors the reference's figment-based
+RuntimeConfig (lib/runtime/src/config.rs:75, env prefixes at :219-265).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Any
+
+import yaml
+
+_PREFIX = "DYN_"
+
+
+def _coerce(value: str, typ: Any) -> Any:
+    if typ is bool:
+        return value.lower() in ("1", "true", "yes", "on")
+    if typ is int:
+        return int(value)
+    if typ is float:
+        return float(value)
+    return value
+
+
+@dataclass
+class RuntimeConfig:
+    """Process-level runtime knobs (env prefix ``DYN_``)."""
+
+    # identity / cluster
+    namespace: str = "dynamo"
+    hub_address: str = ""  # "host:port" of the hub service; empty = in-memory
+    static: bool = False  # static mode: no discovery, fixed peers (ref lib.rs:205)
+
+    # data plane
+    host: str = "127.0.0.1"  # address workers advertise for their TCP listener
+    request_timeout_s: float = 600.0
+    connect_timeout_s: float = 5.0
+
+    # leases / health
+    lease_ttl_s: float = 10.0
+    keepalive_interval_s: float = 3.0
+    health_check_interval_s: float = 30.0
+    health_check_timeout_s: float = 10.0
+
+    # http frontend
+    http_port: int = 8000
+    system_port: int = 9090  # liveness/readiness/metrics server
+
+    # logging
+    log_level: str = "INFO"
+    log_jsonl: bool = False
+
+    # engine-side compute
+    block_size: int = 64  # KV cache block granularity (tokens/block)
+
+    extra: dict[str, Any] = field(default_factory=dict)
+
+    @classmethod
+    def from_env(cls, env: dict[str, str] | None = None) -> "RuntimeConfig":
+        env = dict(os.environ if env is None else env)
+        layers: dict[str, Any] = {}
+
+        cfg_path = env.get(_PREFIX + "CONFIG")
+        if cfg_path and Path(cfg_path).exists():
+            loaded = yaml.safe_load(Path(cfg_path).read_text()) or {}
+            if not isinstance(loaded, dict):
+                raise ValueError(f"config file {cfg_path} must be a mapping")
+            layers.update(loaded)
+
+        fields = {f.name: f for f in dataclasses.fields(cls)}
+        for key, raw in env.items():
+            if not key.startswith(_PREFIX):
+                continue
+            name = key[len(_PREFIX) :].lower()
+            if name != "extra" and name != "config":
+                layers[name] = raw  # known keys coerced below via default's type
+
+        known = {k: v for k, v in layers.items() if k in fields and k != "extra"}
+        extra = {k: v for k, v in layers.items() if k not in fields}
+        # dataclasses stores declared types as strings under future annotations;
+        # coerce via the default value's type instead.
+        defaults = cls()
+        for k, v in list(known.items()):
+            if isinstance(v, str):
+                known[k] = _coerce(v, type(getattr(defaults, k)))
+        return cls(**known, extra=extra)
+
+
+def config_from_env() -> RuntimeConfig:
+    return RuntimeConfig.from_env()

@@ -1,0 +1,94 @@
+"""Per-request context: identity, cancellation, tracing baggage.
+
+Mirrors the role of the reference's ``AsyncEngineContext``
+(lib/runtime/src/engine.rs:112 - ``stop_generating``, ``killed``) and the
+pipeline ``Context`` (lib/runtime/src/pipeline/context.rs): a handle that
+travels with a request through every operator and across process boundaries,
+letting any stage observe or trigger cancellation.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import uuid
+from typing import Any
+
+
+class StreamError(RuntimeError):
+    """A response stream died mid-flight (worker crash / connection loss).
+
+    The migration operator (frontend.migration) catches this to re-dispatch
+    the request to another worker; ref lib/llm/src/migration.rs STREAM_ERR_MSG.
+    """
+
+
+class Context:
+    """Cancellation + identity context for one in-flight request."""
+
+    def __init__(self, request_id: str | None = None, headers: dict[str, str] | None = None):
+        self.id: str = request_id or uuid.uuid4().hex
+        self.headers: dict[str, str] = headers or {}
+        self._stopped = asyncio.Event()
+        self._killed = asyncio.Event()
+        self._children: list[Context] = []
+
+    # -- cancellation ------------------------------------------------------
+
+    def stop_generating(self) -> None:
+        """Graceful cancel: finish the current step, emit no more tokens."""
+        self._stopped.set()
+        for c in self._children:
+            c.stop_generating()
+
+    def kill(self) -> None:
+        """Hard cancel: abandon the request immediately."""
+        self._killed.set()
+        self.stop_generating()
+
+    @property
+    def is_stopped(self) -> bool:
+        return self._stopped.is_set()
+
+    @property
+    def is_killed(self) -> bool:
+        return self._killed.is_set()
+
+    async def stopped(self) -> None:
+        await self._stopped.wait()
+
+    async def killed_or_stopped(self) -> None:
+        await self._stopped.wait()
+
+    def child(self, request_id: str | None = None) -> "Context":
+        """Derived context: cancelling the parent cancels the child."""
+        c = Context(request_id or self.id, dict(self.headers))
+        if self.is_stopped:
+            c.stop_generating()
+        if self.is_killed:
+            c.kill()
+        self._children.append(c)
+        return c
+
+    def link_task(self, task: asyncio.Task) -> None:
+        """Cancel ``task`` when this context is stopped."""
+
+        async def _watch() -> None:
+            await self._stopped.wait()
+            if not task.done():
+                task.cancel()
+
+        watcher = asyncio.get_running_loop().create_task(_watch())
+        task.add_done_callback(lambda _t: watcher.cancel())
+
+    def __repr__(self) -> str:  # pragma: no cover
+        state = "killed" if self.is_killed else "stopped" if self.is_stopped else "live"
+        return f"Context({self.id[:8]}, {state})"
+
+
+def ensure_context(ctx: Context | None) -> Context:
+    return ctx if ctx is not None else Context()
+
+
+def annotation(event: str, data: Any = None) -> dict[str, Any]:
+    """Out-of-band event envelope entry (ref protocols Annotated<T>)."""
+    return {"event": event, "data": data}

@@ -1,0 +1,57 @@
+"""The AsyncEngine abstraction: a streaming request -> response trait.
+
+Everything that serves requests in this framework - the JAX engine, the
+mocker, each pipeline operator (preprocessor, backend, migration, routers) -
+implements this one interface, so operators compose into pipelines and any
+stage can be moved across a process boundary. Ref: lib/runtime/src/engine.rs:201
+``AsyncEngine<SingleIn<Req>, ManyOut<Resp>, Error>``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, AsyncIterator, Protocol, runtime_checkable
+
+from dynamo_tpu.runtime.context import Context
+
+
+@runtime_checkable
+class AsyncEngine(Protocol):
+    """Streaming engine: one request in, many responses out."""
+
+    def generate(
+        self, request: Any, context: Context
+    ) -> AsyncIterator[Any]:  # pragma: no cover - protocol
+        ...
+
+
+class Annotated(dict):
+    """Response envelope: either a data item or an out-of-band event.
+
+    Ref: lib/llm/src/protocols Annotated<T> - carries ``data`` plus optional
+    ``event``/``comment`` used for annotations (e.g. routing metadata,
+    health-check probes) without polluting the data type.
+    """
+
+    @classmethod
+    def from_data(cls, data: Any) -> "Annotated":
+        return cls(data=data)
+
+    @classmethod
+    def from_event(cls, event: str, data: Any = None) -> "Annotated":
+        return cls(event=event, data=data)
+
+    @property
+    def data(self) -> Any:
+        return self.get("data")
+
+    @property
+    def event(self) -> str | None:
+        return self.get("event")
+
+    def is_error(self) -> bool:
+        return self.get("event") == "error"
+
+
+async def collect(stream: AsyncIterator[Any]) -> list[Any]:
+    """Drain a response stream into a list (test/CLI helper)."""
+    return [item async for item in stream]

@@ -1,0 +1,44 @@
+"""Length-prefixed msgpack framing shared by all TCP planes.
+
+Wire format: 4-byte big-endian unsigned length, then a msgpack-encoded map.
+Used by the hub protocol (hub_server/hub_client) and the request/response
+data plane (transport.py). Ref: the reference's two-part codec in
+lib/runtime/src/pipeline/network/codec.rs.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import struct
+from typing import Any
+
+import msgpack
+
+_LEN = struct.Struct(">I")
+MAX_FRAME = 512 * 1024 * 1024  # object-store blobs can be large
+
+
+def pack(msg: dict[str, Any]) -> bytes:
+    body = msgpack.packb(msg, use_bin_type=True)
+    return _LEN.pack(len(body)) + body
+
+
+async def read_frame(reader: asyncio.StreamReader) -> dict[str, Any] | None:
+    """Read one frame; None on clean EOF."""
+    try:
+        header = await reader.readexactly(_LEN.size)
+    except (asyncio.IncompleteReadError, ConnectionResetError):
+        return None
+    (length,) = _LEN.unpack(header)
+    if length > MAX_FRAME:
+        raise ValueError(f"frame too large: {length}")
+    try:
+        body = await reader.readexactly(length)
+    except (asyncio.IncompleteReadError, ConnectionResetError):
+        return None
+    return msgpack.unpackb(body, raw=False)
+
+
+async def write_frame(writer: asyncio.StreamWriter, msg: dict[str, Any]) -> None:
+    writer.write(pack(msg))
+    await writer.drain()

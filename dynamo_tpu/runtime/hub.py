@@ -1,0 +1,287 @@
+"""The hub: cluster coordination service (discovery + events + objects).
+
+The reference requires two external services - etcd (discovery, leases, model
+cards, config watches; lib/runtime/src/transports/etcd.rs) and NATS (request
+transport, JetStream KV-event streams, object store; transports/nats.rs). This
+framework self-hosts one small coordination service with the union of the
+*capabilities actually used*:
+
+  - lease-scoped KV store with atomic create and prefix watches (etcd role)
+  - pub/sub subjects with wildcard suffix match (JetStream event-stream role)
+  - object store buckets (NATS object-store role: model cards, router snapshots)
+
+Requests do NOT flow through the hub - the data plane is direct worker TCP
+(see transport.py) - so the hub stays off the hot path, like etcd/NATS-core in
+the reference. ``InMemoryHub`` backs single-process tests; ``hub_server.py``
+exposes the same interface over TCP for multi-process deployments.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import fnmatch
+import time
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Any, AsyncIterator
+
+__all__ = ["WatchEvent", "Hub", "InMemoryHub", "KeyExists"]
+
+
+class KeyExists(Exception):
+    """Atomic create failed: key already present."""
+
+
+@dataclass(frozen=True)
+class WatchEvent:
+    """One KV mutation delivered to a prefix watcher."""
+
+    kind: str  # "put" | "delete"
+    key: str
+    value: Any = None
+
+
+@dataclass
+class _Lease:
+    lease_id: int
+    ttl: float
+    deadline: float
+    keys: set[str] = field(default_factory=set)
+
+
+class Hub:
+    """Abstract hub interface (see module docstring)."""
+
+    # -- kv ---------------------------------------------------------------
+    async def put(self, key: str, value: Any, lease_id: int | None = None) -> None:
+        raise NotImplementedError
+
+    async def create(self, key: str, value: Any, lease_id: int | None = None) -> None:
+        """Atomic create: raise KeyExists if the key is already present."""
+        raise NotImplementedError
+
+    async def get(self, key: str) -> Any:
+        raise NotImplementedError
+
+    async def delete(self, key: str) -> bool:
+        raise NotImplementedError
+
+    async def get_prefix(self, prefix: str) -> dict[str, Any]:
+        raise NotImplementedError
+
+    def watch_prefix(
+        self, prefix: str, *, initial: bool = True
+    ) -> AsyncIterator[WatchEvent]:
+        """Stream of WatchEvents for keys under ``prefix``.
+
+        With ``initial=True`` the current contents are replayed as synthetic
+        "put" events first (ref etcd.rs kv_get_and_watch_prefix).
+        """
+        raise NotImplementedError
+
+    # -- leases ------------------------------------------------------------
+    async def grant_lease(self, ttl_s: float) -> int:
+        raise NotImplementedError
+
+    async def keepalive(self, lease_id: int) -> bool:
+        raise NotImplementedError
+
+    async def revoke_lease(self, lease_id: int) -> None:
+        raise NotImplementedError
+
+    # -- pub/sub -----------------------------------------------------------
+    async def publish(self, subject: str, payload: Any) -> None:
+        raise NotImplementedError
+
+    def subscribe(
+        self, subject: str, *, replay: bool = False
+    ) -> AsyncIterator[tuple[str, Any]]:
+        """Subscribe to a subject; ``*`` suffix wildcard supported.
+
+        With ``replay=True`` retained history for the subject is delivered
+        first (JetStream-style persistent stream: late subscribers catch up
+        on e.g. KV cache events published before they joined).
+        """
+        raise NotImplementedError
+
+    # -- object store ------------------------------------------------------
+    async def put_object(self, bucket: str, name: str, data: bytes) -> None:
+        raise NotImplementedError
+
+    async def get_object(self, bucket: str, name: str) -> bytes | None:
+        raise NotImplementedError
+
+    async def delete_object(self, bucket: str, name: str) -> None:
+        raise NotImplementedError
+
+    async def close(self) -> None:
+        pass
+
+
+class InMemoryHub(Hub):
+    """Single-process hub; also the core logic reused by the TCP hub server."""
+
+    RETAIN_PER_SUBJECT = 65536
+
+    def __init__(self) -> None:
+        self._retained: dict[str, deque] = {}  # subject -> recent payloads
+        self._kv: dict[str, Any] = {}
+        self._key_lease: dict[str, int] = {}
+        self._leases: dict[int, _Lease] = {}
+        self._next_lease = 1
+        self._watchers: list[tuple[str, asyncio.Queue]] = []
+        self._subs: list[tuple[str, asyncio.Queue]] = []
+        self._objects: dict[tuple[str, str], bytes] = {}
+        self._reaper: asyncio.Task | None = None
+        self._closed = False
+
+    # -- internals ---------------------------------------------------------
+
+    def _notify(self, ev: WatchEvent) -> None:
+        for prefix, q in self._watchers:
+            if ev.key.startswith(prefix):
+                q.put_nowait(ev)
+
+    def _ensure_reaper(self) -> None:
+        if self._reaper is None or self._reaper.done():
+            self._reaper = asyncio.get_running_loop().create_task(self._reap_loop())
+
+    async def _reap_loop(self) -> None:
+        while not self._closed:
+            await asyncio.sleep(0.5)
+            self.reap_expired()
+
+    def reap_expired(self, now: float | None = None) -> list[int]:
+        """Expire leases whose deadline passed; drop their keys. Returns ids."""
+        now = time.monotonic() if now is None else now
+        expired = [l for l in self._leases.values() if l.deadline <= now]
+        for lease in expired:
+            self._drop_lease(lease)
+        return [l.lease_id for l in expired]
+
+    def _drop_lease(self, lease: _Lease) -> None:
+        self._leases.pop(lease.lease_id, None)
+        for key in sorted(lease.keys):
+            if self._kv.pop(key, None) is not None:
+                self._key_lease.pop(key, None)
+                self._notify(WatchEvent("delete", key))
+
+    # -- kv ---------------------------------------------------------------
+
+    async def put(self, key: str, value: Any, lease_id: int | None = None) -> None:
+        if lease_id is not None:
+            lease = self._leases.get(lease_id)
+            if lease is None:
+                raise ValueError(f"unknown lease {lease_id}")
+            lease.keys.add(key)
+            self._key_lease[key] = lease_id
+        self._kv[key] = value
+        self._notify(WatchEvent("put", key, value))
+
+    async def create(self, key: str, value: Any, lease_id: int | None = None) -> None:
+        if key in self._kv:
+            raise KeyExists(key)
+        await self.put(key, value, lease_id)
+
+    async def get(self, key: str) -> Any:
+        return self._kv.get(key)
+
+    async def delete(self, key: str) -> bool:
+        if key in self._kv:
+            del self._kv[key]
+            lease_id = self._key_lease.pop(key, None)
+            if lease_id is not None and lease_id in self._leases:
+                self._leases[lease_id].keys.discard(key)
+            self._notify(WatchEvent("delete", key))
+            return True
+        return False
+
+    async def get_prefix(self, prefix: str) -> dict[str, Any]:
+        return {k: v for k, v in self._kv.items() if k.startswith(prefix)}
+
+    async def watch_prefix(
+        self, prefix: str, *, initial: bool = True
+    ) -> AsyncIterator[WatchEvent]:
+        q: asyncio.Queue = asyncio.Queue()
+        snapshot = (
+            [WatchEvent("put", k, v) for k, v in sorted(self._kv.items()) if k.startswith(prefix)]
+            if initial
+            else []
+        )
+        self._watchers.append((prefix, q))
+        try:
+            for ev in snapshot:
+                yield ev
+            while True:
+                yield await q.get()
+        finally:
+            self._watchers.remove((prefix, q))
+
+    # -- leases ------------------------------------------------------------
+
+    async def grant_lease(self, ttl_s: float) -> int:
+        self._ensure_reaper()
+        lease_id = self._next_lease
+        self._next_lease += 1
+        self._leases[lease_id] = _Lease(
+            lease_id, ttl_s, time.monotonic() + ttl_s
+        )
+        return lease_id
+
+    async def keepalive(self, lease_id: int) -> bool:
+        lease = self._leases.get(lease_id)
+        if lease is None:
+            return False
+        lease.deadline = time.monotonic() + lease.ttl
+        return True
+
+    async def revoke_lease(self, lease_id: int) -> None:
+        lease = self._leases.get(lease_id)
+        if lease is not None:
+            self._drop_lease(lease)
+
+    # -- pub/sub -----------------------------------------------------------
+
+    async def publish(self, subject: str, payload: Any) -> None:
+        if subject not in self._retained:
+            self._retained[subject] = deque(maxlen=self.RETAIN_PER_SUBJECT)
+        self._retained[subject].append(payload)
+        for pattern, q in self._subs:
+            if fnmatch.fnmatchcase(subject, pattern):
+                q.put_nowait((subject, payload))
+
+    async def subscribe(
+        self, subject: str, *, replay: bool = False
+    ) -> AsyncIterator[tuple[str, Any]]:
+        # Snapshot history, then register live - both synchronous, so no gap
+        # (single-threaded event loop) and no duplicates.
+        backlog: list[tuple[str, Any]] = []
+        if replay:
+            for subj in sorted(self._retained):
+                if fnmatch.fnmatchcase(subj, subject):
+                    backlog.extend((subj, p) for p in self._retained[subj])
+        q: asyncio.Queue = asyncio.Queue()
+        self._subs.append((subject, q))
+        try:
+            for item in backlog:
+                yield item
+            while True:
+                yield await q.get()
+        finally:
+            self._subs.remove((subject, q))
+
+    # -- object store ------------------------------------------------------
+
+    async def put_object(self, bucket: str, name: str, data: bytes) -> None:
+        self._objects[(bucket, name)] = bytes(data)
+
+    async def get_object(self, bucket: str, name: str) -> bytes | None:
+        return self._objects.get((bucket, name))
+
+    async def delete_object(self, bucket: str, name: str) -> None:
+        self._objects.pop((bucket, name), None)
+
+    async def close(self) -> None:
+        self._closed = True
+        if self._reaper is not None:
+            self._reaper.cancel()

@@ -1,0 +1,192 @@
+"""TCP client for the hub service - same interface as InMemoryHub.
+
+One connection per client, request/response multiplexed by message id;
+watch/subscribe streams fan out to per-stream queues. Reconnection is the
+caller's concern (workers treat hub loss as fatal after retries, mirroring
+the reference's etcd-loss => shutdown behavior, lib/runtime/src/lib.rs).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import itertools
+from typing import Any, AsyncIterator
+
+from dynamo_tpu.runtime import framing
+from dynamo_tpu.runtime.hub import Hub, KeyExists, WatchEvent
+
+
+class RemoteHub(Hub):
+    def __init__(self, address: str):
+        host, _, port = address.rpartition(":")
+        self._host, self._port = host or "127.0.0.1", int(port)
+        self._reader: asyncio.StreamReader | None = None
+        self._writer: asyncio.StreamWriter | None = None
+        self._ids = itertools.count(1)
+        self._pending: dict[int, asyncio.Future] = {}
+        self._streams: dict[int, asyncio.Queue] = {}
+        self._rx_task: asyncio.Task | None = None
+        self._write_lock = asyncio.Lock()
+        self._closed = False
+
+    @classmethod
+    async def connect(cls, address: str, timeout: float = 5.0) -> "RemoteHub":
+        hub = cls(address)
+        await hub._connect(timeout)
+        return hub
+
+    async def _connect(self, timeout: float = 5.0) -> None:
+        self._reader, self._writer = await asyncio.wait_for(
+            asyncio.open_connection(self._host, self._port), timeout
+        )
+        self._rx_task = asyncio.get_running_loop().create_task(self._rx_loop())
+
+    async def _rx_loop(self) -> None:
+        assert self._reader is not None
+        while True:
+            msg = await framing.read_frame(self._reader)
+            if msg is None:
+                break
+            mid = msg.get("id")
+            if "stream" in msg:
+                q = self._streams.get(mid)
+                if q is not None:
+                    q.put_nowait(msg["stream"])
+            else:
+                fut = self._pending.pop(mid, None)
+                if fut is not None and not fut.done():
+                    fut.set_result(msg)
+        # connection lost: fail everything
+        err = ConnectionError("hub connection lost")
+        for fut in self._pending.values():
+            if not fut.done():
+                fut.set_exception(err)
+        self._pending.clear()
+        for q in self._streams.values():
+            q.put_nowait(None)  # sentinel: stream closed
+
+    async def _call(self, op: str, **kwargs: Any) -> Any:
+        if self._writer is None:
+            raise ConnectionError("hub not connected")
+        mid = next(self._ids)
+        fut: asyncio.Future = asyncio.get_running_loop().create_future()
+        self._pending[mid] = fut
+        async with self._write_lock:
+            await framing.write_frame(self._writer, {"id": mid, "op": op, **kwargs})
+        msg = await fut
+        if not msg.get("ok"):
+            if msg.get("error") == "key_exists":
+                raise KeyExists(msg.get("key"))
+            raise RuntimeError(f"hub error for {op}: {msg.get('error')}")
+        return msg.get("result")
+
+    async def _open_stream(self, op: str, **kwargs: Any) -> tuple[int, asyncio.Queue]:
+        if self._writer is None:
+            raise ConnectionError("hub not connected")
+        mid = next(self._ids)
+        q: asyncio.Queue = asyncio.Queue()
+        self._streams[mid] = q
+        async with self._write_lock:
+            await framing.write_frame(self._writer, {"id": mid, "op": op, **kwargs})
+        return mid, q
+
+    async def _close_stream(self, mid: int) -> None:
+        self._streams.pop(mid, None)
+        if self._writer is not None and not self._closed:
+            try:
+                async with self._write_lock:
+                    await framing.write_frame(
+                        self._writer, {"id": next(self._ids), "op": "cancel", "target": mid}
+                    )
+            except (ConnectionError, RuntimeError):
+                pass
+
+    # -- kv ---------------------------------------------------------------
+
+    async def put(self, key: str, value: Any, lease_id: int | None = None) -> None:
+        await self._call("put", key=key, value=value, lease=lease_id)
+
+    async def create(self, key: str, value: Any, lease_id: int | None = None) -> None:
+        await self._call("create", key=key, value=value, lease=lease_id)
+
+    async def get(self, key: str) -> Any:
+        return await self._call("get", key=key)
+
+    async def delete(self, key: str) -> bool:
+        return await self._call("delete", key=key)
+
+    async def get_prefix(self, prefix: str) -> dict[str, Any]:
+        return await self._call("get_prefix", prefix=prefix)
+
+    async def watch_prefix(
+        self, prefix: str, *, initial: bool = True
+    ) -> AsyncIterator[WatchEvent]:
+        mid, q = await self._open_stream("watch", prefix=prefix, initial=initial)
+        try:
+            while True:
+                item = await q.get()
+                if item is None:
+                    raise ConnectionError("hub connection lost during watch")
+                yield WatchEvent(item["kind"], item["key"], item.get("value"))
+        finally:
+            await self._close_stream(mid)
+
+    # -- leases ------------------------------------------------------------
+
+    async def grant_lease(self, ttl_s: float) -> int:
+        return await self._call("grant_lease", ttl=ttl_s)
+
+    async def keepalive(self, lease_id: int) -> bool:
+        return await self._call("keepalive", lease=lease_id)
+
+    async def revoke_lease(self, lease_id: int) -> None:
+        await self._call("revoke_lease", lease=lease_id)
+
+    # -- pub/sub -----------------------------------------------------------
+
+    async def publish(self, subject: str, payload: Any) -> None:
+        await self._call("publish", subject=subject, payload=payload)
+
+    async def subscribe(
+        self, subject: str, *, replay: bool = False
+    ) -> AsyncIterator[tuple[str, Any]]:
+        mid, q = await self._open_stream("subscribe", subject=subject, replay=replay)
+        try:
+            while True:
+                item = await q.get()
+                if item is None:
+                    raise ConnectionError("hub connection lost during subscribe")
+                yield item["subject"], item["payload"]
+        finally:
+            await self._close_stream(mid)
+
+    # -- object store ------------------------------------------------------
+
+    async def put_object(self, bucket: str, name: str, data: bytes) -> None:
+        await self._call("put_object", bucket=bucket, name=name, data=bytes(data))
+
+    async def get_object(self, bucket: str, name: str) -> bytes | None:
+        return await self._call("get_object", bucket=bucket, name=name)
+
+    async def delete_object(self, bucket: str, name: str) -> None:
+        await self._call("delete_object", bucket=bucket, name=name)
+
+    async def close(self) -> None:
+        self._closed = True
+        if self._rx_task is not None:
+            self._rx_task.cancel()
+        if self._writer is not None:
+            self._writer.close()
+            try:
+                await self._writer.wait_closed()
+            except (ConnectionError, OSError):
+                pass
+
+
+async def connect_hub(address: str | None) -> Hub:
+    """Connect to a remote hub, or fall back to a process-local one."""
+    from dynamo_tpu.runtime.hub import InMemoryHub
+
+    if address:
+        return await RemoteHub.connect(address)
+    return InMemoryHub()

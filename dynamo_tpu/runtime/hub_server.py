@@ -1,0 +1,233 @@
+"""TCP server exposing an InMemoryHub to many processes.
+
+Run as ``python -m dynamo_tpu.runtime.hub_server [--port N]`` - this is the
+deployment's single coordination process, playing the role etcd + NATS play
+for the reference (SURVEY.md section 2.4). State is in-memory (like NATS
+core); router snapshots / model cards that must survive restarts go through
+the object store API which can be pointed at disk via --data-dir.
+
+Protocol: framing.py frames. Request: ``{"id": n, "op": str, ...}`` ->
+response ``{"id": n, "ok": bool, "result"/"error": ...}``. Streaming ops
+(``watch``, ``subscribe``) emit ``{"id": n, "stream": item}`` frames until the
+client sends ``{"op": "cancel", "target": n}``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import logging
+from pathlib import Path
+from typing import Any
+
+from dynamo_tpu.runtime import framing
+from dynamo_tpu.runtime.hub import InMemoryHub, KeyExists
+
+log = logging.getLogger("dynamo.hub")
+
+
+class HubServer:
+    def __init__(self, host: str = "127.0.0.1", port: int = 0, data_dir: str | None = None):
+        self.hub = InMemoryHub()
+        self.host = host
+        self.port = port
+        self._server: asyncio.AbstractServer | None = None
+        self._conns: set[asyncio.StreamWriter] = set()
+        self._data_dir = Path(data_dir) if data_dir else None
+        if self._data_dir:
+            self._data_dir.mkdir(parents=True, exist_ok=True)
+
+    async def start(self) -> tuple[str, int]:
+        self._server = await asyncio.start_server(self._handle, self.host, self.port)
+        sock = self._server.sockets[0]
+        self.host, self.port = sock.getsockname()[:2]
+        log.info("hub listening on %s:%d", self.host, self.port)
+        return self.host, self.port
+
+    async def serve_forever(self) -> None:
+        assert self._server is not None
+        async with self._server:
+            await self._server.serve_forever()
+
+    async def stop(self) -> None:
+        if self._server is not None:
+            self._server.close()
+        # close peer connections: on 3.12+ wait_closed() blocks until every
+        # client connection handler has finished.
+        for w in list(self._conns):
+            w.close()
+        if self._server is not None:
+            try:
+                await asyncio.wait_for(self._server.wait_closed(), timeout=5)
+            except asyncio.TimeoutError:  # pragma: no cover
+                pass
+        await self.hub.close()
+
+    # -- per-connection ----------------------------------------------------
+
+    async def _handle(
+        self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter
+    ) -> None:
+        streams: dict[int, asyncio.Task] = {}
+        conn_leases: set[int] = set()
+        write_lock = asyncio.Lock()
+        self._conns.add(writer)
+
+        async def send(msg: dict[str, Any]) -> None:
+            async with write_lock:
+                await framing.write_frame(writer, msg)
+
+        try:
+            while True:
+                msg = await framing.read_frame(reader)
+                if msg is None:
+                    break
+                asyncio.ensure_future(
+                    self._dispatch(msg, send, streams, conn_leases)
+                )
+        except (ConnectionResetError, BrokenPipeError):
+            pass
+        finally:
+            for t in streams.values():
+                t.cancel()
+            # leases are NOT revoked on disconnect: clients may reconnect and
+            # keepalive; expiry is governed solely by TTL (like etcd).
+            self._conns.discard(writer)
+            writer.close()
+
+    async def _dispatch(
+        self,
+        msg: dict[str, Any],
+        send,
+        streams: dict[int, asyncio.Task],
+        conn_leases: set[int],
+    ) -> None:
+        op = msg.get("op")
+        mid = msg.get("id")
+        hub = self.hub
+        try:
+            if op == "put":
+                await hub.put(msg["key"], msg["value"], msg.get("lease"))
+                result: Any = True
+            elif op == "create":
+                await hub.create(msg["key"], msg["value"], msg.get("lease"))
+                result = True
+            elif op == "get":
+                result = await hub.get(msg["key"])
+            elif op == "delete":
+                result = await hub.delete(msg["key"])
+            elif op == "get_prefix":
+                result = await hub.get_prefix(msg["prefix"])
+            elif op == "grant_lease":
+                result = await hub.grant_lease(msg["ttl"])
+                conn_leases.add(result)
+            elif op == "keepalive":
+                result = await hub.keepalive(msg["lease"])
+            elif op == "revoke_lease":
+                await hub.revoke_lease(msg["lease"])
+                result = True
+            elif op == "publish":
+                await hub.publish(msg["subject"], msg["payload"])
+                result = True
+            elif op == "put_object":
+                await self._put_object(msg["bucket"], msg["name"], msg["data"])
+                result = True
+            elif op == "get_object":
+                result = await self._get_object(msg["bucket"], msg["name"])
+            elif op == "delete_object":
+                await hub.delete_object(msg["bucket"], msg["name"])
+                result = True
+            elif op == "watch":
+                streams[mid] = asyncio.ensure_future(
+                    self._stream_watch(mid, msg["prefix"], msg.get("initial", True), send)
+                )
+                return  # stream frames only; no immediate ack
+            elif op == "subscribe":
+                streams[mid] = asyncio.ensure_future(
+                    self._stream_subscribe(
+                        mid, msg["subject"], msg.get("replay", False), send
+                    )
+                )
+                return
+            elif op == "cancel":
+                t = streams.pop(msg["target"], None)
+                if t:
+                    t.cancel()
+                result = True
+            elif op == "ping":
+                result = "pong"
+            else:
+                raise ValueError(f"unknown op {op!r}")
+            await send({"id": mid, "ok": True, "result": result})
+        except KeyExists as e:
+            await send({"id": mid, "ok": False, "error": "key_exists", "key": str(e)})
+        except asyncio.CancelledError:
+            raise
+        except Exception as e:  # noqa: BLE001 - serve errors to the client
+            await send({"id": mid, "ok": False, "error": repr(e)})
+
+    async def _stream_watch(self, mid: int, prefix: str, initial: bool, send) -> None:
+        try:
+            async for ev in self.hub.watch_prefix(prefix, initial=initial):
+                await send(
+                    {"id": mid, "stream": {"kind": ev.kind, "key": ev.key, "value": ev.value}}
+                )
+        except asyncio.CancelledError:
+            pass
+        except (ConnectionResetError, BrokenPipeError):
+            pass
+
+    async def _stream_subscribe(self, mid: int, subject: str, replay: bool, send) -> None:
+        try:
+            async for subj, payload in self.hub.subscribe(subject, replay=replay):
+                await send({"id": mid, "stream": {"subject": subj, "payload": payload}})
+        except asyncio.CancelledError:
+            pass
+        except (ConnectionResetError, BrokenPipeError):
+            pass
+
+    # -- object store with optional disk persistence -----------------------
+
+    def _obj_path(self, bucket: str, name: str) -> Path:
+        safe = name.replace("/", "_")
+        return self._data_dir / bucket / safe  # type: ignore[operator]
+
+    async def _put_object(self, bucket: str, name: str, data: bytes) -> None:
+        await self.hub.put_object(bucket, name, data)
+        if self._data_dir:
+            p = self._obj_path(bucket, name)
+            p.parent.mkdir(parents=True, exist_ok=True)
+            p.write_bytes(data)
+
+    async def _get_object(self, bucket: str, name: str) -> bytes | None:
+        data = await self.hub.get_object(bucket, name)
+        if data is None and self._data_dir:
+            p = self._obj_path(bucket, name)
+            if p.exists():
+                data = p.read_bytes()
+                await self.hub.put_object(bucket, name, data)
+        return data
+
+
+async def _amain(args: argparse.Namespace) -> None:
+    server = HubServer(args.host, args.port, args.data_dir)
+    await server.start()
+    print(f"DYNAMO_HUB={server.host}:{server.port}", flush=True)
+    await server.serve_forever()
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser(description="dynamo-tpu hub (coordination service)")
+    parser.add_argument("--host", default="127.0.0.1")
+    parser.add_argument("--port", type=int, default=6650)
+    parser.add_argument("--data-dir", default=None)
+    args = parser.parse_args()
+    logging.basicConfig(level=logging.INFO)
+    try:
+        asyncio.run(_amain(args))
+    except KeyboardInterrupt:
+        pass
+
+
+if __name__ == "__main__":
+    main()

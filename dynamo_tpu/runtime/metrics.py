@@ -1,0 +1,71 @@
+"""Hierarchical metrics registry (ref lib/runtime/src/metrics.rs).
+
+Thin layer over prometheus_client: one CollectorRegistry per
+DistributedRuntime, metric names auto-prefixed ``dynamo_`` with
+namespace/component/endpoint labels, exposition as Prometheus text via the
+frontend's /metrics route and the system status server.
+"""
+
+from __future__ import annotations
+
+from typing import Iterable
+
+from prometheus_client import (
+    CollectorRegistry,
+    Counter,
+    Gauge,
+    Histogram,
+    generate_latest,
+)
+
+PREFIX = "dynamo_"
+
+# Buckets tuned for LLM serving latencies (seconds).
+LATENCY_BUCKETS = (
+    0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+    1.0, 2.5, 5.0, 10.0, 30.0, 60.0, 120.0, 300.0,
+)
+
+
+class MetricsRegistry:
+    def __init__(self, labels: dict[str, str] | None = None):
+        self.registry = CollectorRegistry()
+        self.const_labels = labels or {}
+        self._metrics: dict[str, object] = {}
+
+    def _full(self, name: str) -> str:
+        return name if name.startswith(PREFIX) else PREFIX + name
+
+    def counter(self, name: str, doc: str, labelnames: Iterable[str] = ()) -> Counter:
+        key = "c:" + name
+        if key not in self._metrics:
+            self._metrics[key] = Counter(
+                self._full(name), doc, list(labelnames), registry=self.registry
+            )
+        return self._metrics[key]  # type: ignore[return-value]
+
+    def gauge(self, name: str, doc: str, labelnames: Iterable[str] = ()) -> Gauge:
+        key = "g:" + name
+        if key not in self._metrics:
+            self._metrics[key] = Gauge(
+                self._full(name), doc, list(labelnames), registry=self.registry
+            )
+        return self._metrics[key]  # type: ignore[return-value]
+
+    def histogram(
+        self,
+        name: str,
+        doc: str,
+        labelnames: Iterable[str] = (),
+        buckets: tuple = LATENCY_BUCKETS,
+    ) -> Histogram:
+        key = "h:" + name
+        if key not in self._metrics:
+            self._metrics[key] = Histogram(
+                self._full(name), doc, list(labelnames),
+                buckets=buckets, registry=self.registry,
+            )
+        return self._metrics[key]  # type: ignore[return-value]
+
+    def exposition(self) -> bytes:
+        return generate_latest(self.registry)

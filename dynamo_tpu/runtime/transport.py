@@ -1,0 +1,294 @@
+"""Request/response data plane: direct TCP streaming to workers.
+
+The reference splits its data plane across NATS (request push) and a
+call-home TCP response stream (lib/runtime/src/pipeline/network/). Here both
+directions ride one direct TCP connection from client to worker: each worker
+process runs a single ``EndpointServer``; all of its endpoints share it,
+demultiplexed by endpoint path. Multiple in-flight requests are multiplexed
+per connection by request id.
+
+Frames (framing.py msgpack):
+  client -> worker: {"kind": "req", "req": id, "path": str, "payload": ..., "headers": {}}
+                    {"kind": "cancel", "req": id}
+  worker -> client: {"kind": "data", "req": id, "payload": ...}
+                    {"kind": "end", "req": id}
+                    {"kind": "err", "req": id, "error": str}
+
+In-process instances short-circuit the wire entirely (LocalRegistry), which
+is what hermetic tests and single-process deployments use.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import uuid
+from typing import Any, AsyncIterator, Awaitable, Callable
+
+from dynamo_tpu.runtime import framing
+from dynamo_tpu.runtime.context import Context, StreamError
+
+log = logging.getLogger("dynamo.transport")
+
+Handler = Callable[[Any, Context], AsyncIterator[Any]]
+
+
+class LocalRegistry:
+    """Process-local instance registry for zero-copy in-proc dispatch."""
+
+    def __init__(self) -> None:
+        self._handlers: dict[str, Handler] = {}
+
+    def register(self, path: str, handler: Handler) -> None:
+        self._handlers[path] = handler
+
+    def unregister(self, path: str) -> None:
+        self._handlers.pop(path, None)
+
+    def get(self, path: str) -> Handler | None:
+        return self._handlers.get(path)
+
+
+class EndpointServer:
+    """Worker-side TCP listener serving all endpoints of one process."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        self.host = host
+        self.port = port
+        self._handlers: dict[str, Handler] = {}
+        self._server: asyncio.AbstractServer | None = None
+        self._inflight: set[asyncio.Task] = set()
+        self._conns: set[asyncio.StreamWriter] = set()
+        self.draining = False
+
+    def register(self, path: str, handler: Handler) -> None:
+        self._handlers[path] = handler
+
+    def unregister(self, path: str) -> None:
+        self._handlers.pop(path, None)
+
+    async def start(self) -> tuple[str, int]:
+        self._server = await asyncio.start_server(self._handle, self.host, self.port)
+        self.host, self.port = self._server.sockets[0].getsockname()[:2]
+        return self.host, self.port
+
+    async def stop(self, drain: bool = True, timeout: float = 30.0) -> None:
+        """Stop accepting; optionally wait for in-flight requests to finish."""
+        self.draining = True
+        if self._server is not None:
+            self._server.close()
+        if drain and self._inflight:
+            await asyncio.wait(self._inflight, timeout=timeout)
+        for t in self._inflight:
+            t.cancel()
+        # Actively close peer connections: from 3.12 Server.wait_closed()
+        # blocks until every client connection is gone.
+        for w in list(self._conns):
+            w.close()
+        if self._server is not None:
+            try:
+                await asyncio.wait_for(self._server.wait_closed(), timeout=5)
+            except asyncio.TimeoutError:  # pragma: no cover
+                pass
+
+    @property
+    def num_inflight(self) -> int:
+        return len(self._inflight)
+
+    async def _handle(
+        self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter
+    ) -> None:
+        write_lock = asyncio.Lock()
+        contexts: dict[str, Context] = {}
+        self._conns.add(writer)
+
+        async def send(msg: dict[str, Any]) -> None:
+            async with write_lock:
+                await framing.write_frame(writer, msg)
+
+        try:
+            while True:
+                msg = await framing.read_frame(reader)
+                if msg is None:
+                    break
+                kind = msg.get("kind")
+                if kind == "req":
+                    # Register the context BEFORE scheduling the handler task:
+                    # a cancel frame in the same read buffer must find it.
+                    ctx = Context(
+                        request_id=msg["req"], headers=msg.get("headers") or {}
+                    )
+                    contexts[msg["req"]] = ctx
+                    task = asyncio.ensure_future(
+                        self._serve_request(msg, ctx, send, contexts)
+                    )
+                    self._inflight.add(task)
+                    task.add_done_callback(self._inflight.discard)
+                elif kind == "cancel":
+                    ctx = contexts.get(msg["req"])
+                    if ctx is not None:
+                        ctx.stop_generating()
+        except (ConnectionResetError, BrokenPipeError):
+            pass
+        finally:
+            # peer gone: cancel everything it had in flight here
+            for ctx in contexts.values():
+                ctx.kill()
+            self._conns.discard(writer)
+            writer.close()
+
+    async def _serve_request(
+        self, msg: dict[str, Any], ctx: Context, send, contexts: dict[str, Context]
+    ) -> None:
+        req_id = msg["req"]
+        path = msg.get("path", "")
+        handler = self._handlers.get(path)
+        if handler is None or self.draining:
+            reason = "draining" if self.draining else f"no handler for {path!r}"
+            contexts.pop(req_id, None)
+            try:
+                await send({"kind": "err", "req": req_id, "error": reason})
+            except (ConnectionError, RuntimeError):
+                pass
+            return
+        try:
+            async for item in handler(msg.get("payload"), ctx):
+                if ctx.is_killed:
+                    break
+                await send({"kind": "data", "req": req_id, "payload": item})
+            if not ctx.is_killed:
+                await send({"kind": "end", "req": req_id})
+        except (ConnectionResetError, BrokenPipeError):
+            ctx.kill()
+        except asyncio.CancelledError:
+            ctx.kill()
+            raise
+        except Exception as e:  # noqa: BLE001 - report handler errors to the peer
+            log.exception("handler error on %s", path)
+            try:
+                await send({"kind": "err", "req": req_id, "error": repr(e)})
+            except (ConnectionError, RuntimeError):
+                pass
+        finally:
+            contexts.pop(req_id, None)
+
+
+class InstanceChannel:
+    """Client-side multiplexed connection to one worker instance."""
+
+    def __init__(self, host: str, port: int):
+        self.host, self.port = host, port
+        self._reader: asyncio.StreamReader | None = None
+        self._writer: asyncio.StreamWriter | None = None
+        self._queues: dict[str, asyncio.Queue] = {}
+        self._rx: asyncio.Task | None = None
+        self._lock = asyncio.Lock()
+        self._closed = False
+
+    async def connect(self, timeout: float = 5.0) -> None:
+        self._reader, self._writer = await asyncio.wait_for(
+            asyncio.open_connection(self.host, self.port), timeout
+        )
+        self._rx = asyncio.get_running_loop().create_task(self._rx_loop())
+
+    @property
+    def connected(self) -> bool:
+        return self._writer is not None and not self._closed
+
+    async def _rx_loop(self) -> None:
+        assert self._reader is not None
+        while True:
+            msg = await framing.read_frame(self._reader)
+            if msg is None:
+                break
+            q = self._queues.get(msg.get("req"))
+            if q is not None:
+                q.put_nowait(msg)
+        self._closed = True
+        for q in self._queues.values():
+            q.put_nowait(None)  # stream death sentinel
+
+    async def call(
+        self, path: str, payload: Any, context: Context
+    ) -> AsyncIterator[Any]:
+        """Issue a request; yields response payloads; raises StreamError on
+        mid-stream connection death (the migration trigger)."""
+        if not self.connected:
+            raise StreamError(f"not connected to {self.host}:{self.port}")
+        req_id = context.id or uuid.uuid4().hex
+        q: asyncio.Queue = asyncio.Queue()
+        self._queues[req_id] = q
+        try:
+            async with self._lock:
+                await framing.write_frame(
+                    self._writer,
+                    {
+                        "kind": "req",
+                        "req": req_id,
+                        "path": path,
+                        "payload": payload,
+                        "headers": context.headers,
+                    },
+                )
+        except (ConnectionError, RuntimeError) as e:
+            self._queues.pop(req_id, None)
+            raise StreamError(f"send failed: {e}") from e
+
+        cancel_task = asyncio.ensure_future(self._watch_cancel(req_id, context))
+        finished = False
+        try:
+            while True:
+                msg = await q.get()
+                if msg is None:
+                    finished = True
+                    raise StreamError("response stream died (worker lost)")
+                kind = msg["kind"]
+                if kind == "data":
+                    yield msg["payload"]
+                elif kind == "end":
+                    finished = True
+                    return
+                elif kind == "err":
+                    finished = True
+                    raise RuntimeError(msg.get("error", "remote error"))
+        finally:
+            cancel_task.cancel()
+            self._queues.pop(req_id, None)
+            if not finished:
+                # Consumer abandoned the stream (break / exception upstream):
+                # tell the worker to stop generating. Fire-and-forget - we may
+                # be inside GeneratorExit where awaiting is restricted.
+                asyncio.ensure_future(self._send_cancel(req_id))
+
+    async def _watch_cancel(self, req_id: str, context: Context) -> None:
+        await context.stopped()
+        await self._send_cancel(req_id)
+
+    async def _send_cancel(self, req_id: str) -> None:
+        if self.connected:
+            try:
+                async with self._lock:
+                    await framing.write_frame(
+                        self._writer, {"kind": "cancel", "req": req_id}
+                    )
+            except (ConnectionError, RuntimeError):
+                pass
+
+    async def close(self) -> None:
+        self._closed = True
+        if self._rx is not None:
+            self._rx.cancel()
+        if self._writer is not None:
+            self._writer.close()
+            try:
+                await self._writer.wait_closed()
+            except (ConnectionError, OSError):
+                pass
+
+
+async def call_local(
+    handler: Handler, payload: Any, context: Context
+) -> AsyncIterator[Any]:
+    """In-process dispatch path (no serialization)."""
+    async for item in handler(payload, context):
+        yield item

@@ -1,0 +1,146 @@
+"""End-to-end demo: multi-process KV-aware routing.
+
+Spawns (as real OS processes):
+  1. the hub (coordination service),
+  2. two worker processes serving a ``generate`` endpoint that echoes which
+     worker handled the request; worker B pre-populates KV-cache events for a
+     known prompt prefix,
+then routes two requests from this (frontend) process:
+  - a request WITH the cached prefix  -> must land on worker B,
+  - a request with a cold prefix      -> load-balanced (either worker).
+
+Run: python examples/kv_routing_demo.py
+"""
+
+import asyncio
+import os
+import subprocess
+import sys
+import tempfile
+import textwrap
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+WORKER_SRC = """
+import asyncio, sys
+sys.path.insert(0, {repo!r})
+from dynamo_tpu.runtime.config import RuntimeConfig
+from dynamo_tpu.runtime.distributed import DistributedRuntime
+from dynamo_tpu.runtime.hub_client import RemoteHub
+from dynamo_tpu.kv_router.publisher import KvEventPublisher
+from dynamo_tpu.tokens import compute_sequence_hashes
+
+HUB = sys.argv[1]
+TAG = sys.argv[2]
+CACHED = sys.argv[3] == "cached"
+
+async def main():
+    cfg = RuntimeConfig(hub_address=HUB)
+    drt = DistributedRuntime(await RemoteHub.connect(HUB), cfg)
+
+    async def handler(request, context):
+        for i, tok in enumerate(request.get("token_ids", [])[:3]):
+            yield {{"worker": TAG, "step": i,
+                   "overlap_blocks": request.get("estimated_prefix_hit_num_blocks")}}
+
+    ep = drt.namespace("demo").component("llm").endpoint("generate")
+    served = await ep.serve(handler)
+    wid = served.instance.instance_id
+
+    if CACHED:
+        pub = KvEventPublisher(drt.hub, "demo/llm", worker_id=wid,
+                               flush_interval_s=0.01).start()
+        warm = list(range(1000, 1032))  # the warm prefix: 8 blocks of 4
+        hashes = compute_sequence_hashes(warm, 4)
+        parents = [0] + hashes[:-1]
+        for sh, p in zip(hashes, parents):
+            pub.block_stored(sh, p)
+        await pub.flush()
+
+    print(f"WORKER_READY {{TAG}} {{wid}}", flush=True)
+    await drt.runtime.wait_for_shutdown()
+
+asyncio.run(main())
+"""
+
+
+async def main() -> int:
+    # 1. hub process
+    hub_proc = subprocess.Popen(
+        [sys.executable, "-m", "dynamo_tpu.runtime.hub_server", "--port", "0"],
+        stdout=subprocess.PIPE, text=True, cwd=REPO,
+        env={**os.environ, "PYTHONPATH": REPO},
+    )
+    hub_addr = hub_proc.stdout.readline().strip().split("=", 1)[1]
+    print(f"[demo] hub at {hub_addr}")
+
+    # 2. worker processes
+    worker_src = WORKER_SRC.format(repo=REPO)
+    with tempfile.NamedTemporaryFile("w", suffix=".py", delete=False) as f:
+        f.write(textwrap.dedent(worker_src))
+        worker_file = f.name
+
+    workers = []
+    for tag, cached in [("worker-A", "cold"), ("worker-B", "cached")]:
+        p = subprocess.Popen(
+            [sys.executable, worker_file, hub_addr, tag, cached],
+            stdout=subprocess.PIPE, text=True,
+            env={**os.environ, "PYTHONPATH": REPO},
+        )
+        line = p.stdout.readline().strip()
+        print(f"[demo] {line}")
+        workers.append(p)
+
+    # 3. frontend-side: KV router over both workers
+    sys.path.insert(0, REPO)
+    from dynamo_tpu.kv_router.protocols import RouterConfig
+    from dynamo_tpu.kv_router.router import KvPushRouter, KvRouter
+    from dynamo_tpu.runtime.config import RuntimeConfig
+    from dynamo_tpu.runtime.context import Context
+    from dynamo_tpu.runtime.distributed import DistributedRuntime
+    from dynamo_tpu.runtime.hub_client import RemoteHub
+    from dynamo_tpu.runtime.push import PushRouter, RouterMode
+
+    cfg = RuntimeConfig(hub_address=hub_addr)
+    drt = DistributedRuntime(await RemoteHub.connect(hub_addr), cfg)
+    ep = drt.namespace("demo").component("llm").endpoint("generate")
+    push = await PushRouter.from_endpoint(ep, RouterMode.DIRECT)
+    insts = await push.client.wait_for_instances(2, timeout=10)
+    print(f"[demo] discovered {len(insts)} workers: "
+          f"{[f'{i.instance_id:x}@{i.host}:{i.port}' for i in insts]}")
+
+    kv_router = await KvRouter(drt.hub, "demo/llm", RouterConfig(block_size=4)).start()
+    kvp = KvPushRouter(push, kv_router)
+    await asyncio.sleep(0.3)  # let the router consume worker B's cache events
+
+    ok = True
+
+    # request 1: warm prefix -> worker-B
+    warm = list(range(1000, 1032))
+    out = [x async for x in kvp.generate({"token_ids": warm}, Context())]
+    print(f"[demo] warm-prefix request handled by: {out[0]['worker']} "
+          f"(overlap={out[0]['overlap_blocks']} blocks)  stream={len(out)} items")
+    if out[0]["worker"] != "worker-B" or out[0]["overlap_blocks"] != 8:
+        print("[demo] FAIL: warm request should hit worker-B with 8-block overlap")
+        ok = False
+
+    # request 2: cold prefix -> either, with 0 overlap
+    cold = list(range(5000, 5032))
+    out2 = [x async for x in kvp.generate({"token_ids": cold}, Context())]
+    print(f"[demo] cold-prefix request handled by: {out2[0]['worker']} "
+          f"(overlap={out2[0]['overlap_blocks']} blocks)")
+    if out2[0]["overlap_blocks"] != 0:
+        print("[demo] FAIL: cold request should have 0 overlap")
+        ok = False
+
+    # teardown
+    for p in workers:
+        p.terminate()
+    hub_proc.terminate()
+    os.unlink(worker_file)
+    print("[demo] PASS" if ok else "[demo] FAIL")
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(asyncio.run(main()))

@@ -1,0 +1,34 @@
+"""Test configuration.
+
+All tests run on a virtual 8-device CPU mesh (the driver separately dry-runs the
+multi-chip path, and bench.py runs on the real TPU chip). Env vars must be set
+before the first ``import jax`` anywhere in the test process.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import asyncio  # noqa: E402
+import inspect  # noqa: E402
+
+
+def pytest_pyfunc_call(pyfuncitem):
+    """Run ``async def`` tests with asyncio.run (pytest-asyncio is not installed)."""
+    fn = pyfuncitem.obj
+    if inspect.iscoroutinefunction(fn):
+        sig = inspect.signature(fn)
+        kwargs = {
+            name: pyfuncitem.funcargs[name]
+            for name in sig.parameters
+            if name in pyfuncitem.funcargs
+        }
+        timeout = float(os.environ.get("DYN_TEST_TIMEOUT", "60"))
+        asyncio.run(asyncio.wait_for(fn(**kwargs), timeout=timeout))
+        return True
+    return None

@@ -1,0 +1,283 @@
+"""KV router tests: radix indexer, scheduler cost, end-to-end routing."""
+
+import asyncio
+import random
+
+import pytest
+
+from dynamo_tpu.kv_router.indexer import ApproxKvIndexer, RadixTree
+from dynamo_tpu.kv_router.protocols import (
+    BlockStored,
+    ForwardPassMetrics,
+    KvCacheEvent,
+    RouterConfig,
+    RouterEvent,
+)
+from dynamo_tpu.kv_router.publisher import KvEventPublisher, WorkerMetricsPublisher
+from dynamo_tpu.kv_router.router import KvPushRouter, KvRouter
+from dynamo_tpu.kv_router.scheduler import KvScheduler, softmax_sample
+from dynamo_tpu.runtime.context import Context
+from dynamo_tpu.runtime.distributed import DistributedRuntime
+from dynamo_tpu.runtime.hub import InMemoryHub
+from dynamo_tpu.runtime.push import PushRouter, RouterMode
+from dynamo_tpu.tokens import compute_sequence_hashes
+
+pytestmark = pytest.mark.unit
+
+
+def stored_event(seq_hashes, parents):
+    return KvCacheEvent(
+        "stored",
+        stored=tuple(
+            BlockStored(sh, p) for sh, p in zip(seq_hashes, parents)
+        ),
+    )
+
+
+def chain(tokens, bs=4):
+    hashes = compute_sequence_hashes(tokens, bs)
+    parents = [0] + hashes[:-1]
+    return hashes, parents
+
+
+# ------------------------------------------------------------------ radix
+
+
+def test_radix_find_matches_consecutive_prefix():
+    tree = RadixTree()
+    toks = list(range(16))
+    hashes, parents = chain(toks)
+    # worker 1 has all 4 blocks; worker 2 has only the first 2
+    tree.apply_event(1, stored_event(hashes, parents))
+    tree.apply_event(2, stored_event(hashes[:2], parents[:2]))
+
+    scores = tree.find_matches(hashes)
+    assert scores.scores == {1: 4, 2: 2}
+    assert scores.total_blocks == 4
+    assert scores.best() == (1, 4)
+
+    # a diverging request only matches the shared prefix
+    other = toks[:8] + [99, 98, 97, 96]
+    ohashes, _ = chain(other)
+    scores = tree.find_matches(ohashes)
+    assert scores.scores == {1: 2, 2: 2}
+
+
+def test_radix_interior_hit_does_not_count():
+    tree = RadixTree()
+    hashes, parents = chain(list(range(12)))
+    # worker 1 holds only blocks 2,3 (no block 1) -> zero usable overlap
+    tree.apply_event(1, stored_event(hashes[1:], parents[1:]))
+    scores = tree.find_matches(hashes)
+    assert scores.scores == {}
+
+
+def test_radix_removal_and_worker_removal():
+    tree = RadixTree()
+    hashes, parents = chain(list(range(16)))
+    tree.apply_event(1, stored_event(hashes, parents))
+    tree.apply_event(2, stored_event(hashes, parents))
+    assert tree.num_blocks(1) == 4
+
+    tree.apply_event(1, KvCacheEvent("removed", removed=(hashes[3],)))
+    assert tree.find_matches(hashes).scores == {1: 3, 2: 4}
+
+    tree.remove_worker(2)
+    assert tree.find_matches(hashes).scores == {1: 3}
+    assert tree.workers() == {1}
+
+    tree.apply_event(1, KvCacheEvent("cleared"))
+    assert tree.find_matches(hashes).scores == {}
+    assert tree.num_blocks() == 0
+
+
+def test_radix_snapshot_restore():
+    tree = RadixTree()
+    hashes, parents = chain(list(range(16)))
+    tree.apply_event(7, stored_event(hashes, parents))
+    snap = tree.snapshot()
+    tree2 = RadixTree.restore(snap)
+    assert tree2.find_matches(hashes).scores == {7: 4}
+
+
+def test_approx_indexer_ttl(monkeypatch):
+    idx = ApproxKvIndexer(ttl_s=0.05)
+    hashes, parents = chain(list(range(8)))
+    idx.process_routing_decision(3, hashes, parents)
+    assert idx.find_matches(hashes).scores == {3: 2}
+    import time
+
+    time.sleep(0.08)
+    assert idx.find_matches(hashes).scores == {}
+
+
+# --------------------------------------------------------------- scheduler
+
+
+def test_softmax_sample_argmin_at_zero_temp():
+    logits = {10: 5.0, 20: 3.0, 30: 3.0}
+    # argmin w/ tie-break on lowest worker id
+    assert softmax_sample(logits, 0.0) == 20
+
+
+def test_softmax_sample_spreads_at_high_temp():
+    logits = {1: 1.0, 2: 1.1}
+    rng = random.Random(0)
+    picks = {softmax_sample(logits, 10.0, rng) for _ in range(200)}
+    assert picks == {1, 2}
+
+
+def test_scheduler_prefers_overlap_and_penalizes_load():
+    cfg = RouterConfig(overlap_weight=1.0, temperature=0.0, block_size=4)
+    sched = KvScheduler(cfg)
+    sched.update_workers([1, 2])
+    sched.update_metrics(ForwardPassMetrics(worker_id=1, active_kv_blocks=0, total_kv_blocks=100))
+    sched.update_metrics(ForwardPassMetrics(worker_id=2, active_kv_blocks=0, total_kv_blocks=100))
+
+    from dynamo_tpu.kv_router.indexer import OverlapScores
+
+    # worker 2 holds 3 of 4 blocks -> wins
+    wid, overlap = sched.schedule(4, OverlapScores(scores={2: 3}))
+    assert (wid, overlap) == (2, 3)
+
+    # but if worker 2 is drowning in decode blocks, worker 1 wins
+    sched.update_metrics(
+        ForwardPassMetrics(worker_id=2, active_kv_blocks=500, total_kv_blocks=600)
+    )
+    wid, _ = sched.schedule(4, OverlapScores(scores={2: 3}))
+    assert wid == 1
+
+
+def test_scheduler_update_workers_reconciles():
+    sched = KvScheduler()
+    sched.update_workers([1, 2, 3])
+    assert len(sched.workers()) == 3
+    sched.update_workers([2])
+    assert [w.worker_id for w in sched.workers()] == [2]
+
+
+# ------------------------------------------------------------- publishers
+
+
+async def test_event_publisher_batches_and_publishes():
+    hub = InMemoryHub()
+    got = []
+
+    async def consume():
+        async for _s, payload in hub.subscribe("kv_events.*"):
+            got.append(RouterEvent.from_dict(payload))
+            if len(got) >= 2:
+                return
+
+    task = asyncio.ensure_future(consume())
+    await asyncio.sleep(0.02)
+    pub = KvEventPublisher(hub, "ns/comp", worker_id=42, flush_interval_s=0.01).start()
+    pub.block_stored(100, 0)
+    pub.block_stored(200, 100)
+    await asyncio.sleep(0.05)
+    pub.blocks_removed([100])
+    await asyncio.wait_for(task, 5)
+    await pub.close()
+
+    assert got[0].worker_id == 42
+    assert got[0].event.kind == "stored"
+    assert [b.sequence_hash for b in got[0].event.stored] == [100, 200]
+    assert got[1].event.kind == "removed"
+    assert got[1].event.removed == (100,)
+
+
+async def test_metrics_publisher_latest_wins():
+    hub = InMemoryHub()
+    got = []
+
+    async def consume():
+        async for _s, payload in hub.subscribe("kv_metrics.*"):
+            got.append(ForwardPassMetrics.from_dict(payload))
+            return
+
+    task = asyncio.ensure_future(consume())
+    await asyncio.sleep(0.02)
+    pub = WorkerMetricsPublisher(hub, "ns/comp", worker_id=7, interval_s=0.01).start()
+    pub.publish(ForwardPassMetrics(active_kv_blocks=5, total_kv_blocks=10))
+    await asyncio.wait_for(task, 5)
+    await pub.close()
+    assert got[0].worker_id == 7
+    assert got[0].active_kv_blocks == 5
+
+
+# ------------------------------------------------------- kv router end-to-end
+
+
+async def test_kv_router_routes_to_cached_worker():
+    """Worker events flow through the hub into routing decisions."""
+    hub = InMemoryHub()
+    cfg = RouterConfig(block_size=4, temperature=0.0)
+    router = await KvRouter(hub, "ns/workers", cfg).start()
+    router.update_workers([111, 222])
+
+    pub = KvEventPublisher(hub, "ns/workers", worker_id=222, flush_interval_s=0.01).start()
+    toks = list(range(20))
+    hashes, parents = chain(toks)
+    for sh, p in zip(hashes, parents):
+        pub.block_stored(sh, p)
+    await asyncio.sleep(0.1)  # let events flow
+
+    wid, overlap = router.find_best_match("r1", toks)
+    assert wid == 222
+    assert overlap == 5
+
+    # an unrelated request load-balances away from the busy worker
+    router.free("r1")
+    await pub.close()
+    await router.close()
+
+
+async def test_kv_push_router_full_path():
+    """KvPushRouter routes a tokenized request to the worker with its prefix."""
+    drt = DistributedRuntime(InMemoryHub())
+    ep = drt.namespace("ns").component("w").endpoint("generate")
+
+    served_ids = []
+
+    def mk(tag):
+        async def h(request, context):
+            yield {"from": tag, "overlap": request.get("estimated_prefix_hit_num_blocks")}
+
+        return h
+
+    s1 = await ep.serve(mk("w1"))
+    s2 = await ep.serve(mk("w2"))
+    served_ids = [s1.instance.instance_id, s2.instance.instance_id]
+
+    push = await PushRouter.from_endpoint(ep, RouterMode.DIRECT)
+    await push.client.wait_for_instances(2, timeout=5)
+
+    cfg = RouterConfig(block_size=4)
+    kv_router = await KvRouter(drt.hub, "ns/w", cfg).start()
+
+    # publish cache events for instance 2 under its real instance id
+    pub = KvEventPublisher(
+        drt.hub, "ns/w", worker_id=served_ids[1], flush_interval_s=0.01
+    ).start()
+    toks = list(range(16))
+    hashes, parents = chain(toks)
+    for sh, p in zip(hashes, parents):
+        pub.block_stored(sh, p)
+    await asyncio.sleep(0.1)
+
+    kvp = KvPushRouter(push, kv_router)
+    out = [x async for x in kvp.generate({"token_ids": toks}, Context())]
+    assert out == [{"from": "w2", "overlap": 4}]
+
+    # sequence freed after stream end
+    assert kv_router.sequences.loads()[served_ids[1]] == (0, 0)
+
+    # snapshot round-trip through hub object store
+    await kv_router.save_snapshot()
+    router2 = KvRouter(drt.hub, "ns/w", cfg)
+    assert await router2.load_snapshot() is True
+    assert router2.tree.find_matches(hashes).scores == {served_ids[1]: 4}
+
+    await pub.close()
+    await kv_router.close()
+    await drt.close()

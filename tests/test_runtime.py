@@ -1,0 +1,368 @@
+"""Runtime core tests: hub, component model, transport, cancellation."""
+
+import asyncio
+
+import pytest
+
+from dynamo_tpu.runtime.component import Instance
+from dynamo_tpu.runtime.config import RuntimeConfig
+from dynamo_tpu.runtime.context import Context, StreamError
+from dynamo_tpu.runtime.distributed import DistributedRuntime
+from dynamo_tpu.runtime.hub import InMemoryHub, KeyExists
+from dynamo_tpu.runtime.hub_client import RemoteHub
+from dynamo_tpu.runtime.hub_server import HubServer
+from dynamo_tpu.runtime.push import NoInstancesError, PushRouter, RouterMode
+
+pytestmark = pytest.mark.unit
+
+
+# ---------------------------------------------------------------- hub: kv
+
+
+async def test_hub_kv_roundtrip():
+    hub = InMemoryHub()
+    await hub.put("a/b", {"x": 1})
+    assert await hub.get("a/b") == {"x": 1}
+    await hub.put("a/c", 2)
+    assert await hub.get_prefix("a/") == {"a/b": {"x": 1}, "a/c": 2}
+    assert await hub.delete("a/b") is True
+    assert await hub.delete("a/b") is False
+    with pytest.raises(KeyExists):
+        await hub.create("a/c", 3)
+    await hub.create("a/d", 4)
+    assert await hub.get("a/d") == 4
+
+
+async def test_hub_watch_sees_snapshot_and_updates():
+    hub = InMemoryHub()
+    await hub.put("w/1", "one")
+    events = []
+
+    async def watch():
+        async for ev in hub.watch_prefix("w/"):
+            events.append((ev.kind, ev.key, ev.value))
+            if len(events) == 3:
+                return
+
+    task = asyncio.ensure_future(watch())
+    await asyncio.sleep(0.05)
+    await hub.put("w/2", "two")
+    await hub.delete("w/1")
+    await asyncio.wait_for(task, 5)
+    assert events == [
+        ("put", "w/1", "one"),
+        ("put", "w/2", "two"),
+        ("delete", "w/1", None),
+    ]
+
+
+async def test_hub_lease_expiry_drops_keys():
+    hub = InMemoryHub()
+    lease = await hub.grant_lease(0.2)
+    await hub.put("l/a", 1, lease_id=lease)
+    await hub.put("l/b", 2)
+    assert await hub.keepalive(lease) is True
+    await asyncio.sleep(0.35)
+    hub.reap_expired()
+    assert await hub.get("l/a") is None
+    assert await hub.get("l/b") == 2
+    assert await hub.keepalive(lease) is False
+
+
+async def test_hub_pubsub_wildcard():
+    hub = InMemoryHub()
+    got = []
+
+    async def sub():
+        async for subj, payload in hub.subscribe("kv_events.*"):
+            got.append((subj, payload))
+            if len(got) == 2:
+                return
+
+    task = asyncio.ensure_future(sub())
+    await asyncio.sleep(0.05)
+    await hub.publish("kv_events.w1", {"n": 1})
+    await hub.publish("other.w1", {"n": 0})
+    await hub.publish("kv_events.w2", {"n": 2})
+    await asyncio.wait_for(task, 5)
+    assert got == [("kv_events.w1", {"n": 1}), ("kv_events.w2", {"n": 2})]
+
+
+async def test_hub_subscribe_replay_delivers_history():
+    """Late subscribers with replay=True catch up on retained events.
+
+    Regression: KV events published by workers at startup were lost if the
+    router subscribed later (found by examples/kv_routing_demo.py).
+    """
+    hub = InMemoryHub()
+    await hub.publish("kv_events.a", {"n": 1})
+    await hub.publish("kv_events.b", {"n": 2})
+    got = []
+
+    async def sub():
+        async for subj, payload in hub.subscribe("kv_events.*", replay=True):
+            got.append(payload["n"])
+            if len(got) == 3:
+                return
+
+    task = asyncio.ensure_future(sub())
+    await asyncio.sleep(0.05)
+    await hub.publish("kv_events.a", {"n": 3})
+    await asyncio.wait_for(task, 5)
+    assert got == [1, 2, 3]
+
+    # without replay, only live events arrive
+    got2 = []
+
+    async def sub2():
+        async for _subj, payload in hub.subscribe("kv_events.*"):
+            got2.append(payload["n"])
+            return
+
+    task2 = asyncio.ensure_future(sub2())
+    await asyncio.sleep(0.05)
+    await hub.publish("kv_events.a", {"n": 9})
+    await asyncio.wait_for(task2, 5)
+    assert got2 == [9]
+
+
+# ------------------------------------------------------- remote hub over tcp
+
+
+async def test_remote_hub_roundtrip():
+    server = HubServer(port=0)
+    await server.start()
+    try:
+        hub = await RemoteHub.connect(f"127.0.0.1:{server.port}")
+        await hub.put("k", [1, 2, 3])
+        assert await hub.get("k") == [1, 2, 3]
+        with pytest.raises(KeyExists):
+            await hub.create("k", 0)
+
+        lease = await hub.grant_lease(5.0)
+        await hub.put("leased", "v", lease_id=lease)
+        await hub.revoke_lease(lease)
+        assert await hub.get("leased") is None
+
+        # watch stream
+        events = []
+
+        async def watch():
+            async for ev in hub.watch_prefix("k"):
+                events.append(ev)
+                if len(events) == 2:
+                    return
+
+        task = asyncio.ensure_future(watch())
+        await asyncio.sleep(0.1)
+        await hub.put("k2", "x")
+        await asyncio.wait_for(task, 5)
+        assert [e.key for e in events] == ["k", "k2"]
+
+        # object store
+        await hub.put_object("bucket", "obj", b"\x00\x01bytes")
+        assert await hub.get_object("bucket", "obj") == b"\x00\x01bytes"
+        assert await hub.get_object("bucket", "missing") is None
+        await hub.close()
+    finally:
+        await server.stop()
+
+
+# ------------------------------------------------- endpoints: local transport
+
+
+async def echo_handler(request, context: Context):
+    for part in request["parts"]:
+        yield {"part": part}
+
+
+async def test_serve_and_call_local():
+    drt = DistributedRuntime(InMemoryHub())
+    ep = drt.namespace("ns").component("comp").endpoint("generate")
+    served = await ep.serve(echo_handler)
+    client = await ep.client().start()
+    insts = await client.wait_for_instances(1, timeout=5)
+    assert insts[0].transport == "local"
+
+    out = []
+    async for item in client.call_instance(
+        insts[0].instance_id, {"parts": [1, 2, 3]}, Context()
+    ):
+        out.append(item)
+    assert out == [{"part": 1}, {"part": 2}, {"part": 3}]
+    await served.shutdown()
+    assert client.instance_ids() == [] or await _eventually_empty(client)
+    await drt.close()
+
+
+async def _eventually_empty(client, timeout=2.0):
+    loop = asyncio.get_running_loop()
+    end = loop.time() + timeout
+    while loop.time() < end:
+        if not client.instance_ids():
+            return True
+        await asyncio.sleep(0.02)
+    return False
+
+
+# --------------------------------------------------- endpoints: tcp transport
+
+
+async def test_serve_and_call_tcp_with_cancellation():
+    """Two DistributedRuntimes sharing a TCP hub; worker streams until cancelled."""
+    server = HubServer(port=0)
+    await server.start()
+    addr = f"127.0.0.1:{server.port}"
+    cfg = RuntimeConfig(hub_address=addr)
+
+    worker_drt = DistributedRuntime(await RemoteHub.connect(addr), cfg)
+    client_drt = DistributedRuntime(await RemoteHub.connect(addr), cfg)
+
+    cancelled = asyncio.Event()
+
+    async def slow_stream(request, context: Context):
+        try:
+            for i in range(10_000):
+                if context.is_stopped:
+                    return
+                yield i
+                await asyncio.sleep(0.01)
+        finally:
+            cancelled.set()
+
+    ep_w = worker_drt.namespace("ns").component("w").endpoint("gen")
+    await ep_w.serve(slow_stream)
+
+    ep_c = client_drt.namespace("ns").component("w").endpoint("gen")
+    client = await ep_c.client().start()
+    insts = await client.wait_for_instances(1, timeout=5)
+    assert insts[0].transport == "tcp"
+
+    ctx = Context()
+    got = []
+    async for item in client.call_instance(insts[0].instance_id, {}, ctx):
+        got.append(item)
+        if len(got) == 3:
+            ctx.stop_generating()
+            break
+    assert got == [0, 1, 2]
+    await asyncio.wait_for(cancelled.wait(), 5)
+
+    await client_drt.close()
+    await worker_drt.close()
+    await server.stop()
+
+
+async def test_stream_error_on_worker_death():
+    """Killing the worker's endpoint server mid-stream raises StreamError."""
+    server = HubServer(port=0)
+    await server.start()
+    addr = f"127.0.0.1:{server.port}"
+    cfg = RuntimeConfig(hub_address=addr)
+    worker_drt = DistributedRuntime(await RemoteHub.connect(addr), cfg)
+    client_drt = DistributedRuntime(await RemoteHub.connect(addr), cfg)
+
+    async def infinite(request, context: Context):
+        i = 0
+        while True:
+            yield i
+            i += 1
+            await asyncio.sleep(0.01)
+
+    ep_w = worker_drt.namespace("ns").component("dying").endpoint("gen")
+    await ep_w.serve(infinite)
+    ep_c = client_drt.namespace("ns").component("dying").endpoint("gen")
+    client = await ep_c.client().start()
+    insts = await client.wait_for_instances(1, timeout=5)
+
+    got = []
+    with pytest.raises(StreamError):
+        async for item in client.call_instance(insts[0].instance_id, {}, Context()):
+            got.append(item)
+            if len(got) == 2:
+                # simulate worker crash: hard-stop its endpoint server
+                await worker_drt._server.stop(drain=False)
+    assert len(got) >= 2
+    await client_drt.close()
+    await worker_drt.close()
+    await server.stop()
+
+
+# --------------------------------------------------------------- push router
+
+
+async def test_push_router_round_robin_and_direct():
+    drt = DistributedRuntime(InMemoryHub())
+
+    def make_handler(tag):
+        async def h(request, context):
+            yield tag
+
+        return h
+
+    ep = drt.namespace("ns").component("pool").endpoint("gen")
+    await ep.serve(make_handler("a"))
+    await ep.serve(make_handler("b"))
+
+    router = await PushRouter.from_endpoint(ep, RouterMode.ROUND_ROBIN)
+    await router.client.wait_for_instances(2, timeout=5)
+
+    seen = set()
+    for _ in range(4):
+        async for item in router.generate({}, Context()):
+            seen.add(item)
+    assert seen == {"a", "b"}
+
+    # direct mode pins an instance
+    iid = router.client.instance_ids()[0]
+    out = [x async for x in router.generate({}, Context(), instance_id=iid)]
+    assert len(out) == 1
+
+    with pytest.raises(NoInstancesError):
+        router.select(instance_id=0xDEAD)
+    await drt.close()
+
+
+async def test_lease_expiry_removes_instance_from_client():
+    hub = InMemoryHub()
+    cfg = RuntimeConfig(lease_ttl_s=0.3, keepalive_interval_s=10.0)  # no keepalive
+    drt = DistributedRuntime(hub, cfg)
+
+    async def h(request, context):
+        yield "ok"
+
+    ep = drt.namespace("ns").component("flaky").endpoint("gen")
+    await ep.serve(h)
+    client = await ep.client().start()
+    await client.wait_for_instances(1, timeout=5)
+
+    # stop keepalives (simulate process death) and wait past TTL
+    drt._keepalive_task.cancel()
+    await asyncio.sleep(0.5)
+    hub.reap_expired()
+    assert await _eventually_empty(client, timeout=2.0)
+    await drt.close()
+
+
+def test_instance_roundtrip_dict():
+    inst = Instance(0xAB12, "ns", "c", "e", "1.2.3.4", 555, "tcp", {"m": 1})
+    assert Instance.from_dict(inst.to_dict()) == inst
+    assert inst.path == "v1/instances/ns/c/e/ab12"
+
+
+def test_config_env_layering(tmp_path, monkeypatch):
+    cfg_file = tmp_path / "cfg.yaml"
+    cfg_file.write_text("http_port: 1234\nnamespace: filens\n")
+    env = {
+        "DYN_CONFIG": str(cfg_file),
+        "DYN_NAMESPACE": "envns",
+        "DYN_LEASE_TTL_S": "42.5",
+        "DYN_LOG_JSONL": "true",
+        "DYN_CUSTOM_THING": "x",
+    }
+    cfg = RuntimeConfig.from_env(env)
+    assert cfg.http_port == 1234  # from file
+    assert cfg.namespace == "envns"  # env beats file
+    assert cfg.lease_ttl_s == 42.5
+    assert cfg.log_jsonl is True
+    assert cfg.extra == {"custom_thing": "x"}

@@ -1,0 +1,98 @@
+"""Unit tests for token-block hashing (dynamo_tpu.tokens)."""
+
+import pytest
+
+from dynamo_tpu.tokens import (
+    TokenBlockSequence,
+    block_hash,
+    chain_hash,
+    compute_block_hashes,
+    compute_sequence_hashes,
+    salt_hash,
+)
+
+pytestmark = pytest.mark.unit
+
+
+def test_block_hash_deterministic_and_order_sensitive():
+    assert block_hash([1, 2, 3]) == block_hash([1, 2, 3])
+    assert block_hash([1, 2, 3]) != block_hash([3, 2, 1])
+    assert block_hash([]) == block_hash([])
+
+
+def test_sequence_hash_chain_depends_on_prefix():
+    # same block content in different prefixes -> different sequence hashes
+    a = compute_sequence_hashes([1, 2, 3, 4], block_size=2)
+    b = compute_sequence_hashes([9, 9, 3, 4], block_size=2)
+    assert len(a) == len(b) == 2
+    assert a[1] != b[1]  # block [3,4] but different parents
+    # but identical prefixes agree
+    c = compute_sequence_hashes([1, 2, 3, 4], block_size=2)
+    assert a == c
+
+
+def test_salt_partitions_hash_space():
+    a = compute_sequence_hashes([1, 2, 3, 4], 2, salt="model-a")
+    b = compute_sequence_hashes([1, 2, 3, 4], 2, salt="model-b")
+    assert a != b
+    assert salt_hash(None) == 0
+    assert salt_hash("x") == salt_hash(b"x")
+
+
+def test_incremental_matches_batch():
+    tokens = list(range(100, 175))
+    seq = TokenBlockSequence(block_size=16)
+    sealed = seq.extend(tokens)
+    assert len(sealed) == 75 // 16 == 4
+    assert len(seq) == 75
+    assert len(seq.partial) == 75 - 4 * 16
+    assert seq.block_hashes() == compute_block_hashes(tokens, 16)
+    assert seq.sequence_hashes() == compute_sequence_hashes(tokens, 16)
+    assert seq.tokens() == tokens
+
+
+def test_append_seals_at_boundary():
+    seq = TokenBlockSequence(block_size=4)
+    assert seq.append(1) is None
+    assert seq.append(2) is None
+    assert seq.append(3) is None
+    blk = seq.append(4)
+    assert blk is not None
+    assert blk.tokens == (1, 2, 3, 4)
+    assert blk.block_index == 0
+    assert blk.parent_sequence_hash == salt_hash(None)
+    assert blk.sequence_hash == chain_hash(salt_hash(None), blk.block_hash)
+
+
+def test_truncate_and_unwind_reopen_blocks():
+    tokens = list(range(20))
+    seq = TokenBlockSequence.from_tokens(tokens, block_size=4)
+    assert seq.num_complete_blocks == 5
+    seq.truncate(10)
+    assert seq.tokens() == tokens[:10]
+    assert seq.num_complete_blocks == 2
+    assert len(seq.partial) == 2
+    # re-extending reproduces the batch hashes
+    seq.extend(tokens[10:])
+    assert seq.sequence_hashes() == compute_sequence_hashes(tokens, 4)
+
+    seq.unwind(1)
+    assert len(seq) == 19
+    assert seq.num_complete_blocks == 4
+
+    with pytest.raises(ValueError):
+        seq.truncate(99)
+
+
+def test_truncate_within_partial():
+    seq = TokenBlockSequence.from_tokens([1, 2, 3, 4, 5, 6], block_size=4)
+    seq.truncate(5)
+    assert seq.tokens() == [1, 2, 3, 4, 5]
+    assert seq.num_complete_blocks == 1
+
+
+def test_last_sequence_hash_chains_from_salt():
+    seq = TokenBlockSequence(block_size=2, salt="m")
+    assert seq.last_sequence_hash == salt_hash("m")
+    seq.extend([1, 2])
+    assert seq.last_sequence_hash == seq.blocks[-1].sequence_hash
