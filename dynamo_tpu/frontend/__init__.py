@@ -1,0 +1,34 @@
+"""OpenAI-compatible frontend + request pipeline.
+
+The serving pipeline (ref lib/llm/src/entrypoint/input/common.rs:196
+build_pipeline / :228 build_routed_pipeline):
+
+    HTTP (SSE) -> OpenAIPreprocessor -> Backend (detokenize/stops)
+               -> Migration (retry on worker death) -> PushRouter | KvPushRouter
+               -> worker instances (tokens in / tokens out)
+
+Workers self-register ModelDeploymentCards in the hub (v1/mdc/...); the
+frontend's ModelWatcher builds a pipeline per model as cards appear and
+tears them down as leases expire.
+"""
+
+from dynamo_tpu.frontend.tokenizer import MockTokenizer, load_tokenizer
+from dynamo_tpu.frontend.model_card import ModelDeploymentCard, register_llm
+from dynamo_tpu.frontend.preprocessor import OpenAIPreprocessor
+from dynamo_tpu.frontend.backend_op import Backend
+from dynamo_tpu.frontend.migration import Migration
+from dynamo_tpu.frontend.watcher import ModelManager, ModelWatcher
+from dynamo_tpu.frontend.http import HttpFrontend
+
+__all__ = [
+    "MockTokenizer",
+    "load_tokenizer",
+    "ModelDeploymentCard",
+    "register_llm",
+    "OpenAIPreprocessor",
+    "Backend",
+    "Migration",
+    "ModelManager",
+    "ModelWatcher",
+    "HttpFrontend",
+]

@@ -1,0 +1,54 @@
+"""Frontend process: ``python -m dynamo_tpu.frontend``.
+
+Connects to the hub, watches for model cards, serves the OpenAI API.
+Ref: components/src/dynamo/frontend/main.py (``python -m dynamo.frontend``).
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+
+from dynamo_tpu.frontend.http import HttpFrontend
+from dynamo_tpu.frontend.watcher import ModelManager, ModelWatcher
+from dynamo_tpu.runtime.config import RuntimeConfig
+from dynamo_tpu.runtime.distributed import DistributedRuntime
+from dynamo_tpu.runtime.hub_client import connect_hub
+from dynamo_tpu.runtime.logging_util import setup_logging
+
+
+async def _amain(args: argparse.Namespace) -> None:
+    cfg = RuntimeConfig.from_env()
+    if args.hub:
+        cfg.hub_address = args.hub
+    if args.port is not None:
+        cfg.http_port = args.port
+    drt = DistributedRuntime(await connect_hub(cfg.hub_address), cfg)
+    manager = ModelManager()
+    watcher = await ModelWatcher(drt, manager).start()
+    frontend = HttpFrontend(manager, host=args.host, port=cfg.http_port)
+    host, port = await frontend.start()
+    print(f"DYNAMO_HTTP={host}:{port}", flush=True)
+    try:
+        await drt.runtime.wait_for_shutdown()
+    finally:
+        await frontend.stop()
+        await watcher.close()
+        await drt.close()
+
+
+def main() -> None:
+    p = argparse.ArgumentParser(description="dynamo-tpu OpenAI frontend")
+    p.add_argument("--hub", default=None, help="hub address host:port")
+    p.add_argument("--host", default="0.0.0.0")
+    p.add_argument("--port", type=int, default=None, help="HTTP port (default DYN_HTTP_PORT or 8000)")
+    args = p.parse_args()
+    setup_logging()
+    try:
+        asyncio.run(_amain(args))
+    except KeyboardInterrupt:
+        pass
+
+
+if __name__ == "__main__":
+    main()

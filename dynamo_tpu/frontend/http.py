@@ -1,0 +1,323 @@
+"""OpenAI-compatible HTTP frontend (aiohttp).
+
+Routes (ref lib/llm/src/http/service/openai.rs + service_v2.rs):
+  POST /v1/chat/completions   - streaming (SSE) + aggregated
+  POST /v1/completions        - streaming (SSE) + aggregated
+  POST /v1/embeddings         - embeddings models
+  GET  /v1/models             - discovered model cards
+  GET  /health, /live, /ready - liveness/readiness
+  GET  /metrics               - Prometheus exposition (TTFT/ITL/duration
+                                histograms per model, ref service/metrics.rs)
+
+Client disconnect mid-SSE cancels the whole pipeline (ref disconnect.rs ->
+AsyncEngineContext.stop_generating).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+import time
+from typing import Any
+
+from aiohttp import web
+
+from dynamo_tpu.frontend.protocols import new_request_id
+from dynamo_tpu.frontend.watcher import ModelManager, ModelPipeline
+from dynamo_tpu.runtime.context import Context
+from dynamo_tpu.runtime.metrics import MetricsRegistry
+
+log = logging.getLogger("dynamo.http")
+
+
+class HttpFrontend:
+    def __init__(
+        self,
+        manager: ModelManager,
+        *,
+        host: str = "0.0.0.0",
+        port: int = 8000,
+        metrics: MetricsRegistry | None = None,
+    ):
+        self.manager = manager
+        self.host = host
+        self.port = port
+        self.metrics = metrics or MetricsRegistry()
+        self._runner: web.AppRunner | None = None
+        self.app = web.Application()
+        self.app.add_routes(
+            [
+                web.post("/v1/chat/completions", self.chat_completions),
+                web.post("/v1/completions", self.completions),
+                web.post("/v1/embeddings", self.embeddings),
+                web.get("/v1/models", self.models),
+                web.get("/health", self.health),
+                web.get("/live", self.health),
+                web.get("/ready", self.health),
+                web.get("/metrics", self.prometheus),
+            ]
+        )
+        m = self.metrics
+        self._m_requests = m.counter(
+            "http_requests_total", "HTTP requests", ["model", "route", "status"]
+        )
+        self._m_ttft = m.histogram(
+            "time_to_first_token_seconds", "TTFT", ["model"]
+        )
+        self._m_itl = m.histogram(
+            "inter_token_latency_seconds", "ITL", ["model"],
+            buckets=(0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5),
+        )
+        self._m_duration = m.histogram(
+            "request_duration_seconds", "request duration", ["model"]
+        )
+        self._m_tokens = m.counter(
+            "output_tokens_total", "generated tokens", ["model"]
+        )
+        self._m_inflight = m.gauge(
+            "inflight_requests", "in-flight requests", ["model"]
+        )
+
+    # -- lifecycle ---------------------------------------------------------
+
+    async def start(self) -> tuple[str, int]:
+        self._runner = web.AppRunner(self.app, access_log=None)
+        await self._runner.setup()
+        site = web.TCPSite(self._runner, self.host, self.port)
+        await site.start()
+        for s in site._server.sockets:  # real bound port when port=0
+            self.port = s.getsockname()[1]
+            break
+        log.info("http frontend on %s:%d", self.host, self.port)
+        return self.host, self.port
+
+    async def stop(self) -> None:
+        if self._runner is not None:
+            await self._runner.cleanup()
+
+    # -- helpers -----------------------------------------------------------
+
+    def _pipeline_or_error(
+        self, body: dict[str, Any]
+    ) -> tuple[ModelPipeline | None, web.Response | None]:
+        model = body.get("model")
+        if not model:
+            return None, _error(400, "missing 'model' field")
+        pipe = self.manager.get(model)
+        if pipe is None:
+            return None, _error(
+                404, f"model {model!r} not found", code="model_not_found"
+            )
+        return pipe, None
+
+    # -- routes ------------------------------------------------------------
+
+    async def chat_completions(self, request: web.Request) -> web.StreamResponse:
+        return await self._completions_common(request, chat=True)
+
+    async def completions(self, request: web.Request) -> web.StreamResponse:
+        return await self._completions_common(request, chat=False)
+
+    async def _completions_common(
+        self, request: web.Request, *, chat: bool
+    ) -> web.StreamResponse:
+        route = "chat" if chat else "completions"
+        try:
+            body = await request.json()
+        except json.JSONDecodeError:
+            self._m_requests.labels("?", route, "400").inc()
+            return _error(400, "invalid JSON body")
+        pipe, err = self._pipeline_or_error(body)
+        if err is not None:
+            self._m_requests.labels(str(body.get("model")), route, str(err.status)).inc()
+            return err
+        model = pipe.card.name
+        ctx = Context(request_id=new_request_id())
+        t_start = time.monotonic()
+        self._m_inflight.labels(model).inc()
+        try:
+            try:
+                preprocessed = pipe.preprocessor.preprocess(body)
+            except ValueError as e:
+                self._m_requests.labels(model, route, "400").inc()
+                return _error(400, str(e))
+            prompt_tokens = len(preprocessed["token_ids"])
+            deltas = pipe.generate(preprocessed, ctx)
+            timed = self._timed_stream(deltas, model, t_start)
+
+            if body.get("stream"):
+                pp = (
+                    pipe.preprocessor.postprocess_chat_stream(
+                        timed,
+                        request_id=ctx.id,
+                        include_usage=bool(
+                            (body.get("stream_options") or {}).get("include_usage")
+                        ),
+                        prompt_tokens=prompt_tokens,
+                    )
+                    if chat
+                    else pipe.preprocessor.postprocess_completions_stream(
+                        timed, request_id=ctx.id
+                    )
+                )
+                resp = await self._sse(request, pp, ctx)
+                self._m_requests.labels(model, route, "200").inc()
+                return resp
+            else:
+                agg = (
+                    await pipe.preprocessor.aggregate_chat(
+                        timed, request_id=ctx.id, prompt_tokens=prompt_tokens
+                    )
+                    if chat
+                    else await pipe.preprocessor.aggregate_completions(
+                        timed, request_id=ctx.id, prompt_tokens=prompt_tokens
+                    )
+                )
+                self._m_requests.labels(model, route, "200").inc()
+                return web.json_response(agg)
+        except Exception as e:  # noqa: BLE001
+            log.exception("request %s failed", ctx.id)
+            ctx.stop_generating()
+            self._m_requests.labels(model, route, "500").inc()
+            return _error(500, f"internal error: {e}")
+        finally:
+            self._m_inflight.labels(model).dec()
+            self._m_duration.labels(model).observe(time.monotonic() - t_start)
+
+    async def _timed_stream(self, deltas, model: str, t_start: float):
+        """Wrap the backend stream with TTFT/ITL/token metrics."""
+        last = None
+        async for d in deltas:
+            now = time.monotonic()
+            if last is None:
+                self._m_ttft.labels(model).observe(now - t_start)
+            else:
+                self._m_itl.labels(model).observe(now - last)
+            last = now
+            self._m_tokens.labels(model).inc(len(d.get("token_ids") or ()))
+            yield d
+
+    async def _sse(
+        self, request: web.Request, chunks, ctx: Context
+    ) -> web.StreamResponse:
+        resp = web.StreamResponse(
+            status=200,
+            headers={
+                "Content-Type": "text/event-stream",
+                "Cache-Control": "no-cache",
+                "Connection": "keep-alive",
+            },
+        )
+        await resp.prepare(request)
+        try:
+            async for chunk in chunks:
+                await resp.write(
+                    b"data: " + json.dumps(chunk).encode() + b"\n\n"
+                )
+            await resp.write(b"data: [DONE]\n\n")
+        except (ConnectionResetError, asyncio.CancelledError):
+            # client went away: cancel the whole pipeline
+            ctx.stop_generating()
+            raise
+        except Exception as e:  # noqa: BLE001
+            # mid-stream failure (e.g. migration exhausted): the response is
+            # already streaming, so deliver the error as a final SSE event
+            log.exception("stream %s failed mid-flight", ctx.id)
+            try:
+                err = {"error": {"message": str(e), "type": "server_error"}}
+                await resp.write(b"data: " + json.dumps(err).encode() + b"\n\n")
+                await resp.write(b"data: [DONE]\n\n")
+            except (ConnectionError, ConnectionResetError):
+                pass
+        finally:
+            ctx.stop_generating()
+        await resp.write_eof()
+        return resp
+
+    async def embeddings(self, request: web.Request) -> web.Response:
+        try:
+            body = await request.json()
+        except json.JSONDecodeError:
+            return _error(400, "invalid JSON body")
+        pipe, err = self._pipeline_or_error(body)
+        if err is not None:
+            return err
+        if pipe.card.model_type != "embeddings":
+            return _error(
+                400, f"model {pipe.card.name!r} is not an embeddings model"
+            )
+        inputs = body.get("input")
+        if isinstance(inputs, str):
+            inputs = [inputs]
+        if not isinstance(inputs, list) or not all(
+            isinstance(x, str) for x in inputs
+        ):
+            return _error(
+                400, "'input' must be a string or a list of strings"
+            )
+        ctx = Context(request_id=new_request_id())
+        data = []
+        for i, text in enumerate(inputs):
+            token_ids = pipe.preprocessor.tokenizer.encode(text)
+            out = None
+            async for item in pipe.generate(
+                {"token_ids": token_ids, "stop_conditions": {"max_tokens": 1},
+                 "embedding_request": True},
+                ctx.child(f"{ctx.id}-{i}"),
+            ):
+                if isinstance(item, dict) and "embedding" in item:
+                    out = item["embedding"]
+            if out is None:
+                return _error(502, "worker returned no embedding")
+            data.append({"object": "embedding", "index": i, "embedding": out})
+        return web.json_response(
+            {"object": "list", "data": data, "model": pipe.card.name,
+             "usage": {"prompt_tokens": 0, "total_tokens": 0}}
+        )
+
+    async def models(self, request: web.Request) -> web.Response:
+        return web.json_response(
+            {
+                "object": "list",
+                "data": [
+                    {
+                        "id": c.name,
+                        "object": "model",
+                        "owned_by": "dynamo-tpu",
+                        "created": 0,
+                        "meta": {
+                            "context_length": c.context_length,
+                            "model_type": c.model_type,
+                            "router_mode": c.router_mode,
+                        },
+                    }
+                    for c in self.manager.cards()
+                ],
+            }
+        )
+
+    async def health(self, request: web.Request) -> web.Response:
+        models = {}
+        for pipe in [self.manager.get(n) for n in self.manager.names()]:
+            if pipe:
+                models[pipe.card.name] = {
+                    "instances": len(pipe.push_router.client.instance_ids())
+                }
+        status = "healthy" if models else "no_models"
+        return web.json_response({"status": status, "models": models})
+
+    async def prometheus(self, request: web.Request) -> web.Response:
+        return web.Response(
+            body=self.metrics.exposition(),
+            content_type="text/plain",
+            charset="utf-8",
+        )
+
+
+def _error(status: int, message: str, code: str | None = None) -> web.Response:
+    return web.json_response(
+        {"error": {"message": message, "type": "invalid_request_error",
+                   "code": code}},
+        status=status,
+    )

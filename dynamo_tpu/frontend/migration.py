@@ -1,0 +1,69 @@
+"""Migration operator: fault-tolerant retry across workers.
+
+If the response stream dies mid-generation (worker crash, connection loss ->
+StreamError from the transport), re-issue the request to another worker with
+the already-generated tokens appended to the prompt, up to
+``migration_limit`` times. The client never notices beyond a brief pause.
+Ref: lib/llm/src/migration.rs (Migration :26, RetryManager :74).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from typing import Any, AsyncIterator
+
+from dynamo_tpu.runtime.context import Context, StreamError
+
+log = logging.getLogger("dynamo.migration")
+
+
+class Migration:
+    def __init__(self, downstream, *, migration_limit: int = 3, retry_delay_s: float = 0.2):
+        self.downstream = downstream
+        self.migration_limit = migration_limit
+        self.retry_delay_s = retry_delay_s
+
+    async def generate(
+        self, request: dict[str, Any], context: Context
+    ) -> AsyncIterator[dict[str, Any]]:
+        request = dict(request)
+        attempts_left = self.migration_limit
+        generated: list[int] = []
+
+        while True:
+            retry = False
+            try:
+                async for item in self.downstream.generate(request, context):
+                    if isinstance(item, dict):
+                        generated.extend(item.get("token_ids") or [])
+                    yield item
+                    if isinstance(item, dict) and item.get("finish_reason"):
+                        return
+                return  # clean end of stream
+            except StreamError as e:
+                if context.is_stopped or attempts_left <= 0:
+                    raise
+                attempts_left -= 1
+                retry = True
+                log.warning(
+                    "stream died (%s); migrating request %s "
+                    "(%d tokens generated, %d retries left)",
+                    e, context.id, len(generated), attempts_left,
+                )
+            if retry:
+                await asyncio.sleep(self.retry_delay_s)
+                # resume: prompt = original + generated so far; shrink budget
+                stop = dict(request.get("stop_conditions") or {})
+                max_tokens = stop.get("max_tokens")
+                if max_tokens is not None:
+                    stop["max_tokens"] = max(max_tokens - len(generated), 1)
+                request = {
+                    **request,
+                    "token_ids": list(request.get("token_ids") or []) + generated,
+                    "stop_conditions": stop,
+                    "backend_instance_id": None,  # re-route freely
+                }
+                # fresh child context: the old request id may be poisoned on
+                # the dead worker's peers
+                context = context.child(f"{context.id}-m{self.migration_limit - attempts_left}")

@@ -1,0 +1,240 @@
+"""OpenAIPreprocessor: OpenAI API request <-> token-level pipeline.
+
+Forward: render the chat template (jinja2 / tokenizer-native), tokenize,
+extract sampling + stop conditions -> PreprocessedRequest (ref
+lib/llm/src/preprocessor.rs:159 preprocess_request, prompt/template/oai.rs).
+
+Backward: wrap the Backend's detokenized deltas as OpenAI
+chat.completion.chunk / text_completion objects and aggregate non-streaming
+responses (ref preprocessor.rs:430 transform_postprocessor_stream,
+protocols/openai/chat_completions/aggregator.rs).
+"""
+
+from __future__ import annotations
+
+from typing import Any, AsyncIterator
+
+import jinja2
+
+from dynamo_tpu.frontend.protocols import (
+    make_preprocessed_request,
+    new_request_id,
+    now_unix,
+)
+from dynamo_tpu.frontend.tokenizer import Tokenizer
+
+
+class OpenAIPreprocessor:
+    def __init__(
+        self,
+        tokenizer: Tokenizer,
+        *,
+        model_name: str,
+        context_length: int = 8192,
+        chat_template: str | None = None,
+        default_max_tokens: int = 256,
+    ):
+        self.tokenizer = tokenizer
+        self.model_name = model_name
+        self.context_length = context_length
+        self.default_max_tokens = default_max_tokens
+        self._template = (
+            jinja2.Template(chat_template) if chat_template else None
+        )
+
+    # -- forward: OpenAI request -> PreprocessedRequest --------------------
+
+    def render_prompt(self, request: dict[str, Any]) -> str:
+        if "messages" in request:
+            messages = request["messages"]
+            if self._template is not None:
+                return self._template.render(
+                    messages=messages, add_generation_prompt=True
+                )
+            return self.tokenizer.apply_chat_template(
+                messages, add_generation_prompt=True
+            )
+        prompt = request.get("prompt", "")
+        if isinstance(prompt, list):
+            prompt = "".join(prompt)
+        return prompt
+
+    def preprocess(self, request: dict[str, Any]) -> dict[str, Any]:
+        """OpenAI chat/completions request (dict) -> PreprocessedRequest."""
+        prompt = self.render_prompt(request)
+        token_ids = self.tokenizer.encode(prompt)
+        if len(token_ids) >= self.context_length:
+            raise ValueError(
+                f"prompt ({len(token_ids)} tokens) exceeds context length "
+                f"{self.context_length}"
+            )
+        max_tokens = request.get("max_completion_tokens") or request.get(
+            "max_tokens"
+        )
+        if max_tokens is None:
+            max_tokens = min(
+                self.default_max_tokens, self.context_length - len(token_ids)
+            )
+        max_tokens = min(max_tokens, self.context_length - len(token_ids))
+        stop = request.get("stop")
+        if isinstance(stop, str):
+            stop = [stop]
+        return make_preprocessed_request(
+            token_ids,
+            max_tokens=max_tokens,
+            temperature=request.get("temperature"),
+            top_p=request.get("top_p"),
+            top_k=request.get("top_k"),
+            seed=request.get("seed"),
+            stop=stop,
+            ignore_eos=bool(request.get("ignore_eos", False)),
+            min_tokens=int(request.get("min_tokens") or 0),
+            eos_token_ids=[self.tokenizer.eos_token_id],
+            annotations=list(request.get("nvext", {}).get("annotations", []))
+            if isinstance(request.get("nvext"), dict)
+            else [],
+        )
+
+    # -- backward: backend deltas -> OpenAI objects ------------------------
+
+    async def postprocess_chat_stream(
+        self,
+        deltas: AsyncIterator[dict[str, Any]],
+        *,
+        request_id: str | None = None,
+        include_usage: bool = False,
+        prompt_tokens: int = 0,
+    ) -> AsyncIterator[dict[str, Any]]:
+        """Backend deltas -> chat.completion.chunk dicts (SSE payloads)."""
+        rid = request_id or new_request_id()
+        created = now_unix()
+        first = True
+        completion_tokens = 0
+        finish = None
+        async for d in deltas:
+            completion_tokens += len(d.get("token_ids", ()))
+            finish = d.get("finish_reason")
+            delta: dict[str, Any] = {}
+            if first:
+                delta["role"] = "assistant"
+                first = False
+            if d.get("text"):
+                delta["content"] = d["text"]
+            chunk = {
+                "id": rid,
+                "object": "chat.completion.chunk",
+                "created": created,
+                "model": self.model_name,
+                "choices": [
+                    {"index": 0, "delta": delta, "finish_reason": finish}
+                ],
+            }
+            yield chunk
+        if include_usage:
+            yield {
+                "id": rid,
+                "object": "chat.completion.chunk",
+                "created": created,
+                "model": self.model_name,
+                "choices": [],
+                "usage": {
+                    "prompt_tokens": prompt_tokens,
+                    "completion_tokens": completion_tokens,
+                    "total_tokens": prompt_tokens + completion_tokens,
+                },
+            }
+
+    async def aggregate_chat(
+        self,
+        deltas: AsyncIterator[dict[str, Any]],
+        *,
+        request_id: str | None = None,
+        prompt_tokens: int = 0,
+    ) -> dict[str, Any]:
+        """Backend deltas -> one chat.completion response (non-streaming)."""
+        rid = request_id or new_request_id()
+        text_parts: list[str] = []
+        completion_tokens = 0
+        finish = "stop"
+        async for d in deltas:
+            if d.get("text"):
+                text_parts.append(d["text"])
+            completion_tokens += len(d.get("token_ids", ()))
+            if d.get("finish_reason"):
+                finish = d["finish_reason"]
+        return {
+            "id": rid,
+            "object": "chat.completion",
+            "created": now_unix(),
+            "model": self.model_name,
+            "choices": [
+                {
+                    "index": 0,
+                    "message": {
+                        "role": "assistant",
+                        "content": "".join(text_parts),
+                    },
+                    "finish_reason": finish,
+                }
+            ],
+            "usage": {
+                "prompt_tokens": prompt_tokens,
+                "completion_tokens": completion_tokens,
+                "total_tokens": prompt_tokens + completion_tokens,
+            },
+        }
+
+    async def postprocess_completions_stream(
+        self,
+        deltas: AsyncIterator[dict[str, Any]],
+        *,
+        request_id: str | None = None,
+    ) -> AsyncIterator[dict[str, Any]]:
+        rid = request_id or new_request_id()
+        created = now_unix()
+        async for d in deltas:
+            yield {
+                "id": rid,
+                "object": "text_completion",
+                "created": created,
+                "model": self.model_name,
+                "choices": [
+                    {
+                        "index": 0,
+                        "text": d.get("text", ""),
+                        "finish_reason": d.get("finish_reason"),
+                    }
+                ],
+            }
+
+    async def aggregate_completions(
+        self,
+        deltas: AsyncIterator[dict[str, Any]],
+        *,
+        request_id: str | None = None,
+        prompt_tokens: int = 0,
+    ) -> dict[str, Any]:
+        rid = request_id or new_request_id()
+        text_parts: list[str] = []
+        completion_tokens = 0
+        finish = "stop"
+        async for d in deltas:
+            if d.get("text"):
+                text_parts.append(d["text"])
+            completion_tokens += len(d.get("token_ids", ()))
+            if d.get("finish_reason"):
+                finish = d["finish_reason"]
+        return {
+            "id": rid,
+            "object": "text_completion",
+            "created": now_unix(),
+            "model": self.model_name,
+            "choices": [
+                {"index": 0, "text": "".join(text_parts), "finish_reason": finish}
+            ],
+            "usage": {
+                "prompt_tokens": prompt_tokens,
+                "completion_tokens": completion_tokens,
+                "total_tokens": prompt_tokens + completion_tokens,
+            },
+        }

@@ -1,0 +1,161 @@
+"""Tokenizer abstraction + incremental detokenization.
+
+Two implementations:
+  - ``HFTokenizer``: wraps a local HuggingFace tokenizer (transformers) -
+    the production path (ref: the preprocessor's HF tokenizers usage,
+    lib/llm/src/preprocessor.rs + tokenizers crate).
+  - ``MockTokenizer``: deterministic byte-level tokenizer for hermetic tests
+    and the mock engine (no downloads; this environment has no egress).
+
+``IncrementalDecoder`` converts a stream of token ids into clean UTF-8 text
+deltas (the reference's Decoder in backend.rs): it withholds bytes until
+they form complete codepoints, so multi-byte characters split across tokens
+never emit mojibake.
+"""
+
+from __future__ import annotations
+
+from typing import Protocol, Sequence
+
+__all__ = ["Tokenizer", "MockTokenizer", "HFTokenizer", "IncrementalDecoder", "load_tokenizer"]
+
+
+class Tokenizer(Protocol):
+    eos_token_id: int
+    vocab_size: int
+
+    def encode(self, text: str) -> list[int]: ...  # pragma: no cover
+    def decode(self, ids: Sequence[int]) -> str: ...  # pragma: no cover
+    def apply_chat_template(self, messages: list[dict], add_generation_prompt: bool = True) -> str: ...  # pragma: no cover
+
+
+_DEFAULT_CHAT_TEMPLATE = (
+    "{% for m in messages %}"
+    "<|{{ m['role'] }}|>{{ m['content'] }}<|end|>\n"
+    "{% endfor %}"
+    "{% if add_generation_prompt %}<|assistant|>{% endif %}"
+)
+
+
+class MockTokenizer:
+    """Byte-level tokenizer: token id = byte value + 16 (0..15 reserved).
+
+    Deterministic, reversible, and needs no model files. Special ids:
+    0=pad, 1=bos, 2=eos.
+    """
+
+    PAD, BOS, EOS = 0, 1, 2
+    OFFSET = 16
+
+    def __init__(self) -> None:
+        self.eos_token_id = self.EOS
+        self.vocab_size = 256 + self.OFFSET
+        import jinja2
+
+        self._template = jinja2.Template(_DEFAULT_CHAT_TEMPLATE)
+
+    def encode(self, text: str) -> list[int]:
+        return [b + self.OFFSET for b in text.encode("utf-8")]
+
+    def decode(self, ids: Sequence[int]) -> str:
+        data = bytes(
+            i - self.OFFSET for i in ids if self.OFFSET <= i < self.OFFSET + 256
+        )
+        return data.decode("utf-8", errors="replace")
+
+    def decode_bytes(self, ids: Sequence[int]) -> bytes:
+        return bytes(
+            i - self.OFFSET for i in ids if self.OFFSET <= i < self.OFFSET + 256
+        )
+
+    def apply_chat_template(
+        self, messages: list[dict], add_generation_prompt: bool = True
+    ) -> str:
+        return self._template.render(
+            messages=messages, add_generation_prompt=add_generation_prompt
+        )
+
+
+class HFTokenizer:
+    """HuggingFace tokenizer wrapper (local files only; no egress)."""
+
+    def __init__(self, path: str):
+        from transformers import AutoTokenizer  # deferred: heavy import
+
+        self._tok = AutoTokenizer.from_pretrained(path, local_files_only=True)
+        self.eos_token_id = self._tok.eos_token_id or 2
+        self.vocab_size = getattr(self._tok, "vocab_size", 32000)
+
+    def encode(self, text: str) -> list[int]:
+        return self._tok.encode(text, add_special_tokens=False)
+
+    def decode(self, ids: Sequence[int]) -> str:
+        return self._tok.decode(ids, skip_special_tokens=True)
+
+    def apply_chat_template(
+        self, messages: list[dict], add_generation_prompt: bool = True
+    ) -> str:
+        return self._tok.apply_chat_template(
+            messages, tokenize=False, add_generation_prompt=add_generation_prompt
+        )
+
+
+def load_tokenizer(spec: str | None) -> Tokenizer:
+    """Resolve a tokenizer spec from a model card: "mock" or a local path."""
+    if not spec or spec == "mock":
+        return MockTokenizer()
+    return HFTokenizer(spec)
+
+
+class IncrementalDecoder:
+    """Streaming token-ids -> text deltas without broken codepoints.
+
+    Sliding-window algorithm (the standard HF/vLLM incremental detokenizer,
+    and the reference Decoder's approach in backend.rs): decode only a
+    bounded window ``ids[prefix_offset:]`` each step - O(1) amortized per
+    token, not O(n) - and hold the delta back while it ends in U+FFFD
+    (a token boundary split a multi-byte character).
+    """
+
+    def __init__(self, tokenizer: Tokenizer):
+        self.tokenizer = tokenizer
+        self._ids: list[int] = []
+        self._prefix_offset = 0  # window start (last fully-emitted boundary)
+        self._read_offset = 0  # ids already attributed to emitted text
+        self._text_parts: list[str] = []  # all emitted deltas
+        self._text_len = 0
+
+    def push(self, ids: Sequence[int]) -> str:
+        self._ids.extend(ids)
+        prefix_text = self.tokenizer.decode(
+            self._ids[self._prefix_offset : self._read_offset]
+        )
+        window_text = self.tokenizer.decode(self._ids[self._prefix_offset :])
+        if window_text.endswith("�"):
+            return ""  # incomplete codepoint: wait for more tokens
+        delta = window_text[len(prefix_text) :]
+        self._prefix_offset = self._read_offset
+        self._read_offset = len(self._ids)
+        if delta:
+            self._text_parts.append(delta)
+            self._text_len += len(delta)
+        return delta
+
+    def flush(self) -> str:
+        window_text = self.tokenizer.decode(self._ids[self._prefix_offset :])
+        prefix_text = self.tokenizer.decode(
+            self._ids[self._prefix_offset : self._read_offset]
+        )
+        delta = window_text[len(prefix_text) :]
+        self._prefix_offset = self._read_offset = len(self._ids)
+        if delta:
+            self._text_parts.append(delta)
+            self._text_len += len(delta)
+        return delta
+
+    @property
+    def text(self) -> str:
+        """All text emitted so far (O(1) amortized; no re-decode)."""
+        if len(self._text_parts) > 1:
+            self._text_parts = ["".join(self._text_parts)]
+        return self._text_parts[0] if self._text_parts else ""

@@ -1,0 +1,183 @@
+"""MockEngine: streaming AsyncEngine with simulated compute.
+
+Timing model (ref lib/llm/src/mocker/scheduler.rs): prefill costs
+``prefill_base_s + prefill_per_token_s * uncached_tokens``, each decode step
+costs ``decode_step_s`` (scaled by active batch pressure), all divided by
+``speedup_ratio`` so large fleets simulate fast. KV blocks are allocated per
+request through MockKvManager; decode extends the sequence one token at a
+time, sealing new blocks (emitting store events) at block boundaries exactly
+like a real paged engine.
+
+Request schema = the framework's PreprocessedRequest (see
+frontend/protocols): {"token_ids": [...], "stop_conditions": {"max_tokens"},
+"sampling": {...}, ...}. Responses: {"token_ids": [t], "finish_reason"}.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import random
+from dataclasses import dataclass
+from typing import Any, AsyncIterator
+
+from dynamo_tpu.kv_router.protocols import ForwardPassMetrics
+from dynamo_tpu.mocker.kv_manager import MockKvManager, NotEnoughBlocks
+from dynamo_tpu.runtime.context import Context
+from dynamo_tpu.tokens import TokenBlockSequence
+
+__all__ = ["MockEngineConfig", "MockEngine"]
+
+
+@dataclass
+class MockEngineConfig:
+    block_size: int = 16
+    total_kv_blocks: int = 4096
+    max_batch_size: int = 64
+    speedup_ratio: float = 1.0  # >1 = time dilation (faster than real)
+    prefill_base_s: float = 0.02
+    prefill_per_token_s: float = 0.0002
+    decode_step_s: float = 0.01
+    # default matches MockTokenizer's decodable range (bytes + 16 offset) so
+    # mock generations detokenize to visible text
+    vocab_size: int = 272
+    eos_token_id: int = 2
+    data_parallel_rank: int = 0
+    seed: int = 0
+
+
+class MockEngine:
+    """Simulated engine worker; one instance per mock worker process/task."""
+
+    def __init__(
+        self,
+        config: MockEngineConfig | None = None,
+        *,
+        event_publisher=None,  # KvEventPublisher | None
+        metrics_publisher=None,  # WorkerMetricsPublisher | None
+    ):
+        self.config = config or MockEngineConfig()
+        self.events = event_publisher
+        self.metrics = metrics_publisher
+        self.kv = MockKvManager(
+            self.config.total_kv_blocks,
+            on_store=self._on_store,
+            on_evict=self._on_evict,
+        )
+        self._rng = random.Random(self.config.seed)
+        self._running = 0
+        self._waiting = 0
+        self._admit = asyncio.Semaphore(self.config.max_batch_size)
+
+    # -- kv event plumbing -------------------------------------------------
+
+    def _on_store(self, sh: int, parent: int) -> None:
+        if self.events is not None:
+            self.events.block_stored(sh, parent)
+
+    def _on_evict(self, shs: list[int]) -> None:
+        if self.events is not None and shs:
+            self.events.blocks_removed(shs)
+
+    def _publish_metrics(self) -> None:
+        if self.metrics is not None:
+            self.metrics.publish(
+                ForwardPassMetrics(
+                    active_kv_blocks=self.kv.active_blocks,
+                    total_kv_blocks=self.kv.total_blocks,
+                    waiting_requests=self._waiting,
+                    running_requests=self._running,
+                    data_parallel_rank=self.config.data_parallel_rank,
+                )
+            )
+
+    async def _sleep(self, seconds: float) -> None:
+        await asyncio.sleep(seconds / max(self.config.speedup_ratio, 1e-9))
+
+    # -- the engine --------------------------------------------------------
+
+    async def generate(
+        self, request: dict[str, Any], context: Context
+    ) -> AsyncIterator[dict[str, Any]]:
+        cfg = self.config
+        token_ids: list[int] = list(request.get("token_ids") or [])
+        stop = request.get("stop_conditions") or {}
+        max_tokens = int(stop.get("max_tokens") or 16)
+        ignore_eos = bool(stop.get("ignore_eos", True))
+
+        seq = TokenBlockSequence.from_tokens(token_ids, cfg.block_size)
+        prefix_hashes = seq.sequence_hashes()
+
+        self._waiting += 1
+        self._publish_metrics()
+        owned: list[int] = []  # block hashes this request holds a ref on
+        async with self._admit:  # continuous-batching admission
+            self._waiting -= 1
+            self._running += 1
+            try:
+                # --- prefill ---------------------------------------------
+                reused = self.kv.touch(prefix_hashes)
+                owned.extend(prefix_hashes[:reused])
+                new_hashes = prefix_hashes[reused:]
+                if new_hashes:
+                    parents = [
+                        seq.blocks[i].parent_sequence_hash
+                        for i in range(reused, len(seq.blocks))
+                    ]
+                    try:
+                        self.kv.allocate(new_hashes, parents)
+                        owned.extend(new_hashes)
+                    except NotEnoughBlocks:
+                        yield {
+                            "token_ids": [],
+                            "finish_reason": "error",
+                            "error": "kv pool exhausted",
+                        }
+                        return
+                uncached_tokens = len(token_ids) - reused * cfg.block_size
+                await self._sleep(
+                    cfg.prefill_base_s
+                    + cfg.prefill_per_token_s * max(uncached_tokens, 0)
+                )
+                self._publish_metrics()
+
+                # --- decode ----------------------------------------------
+                generated = 0
+                while generated < max_tokens:
+                    if context.is_stopped:
+                        yield {"token_ids": [], "finish_reason": "cancelled"}
+                        return
+                    # batch pressure: decode step slows with concurrency
+                    pressure = 1.0 + 0.02 * max(self._running - 1, 0)
+                    await self._sleep(cfg.decode_step_s * pressure)
+                    tok = self._rng.randrange(3, cfg.vocab_size)
+                    sealed = seq.append(tok)
+                    if sealed is not None:
+                        # new decode block materializes in the KV pool
+                        try:
+                            self.kv.allocate(
+                                [sealed.sequence_hash],
+                                [sealed.parent_sequence_hash],
+                            )
+                            owned.append(sealed.sequence_hash)
+                        except NotEnoughBlocks:
+                            yield {
+                                "token_ids": [tok],
+                                "finish_reason": "error",
+                                "error": "kv pool exhausted mid-decode",
+                            }
+                            return
+                    generated += 1
+                    is_eos = (not ignore_eos) and tok == cfg.eos_token_id
+                    done = generated >= max_tokens or is_eos
+                    yield {
+                        "token_ids": [tok],
+                        "finish_reason": (
+                            "stop" if is_eos else "length" if done else None
+                        ),
+                    }
+                    if done:
+                        return
+            finally:
+                self._running -= 1
+                self.kv.free(owned)
+                self._publish_metrics()

@@ -1,0 +1,337 @@
+"""Frontend tests: tokenizer/decoder, preprocessor, backend op, migration,
+HTTP service over a live mocker fleet."""
+
+import asyncio
+import json
+
+import aiohttp
+import pytest
+
+from dynamo_tpu.frontend.backend_op import Backend
+from dynamo_tpu.frontend.http import HttpFrontend
+from dynamo_tpu.frontend.migration import Migration
+from dynamo_tpu.frontend.model_card import register_llm
+from dynamo_tpu.frontend.preprocessor import OpenAIPreprocessor
+from dynamo_tpu.frontend.tokenizer import IncrementalDecoder, MockTokenizer
+from dynamo_tpu.frontend.watcher import ModelManager, ModelWatcher
+from dynamo_tpu.mocker.engine import MockEngine, MockEngineConfig
+from dynamo_tpu.runtime.context import Context, StreamError
+from dynamo_tpu.runtime.distributed import DistributedRuntime
+from dynamo_tpu.runtime.hub import InMemoryHub
+
+pytestmark = pytest.mark.unit
+
+
+# ---------------------------------------------------------------- tokenizer
+
+
+def test_mock_tokenizer_roundtrip():
+    tok = MockTokenizer()
+    text = "hello wörld ☃"
+    assert tok.decode(tok.encode(text)) == text
+
+
+def test_incremental_decoder_handles_split_multibyte():
+    tok = MockTokenizer()
+    dec = IncrementalDecoder(tok)
+    ids = tok.encode("é☃x")  # multibyte chars
+    out = ""
+    for i in ids:
+        out += dec.push([i])
+    out += dec.flush()
+    assert out == "é☃x"
+    assert "�" not in out
+
+
+def test_chat_template_renders_messages():
+    tok = MockTokenizer()
+    text = tok.apply_chat_template(
+        [{"role": "user", "content": "hi"}], add_generation_prompt=True
+    )
+    assert "user" in text and "hi" in text and text.endswith("<|assistant|>")
+
+
+# -------------------------------------------------------------- preprocessor
+
+
+def test_preprocess_chat_request():
+    tok = MockTokenizer()
+    pp = OpenAIPreprocessor(tok, model_name="m", context_length=512)
+    req = pp.preprocess(
+        {
+            "model": "m",
+            "messages": [{"role": "user", "content": "hello"}],
+            "max_tokens": 7,
+            "temperature": 0.5,
+            "stop": "END",
+        }
+    )
+    assert req["stop_conditions"]["max_tokens"] == 7
+    assert req["stop_conditions"]["stop"] == ["END"]
+    assert req["sampling"]["temperature"] == 0.5
+    assert req["eos_token_ids"] == [tok.eos_token_id]
+    assert len(req["token_ids"]) > 0
+
+
+def test_preprocess_rejects_oversized_prompt():
+    tok = MockTokenizer()
+    pp = OpenAIPreprocessor(tok, model_name="m", context_length=10)
+    with pytest.raises(ValueError):
+        pp.preprocess({"model": "m", "prompt": "x" * 100})
+
+
+# ---------------------------------------------------------------- backend op
+
+
+class _TokenEngine:
+    """Downstream stub yielding fixed token deltas."""
+
+    def __init__(self, token_batches, finish="length"):
+        self.batches = token_batches
+        self.finish = finish
+
+    async def generate(self, request, context):
+        for i, batch in enumerate(self.batches):
+            last = i == len(self.batches) - 1
+            yield {
+                "token_ids": batch,
+                "finish_reason": self.finish if last else None,
+            }
+
+
+async def test_backend_detokenizes_stream():
+    tok = MockTokenizer()
+    ids = tok.encode("hello world")
+    eng = _TokenEngine([ids[:3], ids[3:8], ids[8:]])
+    backend = Backend(tok, eng)
+    out = [x async for x in backend.generate({"stop_conditions": {}}, Context())]
+    assert "".join(x["text"] for x in out) == "hello world"
+    assert out[-1]["finish_reason"] == "length"
+
+
+async def test_backend_stop_sequence_truncates():
+    tok = MockTokenizer()
+    ids = tok.encode("abcSTOPdef")
+    eng = _TokenEngine([ids[:2], ids[2:6], ids[6:]], finish="length")
+    backend = Backend(tok, eng)
+    req = {"stop_conditions": {"stop": ["STOP"]}}
+    ctx = Context()
+    out = [x async for x in backend.generate(req, ctx)]
+    text = "".join(x["text"] for x in out)
+    assert text == "abc"
+    assert out[-1]["finish_reason"] == "stop"
+    assert ctx.is_stopped  # downstream cancelled
+
+
+async def test_backend_eos_stops():
+    tok = MockTokenizer()
+    eng = _TokenEngine([[20, 21], [tok.eos_token_id, 22]], finish=None)
+    backend = Backend(tok, eng)
+    req = {"stop_conditions": {}, "eos_token_ids": [tok.eos_token_id]}
+    out = [x async for x in backend.generate(req, Context())]
+    assert out[-1]["finish_reason"] == "stop"
+    # the token after eos is dropped
+    assert out[-1]["token_ids"] == [tok.eos_token_id]
+
+
+# ---------------------------------------------------------------- migration
+
+
+class _FlakyEngine:
+    """Dies after N tokens on the first M attempts."""
+
+    def __init__(self, die_after=3, failures=1):
+        self.die_after = die_after
+        self.failures = failures
+        self.attempts = 0
+        self.received_prompts = []
+
+    async def generate(self, request, context):
+        self.attempts += 1
+        self.received_prompts.append(list(request["token_ids"]))
+        max_tokens = request["stop_conditions"]["max_tokens"]
+        for i in range(max_tokens):
+            if self.attempts <= self.failures and i >= self.die_after:
+                raise StreamError("worker died")
+            yield {
+                "token_ids": [1000 + len(request["token_ids"]) + i],
+                "finish_reason": "length" if i == max_tokens - 1 else None,
+            }
+
+
+async def test_migration_resumes_with_generated_tokens():
+    eng = _FlakyEngine(die_after=3, failures=1)
+    mig = Migration(eng, migration_limit=2, retry_delay_s=0.01)
+    req = {"token_ids": [1, 2, 3], "stop_conditions": {"max_tokens": 8}}
+    out = [x async for x in mig.generate(req, Context())]
+    tokens = [t for x in out for t in x["token_ids"]]
+    assert len(tokens) == 8  # 3 before death + 5 after migration
+    assert eng.attempts == 2
+    # second attempt got original prompt + the 3 generated tokens
+    assert len(eng.received_prompts[1]) == 6
+    assert out[-1]["finish_reason"] == "length"
+
+
+async def test_migration_exhausts_and_raises():
+    eng = _FlakyEngine(die_after=1, failures=99)
+    mig = Migration(eng, migration_limit=2, retry_delay_s=0.01)
+    req = {"token_ids": [1], "stop_conditions": {"max_tokens": 5}}
+    with pytest.raises(StreamError):
+        async for _ in mig.generate(req, Context()):
+            pass
+    assert eng.attempts == 3  # initial + 2 retries
+
+
+# ------------------------------------------------------- http over mockers
+
+
+async def _serve_stack(num_workers=2, router_mode="kv"):
+    """In-process stack: mocker fleet + watcher + http frontend."""
+    drt = DistributedRuntime(InMemoryHub())
+    cfg = MockEngineConfig(block_size=4, total_kv_blocks=512, speedup_ratio=500.0)
+    from dynamo_tpu.mocker.__main__ import launch_mock_worker
+
+    for i in range(num_workers):
+        await launch_mock_worker(
+            drt, "dyn", "backend", "generate", cfg,
+            model_name="mock-model", register_card=True, router_mode=router_mode,
+        )
+    manager = ModelManager()
+    watcher = await ModelWatcher(drt, manager).start()
+    await watcher.wait_for_model("mock-model", timeout=5)
+    frontend = HttpFrontend(manager, host="127.0.0.1", port=0)
+    await frontend.start()
+    return drt, watcher, frontend
+
+
+async def test_http_chat_completion_aggregated_and_models():
+    drt, watcher, frontend = await _serve_stack()
+    base = f"http://127.0.0.1:{frontend.port}"
+    try:
+        async with aiohttp.ClientSession() as sess:
+            # /v1/models
+            async with sess.get(f"{base}/v1/models") as r:
+                models = await r.json()
+            assert models["data"][0]["id"] == "mock-model"
+
+            # aggregated chat completion
+            async with sess.post(
+                f"{base}/v1/chat/completions",
+                json={
+                    "model": "mock-model",
+                    "messages": [{"role": "user", "content": "hello"}],
+                    "max_tokens": 5,
+                },
+            ) as r:
+                assert r.status == 200, await r.text()
+                body = await r.json()
+            assert body["object"] == "chat.completion"
+            assert body["usage"]["completion_tokens"] == 5
+            assert body["choices"][0]["finish_reason"] == "length"
+
+            # unknown model -> 404
+            async with sess.post(
+                f"{base}/v1/chat/completions",
+                json={"model": "nope", "messages": []},
+            ) as r:
+                assert r.status == 404
+
+            # invalid json -> 400
+            async with sess.post(
+                f"{base}/v1/chat/completions", data=b"{not json"
+            ) as r:
+                assert r.status == 400
+
+            # health + metrics
+            async with sess.get(f"{base}/health") as r:
+                health = await r.json()
+            assert health["status"] == "healthy"
+            assert health["models"]["mock-model"]["instances"] == 2
+            async with sess.get(f"{base}/metrics") as r:
+                text = await r.text()
+            assert "dynamo_time_to_first_token_seconds" in text
+            assert "dynamo_http_requests_total" in text
+    finally:
+        await frontend.stop()
+        await watcher.close()
+        await drt.close()
+
+
+async def test_http_chat_completion_streaming_sse():
+    drt, watcher, frontend = await _serve_stack(num_workers=1)
+    base = f"http://127.0.0.1:{frontend.port}"
+    try:
+        async with aiohttp.ClientSession() as sess:
+            async with sess.post(
+                f"{base}/v1/chat/completions",
+                json={
+                    "model": "mock-model",
+                    "messages": [{"role": "user", "content": "hi"}],
+                    "max_tokens": 4,
+                    "stream": True,
+                    "stream_options": {"include_usage": True},
+                },
+            ) as r:
+                assert r.status == 200
+                assert r.headers["Content-Type"].startswith("text/event-stream")
+                chunks = []
+                async for line in r.content:
+                    line = line.decode().strip()
+                    if line.startswith("data: "):
+                        payload = line[6:]
+                        if payload == "[DONE]":
+                            break
+                        chunks.append(json.loads(payload))
+        assert chunks[0]["choices"][0]["delta"].get("role") == "assistant"
+        assert chunks[-1].get("usage", {}).get("completion_tokens") == 4
+        data_chunks = [c for c in chunks if c["choices"]]
+        assert data_chunks[-1]["choices"][0]["finish_reason"] == "length"
+    finally:
+        await frontend.stop()
+        await watcher.close()
+        await drt.close()
+
+
+async def test_http_completions_endpoint():
+    drt, watcher, frontend = await _serve_stack(num_workers=1)
+    base = f"http://127.0.0.1:{frontend.port}"
+    try:
+        async with aiohttp.ClientSession() as sess:
+            async with sess.post(
+                f"{base}/v1/completions",
+                json={"model": "mock-model", "prompt": "once upon", "max_tokens": 3},
+            ) as r:
+                assert r.status == 200
+                body = await r.json()
+            assert body["object"] == "text_completion"
+            assert body["usage"]["completion_tokens"] == 3
+    finally:
+        await frontend.stop()
+        await watcher.close()
+        await drt.close()
+
+
+async def test_model_removed_when_last_worker_leaves():
+    drt = DistributedRuntime(InMemoryHub())
+    cfg = MockEngineConfig(block_size=4, speedup_ratio=500.0)
+    from dynamo_tpu.mocker.__main__ import launch_mock_worker
+
+    _eng, served = await launch_mock_worker(
+        drt, "dyn", "backend", "generate", cfg,
+        model_name="solo", register_card=True,
+    )
+    manager = ModelManager()
+    watcher = await ModelWatcher(drt, manager).start()
+    await watcher.wait_for_model("solo", timeout=5)
+
+    # deregister: delete instance + card keys (as lease expiry would)
+    await served.shutdown()
+    lease = drt._lease_id
+    await drt.hub.revoke_lease(lease)
+    for _ in range(100):
+        if manager.get("solo") is None:
+            break
+        await asyncio.sleep(0.02)
+    assert manager.get("solo") is None
+    await watcher.close()
+    await drt.close()
