@@ -1,0 +1,21 @@
+"""The JAX inference engine: the TPU-native compute path.
+
+This is the part the reference delegates to vLLM/SGLang/TRT-LLM - here it is
+ours, built TPU-first:
+
+  - paged KV cache as stacked per-layer page arrays in HBM (cache.py)
+  - llama-family models in pure JAX with tensor-parallel shardings over a
+    jax.sharding.Mesh (models/llama.py)
+  - prefill/decode as two jitted functions with static shapes (bucketed
+    prefill, fixed decode slots) so XLA compiles each shape once (core.py)
+  - continuous batching: admission into decode slots, page-granular prefix
+    cache keyed by the same sequence hashes the router uses, KV event
+    emission (core.py + cache.py)
+  - on-device sampling (sampling.py) so only sampled token ids cross
+    device->host per step
+"""
+
+from dynamo_tpu.engine.config import EngineConfig, ModelSpec
+from dynamo_tpu.engine.core import InferenceEngine
+
+__all__ = ["EngineConfig", "ModelSpec", "InferenceEngine"]

@@ -1,0 +1,92 @@
+"""Model + engine configuration.
+
+ModelSpec describes a llama-family transformer (all the models the reference
+recipes target are in-family or MoE variants handled in models/moe.py);
+EngineConfig describes the serving engine's memory and batching envelope.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+
+@dataclass(frozen=True)
+class ModelSpec:
+    name: str = "tiny-test"
+    vocab_size: int = 272  # mock-tokenizer-compatible default
+    hidden_size: int = 128
+    intermediate_size: int = 256
+    num_layers: int = 2
+    num_heads: int = 4
+    num_kv_heads: int = 2
+    head_dim: int = 32
+    rope_theta: float = 500000.0
+    rms_eps: float = 1e-5
+    tie_embeddings: bool = True
+    dtype: str = "bfloat16"
+    # MoE (0 experts = dense)
+    num_experts: int = 0
+    num_experts_per_token: int = 0
+    moe_intermediate_size: int = 0
+
+    @classmethod
+    def llama3_8b(cls) -> "ModelSpec":
+        return cls(
+            name="llama-3-8b", vocab_size=128256, hidden_size=4096,
+            intermediate_size=14336, num_layers=32, num_heads=32,
+            num_kv_heads=8, head_dim=128, tie_embeddings=False,
+        )
+
+    @classmethod
+    def llama3_70b(cls) -> "ModelSpec":
+        return cls(
+            name="llama-3-70b", vocab_size=128256, hidden_size=8192,
+            intermediate_size=28672, num_layers=80, num_heads=64,
+            num_kv_heads=8, head_dim=128, tie_embeddings=False,
+        )
+
+    @classmethod
+    def tiny(cls, vocab_size: int = 272) -> "ModelSpec":
+        return cls(vocab_size=vocab_size)
+
+    @classmethod
+    def preset(cls, name: str) -> "ModelSpec":
+        presets = {
+            "tiny-test": cls.tiny,
+            "llama-3-8b": cls.llama3_8b,
+            "llama-3-70b": cls.llama3_70b,
+        }
+        if name in presets:
+            return presets[name]()
+        raise KeyError(f"unknown model preset {name!r}")
+
+
+@dataclass
+class EngineConfig:
+    # paged KV cache
+    page_size: int = 16  # tokens per page (= router block_size granularity)
+    num_pages: int = 2048  # HBM page budget (per shard)
+    max_pages_per_seq: int = 64  # max context = page_size * this
+    # batching
+    max_decode_slots: int = 8  # concurrent sequences in the decode batch
+    prefill_buckets: tuple[int, ...] = (64, 128, 256, 512, 1024, 2048, 4096)
+    # parallelism (mesh axes sizes; 1 = off)
+    tp: int = 1
+    dp: int = 1
+    # sampling
+    seed: int = 0
+    # scheduler
+    step_idle_sleep_s: float = 0.002
+
+    @property
+    def max_context(self) -> int:
+        return self.page_size * self.max_pages_per_seq
+
+    def bucket_for(self, length: int) -> int:
+        for b in self.prefill_buckets:
+            if length <= b:
+                return b
+        raise ValueError(
+            f"prompt length {length} exceeds largest prefill bucket "
+            f"{self.prefill_buckets[-1]}"
+        )

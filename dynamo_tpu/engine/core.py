@@ -1,0 +1,503 @@
+"""InferenceEngine: continuous batching over the paged JAX model.
+
+The engine is the TPU-native replacement for the reference's delegated
+engines (vLLM et al.). One background step loop owns the device:
+
+  admit -> prefill (one request per step, bucketed static shape)
+        -> decode (all active slots, one fixed-shape step)
+        -> sample on device -> stream tokens to per-request queues
+
+Prefix caching is page-granular and keyed by the same sequence-hash chain
+the KV router indexes, so the router's cache view and the engine's actual
+reuse agree. Cache events + ForwardPassMetrics publish through the standard
+worker publishers, making this engine a drop-in behind the same frontend /
+router / planner stack as the mocker.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import threading
+from dataclasses import dataclass, field
+from typing import Any, AsyncIterator
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from dynamo_tpu.engine.cache import OutOfPages, PageAllocator, SeqPages
+from dynamo_tpu.engine.config import EngineConfig, ModelSpec
+from dynamo_tpu.engine.sampling import sample_tokens
+from dynamo_tpu.kv_router.protocols import ForwardPassMetrics
+from dynamo_tpu.models import llama
+from dynamo_tpu.runtime.context import Context
+from dynamo_tpu.tokens import TokenBlockSequence
+
+log = logging.getLogger("dynamo.engine")
+
+
+@dataclass
+class _Slot:
+    request_id: str
+    context: Context
+    out_q: asyncio.Queue
+    seq: TokenBlockSequence  # prompt + generated tokens
+    pages: SeqPages
+    seq_len: int  # tokens currently in the KV cache
+    remaining: int  # decode budget left
+    temperature: float = 0.0
+    top_k: int = 0
+    top_p: float = 1.0
+    ignore_eos: bool = False
+    stop_token_ids: frozenset[int] = frozenset()
+    eos_ids: frozenset[int] = frozenset((2,))
+    min_tokens: int = 0
+    generated: int = 0
+    last_token: int = 0
+    sample_seed: int = 0  # per-request PRNG seed (reproducible if client-set)
+    stalled_steps: int = 0  # consecutive steps skipped waiting for pages
+
+
+@dataclass
+class _Waiting:
+    request: dict[str, Any]
+    context: Context
+    out_q: asyncio.Queue
+
+
+class InferenceEngine:
+    def __init__(
+        self,
+        spec: ModelSpec,
+        config: EngineConfig | None = None,
+        *,
+        mesh=None,
+        params=None,
+        event_publisher=None,
+        metrics_publisher=None,
+    ):
+        self.spec = spec
+        self.config = config or EngineConfig()
+        self.mesh = mesh
+        self.events = event_publisher
+        self.metrics = metrics_publisher
+
+        key = jax.random.PRNGKey(self.config.seed)
+        if params is None:
+            params = llama.init_params(spec, key)
+        if mesh is not None:
+            shardings = llama.param_shardings(spec, mesh)
+            params = jax.tree.map(
+                lambda p, s: jax.device_put(p, s), params, shardings
+            )
+        self.params = params
+
+        # +1 page: index 0 is the trash page
+        self.k_pages, self.v_pages = llama.init_cache(
+            spec, self.config.num_pages + 1, self.config.page_size
+        )
+        if mesh is not None:
+            ks, vs = llama.cache_shardings(mesh)
+            self.k_pages = jax.device_put(self.k_pages, ks)
+            self.v_pages = jax.device_put(self.v_pages, vs)
+
+        self.allocator = PageAllocator(
+            self.config.num_pages + 1,
+            self.config.page_size,
+            on_store=self._on_store,
+            on_evict=self._on_evict,
+        )
+        self._slots: list[_Slot | None] = [None] * self.config.max_decode_slots
+        self._waiting: asyncio.Queue[_Waiting] = asyncio.Queue()
+        self._seed_counter = self.config.seed
+        self._loop_task: asyncio.Task | None = None
+        self._loop: asyncio.AbstractEventLoop | None = None
+        self._loop_thread: int | None = None
+        self._wake = asyncio.Event()
+        self._closed = False
+        self.steps = 0
+        # largest prompt the engine accepts in one prefill
+        self.max_prefill_tokens = min(
+            self.config.prefill_buckets[-1], self.config.max_context
+        )
+
+    # -- events ------------------------------------------------------------
+
+    def _on_store(self, sh: int, parent: int) -> None:
+        if self.events is not None:
+            self.events.block_stored(sh, parent)
+
+    def _on_evict(self, shs: list[int]) -> None:
+        if self.events is not None and shs:
+            self.events.blocks_removed(shs)
+
+    def _publish_metrics(self) -> None:
+        if self.metrics is not None:
+            self.metrics.publish(
+                ForwardPassMetrics(
+                    active_kv_blocks=self.allocator.active_pages,
+                    total_kv_blocks=self.allocator.num_pages - 1,
+                    waiting_requests=self._waiting.qsize(),
+                    running_requests=sum(s is not None for s in self._slots),
+                )
+            )
+
+    def _post(self, q: asyncio.Queue, item: Any) -> None:
+        """Thread-safe queue put: compute threads must not touch asyncio
+        primitives directly."""
+        if self._loop is None or threading.get_ident() == self._loop_thread:
+            q.put_nowait(item)
+        else:
+            self._loop.call_soon_threadsafe(q.put_nowait, item)
+
+    # -- public API --------------------------------------------------------
+
+    async def start(self) -> "InferenceEngine":
+        if self._loop_task is None or self._loop_task.done():
+            self._loop = asyncio.get_running_loop()
+            self._loop_thread = threading.get_ident()
+            self._loop_task = self._loop.create_task(self._step_loop())
+        return self
+
+    async def close(self) -> None:
+        self._closed = True
+        self._wake.set()
+        if self._loop_task is not None:
+            self._loop_task.cancel()
+
+    async def generate(
+        self, request: dict[str, Any], context: Context
+    ) -> AsyncIterator[dict[str, Any]]:
+        """AsyncEngine surface: stream token deltas for one request."""
+        await self.start()
+        token_ids = list(request.get("token_ids") or [])
+        if not token_ids:
+            yield {"token_ids": [], "finish_reason": "error",
+                   "error": "empty token_ids"}
+            return
+        if len(token_ids) >= self.config.max_context:
+            yield {"token_ids": [], "finish_reason": "error",
+                   "error": f"prompt exceeds max context {self.config.max_context}"}
+            return
+        if len(token_ids) > self.max_prefill_tokens:
+            yield {"token_ids": [], "finish_reason": "error",
+                   "error": f"prompt exceeds max prefill {self.max_prefill_tokens}"}
+            return
+        out_q: asyncio.Queue = asyncio.Queue()
+        await self._waiting.put(_Waiting(request, context, out_q))
+        self._wake.set()
+        while True:
+            item = await out_q.get()
+            if item is None:
+                return
+            yield item
+            if item.get("finish_reason") is not None:
+                return
+
+    # -- step loop ---------------------------------------------------------
+
+    async def _step_loop(self) -> None:
+        while not self._closed:
+            try:
+                did_work = await self._step()
+                if not did_work:
+                    self._wake.clear()
+                    if self._waiting.empty() and not any(self._slots):
+                        await self._wake.wait()
+                    else:
+                        await asyncio.sleep(self.config.step_idle_sleep_s)
+            except asyncio.CancelledError:
+                return
+            except Exception:  # noqa: BLE001
+                # fail every in-flight request, then KEEP SERVING: one bad
+                # step must not brick the worker
+                log.exception("engine step failed; failing in-flight requests")
+                for i, slot in enumerate(self._slots):
+                    if slot is not None:
+                        self._finish(i, slot, "error", error="engine step failure")
+                while not self._waiting.empty():
+                    w = self._waiting.get_nowait()
+                    w.out_q.put_nowait(
+                        {"token_ids": [], "finish_reason": "error",
+                         "error": "engine step failure"}
+                    )
+                await asyncio.sleep(0.05)
+
+    async def _step(self) -> bool:
+        did = False
+        # 1) admit one waiting request into a free slot (prefill)
+        free_idx = next(
+            (i for i, s in enumerate(self._slots) if s is None), None
+        )
+        if free_idx is not None and not self._waiting.empty():
+            waiting = self._waiting.get_nowait()
+            if waiting.context.is_stopped:
+                waiting.out_q.put_nowait(
+                    {"token_ids": [], "finish_reason": "cancelled"}
+                )
+            else:
+                await asyncio.to_thread(self._prefill_safe, free_idx, waiting)
+            did = True
+            self._publish_metrics()
+
+        # 2) one decode step over active slots
+        if any(s is not None for s in self._slots):
+            await asyncio.to_thread(self._decode_step)
+            did = True
+        return did
+
+    # -- prefill (runs in thread) ------------------------------------------
+
+    def _prefill_safe(self, slot_idx: int, waiting: _Waiting) -> None:
+        """Per-request error isolation: a bad request must not kill the loop."""
+        try:
+            self._prefill(slot_idx, waiting)
+        except Exception as e:  # noqa: BLE001
+            log.exception("prefill failed for %s", waiting.context.id)
+            self._post(
+                waiting.out_q,
+                {"token_ids": [], "finish_reason": "error",
+                 "error": f"prefill failed: {e}"},
+            )
+
+    def _prefill(self, slot_idx: int, waiting: _Waiting) -> None:
+        cfg = self.config
+        req = waiting.request
+        token_ids = list(req["token_ids"])
+        sampling = req.get("sampling") or {}
+        stop = req.get("stop_conditions") or {}
+        max_tokens = stop.get("max_tokens")
+        max_tokens = 16 if max_tokens is None else int(max_tokens)
+        max_tokens = max(min(max_tokens, cfg.max_context - len(token_ids) - 1), 1)
+
+        seq = TokenBlockSequence.from_tokens(token_ids, cfg.page_size)
+        hashes = seq.sequence_hashes()
+
+        # prefix-cache hit: reuse cached pages, but always leave >=1 token to
+        # compute (we need last-position logits)
+        cached_pages = self.allocator.take_prefix(hashes)
+        while cached_pages and len(cached_pages) * cfg.page_size >= len(token_ids):
+            self.allocator.release([cached_pages.pop()])
+        start_pos = len(cached_pages) * cfg.page_size
+
+        sp = SeqPages(request_id=waiting.context.id)
+        sp.pages = list(cached_pages)
+        sp.hashes = [hashes[i] for i in range(len(cached_pages))]
+        sp.cached_prefix_pages = len(cached_pages)
+
+        # allocate pages to cover the whole prompt
+        needed_pages = (len(token_ids) + cfg.page_size - 1) // cfg.page_size
+        try:
+            while sp.num_pages < needed_pages:
+                sp.pages.append(self.allocator.alloc_page())
+                sp.hashes.append(None)
+        except OutOfPages:
+            self.allocator.release(sp.pages)
+            self._post(
+                waiting.out_q,
+                {"token_ids": [], "finish_reason": "error",
+                 "error": "kv pages exhausted"},
+            )
+            return
+
+        new_tokens = token_ids[start_pos:]
+        bucket = cfg.bucket_for(len(new_tokens))
+        padded = np.zeros((bucket,), np.int32)
+        padded[: len(new_tokens)] = new_tokens
+        block_table = np.zeros((cfg.max_pages_per_seq,), np.int32)
+        block_table[: sp.num_pages] = sp.pages
+
+        logits, self.k_pages, self.v_pages = llama.prefill_forward(
+            self.spec,
+            self.params,
+            jnp.asarray(padded),
+            jnp.asarray(block_table),
+            jnp.asarray(start_pos, jnp.int32),
+            self.k_pages,
+            self.v_pages,
+            jnp.asarray(len(new_tokens), jnp.int32),
+        )
+
+        # seal prompt pages whose block is complete (skip already-cached)
+        for i in range(sp.cached_prefix_pages, len(seq.blocks)):
+            blk = seq.blocks[i]
+            self.allocator.seal_page(
+                sp.pages[i], blk.sequence_hash, blk.parent_sequence_hash
+            )
+            sp.hashes[i] = blk.sequence_hash
+
+        def opt(d, key, default):
+            v = d.get(key)
+            return default if v is None else v
+
+        self._seed_counter += 1
+        slot = _Slot(
+            request_id=waiting.context.id,
+            context=waiting.context,
+            out_q=waiting.out_q,
+            seq=seq,
+            pages=sp,
+            seq_len=len(token_ids),
+            remaining=max_tokens,
+            temperature=float(opt(sampling, "temperature", 0.0)),
+            top_k=int(opt(sampling, "top_k", 0)),
+            top_p=float(opt(sampling, "top_p", 1.0)),
+            ignore_eos=bool(stop.get("ignore_eos", False)),
+            stop_token_ids=frozenset(stop.get("stop_token_ids") or ()),
+            eos_ids=frozenset(req.get("eos_token_ids") or (2,)),
+            min_tokens=int(opt(stop, "min_tokens", 0)),
+            last_token=token_ids[-1],
+            sample_seed=int(opt(sampling, "seed", self._seed_counter)) & 0xFFFFFFFF,
+        )
+
+        # sample the first token from prefill logits
+        tok = self._sample_single(logits, slot)
+        self._emit_token(slot_idx, slot, tok)
+
+    # -- decode (runs in thread) -------------------------------------------
+
+    def _decode_step(self) -> None:
+        cfg = self.config
+        B = cfg.max_decode_slots
+        tokens = np.zeros((B,), np.int32)
+        block_tables = np.zeros((B, cfg.max_pages_per_seq), np.int32)
+        seq_lens = np.ones((B,), np.int32)
+        active = np.zeros((B,), bool)
+        temps = np.zeros((B,), np.float32)
+        topk = np.zeros((B,), np.int32)
+        topp = np.ones((B,), np.float32)
+        seeds = np.zeros((B,), np.uint32)
+        steps = np.zeros((B,), np.int32)
+
+        MAX_STALL = 2000  # steps a slot may wait for a free page
+
+        for i, slot in enumerate(self._slots):
+            if slot is None:
+                continue
+            if slot.context.is_stopped:
+                self._finish(i, slot, "cancelled")
+                continue
+            # ensure a page exists for the incoming token at position seq_len
+            page_needed = slot.seq_len // cfg.page_size
+            if page_needed >= slot.pages.num_pages:
+                try:
+                    slot.pages.pages.append(self.allocator.alloc_page())
+                    slot.pages.hashes.append(None)
+                except OutOfPages:
+                    # backpressure: stall this slot; a neighbor finishing
+                    # will free pages. Only give up after a long stall.
+                    slot.stalled_steps += 1
+                    if slot.stalled_steps > MAX_STALL:
+                        self._finish(i, slot, "error", error="kv pages exhausted")
+                    continue
+            slot.stalled_steps = 0
+            active[i] = True
+            tokens[i] = slot.last_token
+            block_tables[i, : slot.pages.num_pages] = slot.pages.pages
+            seq_lens[i] = slot.seq_len + 1  # including the new token
+            temps[i] = slot.temperature
+            topk[i] = slot.top_k
+            topp[i] = slot.top_p
+            seeds[i] = slot.sample_seed
+            steps[i] = slot.generated
+
+        if not active.any():
+            return
+
+        logits, self.k_pages, self.v_pages = llama.decode_forward(
+            self.spec,
+            self.params,
+            jnp.asarray(tokens),
+            jnp.asarray(block_tables),
+            jnp.asarray(seq_lens),
+            self.k_pages,
+            self.v_pages,
+            jnp.asarray(active),
+        )
+        sampled = np.asarray(
+            sample_tokens(
+                logits, jnp.asarray(temps), jnp.asarray(topk),
+                jnp.asarray(topp), jnp.asarray(seeds), jnp.asarray(steps),
+            )
+        )
+        self.steps += 1
+
+        for i, slot in enumerate(self._slots):
+            if slot is None or not active[i]:
+                continue
+            slot.seq_len += 1  # the fed token is now in the cache
+            self._maybe_seal(slot)
+            self._emit_token(i, slot, int(sampled[i]))
+
+        if self.steps % 16 == 0:
+            self._publish_metrics()
+
+    # -- helpers -----------------------------------------------------------
+
+    def _sample_single(self, logits: jax.Array, slot: _Slot) -> int:
+        tok = sample_tokens(
+            logits[None, :],
+            jnp.asarray([slot.temperature], jnp.float32),
+            jnp.asarray([slot.top_k], jnp.int32),
+            jnp.asarray([slot.top_p], jnp.float32),
+            jnp.asarray([slot.sample_seed], jnp.uint32),
+            jnp.asarray([slot.generated], jnp.int32),
+        )
+        return int(np.asarray(tok)[0])
+
+    def _maybe_seal(self, slot: _Slot) -> None:
+        """Seal the page whose block just completed (if any)."""
+        n_complete = slot.seq_len // self.config.page_size
+        for i in range(n_complete):
+            if i < len(slot.pages.hashes) and slot.pages.hashes[i] is None:
+                if i < len(slot.seq.blocks):
+                    blk = slot.seq.blocks[i]
+                    self.allocator.seal_page(
+                        slot.pages.pages[i],
+                        blk.sequence_hash,
+                        blk.parent_sequence_hash,
+                    )
+                    slot.pages.hashes[i] = blk.sequence_hash
+
+    def _emit_token(self, slot_idx: int, slot: _Slot, tok: int) -> None:
+        """Record + stream one sampled token; place slot or finish."""
+        slot.seq.append(tok)
+        slot.generated += 1
+        slot.remaining -= 1
+        slot.last_token = tok
+
+        finish = None
+        if (
+            not slot.ignore_eos
+            and slot.generated >= slot.min_tokens
+            and tok in slot.eos_ids
+        ):
+            finish = "stop"
+        elif tok in slot.stop_token_ids and slot.generated >= slot.min_tokens:
+            finish = "stop"
+        elif slot.remaining <= 0:
+            finish = "length"
+
+        if finish is not None:
+            # release resources BEFORE posting the finish item, so a client
+            # observing the end of stream sees the engine's pages freed.
+            # (The finishing token was never written to the cache - it would
+            # be written on the next step - which is fine: the request is over.)
+            self._finish(slot_idx, slot, finish, emit=False)
+        else:
+            self._slots[slot_idx] = slot
+        self._post(slot.out_q, {"token_ids": [tok], "finish_reason": finish})
+
+    def _finish(
+        self, slot_idx: int, slot: _Slot, reason: str,
+        *, error: str | None = None, emit: bool = True,
+    ) -> None:
+        if emit:
+            item: dict[str, Any] = {"token_ids": [], "finish_reason": reason}
+            if error:
+                item["error"] = error
+            self._post(slot.out_q, item)
+        self.allocator.release(slot.pages.pages)
+        self._slots[slot_idx] = None
+        self._publish_metrics()

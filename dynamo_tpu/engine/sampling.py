@@ -1,0 +1,65 @@
+"""On-device token sampling.
+
+Sampling runs on the accelerator so only the sampled ids [B] cross to host
+each step (pulling [B, vocab] logits would burn PCIe/host time every
+iteration). Per-slot parameters travel as arrays; temperature 0 selects
+greedy via a where, keeping one jitted function for the whole batch.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = -1e30
+
+
+@partial(jax.jit, donate_argnums=())
+def sample_tokens(
+    logits: jax.Array,  # [B, V] f32
+    temperature: jax.Array,  # [B]
+    top_k: jax.Array,  # [B] int32 (0 = off)
+    top_p: jax.Array,  # [B] f32 (1.0 = off)
+    seeds: jax.Array,  # [B] uint32: per-request sampling seed
+    steps: jax.Array,  # [B] int32: tokens generated so far (fold-in)
+) -> jax.Array:
+    """Returns sampled token ids [B].
+
+    Randomness is per-request: key_i = fold_in(PRNGKey(seed_i), step_i), so a
+    request with an explicit seed reproduces its stream regardless of what
+    else shares the batch.
+    """
+    B, V = logits.shape
+    greedy = jnp.argmax(logits, axis=-1)
+
+    # top-k mask
+    def apply_topk(lg, k):
+        # k == 0 -> disabled
+        kth = jnp.sort(lg)[-jnp.maximum(k, 1)]
+        mask = lg >= kth
+        return jnp.where((k > 0) & ~mask, NEG_INF, lg)
+
+    logits_k = jax.vmap(apply_topk)(logits, top_k)
+
+    # top-p (nucleus) mask
+    def apply_topp(lg, p):
+        sorted_lg = jnp.sort(lg)[::-1]
+        probs = jax.nn.softmax(sorted_lg)
+        cum = jnp.cumsum(probs)
+        # keep tokens whose cumulative prob (exclusive) < p
+        cutoff_count = jnp.sum(cum - probs < p)
+        kth = sorted_lg[jnp.maximum(cutoff_count - 1, 0)]
+        return jnp.where((p < 1.0) & (lg < kth), NEG_INF, lg)
+
+    logits_kp = jax.vmap(apply_topp)(logits_k, top_p)
+
+    temp = jnp.maximum(temperature, 1e-6)[:, None]
+    keys = jax.vmap(
+        lambda s, st: jax.random.fold_in(jax.random.PRNGKey(s), st)
+    )(seeds, steps)
+    sampled = jax.vmap(
+        lambda k, lg: jax.random.categorical(k, lg)
+    )(keys, logits_kp / temp)
+    return jnp.where(temperature <= 0.0, greedy, sampled).astype(jnp.int32)

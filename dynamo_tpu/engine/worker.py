@@ -1,0 +1,127 @@
+"""JAX engine worker process: ``python -m dynamo_tpu.engine.worker``.
+
+The TPU-native counterpart of the reference's engine workers
+(components/src/dynamo/vllm/main.py:69 ``worker``): build the engine (model
++ mesh + paged cache), register the model card, serve ``generate``, publish
+KV events + metrics. Disagg prefill/decode roles arrive with the disagg
+module (--mode prefill|decode|aggregated).
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import logging
+
+from dynamo_tpu.engine.config import EngineConfig, ModelSpec
+from dynamo_tpu.engine.core import InferenceEngine
+from dynamo_tpu.frontend.model_card import register_llm
+from dynamo_tpu.kv_router.publisher import KvEventPublisher, WorkerMetricsPublisher
+from dynamo_tpu.runtime.config import RuntimeConfig
+from dynamo_tpu.runtime.distributed import DistributedRuntime
+from dynamo_tpu.runtime.hub_client import connect_hub
+from dynamo_tpu.runtime.logging_util import setup_logging
+
+log = logging.getLogger("dynamo.engine.worker")
+
+
+async def launch_engine_worker(
+    drt: DistributedRuntime,
+    *,
+    namespace: str = "dynamo",
+    component: str = "backend",
+    endpoint: str = "generate",
+    model: str = "tiny-test",
+    model_name: str | None = None,
+    tokenizer: str = "mock",
+    engine_config: EngineConfig | None = None,
+    spec: ModelSpec | None = None,
+    router_mode: str = "kv",
+) -> tuple[InferenceEngine, object]:
+    """Build + register one engine worker in this process."""
+    spec = spec or ModelSpec.preset(model)
+    cfg = engine_config or EngineConfig()
+    mesh = None
+    if cfg.tp > 1 or cfg.dp > 1:
+        from dynamo_tpu.parallel.mesh import make_mesh
+
+        mesh = make_mesh(tp=cfg.tp, dp=cfg.dp)
+
+    engine = InferenceEngine(spec, cfg, mesh=mesh)
+    ep = drt.namespace(namespace).component(component).endpoint(endpoint)
+    served, card = await register_llm(
+        drt, ep, engine.generate,
+        model_name=model_name or spec.name,
+        tokenizer=tokenizer,
+        context_length=cfg.max_context,
+        kv_block_size=cfg.page_size,
+        router_mode=router_mode,
+        runtime_config={"engine": "jax", "tp": cfg.tp},
+        metadata={"engine": "jax"},
+    )
+    wid = served.instance.instance_id
+    comp_path = f"{namespace}/{component}"
+    engine.events = KvEventPublisher(drt.hub, comp_path, wid).start()
+    engine.metrics = WorkerMetricsPublisher(drt.hub, comp_path, wid).start()
+    await engine.start()
+    engine._publish_metrics()
+    log.info(
+        "engine worker %x up: model=%s pages=%d slots=%d tp=%d",
+        wid, spec.name, cfg.num_pages, cfg.max_decode_slots, cfg.tp,
+    )
+    return engine, served
+
+
+async def _amain(args: argparse.Namespace) -> None:
+    rcfg = RuntimeConfig.from_env()
+    if args.hub:
+        rcfg.hub_address = args.hub
+    drt = DistributedRuntime(await connect_hub(rcfg.hub_address), rcfg)
+    ecfg = EngineConfig(
+        page_size=args.page_size,
+        num_pages=args.num_pages,
+        max_pages_per_seq=args.max_pages_per_seq,
+        max_decode_slots=args.max_decode_slots,
+        tp=args.tp,
+    )
+    await launch_engine_worker(
+        drt,
+        namespace=args.namespace,
+        component=args.component,
+        endpoint=args.endpoint,
+        model=args.model,
+        model_name=args.model_name,
+        tokenizer=args.tokenizer,
+        engine_config=ecfg,
+        router_mode=args.router_mode,
+    )
+    print("ENGINE_READY", flush=True)
+    await drt.runtime.wait_for_shutdown()
+
+
+def main() -> None:
+    p = argparse.ArgumentParser(description="dynamo-tpu JAX engine worker")
+    p.add_argument("--hub", default=None)
+    p.add_argument("--namespace", default="dynamo")
+    p.add_argument("--component", default="backend")
+    p.add_argument("--endpoint", default="generate")
+    p.add_argument("--model", default="tiny-test", help="model preset name")
+    p.add_argument("--model-name", default=None, help="served model name")
+    p.add_argument("--tokenizer", default="mock")
+    p.add_argument("--page-size", type=int, default=16)
+    p.add_argument("--num-pages", type=int, default=2048)
+    p.add_argument("--max-pages-per-seq", type=int, default=64)
+    p.add_argument("--max-decode-slots", type=int, default=8)
+    p.add_argument("--tp", type=int, default=1)
+    p.add_argument("--router-mode", default="kv",
+                   choices=["kv", "round_robin", "random"])
+    args = p.parse_args()
+    setup_logging()
+    try:
+        asyncio.run(_amain(args))
+    except KeyboardInterrupt:
+        pass
+
+
+if __name__ == "__main__":
+    main()

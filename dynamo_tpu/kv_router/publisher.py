@@ -10,6 +10,7 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import threading
 from typing import Any, Iterable
 
 from dynamo_tpu.kv_router.protocols import (
@@ -48,10 +49,14 @@ class KvEventPublisher:
         self._task: asyncio.Task | None = None
         self._dirty = asyncio.Event()
         self._closed = False
+        self._loop: asyncio.AbstractEventLoop | None = None
+        self._loop_thread: int | None = None
 
     def start(self) -> "KvEventPublisher":
         if self._task is None:
-            self._task = asyncio.get_running_loop().create_task(self._flush_loop())
+            self._loop = asyncio.get_running_loop()
+            self._loop_thread = threading.get_ident()
+            self._task = self._loop.create_task(self._flush_loop())
         return self
 
     # engine-facing (sync, callable from the scheduler loop) ---------------
@@ -69,17 +74,35 @@ class KvEventPublisher:
         self._mark_dirty()
 
     def _mark_dirty(self) -> None:
-        self._dirty.set()
-        if len(self._ops) >= self.max_batch:
-            # batch full: flush immediately rather than waiting the interval
-            asyncio.ensure_future(self.flush())
+        """Thread-safe: engines call block_stored from compute threads."""
+        if self._loop is None:
+            return  # not started yet; ops accumulate until start()
+
+        def signal() -> None:
+            self._dirty.set()
+            if len(self._ops) >= self.max_batch:
+                # batch full: flush immediately rather than waiting the interval
+                asyncio.ensure_future(self.flush())
+
+        if threading.get_ident() == self._loop_thread:
+            signal()
+        else:
+            self._loop.call_soon_threadsafe(signal)
 
     def cache_cleared(self) -> None:
         self._ops.clear()
         self._event_id += 1
-        asyncio.ensure_future(
-            self._publish(RouterEvent(self.worker_id, KvCacheEvent("cleared"), self._event_id))
-        )
+        ev = RouterEvent(self.worker_id, KvCacheEvent("cleared"), self._event_id)
+        if self._loop is None:
+            return
+
+        def send() -> None:
+            asyncio.ensure_future(self._publish(ev))
+
+        if threading.get_ident() == self._loop_thread:
+            send()
+        else:
+            self._loop.call_soon_threadsafe(send)
 
     # internals ------------------------------------------------------------
 

@@ -1,0 +1,6 @@
+"""Model definitions (pure JAX, mesh-shardable).
+
+llama.py covers the llama family (llama-2/3 dense: the reference's
+recipes/llama-3-70b target); moe.py adds mixture-of-experts layers with
+expert parallelism (gpt-oss-120b / deepseek-r1-class configs).
+"""

@@ -1,0 +1,281 @@
+"""Llama-family transformer in pure JAX with paged KV cache + TP shardings.
+
+Functional core: ``init_params`` builds the weight pytree (randomly - this
+environment has no model downloads; loading real safetensors goes through
+``load_params`` when files are present), ``prefill_forward`` and
+``decode_forward`` are the two jitted entry points. Tensor parallelism is
+megatron-style, expressed as NamedShardings on the weights (attention heads
+and MLP hidden column-sharded, output projections row-sharded) so XLA's SPMD
+partitioner inserts the collectives; activations get light
+``with_sharding_constraint`` guidance.
+
+Page 0 of the KV cache is the trash page: padded token positions scatter
+there, so static-shape prefill never corrupts live pages.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from dynamo_tpu.engine.config import ModelSpec
+from dynamo_tpu.ops.attention import causal_attention, gather_pages, paged_decode_attention
+
+TRASH_PAGE = 0  # reserved page index for padded-position scatters
+
+Params = dict[str, Any]
+
+
+# ---------------------------------------------------------------- init
+
+
+def init_params(spec: ModelSpec, key: jax.Array) -> Params:
+    """Random init (serving-scale weights come from load_params)."""
+    dtype = jnp.dtype(spec.dtype)
+    d, hd = spec.hidden_size, spec.head_dim
+    nh, nkv = spec.num_heads, spec.num_kv_heads
+    keys = iter(jax.random.split(key, 4 + spec.num_layers * 8))
+
+    def dense(k, shape, scale=None):
+        scale = scale or (1.0 / jnp.sqrt(shape[0]))
+        return (jax.random.normal(k, shape, jnp.float32) * scale).astype(dtype)
+
+    params: Params = {
+        "embed": dense(next(keys), (spec.vocab_size, d), scale=0.02),
+        "final_norm": jnp.ones((d,), dtype),
+        "layers": [],
+    }
+    if not spec.tie_embeddings:
+        params["lm_head"] = dense(next(keys), (d, spec.vocab_size))
+    for _ in range(spec.num_layers):
+        params["layers"].append(
+            {
+                "attn_norm": jnp.ones((d,), dtype),
+                "wq": dense(next(keys), (d, nh * hd)),
+                "wk": dense(next(keys), (d, nkv * hd)),
+                "wv": dense(next(keys), (d, nkv * hd)),
+                "wo": dense(next(keys), (nh * hd, d)),
+                "mlp_norm": jnp.ones((d,), dtype),
+                "w_gate": dense(next(keys), (d, spec.intermediate_size)),
+                "w_up": dense(next(keys), (d, spec.intermediate_size)),
+                "w_down": dense(next(keys), (spec.intermediate_size, d)),
+            }
+        )
+    return params
+
+
+def param_shardings(spec: ModelSpec, mesh: Mesh) -> Params:
+    """Megatron TP shardings over mesh axis "tp"."""
+
+    def ns(*axes):
+        return NamedSharding(mesh, P(*axes))
+
+    layer = {
+        "attn_norm": ns(),
+        "wq": ns(None, "tp"),  # column (heads)
+        "wk": ns(None, "tp"),
+        "wv": ns(None, "tp"),
+        "wo": ns("tp", None),  # row
+        "mlp_norm": ns(),
+        "w_gate": ns(None, "tp"),
+        "w_up": ns(None, "tp"),
+        "w_down": ns("tp", None),
+    }
+    out = {
+        "embed": ns(None, "tp"),
+        "final_norm": ns(),
+        "layers": [dict(layer) for _ in range(spec.num_layers)],
+    }
+    if not spec.tie_embeddings:
+        out["lm_head"] = ns(None, "tp")
+    return out
+
+
+def cache_shardings(mesh: Mesh) -> tuple[NamedSharding, NamedSharding]:
+    """KV pages [L, pages, page_size, kv_heads, D]: shard kv_heads on tp."""
+    s = NamedSharding(mesh, P(None, None, None, "tp", None))
+    return s, s
+
+
+def init_cache(
+    spec: ModelSpec, num_pages: int, page_size: int, dtype=None
+) -> tuple[jax.Array, jax.Array]:
+    """K and V page arrays [L, num_pages, page_size, kv_heads, head_dim].
+
+    ``num_pages`` must already include the trash page (index 0).
+    """
+    dtype = dtype or jnp.dtype(spec.dtype)
+    shape = (spec.num_layers, num_pages, page_size, spec.num_kv_heads, spec.head_dim)
+    return jnp.zeros(shape, dtype), jnp.zeros(shape, dtype)
+
+
+# ---------------------------------------------------------------- layers
+
+
+def rms_norm(x: jax.Array, w: jax.Array, eps: float) -> jax.Array:
+    xf = x.astype(jnp.float32)
+    var = jnp.mean(xf * xf, axis=-1, keepdims=True)
+    return (xf * jax.lax.rsqrt(var + eps)).astype(x.dtype) * w
+
+
+def rope(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:
+    """Rotary embedding. x: [T, heads, D], positions: [T]."""
+    D = x.shape[-1]
+    half = D // 2
+    freqs = 1.0 / (theta ** (jnp.arange(0, half, dtype=jnp.float32) / half))
+    angles = positions[:, None].astype(jnp.float32) * freqs[None, :]  # [T, half]
+    cos = jnp.cos(angles)[:, None, :]  # [T, 1, half]
+    sin = jnp.sin(angles)[:, None, :]
+    x1, x2 = x[..., :half], x[..., half:]
+    xf1, xf2 = x1.astype(jnp.float32), x2.astype(jnp.float32)
+    return jnp.concatenate(
+        [xf1 * cos - xf2 * sin, xf2 * cos + xf1 * sin], axis=-1
+    ).astype(x.dtype)
+
+
+def _attn_qkv(spec: ModelSpec, lp: Params, x: jax.Array, positions: jax.Array):
+    """x: [T, d] -> q [T, nh, hd], k/v [T, nkv, hd] with rope applied."""
+    T = x.shape[0]
+    q = (x @ lp["wq"]).reshape(T, spec.num_heads, spec.head_dim)
+    k = (x @ lp["wk"]).reshape(T, spec.num_kv_heads, spec.head_dim)
+    v = (x @ lp["wv"]).reshape(T, spec.num_kv_heads, spec.head_dim)
+    q = rope(q, positions, spec.rope_theta)
+    k = rope(k, positions, spec.rope_theta)
+    return q, k, v
+
+
+def _mlp(lp: Params, x: jax.Array) -> jax.Array:
+    return (jax.nn.silu(x @ lp["w_gate"]) * (x @ lp["w_up"])) @ lp["w_down"]
+
+
+def _logits(spec: ModelSpec, params: Params, x: jax.Array) -> jax.Array:
+    x = rms_norm(x, params["final_norm"], spec.rms_eps)
+    head = params["embed"].T if spec.tie_embeddings else params["lm_head"]
+    return (x @ head).astype(jnp.float32)
+
+
+# ---------------------------------------------------------------- prefill
+
+
+@partial(jax.jit, static_argnums=(0,), donate_argnums=(5, 6))
+def prefill_forward(
+    spec: ModelSpec,
+    params: Params,
+    tokens: jax.Array,  # [T_pad] int32 (padded)
+    block_table: jax.Array,  # [max_pages_per_seq] int32
+    start_pos: jax.Array,  # scalar: cached-prefix length (tokens)
+    k_pages: jax.Array,  # [L, num_pages, page, kvh, D] (donated)
+    v_pages: jax.Array,
+    num_tokens: jax.Array,  # scalar: real token count in ``tokens``
+) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """Process one prompt; writes KV pages; returns (last_logits, k, v).
+
+    Attention runs over the gathered paged context (cached prefix + newly
+    written tokens), so prefix-cache hits skip recompute of cached tokens.
+    """
+    T = tokens.shape[0]
+    idx = jnp.arange(T)
+    valid = idx < num_tokens
+    positions = start_pos + idx  # absolute positions of new tokens
+    page_size = k_pages.shape[2]
+
+    # padded positions scatter to the trash page
+    page_idx_raw = block_table[positions // page_size]
+    safe_page = jnp.where(valid, page_idx_raw, TRASH_PAGE)
+    offset = positions % page_size
+
+    x = params["embed"][tokens]  # [T, d]
+    kv_len = start_pos + num_tokens
+
+    for li, lp in enumerate(params["layers"]):
+        h = rms_norm(x, lp["attn_norm"], spec.rms_eps)
+        q, k, v = _attn_qkv(spec, lp, h, positions)
+        k_pages = k_pages.at[li, safe_page, offset].set(k)
+        v_pages = v_pages.at[li, safe_page, offset].set(v)
+        k_ctx = gather_pages(k_pages[li], block_table)  # [max_ctx, kvh, D]
+        v_ctx = gather_pages(v_pages[li], block_table)
+        attn = causal_attention(q, k_ctx, v_ctx, positions, kv_len)
+        attn = attn.reshape(T, spec.num_heads * spec.head_dim)
+        x = x + attn @ lp["wo"]
+        h = rms_norm(x, lp["mlp_norm"], spec.rms_eps)
+        x = x + _mlp(lp, h)
+
+    last = jnp.clip(num_tokens - 1, 0, T - 1)
+    logits = _logits(spec, params, x[last])  # [V]
+    return logits, k_pages, v_pages
+
+
+# ---------------------------------------------------------------- decode
+
+
+@partial(jax.jit, static_argnums=(0,), donate_argnums=(5, 6))
+def decode_forward(
+    spec: ModelSpec,
+    params: Params,
+    tokens: jax.Array,  # [B] int32: last sampled token per slot
+    block_tables: jax.Array,  # [B, max_pages_per_seq]
+    seq_lens: jax.Array,  # [B] length INCLUDING the new token
+    k_pages: jax.Array,  # donated
+    v_pages: jax.Array,
+    active: jax.Array,  # [B] bool: slot has a live request
+) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """One decode step for the whole slot batch; returns (logits[B,V], k, v)."""
+    B = tokens.shape[0]
+    page_size = k_pages.shape[2]
+    positions = seq_lens - 1  # position of the new token
+
+    page_idx_raw = jnp.take_along_axis(
+        block_tables, (positions // page_size)[:, None], axis=1
+    )[:, 0]
+    safe_page = jnp.where(active, page_idx_raw, TRASH_PAGE)
+    offset = positions % page_size
+
+    x = params["embed"][tokens]  # [B, d]
+
+    for li, lp in enumerate(params["layers"]):
+        h = rms_norm(x, lp["attn_norm"], spec.rms_eps)
+        # per-slot single-token qkv: vmap the [T=1] path
+        q = (h @ lp["wq"]).reshape(B, spec.num_heads, spec.head_dim)
+        k = (h @ lp["wk"]).reshape(B, spec.num_kv_heads, spec.head_dim)
+        v = (h @ lp["wv"]).reshape(B, spec.num_kv_heads, spec.head_dim)
+        q = rope(q, positions, spec.rope_theta)
+        k = rope(k, positions, spec.rope_theta)
+        k_pages = k_pages.at[li, safe_page, offset].set(k)
+        v_pages = v_pages.at[li, safe_page, offset].set(v)
+        attn = paged_decode_attention(
+            q, k_pages[li], v_pages[li], block_tables, seq_lens
+        )
+        attn = attn.reshape(B, spec.num_heads * spec.head_dim)
+        x = x + attn @ lp["wo"]
+        h = rms_norm(x, lp["mlp_norm"], spec.rms_eps)
+        x = x + _mlp(lp, h)
+
+    logits = _logits(spec, params, x)  # [B, V]
+    return logits, k_pages, v_pages
+
+
+# -------------------------------------------------------------- reference
+
+
+def reference_forward(
+    spec: ModelSpec, params: Params, tokens: jax.Array
+) -> jax.Array:
+    """Plain full-attention forward (no paging) - numerical ground truth for
+    tests. tokens: [T] -> logits [T, V]."""
+    T = tokens.shape[0]
+    positions = jnp.arange(T)
+    x = params["embed"][tokens]
+    for lp in params["layers"]:
+        h = rms_norm(x, lp["attn_norm"], spec.rms_eps)
+        q, k, v = _attn_qkv(spec, lp, h, positions)
+        attn = causal_attention(q, k, v, positions, jnp.asarray(T))
+        x = x + attn.reshape(T, -1) @ lp["wo"]
+        h = rms_norm(x, lp["mlp_norm"], spec.rms_eps)
+        x = x + _mlp(lp, h)
+    xn = rms_norm(x, params["final_norm"], spec.rms_eps)
+    head = params["embed"].T if spec.tie_embeddings else params["lm_head"]
+    return (xn @ head).astype(jnp.float32)

@@ -1,0 +1,7 @@
+"""TPU compute ops: pure-JAX references + Pallas kernels.
+
+Every op has a pure-JAX reference implementation (runs anywhere, used on the
+CPU test mesh and as the numerical ground truth) and, where it matters, a
+Pallas TPU kernel (ops/pallas/). Dispatch picks the kernel on TPU unless
+``DYN_DISABLE_PALLAS=1``.
+"""

@@ -1,0 +1,101 @@
+"""Attention ops over the paged KV cache (pure-JAX reference forms).
+
+The paged layout: per layer, K and V live in page arrays of shape
+``[num_pages, page_size, num_kv_heads, head_dim]``; a sequence's pages are
+listed in its row of ``block_tables [B, max_pages_per_seq]``. This is the
+TPU-first replacement for the reference's engine-internal (vLLM) paged
+attention + its block-copy CUDA kernel (lib/llm/src/kernels/block_copy.cu):
+XLA-friendly gathers/scatters here, a Pallas kernel (ops/pallas/) on the hot
+decode path.
+
+All functions are shape-static and jit-safe. GQA is handled by repeating KV
+heads up to the query head count.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = -1e30
+
+
+def repeat_kv(x: jax.Array, n_rep: int) -> jax.Array:
+    """[.., S, kv_heads, D] -> [.., S, kv_heads*n_rep, D] (GQA expansion)."""
+    if n_rep == 1:
+        return x
+    return jnp.repeat(x, n_rep, axis=-2)
+
+
+def gather_pages(
+    pages: jax.Array,  # [num_pages, page_size, kv_heads, head_dim]
+    block_table: jax.Array,  # [max_pages_per_seq] int32
+) -> jax.Array:
+    """Materialize one sequence's KV as [max_ctx, kv_heads, head_dim]."""
+    toks = pages[block_table]  # [P, page, H, D]
+    P, page, H, D = toks.shape
+    return toks.reshape(P * page, H, D)
+
+
+def causal_attention(
+    q: jax.Array,  # [T, heads, D]
+    k: jax.Array,  # [S, kv_heads, D]
+    v: jax.Array,  # [S, kv_heads, D]
+    q_positions: jax.Array,  # [T] absolute positions of the queries
+    kv_len: jax.Array,  # scalar: number of valid kv tokens
+) -> jax.Array:
+    """Causal attention of new queries over (cached + new) keys.
+
+    Key j is visible to query i iff j <= q_positions[i] and j < kv_len.
+    Returns [T, heads, D]. Softmax in f32 regardless of input dtype.
+    """
+    T, H, D = q.shape
+    S, KH, _ = k.shape
+    n_rep = H // KH
+    k = repeat_kv(k, n_rep)
+    v = repeat_kv(v, n_rep)
+    scale = 1.0 / jnp.sqrt(jnp.asarray(D, jnp.float32))
+    logits = jnp.einsum("thd,shd->hts", q.astype(jnp.float32), k.astype(jnp.float32))
+    logits = logits * scale
+    kv_pos = jnp.arange(S)[None, :]  # [1, S]
+    mask = (kv_pos <= q_positions[:, None]) & (kv_pos < kv_len)  # [T, S]
+    logits = jnp.where(mask[None, :, :], logits, NEG_INF)
+    probs = jax.nn.softmax(logits, axis=-1)
+    out = jnp.einsum("hts,shd->thd", probs, v.astype(jnp.float32))
+    return out.astype(q.dtype)
+
+
+def paged_decode_attention(
+    q: jax.Array,  # [B, heads, D] (one new token per sequence)
+    k_pages: jax.Array,  # [num_pages, page_size, kv_heads, D]
+    v_pages: jax.Array,  # [num_pages, page_size, kv_heads, D]
+    block_tables: jax.Array,  # [B, max_pages_per_seq]
+    seq_lens: jax.Array,  # [B] context length INCLUDING the new token
+) -> jax.Array:
+    """Decode-step attention: each query attends to its full paged context.
+
+    Pure-JAX reference: gathers [B, max_ctx, kv_heads, D] then masked
+    attention. The Pallas kernel (ops/pallas/paged_attention.py) computes
+    the same thing without materializing the gather.
+    """
+    B, H, D = q.shape
+    page_size = k_pages.shape[1]
+    P = block_tables.shape[1]
+    max_ctx = P * page_size
+
+    k = jax.vmap(lambda bt: gather_pages(k_pages, bt))(block_tables)
+    v = jax.vmap(lambda bt: gather_pages(v_pages, bt))(block_tables)
+    KH = k.shape[2]
+    n_rep = H // KH
+    k = repeat_kv(k, n_rep)  # [B, max_ctx, H, D]
+    v = repeat_kv(v, n_rep)
+
+    scale = 1.0 / jnp.sqrt(jnp.asarray(D, jnp.float32))
+    logits = jnp.einsum(
+        "bhd,bshd->bhs", q.astype(jnp.float32), k.astype(jnp.float32)
+    ) * scale
+    mask = jnp.arange(max_ctx)[None, :] < seq_lens[:, None]  # [B, max_ctx]
+    logits = jnp.where(mask[:, None, :], logits, NEG_INF)
+    probs = jax.nn.softmax(logits, axis=-1)
+    out = jnp.einsum("bhs,bshd->bhd", probs, v.astype(jnp.float32))
+    return out.astype(q.dtype)

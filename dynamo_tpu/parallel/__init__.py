@@ -1,0 +1,12 @@
+"""Parallelism: mesh construction, ring attention, KV transfer.
+
+Unlike the reference - where TP/PP/EP live inside third-party engines and
+Dynamo only orchestrates (SURVEY.md section 2.3) - parallelism here is
+first-class: the engine shards its own weights/caches over a
+jax.sharding.Mesh, and sequence/context parallelism (ring attention, absent
+from the reference entirely) is native.
+"""
+
+from dynamo_tpu.parallel.mesh import make_mesh
+
+__all__ = ["make_mesh"]

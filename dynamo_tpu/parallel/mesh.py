@@ -1,0 +1,35 @@
+"""Device mesh construction for the engine.
+
+Axes convention (used by all shardings in models/ and engine/):
+  dp - data parallel (engine-level replica within one worker)
+  tp - tensor parallel (attention heads / MLP columns)
+  ep - expert parallel (MoE experts; aliases tp devices unless distinct)
+  sp - sequence/context parallel (ring attention)
+
+On a TPU slice the default device order already follows the physical torus;
+we fold it into the requested logical shape. Multi-host: every host calls
+this with the same shape over jax.devices() (the global device list).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax
+from jax.sharding import Mesh
+
+
+def make_mesh(
+    tp: int = 1,
+    dp: int = 1,
+    sp: int = 1,
+    devices: list | None = None,
+) -> Mesh:
+    devices = devices if devices is not None else jax.devices()
+    need = tp * dp * sp
+    if need > len(devices):
+        raise ValueError(
+            f"mesh needs {need} devices (dp={dp} sp={sp} tp={tp}), "
+            f"have {len(devices)}"
+        )
+    arr = np.array(devices[:need]).reshape(dp, sp, tp)
+    return Mesh(arr, ("dp", "sp", "tp"))

@@ -1,0 +1,91 @@
+"""Full-stack test: OpenAI HTTP frontend -> KV router -> JAX engine worker."""
+
+import asyncio
+
+import aiohttp
+import pytest
+
+from dynamo_tpu.engine.config import EngineConfig, ModelSpec
+from dynamo_tpu.engine.worker import launch_engine_worker
+from dynamo_tpu.frontend.http import HttpFrontend
+from dynamo_tpu.frontend.watcher import ModelManager, ModelWatcher
+from dynamo_tpu.runtime.distributed import DistributedRuntime
+from dynamo_tpu.runtime.hub import InMemoryHub
+
+pytestmark = pytest.mark.integration
+
+TINY = ModelSpec(
+    name="tiny-test",
+    vocab_size=272,  # mock tokenizer range
+    hidden_size=32, intermediate_size=64, num_layers=2,
+    num_heads=4, num_kv_heads=2, head_dim=8, dtype="float32",
+)
+
+
+async def test_http_to_jax_engine_roundtrip():
+    drt = DistributedRuntime(InMemoryHub())
+    ecfg = EngineConfig(
+        page_size=4, num_pages=128, max_pages_per_seq=32,
+        max_decode_slots=4, prefill_buckets=(32, 64, 128),
+    )
+    engine, _served = await launch_engine_worker(
+        drt, model="tiny-test", spec=TINY, engine_config=ecfg,
+        model_name="tiny-test", router_mode="kv",
+    )
+    manager = ModelManager()
+    watcher = await ModelWatcher(drt, manager).start()
+    await watcher.wait_for_model("tiny-test", timeout=10)
+    frontend = HttpFrontend(manager, host="127.0.0.1", port=0)
+    await frontend.start()
+    base = f"http://127.0.0.1:{frontend.port}"
+    try:
+        async with aiohttp.ClientSession() as sess:
+            # aggregated: greedy determinism end-to-end
+            payload = {
+                "model": "tiny-test",
+                "messages": [{"role": "user", "content": "hi"}],
+                "max_tokens": 6,
+                "temperature": 0.0,
+                "ignore_eos": True,
+            }
+            async with sess.post(f"{base}/v1/chat/completions", json=payload) as r:
+                assert r.status == 200, await r.text()
+                body1 = await r.json()
+            assert body1["usage"]["completion_tokens"] == 6
+            async with sess.post(f"{base}/v1/chat/completions", json=payload) as r:
+                body2 = await r.json()
+            # greedy + same prompt -> identical content (and prefix cache hit)
+            assert (
+                body1["choices"][0]["message"]["content"]
+                == body2["choices"][0]["message"]["content"]
+            )
+
+            # streaming SSE
+            n = 0
+            async with sess.post(
+                f"{base}/v1/chat/completions",
+                json={**payload, "stream": True},
+            ) as r:
+                async for line in r.content:
+                    if line.startswith(b"data: ") and b"[DONE]" not in line:
+                        n += 1
+            assert n >= 6
+
+            # concurrent requests through the continuous batcher
+            async def one(i):
+                async with sess.post(
+                    f"{base}/v1/completions",
+                    json={"model": "tiny-test", "prompt": f"req {i}",
+                          "max_tokens": 4, "ignore_eos": True},
+                ) as r:
+                    return r.status
+
+            statuses = await asyncio.gather(*(one(i) for i in range(6)))
+            assert set(statuses) == {200}
+    finally:
+        await frontend.stop()
+        await watcher.close()
+        await engine.close()
+        await drt.close()
+    # no leaked pages
+    assert engine.allocator.active_pages == 0
