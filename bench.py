@@ -1,0 +1,122 @@
+#!/usr/bin/env python
+"""Decode-throughput benchmark. Prints ONE JSON line:
+
+  {"metric": "decode_tokens_per_sec_per_chip", "value": N, "unit": "tok/s",
+   "vs_baseline": R}
+
+Measures batched paged-decode steps (the serving hot loop) on the default
+JAX backend — a ~1B-param llama-family model on a real TPU chip, a tiny
+model when only CPU is available (local smoke). ``vs_baseline`` is the ratio
+against the newest recorded ``BENCH_r*.json`` at the repo root (the
+reference publishes no absolute tok/s — see BASELINE.md), 1.0 when none
+exists.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+import re
+import sys
+import time
+
+import numpy as np
+
+import jax
+
+if "--cpu" in sys.argv:
+    # the ambient axon TPU platform pins jax_platforms at interpreter start;
+    # only a post-import config update can force the CPU smoke path
+    jax.config.update("jax_platforms", "cpu")
+
+import jax.numpy as jnp
+
+from dynamo_tpu.engine.config import ModelSpec
+from dynamo_tpu.models import llama
+
+STEPS = 48
+WARMUP = 3
+
+
+def bench_spec(on_tpu: bool) -> tuple[ModelSpec, int, int, int]:
+    """(spec, batch, page_size, pages_per_seq)."""
+    if on_tpu:
+        spec = ModelSpec(
+            name="llama-1b-bench", vocab_size=32768, hidden_size=2048,
+            intermediate_size=8192, num_layers=16, num_heads=16,
+            num_kv_heads=8, head_dim=128, tie_embeddings=False,
+        )
+        return spec, 64, 16, 16
+    return ModelSpec.dryrun(), 8, 16, 8
+
+
+def prior_value() -> float | None:
+    best_round, value = -1, None
+    for path in glob.glob(os.path.join(os.path.dirname(__file__), "BENCH_r*.json")):
+        m = re.search(r"BENCH_r(\d+)\.json$", path)
+        if not m:
+            continue
+        try:
+            data = json.loads(open(path).read())
+            v = float(data.get("value"))
+        except (ValueError, TypeError, OSError, json.JSONDecodeError):
+            continue
+        if int(m.group(1)) > best_round and v > 0:
+            best_round, value = int(m.group(1)), v
+    return value
+
+
+def main() -> None:
+    backend = jax.default_backend()
+    spec, B, page_size, pages_per_seq = bench_spec(backend == "tpu")
+    num_pages = 1 + B * pages_per_seq
+
+    key = jax.random.PRNGKey(0)
+    params = llama.init_params(spec, key)
+    k_pages, v_pages = llama.init_cache(spec, num_pages, page_size)
+
+    bt = np.zeros((B, pages_per_seq), np.int32)
+    for i in range(B):
+        bt[i] = np.arange(1 + i * pages_per_seq, 1 + (i + 1) * pages_per_seq)
+    block_tables = jnp.asarray(bt)
+    active = jnp.ones((B,), bool)
+    # leave room for every decoded token (warmup + timed) inside the table
+    capacity = page_size * pages_per_seq
+    start_len = capacity - (WARMUP + STEPS) - 2
+    assert start_len > 0
+    tokens = jnp.zeros((B,), jnp.int32)
+
+    def run(n_steps: int, k_pages, v_pages):
+        toks = tokens
+        lens = jnp.full((B,), start_len + 1, jnp.int32)
+        for _ in range(n_steps):
+            logits, k_pages, v_pages = llama.decode_forward(
+                spec, params, toks, block_tables, lens, k_pages, v_pages, active
+            )
+            toks = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            lens = lens + 1
+        return toks, k_pages, v_pages
+
+    toks, k_pages, v_pages = run(WARMUP, k_pages, v_pages)  # compile
+    toks.block_until_ready()
+
+    t0 = time.perf_counter()
+    toks, k_pages, v_pages = run(STEPS, k_pages, v_pages)
+    toks.block_until_ready()
+    dt = time.perf_counter() - t0
+
+    n_chips = 1  # single-chip bench (driver runs on one real TPU chip)
+    value = B * STEPS / dt / n_chips
+    prior = prior_value()
+    out = {
+        "metric": "decode_tokens_per_sec_per_chip",
+        "value": round(value, 2),
+        "unit": "tok/s",
+        "vs_baseline": round(value / prior, 4) if prior else 1.0,
+    }
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

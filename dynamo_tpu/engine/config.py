@@ -50,6 +50,16 @@ class ModelSpec:
         return cls(vocab_size=vocab_size)
 
     @classmethod
+    def dryrun(cls) -> "ModelSpec":
+        """Tiny spec with kv_heads=8 so tp up to 8 divides the KV head axis
+        (shared by bench.py's CPU smoke and __graft_entry__)."""
+        return cls(
+            name="dryrun", vocab_size=512, hidden_size=256,
+            intermediate_size=512, num_layers=2, num_heads=8,
+            num_kv_heads=8, head_dim=32, tie_embeddings=True,
+        )
+
+    @classmethod
     def preset(cls, name: str) -> "ModelSpec":
         presets = {
             "tiny-test": cls.tiny,

@@ -161,8 +161,7 @@ def _logits(spec: ModelSpec, params: Params, x: jax.Array) -> jax.Array:
 # ---------------------------------------------------------------- prefill
 
 
-@partial(jax.jit, static_argnums=(0,), donate_argnums=(5, 6))
-def prefill_forward(
+def prefill_forward_impl(
     spec: ModelSpec,
     params: Params,
     tokens: jax.Array,  # [T_pad] int32 (padded)
@@ -209,11 +208,15 @@ def prefill_forward(
     return logits, k_pages, v_pages
 
 
+prefill_forward = jax.jit(
+    prefill_forward_impl, static_argnums=(0,), donate_argnums=(5, 6)
+)
+
+
 # ---------------------------------------------------------------- decode
 
 
-@partial(jax.jit, static_argnums=(0,), donate_argnums=(5, 6))
-def decode_forward(
+def decode_forward_impl(
     spec: ModelSpec,
     params: Params,
     tokens: jax.Array,  # [B] int32: last sampled token per slot
@@ -256,6 +259,11 @@ def decode_forward(
 
     logits = _logits(spec, params, x)  # [B, V]
     return logits, k_pages, v_pages
+
+
+decode_forward = jax.jit(
+    decode_forward_impl, static_argnums=(0,), donate_argnums=(5, 6)
+)
 
 
 # -------------------------------------------------------------- reference

@@ -1,0 +1,33 @@
+"""The driver-facing entry points must always compile and run."""
+
+import sys
+from pathlib import Path
+
+import jax
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
+
+import __graft_entry__ as graft  # noqa: E402
+
+
+def test_entry_compiles_single_chip():
+    fn, example_args = graft.entry()
+    jitted = jax.jit(fn)
+    logits, k, v = jitted(*example_args)
+    assert logits.shape[0] == example_args[1].shape[0]
+    logits.block_until_ready()
+
+
+def test_dryrun_multichip_8():
+    graft.dryrun_multichip(8)
+
+
+def test_factor():
+    assert graft._factor(8) == (2, 1, 4)
+    assert graft._factor(4) == (2, 1, 2)
+    assert graft._factor(2) == (1, 1, 2)
+    assert graft._factor(1) == (1, 1, 1)
+    for n in (1, 2, 4, 8, 16, 32):
+        dp, sp, tp = graft._factor(n)
+        assert dp * sp * tp == n
+        assert 8 % tp == 0  # tp must divide the dryrun spec's kv_heads
