@@ -414,6 +414,7 @@ class InferenceEngine:
             self.k_pages,
             self.v_pages,
             jnp.asarray(active),
+            mesh=self.mesh,
         )
         sampled = np.asarray(
             sample_tokens(

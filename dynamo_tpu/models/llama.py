@@ -23,7 +23,11 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from dynamo_tpu.engine.config import ModelSpec
-from dynamo_tpu.ops.attention import causal_attention, gather_pages, paged_decode_attention
+from dynamo_tpu.ops.attention import (
+    causal_attention,
+    gather_pages,
+    paged_decode_attention_auto,
+)
 
 TRASH_PAGE = 0  # reserved page index for padded-position scatters
 
@@ -225,6 +229,7 @@ def decode_forward_impl(
     k_pages: jax.Array,  # donated
     v_pages: jax.Array,
     active: jax.Array,  # [B] bool: slot has a live request
+    mesh: Mesh | None = None,  # static: routes attention through shard_map
 ) -> tuple[jax.Array, jax.Array, jax.Array]:
     """One decode step for the whole slot batch; returns (logits[B,V], k, v)."""
     B = tokens.shape[0]
@@ -249,8 +254,8 @@ def decode_forward_impl(
         k = rope(k, positions, spec.rope_theta)
         k_pages = k_pages.at[li, safe_page, offset].set(k)
         v_pages = v_pages.at[li, safe_page, offset].set(v)
-        attn = paged_decode_attention(
-            q, k_pages[li], v_pages[li], block_tables, seq_lens
+        attn = paged_decode_attention_auto(
+            q, k_pages[li], v_pages[li], block_tables, seq_lens, mesh=mesh
         )
         attn = attn.reshape(B, spec.num_heads * spec.head_dim)
         x = x + attn @ lp["wo"]
@@ -262,7 +267,8 @@ def decode_forward_impl(
 
 
 decode_forward = jax.jit(
-    decode_forward_impl, static_argnums=(0,), donate_argnums=(5, 6)
+    decode_forward_impl, static_argnums=(0,), static_argnames=("mesh",),
+    donate_argnums=(5, 6),
 )
 
 

@@ -14,10 +14,23 @@ heads up to the query head count.
 
 from __future__ import annotations
 
+import functools
+import os
+
 import jax
 import jax.numpy as jnp
 
 NEG_INF = -1e30
+
+
+def use_pallas() -> bool:
+    """Pallas decode kernel on TPU unless DYNAMO_PALLAS overrides (0/1)."""
+    env = (os.environ.get("DYNAMO_PALLAS") or "").strip().lower()
+    if env in ("1", "true", "on"):
+        return True
+    if env in ("0", "false", "off", "no"):
+        return False
+    return jax.default_backend() == "tpu"
 
 
 def repeat_kv(x: jax.Array, n_rep: int) -> jax.Array:
@@ -99,3 +112,51 @@ def paged_decode_attention(
     probs = jax.nn.softmax(logits, axis=-1)
     out = jnp.einsum("bhs,bshd->bhd", probs, v.astype(jnp.float32))
     return out.astype(q.dtype)
+
+
+def paged_decode_attention_auto(
+    q: jax.Array,
+    k_pages: jax.Array,
+    v_pages: jax.Array,
+    block_tables: jax.Array,
+    seq_lens: jax.Array,
+    mesh=None,
+) -> jax.Array:
+    """Dispatch: Pallas kernel on TPU, pure-JAX gather elsewhere.
+
+    With a mesh, the kernel runs under shard_map over the "tp" axis: query
+    heads and KV heads are both head-sharded, every GQA group is fully
+    local to its shard, so the kernel needs zero collectives (pallas_call
+    itself has no SPMD partitioning rule — without shard_map GSPMD would
+    all-gather the whole KV cache every step).
+
+    DYNAMO_PALLAS=1 off-TPU runs the kernel in interpret mode (slow; lets
+    the whole engine be driven through the kernel path on CPU).
+    """
+    if use_pallas():
+        from jax.sharding import PartitionSpec as P
+
+        from dynamo_tpu.ops.pallas.paged_attention import (
+            paged_decode_attention_pallas,
+        )
+
+        interpret = jax.default_backend() != "tpu"
+        kernel = functools.partial(
+            paged_decode_attention_pallas, interpret=interpret
+        )
+        if mesh is not None and mesh.shape.get("tp", 1) > 1:
+            kernel = jax.shard_map(
+                kernel,
+                mesh=mesh,
+                in_specs=(
+                    P(None, "tp", None),  # q: heads sharded
+                    P(None, None, "tp", None),  # k_pages: kv heads sharded
+                    P(None, None, "tp", None),
+                    P(None, None),  # block tables replicated
+                    P(None),  # seq lens replicated
+                ),
+                out_specs=P(None, "tp", None),
+                check_vma=False,
+            )
+        return kernel(q, k_pages, v_pages, block_tables, seq_lens)
+    return paged_decode_attention(q, k_pages, v_pages, block_tables, seq_lens)
