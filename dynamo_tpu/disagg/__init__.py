@@ -1,0 +1,26 @@
+"""Disaggregated prefill/decode serving.
+
+TPU-native re-design of the reference's disaggregation stack (SURVEY.md §3
+call stack C): a decode worker conditionally delegates prompt processing to
+a prefill worker pool; KV pages move prefill→decode through a direct
+transfer plane (the NIXL-RDMA equivalent — here a zero-copy in-process path
+plus a TCP host-staging path; on multi-slice TPU deployments the payload
+rides ICI/DCN via host-staged device_put).
+
+Modules:
+  transfer.py — KvTransferSource/pull client (ref: vLLM NIXL connector roundtrip)
+  policy.py   — conditional disagg policy (ref: lib/llm/src/disagg_router.rs)
+  handlers.py — Decode/Prefill worker handlers (ref: components/src/dynamo/vllm/handlers.py)
+"""
+
+from dynamo_tpu.disagg.handlers import DecodeWorkerHandler, PrefillWorkerHandler
+from dynamo_tpu.disagg.policy import DisaggPolicy
+from dynamo_tpu.disagg.transfer import KvTransferSource, pull_kv_blocks
+
+__all__ = [
+    "DecodeWorkerHandler",
+    "PrefillWorkerHandler",
+    "DisaggPolicy",
+    "KvTransferSource",
+    "pull_kv_blocks",
+]

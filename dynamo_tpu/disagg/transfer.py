@@ -1,0 +1,268 @@
+"""KV-block transfer plane: the NIXL-RDMA equivalent for TPU serving.
+
+The prefill worker exports finished prompt KV pages (host-staged numpy
+blocks, shape [L, n_pages, page_size, kv_heads, head_dim]); the decode
+worker pulls them by ``transfer_id`` and scatters them into its own page
+pool. Metadata (transfer_id + address) rides the request/response path —
+exactly the reference's ``kv_transfer_params`` roundtrip
+(components/src/dynamo/vllm/handlers.py:151-216); the payload moves over a
+direct worker↔worker connection, bypassing frontend and hub (reference:
+NIXL/UCX RDMA, block_manager/block/transfer/nixl.rs).
+
+Two paths:
+  - in-process (same interpreter): zero-copy handoff through a registry —
+    the common case for N-workers-per-host tests and single-host serving.
+  - TCP: length-prefixed raw bytes; on multi-host TPU pods this is the DCN
+    host-staging path (device→host on source, host→device on destination;
+    ICI stays free for the model's collectives).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+import socket
+import struct
+import threading
+import time
+import uuid
+from dataclasses import dataclass, field
+from typing import Callable
+
+import numpy as np
+
+log = logging.getLogger("dynamo.disagg.transfer")
+
+_LEN = struct.Struct(">Q")
+
+
+def _dtype_from_name(name: str):
+    import jax.numpy as jnp
+
+    try:
+        return np.dtype(name)
+    except TypeError:
+        return np.dtype(getattr(jnp, name))
+
+
+@dataclass
+class _Export:
+    k: np.ndarray  # [L, n_pages, page_size, kv_heads, head_dim]
+    v: np.ndarray
+    meta: dict
+    created: float = field(default_factory=time.monotonic)
+    on_done: Callable[[], None] | None = None
+
+
+# in-process registry: source_uid -> KvTransferSource (zero-copy fast path)
+_LOCAL_SOURCES: dict[str, "KvTransferSource"] = {}
+_LOCAL_LOCK = threading.Lock()
+
+
+class KvTransferSource:
+    """Export table + TCP server on the prefill side.
+
+    One per engine. ``export()`` registers host-staged KV blocks and returns
+    the ``kv_transfer_params`` dict the decode worker needs to pull them.
+    Unclaimed exports are garbage-collected after ``ttl_s``.
+    """
+
+    def __init__(self, *, host: str = "127.0.0.1", port: int = 0, ttl_s: float = 120.0):
+        self.host = host
+        self.port = port
+        self.ttl_s = ttl_s
+        self.uid = uuid.uuid4().hex
+        self._exports: dict[str, _Export] = {}
+        self._lock = threading.Lock()
+        self._server: asyncio.AbstractServer | None = None
+        self._gc_task: asyncio.Task | None = None
+
+    async def start(self) -> "KvTransferSource":
+        if self._server is None:
+            self._server = await asyncio.start_server(
+                self._handle, self.host, self.port
+            )
+            self.port = self._server.sockets[0].getsockname()[1]
+            self._gc_task = asyncio.get_running_loop().create_task(self._gc_loop())
+            with _LOCAL_LOCK:
+                _LOCAL_SOURCES[self.uid] = self
+        return self
+
+    async def close(self) -> None:
+        with _LOCAL_LOCK:
+            _LOCAL_SOURCES.pop(self.uid, None)
+        if self._gc_task is not None:
+            self._gc_task.cancel()
+        if self._server is not None:
+            self._server.close()
+            await self._server.wait_closed()
+            self._server = None
+        with self._lock:
+            pending = list(self._exports.values())
+            self._exports.clear()
+        for e in pending:
+            if e.on_done:
+                e.on_done()
+
+    # -- export (prefill side) --------------------------------------------
+
+    def export(
+        self,
+        k_blocks: np.ndarray,
+        v_blocks: np.ndarray,
+        *,
+        num_tokens: int,
+        page_size: int,
+        on_done: Callable[[], None] | None = None,
+    ) -> dict:
+        """Register staged blocks; returns kv_transfer_params for the puller."""
+        tid = uuid.uuid4().hex
+        with self._lock:
+            self._exports[tid] = _Export(
+                k=k_blocks,
+                v=v_blocks,
+                meta={"num_tokens": num_tokens, "page_size": page_size},
+                on_done=on_done,
+            )
+        return {
+            "transfer_id": tid,
+            "source_uid": self.uid,
+            "addr": f"{self.host}:{self.port}",
+            "num_tokens": num_tokens,
+            "page_size": page_size,
+        }
+
+    def _take(self, tid: str) -> _Export | None:
+        with self._lock:
+            return self._exports.pop(tid, None)
+
+    def release(self, tid: str) -> None:
+        e = self._take(tid)
+        if e is not None and e.on_done:
+            e.on_done()
+
+    # -- TCP server --------------------------------------------------------
+
+    async def _handle(
+        self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter
+    ) -> None:
+        try:
+            line = await reader.readline()
+            req = json.loads(line)
+            op, tid = req.get("op"), req.get("transfer_id", "")
+            if op == "release":
+                self.release(tid)
+                writer.write(b'{"ok": true}\n')
+                await writer.drain()
+                return
+            if op != "pull":
+                writer.write(b'{"ok": false, "error": "bad op"}\n')
+                await writer.drain()
+                return
+            e = self._take(tid)
+            if e is None:
+                writer.write(b'{"ok": false, "error": "unknown transfer_id"}\n')
+                await writer.drain()
+                return
+            kb, vb = e.k.tobytes(), e.v.tobytes()
+            header = {
+                "ok": True,
+                "dtype": e.k.dtype.name,
+                "k_shape": list(e.k.shape),
+                "v_shape": list(e.v.shape),
+                **e.meta,
+            }
+            writer.write(json.dumps(header).encode() + b"\n")
+            writer.write(_LEN.pack(len(kb)))
+            writer.write(kb)
+            writer.write(_LEN.pack(len(vb)))
+            writer.write(vb)
+            await writer.drain()
+            if e.on_done:
+                e.on_done()
+        except (ConnectionError, json.JSONDecodeError, asyncio.IncompleteReadError):
+            log.warning("kv transfer connection error", exc_info=True)
+        finally:
+            writer.close()
+            try:
+                await writer.wait_closed()
+            except ConnectionError:
+                pass
+
+    async def _gc_loop(self) -> None:
+        try:
+            while True:
+                await asyncio.sleep(self.ttl_s / 4)
+                cutoff = time.monotonic() - self.ttl_s
+                with self._lock:
+                    stale = [t for t, e in self._exports.items() if e.created < cutoff]
+                for t in stale:
+                    log.warning("kv transfer %s expired unclaimed", t)
+                    self.release(t)
+        except asyncio.CancelledError:
+            pass
+
+
+# -- pull client (decode side) ---------------------------------------------
+
+
+def pull_kv_blocks(params: dict, timeout: float = 30.0) -> tuple[np.ndarray, np.ndarray, dict]:
+    """Pull exported KV blocks. Blocking — call from a worker thread.
+
+    Returns (k_blocks, v_blocks, meta). In-process sources are zero-copy.
+    """
+    tid = params["transfer_id"]
+    src = _LOCAL_SOURCES.get(params.get("source_uid", ""))
+    if src is not None:
+        e = src._take(tid)
+        if e is None:
+            raise KeyError(f"unknown transfer_id {tid}")
+        if e.on_done:
+            e.on_done()
+        return e.k, e.v, e.meta
+
+    host, port = params["addr"].rsplit(":", 1)
+    with socket.create_connection((host, int(port)), timeout=timeout) as sock:
+        sock.settimeout(timeout)
+        f = sock.makefile("rwb")
+        f.write(json.dumps({"op": "pull", "transfer_id": tid}).encode() + b"\n")
+        f.flush()
+        header = json.loads(f.readline())
+        if not header.get("ok"):
+            raise KeyError(f"kv transfer pull failed: {header.get('error')}")
+        dtype = _dtype_from_name(header["dtype"])
+
+        def read_block(shape):
+            (n,) = _LEN.unpack(f.read(_LEN.size))
+            buf = f.read(n)
+            if len(buf) != n:
+                raise ConnectionError("short read in kv transfer")
+            return np.frombuffer(buf, dtype=dtype).reshape(shape)
+
+        k = read_block(header["k_shape"])
+        v = read_block(header["v_shape"])
+        meta = {k_: header[k_] for k_ in ("num_tokens", "page_size") if k_ in header}
+        return k, v, meta
+
+
+def release_kv_blocks(params: dict, timeout: float = 5.0) -> None:
+    """Tell the source an export won't be pulled (e.g. EOS on first token)."""
+    src = _LOCAL_SOURCES.get(params.get("source_uid", ""))
+    if src is not None:
+        src.release(params["transfer_id"])
+        return
+    try:
+        host, port = params["addr"].rsplit(":", 1)
+        with socket.create_connection((host, int(port)), timeout=timeout) as sock:
+            f = sock.makefile("rwb")
+            f.write(
+                json.dumps(
+                    {"op": "release", "transfer_id": params["transfer_id"]}
+                ).encode()
+                + b"\n"
+            )
+            f.flush()
+            f.readline()
+    except OSError:
+        log.warning("kv transfer release failed (source will GC)", exc_info=True)

@@ -76,8 +76,10 @@ class InferenceEngine:
         params=None,
         event_publisher=None,
         metrics_publisher=None,
+        transfer_source=None,
     ):
         self.spec = spec
+        self.transfer_source = transfer_source
         self.config = config or EngineConfig()
         self.mesh = mesh
         self.events = event_publisher
@@ -252,7 +254,11 @@ class InferenceEngine:
     def _prefill_safe(self, slot_idx: int, waiting: _Waiting) -> None:
         """Per-request error isolation: a bad request must not kill the loop."""
         try:
-            self._prefill(slot_idx, waiting)
+            disagg = waiting.request.get("disagg") or {}
+            if disagg.get("mode") == "decode" and disagg.get("kv_transfer"):
+                self._resume_from_remote(slot_idx, waiting)
+            else:
+                self._prefill(slot_idx, waiting)
         except Exception as e:  # noqa: BLE001
             log.exception("prefill failed for %s", waiting.context.id)
             self._post(
@@ -261,45 +267,125 @@ class InferenceEngine:
                  "error": f"prefill failed: {e}"},
             )
 
-    def _prefill(self, slot_idx: int, waiting: _Waiting) -> None:
-        cfg = self.config
-        req = waiting.request
-        token_ids = list(req["token_ids"])
-        sampling = req.get("sampling") or {}
+    def prefix_hit_tokens(self, token_ids: list[int]) -> int:
+        """How many leading prompt tokens are already in the local prefix
+        cache (policy probe for conditional disagg)."""
+        seq = TokenBlockSequence.from_tokens(token_ids, self.config.page_size)
+        return len(self.allocator.match_prefix(seq.sequence_hashes())) * self.config.page_size
+
+    # -- admission helpers (shared by local prefill and disagg resume) -----
+
+    @staticmethod
+    def _opt(d: dict, key: str, default):
+        v = d.get(key)
+        return default if v is None else v
+
+    def _decode_budget(self, req: dict, n_prompt: int) -> int:
         stop = req.get("stop_conditions") or {}
         max_tokens = stop.get("max_tokens")
         max_tokens = 16 if max_tokens is None else int(max_tokens)
-        max_tokens = max(min(max_tokens, cfg.max_context - len(token_ids) - 1), 1)
+        return max(min(max_tokens, self.config.max_context - n_prompt - 1), 1)
 
-        seq = TokenBlockSequence.from_tokens(token_ids, cfg.page_size)
-        hashes = seq.sequence_hashes()
+    def _acquire_prompt_pages(
+        self,
+        request_id: str,
+        hashes: list[int],
+        needed_pages: int,
+        *,
+        n_tokens: int,
+        full_prefix_ok: bool,
+    ) -> SeqPages:
+        """Prefix-cache take + allocation to cover the prompt. Raises
+        OutOfPages (with nothing held) if the pool is exhausted.
 
-        # prefix-cache hit: reuse cached pages, but always leave >=1 token to
-        # compute (we need last-position logits)
-        cached_pages = self.allocator.take_prefix(hashes)
-        while cached_pages and len(cached_pages) * cfg.page_size >= len(token_ids):
-            self.allocator.release([cached_pages.pop()])
-        start_pos = len(cached_pages) * cfg.page_size
-
-        sp = SeqPages(request_id=waiting.context.id)
-        sp.pages = list(cached_pages)
-        sp.hashes = [hashes[i] for i in range(len(cached_pages))]
-        sp.cached_prefix_pages = len(cached_pages)
-
-        # allocate pages to cover the whole prompt
-        needed_pages = (len(token_ids) + cfg.page_size - 1) // cfg.page_size
+        ``full_prefix_ok=False`` keeps >=1 token uncached (local prefill
+        needs last-position logits); the disagg resume path computes
+        nothing, so full coverage is fine there.
+        """
+        cached = self.allocator.take_prefix(hashes)
+        if not full_prefix_ok:
+            while cached and len(cached) * self.config.page_size >= n_tokens:
+                self.allocator.release([cached.pop()])
+        sp = SeqPages(request_id=request_id)
+        sp.pages = list(cached)
+        sp.hashes = [hashes[i] for i in range(len(cached))]
+        sp.cached_prefix_pages = len(cached)
         try:
             while sp.num_pages < needed_pages:
                 sp.pages.append(self.allocator.alloc_page())
                 sp.hashes.append(None)
         except OutOfPages:
             self.allocator.release(sp.pages)
+            raise
+        return sp
+
+    def _seal_prompt_blocks(self, sp: SeqPages, seq: TokenBlockSequence) -> None:
+        """Seal every complete prompt block into the prefix cache."""
+        for i in range(sp.cached_prefix_pages, len(seq.blocks)):
+            blk = seq.blocks[i]
+            self.allocator.seal_page(
+                sp.pages[i], blk.sequence_hash, blk.parent_sequence_hash
+            )
+            sp.hashes[i] = blk.sequence_hash
+
+    def _make_slot(
+        self,
+        waiting: _Waiting,
+        seq: TokenBlockSequence,
+        sp: SeqPages,
+        *,
+        seq_len: int,
+        remaining: int,
+        generated: int = 0,
+        last_token: int,
+    ) -> _Slot:
+        req = waiting.request
+        sampling = req.get("sampling") or {}
+        stop = req.get("stop_conditions") or {}
+        self._seed_counter += 1
+        return _Slot(
+            request_id=waiting.context.id,
+            context=waiting.context,
+            out_q=waiting.out_q,
+            seq=seq,
+            pages=sp,
+            seq_len=seq_len,
+            remaining=remaining,
+            temperature=float(self._opt(sampling, "temperature", 0.0)),
+            top_k=int(self._opt(sampling, "top_k", 0)),
+            top_p=float(self._opt(sampling, "top_p", 1.0)),
+            ignore_eos=bool(stop.get("ignore_eos", False)),
+            stop_token_ids=frozenset(stop.get("stop_token_ids") or ()),
+            eos_ids=frozenset(req.get("eos_token_ids") or (2,)),
+            min_tokens=int(self._opt(stop, "min_tokens", 0)),
+            generated=generated,
+            last_token=last_token,
+            sample_seed=int(self._opt(sampling, "seed", self._seed_counter))
+            & 0xFFFFFFFF,
+        )
+
+    def _prefill(self, slot_idx: int, waiting: _Waiting) -> None:
+        cfg = self.config
+        req = waiting.request
+        token_ids = list(req["token_ids"])
+        max_tokens = self._decode_budget(req, len(token_ids))
+
+        seq = TokenBlockSequence.from_tokens(token_ids, cfg.page_size)
+        hashes = seq.sequence_hashes()
+        needed_pages = (len(token_ids) + cfg.page_size - 1) // cfg.page_size
+        try:
+            sp = self._acquire_prompt_pages(
+                waiting.context.id, hashes, needed_pages,
+                n_tokens=len(token_ids), full_prefix_ok=False,
+            )
+        except OutOfPages:
             self._post(
                 waiting.out_q,
                 {"token_ids": [], "finish_reason": "error",
                  "error": "kv pages exhausted"},
             )
             return
+        start_pos = sp.cached_prefix_pages * cfg.page_size
 
         new_tokens = token_ids[start_pos:]
         bucket = cfg.bucket_for(len(new_tokens))
@@ -320,40 +406,108 @@ class InferenceEngine:
         )
 
         # seal prompt pages whose block is complete (skip already-cached)
-        for i in range(sp.cached_prefix_pages, len(seq.blocks)):
-            blk = seq.blocks[i]
-            self.allocator.seal_page(
-                sp.pages[i], blk.sequence_hash, blk.parent_sequence_hash
-            )
-            sp.hashes[i] = blk.sequence_hash
-
-        def opt(d, key, default):
-            v = d.get(key)
-            return default if v is None else v
-
-        self._seed_counter += 1
-        slot = _Slot(
-            request_id=waiting.context.id,
-            context=waiting.context,
-            out_q=waiting.out_q,
-            seq=seq,
-            pages=sp,
-            seq_len=len(token_ids),
-            remaining=max_tokens,
-            temperature=float(opt(sampling, "temperature", 0.0)),
-            top_k=int(opt(sampling, "top_k", 0)),
-            top_p=float(opt(sampling, "top_p", 1.0)),
-            ignore_eos=bool(stop.get("ignore_eos", False)),
-            stop_token_ids=frozenset(stop.get("stop_token_ids") or ()),
-            eos_ids=frozenset(req.get("eos_token_ids") or (2,)),
-            min_tokens=int(opt(stop, "min_tokens", 0)),
+        self._seal_prompt_blocks(sp, seq)
+        slot = self._make_slot(
+            waiting, seq, sp,
+            seq_len=len(token_ids), remaining=max_tokens,
             last_token=token_ids[-1],
-            sample_seed=int(opt(sampling, "seed", self._seed_counter)) & 0xFFFFFFFF,
         )
 
         # sample the first token from prefill logits
         tok = self._sample_single(logits, slot)
+        disagg = req.get("disagg") or {}
+        if (
+            (disagg.get("kv_transfer") or {}).get("do_remote_decode")
+            and self.transfer_source is not None
+        ):
+            # disagg prefill: stage KV to host, hand off, free device pages
+            self._export_and_finish(slot, sp, token_ids, tok)
+            return
         self._emit_token(slot_idx, slot, tok)
+
+    def _export_and_finish(
+        self, slot: _Slot, sp: SeqPages, token_ids: list[int], tok: int
+    ) -> None:
+        """Prefill-worker handoff: export prompt KV pages for remote decode."""
+        page_ids = jnp.asarray(np.asarray(sp.pages, np.int32))
+        kb, vb = llama.extract_kv_pages(self.k_pages, self.v_pages, page_ids)
+        params = self.transfer_source.export(
+            np.asarray(kb),
+            np.asarray(vb),
+            num_tokens=len(token_ids),
+            page_size=self.config.page_size,
+        )
+        self.allocator.release(sp.pages)
+        self._post(
+            slot.out_q,
+            {"token_ids": [tok], "finish_reason": "length",
+             "kv_transfer_params": params},
+        )
+        self._publish_metrics()
+
+    def _resume_from_remote(self, slot_idx: int, waiting: _Waiting) -> None:
+        """Decode-worker resume: pull prefilled KV, install, enter decode."""
+        from dynamo_tpu.disagg.transfer import pull_kv_blocks, release_kv_blocks
+
+        cfg = self.config
+        req = waiting.request
+        kvp = dict((req.get("disagg") or {}).get("kv_transfer") or {})
+        first_token = int(kvp.pop("first_token"))
+        token_ids = list(req["token_ids"])
+        max_tokens = self._decode_budget(req, len(token_ids))
+        if max_tokens <= 1:
+            # the remote-prefill token (already emitted by the handler) was
+            # the whole budget; don't pull KV we'd never use
+            release_kv_blocks(kvp)
+            self._post(waiting.out_q, {"token_ids": [], "finish_reason": "length"})
+            return
+
+        k_blocks, v_blocks, meta = pull_kv_blocks(kvp)  # blocking (thread)
+        if int(meta.get("page_size", cfg.page_size)) != cfg.page_size:
+            raise ValueError("page_size mismatch between prefill and decode")
+
+        seq = TokenBlockSequence.from_tokens(token_ids, cfg.page_size)
+        hashes = seq.sequence_hashes()
+        needed_pages = (len(token_ids) + cfg.page_size - 1) // cfg.page_size
+        try:
+            sp = self._acquire_prompt_pages(
+                waiting.context.id, hashes, needed_pages,
+                n_tokens=len(token_ids), full_prefix_ok=True,
+            )
+        except OutOfPages:
+            self._post(
+                waiting.out_q,
+                {"token_ids": [], "finish_reason": "error",
+                 "error": "kv pages exhausted"},
+            )
+            return
+
+        try:
+            install = list(range(sp.cached_prefix_pages, needed_pages))
+            if install:
+                page_ids = jnp.asarray(
+                    np.asarray([sp.pages[i] for i in install], np.int32)
+                )
+                self.k_pages, self.v_pages = llama.insert_kv_pages(
+                    self.k_pages, self.v_pages, page_ids,
+                    jnp.asarray(k_blocks[:, install]),
+                    jnp.asarray(v_blocks[:, install]),
+                )
+            self._seal_prompt_blocks(sp, seq)
+        except Exception:
+            self.allocator.release(sp.pages)
+            raise
+
+        slot = self._make_slot(
+            waiting, seq, sp,
+            seq_len=len(token_ids),
+            remaining=max_tokens - 1,
+            generated=1,  # the remote-prefill token (emitted by the handler)
+            last_token=first_token,
+        )
+        slot.seq.append(first_token)
+        self._slots[slot_idx] = slot
+        self._publish_metrics()
 
     # -- decode (runs in thread) -------------------------------------------
 

@@ -3,8 +3,15 @@
 The TPU-native counterpart of the reference's engine workers
 (components/src/dynamo/vllm/main.py:69 ``worker``): build the engine (model
 + mesh + paged cache), register the model card, serve ``generate``, publish
-KV events + metrics. Disagg prefill/decode roles arrive with the disagg
-module (--mode prefill|decode|aggregated).
+KV events + metrics. ``--mode prefill|decode|aggregated`` selects the
+disaggregation role (ref: init/init_prefill, vllm/main.py:175-280):
+
+  aggregated — one engine does prefill + decode (default)
+  prefill    — serves 1-token prefills, exports KV via the transfer plane;
+               registers on the prefill component (no model card: the
+               frontend only discovers decode workers)
+  decode     — fronted by DecodeWorkerHandler; conditionally delegates long
+               prompts to the prefill pool and resumes from transferred KV
 """
 
 from __future__ import annotations
@@ -24,6 +31,8 @@ from dynamo_tpu.runtime.logging_util import setup_logging
 
 log = logging.getLogger("dynamo.engine.worker")
 
+PREFILL_COMPONENT = "prefill"
+
 
 async def launch_engine_worker(
     drt: DistributedRuntime,
@@ -37,8 +46,17 @@ async def launch_engine_worker(
     engine_config: EngineConfig | None = None,
     spec: ModelSpec | None = None,
     router_mode: str = "kv",
+    mode: str = "aggregated",
+    prefill_component: str = PREFILL_COMPONENT,
+    prefill_router_mode: str = "kv",
+    max_local_prefill_length: int = 128,
+    always_remote_prefill: bool = False,
 ) -> tuple[InferenceEngine, object]:
-    """Build + register one engine worker in this process."""
+    """Build + register one engine worker in this process.
+
+    The serving front door (engine or disagg handler) is attached as
+    ``engine.frontdoor``.
+    """
     spec = spec or ModelSpec.preset(model)
     cfg = engine_config or EngineConfig()
     mesh = None
@@ -47,29 +65,98 @@ async def launch_engine_worker(
 
         mesh = make_mesh(tp=cfg.tp, dp=cfg.dp)
 
-    engine = InferenceEngine(spec, cfg, mesh=mesh)
-    ep = drt.namespace(namespace).component(component).endpoint(endpoint)
-    served, card = await register_llm(
-        drt, ep, engine.generate,
-        model_name=model_name or spec.name,
-        tokenizer=tokenizer,
-        context_length=cfg.max_context,
-        kv_block_size=cfg.page_size,
-        router_mode=router_mode,
-        runtime_config={"engine": "jax", "tp": cfg.tp},
-        metadata={"engine": "jax"},
+    transfer_source = None
+    if mode == "prefill":
+        from dynamo_tpu.disagg.transfer import KvTransferSource
+
+        transfer_source = await KvTransferSource().start()
+
+    engine = InferenceEngine(
+        spec, cfg, mesh=mesh, transfer_source=transfer_source
     )
+
+    if mode == "prefill":
+        from dynamo_tpu.disagg.handlers import PrefillWorkerHandler
+
+        handler = PrefillWorkerHandler(engine)
+        ep = drt.namespace(namespace).component(prefill_component).endpoint(endpoint)
+        served = await ep.serve(
+            handler.generate,
+            metadata={"model": model_name or spec.name, "role": "prefill"},
+        )
+        comp_path = f"{namespace}/{prefill_component}"
+    else:
+        if mode == "decode":
+            from dynamo_tpu.disagg.handlers import DecodeWorkerHandler
+            from dynamo_tpu.disagg.policy import DisaggPolicy
+
+            prefill_router = await _build_prefill_router(
+                drt, namespace, prefill_component, endpoint,
+                prefill_router_mode, cfg.page_size,
+            )
+            policy = DisaggPolicy(
+                max_local_prefill_length=max_local_prefill_length,
+                always_remote=always_remote_prefill,
+            )
+            await policy.watch(drt.hub, namespace)
+            handler = DecodeWorkerHandler(
+                engine, prefill_router=prefill_router, policy=policy
+            )
+        else:
+            handler = engine
+        ep = drt.namespace(namespace).component(component).endpoint(endpoint)
+        served, _card = await register_llm(
+            drt, ep, handler.generate,
+            model_name=model_name or spec.name,
+            tokenizer=tokenizer,
+            context_length=cfg.max_context,
+            kv_block_size=cfg.page_size,
+            router_mode=router_mode,
+            runtime_config={"engine": "jax", "tp": cfg.tp, "mode": mode},
+            metadata={"engine": "jax", "role": mode},
+        )
+        comp_path = f"{namespace}/{component}"
+
+    engine.frontdoor = handler
     wid = served.instance.instance_id
-    comp_path = f"{namespace}/{component}"
     engine.events = KvEventPublisher(drt.hub, comp_path, wid).start()
     engine.metrics = WorkerMetricsPublisher(drt.hub, comp_path, wid).start()
     await engine.start()
     engine._publish_metrics()
     log.info(
-        "engine worker %x up: model=%s pages=%d slots=%d tp=%d",
-        wid, spec.name, cfg.num_pages, cfg.max_decode_slots, cfg.tp,
+        "engine worker %x up: mode=%s model=%s pages=%d slots=%d tp=%d",
+        wid, mode, spec.name, cfg.num_pages, cfg.max_decode_slots, cfg.tp,
     )
     return engine, served
+
+
+async def _build_prefill_router(
+    drt: DistributedRuntime,
+    namespace: str,
+    prefill_component: str,
+    endpoint: str,
+    router_mode: str,
+    page_size: int,
+):
+    """Router over the prefill pool: KV-aware by default (a long prompt with
+    a warm prefix should land on the prefill worker that has it cached)."""
+    from dynamo_tpu.runtime.push import PushRouter, RouterMode
+
+    ep = drt.namespace(namespace).component(prefill_component).endpoint(endpoint)
+    if router_mode == "kv":
+        from dynamo_tpu.kv_router.protocols import RouterConfig
+        from dynamo_tpu.kv_router.router import KvPushRouter, KvRouter
+
+        push = await PushRouter.from_endpoint(ep, RouterMode.DIRECT)
+        # block_size must match the engines' KV-event page granularity or
+        # radix overlap silently never matches
+        kv = await KvRouter(
+            drt.hub, f"{namespace}/{prefill_component}",
+            RouterConfig(block_size=page_size),
+        ).start()
+        return KvPushRouter(push, kv)
+    mode = RouterMode.RANDOM if router_mode == "random" else RouterMode.ROUND_ROBIN
+    return await PushRouter.from_endpoint(ep, mode)
 
 
 async def _amain(args: argparse.Namespace) -> None:
@@ -94,6 +181,11 @@ async def _amain(args: argparse.Namespace) -> None:
         tokenizer=args.tokenizer,
         engine_config=ecfg,
         router_mode=args.router_mode,
+        mode=args.mode,
+        prefill_component=args.prefill_component,
+        prefill_router_mode=args.prefill_router_mode,
+        max_local_prefill_length=args.max_local_prefill_length,
+        always_remote_prefill=args.always_remote_prefill,
     )
     print("ENGINE_READY", flush=True)
     await drt.runtime.wait_for_shutdown()
@@ -115,6 +207,13 @@ def main() -> None:
     p.add_argument("--tp", type=int, default=1)
     p.add_argument("--router-mode", default="kv",
                    choices=["kv", "round_robin", "random"])
+    p.add_argument("--mode", default="aggregated",
+                   choices=["aggregated", "prefill", "decode"])
+    p.add_argument("--prefill-component", default=PREFILL_COMPONENT)
+    p.add_argument("--prefill-router-mode", default="kv",
+                   choices=["kv", "round_robin", "random"])
+    p.add_argument("--max-local-prefill-length", type=int, default=128)
+    p.add_argument("--always-remote-prefill", action="store_true")
     args = p.parse_args()
     setup_logging()
     try:

@@ -272,6 +272,28 @@ decode_forward = jax.jit(
 )
 
 
+# ------------------------------------------------------- kv page movement
+
+
+def _extract_kv_pages_impl(k_pages, v_pages, page_ids):
+    """Gather whole pages for transfer: -> [L, n, page, kvh, D] x2."""
+    return k_pages[:, page_ids], v_pages[:, page_ids]
+
+
+extract_kv_pages = jax.jit(_extract_kv_pages_impl)
+
+
+def _insert_kv_pages_impl(k_pages, v_pages, page_ids, k_blocks, v_blocks):
+    """Scatter transferred pages into the local pools (donated)."""
+    return (
+        k_pages.at[:, page_ids].set(k_blocks),
+        v_pages.at[:, page_ids].set(v_blocks),
+    )
+
+
+insert_kv_pages = jax.jit(_insert_kv_pages_impl, donate_argnums=(0, 1))
+
+
 # -------------------------------------------------------------- reference
 
 

@@ -1,0 +1,246 @@
+"""Disaggregated prefill/decode: transfer plane, policy, e2e vs aggregated.
+
+Port of the reference's disagg behaviors (SURVEY.md §3 call stack C) onto
+the JAX engine: the decode worker delegates long prompts to a prefill pool,
+pulls the KV pages, and must produce *exactly* the tokens the aggregated
+path produces (greedy, same seed/params).
+"""
+
+import asyncio
+
+import numpy as np
+import pytest
+
+from dynamo_tpu.disagg.policy import DisaggPolicy
+from dynamo_tpu.disagg.transfer import (
+    _LOCAL_SOURCES,
+    KvTransferSource,
+    pull_kv_blocks,
+    release_kv_blocks,
+)
+from dynamo_tpu.engine.config import EngineConfig, ModelSpec
+from dynamo_tpu.engine.worker import launch_engine_worker
+from dynamo_tpu.runtime.context import Context
+from dynamo_tpu.runtime.distributed import DistributedRuntime
+from dynamo_tpu.runtime.hub import InMemoryHub
+
+pytestmark = pytest.mark.integration
+
+SPEC = ModelSpec(
+    name="tiny-test",
+    vocab_size=272,
+    hidden_size=32, intermediate_size=64, num_layers=2,
+    num_heads=4, num_kv_heads=2, head_dim=8, dtype="float32",
+)
+
+
+def engine_config(**kw):
+    defaults = dict(
+        page_size=4, num_pages=128, max_pages_per_seq=32,
+        max_decode_slots=4, prefill_buckets=(32, 64, 128),
+    )
+    defaults.update(kw)
+    return EngineConfig(**defaults)
+
+
+def request(token_ids, max_tokens=8, **kw):
+    return {
+        "token_ids": list(token_ids),
+        "sampling": {"temperature": 0.0},
+        "stop_conditions": {"max_tokens": max_tokens, "ignore_eos": True},
+        "eos_token_ids": [2],
+        **kw,
+    }
+
+
+async def collect(agen):
+    toks, items = [], []
+    async for item in agen:
+        items.append(item)
+        toks.extend(item.get("token_ids") or [])
+    return toks, items
+
+
+# ------------------------------------------------------------- transfer plane
+
+
+async def test_transfer_roundtrip_tcp_and_local():
+    src = await KvTransferSource().start()
+    k = np.arange(2 * 3 * 4 * 2 * 8, dtype=np.float32).reshape(2, 3, 4, 2, 8)
+    v = k + 1000.0
+    try:
+        # in-process zero-copy path
+        params = src.export(k, v, num_tokens=11, page_size=4)
+        k2, v2, meta = pull_kv_blocks(params)
+        assert meta["num_tokens"] == 11
+        np.testing.assert_array_equal(k, k2)
+        np.testing.assert_array_equal(v, v2)
+        # pulled exports are one-shot
+        with pytest.raises(KeyError):
+            pull_kv_blocks(params)
+
+        # TCP path: hide the local registry entry to force the socket route
+        params = src.export(k, v, num_tokens=11, page_size=4)
+        hidden = _LOCAL_SOURCES.pop(src.uid)
+        try:
+            # blocking client must run off the event-loop thread (as the
+            # engine does): the source's asyncio server shares this loop
+            k3, v3, meta = await asyncio.to_thread(pull_kv_blocks, params)
+        finally:
+            _LOCAL_SOURCES[src.uid] = hidden
+        np.testing.assert_array_equal(k, k3)
+        np.testing.assert_array_equal(v, v3)
+
+        # release drops the export without pulling
+        released = []
+        params = src.export(k, v, num_tokens=11, page_size=4,
+                            on_done=lambda: released.append(1))
+        release_kv_blocks(params)
+        assert released == [1]
+        with pytest.raises(KeyError):
+            pull_kv_blocks(params)
+    finally:
+        await src.close()
+
+
+# -------------------------------------------------------------------- policy
+
+
+async def test_disagg_policy_live_update():
+    hub = InMemoryHub()
+    policy = DisaggPolicy(max_local_prefill_length=10)
+    assert not policy.prefill_remote(10)
+    assert policy.prefill_remote(11)
+    # prefix hits shrink the effective prefill
+    assert not policy.prefill_remote(14, prefix_hit_len=4)
+
+    await policy.watch(hub, "dynamo")
+    await hub.put("v1/config/disagg/dynamo", {"max_local_prefill_length": 2})
+    await asyncio.sleep(0.05)
+    assert policy.prefill_remote(3)
+    policy.close()
+    await hub.close()
+
+
+# ------------------------------------------------------------------ e2e parity
+
+
+async def test_disagg_matches_aggregated_greedy():
+    """prefill worker + decode worker == aggregated worker, token for token."""
+    prompt = list(range(40, 40 + 23))  # 23 tokens -> crosses page boundaries
+
+    # aggregated ground truth
+    drt_a = DistributedRuntime(InMemoryHub())
+    agg, _ = await launch_engine_worker(
+        drt_a, spec=SPEC, engine_config=engine_config(), model_name="agg",
+    )
+    want, _ = await collect(agg.generate(request(prompt), Context()))
+    await agg.close()
+    await drt_a.close()
+    assert len(want) == 8
+
+    # disagg pair on a fresh hub
+    drt = DistributedRuntime(InMemoryHub())
+    pre, _ = await launch_engine_worker(
+        drt, spec=SPEC, engine_config=engine_config(), model_name="tiny-test",
+        mode="prefill",
+    )
+    dec, _ = await launch_engine_worker(
+        drt, spec=SPEC, engine_config=engine_config(), model_name="tiny-test",
+        mode="decode", always_remote_prefill=True,
+    )
+    handler = dec.frontdoor
+    await handler.wait_for_prefill_pool()
+    assert handler.can_prefill()
+    try:
+        got, items = await collect(handler.generate(request(prompt), Context()))
+        assert got == want
+        # the prompt really was prefilled remotely: the prefill engine sealed
+        # the prompt's pages into its prefix cache, the decode engine ran
+        # decode steps but never a full prefill forward
+        assert pre.allocator.evictable_pages >= len(prompt) // 4
+        assert dec.steps >= len(want) - 1
+
+        # second request, same prompt: decode-side prefix cache now holds the
+        # prompt (sealed during resume), so policy keeps it local
+        hit = dec.prefix_hit_tokens(prompt)
+        assert hit >= (len(prompt) // 4) * 4 - 4
+        got2, _ = await collect(handler.generate(request(prompt), Context()))
+        assert got2 == want
+    finally:
+        await pre.close()
+        await dec.close()
+        await drt.close()
+    assert pre.allocator.active_pages == 0
+    assert dec.allocator.active_pages == 0
+
+
+async def test_disagg_fallback_without_prefill_pool():
+    """No live prefill workers -> decode worker serves locally."""
+    drt = DistributedRuntime(InMemoryHub())
+    dec, _ = await launch_engine_worker(
+        drt, spec=SPEC, engine_config=engine_config(), model_name="tiny-test",
+        mode="decode", always_remote_prefill=True,
+    )
+    try:
+        assert not dec.frontdoor.can_prefill()
+        got, _ = await collect(
+            dec.frontdoor.generate(request(list(range(50, 70))), Context())
+        )
+        assert len(got) == 8
+    finally:
+        await dec.close()
+        await drt.close()
+
+
+async def test_disagg_short_prompt_stays_local():
+    drt = DistributedRuntime(InMemoryHub())
+    pre, _ = await launch_engine_worker(
+        drt, spec=SPEC, engine_config=engine_config(), model_name="tiny-test",
+        mode="prefill",
+    )
+    dec, _ = await launch_engine_worker(
+        drt, spec=SPEC, engine_config=engine_config(), model_name="tiny-test",
+        mode="decode", max_local_prefill_length=64,
+    )
+    try:
+        await dec.frontdoor.wait_for_prefill_pool()
+        got, _ = await collect(
+            dec.frontdoor.generate(request(list(range(40, 52))), Context())
+        )
+        assert len(got) == 8
+        # prefill pool untouched: its engine never ran a step
+        assert pre.steps == 0 and pre.allocator.used_pages == 0
+    finally:
+        await pre.close()
+        await dec.close()
+        await drt.close()
+
+
+async def test_disagg_max_tokens_one():
+    """A 1-token request through disagg finishes after the remote token."""
+    drt = DistributedRuntime(InMemoryHub())
+    pre, _ = await launch_engine_worker(
+        drt, spec=SPEC, engine_config=engine_config(), model_name="tiny-test",
+        mode="prefill",
+    )
+    dec, _ = await launch_engine_worker(
+        drt, spec=SPEC, engine_config=engine_config(), model_name="tiny-test",
+        mode="decode", always_remote_prefill=True,
+    )
+    try:
+        await dec.frontdoor.wait_for_prefill_pool()
+        got, items = await collect(
+            dec.frontdoor.generate(
+                request(list(range(40, 60)), max_tokens=1), Context()
+            )
+        )
+        assert len(got) == 1
+        assert items[-1]["finish_reason"] == "length"
+        # nothing left pending on the transfer source
+        await asyncio.sleep(0.05)
+        assert not pre.transfer_source._exports
+    finally:
+        await pre.close()
+        await dec.close()
+        await drt.close()
