@@ -77,9 +77,18 @@ class InferenceEngine:
         event_publisher=None,
         metrics_publisher=None,
         transfer_source=None,
+        kvbm=None,
     ):
         self.spec = spec
         self.transfer_source = transfer_source
+        self.kvbm = kvbm
+        self.offload = None
+        if kvbm is not None:
+            from dynamo_tpu.kvbm.offload import OffloadEngine
+
+            self.offload = OffloadEngine(kvbm).start()
+        # (sequence_hash, page, block_index) sealed this step, pending offload
+        self._pending_offload: list[tuple[int, int, int]] = []
         self.config = config or EngineConfig()
         self.mesh = mesh
         self.events = event_publisher
@@ -167,6 +176,10 @@ class InferenceEngine:
         self._wake.set()
         if self._loop_task is not None:
             self._loop_task.cancel()
+        if self.offload is not None:
+            # blocking join (may wait on an in-flight DMA) — keep it off
+            # the event loop
+            await asyncio.to_thread(self.offload.close)
 
     async def generate(
         self, request: dict[str, Any], context: Context
@@ -215,6 +228,8 @@ class InferenceEngine:
                 # fail every in-flight request, then KEEP SERVING: one bad
                 # step must not brick the worker
                 log.exception("engine step failed; failing in-flight requests")
+                # queued offloads may reference pages about to be released
+                self._pending_offload.clear()
                 for i, slot in enumerate(self._slots):
                     if slot is not None:
                         self._finish(i, slot, "error", error="engine step failure")
@@ -268,10 +283,16 @@ class InferenceEngine:
             )
 
     def prefix_hit_tokens(self, token_ids: list[int]) -> int:
-        """How many leading prompt tokens are already in the local prefix
-        cache (policy probe for conditional disagg)."""
+        """How many leading prompt tokens are locally cached — G1 device
+        pages plus KVBM host/disk tiers the admission path can onboard from
+        (policy probe for conditional disagg)."""
         seq = TokenBlockSequence.from_tokens(token_ids, self.config.page_size)
-        return len(self.allocator.match_prefix(seq.sequence_hashes())) * self.config.page_size
+        hashes = seq.sequence_hashes()
+        n = len(self.allocator.match_prefix(hashes))
+        if self.kvbm is not None:
+            while n < len(hashes) and hashes[n] in self.kvbm:
+                n += 1
+        return n * self.config.page_size
 
     # -- admission helpers (shared by local prefill and disagg resume) -----
 
@@ -289,23 +310,40 @@ class InferenceEngine:
     def _acquire_prompt_pages(
         self,
         request_id: str,
-        hashes: list[int],
+        seq: TokenBlockSequence,
         needed_pages: int,
         *,
         n_tokens: int,
         full_prefix_ok: bool,
     ) -> SeqPages:
-        """Prefix-cache take + allocation to cover the prompt. Raises
-        OutOfPages (with nothing held) if the pool is exhausted.
+        """Prefix-cache take (G1, then KVBM onboard from host/disk tiers) +
+        allocation to cover the prompt. Raises OutOfPages (with nothing
+        held) if the pool is exhausted.
 
         ``full_prefix_ok=False`` keeps >=1 token uncached (local prefill
         needs last-position logits); the disagg resume path computes
         nothing, so full coverage is fine there.
         """
+        hashes = seq.sequence_hashes()
+        page_size = self.config.page_size
         cached = self.allocator.take_prefix(hashes)
         if not full_prefix_ok:
-            while cached and len(cached) * self.config.page_size >= n_tokens:
+            while cached and len(cached) * page_size >= n_tokens:
                 self.allocator.release([cached.pop()])
+
+        # KVBM onboard: consecutive blocks beyond the G1 hit that live in
+        # host/disk tiers get pulled back into fresh device pages
+        onboard: list[tuple[Any, Any]] = []
+        if self.kvbm is not None:
+            limit = needed_pages if full_prefix_ok else (n_tokens - 1) // page_size
+            i = len(cached)
+            while i < min(limit, len(hashes)):
+                blk = self.kvbm.get(hashes[i])
+                if blk is None:
+                    break
+                onboard.append(blk)
+                i += 1
+
         sp = SeqPages(request_id=request_id)
         sp.pages = list(cached)
         sp.hashes = [hashes[i] for i in range(len(cached))]
@@ -317,16 +355,81 @@ class InferenceEngine:
         except OutOfPages:
             self.allocator.release(sp.pages)
             raise
+
+        if onboard:
+            idxs = range(len(cached), len(cached) + len(onboard))
+            try:
+                page_ids = jnp.asarray(
+                    np.asarray([sp.pages[i] for i in idxs], np.int32)
+                )
+                self.k_pages, self.v_pages = llama.insert_kv_pages(
+                    self.k_pages, self.v_pages, page_ids,
+                    jnp.asarray(np.stack([b[0] for b in onboard], axis=1)),
+                    jnp.asarray(np.stack([b[1] for b in onboard], axis=1)),
+                )
+            except Exception:
+                self.allocator.release(sp.pages)
+                raise
+            # onboarded content came FROM kvbm: seal without re-offloading
+            self._seal_prompt_blocks(
+                sp, seq, start=len(cached), end=len(cached) + len(onboard),
+                offload=False,
+            )
+            sp.cached_prefix_pages = len(cached) + len(onboard)
         return sp
 
-    def _seal_prompt_blocks(self, sp: SeqPages, seq: TokenBlockSequence) -> None:
-        """Seal every complete prompt block into the prefix cache."""
-        for i in range(sp.cached_prefix_pages, len(seq.blocks)):
+    def _seal_prompt_blocks(
+        self,
+        sp: SeqPages,
+        seq: TokenBlockSequence,
+        start: int | None = None,
+        end: int | None = None,
+        *,
+        offload: bool = True,
+    ) -> None:
+        """Seal complete prompt blocks [start, end) into the prefix cache."""
+        start = sp.cached_prefix_pages if start is None else start
+        end = len(seq.blocks) if end is None else end
+        for i in range(start, end):
             blk = seq.blocks[i]
             self.allocator.seal_page(
                 sp.pages[i], blk.sequence_hash, blk.parent_sequence_hash
             )
             sp.hashes[i] = blk.sequence_hash
+            if offload:
+                self._queue_offload(blk.sequence_hash, sp.pages[i], i)
+
+    # -- KVBM offload (device -> host tiers) -------------------------------
+
+    def _queue_offload(self, sh: int, page: int, block_index: int) -> None:
+        if self.kvbm is not None and self.kvbm.should_offload(block_index):
+            self._pending_offload.append((sh, page, block_index))
+
+    def _drain_offload(self) -> None:
+        """One batched device gather for all pages sealed this step; the
+        device->host copy runs async and lands in the offload thread.
+
+        MUST run before any queued page can be released/evicted (callers:
+        right after sealing, before emit/finish) — extraction reads the live
+        page pool. Page ids pad to bucket sizes with the trash page so the
+        jitted gather compiles once per bucket, not per batch size.
+        """
+        if not self._pending_offload:
+            return
+        batch, self._pending_offload = self._pending_offload, []
+        n = len(batch)
+        bucket = 4
+        while bucket < n:
+            bucket *= 2
+        ids = np.zeros((bucket,), np.int32)  # pad with trash page 0
+        ids[:n] = [p for _s, p, _i in batch]
+        kb, vb = llama.extract_kv_pages(self.k_pages, self.v_pages, jnp.asarray(ids))
+        try:
+            kb.copy_to_host_async()
+            vb.copy_to_host_async()
+        except AttributeError:
+            pass
+        self.offload.submit([s for s, _p, _i in batch], kb, vb)
 
     def _make_slot(
         self,
@@ -371,11 +474,10 @@ class InferenceEngine:
         max_tokens = self._decode_budget(req, len(token_ids))
 
         seq = TokenBlockSequence.from_tokens(token_ids, cfg.page_size)
-        hashes = seq.sequence_hashes()
         needed_pages = (len(token_ids) + cfg.page_size - 1) // cfg.page_size
         try:
             sp = self._acquire_prompt_pages(
-                waiting.context.id, hashes, needed_pages,
+                waiting.context.id, seq, needed_pages,
                 n_tokens=len(token_ids), full_prefix_ok=False,
             )
         except OutOfPages:
@@ -407,6 +509,7 @@ class InferenceEngine:
 
         # seal prompt pages whose block is complete (skip already-cached)
         self._seal_prompt_blocks(sp, seq)
+        self._drain_offload()
         slot = self._make_slot(
             waiting, seq, sp,
             seq_len=len(token_ids), remaining=max_tokens,
@@ -467,11 +570,10 @@ class InferenceEngine:
             raise ValueError("page_size mismatch between prefill and decode")
 
         seq = TokenBlockSequence.from_tokens(token_ids, cfg.page_size)
-        hashes = seq.sequence_hashes()
         needed_pages = (len(token_ids) + cfg.page_size - 1) // cfg.page_size
         try:
             sp = self._acquire_prompt_pages(
-                waiting.context.id, hashes, needed_pages,
+                waiting.context.id, seq, needed_pages,
                 n_tokens=len(token_ids), full_prefix_ok=True,
             )
         except OutOfPages:
@@ -494,7 +596,9 @@ class InferenceEngine:
                     jnp.asarray(v_blocks[:, install]),
                 )
             self._seal_prompt_blocks(sp, seq)
+            self._drain_offload()
         except Exception:
+            self._pending_offload.clear()
             self.allocator.release(sp.pages)
             raise
 
@@ -578,11 +682,18 @@ class InferenceEngine:
         )
         self.steps += 1
 
+        # seal + drain offloads BEFORE emit: _emit_token may finish a slot
+        # and release its pages, and a neighbor's later alloc could evict a
+        # just-sealed page before extraction reads it
         for i, slot in enumerate(self._slots):
             if slot is None or not active[i]:
                 continue
             slot.seq_len += 1  # the fed token is now in the cache
             self._maybe_seal(slot)
+        self._drain_offload()
+        for i, slot in enumerate(self._slots):
+            if slot is None or not active[i]:
+                continue
             self._emit_token(i, slot, int(sampled[i]))
 
         if self.steps % 16 == 0:
@@ -614,6 +725,7 @@ class InferenceEngine:
                         blk.parent_sequence_hash,
                     )
                     slot.pages.hashes[i] = blk.sequence_hash
+                    self._queue_offload(blk.sequence_hash, slot.pages.pages[i], i)
 
     def _emit_token(self, slot_idx: int, slot: _Slot, tok: int) -> None:
         """Record + stream one sampled token; place slot or finish."""

@@ -51,6 +51,7 @@ async def launch_engine_worker(
     prefill_router_mode: str = "kv",
     max_local_prefill_length: int = 128,
     always_remote_prefill: bool = False,
+    kvbm_config=None,
 ) -> tuple[InferenceEngine, object]:
     """Build + register one engine worker in this process.
 
@@ -71,8 +72,14 @@ async def launch_engine_worker(
 
         transfer_source = await KvTransferSource().start()
 
+    kvbm = None
+    if kvbm_config is not None:
+        from dynamo_tpu.kvbm import KvBlockManager
+
+        kvbm = KvBlockManager(kvbm_config)
+
     engine = InferenceEngine(
-        spec, cfg, mesh=mesh, transfer_source=transfer_source
+        spec, cfg, mesh=mesh, transfer_source=transfer_source, kvbm=kvbm
     )
 
     if mode == "prefill":
@@ -159,6 +166,18 @@ async def _build_prefill_router(
     return await PushRouter.from_endpoint(ep, mode)
 
 
+def _kvbm_config_from_args(args: argparse.Namespace):
+    if args.kvbm_host_mb <= 0:
+        return None
+    from dynamo_tpu.kvbm import KvbmConfig
+
+    return KvbmConfig(
+        host_bytes=args.kvbm_host_mb * 1024 * 1024,
+        disk_bytes=args.kvbm_disk_mb * 1024 * 1024,
+        disk_dir=args.kvbm_disk_dir,
+    )
+
+
 async def _amain(args: argparse.Namespace) -> None:
     rcfg = RuntimeConfig.from_env()
     if args.hub:
@@ -186,6 +205,7 @@ async def _amain(args: argparse.Namespace) -> None:
         prefill_router_mode=args.prefill_router_mode,
         max_local_prefill_length=args.max_local_prefill_length,
         always_remote_prefill=args.always_remote_prefill,
+        kvbm_config=_kvbm_config_from_args(args),
     )
     print("ENGINE_READY", flush=True)
     await drt.runtime.wait_for_shutdown()
@@ -214,7 +234,18 @@ def main() -> None:
                    choices=["kv", "round_robin", "random"])
     p.add_argument("--max-local-prefill-length", type=int, default=128)
     p.add_argument("--always-remote-prefill", action="store_true")
+    p.add_argument("--kvbm-host-mb", type=int, default=0,
+                   help="host-DRAM KV tier budget in MiB (0 = KVBM off)")
+    p.add_argument("--kvbm-disk-mb", type=int, default=0,
+                   help="disk KV tier budget in MiB (0 = no disk tier)")
+    p.add_argument("--kvbm-disk-dir", default=None)
     args = p.parse_args()
+    if (args.kvbm_disk_mb > 0 or args.kvbm_disk_dir) and args.kvbm_host_mb <= 0:
+        p.error("--kvbm-disk-* requires --kvbm-host-mb > 0 (KVBM is off)")
+    if args.kvbm_disk_mb > 0 and not args.kvbm_disk_dir:
+        p.error("--kvbm-disk-mb requires --kvbm-disk-dir")
+    if args.kvbm_disk_dir and args.kvbm_disk_mb <= 0:
+        p.error("--kvbm-disk-dir requires --kvbm-disk-mb > 0")
     setup_logging()
     try:
         asyncio.run(_amain(args))

@@ -1,0 +1,31 @@
+"""KVBM: multi-tier KV block manager.
+
+TPU-native re-design of the reference's block manager
+(lib/llm/src/block_manager/, SURVEY.md §2.1 "KVBM"): KV blocks flow between
+cache tiers keyed by the same sequence-hash chain the router and engine use:
+
+  G1 — device HBM pages (the engine's PageAllocator prefix cache)
+  G2 — host DRAM pool (bounded bytes, LRU)
+  G3 — local disk (bounded bytes, LRU, survives restart)
+  (G4 remote — reachable through the disagg transfer plane; later round)
+
+Offload is write-through at block-seal time: the engine extracts sealed
+pages device→host in one batched gather per step (the XLA equivalent of the
+reference's block_copy.cu strided gather kernel) and hands them to a
+background offload thread; decode latency never waits on host/disk IO.
+Onboard happens at prefill admission: blocks missing in G1 but present in
+G2/G3 are scattered back into fresh device pages, extending the cached
+prefix and skipping prompt FLOPs.
+"""
+
+from dynamo_tpu.kvbm.manager import KvbmConfig, KvBlockManager
+from dynamo_tpu.kvbm.offload import OffloadEngine
+from dynamo_tpu.kvbm.pool import DiskBlockPool, HostBlockPool
+
+__all__ = [
+    "KvbmConfig",
+    "KvBlockManager",
+    "OffloadEngine",
+    "HostBlockPool",
+    "DiskBlockPool",
+]

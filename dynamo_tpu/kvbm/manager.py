@@ -1,0 +1,98 @@
+"""KvBlockManager: the tier orchestrator.
+
+Ties the pools together behind two calls the engine uses on its hot paths
+(ref: KvBlockManager block_manager.rs:98, onboard_blocks :143):
+
+  offer(sh, k, v)  — write-through from G1 seal (called by the offload
+                     thread; never the step loop)
+  get(sh)          — onboard probe at prefill admission; a G3 hit is
+                     promoted to G2 on the way up
+
+Lookup order is G2 then G3. Stats counters feed worker metrics.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from dynamo_tpu.kvbm.pool import DiskBlockPool, HostBlockPool
+
+log = logging.getLogger("dynamo.kvbm")
+
+
+@dataclass
+class KvbmConfig:
+    host_bytes: int = 256 * 1024 * 1024  # G2 budget
+    disk_bytes: int = 0  # G3 budget; 0 disables the disk tier
+    disk_dir: str | None = None
+    # offload filter: only blocks this many tokens deep into the prompt or
+    # shallower are offloaded (0 = offload everything). Deep blocks are the
+    # least likely to be shared. Ref: offload/filter.rs.
+    max_offload_depth_blocks: int = 0
+
+
+@dataclass
+class KvbmStats:
+    offloaded: int = 0
+    onboard_hits_host: int = 0
+    onboard_hits_disk: int = 0
+    onboard_misses: int = 0
+
+    def to_dict(self) -> dict[str, int]:
+        return dict(self.__dict__)
+
+
+class KvBlockManager:
+    def __init__(self, config: KvbmConfig | None = None):
+        self.config = config or KvbmConfig()
+        self.disk: DiskBlockPool | None = None
+        if self.config.disk_bytes > 0 and self.config.disk_dir:
+            self.disk = DiskBlockPool(self.config.disk_dir, self.config.disk_bytes)
+        # G2 evictions cascade down to G3 when the disk tier exists
+        self.host = HostBlockPool(
+            self.config.host_bytes,
+            on_evict=(lambda sh, k, v: self.disk.put(sh, k, v))
+            if self.disk is not None else None,
+        )
+        self.stats = KvbmStats()
+        self._lock = threading.Lock()
+
+    def should_offload(self, block_index: int) -> bool:
+        d = self.config.max_offload_depth_blocks
+        return d <= 0 or block_index < d
+
+    def offer(self, sh: int, k: np.ndarray, v: np.ndarray) -> None:
+        """Write-through insert from a sealed G1 page."""
+        if self.host.put(sh, np.ascontiguousarray(k), np.ascontiguousarray(v)):
+            with self._lock:
+                self.stats.offloaded += 1
+
+    def get(self, sh: int) -> tuple[np.ndarray, np.ndarray] | None:
+        """Onboard probe: G2 then G3 (with promotion)."""
+        blk = self.host.get(sh)
+        if blk is not None:
+            with self._lock:
+                self.stats.onboard_hits_host += 1
+            return blk
+        if self.disk is not None:
+            blk = self.disk.get(sh)
+            if blk is not None:
+                self.host.put(sh, blk[0], blk[1])
+                with self._lock:
+                    self.stats.onboard_hits_disk += 1
+                return blk
+        with self._lock:
+            self.stats.onboard_misses += 1
+        return None
+
+    def __contains__(self, sh: int) -> bool:
+        return sh in self.host or (self.disk is not None and sh in self.disk)
+
+    def clear(self) -> None:
+        self.host.clear()
+        if self.disk is not None:
+            self.disk.clear()

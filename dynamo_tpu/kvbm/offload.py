@@ -1,0 +1,73 @@
+"""Offload engine: background G1→G2 writer.
+
+The engine's step loop stays device-bound: at step end it batches sealed
+pages into ONE jitted gather (`extract_kv_pages`), starts the device→host
+copy asynchronously, and enqueues the in-flight arrays here. This thread
+materializes them (blocking on the DMA, not the step loop) and offers each
+block to the tier manager. Ref: the offload/onboard engine with its worker
+queues, block_manager/offload.rs.
+"""
+
+from __future__ import annotations
+
+import logging
+import queue
+import threading
+
+import numpy as np
+
+log = logging.getLogger("dynamo.kvbm.offload")
+
+_STOP = object()
+
+
+class OffloadEngine:
+    def __init__(self, manager, *, max_queue: int = 64):
+        self.manager = manager
+        self._q: queue.Queue = queue.Queue(maxsize=max_queue)
+        self._thread: threading.Thread | None = None
+        self.dropped = 0  # batches skipped under backpressure
+
+    def start(self) -> "OffloadEngine":
+        if self._thread is None:
+            self._thread = threading.Thread(
+                target=self._run, name="kvbm-offload", daemon=True
+            )
+            self._thread.start()
+        return self
+
+    def submit(self, hashes: list[int], k_blocks, v_blocks) -> None:
+        """Non-blocking: a full queue drops the batch (offload is a cache
+        fill, never worth stalling decode for)."""
+        try:
+            self._q.put_nowait((hashes, k_blocks, v_blocks))
+        except queue.Full:
+            self.dropped += 1
+
+    def flush(self, timeout: float = 10.0) -> None:
+        """Wait until everything queued so far has been offered (tests)."""
+        done = threading.Event()
+        self._q.put((done, None, None))
+        done.wait(timeout)
+
+    def close(self) -> None:
+        if self._thread is not None:
+            self._q.put((_STOP, None, None))
+            self._thread.join(timeout=5)
+            self._thread = None
+
+    def _run(self) -> None:
+        while True:
+            hashes, kb, vb = self._q.get()
+            if hashes is _STOP:
+                return
+            if isinstance(hashes, threading.Event):
+                hashes.set()
+                continue
+            try:
+                # np.asarray blocks until the async device->host copy lands
+                k_np, v_np = np.asarray(kb), np.asarray(vb)
+                for i, sh in enumerate(hashes):
+                    self.manager.offer(sh, k_np[:, i], v_np[:, i])
+            except Exception:  # noqa: BLE001
+                log.exception("offload batch failed")

@@ -1,0 +1,185 @@
+"""Host-DRAM and disk block pools (tiers G2/G3).
+
+Each pool maps ``sequence_hash -> (k_block, v_block)`` where a block is the
+KV content of one page across all layers: shape [L, page_size, kv_heads,
+head_dim]. Pools are byte-bounded with LRU eviction (ref: ManagedBlockPool
+active/inactive registries + sequence-hash reuse, block_manager/pool/
+managed.rs); the disk pool persists across restarts (ref: G3 local NVMe
+tier, block_manager.rs:62-74 CacheLevel).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import threading
+from collections import OrderedDict
+from typing import Callable
+
+import numpy as np
+
+log = logging.getLogger("dynamo.kvbm.pool")
+
+
+class HostBlockPool:
+    """Byte-bounded LRU of KV blocks in host DRAM. Thread-safe."""
+
+    def __init__(
+        self,
+        capacity_bytes: int,
+        *,
+        on_evict: Callable[[int, np.ndarray, np.ndarray], None] | None = None,
+    ):
+        self.capacity_bytes = capacity_bytes
+        self.used_bytes = 0
+        self._blocks: OrderedDict[int, tuple[np.ndarray, np.ndarray]] = OrderedDict()
+        self._lock = threading.Lock()
+        # demotion hook: evicted blocks cascade to the next tier (G3)
+        self._on_evict = on_evict
+
+    def __len__(self) -> int:
+        return len(self._blocks)
+
+    def __contains__(self, sh: int) -> bool:
+        with self._lock:
+            return sh in self._blocks
+
+    def put(self, sh: int, k: np.ndarray, v: np.ndarray) -> bool:
+        nbytes = k.nbytes + v.nbytes
+        if nbytes > self.capacity_bytes:
+            return False
+        evicted: list[tuple[int, np.ndarray, np.ndarray]] = []
+        with self._lock:
+            if sh in self._blocks:
+                self._blocks.move_to_end(sh)
+                return True
+            while self.used_bytes + nbytes > self.capacity_bytes and self._blocks:
+                esh, (ek, ev) = self._blocks.popitem(last=False)
+                self.used_bytes -= ek.nbytes + ev.nbytes
+                evicted.append((esh, ek, ev))
+            self._blocks[sh] = (k, v)
+            self.used_bytes += nbytes
+        for esh, ek, ev in evicted:
+            if self._on_evict is not None:
+                self._on_evict(esh, ek, ev)
+        return True
+
+    def get(self, sh: int) -> tuple[np.ndarray, np.ndarray] | None:
+        with self._lock:
+            blk = self._blocks.get(sh)
+            if blk is not None:
+                self._blocks.move_to_end(sh)
+            return blk
+
+    def remove(self, sh: int) -> bool:
+        with self._lock:
+            blk = self._blocks.pop(sh, None)
+            if blk is None:
+                return False
+            self.used_bytes -= blk[0].nbytes + blk[1].nbytes
+            return True
+
+    def clear(self) -> None:
+        with self._lock:
+            self._blocks.clear()
+            self.used_bytes = 0
+
+
+class DiskBlockPool:
+    """Byte-bounded LRU of KV blocks on local disk; index survives restart.
+
+    One ``.npy``-pair file per block (stacked [2, L, page, kvh, D]); a
+    ``kvbm_index.json`` records hashes + LRU order. Thread-safe.
+    """
+
+    INDEX = "kvbm_index.json"
+
+    def __init__(self, directory: str, capacity_bytes: int):
+        self.dir = directory
+        self.capacity_bytes = capacity_bytes
+        self.used_bytes = 0
+        self._order: OrderedDict[int, int] = OrderedDict()  # sh -> nbytes
+        self._lock = threading.Lock()
+        os.makedirs(directory, exist_ok=True)
+        self._load_index()
+
+    def __len__(self) -> int:
+        return len(self._order)
+
+    def __contains__(self, sh: int) -> bool:
+        with self._lock:
+            return sh in self._order
+
+    def _path(self, sh: int) -> str:
+        return os.path.join(self.dir, f"{sh & 0xFFFFFFFFFFFFFFFF:016x}.npy")
+
+    def _load_index(self) -> None:
+        path = os.path.join(self.dir, self.INDEX)
+        try:
+            with open(path) as f:
+                entries = json.load(f)
+        except (OSError, json.JSONDecodeError):
+            return
+        for sh, nbytes in entries:
+            if os.path.exists(self._path(sh)):
+                self._order[sh] = nbytes
+                self.used_bytes += nbytes
+
+    def _save_index(self) -> None:
+        path = os.path.join(self.dir, self.INDEX)
+        try:
+            with open(path, "w") as f:
+                json.dump(list(self._order.items()), f)
+        except OSError:
+            log.warning("could not persist kvbm disk index", exc_info=True)
+
+    def put(self, sh: int, k: np.ndarray, v: np.ndarray) -> bool:
+        nbytes = k.nbytes + v.nbytes
+        if nbytes > self.capacity_bytes:
+            return False
+        with self._lock:
+            if sh in self._order:
+                self._order.move_to_end(sh)
+                return True
+            while self.used_bytes + nbytes > self.capacity_bytes and self._order:
+                esh, en = self._order.popitem(last=False)
+                self.used_bytes -= en
+                try:
+                    os.unlink(self._path(esh))
+                except OSError:
+                    pass
+            try:
+                np.save(self._path(sh), np.stack([k, v]))
+            except OSError:
+                log.warning("kvbm disk write failed", exc_info=True)
+                return False
+            self._order[sh] = nbytes
+            self.used_bytes += nbytes
+            self._save_index()
+        return True
+
+    def get(self, sh: int) -> tuple[np.ndarray, np.ndarray] | None:
+        with self._lock:
+            if sh not in self._order:
+                return None
+            self._order.move_to_end(sh)
+        try:
+            stacked = np.load(self._path(sh))
+        except OSError:
+            with self._lock:
+                nbytes = self._order.pop(sh, 0)
+                self.used_bytes -= nbytes
+            return None
+        return stacked[0], stacked[1]
+
+    def clear(self) -> None:
+        with self._lock:
+            for sh in list(self._order):
+                try:
+                    os.unlink(self._path(sh))
+                except OSError:
+                    pass
+            self._order.clear()
+            self.used_bytes = 0
+            self._save_index()
