@@ -60,11 +60,44 @@ class ModelSpec:
         )
 
     @classmethod
+    def tiny_moe(cls) -> "ModelSpec":
+        return cls(
+            name="tiny-moe", vocab_size=272, hidden_size=64,
+            intermediate_size=128, num_layers=2, num_heads=4,
+            num_kv_heads=2, head_dim=16, dtype="float32",
+            num_experts=4, num_experts_per_token=2, moe_intermediate_size=64,
+        )
+
+    @classmethod
+    def mixtral_8x7b(cls) -> "ModelSpec":
+        return cls(
+            name="mixtral-8x7b", vocab_size=32000, hidden_size=4096,
+            intermediate_size=14336, num_layers=32, num_heads=32,
+            num_kv_heads=8, head_dim=128, tie_embeddings=False,
+            num_experts=8, num_experts_per_token=2,
+            moe_intermediate_size=14336,
+        )
+
+    @classmethod
+    def gpt_oss_120b(cls) -> "ModelSpec":
+        """Wide-EP config (ref: engine_configs gpt-oss-120b recipes)."""
+        return cls(
+            name="gpt-oss-120b", vocab_size=201088, hidden_size=2880,
+            intermediate_size=2880, num_layers=36, num_heads=64,
+            num_kv_heads=8, head_dim=64, tie_embeddings=False,
+            num_experts=128, num_experts_per_token=4,
+            moe_intermediate_size=2880,
+        )
+
+    @classmethod
     def preset(cls, name: str) -> "ModelSpec":
         presets = {
             "tiny-test": cls.tiny,
+            "tiny-moe": cls.tiny_moe,
             "llama-3-8b": cls.llama3_8b,
             "llama-3-70b": cls.llama3_70b,
+            "mixtral-8x7b": cls.mixtral_8x7b,
+            "gpt-oss-120b": cls.gpt_oss_120b,
         }
         if name in presets:
             return presets[name]()
@@ -83,6 +116,8 @@ class EngineConfig:
     # parallelism (mesh axes sizes; 1 = off)
     tp: int = 1
     dp: int = 1
+    sp: int = 1  # sequence/context parallel (ring-attention prefill)
+    ep: int = 1  # expert parallel (MoE)
     # sampling
     seed: int = 0
     # scheduler

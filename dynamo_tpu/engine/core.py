@@ -496,16 +496,35 @@ class InferenceEngine:
         block_table = np.zeros((cfg.max_pages_per_seq,), np.int32)
         block_table[: sp.num_pages] = sp.pages
 
-        logits, self.k_pages, self.v_pages = llama.prefill_forward(
-            self.spec,
-            self.params,
-            jnp.asarray(padded),
-            jnp.asarray(block_table),
-            jnp.asarray(start_pos, jnp.int32),
-            self.k_pages,
-            self.v_pages,
-            jnp.asarray(len(new_tokens), jnp.int32),
+        use_ring = (
+            self.mesh is not None
+            and self.mesh.shape.get("sp", 1) > 1
+            and start_pos == 0
+            and bucket % self.mesh.shape["sp"] == 0
         )
+        if use_ring:
+            # cold long prompt: sequence-parallel ring-attention prefill
+            logits, self.k_pages, self.v_pages = llama.prefill_forward_ring(
+                self.spec,
+                self.params,
+                jnp.asarray(padded),
+                jnp.asarray(block_table),
+                self.k_pages,
+                self.v_pages,
+                jnp.asarray(len(new_tokens), jnp.int32),
+                mesh=self.mesh,
+            )
+        else:
+            logits, self.k_pages, self.v_pages = llama.prefill_forward(
+                self.spec,
+                self.params,
+                jnp.asarray(padded),
+                jnp.asarray(block_table),
+                jnp.asarray(start_pos, jnp.int32),
+                self.k_pages,
+                self.v_pages,
+                jnp.asarray(len(new_tokens), jnp.int32),
+            )
 
         # seal prompt pages whose block is complete (skip already-cached)
         self._seal_prompt_blocks(sp, seq)
@@ -592,8 +611,8 @@ class InferenceEngine:
                 )
                 self.k_pages, self.v_pages = llama.insert_kv_pages(
                     self.k_pages, self.v_pages, page_ids,
-                    jnp.asarray(k_blocks[:, install]),
-                    jnp.asarray(v_blocks[:, install]),
+                    jnp.asarray(k_blocks[:, :, install]),
+                    jnp.asarray(v_blocks[:, :, install]),
                 )
             self._seal_prompt_blocks(sp, seq)
             self._drain_offload()

@@ -61,10 +61,10 @@ async def launch_engine_worker(
     spec = spec or ModelSpec.preset(model)
     cfg = engine_config or EngineConfig()
     mesh = None
-    if cfg.tp > 1 or cfg.dp > 1:
+    if cfg.tp > 1 or cfg.dp > 1 or cfg.sp > 1 or cfg.ep > 1:
         from dynamo_tpu.parallel.mesh import make_mesh
 
-        mesh = make_mesh(tp=cfg.tp, dp=cfg.dp)
+        mesh = make_mesh(tp=cfg.tp, dp=cfg.dp, sp=cfg.sp, ep=cfg.ep)
 
     transfer_source = None
     if mode == "prefill":
@@ -189,6 +189,8 @@ async def _amain(args: argparse.Namespace) -> None:
         max_pages_per_seq=args.max_pages_per_seq,
         max_decode_slots=args.max_decode_slots,
         tp=args.tp,
+        sp=args.sp,
+        ep=args.ep,
     )
     await launch_engine_worker(
         drt,
@@ -225,6 +227,10 @@ def main() -> None:
     p.add_argument("--max-pages-per-seq", type=int, default=64)
     p.add_argument("--max-decode-slots", type=int, default=8)
     p.add_argument("--tp", type=int, default=1)
+    p.add_argument("--sp", type=int, default=1,
+                   help="sequence-parallel ring-attention prefill width")
+    p.add_argument("--ep", type=int, default=1,
+                   help="expert-parallel width (MoE models)")
     p.add_argument("--router-mode", default="kv",
                    choices=["kv", "round_robin", "random"])
     p.add_argument("--mode", default="aggregated",

@@ -56,19 +56,25 @@ def init_params(spec: ModelSpec, key: jax.Array) -> Params:
     if not spec.tie_embeddings:
         params["lm_head"] = dense(next(keys), (d, spec.vocab_size))
     for _ in range(spec.num_layers):
-        params["layers"].append(
-            {
-                "attn_norm": jnp.ones((d,), dtype),
-                "wq": dense(next(keys), (d, nh * hd)),
-                "wk": dense(next(keys), (d, nkv * hd)),
-                "wv": dense(next(keys), (d, nkv * hd)),
-                "wo": dense(next(keys), (nh * hd, d)),
-                "mlp_norm": jnp.ones((d,), dtype),
-                "w_gate": dense(next(keys), (d, spec.intermediate_size)),
-                "w_up": dense(next(keys), (d, spec.intermediate_size)),
-                "w_down": dense(next(keys), (spec.intermediate_size, d)),
-            }
-        )
+        layer = {
+            "attn_norm": jnp.ones((d,), dtype),
+            "wq": dense(next(keys), (d, nh * hd)),
+            "wk": dense(next(keys), (d, nkv * hd)),
+            "wv": dense(next(keys), (d, nkv * hd)),
+            "wo": dense(next(keys), (nh * hd, d)),
+            "mlp_norm": jnp.ones((d,), dtype),
+        }
+        if spec.num_experts:
+            from dynamo_tpu.models import moe
+
+            layer["moe"] = moe.init_moe_layer(spec, next(keys))
+        else:
+            layer.update(
+                w_gate=dense(next(keys), (d, spec.intermediate_size)),
+                w_up=dense(next(keys), (d, spec.intermediate_size)),
+                w_down=dense(next(keys), (spec.intermediate_size, d)),
+            )
+        params["layers"].append(layer)
     return params
 
 
@@ -85,10 +91,17 @@ def param_shardings(spec: ModelSpec, mesh: Mesh) -> Params:
         "wv": ns(None, "tp"),
         "wo": ns("tp", None),  # row
         "mlp_norm": ns(),
-        "w_gate": ns(None, "tp"),
-        "w_up": ns(None, "tp"),
-        "w_down": ns("tp", None),
     }
+    if spec.num_experts:
+        from dynamo_tpu.models import moe
+
+        layer["moe"] = moe.moe_layer_shardings(mesh)
+    else:
+        layer.update(
+            w_gate=ns(None, "tp"),
+            w_up=ns(None, "tp"),
+            w_down=ns("tp", None),
+        )
     out = {
         "embed": ns(None, "tp"),
         "final_norm": ns(),
@@ -100,20 +113,23 @@ def param_shardings(spec: ModelSpec, mesh: Mesh) -> Params:
 
 
 def cache_shardings(mesh: Mesh) -> tuple[NamedSharding, NamedSharding]:
-    """KV pages [L, pages, page_size, kv_heads, D]: shard kv_heads on tp."""
-    s = NamedSharding(mesh, P(None, None, None, "tp", None))
+    """KV pages [L, kv_heads, pages, page_size, D]: shard kv_heads on tp."""
+    s = NamedSharding(mesh, P(None, "tp", None, None, None))
     return s, s
 
 
 def init_cache(
     spec: ModelSpec, num_pages: int, page_size: int, dtype=None
 ) -> tuple[jax.Array, jax.Array]:
-    """K and V page arrays [L, num_pages, page_size, kv_heads, head_dim].
+    """K and V page arrays [L, kv_heads, num_pages, page_size, head_dim].
 
-    ``num_pages`` must already include the trash page (index 0).
+    Head-major layout: a page DMA for one kv head slices only leading dims,
+    keeping the trailing (page_size, head_dim) tile contiguous — the layout
+    the Pallas decode kernel (and Mosaic's tiling rules) require. ``num_pages``
+    must already include the trash page (index 0).
     """
     dtype = dtype or jnp.dtype(spec.dtype)
-    shape = (spec.num_layers, num_pages, page_size, spec.num_kv_heads, spec.head_dim)
+    shape = (spec.num_layers, spec.num_kv_heads, num_pages, page_size, spec.head_dim)
     return jnp.zeros(shape, dtype), jnp.zeros(shape, dtype)
 
 
@@ -156,6 +172,15 @@ def _mlp(lp: Params, x: jax.Array) -> jax.Array:
     return (jax.nn.silu(x @ lp["w_gate"]) * (x @ lp["w_up"])) @ lp["w_down"]
 
 
+def _ffn(spec: ModelSpec, lp: Params, x: jax.Array) -> jax.Array:
+    """Dense MLP or routed MoE depending on the spec."""
+    if spec.num_experts:
+        from dynamo_tpu.models import moe
+
+        return moe.moe_mlp(spec, lp["moe"], x)
+    return _mlp(lp, x)
+
+
 def _logits(spec: ModelSpec, params: Params, x: jax.Array) -> jax.Array:
     x = rms_norm(x, params["final_norm"], spec.rms_eps)
     head = params["embed"].T if spec.tie_embeddings else params["lm_head"]
@@ -184,7 +209,7 @@ def prefill_forward_impl(
     idx = jnp.arange(T)
     valid = idx < num_tokens
     positions = start_pos + idx  # absolute positions of new tokens
-    page_size = k_pages.shape[2]
+    page_size = k_pages.shape[3]
 
     # padded positions scatter to the trash page
     page_idx_raw = block_table[positions // page_size]
@@ -197,15 +222,17 @@ def prefill_forward_impl(
     for li, lp in enumerate(params["layers"]):
         h = rms_norm(x, lp["attn_norm"], spec.rms_eps)
         q, k, v = _attn_qkv(spec, lp, h, positions)
-        k_pages = k_pages.at[li, safe_page, offset].set(k)
-        v_pages = v_pages.at[li, safe_page, offset].set(v)
+        # li/safe_page/offset are all advanced indices split by the ':'
+        # slice, so the broadcast dim moves to the FRONT: update is [T, KH, D]
+        k_pages = k_pages.at[li, :, safe_page, offset].set(k)
+        v_pages = v_pages.at[li, :, safe_page, offset].set(v)
         k_ctx = gather_pages(k_pages[li], block_table)  # [max_ctx, kvh, D]
         v_ctx = gather_pages(v_pages[li], block_table)
         attn = causal_attention(q, k_ctx, v_ctx, positions, kv_len)
         attn = attn.reshape(T, spec.num_heads * spec.head_dim)
         x = x + attn @ lp["wo"]
         h = rms_norm(x, lp["mlp_norm"], spec.rms_eps)
-        x = x + _mlp(lp, h)
+        x = x + _ffn(spec, lp, h)
 
     last = jnp.clip(num_tokens - 1, 0, T - 1)
     logits = _logits(spec, params, x[last])  # [V]
@@ -214,6 +241,65 @@ def prefill_forward_impl(
 
 prefill_forward = jax.jit(
     prefill_forward_impl, static_argnums=(0,), donate_argnums=(5, 6)
+)
+
+
+def prefill_forward_ring_impl(
+    spec: ModelSpec,
+    params: Params,
+    tokens: jax.Array,  # [T_pad] int32, T_pad divisible by mesh sp
+    block_table: jax.Array,  # [max_pages_per_seq] int32
+    k_pages: jax.Array,  # donated
+    v_pages: jax.Array,
+    num_tokens: jax.Array,  # scalar: real token count
+    mesh: Mesh,  # static
+) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """Long-context prefill with sequence-parallel ring attention.
+
+    Token activations shard over the "sp" mesh axis (sharding constraints
+    guide GSPMD; only the attention itself is an explicit shard_map ring —
+    see parallel/ring.py). No cached-prefix support: ring prefill serves
+    cold ultra-long prompts; warm prefixes take the paged path. Padding at
+    the tail is masked by causality (padded positions exceed every real
+    query) and scatters to the trash page.
+    """
+    from dynamo_tpu.parallel.ring import ring_attention
+
+    T = tokens.shape[0]
+    idx = jnp.arange(T)
+    valid = idx < num_tokens
+    page_size = k_pages.shape[3]
+    page_idx_raw = block_table[idx // page_size]
+    safe_page = jnp.where(valid, page_idx_raw, TRASH_PAGE)
+    offset = idx % page_size
+
+    sp_spec = NamedSharding(mesh, P("sp", None))
+    x = params["embed"][tokens]
+    x = jax.lax.with_sharding_constraint(x, sp_spec)
+
+    for li, lp in enumerate(params["layers"]):
+        h = rms_norm(x, lp["attn_norm"], spec.rms_eps)
+        q, k, v = _attn_qkv(spec, lp, h, idx)
+        # li/safe_page/offset are all advanced indices split by the ':'
+        # slice, so the broadcast dim moves to the FRONT: update is [T, KH, D]
+        k_pages = k_pages.at[li, :, safe_page, offset].set(k)
+        v_pages = v_pages.at[li, :, safe_page, offset].set(v)
+        attn = ring_attention(q, k, v, mesh=mesh)
+        x = x + attn.reshape(T, spec.num_heads * spec.head_dim) @ lp["wo"]
+        h = rms_norm(x, lp["mlp_norm"], spec.rms_eps)
+        x = x + _ffn(spec, lp, h)
+        x = jax.lax.with_sharding_constraint(x, sp_spec)
+
+    last = jnp.clip(num_tokens - 1, 0, T - 1)
+    logits = _logits(spec, params, x[last])
+    return logits, k_pages, v_pages
+
+
+prefill_forward_ring = jax.jit(
+    prefill_forward_ring_impl,
+    static_argnums=(0,),
+    static_argnames=("mesh",),
+    donate_argnums=(4, 5),
 )
 
 
@@ -233,7 +319,7 @@ def decode_forward_impl(
 ) -> tuple[jax.Array, jax.Array, jax.Array]:
     """One decode step for the whole slot batch; returns (logits[B,V], k, v)."""
     B = tokens.shape[0]
-    page_size = k_pages.shape[2]
+    page_size = k_pages.shape[3]
     positions = seq_lens - 1  # position of the new token
 
     page_idx_raw = jnp.take_along_axis(
@@ -252,15 +338,17 @@ def decode_forward_impl(
         v = (h @ lp["wv"]).reshape(B, spec.num_kv_heads, spec.head_dim)
         q = rope(q, positions, spec.rope_theta)
         k = rope(k, positions, spec.rope_theta)
-        k_pages = k_pages.at[li, safe_page, offset].set(k)
-        v_pages = v_pages.at[li, safe_page, offset].set(v)
+        # li/safe_page/offset are all advanced indices split by the ':'
+        # slice, so the broadcast dim moves to the FRONT: update is [T, KH, D]
+        k_pages = k_pages.at[li, :, safe_page, offset].set(k)
+        v_pages = v_pages.at[li, :, safe_page, offset].set(v)
         attn = paged_decode_attention_auto(
             q, k_pages[li], v_pages[li], block_tables, seq_lens, mesh=mesh
         )
         attn = attn.reshape(B, spec.num_heads * spec.head_dim)
         x = x + attn @ lp["wo"]
         h = rms_norm(x, lp["mlp_norm"], spec.rms_eps)
-        x = x + _mlp(lp, h)
+        x = x + _ffn(spec, lp, h)
 
     logits = _logits(spec, params, x)  # [B, V]
     return logits, k_pages, v_pages
@@ -276,8 +364,8 @@ decode_forward = jax.jit(
 
 
 def _extract_kv_pages_impl(k_pages, v_pages, page_ids):
-    """Gather whole pages for transfer: -> [L, n, page, kvh, D] x2."""
-    return k_pages[:, page_ids], v_pages[:, page_ids]
+    """Gather whole pages for transfer: -> [L, kvh, n, page, D] x2."""
+    return k_pages[:, :, page_ids], v_pages[:, :, page_ids]
 
 
 extract_kv_pages = jax.jit(_extract_kv_pages_impl)
@@ -286,8 +374,8 @@ extract_kv_pages = jax.jit(_extract_kv_pages_impl)
 def _insert_kv_pages_impl(k_pages, v_pages, page_ids, k_blocks, v_blocks):
     """Scatter transferred pages into the local pools (donated)."""
     return (
-        k_pages.at[:, page_ids].set(k_blocks),
-        v_pages.at[:, page_ids].set(v_blocks),
+        k_pages.at[:, :, page_ids].set(k_blocks),
+        v_pages.at[:, :, page_ids].set(v_blocks),
     )
 
 
@@ -311,7 +399,7 @@ def reference_forward(
         attn = causal_attention(q, k, v, positions, jnp.asarray(T))
         x = x + attn.reshape(T, -1) @ lp["wo"]
         h = rms_norm(x, lp["mlp_norm"], spec.rms_eps)
-        x = x + _mlp(lp, h)
+        x = x + _ffn(spec, lp, h)
     xn = rms_norm(x, params["final_norm"], spec.rms_eps)
     head = params["embed"].T if spec.tie_embeddings else params["lm_head"]
     return (xn @ head).astype(jnp.float32)

@@ -41,13 +41,13 @@ def repeat_kv(x: jax.Array, n_rep: int) -> jax.Array:
 
 
 def gather_pages(
-    pages: jax.Array,  # [num_pages, page_size, kv_heads, head_dim]
+    pages: jax.Array,  # [kv_heads, num_pages, page_size, head_dim]
     block_table: jax.Array,  # [max_pages_per_seq] int32
 ) -> jax.Array:
     """Materialize one sequence's KV as [max_ctx, kv_heads, head_dim]."""
-    toks = pages[block_table]  # [P, page, H, D]
-    P, page, H, D = toks.shape
-    return toks.reshape(P * page, H, D)
+    toks = pages[:, block_table]  # [H, P, page, D]
+    H, P, page, D = toks.shape
+    return toks.reshape(H, P * page, D).swapaxes(0, 1)
 
 
 def causal_attention(
@@ -80,8 +80,8 @@ def causal_attention(
 
 def paged_decode_attention(
     q: jax.Array,  # [B, heads, D] (one new token per sequence)
-    k_pages: jax.Array,  # [num_pages, page_size, kv_heads, D]
-    v_pages: jax.Array,  # [num_pages, page_size, kv_heads, D]
+    k_pages: jax.Array,  # [kv_heads, num_pages, page_size, D]
+    v_pages: jax.Array,  # [kv_heads, num_pages, page_size, D]
     block_tables: jax.Array,  # [B, max_pages_per_seq]
     seq_lens: jax.Array,  # [B] context length INCLUDING the new token
 ) -> jax.Array:
@@ -92,7 +92,7 @@ def paged_decode_attention(
     the same thing without materializing the gather.
     """
     B, H, D = q.shape
-    page_size = k_pages.shape[1]
+    page_size = k_pages.shape[2]
     P = block_tables.shape[1]
     max_ctx = P * page_size
 
@@ -150,8 +150,8 @@ def paged_decode_attention_auto(
                 mesh=mesh,
                 in_specs=(
                     P(None, "tp", None),  # q: heads sharded
-                    P(None, None, "tp", None),  # k_pages: kv heads sharded
-                    P(None, None, "tp", None),
+                    P("tp", None, None, None),  # k_pages: kv heads sharded
+                    P("tp", None, None, None),
                     P(None, None),  # block tables replicated
                     P(None),  # seq lens replicated
                 ),

@@ -34,8 +34,9 @@ def _decode_kernel(
     seq_lens_ref,  # [B] int32 (SMEM)
     # inputs
     q_ref,  # [1, 1, G, D] VMEM (this (b, kh)'s query-head group)
-    k_pages_ref,  # [num_pages, page, KH, D] stays in HBM/ANY
-    v_pages_ref,
+    k_pages_ref,  # [KH, num_pages, page, D] stays in HBM/ANY (head-major:
+    v_pages_ref,  # the per-head page DMA slices leading dims only, so the
+    # trailing (page, D) tile meets Mosaic's alignment rules)
     # outputs
     o_ref,  # [1, 1, G, D] VMEM
     # scratch
@@ -57,13 +58,13 @@ def _decode_kernel(
     def k_dma(slot, i):
         page = block_tables_ref[b, i]
         return pltpu.make_async_copy(
-            k_pages_ref.at[page, :, kh, :], k_buf.at[slot], sems.at[0, slot]
+            k_pages_ref.at[kh, page], k_buf.at[slot], sems.at[0, slot]
         )
 
     def v_dma(slot, i):
         page = block_tables_ref[b, i]
         return pltpu.make_async_copy(
-            v_pages_ref.at[page, :, kh, :], v_buf.at[slot], sems.at[1, slot]
+            v_pages_ref.at[kh, page], v_buf.at[slot], sems.at[1, slot]
         )
 
     # warm-up: start page 0 into slot 0 (skip for empty sequences — an
@@ -115,8 +116,8 @@ def _decode_kernel(
 @functools.partial(jax.jit, static_argnames=("interpret",))
 def paged_decode_attention_pallas(
     q: jax.Array,  # [B, H, D]
-    k_pages: jax.Array,  # [num_pages, page, KH, D]
-    v_pages: jax.Array,  # [num_pages, page, KH, D]
+    k_pages: jax.Array,  # [KH, num_pages, page, D]
+    v_pages: jax.Array,  # [KH, num_pages, page, D]
     block_tables: jax.Array,  # [B, P] int32
     seq_lens: jax.Array,  # [B] int32 (length INCLUDING the new token)
     *,
@@ -124,7 +125,7 @@ def paged_decode_attention_pallas(
 ) -> jax.Array:
     """Decode-step paged attention; same contract as the pure-JAX form."""
     B, H, D = q.shape
-    _, page_size, KH, _ = k_pages.shape
+    KH, _, page_size, _ = k_pages.shape
     G = H // KH
     q4 = q.reshape(B, KH, G, D)
 

@@ -22,14 +22,15 @@ def make_mesh(
     tp: int = 1,
     dp: int = 1,
     sp: int = 1,
+    ep: int = 1,
     devices: list | None = None,
 ) -> Mesh:
     devices = devices if devices is not None else jax.devices()
-    need = tp * dp * sp
+    need = tp * dp * sp * ep
     if need > len(devices):
         raise ValueError(
-            f"mesh needs {need} devices (dp={dp} sp={sp} tp={tp}), "
+            f"mesh needs {need} devices (dp={dp} sp={sp} ep={ep} tp={tp}), "
             f"have {len(devices)}"
         )
-    arr = np.array(devices[:need]).reshape(dp, sp, tp)
-    return Mesh(arr, ("dp", "sp", "tp"))
+    arr = np.array(devices[:need]).reshape(dp, sp, ep, tp)
+    return Mesh(arr, ("dp", "sp", "ep", "tp"))

@@ -15,10 +15,10 @@ def _setup(B=4, H=8, KH=4, D=128, page_size=16, pages_per_seq=4, seed=0,
     num_pages = 1 + B * pages_per_seq
     q = jnp.asarray(rng.standard_normal((B, H, D)), dtype)
     k_pages = jnp.asarray(
-        rng.standard_normal((num_pages, page_size, KH, D)), dtype
+        rng.standard_normal((KH, num_pages, page_size, D)), dtype
     )
     v_pages = jnp.asarray(
-        rng.standard_normal((num_pages, page_size, KH, D)), dtype
+        rng.standard_normal((KH, num_pages, page_size, D)), dtype
     )
     bt = np.zeros((B, pages_per_seq), np.int32)
     for i in range(B):
@@ -73,8 +73,8 @@ def test_shard_map_tp_dispatch(monkeypatch):
     mesh = make_mesh(tp=4, dp=2)
     ref = paged_decode_attention(q, k, v, bt, lens)
     qs = jax.device_put(q, NamedSharding(mesh, P(None, "tp", None)))
-    ks = jax.device_put(k, NamedSharding(mesh, P(None, None, "tp", None)))
-    vs = jax.device_put(v, NamedSharding(mesh, P(None, None, "tp", None)))
+    ks = jax.device_put(k, NamedSharding(mesh, P("tp", None, None, None)))
+    vs = jax.device_put(v, NamedSharding(mesh, P("tp", None, None, None)))
     got = paged_decode_attention_auto(qs, ks, vs, bt, lens, mesh=mesh)
     np.testing.assert_allclose(
         np.asarray(got), np.asarray(ref), rtol=2e-5, atol=2e-5
