@@ -362,10 +362,12 @@ class InferenceEngine:
                 page_ids = jnp.asarray(
                     np.asarray([sp.pages[i] for i in idxs], np.int32)
                 )
+                # tier blocks are [L, KH, page, D]; insert_kv_pages wants the
+                # n stacked pages on axis 2: [L, KH, n, page, D]
                 self.k_pages, self.v_pages = llama.insert_kv_pages(
                     self.k_pages, self.v_pages, page_ids,
-                    jnp.asarray(np.stack([b[0] for b in onboard], axis=1)),
-                    jnp.asarray(np.stack([b[1] for b in onboard], axis=1)),
+                    jnp.asarray(np.stack([b[0] for b in onboard], axis=2)),
+                    jnp.asarray(np.stack([b[1] for b in onboard], axis=2)),
                 )
             except Exception:
                 self.allocator.release(sp.pages)

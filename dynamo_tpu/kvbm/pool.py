@@ -1,8 +1,8 @@
 """Host-DRAM and disk block pools (tiers G2/G3).
 
 Each pool maps ``sequence_hash -> (k_block, v_block)`` where a block is the
-KV content of one page across all layers: shape [L, page_size, kv_heads,
-head_dim]. Pools are byte-bounded with LRU eviction (ref: ManagedBlockPool
+KV content of one page across all layers, head-major: shape [L, kv_heads,
+page_size, head_dim]. Pools are byte-bounded with LRU eviction (ref: ManagedBlockPool
 active/inactive registries + sequence-hash reuse, block_manager/pool/
 managed.rs); the disk pool persists across restarts (ref: G3 local NVMe
 tier, block_manager.rs:62-74 CacheLevel).
@@ -89,7 +89,7 @@ class HostBlockPool:
 class DiskBlockPool:
     """Byte-bounded LRU of KV blocks on local disk; index survives restart.
 
-    One ``.npy``-pair file per block (stacked [2, L, page, kvh, D]); a
+    One ``.npy``-pair file per block (stacked [2, L, kvh, page, D]); a
     ``kvbm_index.json`` records hashes + LRU order. Thread-safe.
     """
 
