@@ -199,6 +199,36 @@ class InferenceEngine:
             yield {"token_ids": [], "finish_reason": "error",
                    "error": f"prompt exceeds max prefill {self.max_prefill_tokens}"}
             return
+        disagg = request.get("disagg") or {}
+        if disagg.get("mode") == "decode" and disagg.get("kv_transfer"):
+            # Stage the remote KV payload HERE (event loop, thread pool),
+            # before admission: _step awaits the admission thread, so a
+            # slow/hung transfer there would stall decode for every active
+            # slot. The reference keeps NIXL transfers off the scheduling
+            # path the same way (vllm/handlers.py kv_transfer_params flow).
+            from dynamo_tpu.disagg.transfer import (
+                pull_kv_blocks,
+                release_kv_blocks,
+            )
+
+            kvp = {
+                k: v for k, v in disagg["kv_transfer"].items()
+                if k != "first_token"
+            }
+            if self._decode_budget(request, len(token_ids)) <= 1:
+                # the remote-prefill token (already emitted by the handler)
+                # was the whole budget; don't pull KV we'd never use
+                await asyncio.to_thread(release_kv_blocks, kvp)
+                yield {"token_ids": [], "finish_reason": "length"}
+                return
+            try:
+                disagg["_staged_kv"] = await asyncio.to_thread(
+                    pull_kv_blocks, kvp
+                )
+            except Exception as e:  # noqa: BLE001
+                yield {"token_ids": [], "finish_reason": "error",
+                       "error": f"kv transfer pull failed: {e}"}
+                return
         out_q: asyncio.Queue = asyncio.Queue()
         await self._waiting.put(_Waiting(request, context, out_q))
         self._wake.set()
@@ -285,7 +315,14 @@ class InferenceEngine:
     def prefix_hit_tokens(self, token_ids: list[int]) -> int:
         """How many leading prompt tokens are locally cached — G1 device
         pages plus KVBM host/disk tiers the admission path can onboard from
-        (policy probe for conditional disagg)."""
+        (policy probe for conditional disagg).
+
+        Advisory and intentionally unlocked: called from the event-loop
+        thread while the step loop mutates the allocator/KVBM pools, so the
+        answer can be stale by the time it's used. That's fine for a
+        routing hint (the admission path re-checks under its own control);
+        a shared lock here would serialize routing against every decode
+        step."""
         seq = TokenBlockSequence.from_tokens(token_ids, self.config.page_size)
         hashes = seq.sequence_hashes()
         n = len(self.allocator.match_prefix(hashes))
@@ -575,7 +612,8 @@ class InferenceEngine:
 
         cfg = self.config
         req = waiting.request
-        kvp = dict((req.get("disagg") or {}).get("kv_transfer") or {})
+        disagg = req.get("disagg") or {}
+        kvp = dict(disagg.get("kv_transfer") or {})
         first_token = int(kvp.pop("first_token"))
         token_ids = list(req["token_ids"])
         max_tokens = self._decode_budget(req, len(token_ids))
@@ -586,7 +624,17 @@ class InferenceEngine:
             self._post(waiting.out_q, {"token_ids": [], "finish_reason": "length"})
             return
 
-        k_blocks, v_blocks, meta = pull_kv_blocks(kvp)  # blocking (thread)
+        # pop: the handler holds the request dict alive for the whole
+        # decode; leaving the payload here would pin the prompt KV in host
+        # RAM after it's installed into device pages
+        staged = disagg.pop("_staged_kv", None)
+        if staged is not None:
+            # generate() already pulled the payload off the step path
+            k_blocks, v_blocks, meta = staged
+        else:
+            # direct callers (tests, bypassing generate): blocking pull on
+            # this admission thread
+            k_blocks, v_blocks, meta = pull_kv_blocks(kvp)
         if int(meta.get("page_size", cfg.page_size)) != cfg.page_size:
             raise ValueError("page_size mismatch between prefill and decode")
 

@@ -125,6 +125,19 @@ class DiskBlockPool:
             if os.path.exists(self._path(sh)):
                 self._order[sh] = nbytes
                 self.used_bytes += nbytes
+        # the byte budget may have shrunk since the index was written:
+        # evict LRU entries until we fit
+        shrunk = False
+        while self.used_bytes > self.capacity_bytes and self._order:
+            esh, en = self._order.popitem(last=False)
+            self.used_bytes -= en
+            shrunk = True
+            try:
+                os.unlink(self._path(esh))
+            except OSError:
+                pass
+        if shrunk:
+            self._save_index()
 
     def _save_index(self) -> None:
         path = os.path.join(self.dir, self.INDEX)
