@@ -265,6 +265,7 @@ class InferenceEngine:
                         self._finish(i, slot, "error", error="engine step failure")
                 while not self._waiting.empty():
                     w = self._waiting.get_nowait()
+                    self._drop_staged_kv(w.request)
                     w.out_q.put_nowait(
                         {"token_ids": [], "finish_reason": "error",
                          "error": "engine step failure"}
@@ -280,6 +281,7 @@ class InferenceEngine:
         if free_idx is not None and not self._waiting.empty():
             waiting = self._waiting.get_nowait()
             if waiting.context.is_stopped:
+                self._drop_staged_kv(waiting.request)
                 waiting.out_q.put_nowait(
                     {"token_ids": [], "finish_reason": "cancelled"}
                 )
@@ -293,6 +295,16 @@ class InferenceEngine:
             await asyncio.to_thread(self._decode_step)
             did = True
         return did
+
+    @staticmethod
+    def _drop_staged_kv(request: dict[str, Any]) -> None:
+        """Free a pre-staged disagg KV payload for a request that will never
+        be admitted (cancel / step-loop failure): the handler keeps the
+        request dict alive for the stream's lifetime, so the multi-MB host
+        copy must be popped here, not left for GC."""
+        disagg = request.get("disagg")
+        if disagg:
+            disagg.pop("_staged_kv", None)
 
     # -- prefill (runs in thread) ------------------------------------------
 

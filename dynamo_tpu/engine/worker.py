@@ -41,6 +41,7 @@ async def launch_engine_worker(
     component: str = "backend",
     endpoint: str = "generate",
     model: str = "tiny-test",
+    model_path: str | None = None,
     model_name: str | None = None,
     tokenizer: str = "mock",
     engine_config: EngineConfig | None = None,
@@ -58,13 +59,29 @@ async def launch_engine_worker(
     The serving front door (engine or disagg handler) is attached as
     ``engine.frontdoor``.
     """
-    spec = spec or ModelSpec.preset(model)
     cfg = engine_config or EngineConfig()
     mesh = None
     if cfg.tp > 1 or cfg.dp > 1 or cfg.sp > 1 or cfg.ep > 1:
         from dynamo_tpu.parallel.mesh import make_mesh
 
         mesh = make_mesh(tp=cfg.tp, dp=cfg.dp, sp=cfg.sp, ep=cfg.ep)
+
+    params = None
+    if model_path:
+        # real checkpoint: spec comes from config.json, params stream from
+        # safetensors straight onto the mesh (ref local_model.rs:323 build)
+        if spec is not None:
+            raise ValueError(
+                "pass either spec= or model_path=, not both: with a "
+                "checkpoint the spec must come from its config.json"
+            )
+        from dynamo_tpu.models.loader import load_model_dir
+
+        spec, params = load_model_dir(model_path, mesh=mesh)
+        if tokenizer == "mock" and _has_tokenizer_files(model_path):
+            tokenizer = model_path
+    else:
+        spec = spec or ModelSpec.preset(model)
 
     transfer_source = None
     if mode == "prefill":
@@ -79,7 +96,8 @@ async def launch_engine_worker(
         kvbm = KvBlockManager(kvbm_config)
 
     engine = InferenceEngine(
-        spec, cfg, mesh=mesh, transfer_source=transfer_source, kvbm=kvbm
+        spec, cfg, mesh=mesh, params=params,
+        transfer_source=transfer_source, kvbm=kvbm,
     )
 
     if mode == "prefill":
@@ -166,6 +184,15 @@ async def _build_prefill_router(
     return await PushRouter.from_endpoint(ep, mode)
 
 
+def _has_tokenizer_files(model_path: str) -> bool:
+    import os
+
+    return any(
+        os.path.exists(os.path.join(model_path, f))
+        for f in ("tokenizer.json", "tokenizer_config.json", "tokenizer.model")
+    )
+
+
 def _kvbm_config_from_args(args: argparse.Namespace):
     if args.kvbm_host_mb <= 0:
         return None
@@ -198,6 +225,7 @@ async def _amain(args: argparse.Namespace) -> None:
         component=args.component,
         endpoint=args.endpoint,
         model=args.model,
+        model_path=args.model_path,
         model_name=args.model_name,
         tokenizer=args.tokenizer,
         engine_config=ecfg,
@@ -220,6 +248,9 @@ def main() -> None:
     p.add_argument("--component", default="backend")
     p.add_argument("--endpoint", default="generate")
     p.add_argument("--model", default="tiny-test", help="model preset name")
+    p.add_argument("--model-path", default=None,
+                   help="local checkpoint dir (config.json + *.safetensors); "
+                        "overrides --model")
     p.add_argument("--model-name", default=None, help="served model name")
     p.add_argument("--tokenizer", default="mock")
     p.add_argument("--page-size", type=int, default=16)

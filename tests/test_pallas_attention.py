@@ -89,3 +89,17 @@ def test_gqa_group_mapping():
     np.testing.assert_allclose(
         np.asarray(got), np.asarray(ref), rtol=2e-5, atol=2e-5
     )
+
+
+def test_lib_pages_per_compute_block():
+    """The real-TPU dispatch picks a page chunk that divides the per-seq
+    page count (library kernel requires P % ppcb == 0)."""
+    import jax.numpy as jnp
+
+    from dynamo_tpu.ops.attention import _lib_pages_per_compute_block
+
+    for P, want in ((16, 8), (8, 8), (12, 4), (6, 2), (5, 1), (4, 4), (1, 1)):
+        bt = jnp.zeros((2, P), jnp.int32)
+        got = _lib_pages_per_compute_block(bt)
+        assert got == want, (P, got, want)
+        assert P % got == 0
