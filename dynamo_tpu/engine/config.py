@@ -113,6 +113,10 @@ class EngineConfig:
     # batching
     max_decode_slots: int = 8  # concurrent sequences in the decode batch
     prefill_buckets: tuple[int, ...] = (64, 128, 256, 512, 1024, 2048, 4096)
+    # decode model steps fused per device dispatch (vLLM multi-step
+    # scheduling analogue): amortizes host dispatch + token sync; tokens
+    # stream in bursts of this size, EOS overshoot is discarded host-side
+    decode_steps_per_dispatch: int = 1
     # parallelism (mesh axes sizes; 1 = off)
     tp: int = 1
     dp: int = 1

@@ -697,6 +697,13 @@ class InferenceEngine:
     # -- decode (runs in thread) -------------------------------------------
 
     def _decode_step(self) -> None:
+        """One decode dispatch: ``decode_steps_per_dispatch`` model steps +
+        on-device sampling fused into a single jit call (host dispatch and
+        the device->host token sync amortize over the burst — the TPU
+        analogue of vLLM's multi-step scheduling). Tokens sampled past a
+        mid-burst EOS/stop are discarded host-side; their cache writes land
+        either on the trash page or in pages released when the slot
+        finishes."""
         cfg = self.config
         B = cfg.max_decode_slots
         tokens = np.zeros((B,), np.int32)
@@ -710,6 +717,14 @@ class InferenceEngine:
         steps = np.zeros((B,), np.int32)
 
         MAX_STALL = 2000  # steps a slot may wait for a free page
+        capacity = cfg.max_context
+
+        # burst size: bounded by every ready slot's room to the context cap
+        # (an overshooting position would clamp-index into a LIVE page)
+        n_burst = cfg.decode_steps_per_dispatch
+        for slot in self._slots:
+            if slot is not None and not slot.context.is_stopped:
+                n_burst = max(1, min(n_burst, capacity - slot.seq_len))
 
         for i, slot in enumerate(self._slots):
             if slot is None:
@@ -717,9 +732,13 @@ class InferenceEngine:
             if slot.context.is_stopped:
                 self._finish(i, slot, "cancelled")
                 continue
-            # ensure a page exists for the incoming token at position seq_len
-            page_needed = slot.seq_len // cfg.page_size
-            if page_needed >= slot.pages.num_pages:
+            # pages for every token this burst will EMIT (overshoot beyond
+            # ``remaining`` scatters to the trash page via the zero-padded
+            # block-table row)
+            need = min(slot.remaining, n_burst)
+            last_page = (slot.seq_len + need - 1) // cfg.page_size
+            stalled = False
+            while last_page >= slot.pages.num_pages:
                 try:
                     slot.pages.pages.append(self.allocator.alloc_page())
                     slot.pages.hashes.append(None)
@@ -729,7 +748,10 @@ class InferenceEngine:
                     slot.stalled_steps += 1
                     if slot.stalled_steps > MAX_STALL:
                         self._finish(i, slot, "error", error="kv pages exhausted")
-                    continue
+                    stalled = True
+                    break
+            if stalled:
+                continue
             slot.stalled_steps = 0
             active[i] = True
             tokens[i] = slot.last_token
@@ -744,7 +766,7 @@ class InferenceEngine:
         if not active.any():
             return
 
-        logits, self.k_pages, self.v_pages = llama.decode_forward(
+        sampled, self.k_pages, self.v_pages = llama.decode_steps(
             self.spec,
             self.params,
             jnp.asarray(tokens),
@@ -753,32 +775,75 @@ class InferenceEngine:
             self.k_pages,
             self.v_pages,
             jnp.asarray(active),
+            jnp.asarray(temps),
+            jnp.asarray(topk),
+            jnp.asarray(topp),
+            jnp.asarray(seeds),
+            jnp.asarray(steps),
+            n_steps=n_burst,
             mesh=self.mesh,
         )
-        sampled = np.asarray(
-            sample_tokens(
-                logits, jnp.asarray(temps), jnp.asarray(topk),
-                jnp.asarray(topp), jnp.asarray(seeds), jnp.asarray(steps),
-            )
-        )
-        self.steps += 1
+        sampled = np.asarray(sampled)  # [B, n_burst]
+        self.steps += n_burst
 
-        # seal + drain offloads BEFORE emit: _emit_token may finish a slot
-        # and release its pages, and a neighbor's later alloc could evict a
-        # just-sealed page before extraction reads it
+        # phase 1: decide per-slot emit counts, advance cache state, seal.
+        # Must fully precede phase 2: a finishing neighbor releases pages,
+        # and a later alloc could evict a just-sealed page before the
+        # offload extraction reads it.
+        burst: dict[int, tuple[list[int], str | None]] = {}
         for i, slot in enumerate(self._slots):
             if slot is None or not active[i]:
                 continue
-            slot.seq_len += 1  # the fed token is now in the cache
+            toks, finish = self._decide_burst(slot, sampled[i, :n_burst])
+            burst[i] = (toks, finish)
+            slot.seq_len += len(toks)  # the fed tokens are now in the cache
             self._maybe_seal(slot)
         self._drain_offload()
-        for i, slot in enumerate(self._slots):
-            if slot is None or not active[i]:
-                continue
-            self._emit_token(i, slot, int(sampled[i]))
 
-        if self.steps % 16 == 0:
+        # phase 2: stream tokens, finish slots
+        for i, (toks, finish) in burst.items():
+            slot = self._slots[i]
+            if finish is not None:
+                self._finish(i, slot, finish, emit=False)
+            self._post(slot.out_q, {"token_ids": toks, "finish_reason": finish})
+
+        if self.steps % 16 < n_burst:
             self._publish_metrics()
+
+    def _accept_token(self, slot: _Slot, tok: int) -> str | None:
+        """Record one sampled token on the slot; return its finish reason
+        (None = keep decoding). The single source of stop semantics for
+        both the prefill first token and decode bursts."""
+        slot.seq.append(tok)
+        slot.generated += 1
+        slot.remaining -= 1
+        slot.last_token = tok
+        if (
+            not slot.ignore_eos
+            and slot.generated >= slot.min_tokens
+            and tok in slot.eos_ids
+        ):
+            return "stop"
+        if tok in slot.stop_token_ids and slot.generated >= slot.min_tokens:
+            return "stop"
+        if slot.remaining <= 0:
+            return "length"
+        return None
+
+    def _decide_burst(
+        self, slot: _Slot, sampled: np.ndarray
+    ) -> tuple[list[int], str | None]:
+        """Apply stop conditions token-by-token over a sampled burst;
+        records accepted tokens on the slot and returns (tokens, finish)."""
+        toks: list[int] = []
+        finish: str | None = None
+        for tok in sampled:
+            tok = int(tok)
+            toks.append(tok)
+            finish = self._accept_token(slot, tok)
+            if finish is not None:
+                break
+        return toks, finish
 
     # -- helpers -----------------------------------------------------------
 
@@ -810,23 +875,7 @@ class InferenceEngine:
 
     def _emit_token(self, slot_idx: int, slot: _Slot, tok: int) -> None:
         """Record + stream one sampled token; place slot or finish."""
-        slot.seq.append(tok)
-        slot.generated += 1
-        slot.remaining -= 1
-        slot.last_token = tok
-
-        finish = None
-        if (
-            not slot.ignore_eos
-            and slot.generated >= slot.min_tokens
-            and tok in slot.eos_ids
-        ):
-            finish = "stop"
-        elif tok in slot.stop_token_ids and slot.generated >= slot.min_tokens:
-            finish = "stop"
-        elif slot.remaining <= 0:
-            finish = "length"
-
+        finish = self._accept_token(slot, tok)
         if finish is not None:
             # release resources BEFORE posting the finish item, so a client
             # observing the end of stream sees the engine's pages freed.

@@ -165,16 +165,6 @@ def _tree_get(tree: Params, path: tuple):
 # ------------------------------------------------------------------ load/save
 
 
-def _sharding_for(
-    spec: ModelSpec, mesh, path: tuple
-):
-    if mesh is None:
-        return None
-    from dynamo_tpu.models.llama import param_shardings
-
-    return _tree_get(param_shardings(spec, mesh), path)
-
-
 def load_params(
     spec: ModelSpec,
     model_dir: str,
@@ -206,11 +196,16 @@ def load_params(
     # MoE expert leaves accumulate per-expert then stack
     pending_experts: dict[tuple, dict[int, np.ndarray]] = {}
 
+    shardings = None
+    if mesh is not None:
+        from dynamo_tpu.models.llama import param_shardings
+
+        shardings = param_shardings(spec, mesh)
+
     def place(path: tuple, arr: np.ndarray, dt: str):
         x = jnp.asarray(arr, dtype=jnp.dtype(dt))
-        s = _sharding_for(spec, mesh, path)
-        if s is not None:
-            x = jax.device_put(x, s)
+        if shardings is not None:
+            x = jax.device_put(x, _tree_get(shardings, path))
         _tree_set(params, path, x)
 
     for path_file in files:
