@@ -117,6 +117,11 @@ class EngineConfig:
     # scheduling analogue): amortizes host dispatch + token sync; tokens
     # stream in bursts of this size, EOS overshoot is discarded host-side
     decode_steps_per_dispatch: int = 1
+    # chunked prefill (ref: vLLM max_num_batched_tokens pass-through):
+    # prompts whose uncached tail exceeds this run as a sequence of
+    # chunk-sized prefill steps interleaved with decode, so one long
+    # admission cannot stall every decoding stream for a whole forward
+    max_prefill_chunk_tokens: int = 512
     # parallelism (mesh axes sizes; 1 = off)
     tp: int = 1
     dp: int = 1

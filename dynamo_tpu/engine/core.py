@@ -66,6 +66,21 @@ class _Waiting:
     out_q: asyncio.Queue
 
 
+@dataclass
+class _PartialPrefill:
+    """A long prompt mid-way through chunked prefill (ref: vLLM's
+    max_num_batched_tokens chunking — here the engine owns the loop, so
+    chunks interleave with decode steps explicitly)."""
+
+    slot_idx: int
+    waiting: _Waiting
+    seq: TokenBlockSequence
+    sp: SeqPages
+    token_ids: list[int]
+    done: int  # prompt tokens already in the KV cache
+    max_tokens: int
+
+
 class InferenceEngine:
     def __init__(
         self,
@@ -128,10 +143,7 @@ class InferenceEngine:
         self._wake = asyncio.Event()
         self._closed = False
         self.steps = 0
-        # largest prompt the engine accepts in one prefill
-        self.max_prefill_tokens = min(
-            self.config.prefill_buckets[-1], self.config.max_context
-        )
+        self._partial: _PartialPrefill | None = None
 
     # -- events ------------------------------------------------------------
 
@@ -195,10 +207,6 @@ class InferenceEngine:
             yield {"token_ids": [], "finish_reason": "error",
                    "error": f"prompt exceeds max context {self.config.max_context}"}
             return
-        if len(token_ids) > self.max_prefill_tokens:
-            yield {"token_ids": [], "finish_reason": "error",
-                   "error": f"prompt exceeds max prefill {self.max_prefill_tokens}"}
-            return
         disagg = request.get("disagg") or {}
         if disagg.get("mode") == "decode" and disagg.get("kv_transfer"):
             # Stage the remote KV payload HERE (event loop, thread pool),
@@ -248,7 +256,11 @@ class InferenceEngine:
                 did_work = await self._step()
                 if not did_work:
                     self._wake.clear()
-                    if self._waiting.empty() and not any(self._slots):
+                    if (
+                        self._waiting.empty()
+                        and not any(self._slots)
+                        and self._partial is None
+                    ):
                         await self._wake.wait()
                     else:
                         await asyncio.sleep(self.config.step_idle_sleep_s)
@@ -260,6 +272,13 @@ class InferenceEngine:
                 log.exception("engine step failed; failing in-flight requests")
                 # queued offloads may reference pages about to be released
                 self._pending_offload.clear()
+                if self._partial is not None:
+                    p, self._partial = self._partial, None
+                    self.allocator.release(p.sp.pages)
+                    p.waiting.out_q.put_nowait(
+                        {"token_ids": [], "finish_reason": "error",
+                         "error": "engine step failure"}
+                    )
                 for i, slot in enumerate(self._slots):
                     if slot is not None:
                         self._finish(i, slot, "error", error="engine step failure")
@@ -274,21 +293,28 @@ class InferenceEngine:
 
     async def _step(self) -> bool:
         did = False
-        # 1) admit one waiting request into a free slot (prefill)
-        free_idx = next(
-            (i for i, s in enumerate(self._slots) if s is None), None
-        )
-        if free_idx is not None and not self._waiting.empty():
-            waiting = self._waiting.get_nowait()
-            if waiting.context.is_stopped:
-                self._drop_staged_kv(waiting.request)
-                waiting.out_q.put_nowait(
-                    {"token_ids": [], "finish_reason": "cancelled"}
-                )
-            else:
-                await asyncio.to_thread(self._prefill_safe, free_idx, waiting)
+        # 1) advance an in-flight chunked prefill, or admit one waiting
+        # request (prefill); either way decode still runs below, so a long
+        # prompt only ever steals one chunk's worth of device time per step
+        if self._partial is not None:
+            await asyncio.to_thread(self._advance_partial_safe)
             did = True
             self._publish_metrics()
+        else:
+            free_idx = next(
+                (i for i, s in enumerate(self._slots) if s is None), None
+            )
+            if free_idx is not None and not self._waiting.empty():
+                waiting = self._waiting.get_nowait()
+                if waiting.context.is_stopped:
+                    self._drop_staged_kv(waiting.request)
+                    waiting.out_q.put_nowait(
+                        {"token_ids": [], "finish_reason": "cancelled"}
+                    )
+                else:
+                    await asyncio.to_thread(self._prefill_safe, free_idx, waiting)
+                did = True
+                self._publish_metrics()
 
         # 2) one decode step over active slots
         if any(s is not None for s in self._slots):
@@ -518,6 +544,10 @@ class InferenceEngine:
             & 0xFFFFFFFF,
         )
 
+    def _prefill_chunk_max(self) -> int:
+        cfg = self.config
+        return min(cfg.max_prefill_chunk_tokens, cfg.prefill_buckets[-1])
+
     def _prefill(self, slot_idx: int, waiting: _Waiting) -> None:
         cfg = self.config
         req = waiting.request
@@ -539,22 +569,43 @@ class InferenceEngine:
             )
             return
         start_pos = sp.cached_prefix_pages * cfg.page_size
+        tail = len(token_ids) - start_pos
 
-        new_tokens = token_ids[start_pos:]
-        bucket = cfg.bucket_for(len(new_tokens))
-        padded = np.zeros((bucket,), np.int32)
-        padded[: len(new_tokens)] = new_tokens
-        block_table = np.zeros((cfg.max_pages_per_seq,), np.int32)
-        block_table[: sp.num_pages] = sp.pages
+        try:
+            self._prefill_with_pages(
+                slot_idx, waiting, seq, sp, token_ids, max_tokens,
+                start_pos, tail,
+            )
+        except BaseException:
+            # anything after acquisition failing must hand the pages back
+            # (handed-off paths clear sp.pages first, so this is a no-op
+            # once ownership moved to a slot/export)
+            self.allocator.release(sp.pages)
+            sp.pages = []
+            raise
 
+    def _prefill_with_pages(
+        self, slot_idx, waiting, seq, sp, token_ids, max_tokens,
+        start_pos, tail,
+    ) -> None:
+        cfg = self.config
         use_ring = (
             self.mesh is not None
             and self.mesh.shape.get("sp", 1) > 1
             and start_pos == 0
-            and bucket % self.mesh.shape["sp"] == 0
+            and tail <= cfg.prefill_buckets[-1]
+            and cfg.bucket_for(tail) % self.mesh.shape["sp"] == 0
         )
         if use_ring:
-            # cold long prompt: sequence-parallel ring-attention prefill
+            # cold long prompt: sequence-parallel ring-attention prefill —
+            # the whole prompt in one shot, split across the sp axis (the
+            # multi-chip answer to long prefills; chunking is the
+            # single-chip one)
+            bucket = cfg.bucket_for(tail)
+            padded = np.zeros((bucket,), np.int32)
+            padded[:tail] = token_ids[start_pos:]
+            block_table = np.zeros((cfg.max_pages_per_seq,), np.int32)
+            block_table[: sp.num_pages] = sp.pages
             logits, self.k_pages, self.v_pages = llama.prefill_forward_ring(
                 self.spec,
                 self.params,
@@ -562,21 +613,99 @@ class InferenceEngine:
                 jnp.asarray(block_table),
                 self.k_pages,
                 self.v_pages,
-                jnp.asarray(len(new_tokens), jnp.int32),
+                jnp.asarray(tail, jnp.int32),
                 mesh=self.mesh,
             )
+            self._finish_prefill(
+                slot_idx, waiting, seq, sp, token_ids, max_tokens, logits
+            )
+            return
+
+        chunk_max = self._prefill_chunk_max()
+        end = min(start_pos + chunk_max, len(token_ids))
+        logits = self._run_prefill_chunk(sp, token_ids, start_pos, end)
+        if end == len(token_ids):
+            self._finish_prefill(
+                slot_idx, waiting, seq, sp, token_ids, max_tokens, logits
+            )
         else:
-            logits, self.k_pages, self.v_pages = llama.prefill_forward(
-                self.spec,
-                self.params,
-                jnp.asarray(padded),
-                jnp.asarray(block_table),
-                jnp.asarray(start_pos, jnp.int32),
-                self.k_pages,
-                self.v_pages,
-                jnp.asarray(len(new_tokens), jnp.int32),
+            # long prompt: remaining chunks advance on subsequent steps,
+            # interleaved with decode (_step)
+            self._partial = _PartialPrefill(
+                slot_idx, waiting, seq, sp, token_ids, end, max_tokens
             )
 
+    def _run_prefill_chunk(
+        self, sp: SeqPages, token_ids: list[int], start: int, end: int
+    ) -> jax.Array:
+        """One bucketed prefill forward over token positions [start, end)."""
+        cfg = self.config
+        new_tokens = token_ids[start:end]
+        bucket = cfg.bucket_for(len(new_tokens))
+        padded = np.zeros((bucket,), np.int32)
+        padded[: len(new_tokens)] = new_tokens
+        block_table = np.zeros((cfg.max_pages_per_seq,), np.int32)
+        block_table[: sp.num_pages] = sp.pages
+        logits, self.k_pages, self.v_pages = llama.prefill_forward(
+            self.spec,
+            self.params,
+            jnp.asarray(padded),
+            jnp.asarray(block_table),
+            jnp.asarray(start, jnp.int32),
+            self.k_pages,
+            self.v_pages,
+            jnp.asarray(len(new_tokens), jnp.int32),
+        )
+        return logits
+
+    def _advance_partial_safe(self) -> None:
+        p = self._partial
+        try:
+            self._advance_partial()
+        except Exception as e:  # noqa: BLE001
+            log.exception("chunked prefill failed for %s", p.waiting.context.id)
+            self._partial = None
+            self.allocator.release(p.sp.pages)
+            self._post(
+                p.waiting.out_q,
+                {"token_ids": [], "finish_reason": "error",
+                 "error": f"prefill failed: {e}"},
+            )
+
+    def _advance_partial(self) -> None:
+        """Run the next chunk of the in-flight chunked prefill."""
+        p = self._partial
+        assert p is not None
+        if p.waiting.context.is_stopped:
+            self._partial = None
+            self.allocator.release(p.sp.pages)
+            self._post(
+                p.waiting.out_q, {"token_ids": [], "finish_reason": "cancelled"}
+            )
+            self._publish_metrics()
+            return
+        end = min(p.done + self._prefill_chunk_max(), len(p.token_ids))
+        logits = self._run_prefill_chunk(p.sp, p.token_ids, p.done, end)
+        p.done = end
+        if end == len(p.token_ids):
+            self._partial = None
+            self._finish_prefill(
+                p.slot_idx, p.waiting, p.seq, p.sp, p.token_ids,
+                p.max_tokens, logits,
+            )
+
+    def _finish_prefill(
+        self,
+        slot_idx: int,
+        waiting: _Waiting,
+        seq: TokenBlockSequence,
+        sp: SeqPages,
+        token_ids: list[int],
+        max_tokens: int,
+        logits: jax.Array,
+    ) -> None:
+        """Common prefill tail: seal pages, sample first token, enter decode
+        (or hand off KV for disagg prefill workers)."""
         # seal prompt pages whose block is complete (skip already-cached)
         self._seal_prompt_blocks(sp, seq)
         self._drain_offload()
@@ -588,7 +717,7 @@ class InferenceEngine:
 
         # sample the first token from prefill logits
         tok = self._sample_single(logits, slot)
-        disagg = req.get("disagg") or {}
+        disagg = waiting.request.get("disagg") or {}
         if (
             (disagg.get("kv_transfer") or {}).get("do_remote_decode")
             and self.transfer_source is not None
@@ -610,7 +739,8 @@ class InferenceEngine:
             num_tokens=len(token_ids),
             page_size=self.config.page_size,
         )
-        self.allocator.release(sp.pages)
+        pages, sp.pages = sp.pages, []  # ownership ends here (see _prefill)
+        self.allocator.release(pages)
         self._post(
             slot.out_q,
             {"token_ids": [tok], "finish_reason": "length",
@@ -895,6 +1025,7 @@ class InferenceEngine:
             if error:
                 item["error"] = error
             self._post(slot.out_q, item)
-        self.allocator.release(slot.pages.pages)
+        pages, slot.pages.pages = slot.pages.pages, []
+        self.allocator.release(pages)
         self._slots[slot_idx] = None
         self._publish_metrics()

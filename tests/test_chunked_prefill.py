@@ -1,0 +1,123 @@
+"""Chunked prefill: long admissions must not stall decode (VERDICT r1 #5).
+
+The reference passes max_num_batched_tokens through to its engines; our
+engine owns the step loop, so the chunking is explicit: a prompt whose
+uncached tail exceeds max_prefill_chunk_tokens runs as N chunk steps
+interleaved with decode steps (engine/core.py _advance_partial)."""
+
+import asyncio
+
+import numpy as np
+
+from dynamo_tpu.engine.config import EngineConfig, ModelSpec
+from dynamo_tpu.engine.core import InferenceEngine
+from dynamo_tpu.runtime.context import Context
+
+SPEC = ModelSpec(
+    name="chunk-test", vocab_size=272, hidden_size=32,
+    intermediate_size=64, num_layers=2, num_heads=4, num_kv_heads=2,
+    head_dim=8, dtype="float32",
+)
+
+
+def _cfg(chunk: int) -> EngineConfig:
+    return EngineConfig(
+        page_size=4, num_pages=128, max_pages_per_seq=32,
+        max_decode_slots=2, prefill_buckets=(16, 32, 64, 128),
+        max_prefill_chunk_tokens=chunk,
+    )
+
+
+async def _collect(engine, prompt, max_tokens, sink=None, tag=None):
+    out = []
+    async for item in engine.generate(
+        {"token_ids": list(prompt),
+         "stop_conditions": {"max_tokens": max_tokens, "ignore_eos": True},
+         "sampling": {"temperature": 0.0}},
+        Context(),
+    ):
+        out.extend(item["token_ids"])
+        if sink is not None:
+            sink.extend([tag] * len(item["token_ids"]))
+    return out
+
+
+async def test_chunked_matches_single_shot():
+    """Greedy output identical whether the prompt prefills in 1 shot or in
+    4 chunks (and the prefix cache sees identical sealed blocks)."""
+    prompt = list(np.arange(60) % 250 + 16)
+
+    e1 = InferenceEngine(SPEC, _cfg(chunk=128))
+    await e1.start()
+    want = await _collect(e1, prompt, 6)
+    await e1.close()
+
+    e2 = InferenceEngine(SPEC, _cfg(chunk=16))
+    await e2.start()
+    got = await _collect(e2, prompt, 6)
+    assert got == want
+    # run it again: the chunked prompt's sealed pages must serve as prefix
+    got2 = await _collect(e2, prompt, 6)
+    assert got2 == want
+    assert e2.allocator.active_pages == 0
+    await e2.close()
+
+
+async def test_decode_progress_during_long_prefill():
+    """While a 64-token prompt prefills in 16-token chunks, an already-
+    decoding stream keeps emitting (bounded ITL) instead of stalling for
+    the whole admission."""
+    engine = InferenceEngine(SPEC, _cfg(chunk=16))
+    await engine.start()
+    order: list[str] = []
+
+    a = asyncio.create_task(
+        _collect(engine, [5, 9, 13], 40, sink=order, tag="A")
+    )
+    # let A enter steady decode
+    while order.count("A") < 4:
+        await asyncio.sleep(0.01)
+    long_prompt = list(np.arange(64) % 250 + 16)
+    b = asyncio.create_task(
+        _collect(engine, long_prompt, 4, sink=order, tag="B")
+    )
+    out_a, out_b = await asyncio.gather(a, b)
+    assert len(out_a) == 40 and len(out_b) == 4
+
+    # decode tokens must interleave between B's admission and B's first
+    # token: find the window from B's submission (approximated by the
+    # first A token after b started... use the tail before first B)
+    first_b = order.index("B")
+    # B's prefill spans 4 chunk steps; each interleaves a decode step, so
+    # at least 2 A-tokens must land in the 6 positions before B's first
+    window = order[max(0, first_b - 6) : first_b]
+    assert window.count("A") >= 2, order
+    await engine.close()
+
+
+async def test_chunked_prefill_cancel_mid_flight():
+    """Cancelling during chunked prefill releases pages and reports
+    cancelled."""
+    engine = InferenceEngine(SPEC, _cfg(chunk=16))
+    await engine.start()
+    ctx = Context()
+    long_prompt = list(np.arange(96) % 250 + 16)
+
+    async def run():
+        items = []
+        async for item in engine.generate(
+            {"token_ids": long_prompt,
+             "stop_conditions": {"max_tokens": 8, "ignore_eos": True}},
+            ctx,
+        ):
+            items.append(item)
+        return items
+
+    task = asyncio.create_task(run())
+    await asyncio.sleep(0.03)  # let a chunk or two run
+    ctx.stop_generating()
+    items = await task
+    assert items[-1]["finish_reason"] in ("cancelled", "stop", "length")
+    # all pages back (cache may retain sealed prefix pages; active = 0)
+    assert engine.allocator.active_pages == 0
+    await engine.close()
