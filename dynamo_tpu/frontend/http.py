@@ -75,6 +75,13 @@ class HttpFrontend:
         self._m_tokens = m.counter(
             "output_tokens_total", "generated tokens", ["model"]
         )
+        self._m_input_tokens = m.counter(
+            "input_tokens_total", "prompt tokens", ["model"]
+        )
+        self._m_completed = m.counter(
+            "requests_completed_total",
+            "generation requests that reached the backend", ["model"],
+        )
         self._m_inflight = m.gauge(
             "inflight_requests", "in-flight requests", ["model"]
         )
@@ -163,6 +170,7 @@ class HttpFrontend:
                 )
                 resp = await self._sse(request, pp, ctx)
                 self._m_requests.labels(model, route, "200").inc()
+                self._mark_completed(model, prompt_tokens)
                 return resp
             else:
                 agg = (
@@ -175,6 +183,7 @@ class HttpFrontend:
                     )
                 )
                 self._m_requests.labels(model, route, "200").inc()
+                self._mark_completed(model, prompt_tokens)
                 return web.json_response(agg)
         except Exception as e:  # noqa: BLE001
             log.exception("request %s failed", ctx.id)
@@ -184,6 +193,14 @@ class HttpFrontend:
         finally:
             self._m_inflight.labels(model).dec()
             self._m_duration.labels(model).observe(time.monotonic() - t_start)
+
+    def _mark_completed(self, model: str, prompt_tokens: int) -> None:
+        """ISL/OSL averages for the SLA planner: counted only when the
+        stream actually finished (output tokens accumulate in
+        _timed_stream), so isl = input_tokens / requests_completed and
+        osl = output_tokens / requests_completed line up per interval."""
+        self._m_input_tokens.labels(model).inc(prompt_tokens)
+        self._m_completed.labels(model).inc()
 
     async def _timed_stream(self, deltas, model: str, t_start: float):
         """Wrap the backend stream with TTFT/ITL/token metrics."""

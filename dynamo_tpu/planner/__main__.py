@@ -1,0 +1,143 @@
+"""``python -m dynamo_tpu.planner`` — SLA planner service.
+
+Reference: ``python -m dynamo.planner`` (planner_sla.py). Scrapes the
+frontend's /metrics, plans every --adjustment-interval, and publishes
+desired replica counts to the hub (virtual connector) for a supervisor to
+act on. ``--dryrun-trace`` replays a JSONL trace of
+{num_req, isl, osl[, ttft, itl]} records instead and prints decisions.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import sys
+
+import numpy as np
+
+from dynamo_tpu.planner.connector import LoggingConnector, VirtualConnector
+from dynamo_tpu.planner.core import (
+    FrontendMetricsSource,
+    PlannerConfig,
+    SlaPlanner,
+)
+from dynamo_tpu.planner.interpolation import (
+    DecodeInterpolator,
+    PrefillInterpolator,
+    synthetic_profile,
+)
+from dynamo_tpu.runtime.config import RuntimeConfig
+from dynamo_tpu.runtime.logging_util import setup_logging
+
+
+def build_planner(args, hub=None) -> SlaPlanner:
+    if args.profile_dir:
+        prefill = PrefillInterpolator(args.profile_dir)
+        decode = DecodeInterpolator(args.profile_dir)
+    else:
+        prof = synthetic_profile()
+        prefill = PrefillInterpolator(prof)
+        decode = DecodeInterpolator(prof)
+    cfg = PlannerConfig(
+        namespace=args.namespace,
+        model=args.model,
+        ttft_sla_s=args.ttft,
+        itl_sla_s=args.itl,
+        adjustment_interval_s=args.adjustment_interval,
+        predictor=args.load_predictor,
+        min_endpoint=args.min_endpoint,
+        max_chip_budget=args.max_chip_budget,
+        prefill_engine_num_chips=args.prefill_engine_num_chips,
+        decode_engine_num_chips=args.decode_engine_num_chips,
+        no_correction=args.no_correction,
+        decode_component=args.decode_component,
+        prefill_component=args.prefill_component,
+    )
+    connector = (
+        VirtualConnector(hub, cfg.namespace, cfg.model)
+        if hub is not None and not args.no_operation
+        else LoggingConnector()
+    )
+    source = (
+        FrontendMetricsSource(args.metrics_url, cfg.model)
+        if args.metrics_url
+        else None
+    )
+
+    worker_counts = None
+    if hub is not None:
+        async def worker_counts():
+            p = await hub.get_prefix(
+                f"v1/instances/{cfg.namespace}/{cfg.prefill_component}/"
+            )
+            d = await hub.get_prefix(
+                f"v1/instances/{cfg.namespace}/{cfg.decode_component}/"
+            )
+            return len(p), len(d)
+
+    return SlaPlanner(
+        cfg, prefill, decode, connector=connector,
+        metrics_source=source, worker_counts=worker_counts,
+    )
+
+
+async def _amain(args) -> None:
+    if args.dryrun_trace:
+        planner = build_planner(args)
+        trace = [
+            json.loads(line)
+            for line in open(args.dryrun_trace)
+            if line.strip()
+        ]
+        decisions = await planner.dryrun(trace)
+        for i, (p, d) in enumerate(decisions):
+            print(json.dumps({"interval": i, "prefill": p, "decode": d}))
+        return
+
+    from dynamo_tpu.runtime.hub_client import connect_hub
+
+    rcfg = RuntimeConfig.from_env()
+    if args.hub:
+        rcfg.hub_address = args.hub
+    hub = await connect_hub(rcfg.hub_address)
+    planner = build_planner(args, hub=hub)
+    print("PLANNER_READY", flush=True)
+    await planner.run()
+
+
+def main() -> None:
+    p = argparse.ArgumentParser(description="dynamo-tpu SLA planner")
+    p.add_argument("--hub", default=None)
+    p.add_argument("--namespace", default="dynamo")
+    p.add_argument("--model", default=None)
+    p.add_argument("--metrics-url", default="http://127.0.0.1:8000/metrics")
+    p.add_argument("--ttft", type=float, default=0.5, help="TTFT SLA (s)")
+    p.add_argument("--itl", type=float, default=0.05, help="ITL SLA (s)")
+    p.add_argument("--adjustment-interval", type=float, default=60.0)
+    p.add_argument("--load-predictor", default="ar",
+                   choices=["constant", "ar", "arima", "holt", "prophet"])
+    p.add_argument("--min-endpoint", type=int, default=1)
+    p.add_argument("--max-chip-budget", type=int, default=64)
+    p.add_argument("--prefill-engine-num-chips", type=int, default=1)
+    p.add_argument("--decode-engine-num-chips", type=int, default=1)
+    p.add_argument("--no-correction", action="store_true")
+    p.add_argument("--no-operation", action="store_true",
+                   help="log decisions without writing to the hub")
+    p.add_argument("--prefill-component", default="prefill")
+    p.add_argument("--decode-component", default="backend")
+    p.add_argument("--profile-dir", default=None,
+                   help="pre-deployment profiling npz dir (default: "
+                        "synthetic analytic profile)")
+    p.add_argument("--dryrun-trace", default=None,
+                   help="JSONL trace to replay without a cluster")
+    args = p.parse_args()
+    setup_logging()
+    try:
+        asyncio.run(_amain(args))
+    except KeyboardInterrupt:
+        pass
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,77 @@
+"""Planner connectors: where replica decisions go.
+
+Reference analogues: ``VirtualConnector`` (lib/bindings planner.rs — writes
+desired counts to etcd for tests/external orchestrators) and
+``KubernetesConnector`` (kubernetes_connector.py — patches
+DynamoGraphDeployment replicas). Here the virtual connector writes a JSON
+document to the hub KV at ``v1/planner/{namespace}/desired``; whatever
+supervises workers (tests, a process manager, a future K8s operator)
+watches that key and converges actual to desired.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from dataclasses import asdict, dataclass
+
+log = logging.getLogger("dynamo.planner.connector")
+
+DESIRED_KEY = "v1/planner/{namespace}/desired"
+
+
+@dataclass
+class DesiredReplicas:
+    prefill: int
+    decode: int
+    revision: int = 0
+    updated_at: float = 0.0
+    model: str | None = None
+
+
+class LoggingConnector:
+    """No-op connector (reference --no-operation): decisions only logged;
+    also keeps the last decision for inspection."""
+
+    def __init__(self) -> None:
+        self.history: list[DesiredReplicas] = []
+
+    async def set_replicas(self, desired: DesiredReplicas) -> None:
+        self.history.append(desired)
+        log.info(
+            "planner decision (no-op): prefill=%d decode=%d",
+            desired.prefill, desired.decode,
+        )
+
+
+class VirtualConnector:
+    """Write desired replica counts to the hub KV, revisioned."""
+
+    def __init__(self, hub, namespace: str, model: str | None = None):
+        self.hub = hub
+        self.namespace = namespace
+        self.model = model
+        self.revision = 0
+
+    @property
+    def key(self) -> str:
+        return DESIRED_KEY.format(namespace=self.namespace)
+
+    async def set_replicas(self, desired: DesiredReplicas) -> None:
+        self.revision += 1
+        desired.revision = self.revision
+        desired.updated_at = time.time()
+        desired.model = desired.model or self.model
+        await self.hub.put(self.key, asdict(desired))
+        log.info(
+            "planner desired replicas -> %s: prefill=%d decode=%d (rev %d)",
+            self.key, desired.prefill, desired.decode, self.revision,
+        )
+
+
+async def read_desired_replicas(hub, namespace: str) -> DesiredReplicas | None:
+    """Supervisor-side helper: current desired counts, or None."""
+    raw = await hub.get(DESIRED_KEY.format(namespace=namespace))
+    if raw is None:
+        return None
+    return DesiredReplicas(**raw)

@@ -1,0 +1,369 @@
+"""SLA planner core loop.
+
+Behavioral parity with the reference planner
+(components/src/dynamo/planner/utils/planner_core.py:61-472):
+
+  observe_metrics (:241)  -> scrape the frontend's Prometheus exposition
+                             and form interval averages (TTFT, ITL, req
+                             rate, ISL, OSL, request duration)
+  correction factors      -> observed TTFT / interpolated TTFT (queueing
+                             shows up here), observed ITL / interpolated
+                             ITL at current concurrency
+  predict_load (:294)     -> per-signal one-step forecasts (predictor.py)
+  _compute_replica_requirements (:313)
+                          -> prefill: predicted prefill tokens/s divided
+                             by profiled per-chip prefill throughput,
+                             dampened by min(1, p_correction);
+                             decode: invert the profiled (ITL, context) ->
+                             throughput surface at the corrected ITL SLA
+                          -> clamp to min endpoints, scale into the chip
+                             budget
+  make_adjustments (:409) -> connector.set_replicas
+
+Differences by design: metrics come straight from the frontend ``/metrics``
+endpoint (no external Prometheus server), and the interpolators run on
+regular grids emitted by our own profiler. ``dryrun`` replays a recorded
+trace of (num_req, isl, osl) without any cluster, mirroring
+``planner_sla_dryrun`` testing.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import math
+import time
+from dataclasses import dataclass, field
+from typing import Any, Awaitable, Callable
+
+from dynamo_tpu.planner.connector import DesiredReplicas, LoggingConnector
+from dynamo_tpu.planner.interpolation import (
+    DecodeInterpolator,
+    PrefillInterpolator,
+)
+from dynamo_tpu.planner.predictor import make_predictor
+
+log = logging.getLogger("dynamo.planner")
+
+
+@dataclass
+class Metrics:
+    """Interval averages observed from the serving frontend."""
+
+    ttft: float | None = None  # seconds
+    itl: float | None = None  # seconds
+    num_req: float | None = None  # requests in the interval
+    isl: float | None = None  # avg input tokens
+    osl: float | None = None  # avg output tokens
+    request_duration: float | None = None  # seconds
+
+    def is_valid(self) -> bool:
+        need = (self.ttft, self.itl, self.isl, self.osl)
+        return all(v is not None and not math.isnan(v) for v in need)
+
+
+@dataclass
+class PlannerConfig:
+    namespace: str = "dynamo"
+    model: str | None = None  # None = aggregate over all models
+    ttft_sla_s: float = 0.5
+    itl_sla_s: float = 0.05
+    adjustment_interval_s: float = 60.0
+    predictor: str = "ar"
+    prediction_window: int = 128
+    min_endpoint: int = 1
+    max_chip_budget: int = 64
+    prefill_engine_num_chips: int = 1
+    decode_engine_num_chips: int = 1
+    no_correction: bool = False
+    decode_component: str = "backend"
+    prefill_component: str = "prefill"
+
+
+# ---------------------------------------------------------------- scraping
+
+
+def parse_prometheus_text(text: str) -> dict[tuple[str, tuple], float]:
+    """Prometheus exposition text -> {(sample name, sorted label items):
+    value}, via prometheus_client's own parser (the library that generates
+    the exposition also parses its edge cases — escapes, NaN/Inf)."""
+    from prometheus_client.parser import text_string_to_metric_families
+
+    out: dict[tuple[str, tuple], float] = {}
+    for family in text_string_to_metric_families(text):
+        for sample in family.samples:
+            out[(sample.name, tuple(sorted(sample.labels.items())))] = (
+                sample.value
+            )
+    return out
+
+
+class FrontendMetricsSource:
+    """Interval averages from successive scrapes of a frontend /metrics URL.
+
+    Counters/histogram sums are cumulative; the interval view is the delta
+    between consecutive scrapes (the same windowing the reference gets
+    from PromQL range queries)."""
+
+    SUMS = {
+        "ttft": "dynamo_time_to_first_token_seconds",
+        "itl": "dynamo_inter_token_latency_seconds",
+        "duration": "dynamo_request_duration_seconds",
+    }
+
+    def __init__(self, url: str, model: str | None = None):
+        self.url = url
+        self.model = model
+        self._prev: dict[tuple[str, tuple], float] | None = None
+
+    async def fetch_text(self) -> str:
+        import aiohttp
+
+        async with aiohttp.ClientSession() as sess:
+            async with sess.get(self.url) as resp:
+                return await resp.text()
+
+    def _sum(self, snap: dict, name: str) -> float:
+        total = 0.0
+        for (metric, labels), v in snap.items():
+            if metric != name:
+                continue
+            if self.model is not None and ("model", self.model) not in labels:
+                continue
+            total += v
+        return total
+
+    def _delta(self, snap: dict, name: str) -> float:
+        prev = self._sum(self._prev, name) if self._prev else 0.0
+        return self._sum(snap, name) - prev
+
+    async def observe(self) -> Metrics:
+        snap = parse_prometheus_text(await self.fetch_text())
+        m = Metrics()
+        if self._prev is not None:
+            def ratio(num, den):
+                return num / den if den > 0 else float("nan")
+
+            n_completed = self._delta(snap, "dynamo_requests_completed_total")
+            m.num_req = n_completed
+            m.isl = ratio(
+                self._delta(snap, "dynamo_input_tokens_total"), n_completed
+            )
+            m.osl = ratio(
+                self._delta(snap, "dynamo_output_tokens_total"), n_completed
+            )
+            for attr, base in self.SUMS.items():
+                s = self._delta(snap, base + "_sum")
+                c = self._delta(snap, base + "_count")
+                val = ratio(s, c)
+                if attr == "duration":
+                    m.request_duration = val
+                else:
+                    setattr(m, attr, val)
+        self._prev = snap
+        return m
+
+
+# ------------------------------------------------------------------ planner
+
+
+class SlaPlanner:
+    def __init__(
+        self,
+        config: PlannerConfig,
+        prefill_interpolator: PrefillInterpolator,
+        decode_interpolator: DecodeInterpolator,
+        *,
+        connector=None,
+        metrics_source=None,
+        worker_counts: Callable[[], Awaitable[tuple[int, int]]] | None = None,
+    ):
+        self.cfg = config
+        self.prefill = prefill_interpolator
+        self.decode = decode_interpolator
+        self.connector = connector or LoggingConnector()
+        self.metrics_source = metrics_source
+        self.worker_counts = worker_counts
+        self.p_correction = 1.0
+        self.d_correction = 1.0
+        self.last_metrics = Metrics()
+        w = config.prediction_window
+        self.pred_num_req = make_predictor(config.predictor, w)
+        self.pred_isl = make_predictor(config.predictor, w)
+        self.pred_osl = make_predictor(config.predictor, w)
+        self.decisions: list[DesiredReplicas] = []
+        self._task: asyncio.Task | None = None
+
+    # -- observation -------------------------------------------------------
+
+    def ingest(self, m: Metrics) -> None:
+        """Feed one interval of observed metrics (live scrape or dryrun)."""
+        self.last_metrics = m
+        self.pred_num_req.observe(m.num_req if m.num_req is not None else 0.0)
+        self.pred_isl.observe(m.isl if m.isl is not None else 0.0)
+        self.pred_osl.observe(m.osl if m.osl is not None else 0.0)
+
+    async def observe_metrics(self) -> None:
+        if self.metrics_source is None:
+            raise RuntimeError("no metrics source configured")
+        self.ingest(await self.metrics_source.observe())
+
+    # -- correction --------------------------------------------------------
+
+    def update_corrections(self, num_decode_workers: int) -> None:
+        """observed/expected ratios (ref planner_core.py make_adjustments):
+        p >> 1 means TTFT blows past the profile (queueing) -> scale
+        prefill pessimistically; d near 1 means the decode profile holds."""
+        m = self.last_metrics
+        if not m.is_valid() or self.cfg.no_correction:
+            return
+        expect_ttft = self.prefill.interpolate_ttft(m.isl)
+        if expect_ttft > 0:
+            self.p_correction = m.ttft / expect_ttft
+        duration = m.request_duration or self.cfg.adjustment_interval_s
+        concurrency = (
+            (m.num_req or 0.0)
+            / max(1, num_decode_workers)
+            * duration
+            / self.cfg.adjustment_interval_s
+        )
+        expect_itl = self.decode.interpolate_itl(
+            concurrency=concurrency, context_length=m.isl + m.osl / 2
+        )
+        if expect_itl > 0:
+            self.d_correction = m.itl / expect_itl
+        log.info(
+            "correction factors: ttft %.3f itl %.3f",
+            self.p_correction, self.d_correction,
+        )
+
+    # -- decision ----------------------------------------------------------
+
+    def predict_load(self) -> tuple[float, float, float]:
+        return (
+            self.pred_num_req.predict(),
+            self.pred_isl.predict(),
+            self.pred_osl.predict(),
+        )
+
+    def compute_replicas(
+        self, num_req: float, isl: float, osl: float
+    ) -> tuple[int, int]:
+        cfg = self.cfg
+        interval = cfg.adjustment_interval_s
+
+        # prefill: predicted prompt tokens/s over profiled per-chip
+        # throughput; TTFT overshoot (p_correction > 1 from queueing) only
+        # ever shrinks the denominator via min(1, .) on the demand side
+        pred_prefill_thpt = (
+            num_req * isl / interval * min(1.0, self.p_correction)
+        )
+        per_replica_prefill = (
+            self.prefill.interpolate_thpt_per_chip(isl)
+            * cfg.prefill_engine_num_chips
+        )
+        n_p = math.ceil(pred_prefill_thpt / max(per_replica_prefill, 1e-9))
+
+        # decode: tighten the ITL target by the observed correction, then
+        # invert the profiled surface for the best sustainable thpt/chip
+        corrected_itl = (
+            cfg.itl_sla_s / self.d_correction
+            if self.d_correction > 0
+            else cfg.itl_sla_s
+        )
+        thpt_per_chip, _, _ = self.decode.find_best_throughput_per_chip(
+            itl=corrected_itl, context_length=isl + osl / 2
+        )
+        pred_decode_thpt = num_req * osl / interval
+        n_d = math.ceil(
+            pred_decode_thpt
+            / max(thpt_per_chip * cfg.decode_engine_num_chips, 1e-9)
+        )
+
+        n_p = max(n_p, cfg.min_endpoint)
+        n_d = max(n_d, cfg.min_endpoint)
+
+        total = (
+            n_p * cfg.prefill_engine_num_chips
+            + n_d * cfg.decode_engine_num_chips
+        )
+        if total > cfg.max_chip_budget:
+            scale = cfg.max_chip_budget / total
+            n_p = max(cfg.min_endpoint, round(n_p * scale))
+            n_d = max(
+                cfg.min_endpoint,
+                round(
+                    (cfg.max_chip_budget - n_p * cfg.prefill_engine_num_chips)
+                    / cfg.decode_engine_num_chips
+                ),
+            )
+            log.warning(
+                "chip budget %d exceeded (%d needed); scaled to p=%d d=%d",
+                cfg.max_chip_budget, total, n_p, n_d,
+            )
+        return n_p, n_d
+
+    async def make_adjustments(self) -> DesiredReplicas | None:
+        if not self.last_metrics.is_valid():
+            log.info("metrics invalid/idle; skipping adjustment")
+            return None
+        if self.worker_counts is not None:
+            _, n_decode = await self.worker_counts()
+            self.update_corrections(max(1, n_decode))
+        num_req, isl, osl = self.predict_load()
+        if isl <= 0 or osl <= 0:
+            return None
+        n_p, n_d = self.compute_replicas(num_req, isl, osl)
+        desired = DesiredReplicas(prefill=n_p, decode=n_d, model=self.cfg.model)
+        self.decisions.append(desired)
+        await self.connector.set_replicas(desired)
+        return desired
+
+    # -- loops -------------------------------------------------------------
+
+    async def run(self) -> None:
+        """Live loop: scrape -> adjust, every adjustment interval."""
+        while True:
+            await asyncio.sleep(self.cfg.adjustment_interval_s)
+            try:
+                await self.observe_metrics()
+                await self.make_adjustments()
+            except asyncio.CancelledError:
+                raise
+            except Exception:  # noqa: BLE001
+                log.exception("planner iteration failed")
+
+    def start(self) -> "SlaPlanner":
+        self._task = asyncio.get_running_loop().create_task(self.run())
+        return self
+
+    async def close(self) -> None:
+        if self._task is not None:
+            self._task.cancel()
+            try:
+                await self._task
+            except asyncio.CancelledError:
+                pass
+
+    async def dryrun(self, trace: list[dict[str, Any]]) -> list[tuple[int, int]]:
+        """Replay recorded intervals without a cluster (ref
+        planner_sla_dryrun): each record needs num_req/isl/osl (ttft/itl
+        optional — corrections need them; otherwise no_correction
+        behavior). Returns the (prefill, decode) decision per interval."""
+        out: list[tuple[int, int]] = []
+        for rec in trace:
+            m = Metrics(
+                ttft=rec.get("ttft", self.cfg.ttft_sla_s / 2),
+                itl=rec.get("itl", self.cfg.itl_sla_s / 2),
+                num_req=rec["num_req"],
+                isl=rec["isl"],
+                osl=rec["osl"],
+                request_duration=rec.get("request_duration"),
+            )
+            self.ingest(m)
+            desired = await self.make_adjustments()
+            if desired is not None:
+                out.append((desired.prefill, desired.decode))
+            else:
+                out.append((0, 0))
+        return out

@@ -1,0 +1,123 @@
+"""Load predictors for the SLA planner.
+
+Same role as the reference's load_predictor.py (constant / auto-ARIMA /
+Prophet). This environment has neither pmdarima nor prophet, and neither is
+necessary: the planner needs one-step-ahead forecasts of slowly-varying
+aggregates. We provide:
+
+  - ``constant``: next = last observed (the reference's ConstantPredictor).
+  - ``ar``: autoregressive AR(p) fit by least squares over a sliding
+    window — the workhorse of ARIMA without the package dependency; falls
+    back to the last value until enough history exists or when the fit is
+    degenerate.
+  - ``holt``: Holt's double exponential smoothing (level + trend), the
+    classic forecaster for load with drift; trend is dampened so a burst
+    does not extrapolate to infinity.
+
+All ignore NaNs, skip the initial idle period (leading zeros), and keep a
+bounded window.
+"""
+
+from __future__ import annotations
+
+import math
+
+import numpy as np
+
+__all__ = ["BasePredictor", "ConstantPredictor", "ARPredictor",
+           "HoltPredictor", "make_predictor", "PREDICTORS"]
+
+
+class BasePredictor:
+    def __init__(self, window_size: int = 128):
+        self.window_size = window_size
+        self.buf: list[float] = []
+
+    def observe(self, value: float) -> None:
+        if value is None or math.isnan(value):
+            value = 0.0
+        if not self.buf and value == 0.0:
+            return  # skip leading idle period
+        self.buf.append(float(value))
+        if len(self.buf) > self.window_size:
+            del self.buf[: len(self.buf) - self.window_size]
+
+    def last(self) -> float:
+        return self.buf[-1] if self.buf else 0.0
+
+    def predict(self) -> float:  # pragma: no cover - abstract
+        raise NotImplementedError
+
+
+class ConstantPredictor(BasePredictor):
+    def predict(self) -> float:
+        return self.last()
+
+
+class ARPredictor(BasePredictor):
+    """AR(p) one-step forecast fit by least squares on the window."""
+
+    def __init__(self, window_size: int = 128, order: int = 4,
+                 min_points: int = 8):
+        super().__init__(window_size)
+        self.order = order
+        self.min_points = min_points
+
+    def predict(self) -> float:
+        x = np.asarray(self.buf, np.float64)
+        p = self.order
+        if len(x) < max(self.min_points, p + 2) or np.ptp(x) == 0.0:
+            return self.last()
+        # rows: x[t] ~ c + sum_j a_j * x[t-j]
+        T = len(x) - p
+        A = np.ones((T, p + 1))
+        for j in range(p):
+            A[:, j + 1] = x[p - 1 - j : len(x) - 1 - j]
+        y = x[p:]
+        try:
+            coef, *_ = np.linalg.lstsq(A, y, rcond=None)
+        except np.linalg.LinAlgError:
+            return self.last()
+        feats = np.concatenate([[1.0], x[-1 : -p - 1 : -1]])
+        pred = float(feats @ coef)
+        if not math.isfinite(pred):
+            return self.last()
+        return max(0.0, pred)
+
+
+class HoltPredictor(BasePredictor):
+    """Holt double exponential smoothing with damped trend."""
+
+    def __init__(self, window_size: int = 128, alpha: float = 0.5,
+                 beta: float = 0.3, phi: float = 0.9):
+        super().__init__(window_size)
+        self.alpha, self.beta, self.phi = alpha, beta, phi
+
+    def predict(self) -> float:
+        if len(self.buf) < 2:
+            return self.last()
+        level, trend = self.buf[0], self.buf[1] - self.buf[0]
+        for x in self.buf[1:]:
+            prev = level
+            level = self.alpha * x + (1 - self.alpha) * (level + self.phi * trend)
+            trend = self.beta * (level - prev) + (1 - self.beta) * self.phi * trend
+        return max(0.0, level + self.phi * trend)
+
+
+PREDICTORS = {
+    "constant": ConstantPredictor,
+    "ar": ARPredictor,
+    "arima": ARPredictor,  # reference flag compatibility
+    "holt": HoltPredictor,
+    "prophet": HoltPredictor,  # reference flag compatibility
+}
+
+
+def make_predictor(kind: str, window_size: int = 128) -> BasePredictor:
+    try:
+        cls = PREDICTORS[kind]
+    except KeyError:
+        raise ValueError(
+            f"unknown predictor {kind!r}; choose from {sorted(PREDICTORS)}"
+        ) from None
+    return cls(window_size=window_size)

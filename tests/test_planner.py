@@ -1,0 +1,291 @@
+"""SLA planner: predictors, interpolators, replica math, dryrun, and live
+metrics scraping against a mocker fleet.
+
+Mirrors the reference planner test surface (tests/planner/unit/,
+planner_sla_dryrun) — see dynamo_tpu/planner/core.py for the behavioral
+contract being checked.
+"""
+
+import asyncio
+import math
+
+import aiohttp
+import numpy as np
+import pytest
+
+from dynamo_tpu.planner import (
+    DecodeInterpolator,
+    Metrics,
+    PlannerConfig,
+    PrefillInterpolator,
+    SlaPlanner,
+    VirtualConnector,
+    make_predictor,
+    read_desired_replicas,
+    synthetic_profile,
+)
+from dynamo_tpu.planner.core import FrontendMetricsSource, parse_prometheus_text
+
+pytestmark = pytest.mark.unit
+
+
+# ---------------------------------------------------------------- predictors
+
+
+def test_constant_predictor():
+    p = make_predictor("constant")
+    for v in (0.0, 0.0, 5.0, 7.0):
+        p.observe(v)
+    assert p.predict() == 7.0
+
+
+def test_ar_predictor_tracks_ramp():
+    p = make_predictor("ar")
+    for t in range(20):
+        p.observe(10.0 + 3.0 * t)
+    nxt = p.predict()
+    assert abs(nxt - (10.0 + 3.0 * 20)) < 2.0  # extrapolates the ramp
+
+
+def test_holt_predictor_tracks_trend():
+    p = make_predictor("holt")
+    for t in range(20):
+        p.observe(100.0 + 10.0 * t)
+    assert p.predict() > 100.0 + 10.0 * 19  # continues upward
+
+
+def test_predictor_skips_leading_idle_and_nan():
+    p = make_predictor("ar")
+    p.observe(0.0)
+    p.observe(float("nan"))
+    assert p.predict() == 0.0
+    p.observe(4.0)
+    assert p.predict() == 4.0
+
+
+# -------------------------------------------------------------- interpolators
+
+
+def _interps():
+    prof = synthetic_profile()
+    return PrefillInterpolator(prof), DecodeInterpolator(prof), prof
+
+
+def test_prefill_interpolation_matches_analytic():
+    pre, _, _ = _interps()
+    # synthetic: ttft = 0.1 + 1e-4 * isl (linear -> interp exact)
+    assert abs(pre.interpolate_ttft(1000) - (0.1 + 1e-4 * 1000)) < 1e-6
+    assert abs(pre.interpolate_thpt_per_chip(512) - 8000.0) < 1e-6
+
+
+def test_decode_interpolation_matches_analytic():
+    _, dec, _ = _interps()
+    # itl = 0.01 + 0.04*kv + 2e-6*ctx at kv=0.5, ctx=1024
+    conc = 0.5 * dec.max_kv_tokens / 1024
+    got = dec.interpolate_itl(concurrency=conc, context_length=1024)
+    want = 0.01 + 0.04 * 0.5 + 2e-6 * 1024
+    assert abs(got - want) < 1e-3
+
+
+def test_find_best_throughput_respects_itl():
+    _, dec, _ = _interps()
+    thpt, itl, kv = dec.find_best_throughput_per_chip(
+        itl=0.03, context_length=1024
+    )
+    assert itl <= 0.03
+    # a tighter SLA must not allow more throughput
+    thpt2, _, _ = dec.find_best_throughput_per_chip(
+        itl=0.02, context_length=1024
+    )
+    assert thpt2 <= thpt
+
+
+# ------------------------------------------------------------- replica math
+
+
+def _planner(**over) -> SlaPlanner:
+    pre, dec, _ = _interps()
+    cfg = PlannerConfig(
+        ttft_sla_s=0.5, itl_sla_s=0.04, adjustment_interval_s=10.0,
+        predictor="constant", no_correction=True, **over,
+    )
+    return SlaPlanner(cfg, pre, dec)
+
+
+def test_replicas_scale_with_load():
+    pl = _planner()
+    lo = pl.compute_replicas(num_req=20, isl=1000, osl=200)
+    hi = pl.compute_replicas(num_req=2000, isl=1000, osl=200)
+    assert hi[0] >= lo[0] and hi[1] >= lo[1]
+    assert hi[1] > lo[1]  # decode demand x10 must need more replicas
+
+
+def test_replicas_respect_min_endpoint():
+    pl = _planner(min_endpoint=2)
+    p, d = pl.compute_replicas(num_req=0.01, isl=64, osl=8)
+    assert (p, d) == (2, 2)
+
+
+def test_replicas_respect_chip_budget():
+    pl = _planner(max_chip_budget=4)
+    p, d = pl.compute_replicas(num_req=10000, isl=4000, osl=1000)
+    assert p * 1 + d * 1 <= 5  # rounding slack of 1, mirrors reference
+
+
+def test_correction_tightens_decode():
+    """Observed ITL worse than profile (d_correction > 1) must not
+    increase per-chip throughput -> at least as many decode replicas."""
+    pl = _planner()
+    base_p, base_d = pl.compute_replicas(num_req=50, isl=1000, osl=500)
+    pl.d_correction = 2.0  # observed itl = 2x expectation
+    _, d2 = pl.compute_replicas(num_req=50, isl=1000, osl=500)
+    assert d2 >= base_d
+
+
+# -------------------------------------------------------------------- dryrun
+
+
+async def test_dryrun_scales_up_and_down():
+    pl = _planner()
+    ramp_up = [{"num_req": r, "isl": 2000, "osl": 400} for r in (5, 5, 50, 200)]
+    ramp_down = [{"num_req": r, "isl": 2000, "osl": 400} for r in (200, 20, 2)]
+    decisions = await pl.dryrun(ramp_up + ramp_down)
+    peak = max(d for _, d in decisions)
+    assert decisions[-1][1] < peak  # scaled back down
+    assert peak > decisions[0][1]  # scaled up under load
+    # decode decisions track the load curve shape
+    assert decisions[3][1] >= decisions[2][1] >= decisions[0][1]
+
+
+# ------------------------------------------------------------- connector
+
+
+async def test_virtual_connector_roundtrip():
+    from dynamo_tpu.runtime.hub import InMemoryHub
+
+    hub = InMemoryHub()
+    pl = _planner()
+    pl.connector = VirtualConnector(hub, "dyn", model="m")
+    pl.ingest(Metrics(ttft=0.2, itl=0.02, num_req=50, isl=1000, osl=200,
+                      request_duration=4.0))
+    desired = await pl.make_adjustments()
+    assert desired is not None
+    got = await read_desired_replicas(hub, "dyn")
+    assert (got.prefill, got.decode) == (desired.prefill, desired.decode)
+    assert got.revision == 1
+    await pl.make_adjustments()
+    got2 = await read_desired_replicas(hub, "dyn")
+    assert got2.revision == 2
+
+
+# ------------------------------------------------- metrics text + live scrape
+
+
+def test_parse_prometheus_text():
+    text = (
+        "# HELP x y\n"
+        'dynamo_output_tokens_total{model="m"} 42.0\n'
+        "dynamo_up 1\n"
+        'dynamo_ttft_sum{model="m",route="chat"} 1.5\n'
+    )
+    snap = parse_prometheus_text(text)
+    assert snap[("dynamo_output_tokens_total", (("model", "m"),))] == 42.0
+    assert snap[("dynamo_up", ())] == 1.0
+
+
+class _FakeSource(FrontendMetricsSource):
+    def __init__(self, texts):
+        super().__init__("http://fake")
+        self.texts = list(texts)
+
+    async def fetch_text(self) -> str:
+        return self.texts.pop(0)
+
+
+async def test_metrics_source_deltas():
+    t1 = (
+        'dynamo_requests_completed_total{model="m"} 10\n'
+        'dynamo_input_tokens_total{model="m"} 1000\n'
+        'dynamo_output_tokens_total{model="m"} 500\n'
+        'dynamo_time_to_first_token_seconds_sum{model="m"} 2.0\n'
+        'dynamo_time_to_first_token_seconds_count{model="m"} 10\n'
+        'dynamo_inter_token_latency_seconds_sum{model="m"} 1.0\n'
+        'dynamo_inter_token_latency_seconds_count{model="m"} 100\n'
+        'dynamo_request_duration_seconds_sum{model="m"} 30.0\n'
+        'dynamo_request_duration_seconds_count{model="m"} 10\n'
+    )
+    t2 = (
+        'dynamo_requests_completed_total{model="m"} 30\n'
+        'dynamo_input_tokens_total{model="m"} 5000\n'
+        'dynamo_output_tokens_total{model="m"} 2500\n'
+        'dynamo_time_to_first_token_seconds_sum{model="m"} 6.0\n'
+        'dynamo_time_to_first_token_seconds_count{model="m"} 30\n'
+        'dynamo_inter_token_latency_seconds_sum{model="m"} 5.0\n'
+        'dynamo_inter_token_latency_seconds_count{model="m"} 300\n'
+        'dynamo_request_duration_seconds_sum{model="m"} 90.0\n'
+        'dynamo_request_duration_seconds_count{model="m"} 30\n'
+    )
+    src = _FakeSource([t1, t2])
+    first = await src.observe()
+    assert first.num_req is None  # no window yet
+    m = await src.observe()
+    assert m.num_req == 20
+    assert m.isl == (5000 - 1000) / 20
+    assert m.osl == (2500 - 500) / 20
+    assert abs(m.ttft - (6.0 - 2.0) / 20) < 1e-9
+    assert abs(m.itl - (5.0 - 1.0) / 200) < 1e-9
+    assert m.is_valid()
+
+
+async def test_live_scrape_from_mocker_fleet():
+    """End-to-end observation: mocker fleet + HTTP frontend, drive traffic,
+    scrape /metrics twice, get valid interval averages, and plan."""
+    from dynamo_tpu.frontend.http import HttpFrontend
+    from dynamo_tpu.frontend.watcher import ModelManager, ModelWatcher
+    from dynamo_tpu.mocker.__main__ import launch_mock_worker
+    from dynamo_tpu.mocker.engine import MockEngineConfig
+    from dynamo_tpu.runtime.distributed import DistributedRuntime
+    from dynamo_tpu.runtime.hub import InMemoryHub
+
+    drt = DistributedRuntime(InMemoryHub())
+    cfg = MockEngineConfig(block_size=4, total_kv_blocks=512, speedup_ratio=500.0)
+    await launch_mock_worker(
+        drt, "dyn", "backend", "generate", cfg,
+        model_name="mock-model", register_card=True, router_mode="kv",
+    )
+    manager = ModelManager()
+    watcher = await ModelWatcher(drt, manager).start()
+    await watcher.wait_for_model("mock-model", timeout=5)
+    frontend = HttpFrontend(manager, host="127.0.0.1", port=0)
+    await frontend.start()
+    base = f"http://127.0.0.1:{frontend.port}"
+    try:
+        src = FrontendMetricsSource(f"{base}/metrics", "mock-model")
+        await src.observe()  # baseline snapshot
+        async with aiohttp.ClientSession() as sess:
+            for i in range(4):
+                async with sess.post(
+                    f"{base}/v1/chat/completions",
+                    json={"model": "mock-model", "stream": True,
+                          "messages": [{"role": "user", "content": f"q{i}"}],
+                          "max_tokens": 8},
+                ) as r:
+                    assert r.status == 200
+                    async for _ in r.content:
+                        pass
+        m = await src.observe()
+        assert m.num_req == 4
+        assert m.is_valid(), m
+
+        pre, dec, _ = _interps()
+        pl = SlaPlanner(
+            PlannerConfig(predictor="constant", no_correction=True),
+            pre, dec,
+        )
+        pl.ingest(m)
+        desired = await pl.make_adjustments()
+        assert desired is not None and desired.decode >= 1
+    finally:
+        await frontend.stop()
+        await watcher.close()
+        await drt.close()
