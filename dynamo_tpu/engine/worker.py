@@ -47,6 +47,8 @@ async def launch_engine_worker(
     engine_config: EngineConfig | None = None,
     spec: ModelSpec | None = None,
     router_mode: str = "kv",
+    tool_call_parser: str | None = None,
+    reasoning_parser: str | None = None,
     mode: str = "aggregated",
     prefill_component: str = PREFILL_COMPONENT,
     prefill_router_mode: str = "kv",
@@ -137,6 +139,8 @@ async def launch_engine_worker(
             context_length=cfg.max_context,
             kv_block_size=cfg.page_size,
             router_mode=router_mode,
+            tool_call_parser=tool_call_parser,
+            reasoning_parser=reasoning_parser,
             runtime_config={"engine": "jax", "tp": cfg.tp, "mode": mode},
             metadata={"engine": "jax", "role": mode},
         )
@@ -230,6 +234,8 @@ async def _amain(args: argparse.Namespace) -> None:
         tokenizer=args.tokenizer,
         engine_config=ecfg,
         router_mode=args.router_mode,
+        tool_call_parser=args.tool_call_parser,
+        reasoning_parser=args.reasoning_parser,
         mode=args.mode,
         prefill_component=args.prefill_component,
         prefill_router_mode=args.prefill_router_mode,
@@ -264,6 +270,11 @@ def main() -> None:
                    help="expert-parallel width (MoE models)")
     p.add_argument("--router-mode", default="kv",
                    choices=["kv", "round_robin", "random"])
+    p.add_argument("--tool-call-parser", default=None,
+                   help="tool-call parser name (hermes, llama3_json, "
+                        "mistral, pythonic, ...)")
+    p.add_argument("--reasoning-parser", default=None,
+                   help="reasoning parser name (basic, deepseek_r1, granite)")
     p.add_argument("--mode", default="aggregated",
                    choices=["aggregated", "prefill", "decode"])
     p.add_argument("--prefill-component", default=PREFILL_COMPONENT)

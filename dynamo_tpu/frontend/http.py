@@ -162,6 +162,7 @@ class HttpFrontend:
                             (body.get("stream_options") or {}).get("include_usage")
                         ),
                         prompt_tokens=prompt_tokens,
+                        request=body,
                     )
                     if chat
                     else pipe.preprocessor.postprocess_completions_stream(
@@ -175,7 +176,8 @@ class HttpFrontend:
             else:
                 agg = (
                     await pipe.preprocessor.aggregate_chat(
-                        timed, request_id=ctx.id, prompt_tokens=prompt_tokens
+                        timed, request_id=ctx.id, prompt_tokens=prompt_tokens,
+                        request=body,
                     )
                     if chat
                     else await pipe.preprocessor.aggregate_completions(

@@ -33,6 +33,8 @@ class ModelDeploymentCard:
     migration_limit: int = 3
     router_mode: str = "kv"  # "kv" | "round_robin" | "random"
     chat_template: str | None = None
+    tool_call_parser: str | None = None  # parsers.TOOL_PARSERS key
+    reasoning_parser: str | None = None  # parsers.REASONING_PARSERS key
     runtime_config: dict[str, Any] = field(default_factory=dict)
 
     def key_for(self, instance_id: int) -> str:
@@ -68,6 +70,8 @@ async def register_llm(
     kv_block_size: int = 16,
     migration_limit: int = 3,
     router_mode: str = "kv",
+    tool_call_parser: str | None = None,
+    reasoning_parser: str | None = None,
     runtime_config: dict[str, Any] | None = None,
     metadata: dict[str, Any] | None = None,
 ):
@@ -87,6 +91,8 @@ async def register_llm(
         kv_block_size=kv_block_size,
         migration_limit=migration_limit,
         router_mode=router_mode,
+        tool_call_parser=tool_call_parser,
+        reasoning_parser=reasoning_parser,
         runtime_config=runtime_config or {},
     )
     served = await endpoint.serve(

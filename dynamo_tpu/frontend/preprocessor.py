@@ -33,27 +33,58 @@ class OpenAIPreprocessor:
         context_length: int = 8192,
         chat_template: str | None = None,
         default_max_tokens: int = 256,
+        tool_call_parser: str | None = None,
+        reasoning_parser: str | None = None,
     ):
         self.tokenizer = tokenizer
         self.model_name = model_name
         self.context_length = context_length
         self.default_max_tokens = default_max_tokens
+        self.tool_call_parser = tool_call_parser
+        self.reasoning_parser = reasoning_parser
+        # fail fast on unknown parser names: a typo must break worker
+        # startup, not every subsequent chat request
+        from dynamo_tpu.parsers import make_reasoning_parser, make_tool_config
+
+        self._tool_cfg = make_tool_config(tool_call_parser)
+        make_reasoning_parser(reasoning_parser)
         self._template = (
             jinja2.Template(chat_template) if chat_template else None
         )
+
+    def _tool_config(self, request: dict[str, Any] | None):
+        """Jail only when the model has a parser AND the request brought
+        tools (ref preprocessor.rs:629 jail application)."""
+        if self._tool_cfg is None or not request or not request.get("tools"):
+            return None
+        if request.get("tool_choice") == "none":
+            return None
+        return self._tool_cfg
+
+    def _reasoning(self):
+        from dynamo_tpu.parsers import make_reasoning_parser
+
+        return make_reasoning_parser(self.reasoning_parser)
 
     # -- forward: OpenAI request -> PreprocessedRequest --------------------
 
     def render_prompt(self, request: dict[str, Any]) -> str:
         if "messages" in request:
             messages = request["messages"]
+            tools = request.get("tools")
             if self._template is not None:
                 return self._template.render(
-                    messages=messages, add_generation_prompt=True
+                    messages=messages, add_generation_prompt=True, tools=tools
                 )
-            return self.tokenizer.apply_chat_template(
-                messages, add_generation_prompt=True
-            )
+            try:
+                return self.tokenizer.apply_chat_template(
+                    messages, add_generation_prompt=True, tools=tools
+                )
+            except TypeError:
+                # tokenizer template without tools support
+                return self.tokenizer.apply_chat_template(
+                    messages, add_generation_prompt=True
+                )
         prompt = request.get("prompt", "")
         if isinstance(prompt, list):
             prompt = "".join(prompt)
@@ -104,23 +135,36 @@ class OpenAIPreprocessor:
         request_id: str | None = None,
         include_usage: bool = False,
         prompt_tokens: int = 0,
+        request: dict[str, Any] | None = None,
     ) -> AsyncIterator[dict[str, Any]]:
-        """Backend deltas -> chat.completion.chunk dicts (SSE payloads)."""
+        """Backend deltas -> chat.completion.chunk dicts (SSE payloads).
+
+        When the model card configures a tool parser and the request
+        carries ``tools``, text runs through the jail (parsers/jail.py):
+        marker-delimited call regions leave the stream as ``tool_calls``
+        deltas. A configured reasoning parser independently splits think
+        segments into ``reasoning_content`` (ref preprocessor.rs:629-694).
+        """
         rid = request_id or new_request_id()
         created = now_unix()
         first = True
         completion_tokens = 0
-        finish = None
-        async for d in deltas:
-            completion_tokens += len(d.get("token_ids", ()))
-            finish = d.get("finish_reason")
-            delta: dict[str, Any] = {}
+        tool_cfg = self._tool_config(request)
+        jail = None
+        if tool_cfg is not None:
+            from dynamo_tpu.parsers import JailedStream
+
+            jail = JailedStream(tool_cfg)
+        reasoning = self._reasoning()
+        tool_index = 0
+        saw_tool_calls = False
+
+        def chunk_for(delta: dict[str, Any], finish: str | None):
+            nonlocal first
             if first:
-                delta["role"] = "assistant"
+                delta = {"role": "assistant", **delta}
                 first = False
-            if d.get("text"):
-                delta["content"] = d["text"]
-            chunk = {
+            return {
                 "id": rid,
                 "object": "chat.completion.chunk",
                 "created": created,
@@ -129,7 +173,59 @@ class OpenAIPreprocessor:
                     {"index": 0, "delta": delta, "finish_reason": finish}
                 ],
             }
-            yield chunk
+
+        async for d in deltas:
+            completion_tokens += len(d.get("token_ids", ()))
+            finish = d.get("finish_reason")
+            text = d.get("text") or ""
+
+            r_delta, content = reasoning.feed(text) if reasoning else ("", text)
+            events = []
+            if content:
+                events = (
+                    jail.feed(content) if jail else [("content", content)]
+                )
+            if finish is not None:
+                if reasoning is not None:
+                    r_tail, c_tail = reasoning.finish()
+                    r_delta += r_tail
+                    if c_tail:
+                        events += (
+                            jail.feed(c_tail) if jail
+                            else [("content", c_tail)]
+                        )
+                if jail is not None:
+                    events += jail.finish()
+
+            pending: list[dict[str, Any]] = []
+            if r_delta:
+                pending.append({"reasoning_content": r_delta})
+            for kind, payload in events:
+                if kind == "content":
+                    if payload:
+                        pending.append({"content": payload})
+                else:  # tool_calls
+                    calls = [
+                        c.to_openai(tool_index + i)
+                        for i, c in enumerate(payload)
+                    ]
+                    tool_index += len(calls)
+                    saw_tool_calls = True
+                    pending.append({"tool_calls": calls})
+
+            if finish is not None and saw_tool_calls and finish == "stop":
+                finish = "tool_calls"
+            # keep a chunk per backend delta when not jailing (clients see
+            # per-token progress even for invisible tokens); while jailed,
+            # silence is the point
+            if not pending and (jail is None or finish is not None):
+                pending.append({})
+            for i, delta in enumerate(pending):
+                yield chunk_for(
+                    delta,
+                    finish if (finish is not None and i == len(pending) - 1)
+                    else None,
+                )
         if include_usage:
             yield {
                 "id": rid,
@@ -150,6 +246,7 @@ class OpenAIPreprocessor:
         *,
         request_id: str | None = None,
         prompt_tokens: int = 0,
+        request: dict[str, Any] | None = None,
     ) -> dict[str, Any]:
         """Backend deltas -> one chat.completion response (non-streaming)."""
         rid = request_id or new_request_id()
@@ -162,6 +259,32 @@ class OpenAIPreprocessor:
             completion_tokens += len(d.get("token_ids", ()))
             if d.get("finish_reason"):
                 finish = d["finish_reason"]
+        text = "".join(text_parts)
+
+        message: dict[str, Any] = {"role": "assistant"}
+        reasoning = self._reasoning()
+        if reasoning is not None:
+            r1, c1 = reasoning.feed(text)
+            r2, c2 = reasoning.finish()
+            if r1 + r2:
+                message["reasoning_content"] = r1 + r2
+            text = c1 + c2
+        tool_cfg = self._tool_config(request)
+        if tool_cfg is not None:
+            from dynamo_tpu.parsers import parse_tool_calls
+
+            calls, normal = parse_tool_calls(text, tool_cfg)
+            if calls:
+                message["tool_calls"] = [
+                    c.to_openai(i) for i, c in enumerate(calls)
+                ]
+                message["content"] = normal or None
+                if finish == "stop":
+                    finish = "tool_calls"
+            else:
+                message["content"] = text
+        else:
+            message["content"] = text
         return {
             "id": rid,
             "object": "chat.completion",
@@ -170,10 +293,7 @@ class OpenAIPreprocessor:
             "choices": [
                 {
                     "index": 0,
-                    "message": {
-                        "role": "assistant",
-                        "content": "".join(text_parts),
-                    },
+                    "message": message,
                     "finish_reason": finish,
                 }
             ],

@@ -69,10 +69,12 @@ class MockTokenizer:
         )
 
     def apply_chat_template(
-        self, messages: list[dict], add_generation_prompt: bool = True
+        self, messages: list[dict], add_generation_prompt: bool = True,
+        tools: list[dict] | None = None,
     ) -> str:
         return self._template.render(
-            messages=messages, add_generation_prompt=add_generation_prompt
+            messages=messages, add_generation_prompt=add_generation_prompt,
+            tools=tools,
         )
 
 
@@ -93,10 +95,12 @@ class HFTokenizer:
         return self._tok.decode(ids, skip_special_tokens=True)
 
     def apply_chat_template(
-        self, messages: list[dict], add_generation_prompt: bool = True
+        self, messages: list[dict], add_generation_prompt: bool = True,
+        tools: list[dict] | None = None,
     ) -> str:
         return self._tok.apply_chat_template(
-            messages, tokenize=False, add_generation_prompt=add_generation_prompt
+            messages, tokenize=False,
+            add_generation_prompt=add_generation_prompt, tools=tools,
         )
 
 

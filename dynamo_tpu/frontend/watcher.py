@@ -110,6 +110,8 @@ async def build_pipeline(
         model_name=card.name,
         context_length=card.context_length,
         chat_template=card.chat_template,
+        tool_call_parser=card.tool_call_parser,
+        reasoning_parser=card.reasoning_parser,
     )
     return ModelPipeline(
         card=card,

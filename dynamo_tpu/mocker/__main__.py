@@ -32,6 +32,8 @@ async def launch_mock_worker(
     model_name: str = "mock-model",
     register_card: bool = False,
     router_mode: str = "kv",
+    tool_call_parser: str | None = None,
+    reasoning_parser: str | None = None,
 ) -> tuple[MockEngine, object]:
     """Serve one mock worker; returns (engine, served_handle)."""
     engine = MockEngine(config)
@@ -45,6 +47,8 @@ async def launch_mock_worker(
             tokenizer="mock",
             kv_block_size=config.block_size,
             router_mode=router_mode,
+            tool_call_parser=tool_call_parser,
+            reasoning_parser=reasoning_parser,
             metadata={"engine": "mocker", "dp_rank": config.data_parallel_rank},
         )
     else:

@@ -43,6 +43,11 @@ class MockEngineConfig:
     eos_token_id: int = 2
     data_parallel_rank: int = 0
     seed: int = 0
+    # echo mode (ref: dynamo-run out=echo, opt.rs Output::Echo): decode
+    # replays the prompt tokens instead of sampling randomly — byte-level
+    # MockTokenizer makes output text == prompt text, which E2E tests use
+    # to drive the tool-call/reasoning parser paths deterministically
+    echo_prompt: bool = False
 
 
 class MockEngine:
@@ -149,7 +154,15 @@ class MockEngine:
                     # batch pressure: decode step slows with concurrency
                     pressure = 1.0 + 0.02 * max(self._running - 1, 0)
                     await self._sleep(cfg.decode_step_s * pressure)
-                    tok = self._rng.randrange(3, cfg.vocab_size)
+                    if cfg.echo_prompt and token_ids:
+                        # replay the prompt once, then stop cleanly
+                        tok = (
+                            token_ids[generated]
+                            if generated < len(token_ids)
+                            else cfg.eos_token_id
+                        )
+                    else:
+                        tok = self._rng.randrange(3, cfg.vocab_size)
                     sealed = seq.append(tok)
                     if sealed is not None:
                         # new decode block materializes in the KV pool
@@ -168,6 +181,10 @@ class MockEngine:
                             return
                     generated += 1
                     is_eos = (not ignore_eos) and tok == cfg.eos_token_id
+                    if cfg.echo_prompt and generated > len(token_ids):
+                        # echo finished (the emitted token was the closing
+                        # EOS): stop regardless of ignore_eos
+                        is_eos = True
                     done = generated >= max_tokens or is_eos
                     yield {
                         "token_ids": [tok],

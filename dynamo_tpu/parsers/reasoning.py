@@ -1,0 +1,86 @@
+"""Reasoning-content extraction from the output stream.
+
+Role of the reference's reasoning parsers (lib/parsers/src/reasoning/:
+base marker parser, granite, gpt_oss): split "thinking" segments out of
+the visible stream into the OpenAI-extension ``reasoning_content`` field.
+Streaming-safe: markers split across deltas are held back by
+MarkerMatcher.
+
+Registry:
+  basic        <think> ... </think>
+  deepseek_r1  like basic but the stream STARTS inside reasoning (R1 chat
+               templates open the think block in the prompt)
+  granite      "Here is my thought process:" / "Here is my response:"
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from dynamo_tpu.parsers.markers import MarkerMatcher
+
+__all__ = ["ReasoningParser", "REASONING_PARSERS", "make_reasoning_parser"]
+
+
+@dataclass
+class ReasoningConfig:
+    start_marker: str
+    end_marker: str
+    starts_in_reasoning: bool = False
+
+
+REASONING_PARSERS: dict[str, ReasoningConfig] = {
+    "basic": ReasoningConfig("<think>", "</think>"),
+    "deepseek_r1": ReasoningConfig("<think>", "</think>",
+                                   starts_in_reasoning=True),
+    "granite": ReasoningConfig(
+        "Here is my thought process:", "Here is my response:"
+    ),
+}
+
+
+def make_reasoning_parser(name: str | None) -> "ReasoningParser | None":
+    if not name:
+        return None
+    try:
+        cfg = REASONING_PARSERS[name]
+    except KeyError:
+        raise ValueError(
+            f"unknown reasoning parser {name!r}; "
+            f"choose from {sorted(REASONING_PARSERS)}"
+        ) from None
+    return ReasoningParser(cfg)
+
+
+class ReasoningParser:
+    def __init__(self, cfg: ReasoningConfig):
+        self.cfg = cfg
+        self.in_reasoning = cfg.starts_in_reasoning
+        self._matcher = MarkerMatcher(
+            [cfg.end_marker if self.in_reasoning else cfg.start_marker]
+        )
+
+    def _switch(self) -> None:
+        self.in_reasoning = not self.in_reasoning
+        self._matcher = MarkerMatcher(
+            [self.cfg.end_marker if self.in_reasoning else self.cfg.start_marker]
+        )
+
+    def feed(self, text: str) -> tuple[str, str]:
+        """Delta -> (reasoning_delta, content_delta)."""
+        reasoning: list[str] = []
+        content: list[str] = []
+        while text:
+            clean, marker, rest = self._matcher.feed(text)
+            (reasoning if self.in_reasoning else content).append(clean)
+            if marker is None:
+                break
+            self._switch()
+            text = rest
+        return "".join(reasoning), "".join(content)
+
+    def finish(self) -> tuple[str, str]:
+        held = self._matcher.flush()
+        if self.in_reasoning:
+            return held, ""
+        return "", held

@@ -1,0 +1,314 @@
+"""Tool-call + reasoning parsers and the jailed stream (parsers/).
+
+Unit coverage mirrors lib/parsers/src/tool_calling/tests.rs scenarios;
+the E2E test drives OpenAI `tools` through the HTTP frontend over an
+echo-mode mocker and asserts tool_calls arrive via SSE (VERDICT r1 #6
+done-criterion).
+"""
+
+import json
+
+import aiohttp
+import pytest
+
+from dynamo_tpu.parsers import (
+    JailedStream,
+    MarkerMatcher,
+    ReasoningParser,
+    make_reasoning_parser,
+    make_tool_config,
+    parse_tool_calls,
+)
+
+pytestmark = pytest.mark.unit
+
+
+# ---------------------------------------------------------------- markers
+
+
+def test_marker_matcher_whole_and_split():
+    m = MarkerMatcher(["<tool_call>"])
+    clean, marker, rest = m.feed("hello <tool_call>{x}")
+    assert (clean, marker, rest) == ("hello ", "<tool_call>", "{x}")
+
+    m = MarkerMatcher(["<tool_call>"])
+    clean, marker, _ = m.feed("abc <tool_")
+    assert clean == "abc " and marker is None  # partial held
+    clean, marker, rest = m.feed("call>rest")
+    assert (clean, marker, rest) == ("", "<tool_call>", "rest")
+
+
+def test_marker_matcher_false_prefix_releases():
+    m = MarkerMatcher(["<tool_call>"])
+    clean, marker, _ = m.feed("a <to")
+    assert clean == "a " and marker is None
+    clean, marker, _ = m.feed("ast of text")
+    assert clean == "<toast of text" and marker is None
+    assert m.flush() == ""
+
+
+# ------------------------------------------------------------- full parse
+
+
+def test_parse_hermes():
+    cfg = make_tool_config("hermes")
+    text = (
+        'I will check. <tool_call>{"name": "get_weather", '
+        '"arguments": {"city": "SF"}}</tool_call> done'
+    )
+    calls, normal = parse_tool_calls(text, cfg)
+    assert len(calls) == 1
+    assert calls[0].name == "get_weather"
+    assert json.loads(calls[0].arguments) == {"city": "SF"}
+    assert "I will check." in normal and "done" in normal
+
+
+def test_parse_nemotron_list():
+    cfg = make_tool_config("nemotron_deci")
+    text = (
+        '<TOOLCALL>[{"name": "a", "arguments": {"x": 1}}, '
+        '{"name": "b", "parameters": {"y": 2}}]</TOOLCALL>'
+    )
+    calls, normal = parse_tool_calls(text, cfg)
+    assert [c.name for c in calls] == ["a", "b"]
+    assert json.loads(calls[1].arguments) == {"y": 2}
+    assert normal == ""
+
+
+def test_parse_llama3_bare_json():
+    cfg = make_tool_config("llama3_json")
+    calls, normal = parse_tool_calls(
+        '{"name": "f", "arguments": {"q": "hi"}}', cfg
+    )
+    assert len(calls) == 1 and calls[0].name == "f"
+    # and with the python tag
+    calls2, _ = parse_tool_calls(
+        '<|python_tag|>{"name": "g", "arguments": {}}', cfg
+    )
+    assert calls2[0].name == "g"
+
+
+def test_parse_mistral():
+    cfg = make_tool_config("mistral")
+    calls, _ = parse_tool_calls(
+        '[TOOL_CALLS][{"name": "f", "arguments": {"a": 1}}]', cfg
+    )
+    assert calls[0].name == "f"
+
+
+def test_parse_pythonic():
+    cfg = make_tool_config("pythonic")
+    calls, normal = parse_tool_calls(
+        '[get_weather(city="SF", unit="F"), refresh()]', cfg
+    )
+    assert [c.name for c in calls] == ["get_weather", "refresh"]
+    assert json.loads(calls[0].arguments) == {"city": "SF", "unit": "F"}
+
+
+def test_parse_plain_text_untouched():
+    cfg = make_tool_config("hermes")
+    calls, normal = parse_tool_calls("just an answer", cfg)
+    assert calls == [] and normal == "just an answer"
+
+
+def test_unknown_parser_raises():
+    try:
+        make_tool_config("nope")
+    except ValueError as e:
+        assert "unknown tool parser" in str(e)
+    else:
+        raise AssertionError("expected ValueError")
+
+
+# -------------------------------------------------------------------- jail
+
+
+def _drain(jail, chunks):
+    events = []
+    for c in chunks:
+        events.extend(jail.feed(c))
+    events.extend(jail.finish())
+    return events
+
+
+def test_jail_streams_content_then_calls():
+    jail = JailedStream(make_tool_config("hermes"))
+    events = _drain(jail, [
+        "Let me ", "look. <tool_", 'call>{"na', 'me": "f", "arguments": ',
+        '{"x": 1}}</tool', "_call> after",
+    ])
+    kinds = [k for k, _ in events]
+    assert kinds.count("tool_calls") == 1
+    content = "".join(p for k, p in events if k == "content")
+    assert "Let me look." in content and "after" in content
+    assert "<tool_call>" not in content
+    calls = next(p for k, p in events if k == "tool_calls")
+    assert calls[0].name == "f"
+
+
+def test_jail_unclosed_region_parsed_at_finish():
+    jail = JailedStream(make_tool_config("llama3_json"))
+    events = _drain(jail, ['<|python_tag|>{"name": "f", "arguments": {}}'])
+    assert any(k == "tool_calls" for k, _ in events)
+
+
+def test_jail_non_call_region_released_verbatim():
+    jail = JailedStream(make_tool_config("hermes"))
+    events = _drain(jail, ["a <tool_call>not json</tool_call> b"])
+    content = "".join(p for k, p in events if k == "content")
+    # exact round-trip, markers included: streaming must agree with the
+    # non-streaming aggregate of the same text
+    assert content == "a <tool_call>not json</tool_call> b"
+    assert not any(k == "tool_calls" for k, _ in events)
+
+
+def test_jail_bare_json_after_leading_whitespace():
+    jail = JailedStream(make_tool_config("mistral"))
+    events = _drain(jail, ["\n", "  ", '[{"name": "f", "arguments": {}}]'])
+    calls = next(p for k, p in events if k == "tool_calls")
+    assert calls[0].name == "f"
+
+
+def test_jail_pythonic_nested_lists_stream():
+    jail = JailedStream(make_tool_config("pythonic"))
+    events = _drain(jail, ["[f(a=[1, 2", ", 3], b=2)]"])
+    calls = next(p for k, p in events if k == "tool_calls")
+    assert calls[0].name == "f"
+    assert json.loads(calls[0].arguments) == {"a": [1, 2, 3], "b": 2}
+
+
+def test_preprocessor_rejects_bad_parser_name():
+    from dynamo_tpu.frontend.preprocessor import OpenAIPreprocessor
+    from dynamo_tpu.frontend.tokenizer import MockTokenizer
+
+    try:
+        OpenAIPreprocessor(
+            MockTokenizer(), model_name="m", tool_call_parser="typo"
+        )
+    except ValueError as e:
+        assert "unknown tool parser" in str(e)
+    else:
+        raise AssertionError("expected ValueError at construction")
+
+
+def test_jail_bare_json_start():
+    jail = JailedStream(make_tool_config("mistral"))
+    events = _drain(jail, ['[{"name": "f", "argu', 'ments": {"a": 2}}]'])
+    calls = next(p for k, p in events if k == "tool_calls")
+    assert calls[0].name == "f"
+    # but ordinary text is not jailed
+    jail2 = JailedStream(make_tool_config("mistral"))
+    events2 = _drain(jail2, ["plain answer"])
+    assert events2 == [("content", "plain answer")]
+
+
+# ---------------------------------------------------------------- reasoning
+
+
+def test_reasoning_split_stream():
+    rp = make_reasoning_parser("basic")
+    r1, c1 = rp.feed("<think>step one")
+    r2, c2 = rp.feed(" step two</think>the answer")
+    r3, c3 = rp.finish()
+    assert (r1 + r2 + r3) == "step one step two"
+    assert (c1 + c2 + c3) == "the answer"
+
+
+def test_reasoning_marker_split_across_chunks():
+    rp = make_reasoning_parser("basic")
+    parts = ["<th", "ink>abc</th", "ink>xyz"]
+    r, c = "", ""
+    for p in parts:
+        dr, dc = rp.feed(p)
+        r, c = r + dr, c + dc
+    dr, dc = rp.finish()
+    assert r + dr == "abc" and c + dc == "xyz"
+
+
+def test_reasoning_deepseek_starts_inside():
+    rp = make_reasoning_parser("deepseek_r1")
+    r1, c1 = rp.feed("thinking...</think>done")
+    assert r1 == "thinking..." and c1 == "done"
+
+
+# ------------------------------------------------------------------ E2E SSE
+
+
+async def test_tool_calls_over_http_sse():
+    """Chat request with tools over the echo mocker: the tool-call text the
+    model 'generates' (= the prompt, echoed) must come back as parsed
+    tool_calls SSE deltas with finish_reason tool_calls."""
+    from dynamo_tpu.frontend.http import HttpFrontend
+    from dynamo_tpu.frontend.watcher import ModelManager, ModelWatcher
+    from dynamo_tpu.mocker.__main__ import launch_mock_worker
+    from dynamo_tpu.mocker.engine import MockEngineConfig
+    from dynamo_tpu.runtime.distributed import DistributedRuntime
+    from dynamo_tpu.runtime.hub import InMemoryHub
+
+    drt = DistributedRuntime(InMemoryHub())
+    cfg = MockEngineConfig(
+        block_size=4, total_kv_blocks=512, speedup_ratio=500.0,
+        echo_prompt=True,
+    )
+    await launch_mock_worker(
+        drt, "dyn", "backend", "generate", cfg,
+        model_name="echo-model", register_card=True,
+        tool_call_parser="hermes",
+    )
+    manager = ModelManager()
+    watcher = await ModelWatcher(drt, manager).start()
+    await watcher.wait_for_model("echo-model", timeout=5)
+    frontend = HttpFrontend(manager, host="127.0.0.1", port=0)
+    await frontend.start()
+    base = f"http://127.0.0.1:{frontend.port}"
+
+    call_text = '<tool_call>{"name": "get_weather", "arguments": {"city": "SF"}}</tool_call>'
+    tools = [{"type": "function",
+              "function": {"name": "get_weather", "parameters": {}}}]
+    try:
+        async with aiohttp.ClientSession() as sess:
+            # the echo engine replays the rendered prompt; content includes
+            # the call text. max_tokens > len so the full call echoes back.
+            payload = {
+                "model": "echo-model",
+                "messages": [{"role": "user", "content": call_text}],
+                "tools": tools,
+                "max_tokens": 400,  # > prompt echo; engine EOSes after one replay
+                "stream": True,
+            }
+            tool_deltas, contents, finishes = [], [], []
+            async with sess.post(f"{base}/v1/chat/completions", json=payload) as r:
+                assert r.status == 200, await r.text()
+                async for line in r.content:
+                    if not line.startswith(b"data: ") or b"[DONE]" in line:
+                        continue
+                    chunk = json.loads(line[len(b"data: "):])
+                    for ch in chunk.get("choices", []):
+                        d = ch.get("delta", {})
+                        if d.get("tool_calls"):
+                            tool_deltas.extend(d["tool_calls"])
+                        if d.get("content"):
+                            contents.append(d["content"])
+                        if ch.get("finish_reason"):
+                            finishes.append(ch["finish_reason"])
+            assert tool_deltas, (contents, finishes)
+            assert tool_deltas[0]["function"]["name"] == "get_weather"
+            assert json.loads(tool_deltas[0]["function"]["arguments"]) == {
+                "city": "SF"
+            }
+            assert "<tool_call>" not in "".join(contents)
+            assert finishes[-1] == "tool_calls"
+
+            # aggregated (non-streaming) parse as well
+            async with sess.post(
+                f"{base}/v1/chat/completions",
+                json={**payload, "stream": False},
+            ) as r:
+                body = await r.json()
+            msg = body["choices"][0]["message"]
+            assert msg["tool_calls"][0]["function"]["name"] == "get_weather"
+            assert body["choices"][0]["finish_reason"] == "tool_calls"
+    finally:
+        await frontend.stop()
+        await watcher.close()
+        await drt.close()
