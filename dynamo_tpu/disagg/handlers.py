@@ -41,6 +41,18 @@ class PrefillWorkerHandler:
     ) -> AsyncIterator[dict[str, Any]]:
         disagg = request.get("disagg") or {}
         if not (disagg.get("kv_transfer") or {}).get("do_remote_decode"):
+            if "health-canary" in (request.get("annotations") or ()):
+                # canary probe (runtime/health.py): run a plain 1-token
+                # local generate through the engine — no KV export, but
+                # exercises the real admission + decode path
+                request = dict(request)
+                request["stop_conditions"] = {
+                    **(request.get("stop_conditions") or {}),
+                    "max_tokens": 1,
+                }
+                async for item in self.engine.generate(request, context):
+                    yield item
+                return
             yield {"token_ids": [], "finish_reason": "error",
                    "error": "prefill worker requires disagg.kv_transfer.do_remote_decode"}
             return

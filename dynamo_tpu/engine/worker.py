@@ -55,6 +55,7 @@ async def launch_engine_worker(
     max_local_prefill_length: int = 128,
     always_remote_prefill: bool = False,
     kvbm_config=None,
+    health=None,  # HealthCheckManager: canary-probe this worker's endpoint
 ) -> tuple[InferenceEngine, object]:
     """Build + register one engine worker in this process.
 
@@ -151,6 +152,11 @@ async def launch_engine_worker(
     engine.events = KvEventPublisher(drt.hub, comp_path, wid).start()
     engine.metrics = WorkerMetricsPublisher(drt.hub, comp_path, wid).start()
     await engine.start()
+    if health is not None:
+        health.register(served)
+        from dynamo_tpu.runtime.health import EngineMonitor
+
+        engine.monitor = EngineMonitor(drt, engine)
     engine._publish_metrics()
     log.info(
         "engine worker %x up: mode=%s model=%s pages=%d slots=%d tp=%d",
@@ -223,8 +229,30 @@ async def _amain(args: argparse.Namespace) -> None:
         sp=args.sp,
         ep=args.ep,
     )
+    health = None
+    status_server = None
+    if args.health_port >= 0:
+        from dynamo_tpu.runtime.health import (
+            HealthCheckConfig,
+            HealthCheckManager,
+            SystemStatusServer,
+        )
+
+        health = HealthCheckManager(
+            drt,
+            HealthCheckConfig(
+                interval_s=args.health_interval,
+                timeout_s=args.health_timeout,
+            ),
+        )
+        status_server = await SystemStatusServer(
+            health=health, port=args.health_port
+        ).start()
+        print(f"SYSTEM_STATUS_PORT={status_server.port}", flush=True)
+
     await launch_engine_worker(
         drt,
+        health=health,
         namespace=args.namespace,
         component=args.component,
         endpoint=args.endpoint,
@@ -287,6 +315,13 @@ def main() -> None:
     p.add_argument("--kvbm-disk-mb", type=int, default=0,
                    help="disk KV tier budget in MiB (0 = no disk tier)")
     p.add_argument("--kvbm-disk-dir", default=None)
+    p.add_argument("--health-port", type=int, default=-1,
+                   help="system status server port (0 = ephemeral, "
+                        "-1 = health subsystem off)")
+    p.add_argument("--health-interval", type=float, default=5.0,
+                   help="canary probe interval (s)")
+    p.add_argument("--health-timeout", type=float, default=5.0,
+                   help="canary probe timeout (s)")
     args = p.parse_args()
     if (args.kvbm_disk_mb > 0 or args.kvbm_disk_dir) and args.kvbm_host_mb <= 0:
         p.error("--kvbm-disk-* requires --kvbm-host-mb > 0 (KVBM is off)")

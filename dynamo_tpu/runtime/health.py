@@ -1,0 +1,300 @@
+"""Health subsystem: canary probes, readiness state, status server, engine
+watchdog.
+
+Reference parity:
+  - HealthCheckManager (lib/runtime/src/health_check.rs:44-353): periodic
+    canary requests THROUGH the real endpoint transport with a
+    configurable payload; consecutive failures flip the endpoint
+    unhealthy.
+  - system_status_server.rs: /live /ready /health (+ /metrics) on a
+    dedicated port.
+  - engine-death watchdog (components/src/dynamo/vllm/engine_monitor.py):
+    a dead engine loop deregisters the worker and shuts the runtime down.
+
+TPU-framework twist: an unhealthy endpoint's instance key is WITHDRAWN
+from the hub (lease kept alive), so routers drop it immediately — the
+same effect the reference gets from lease-expiry, but without waiting out
+the TTL; recovery re-publishes the key.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import time
+from dataclasses import dataclass, field
+from typing import Any
+
+from dynamo_tpu.runtime.context import Context
+from dynamo_tpu.runtime.transport import InstanceChannel, call_local
+
+log = logging.getLogger("dynamo.health")
+
+DEFAULT_CANARY = {
+    "token_ids": [1],
+    "stop_conditions": {"max_tokens": 1, "ignore_eos": True},
+    "sampling": {"temperature": 0.0},
+    "annotations": ["health-canary"],
+}
+
+
+@dataclass
+class HealthCheckConfig:
+    interval_s: float = 5.0
+    timeout_s: float = 5.0
+    failure_threshold: int = 2  # consecutive failures -> unhealthy
+    payload: dict[str, Any] = field(
+        default_factory=lambda: dict(DEFAULT_CANARY)
+    )
+
+
+@dataclass
+class EndpointHealth:
+    path: str
+    status: str = "unknown"  # unknown | ready | unhealthy
+    consecutive_failures: int = 0
+    last_ok: float | None = None
+    last_error: str | None = None
+    probes: int = 0
+
+    def to_dict(self) -> dict[str, Any]:
+        return {
+            "path": self.path,
+            "status": self.status,
+            "consecutive_failures": self.consecutive_failures,
+            "last_ok": self.last_ok,
+            "last_error": self.last_error,
+            "probes": self.probes,
+        }
+
+
+class HealthCheckManager:
+    """Canary-probes served endpoints; withdraws/restores their instance
+    keys in the hub as they flip unhealthy/ready."""
+
+    def __init__(self, drt, config: HealthCheckConfig | None = None):
+        self.drt = drt
+        self.config = config or HealthCheckConfig()
+        self._entries: list[tuple[Any, EndpointHealth, dict]] = []
+        self._tasks: list[asyncio.Task] = []
+        self._closed = False
+
+    def register(self, served, payload: dict | None = None) -> EndpointHealth:
+        """Start probing a ServedEndpoint (worker supplies the canary
+        payload when the default token probe doesn't fit, ref
+        vllm/main.py:199 health_check_payload)."""
+        health = EndpointHealth(path=served.instance.endpoint_path)
+        entry = (served, health, payload or self.config.payload)
+        self._entries.append(entry)
+        self._tasks.append(
+            asyncio.get_running_loop().create_task(self._probe_loop(entry))
+        )
+        return health
+
+    @property
+    def statuses(self) -> list[EndpointHealth]:
+        return [h for _, h, _ in self._entries]
+
+    @property
+    def all_ready(self) -> bool:
+        return bool(self._entries) and all(
+            h.status == "ready" for _, h, _ in self._entries
+        )
+
+    async def close(self) -> None:
+        self._closed = True
+        for t in self._tasks:
+            t.cancel()
+        for t in self._tasks:
+            try:
+                await t
+            except (asyncio.CancelledError, Exception):  # noqa: BLE001
+                pass
+
+    # -- probing -----------------------------------------------------------
+
+    @staticmethod
+    def _check_item(item) -> None:
+        """A handler reporting failure as an error item (finish_reason
+        'error') is just as unhealthy as one that raises."""
+        if isinstance(item, dict) and (
+            item.get("finish_reason") == "error" or item.get("error")
+        ):
+            raise RuntimeError(f"canary error item: {item.get('error')}")
+
+    async def _canary(self, served, payload: dict) -> None:
+        """One canary generate through the instance's real transport."""
+        inst = served.instance
+        ctx = Context(request_id=f"canary-{inst.instance_id:x}")
+        if inst.transport == "local":
+            handler = self.drt.local_registry.get(inst.wire_path)
+            if handler is None:
+                raise RuntimeError("handler not registered")
+            stream = call_local(handler, payload, ctx)
+            async for item in stream:
+                self._check_item(item)
+                break
+            ctx.stop_generating()
+            return
+        ch = InstanceChannel(inst.host, inst.port)
+        await ch.connect(self.drt.config.connect_timeout_s)
+        try:
+            async for item in ch.call(inst.wire_path, payload, ctx):
+                self._check_item(item)
+                break
+            ctx.stop_generating()
+        finally:
+            await ch.close()
+
+    async def _probe_loop(self, entry) -> None:
+        served, health, payload = entry
+        cfg = self.config
+        while not self._closed:
+            await asyncio.sleep(cfg.interval_s)
+            health.probes += 1
+            try:
+                await asyncio.wait_for(
+                    self._canary(served, payload), cfg.timeout_s
+                )
+            except asyncio.CancelledError:
+                raise
+            except Exception as e:  # noqa: BLE001
+                health.consecutive_failures += 1
+                health.last_error = f"{type(e).__name__}: {e}"
+                if (
+                    health.consecutive_failures >= cfg.failure_threshold
+                    and health.status != "unhealthy"
+                ):
+                    health.status = "unhealthy"
+                    log.warning(
+                        "endpoint %s unhealthy (%s); withdrawing instance %x",
+                        health.path, health.last_error,
+                        served.instance.instance_id,
+                    )
+                    await self.drt.hub.delete(served.instance.path)
+                continue
+            health.consecutive_failures = 0
+            health.last_ok = time.time()
+            if health.status == "unhealthy":
+                log.info(
+                    "endpoint %s recovered; re-publishing instance %x",
+                    health.path, served.instance.instance_id,
+                )
+                lease = await self.drt.lease_id()
+                await self.drt.hub.put(
+                    served.instance.path,
+                    served.instance.to_dict(),
+                    lease_id=lease,
+                )
+            health.status = "ready"
+
+
+class SystemStatusServer:
+    """Liveness/readiness/health/metrics on a dedicated port (ref
+    system_status_server.rs, DYN_SYSTEM_PORT)."""
+
+    def __init__(
+        self,
+        *,
+        health: HealthCheckManager | None = None,
+        metrics=None,
+        host: str = "127.0.0.1",
+        port: int = 0,
+    ):
+        from aiohttp import web
+
+        self.health = health
+        self.metrics = metrics
+        self.host = host
+        self.port = port
+        self._web = web
+        self.app = web.Application()
+        self.app.add_routes([
+            web.get("/live", self._live),
+            web.get("/ready", self._ready),
+            web.get("/health", self._health),
+            web.get("/metrics", self._metrics),
+        ])
+        self._runner = None
+
+    async def start(self) -> "SystemStatusServer":
+        web = self._web
+        self._runner = web.AppRunner(self.app, access_log=None)
+        await self._runner.setup()
+        site = web.TCPSite(self._runner, self.host, self.port)
+        await site.start()
+        for s in site._server.sockets:
+            self.port = s.getsockname()[1]
+        return self
+
+    async def stop(self) -> None:
+        if self._runner is not None:
+            await self._runner.cleanup()
+
+    async def _live(self, _request):
+        return self._web.json_response({"status": "live"})
+
+    async def _ready(self, _request):
+        ready = self.health.all_ready if self.health is not None else True
+        return self._web.json_response(
+            {"status": "ready" if ready else "notready"},
+            status=200 if ready else 503,
+        )
+
+    async def _health(self, _request):
+        statuses = (
+            [h.to_dict() for h in self.health.statuses]
+            if self.health is not None
+            else []
+        )
+        ready = self.health.all_ready if self.health is not None else True
+        return self._web.json_response(
+            {"status": "ready" if ready else "notready",
+             "endpoints": statuses}
+        )
+
+    async def _metrics(self, _request):
+        if self.metrics is None:
+            return self._web.Response(status=404)
+        return self._web.Response(
+            body=self.metrics.exposition(),
+            content_type="text/plain",
+        )
+
+
+class EngineMonitor:
+    """Watchdog: if the engine's step loop dies, deregister this worker and
+    shut the runtime down (ref VllmEngineMonitor engine_monitor.py;
+    EngineDeadError -> runtime.shutdown in handlers.py:112-117)."""
+
+    def __init__(self, drt, engine, *, interval_s: float = 1.0):
+        self.drt = drt
+        self.engine = engine
+        self.interval_s = interval_s
+        self._task = asyncio.get_running_loop().create_task(self._watch())
+
+    def _engine_dead(self) -> bool:
+        task = getattr(self.engine, "_loop_task", None)
+        return task is not None and task.done()
+
+    async def _watch(self) -> None:
+        while True:
+            await asyncio.sleep(self.interval_s)
+            if self.engine is None:
+                continue
+            if getattr(self.engine, "_closed", False):
+                return  # orderly close, not a death
+            if self._engine_dead():
+                log.error(
+                    "engine step loop died; deregistering worker and "
+                    "shutting down"
+                )
+                await self.drt.shutdown(drain=False)
+                return
+
+    async def close(self) -> None:
+        self._task.cancel()
+        try:
+            await self._task
+        except asyncio.CancelledError:
+            pass
