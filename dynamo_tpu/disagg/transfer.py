@@ -9,12 +9,16 @@ exactly the reference's ``kv_transfer_params`` roundtrip
 direct worker↔worker connection, bypassing frontend and hub (reference:
 NIXL/UCX RDMA, block_manager/block/transfer/nixl.rs).
 
-Two paths:
+Three paths, selected by locality (ref SURVEY §7 hard part (a)):
   - in-process (same interpreter): zero-copy handoff through a registry —
     the common case for N-workers-per-host tests and single-host serving.
-  - TCP: length-prefixed raw bytes; on multi-host TPU pods this is the DCN
-    host-staging path (device→host on source, host→device on destination;
-    ICI stays free for the model's collectives).
+  - device-to-device: ``jax.experimental.transfer`` — a PJRT transfer
+    server on the prefill worker exposes the KV arrays; the decode worker
+    pulls them straight into its own device memory over the pod
+    interconnect (DCN cross-slice / loopback), no host staging. This is
+    the NIXL-RDMA equivalent.
+  - TCP host staging: length-prefixed raw numpy bytes; the universal
+    fallback (device transfer unsupported/failed, sharded sources).
 """
 
 from __future__ import annotations
@@ -68,7 +72,10 @@ class KvTransferSource:
     Unclaimed exports are garbage-collected after ``ttl_s``.
     """
 
-    def __init__(self, *, host: str = "127.0.0.1", port: int = 0, ttl_s: float = 120.0):
+    def __init__(
+        self, *, host: str = "127.0.0.1", port: int = 0, ttl_s: float = 120.0,
+        device_transfer: bool = True,
+    ):
         self.host = host
         self.port = port
         self.ttl_s = ttl_s
@@ -77,6 +84,40 @@ class KvTransferSource:
         self._lock = threading.Lock()
         self._server: asyncio.AbstractServer | None = None
         self._gc_task: asyncio.Task | None = None
+        self._want_device = device_transfer
+        self._txs = None  # PJRT transfer server (device-to-device path)
+        self.device_addr: str | None = None
+
+    @staticmethod
+    def _device_transfer_supported() -> bool:
+        """PJRT transfer is built for TPU DCN; the CPU backend's support is
+        incomplete in current jaxlib (cross-process pulls fail), so default
+        on only for TPU. DYNAMO_DEVICE_TRANSFER=1/0 overrides."""
+        import os
+
+        env = (os.environ.get("DYNAMO_DEVICE_TRANSFER") or "").strip()
+        if env in ("1", "true", "on"):
+            return True
+        if env in ("0", "false", "off"):
+            return False
+        import jax
+
+        return jax.default_backend() == "tpu"
+
+    def _start_device_server(self) -> None:
+        if not self._want_device or not self._device_transfer_supported():
+            return
+        try:
+            import jax
+            from jax.experimental import transfer as jtx
+
+            self._txs = jtx.start_transfer_server(jax.devices()[0].client)
+            self.device_addr = self._txs.address()
+            log.info("device KV transfer server at %s", self.device_addr)
+        except Exception as e:  # noqa: BLE001 - any backend without support
+            log.info("device KV transfer unavailable (%s); host path only", e)
+            self._txs = None
+            self.device_addr = None
 
     async def start(self) -> "KvTransferSource":
         if self._server is None:
@@ -84,6 +125,7 @@ class KvTransferSource:
                 self._handle, self.host, self.port
             )
             self.port = self._server.sockets[0].getsockname()[1]
+            self._start_device_server()
             self._gc_task = asyncio.get_running_loop().create_task(self._gc_loop())
             with _LOCAL_LOCK:
                 _LOCAL_SOURCES[self.uid] = self
@@ -92,6 +134,11 @@ class KvTransferSource:
     async def close(self) -> None:
         with _LOCAL_LOCK:
             _LOCAL_SOURCES.pop(self.uid, None)
+        # PJRT TransferServer has no shutdown API; drop our handle so no
+        # new stages can register (outstanding registrations live until
+        # process exit)
+        self._txs = None
+        self.device_addr = None
         if self._gc_task is not None:
             self._gc_task.cancel()
         if self._server is not None:
@@ -107,31 +154,61 @@ class KvTransferSource:
 
     # -- export (prefill side) --------------------------------------------
 
+    @staticmethod
+    def _device_exportable(x) -> bool:
+        """Device path wants an unsharded jax array (per-shard transfer of
+        TP-sharded pools goes through host staging for now)."""
+        sharding = getattr(x, "sharding", None)
+        return sharding is not None and len(sharding.device_set) == 1
+
     def export(
         self,
-        k_blocks: np.ndarray,
-        v_blocks: np.ndarray,
+        k_blocks,
+        v_blocks,
         *,
         num_tokens: int,
         page_size: int,
         on_done: Callable[[], None] | None = None,
     ) -> dict:
-        """Register staged blocks; returns kv_transfer_params for the puller."""
+        """Register staged blocks; returns kv_transfer_params for the puller.
+
+        jax-array inputs with a live PJRT transfer server export on-device
+        (pulled device-to-device); anything else stages to host numpy.
+        """
         tid = uuid.uuid4().hex
-        with self._lock:
-            self._exports[tid] = _Export(
-                k=k_blocks,
-                v=v_blocks,
-                meta={"num_tokens": num_tokens, "page_size": page_size},
-                on_done=on_done,
-            )
-        return {
+        params = {
             "transfer_id": tid,
             "source_uid": self.uid,
             "addr": f"{self.host}:{self.port}",
             "num_tokens": num_tokens,
             "page_size": page_size,
         }
+        meta = {"num_tokens": num_tokens, "page_size": page_size}
+        if self._txs is not None and self._device_exportable(k_blocks):
+            # the PJRT registration (await_pull) happens lazily when the
+            # puller asks ("stage_device" control op): a registration has
+            # no cancel API, so registering here would pin the device KV
+            # forever for transfers that get released/expired instead of
+            # pulled
+            with self._lock:
+                self._exports[tid] = _Export(
+                    k=k_blocks, v=v_blocks, meta=meta, on_done=on_done
+                )
+            params.update(
+                device_addr=self.device_addr,
+                uuid_int=int(tid[:15], 16),
+                k_shape=list(k_blocks.shape),
+                v_shape=list(v_blocks.shape),
+                dtype=np.dtype(k_blocks.dtype).name,
+            )
+            return params
+        k_blocks = np.asarray(k_blocks)
+        v_blocks = np.asarray(v_blocks)
+        with self._lock:
+            self._exports[tid] = _Export(
+                k=k_blocks, v=v_blocks, meta=meta, on_done=on_done
+            )
+        return params
 
     def _take(self, tid: str) -> _Export | None:
         with self._lock:
@@ -156,6 +233,30 @@ class KvTransferSource:
                 writer.write(b'{"ok": true}\n')
                 await writer.drain()
                 return
+            if op == "stage_device":
+                # puller is about to device-pull: register with the PJRT
+                # server now (see export() for why not earlier). If the
+                # puller dies between stage and pull this registration
+                # leaks until process end — a narrow window, logged by GC.
+                with self._lock:
+                    e = self._exports.get(tid)
+                ok = (
+                    e is not None
+                    and self._txs is not None
+                    and self._device_exportable(e.k)
+                )
+                if ok:
+                    self._txs.await_pull(int(req["uuid_int"]), [e.k, e.v])
+                    with self._lock:
+                        if tid in self._exports:
+                            self._exports[tid].meta["device_staged"] = True
+                    writer.write(b'{"ok": true}\n')
+                else:
+                    writer.write(
+                        b'{"ok": false, "error": "not device-stageable"}\n'
+                    )
+                await writer.drain()
+                return
             if op != "pull":
                 writer.write(b'{"ok": false, "error": "bad op"}\n')
                 await writer.drain()
@@ -165,12 +266,18 @@ class KvTransferSource:
                 writer.write(b'{"ok": false, "error": "unknown transfer_id"}\n')
                 await writer.drain()
                 return
-            kb, vb = e.k.tobytes(), e.v.tobytes()
+            # device exports serve the host fallback path too; the device
+            # sync + D2H copy must not block the event loop (this runs in
+            # the serving process)
+            k_np, v_np = await asyncio.to_thread(
+                lambda: (np.asarray(e.k), np.asarray(e.v))
+            )
+            kb, vb = k_np.tobytes(), v_np.tobytes()
             header = {
                 "ok": True,
-                "dtype": e.k.dtype.name,
-                "k_shape": list(e.k.shape),
-                "v_shape": list(e.v.shape),
+                "dtype": k_np.dtype.name,
+                "k_shape": list(k_np.shape),
+                "v_shape": list(v_np.shape),
                 **e.meta,
             }
             writer.write(json.dumps(header).encode() + b"\n")
@@ -207,10 +314,70 @@ class KvTransferSource:
 # -- pull client (decode side) ---------------------------------------------
 
 
+# PJRT transfer connections, one per source address (dialing is expensive)
+_DEVICE_CONNS: dict[str, object] = {}
+_DEVICE_CONNS_LOCK = threading.Lock()
+
+
+def _tcp_request(addr: str, obj: dict, timeout: float = 10.0) -> dict:
+    """One-line JSON request/response over the source's control socket."""
+    host, port = addr.rsplit(":", 1)
+    with socket.create_connection((host, int(port)), timeout=timeout) as sock:
+        sock.settimeout(timeout)
+        f = sock.makefile("rwb")
+        f.write(json.dumps(obj).encode() + b"\n")
+        f.flush()
+        return json.loads(f.readline())
+
+
+def _pull_device(params: dict) -> tuple[object, object, dict]:
+    """Device-to-device pull over the PJRT transfer plane."""
+    import jax
+    from jax.experimental import transfer as jtx
+    from jax.sharding import SingleDeviceSharding
+
+    # ask the source to register the arrays with its PJRT server now
+    staged = _tcp_request(
+        params["addr"],
+        {"op": "stage_device", "transfer_id": params["transfer_id"],
+         "uuid_int": params["uuid_int"]},
+    )
+    if not staged.get("ok"):
+        raise RuntimeError(f"device stage refused: {staged.get('error')}")
+
+    addr = params["device_addr"]
+    with _DEVICE_CONNS_LOCK:
+        conn = _DEVICE_CONNS.get(addr)
+        if conn is None:
+            server = jtx.start_transfer_server(jax.devices()[0].client)
+            conn = server.connect(addr)
+            _DEVICE_CONNS[addr] = conn
+            # keep the local server alive with its connection
+            _DEVICE_CONNS[addr + "#server"] = server
+    sh = SingleDeviceSharding(jax.devices()[0])
+    dt = _dtype_from_name(params["dtype"])
+    k, v = conn.pull(
+        params["uuid_int"],
+        [
+            jax.ShapeDtypeStruct(tuple(params["k_shape"]), dt, sharding=sh),
+            jax.ShapeDtypeStruct(tuple(params["v_shape"]), dt, sharding=sh),
+        ],
+    )
+    jax.block_until_ready((k, v))
+    meta = {
+        k_: params[k_] for k_ in ("num_tokens", "page_size") if k_ in params
+    }
+    # the payload has landed: let the source drop its reference
+    release_kv_blocks(params)
+    return k, v, meta
+
+
 def pull_kv_blocks(params: dict, timeout: float = 30.0) -> tuple[np.ndarray, np.ndarray, dict]:
     """Pull exported KV blocks. Blocking — call from a worker thread.
 
-    Returns (k_blocks, v_blocks, meta). In-process sources are zero-copy.
+    Returns (k_blocks, v_blocks, meta) — jax arrays on the device path,
+    numpy otherwise. In-process sources are zero-copy; cross-process
+    prefers device-to-device (PJRT transfer), then TCP host staging.
     """
     tid = params["transfer_id"]
     src = _LOCAL_SOURCES.get(params.get("source_uid", ""))
@@ -221,6 +388,15 @@ def pull_kv_blocks(params: dict, timeout: float = 30.0) -> tuple[np.ndarray, np.
         if e.on_done:
             e.on_done()
         return e.k, e.v, e.meta
+
+    if params.get("device_addr"):
+        try:
+            return _pull_device(params)
+        except Exception:  # noqa: BLE001
+            log.warning(
+                "device KV pull failed; falling back to host staging",
+                exc_info=True,
+            )
 
     host, port = params["addr"].rsplit(":", 1)
     with socket.create_connection((host, int(port)), timeout=timeout) as sock:

@@ -733,9 +733,12 @@ class InferenceEngine:
         """Prefill-worker handoff: export prompt KV pages for remote decode."""
         page_ids = jnp.asarray(np.asarray(sp.pages, np.int32))
         kb, vb = llama.extract_kv_pages(self.k_pages, self.v_pages, page_ids)
+        # device arrays go straight to the transfer plane: with a live PJRT
+        # transfer server the decode worker pulls device-to-device and the
+        # payload never stages through host numpy
         params = self.transfer_source.export(
-            np.asarray(kb),
-            np.asarray(vb),
+            kb,
+            vb,
             num_tokens=len(token_ids),
             page_size=self.config.page_size,
         )

@@ -4,12 +4,11 @@ Covers the reference's MoE model families (gpt-oss-120b EP configs,
 deepseek-r1 wide-EP — engine_configs/deepseek_r1/wide_ep/wide_ep_agg.yaml
 ``moe_expert_parallel_size``, recipes/deepseek-r1/sglang-wideep) the
 TPU-first way: experts are a leading array axis sharded over the mesh's
-"ep" axis, routing is a dense one-hot combine, and XLA's SPMD partitioner
-turns the expert-contraction einsum into the EP all-to-all/psum. Dense
-dispatch (every expert sees every token, combine weights zero out the
-rest) keeps shapes static and the MXU busy; at very large expert counts a
-ragged shard_map dispatch becomes worthwhile — the layer boundary here is
-where it would slot in.
+"ep" axis and dispatch is GShard/Switch capacity-based — static-shape
+one-hot dispatch/combine einsums (MXU) around a batched [E, C, d] expert
+compute, with XLA's SPMD partitioner inserting the EP all-to-alls. Total
+expert work scales with tokens x top_k, not with E, so E=128 presets are
+servable.
 """
 
 from __future__ import annotations
@@ -55,27 +54,71 @@ def moe_layer_shardings(mesh: Mesh) -> Params:
     }
 
 
-def moe_mlp(spec: ModelSpec, lp: Params, x: jax.Array) -> jax.Array:
-    """x: [T, d] -> [T, d] through top-k routed experts.
+def expert_capacity(
+    T: int, E: int, k: int, capacity_factor: float = 1.25
+) -> int:
+    """Per-expert token-slot budget: total slots E*C ~= T*k*cf regardless
+    of E — the property that makes E=128 presets servable (the old dense
+    combine computed every expert for every token: E/k times the FLOPs).
 
-    Routing softmax in f32; top-k weights renormalized (mixtral-style).
+    Floor: C >= min(T, 16). Small batches (decode steps) route
+    correlatedly, and a drop there silently degrades live outputs — at
+    C = T drops are impossible, and for T <= 16 the dispatch tensors are
+    tiny anyway. Large prefills keep the throughput-oriented budget
+    (inference routing is balanced enough at cf 1.25; overflow drops an
+    expert's contribution without renormalizing the rest)."""
+    import math
+
+    cap = math.ceil(T * k / E * capacity_factor)
+    return max(1, min(T, max(cap, 16)))
+
+
+def moe_mlp(
+    spec: ModelSpec, lp: Params, x: jax.Array, *,
+    capacity_factor: float = 1.25,
+) -> jax.Array:
+    """x: [T, d] -> [T, d] through top-k routed experts (sparse dispatch).
+
+    GShard/Switch-style capacity-based dispatch, the canonical TPU MoE:
+    static shapes throughout (XLA-friendly), one-hot dispatch/combine
+    einsums on the MXU, experts batched as one [E, C, d] tensor. Tokens
+    overflowing an expert's capacity drop that expert's contribution
+    (standard capacity semantics; renormalized top-k weights mean the
+    remaining experts still cover the token). Routing softmax in f32;
+    top-k weights renormalized (mixtral-style). Under an "ep" mesh the
+    [E, ...] axes shard and XLA inserts the all-to-alls.
     """
     T = x.shape[0]
+    E, k = spec.num_experts, spec.num_experts_per_token
+    C = expert_capacity(T, E, k, capacity_factor)
+
     probs = jax.nn.softmax(
         x.astype(jnp.float32) @ lp["router"], axis=-1
     )  # [T, E]
-    topv, topi = jax.lax.top_k(probs, spec.num_experts_per_token)
+    topv, topi = jax.lax.top_k(probs, k)  # [T, k]
     topv = topv / jnp.maximum(topv.sum(axis=-1, keepdims=True), 1e-9)
-    # dense combine weights [T, E]: zero for unrouted experts
-    combine = jnp.zeros_like(probs)
-    combine = jax.vmap(lambda c, i, v: c.at[i].set(v))(combine, topi, topv)
 
-    # every expert computes every token; combine zeroes the unrouted ones.
-    # XLA partitions the e-axis over "ep" and psums the final contraction.
-    h_gate = jnp.einsum("td,edf->tef", x, lp["w_gate"])
-    h_up = jnp.einsum("td,edf->tef", x, lp["w_up"])
-    h = jax.nn.silu(h_gate) * h_up
-    out = jnp.einsum("tef,efd->ted", h, lp["w_down"])  # [T, E, d]
+    # position of each (token, choice) within its expert's capacity:
+    # running count of prior assignments to the same expert, in flattened
+    # (t, j) order
+    oh = jax.nn.one_hot(topi.reshape(T * k), E, dtype=jnp.int32)  # [T*k, E]
+    pos_in_expert = jnp.cumsum(oh, axis=0) - oh  # [T*k, E]
+    pos = jnp.take_along_axis(
+        pos_in_expert, topi.reshape(T * k)[:, None], axis=1
+    )[:, 0].reshape(T, k)
+    keep = pos < C  # overflow drops
+
+    # combine[t, e, c] = weight of token t's slot c in expert e
+    e_oh = jax.nn.one_hot(topi, E, dtype=jnp.float32)  # [T, k, E]
+    c_oh = jax.nn.one_hot(pos, C, dtype=jnp.float32)  # [T, k, C]
+    w = topv * keep.astype(jnp.float32)  # [T, k]
+    combine = jnp.einsum("tke,tkc,tk->tec", e_oh, c_oh, w)  # [T, E, C]
+    dispatch = (combine > 0.0).astype(x.dtype)
+
+    xe = jnp.einsum("td,tec->ecd", x, dispatch)  # [E, C, d]
+    h = jax.nn.silu(jnp.einsum("ecd,edf->ecf", xe, lp["w_gate"]))
+    h = h * jnp.einsum("ecd,edf->ecf", xe, lp["w_up"])
+    out_e = jnp.einsum("ecf,efd->ecd", h, lp["w_down"])  # [E, C, d]
     return jnp.einsum(
-        "ted,te->td", out.astype(jnp.float32), combine
+        "ecd,tec->td", out_e.astype(jnp.float32), combine
     ).astype(x.dtype)

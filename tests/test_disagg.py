@@ -103,6 +103,65 @@ async def test_transfer_roundtrip_tcp_and_local():
         await src.close()
 
 
+async def test_transfer_device_to_device_path(monkeypatch):
+    """PJRT device pull (jax.experimental.transfer): a jax-array export is
+    pulled into device memory without host numpy staging.
+
+    CPU-backend constraint: PJRT transfer targets TPU DCN; on CPU a second
+    in-process transfer server aborts, so the test dials through the
+    source's own server (single-server loopback — the only arrangement
+    jaxlib supports off-TPU) by priming the connection cache. Production
+    never dials in-process: the zero-copy registry path wins there.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from dynamo_tpu.disagg import transfer as tmod
+
+    monkeypatch.setenv("DYNAMO_DEVICE_TRANSFER", "1")
+    src = await KvTransferSource().start()
+    try:
+        if src.device_addr is None:
+            pytest.skip("PJRT transfer server unsupported on this backend")
+        k = jnp.arange(2 * 2 * 3 * 2 * 8, dtype=jnp.float32).reshape(
+            2, 2, 3, 2, 8
+        )
+        v = k + 500.0
+        params = src.export(k, v, num_tokens=5, page_size=2)
+        assert params.get("device_addr")
+
+        # prime the conn cache with a loopback via the source's own server
+        monkeypatch.setitem(
+            tmod._DEVICE_CONNS, params["device_addr"],
+            src._txs.connect(src.device_addr),
+        )
+        # force the remote (device) route
+        hidden = _LOCAL_SOURCES.pop(src.uid)
+        try:
+            k2, v2, meta = await asyncio.to_thread(pull_kv_blocks, params)
+        finally:
+            _LOCAL_SOURCES[src.uid] = hidden
+        assert isinstance(k2, jax.Array)
+        assert meta["num_tokens"] == 5
+        np.testing.assert_array_equal(np.asarray(k), np.asarray(k2))
+        np.testing.assert_array_equal(np.asarray(v), np.asarray(v2))
+        # the pull released the export on the source
+        assert params["transfer_id"] not in src._exports
+
+        # a device export also serves the TCP host-staging route (fallback
+        # when a peer cannot dial the PJRT plane)
+        params2 = src.export(k, v, num_tokens=5, page_size=2)
+        params2.pop("device_addr")
+        hidden = _LOCAL_SOURCES.pop(src.uid)
+        try:
+            k3, _v3, _ = await asyncio.to_thread(pull_kv_blocks, params2)
+        finally:
+            _LOCAL_SOURCES[src.uid] = hidden
+        np.testing.assert_array_equal(np.asarray(k), np.asarray(k3))
+    finally:
+        await src.close()
+
+
 # -------------------------------------------------------------------- policy
 
 

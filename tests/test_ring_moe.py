@@ -189,3 +189,36 @@ async def test_engine_ring_prefill_path():
     got = await run(ring, prompt)
     assert got == want
     await ring.close()
+
+
+def test_expert_capacity_scales_with_topk_not_E():
+    """Total expert token-slots (E*C) tracks T*k*cf regardless of E — the
+    sparse-dispatch property that makes wide-EP presets servable."""
+    T, k, cf = 1024, 4, 1.25
+    budget = T * k * cf
+    for E in (8, 32, 128):
+        C = moe.expert_capacity(T, E, k, cf)
+        assert budget <= E * C <= budget + E  # ceil slack only
+    # small (decode) batches get the no-drop floor instead: C == T
+    assert moe.expert_capacity(16, 128, 4, cf) == 16
+    assert moe.expert_capacity(8, 8, 2, cf) == 8
+
+
+def test_moe_capacity_overflow_drops_gracefully():
+    """With capacity factor << 1 experts overflow; output stays finite and
+    the layer still runs (dropped slots simply contribute nothing)."""
+    spec = MOE_SPEC
+    key = jax.random.PRNGKey(3)
+    lp = moe.init_moe_layer(spec, key)
+    x = jax.random.normal(
+        jax.random.PRNGKey(4), (32, spec.hidden_size), jnp.float32
+    )
+    out = moe.moe_mlp(spec, lp, x, capacity_factor=0.25)
+    assert out.shape == x.shape
+    assert bool(jnp.all(jnp.isfinite(out)))
+    # and with generous capacity it matches the no-drop reference
+    full = moe.moe_mlp(spec, lp, x, capacity_factor=8.0)
+    ref = moe.moe_mlp(spec, lp, x, capacity_factor=100.0)
+    np.testing.assert_allclose(
+        np.asarray(full), np.asarray(ref), rtol=1e-5, atol=1e-5
+    )
