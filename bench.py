@@ -91,6 +91,81 @@ def prior_value() -> float | None:
     return value
 
 
+def serving_measurement(spec, page_size: int) -> dict:
+    """Engine-path numbers: TTFT/ITL/throughput through InferenceEngine
+    (scheduler + chunked prefill + multi-step decode + sampling + streams),
+    not raw jit calls — the VERDICT r1 'bench the product' item. Random
+    weights; latency/throughput don't care."""
+    import asyncio
+
+    from dynamo_tpu.engine.config import EngineConfig
+    from dynamo_tpu.engine.core import InferenceEngine
+    from dynamo_tpu.runtime.context import Context
+
+    N_REQ, ISL, OSL, SLOTS = 32, 128, 48, 16
+    cfg = EngineConfig(
+        page_size=page_size,
+        num_pages=SLOTS * 16 + 64,
+        max_pages_per_seq=16,
+        max_decode_slots=SLOTS,
+        prefill_buckets=(128, 256),
+        decode_steps_per_dispatch=8,
+    )
+
+    async def run() -> dict:
+        engine = InferenceEngine(spec, cfg)
+        await engine.start()
+        rng = np.random.default_rng(0)
+        ttfts: list[float] = []
+        itls: list[float] = []
+        total_tokens = 0
+
+        async def one(i: int, record: bool):
+            nonlocal total_tokens
+            toks = rng.integers(3, spec.vocab_size, ISL).tolist()
+            t0 = time.perf_counter()
+            last = None
+            async for item in engine.generate(
+                {"token_ids": toks,
+                 "stop_conditions": {"max_tokens": OSL, "ignore_eos": True},
+                 "sampling": {"temperature": 0.0}},
+                Context(f"bench-{i}"),
+            ):
+                n = len(item.get("token_ids") or ())
+                if not n:
+                    continue
+                now = time.perf_counter()
+                if record:
+                    if last is None:
+                        ttfts.append(now - t0)
+                    else:
+                        # bursts deliver several tokens per item
+                        itls.extend([(now - last) / n] * n)
+                    total_tokens += n
+                last = now
+
+        await asyncio.gather(*(one(i, False) for i in range(4)))  # warmup
+        t0 = time.perf_counter()
+        await asyncio.gather(*(one(i, True) for i in range(N_REQ)))
+        wall = time.perf_counter() - t0
+        await engine.close()
+
+        def pct(xs, p):
+            xs = sorted(xs)
+            return round(xs[min(len(xs) - 1, int(p * len(xs)))] * 1e3, 2)
+
+        return {
+            "requests": N_REQ, "isl": ISL, "osl": OSL, "slots": SLOTS,
+            "output_tok_per_s": round(total_tokens / wall, 1),
+            "ttft_ms_p50": pct(ttfts, 0.5),
+            "ttft_ms_p99": pct(ttfts, 0.99),
+            "itl_ms_p50": pct(itls, 0.5),
+            "itl_ms_p99": pct(itls, 0.99),
+        }
+
+    return asyncio.run(run())
+
+
 def main() -> None:
     backend = jax.default_backend()
     on_tpu = backend == "tpu"
@@ -176,6 +251,8 @@ def main() -> None:
         "hbm_roofline_frac": round(gbps / peak, 3) if peak else None,
         "device": kind,
     }
+    if os.environ.get("DYNAMO_BENCH_SERVING", "1") not in ("0", "false"):
+        out["serving"] = serving_measurement(spec, page_size)
     print(json.dumps(out))
 
 

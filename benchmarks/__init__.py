@@ -1,0 +1,8 @@
+"""Serving benchmarks: load generation, SLA profiling, router benches.
+
+Role of the reference's benchmarks/ tree (aiperf wrapper
+benchmarks/utils/benchmark.py, SLA profiler profiler/profile_sla.py,
+router benchmarks) rebuilt self-contained: an asyncio load generator
+against the OpenAI HTTP surface, and a pre-deployment profiler that emits
+the planner's interpolation grids.
+"""

@@ -1,0 +1,214 @@
+"""OpenAI-surface load generator (aiperf equivalent).
+
+``python -m benchmarks.loadgen --url http://host:port --model m
+--concurrency 8 --num-requests 64 --isl 256 --osl 64`` drives streaming
+chat completions at fixed concurrency and reports TTFT / ITL / duration
+percentiles and throughput — the measurement core of the reference's
+benchmarks/utils/benchmark.py (aiperf) with concurrency/ISL/OSL sweep
+support (``--concurrency 1,4,16``).
+
+Synthetic prompts: ISL is approximated in tokenizer-agnostic fashion by
+byte count with a distinct numeric prefix per request (defeats accidental
+full-prefix cache hits unless --shared-prefix asks for them, mirroring the
+reference router benchmarks' prefix_ratio knob).
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import random
+import string
+import sys
+import time
+from dataclasses import dataclass, field
+
+
+@dataclass
+class RequestResult:
+    ok: bool
+    ttft_s: float | None = None
+    itl_s: list[float] = field(default_factory=list)
+    duration_s: float = 0.0
+    output_tokens: int = 0
+    error: str | None = None
+
+
+@dataclass
+class LoadResult:
+    concurrency: int
+    results: list[RequestResult]
+    wall_s: float
+
+    def summary(self) -> dict:
+        ok = [r for r in self.results if r.ok]
+        ttfts = sorted(r.ttft_s for r in ok if r.ttft_s is not None)
+        itls = sorted(x for r in ok for x in r.itl_s)
+        durs = sorted(r.duration_s for r in ok)
+        tokens = sum(r.output_tokens for r in ok)
+
+        def pct(xs, p):
+            if not xs:
+                return None
+            return round(xs[min(len(xs) - 1, int(p * len(xs)))] * 1e3, 3)
+
+        return {
+            "concurrency": self.concurrency,
+            "requests": len(self.results),
+            "errors": len(self.results) - len(ok),
+            "wall_s": round(self.wall_s, 3),
+            "output_tok_per_s": round(tokens / self.wall_s, 2),
+            "req_per_s": round(len(ok) / self.wall_s, 3),
+            "ttft_ms": {"p50": pct(ttfts, 0.5), "p90": pct(ttfts, 0.9),
+                        "p99": pct(ttfts, 0.99)},
+            "itl_ms": {"p50": pct(itls, 0.5), "p90": pct(itls, 0.9),
+                       "p99": pct(itls, 0.99)},
+            "duration_ms": {"p50": pct(durs, 0.5), "p99": pct(durs, 0.99)},
+        }
+
+
+def make_prompt(isl_bytes: int, index: int, shared_prefix: float = 0.0,
+                seed: int = 0) -> str:
+    """~isl_bytes of text; the first shared_prefix fraction is identical
+    across requests (prefix-cache hit material), the rest unique."""
+    rng = random.Random(seed)
+    shared_len = int(isl_bytes * shared_prefix)
+    shared = "".join(rng.choice(string.ascii_lowercase) for _ in range(shared_len))
+    rng_u = random.Random(seed * 7919 + index)
+    unique = "".join(
+        rng_u.choice(string.ascii_lowercase)
+        for _ in range(max(0, isl_bytes - shared_len - 12))
+    )
+    return f"{shared}[req {index:06d}] {unique}"
+
+
+async def run_one(
+    sess, url: str, model: str, prompt: str, osl: int,
+) -> RequestResult:
+    import aiohttp  # noqa: F401 (typing only)
+
+    r = RequestResult(ok=False)
+    t0 = time.perf_counter()
+    try:
+        async with sess.post(
+            f"{url}/v1/chat/completions",
+            json={
+                "model": model,
+                "messages": [{"role": "user", "content": prompt}],
+                "max_tokens": osl,
+                "ignore_eos": True,
+                "stream": True,
+            },
+        ) as resp:
+            if resp.status != 200:
+                r.error = f"http {resp.status}"
+                return r
+            last = None
+            async for line in resp.content:
+                if not line.startswith(b"data: ") or b"[DONE]" in line:
+                    continue
+                now = time.perf_counter()
+                try:
+                    chunk = json.loads(line[len(b"data: "):])
+                except json.JSONDecodeError:
+                    continue
+                delta = (chunk.get("choices") or [{}])[0].get("delta", {})
+                if not delta.get("content") and not delta.get("role"):
+                    continue
+                if last is None:
+                    r.ttft_s = now - t0
+                else:
+                    r.itl_s.append(now - last)
+                last = now
+                r.output_tokens += 1
+            r.ok = True
+    except (OSError, asyncio.TimeoutError) as e:
+        r.error = f"{type(e).__name__}: {e}"
+    except Exception as e:  # noqa: BLE001 - aiohttp stream errors are not OSError
+        import aiohttp
+
+        if not isinstance(e, aiohttp.ClientError):
+            raise
+        r.error = f"{type(e).__name__}: {e}"
+    finally:
+        r.duration_s = time.perf_counter() - t0
+    return r
+
+
+async def run_load(
+    url: str,
+    model: str,
+    *,
+    concurrency: int,
+    num_requests: int,
+    isl: int,
+    osl: int,
+    shared_prefix: float = 0.0,
+    warmup: int = 2,
+    seed: int = 0,
+) -> LoadResult:
+    import aiohttp
+
+    sem = asyncio.Semaphore(concurrency)
+    results: list[RequestResult] = []
+    async with aiohttp.ClientSession(
+        timeout=aiohttp.ClientTimeout(total=600)
+    ) as sess:
+        for i in range(warmup):
+            await run_one(sess, url, model,
+                          make_prompt(isl, 10**6 + i, 0.0, seed), osl)
+
+        t0 = time.perf_counter()
+
+        async def one(i: int):
+            async with sem:
+                results.append(
+                    await run_one(
+                        sess, url, model,
+                        make_prompt(isl, i, shared_prefix, seed), osl,
+                    )
+                )
+
+        await asyncio.gather(*(one(i) for i in range(num_requests)))
+        wall = time.perf_counter() - t0
+    return LoadResult(concurrency=concurrency, results=results, wall_s=wall)
+
+
+async def amain(args) -> list[dict]:
+    out = []
+    for conc in args.concurrency:
+        res = await run_load(
+            args.url, args.model,
+            concurrency=conc,
+            num_requests=args.num_requests,
+            isl=args.isl, osl=args.osl,
+            shared_prefix=args.shared_prefix,
+            warmup=args.warmup, seed=args.seed,
+        )
+        s = res.summary()
+        print(json.dumps(s), flush=True)
+        out.append(s)
+    return out
+
+
+def main() -> None:
+    p = argparse.ArgumentParser(description="dynamo-tpu load generator")
+    p.add_argument("--url", default="http://127.0.0.1:8000")
+    p.add_argument("--model", required=True)
+    p.add_argument("--concurrency", default="8",
+                   help="comma-separated sweep, e.g. 1,4,16")
+    p.add_argument("--num-requests", type=int, default=64)
+    p.add_argument("--isl", type=int, default=256, help="prompt bytes")
+    p.add_argument("--osl", type=int, default=64, help="output tokens")
+    p.add_argument("--shared-prefix", type=float, default=0.0,
+                   help="fraction of the prompt shared across requests")
+    p.add_argument("--warmup", type=int, default=2)
+    p.add_argument("--seed", type=int, default=0)
+    args = p.parse_args()
+    args.concurrency = [int(c) for c in str(args.concurrency).split(",")]
+    asyncio.run(amain(args))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,93 @@
+"""Benchmark harness (benchmarks/): load generator + SLA profiler against
+an in-process mocker stack."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from benchmarks.loadgen import LoadResult, make_prompt, run_load
+from benchmarks.profile_sla import profile_decode, profile_prefill
+
+pytestmark = pytest.mark.integration
+
+
+async def _stack():
+    from dynamo_tpu.frontend.http import HttpFrontend
+    from dynamo_tpu.frontend.watcher import ModelManager, ModelWatcher
+    from dynamo_tpu.mocker.__main__ import launch_mock_worker
+    from dynamo_tpu.mocker.engine import MockEngineConfig
+    from dynamo_tpu.runtime.distributed import DistributedRuntime
+    from dynamo_tpu.runtime.hub import InMemoryHub
+
+    drt = DistributedRuntime(InMemoryHub())
+    cfg = MockEngineConfig(
+        block_size=4, total_kv_blocks=4096, speedup_ratio=1000.0,
+    )
+    for _ in range(2):
+        await launch_mock_worker(
+            drt, "dyn", "backend", "generate", cfg,
+            model_name="bench-model", register_card=True,
+        )
+    manager = ModelManager()
+    watcher = await ModelWatcher(drt, manager).start()
+    await watcher.wait_for_model("bench-model", timeout=5)
+    frontend = HttpFrontend(manager, host="127.0.0.1", port=0)
+    await frontend.start()
+    return drt, watcher, frontend
+
+
+def test_make_prompt_shared_prefix():
+    a = make_prompt(200, 1, shared_prefix=0.5, seed=3)
+    b = make_prompt(200, 2, shared_prefix=0.5, seed=3)
+    assert a[:100] == b[:100]
+    assert a[100:] != b[100:]
+    assert abs(len(a) - 200) < 16
+
+
+async def test_loadgen_reports_percentiles():
+    drt, watcher, frontend = await _stack()
+    try:
+        res = await run_load(
+            f"http://127.0.0.1:{frontend.port}", "bench-model",
+            concurrency=4, num_requests=8, isl=64, osl=8, warmup=1,
+        )
+        assert isinstance(res, LoadResult)
+        s = res.summary()
+        assert s["errors"] == 0, s
+        assert s["requests"] == 8
+        assert s["output_tok_per_s"] > 0
+        assert s["ttft_ms"]["p50"] is not None
+        assert s["itl_ms"]["p50"] is not None
+        assert s["ttft_ms"]["p50"] <= s["ttft_ms"]["p99"]
+    finally:
+        await frontend.stop()
+        await watcher.close()
+        await drt.close()
+
+
+async def test_profiler_emits_planner_grids(tmp_path):
+    from dynamo_tpu.planner import DecodeInterpolator, PrefillInterpolator
+
+    drt, watcher, frontend = await _stack()
+    url = f"http://127.0.0.1:{frontend.port}"
+    try:
+        prefill = await profile_prefill(
+            url, "bench-model", isls=[32, 128], requests_per_point=2
+        )
+        decode = await profile_decode(
+            url, "bench-model", concurrencies=[1, 4], contexts=[32, 128],
+            max_kv_tokens=4096 * 4, osl=8, requests_per_point=2,
+        )
+        np.savez(tmp_path / "prefill.npz", **prefill)
+        np.savez(tmp_path / "decode.npz", **decode)
+        pre = PrefillInterpolator(str(tmp_path / "prefill.npz"))
+        dec = DecodeInterpolator(str(tmp_path / "decode.npz"))
+        assert pre.interpolate_ttft(64) > 0
+        thpt, itl, kv = dec.find_best_throughput_per_chip(10.0, 64)
+        assert thpt > 0 and itl > 0
+    finally:
+        await frontend.stop()
+        await watcher.close()
+        await drt.close()
