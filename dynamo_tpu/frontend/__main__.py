@@ -26,12 +26,22 @@ async def _amain(args: argparse.Namespace) -> None:
     drt = DistributedRuntime(await connect_hub(cfg.hub_address), cfg)
     manager = ModelManager()
     watcher = await ModelWatcher(drt, manager).start()
-    frontend = HttpFrontend(manager, host=args.host, port=cfg.http_port)
+    frontend = HttpFrontend(manager, host=args.host, port=cfg.http_port, drt=drt)
     host, port = await frontend.start()
     print(f"DYNAMO_HTTP={host}:{port}", flush=True)
+    grpc_frontend = None
+    if args.grpc_port is not None:
+        from dynamo_tpu.grpc import KserveGrpcFrontend
+
+        grpc_frontend = await KserveGrpcFrontend(
+            manager, host=args.host, port=args.grpc_port
+        ).start()
+        print(f"DYNAMO_GRPC={args.host}:{grpc_frontend.port}", flush=True)
     try:
         await drt.runtime.wait_for_shutdown()
     finally:
+        if grpc_frontend is not None:
+            await grpc_frontend.stop()
         await frontend.stop()
         await watcher.close()
         await drt.close()
@@ -42,6 +52,9 @@ def main() -> None:
     p.add_argument("--hub", default=None, help="hub address host:port")
     p.add_argument("--host", default="0.0.0.0")
     p.add_argument("--port", type=int, default=None, help="HTTP port (default DYN_HTTP_PORT or 8000)")
+    p.add_argument("--grpc-port", type=int, default=None,
+                   help="also serve the KServe gRPC inference protocol on "
+                        "this port (0 = ephemeral)")
     args = p.parse_args()
     setup_logging()
     try:

@@ -1,0 +1,14 @@
+"""KServe gRPC frontend (Open Inference Protocol v2).
+
+Counterpart of the reference's GRPCInferenceService
+(lib/llm/src/grpc/service/kserve.rs, service/tensor.rs): text-generation
+over the KServe tensor protocol — ``text_input``/``streaming`` input
+tensors, ``text_output`` responses, live/ready/metadata probes, and
+triton-style ModelStreamInfer streaming. Message classes are generated
+from kserve.proto (protoc); service wiring is hand-rolled on
+``grpc.aio``'s generic handlers (no grpc_tools in this image).
+"""
+
+from dynamo_tpu.grpc.service import KserveGrpcFrontend
+
+__all__ = ["KserveGrpcFrontend"]

@@ -1,0 +1,278 @@
+"""KServe GRPCInferenceService over the model pipeline.
+
+Service methods (ref lib/llm/src/grpc/service/kserve.rs):
+  ServerLive / ServerReady / ServerMetadata
+  ModelReady / ModelMetadata        — from the frontend ModelManager
+  ModelInfer                        — unary text generation
+  ModelStreamInfer                  — server-streaming deltas
+
+Text-generation tensor convention (kserve.rs:449-556): request input
+``text_input`` (BYTES) with optional ``streaming`` (BOOL) input and
+sampling parameters in ``parameters`` (max_tokens, temperature, top_p,
+seed, ignore_eos, min_tokens); responses carry ``text_output`` (BYTES).
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Any, AsyncIterator
+
+import grpc
+
+from dynamo_tpu.frontend.protocols import new_request_id
+from dynamo_tpu.grpc import kserve_pb2 as pb
+from dynamo_tpu.runtime.context import Context
+
+log = logging.getLogger("dynamo.grpc")
+
+SERVICE = "inference.GRPCInferenceService"
+
+
+def _param_value(p: pb.InferParameter):
+    which = p.WhichOneof("parameter_choice")
+    return getattr(p, which) if which else None
+
+
+def _text_output_response(
+    model: str, request_id: str, text: str, *, final: bool = False,
+    tokens: int = 0,
+) -> pb.ModelInferResponse:
+    resp = pb.ModelInferResponse(
+        model_name=model,
+        id=request_id,
+        outputs=[
+            pb.ModelInferResponse.InferOutputTensor(
+                name="text_output",
+                datatype="BYTES",
+                shape=[1],
+                contents=pb.InferTensorContents(
+                    bytes_contents=[text.encode("utf-8")]
+                ),
+            )
+        ],
+    )
+    if final:
+        resp.parameters["triton_final_response"].bool_param = True
+    if tokens:
+        resp.parameters["output_tokens"].int64_param = tokens
+    return resp
+
+
+class KserveGrpcFrontend:
+    """grpc.aio server exposing the ModelManager's pipelines."""
+
+    def __init__(self, manager, *, host: str = "127.0.0.1", port: int = 0):
+        self.manager = manager
+        self.host = host
+        self.port = port
+        self._server: grpc.aio.Server | None = None
+
+    # -- lifecycle ---------------------------------------------------------
+
+    async def start(self) -> "KserveGrpcFrontend":
+        self._server = grpc.aio.server()
+        rpcs = {
+            "ServerLive": grpc.unary_unary_rpc_method_handler(
+                self._server_live,
+                request_deserializer=pb.ServerLiveRequest.FromString,
+                response_serializer=pb.ServerLiveResponse.SerializeToString,
+            ),
+            "ServerReady": grpc.unary_unary_rpc_method_handler(
+                self._server_ready,
+                request_deserializer=pb.ServerReadyRequest.FromString,
+                response_serializer=pb.ServerReadyResponse.SerializeToString,
+            ),
+            "ServerMetadata": grpc.unary_unary_rpc_method_handler(
+                self._server_metadata,
+                request_deserializer=pb.ServerMetadataRequest.FromString,
+                response_serializer=pb.ServerMetadataResponse.SerializeToString,
+            ),
+            "ModelReady": grpc.unary_unary_rpc_method_handler(
+                self._model_ready,
+                request_deserializer=pb.ModelReadyRequest.FromString,
+                response_serializer=pb.ModelReadyResponse.SerializeToString,
+            ),
+            "ModelMetadata": grpc.unary_unary_rpc_method_handler(
+                self._model_metadata,
+                request_deserializer=pb.ModelMetadataRequest.FromString,
+                response_serializer=pb.ModelMetadataResponse.SerializeToString,
+            ),
+            "ModelInfer": grpc.unary_unary_rpc_method_handler(
+                self._model_infer,
+                request_deserializer=pb.ModelInferRequest.FromString,
+                response_serializer=pb.ModelInferResponse.SerializeToString,
+            ),
+            "ModelStreamInfer": grpc.unary_stream_rpc_method_handler(
+                self._model_stream_infer,
+                request_deserializer=pb.ModelInferRequest.FromString,
+                response_serializer=pb.ModelStreamInferResponse.SerializeToString,
+            ),
+        }
+        self._server.add_generic_rpc_handlers(
+            (grpc.method_handlers_generic_handler(SERVICE, rpcs),)
+        )
+        self.port = self._server.add_insecure_port(f"{self.host}:{self.port}")
+        await self._server.start()
+        log.info("kserve grpc frontend on %s:%d", self.host, self.port)
+        return self
+
+    async def stop(self) -> None:
+        if self._server is not None:
+            await self._server.stop(grace=1.0)
+            self._server = None
+
+    # -- probes ------------------------------------------------------------
+
+    async def _server_live(self, _req, _ctx) -> pb.ServerLiveResponse:
+        return pb.ServerLiveResponse(live=True)
+
+    async def _server_ready(self, _req, _ctx) -> pb.ServerReadyResponse:
+        return pb.ServerReadyResponse(ready=bool(self.manager.names()))
+
+    async def _server_metadata(self, _req, _ctx) -> pb.ServerMetadataResponse:
+        return pb.ServerMetadataResponse(
+            name="dynamo-tpu", version="0.2", extensions=[]
+        )
+
+    async def _model_ready(self, req, _ctx) -> pb.ModelReadyResponse:
+        return pb.ModelReadyResponse(
+            ready=self.manager.get(req.name) is not None
+        )
+
+    async def _model_metadata(self, req, ctx) -> pb.ModelMetadataResponse:
+        pipe = self.manager.get(req.name)
+        if pipe is None:
+            await ctx.abort(
+                grpc.StatusCode.NOT_FOUND, f"model {req.name!r} not found"
+            )
+        t = pb.ModelMetadataResponse.TensorMetadata
+        return pb.ModelMetadataResponse(
+            name=pipe.card.name,
+            versions=["1"],
+            platform="dynamo-tpu",
+            inputs=[
+                t(name="text_input", datatype="BYTES", shape=[1]),
+                t(name="streaming", datatype="BOOL", shape=[1]),
+            ],
+            outputs=[t(name="text_output", datatype="BYTES", shape=[1])],
+        )
+
+    # -- inference ---------------------------------------------------------
+
+    def _parse_request(self, req: pb.ModelInferRequest):
+        pipe = self.manager.get(req.model_name)
+        if pipe is None:
+            raise KeyError(f"model {req.model_name!r} not found")
+        text = None
+        streaming = None  # None = caller's RPC decides the default
+        for i, tensor in enumerate(req.inputs):
+            if tensor.name == "text_input":
+                if tensor.contents.bytes_contents:
+                    text = tensor.contents.bytes_contents[0].decode("utf-8")
+                elif i < len(req.raw_input_contents):
+                    raw = req.raw_input_contents[i]
+                    # raw BYTES tensors are length-prefixed (u32 LE)
+                    text = raw[4:].decode("utf-8") if len(raw) >= 4 else ""
+            elif tensor.name == "streaming":
+                if tensor.contents.bool_contents:
+                    streaming = bool(tensor.contents.bool_contents[0])
+        if text is None:
+            raise ValueError("missing 'text_input' input tensor")
+
+        body: dict[str, Any] = {"model": req.model_name, "prompt": text}
+        params = {k: _param_value(v) for k, v in req.parameters.items()}
+        for key in ("max_tokens", "min_tokens", "top_k", "seed"):
+            if params.get(key) is not None:
+                body[key] = int(params[key])
+        for key in ("temperature", "top_p"):
+            if params.get(key) is not None:
+                body[key] = float(params[key])
+        if params.get("ignore_eos") is not None:
+            body["ignore_eos"] = bool(params["ignore_eos"])
+        return pipe, body, streaming
+
+    async def _generate(
+        self, pipe, body: dict[str, Any], ctx: Context
+    ) -> AsyncIterator[dict[str, Any]]:
+        preprocessed = pipe.preprocessor.preprocess(body)
+        async for d in pipe.generate(preprocessed, ctx):
+            yield d
+
+    async def _model_infer(self, req, grpc_ctx) -> pb.ModelInferResponse:
+        try:
+            pipe, body, streaming = self._parse_request(req)
+        except KeyError as e:
+            await grpc_ctx.abort(grpc.StatusCode.NOT_FOUND, str(e))
+        except ValueError as e:
+            await grpc_ctx.abort(grpc.StatusCode.INVALID_ARGUMENT, str(e))
+        if streaming is True:
+            # unary RPC cannot stream (ref kserve.rs:225)
+            await grpc_ctx.abort(
+                grpc.StatusCode.INVALID_ARGUMENT,
+                "streaming=true requires the ModelStreamInfer RPC",
+            )
+        rid = req.id or new_request_id()
+        ctx = Context(request_id=rid)
+        parts: list[str] = []
+        tokens = 0
+        try:
+            async for d in self._generate(pipe, body, ctx):
+                if d.get("text"):
+                    parts.append(d["text"])
+                tokens += len(d.get("token_ids") or ())
+                if d.get("finish_reason") == "error":
+                    await grpc_ctx.abort(
+                        grpc.StatusCode.INTERNAL,
+                        d.get("error") or "generation error",
+                    )
+        finally:
+            ctx.stop_generating()
+        return _text_output_response(
+            req.model_name, rid, "".join(parts), final=True, tokens=tokens
+        )
+
+    async def _model_stream_infer(
+        self, req, grpc_ctx
+    ) -> AsyncIterator[pb.ModelStreamInferResponse]:
+        try:
+            pipe, body, streaming = self._parse_request(req)
+        except (KeyError, ValueError) as e:
+            yield pb.ModelStreamInferResponse(error_message=str(e))
+            return
+        rid = req.id or new_request_id()
+        ctx = Context(request_id=rid)
+        streaming = streaming is not False  # stream RPC defaults to True
+        parts: list[str] = []  # aggregation when streaming=false
+        tokens = 0
+        try:
+            async for d in self._generate(pipe, body, ctx):
+                if d.get("finish_reason") == "error":
+                    yield pb.ModelStreamInferResponse(
+                        error_message=d.get("error") or "generation error"
+                    )
+                    return
+                final = d.get("finish_reason") is not None
+                if not streaming:
+                    # streaming=false on the stream RPC: fold into ONE
+                    # final response (ref tensor.rs:43-44)
+                    if d.get("text"):
+                        parts.append(d["text"])
+                    tokens += len(d.get("token_ids") or ())
+                    if final:
+                        yield pb.ModelStreamInferResponse(
+                            infer_response=_text_output_response(
+                                req.model_name, rid, "".join(parts),
+                                final=True, tokens=tokens,
+                            )
+                        )
+                elif d.get("text") or final:
+                    yield pb.ModelStreamInferResponse(
+                        infer_response=_text_output_response(
+                            req.model_name, rid, d.get("text") or "",
+                            final=final,
+                            tokens=len(d.get("token_ids") or ()),
+                        )
+                    )
+        finally:
+            # client disconnect mid-stream cancels the backend request
+            ctx.stop_generating()

@@ -1,0 +1,168 @@
+"""KServe gRPC frontend (dynamo_tpu/grpc): probes, unary + streaming infer
+over a live mocker fleet with a real grpc.aio client."""
+
+import grpc
+import pytest
+
+from dynamo_tpu.grpc import KserveGrpcFrontend
+from dynamo_tpu.grpc import kserve_pb2 as pb
+
+pytestmark = pytest.mark.integration
+
+SERVICE = "/inference.GRPCInferenceService"
+
+
+async def _stack():
+    from dynamo_tpu.frontend.watcher import ModelManager, ModelWatcher
+    from dynamo_tpu.mocker.__main__ import launch_mock_worker
+    from dynamo_tpu.mocker.engine import MockEngineConfig
+    from dynamo_tpu.runtime.distributed import DistributedRuntime
+    from dynamo_tpu.runtime.hub import InMemoryHub
+
+    drt = DistributedRuntime(InMemoryHub())
+    cfg = MockEngineConfig(
+        block_size=4, total_kv_blocks=512, speedup_ratio=500.0,
+        echo_prompt=True,  # deterministic output (== prompt bytes)
+    )
+    await launch_mock_worker(
+        drt, "dyn", "backend", "generate", cfg,
+        model_name="grpc-model", register_card=True,
+    )
+    manager = ModelManager()
+    watcher = await ModelWatcher(drt, manager).start()
+    await watcher.wait_for_model("grpc-model", timeout=5)
+    server = await KserveGrpcFrontend(manager, port=0).start()
+    return drt, watcher, server
+
+
+def _infer_request(model: str, prompt: str, max_tokens: int = 6):
+    req = pb.ModelInferRequest(
+        model_name=model,
+        id="req-1",
+        inputs=[
+            pb.ModelInferRequest.InferInputTensor(
+                name="text_input", datatype="BYTES", shape=[1],
+                contents=pb.InferTensorContents(
+                    bytes_contents=[prompt.encode()]
+                ),
+            ),
+        ],
+    )
+    req.parameters["max_tokens"].int64_param = max_tokens
+    req.parameters["ignore_eos"].bool_param = True
+    req.parameters["temperature"].double_param = 0.0
+    return req
+
+
+async def test_grpc_probes_and_infer():
+    drt, watcher, server = await _stack()
+    try:
+        async with grpc.aio.insecure_channel(
+            f"127.0.0.1:{server.port}"
+        ) as chan:
+            live = await chan.unary_unary(
+                f"{SERVICE}/ServerLive",
+                request_serializer=pb.ServerLiveRequest.SerializeToString,
+                response_deserializer=pb.ServerLiveResponse.FromString,
+            )(pb.ServerLiveRequest())
+            assert live.live
+
+            ready = await chan.unary_unary(
+                f"{SERVICE}/ModelReady",
+                request_serializer=pb.ModelReadyRequest.SerializeToString,
+                response_deserializer=pb.ModelReadyResponse.FromString,
+            )(pb.ModelReadyRequest(name="grpc-model"))
+            assert ready.ready
+            not_ready = await chan.unary_unary(
+                f"{SERVICE}/ModelReady",
+                request_serializer=pb.ModelReadyRequest.SerializeToString,
+                response_deserializer=pb.ModelReadyResponse.FromString,
+            )(pb.ModelReadyRequest(name="nope"))
+            assert not not_ready.ready
+
+            meta = await chan.unary_unary(
+                f"{SERVICE}/ModelMetadata",
+                request_serializer=pb.ModelMetadataRequest.SerializeToString,
+                response_deserializer=pb.ModelMetadataResponse.FromString,
+            )(pb.ModelMetadataRequest(name="grpc-model"))
+            assert meta.inputs[0].name == "text_input"
+            assert meta.outputs[0].name == "text_output"
+
+            infer = chan.unary_unary(
+                f"{SERVICE}/ModelInfer",
+                request_serializer=pb.ModelInferRequest.SerializeToString,
+                response_deserializer=pb.ModelInferResponse.FromString,
+            )
+            resp = await infer(_infer_request("grpc-model", "hello grpc"))
+            assert resp.model_name == "grpc-model"
+            assert resp.outputs[0].name == "text_output"
+            assert resp.parameters["output_tokens"].int64_param == 6
+            # deterministic greedy mock output: same request -> same bytes
+            resp2 = await infer(_infer_request("grpc-model", "hello grpc"))
+            assert (
+                resp.outputs[0].contents.bytes_contents
+                == resp2.outputs[0].contents.bytes_contents
+            )
+
+            # unknown model -> NOT_FOUND
+            with pytest.raises(grpc.aio.AioRpcError) as err:
+                await infer(_infer_request("nope", "x"))
+            assert err.value.code() == grpc.StatusCode.NOT_FOUND
+    finally:
+        await server.stop()
+        await watcher.close()
+        await drt.close()
+
+
+async def test_grpc_stream_infer():
+    drt, watcher, server = await _stack()
+    try:
+        async with grpc.aio.insecure_channel(
+            f"127.0.0.1:{server.port}"
+        ) as chan:
+            stream = chan.unary_stream(
+                f"{SERVICE}/ModelStreamInfer",
+                request_serializer=pb.ModelInferRequest.SerializeToString,
+                response_deserializer=pb.ModelStreamInferResponse.FromString,
+            )
+            chunks = []
+            finals = 0
+            async for item in stream(
+                _infer_request("grpc-model", "stream me", max_tokens=8)
+            ):
+                assert not item.error_message
+                r = item.infer_response
+                chunks.append(
+                    b"".join(r.outputs[0].contents.bytes_contents)
+                )
+                if r.parameters["triton_final_response"].bool_param:
+                    finals += 1
+            assert len(chunks) >= 2  # streamed, not folded
+            assert finals == 1
+
+            # streaming=false folds the stream into one final response
+            req_folded = _infer_request("grpc-model", "fold me", max_tokens=6)
+            req_folded.inputs.append(
+                pb.ModelInferRequest.InferInputTensor(
+                    name="streaming", datatype="BOOL", shape=[1],
+                    contents=pb.InferTensorContents(bool_contents=[False]),
+                )
+            )
+            folded = [item async for item in stream(req_folded)]
+            assert len(folded) == 1
+            fr = folded[0].infer_response
+            assert fr.parameters["triton_final_response"].bool_param
+            assert fr.parameters["output_tokens"].int64_param == 6
+
+            # bad request -> error message on the stream
+            got_err = False
+            async for item in stream(
+                pb.ModelInferRequest(model_name="grpc-model")
+            ):
+                if item.error_message:
+                    got_err = True
+            assert got_err
+    finally:
+        await server.stop()
+        await watcher.close()
+        await drt.close()
