@@ -1,34 +1,174 @@
 """dynamo-tpu CLI: single entry wiring inputs to engines.
 
 Equivalent of the reference's ``dynamo-run`` binary (launch/dynamo-run/
-src/main.rs:29, opt.rs:7-25): ``dynamo-tpu <subcommand>`` launches the hub,
-a frontend, a worker, or utility tools. Subcommands grow with the framework;
-``hub`` is available from M2.
+src/main.rs:29, opt.rs:7-25 ``Input{http,text}`` x ``Output{auto, mocker,
+echo, dyn://}``):
+
+  dynamo-tpu run --in http --out engine --model-path /ckpt   one-process
+      serving stack (in-memory hub + worker + OpenAI frontend)
+  dynamo-tpu run --in text --out echo                        interactive REPL
+  dynamo-tpu hub|frontend|worker|mocker|router|planner ...   launch the
+      corresponding service process (same as python -m dynamo_tpu.<mod>)
+  dynamo-tpu bench|profile ...                               load generator /
+      SLA profiler (benchmarks/)
 """
 
 from __future__ import annotations
 
+import argparse
+import asyncio
 import sys
+
+SUBCOMMAND_MODULES = {
+    "hub": "dynamo_tpu.runtime.hub_server",
+    "frontend": "dynamo_tpu.frontend.__main__",
+    "worker": "dynamo_tpu.engine.worker",
+    "mocker": "dynamo_tpu.mocker.__main__",
+    "router": "dynamo_tpu.kv_router.service",
+    "planner": "dynamo_tpu.planner.__main__",
+    "bench": "benchmarks.loadgen",
+    "profile": "benchmarks.profile_sla",
+}
+
+
+def _usage() -> str:
+    return (
+        "usage: dynamo-tpu <command> [args]\n"
+        "commands:\n"
+        "  run        one-process serving stack (--in http|text "
+        "--out engine|mocker|echo)\n"
+        + "".join(f"  {name:<10} launch {mod}\n"
+                  for name, mod in SUBCOMMAND_MODULES.items())
+    )
+
+
+async def _arun(args: argparse.Namespace) -> None:
+    from dynamo_tpu.frontend.watcher import ModelManager, ModelWatcher
+    from dynamo_tpu.runtime.distributed import DistributedRuntime
+    from dynamo_tpu.runtime.hub import InMemoryHub
+
+    drt = DistributedRuntime(InMemoryHub())
+    model_name = args.model_name
+    if args.out in ("mocker", "echo"):
+        from dynamo_tpu.mocker.__main__ import launch_mock_worker
+        from dynamo_tpu.mocker.engine import MockEngineConfig
+
+        cfg = MockEngineConfig(
+            block_size=16, speedup_ratio=args.speedup_ratio,
+            echo_prompt=args.out == "echo",
+        )
+        model_name = model_name or (
+            "echo" if args.out == "echo" else "mock-model"
+        )
+        await launch_mock_worker(
+            drt, args.namespace, "backend", "generate", cfg,
+            model_name=model_name, register_card=True,
+        )
+    elif args.out == "engine":
+        from dynamo_tpu.engine.config import EngineConfig
+        from dynamo_tpu.engine.worker import launch_engine_worker
+
+        engine, _ = await launch_engine_worker(
+            drt,
+            namespace=args.namespace,
+            model=args.model,
+            model_path=args.model_path,
+            model_name=model_name,
+            engine_config=EngineConfig(tp=args.tp),
+        )
+        model_name = model_name or engine.spec.name
+    else:
+        raise SystemExit(f"unknown --out {args.out!r}")
+
+    manager = ModelManager()
+    watcher = await ModelWatcher(drt, manager).start()
+    await watcher.wait_for_model(model_name, timeout=30)
+
+    if args.inp == "http":
+        from dynamo_tpu.frontend.http import HttpFrontend
+
+        frontend = HttpFrontend(
+            manager, host=args.host, port=args.port, drt=drt
+        )
+        host, port = await frontend.start()
+        print(f"DYNAMO_HTTP={host}:{port}", flush=True)
+        print(
+            f"serving {model_name!r}: POST http://{host}:{port}"
+            "/v1/chat/completions",
+            flush=True,
+        )
+        await drt.runtime.wait_for_shutdown()
+        return
+
+    if args.inp == "text":
+        from dynamo_tpu.runtime.context import Context
+
+        pipe = manager.get(model_name)
+        print(f"interactive chat with {model_name!r} (ctrl-d to exit)")
+        loop = asyncio.get_running_loop()
+        while True:
+            try:
+                line = await loop.run_in_executor(None, input, "> ")
+            except EOFError:
+                return
+            if not line.strip():
+                continue
+            body = {
+                "model": model_name, "max_tokens": args.max_tokens,
+                "messages": [{"role": "user", "content": line}],
+            }
+            pre = pipe.preprocessor.preprocess(body)
+            async for d in pipe.generate(pre, Context()):
+                if d.get("text"):
+                    print(d["text"], end="", flush=True)
+            print()
+    else:
+        raise SystemExit(f"unknown --in {args.inp!r}")
+
+
+def _run_command(rest: list[str]) -> int:
+    p = argparse.ArgumentParser(prog="dynamo-tpu run")
+    p.add_argument("--in", dest="inp", default="http",
+                   choices=["http", "text"])
+    p.add_argument("--out", default="mocker",
+                   choices=["engine", "mocker", "echo"])
+    p.add_argument("--model", default="tiny-test",
+                   help="model preset (out=engine)")
+    p.add_argument("--model-path", default=None,
+                   help="local checkpoint dir (out=engine)")
+    p.add_argument("--model-name", default=None)
+    p.add_argument("--namespace", default="dynamo")
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=8000)
+    p.add_argument("--tp", type=int, default=1)
+    p.add_argument("--max-tokens", type=int, default=128)
+    p.add_argument("--speedup-ratio", type=float, default=1.0)
+    args = p.parse_args(rest)
+    try:
+        asyncio.run(_arun(args))
+    except KeyboardInterrupt:
+        pass
+    return 0
 
 
 def main(argv: list[str] | None = None) -> int:
     argv = list(sys.argv[1:] if argv is None else argv)
     if not argv or argv[0] in ("-h", "--help"):
-        print(
-            "usage: dynamo-tpu <command> [args]\n"
-            "commands:\n"
-            "  hub        run the coordination service (hub)\n"
-        )
+        print(_usage())
         return 0
     cmd, rest = argv[0], argv[1:]
-    if cmd == "hub":
-        from dynamo_tpu.runtime import hub_server
+    if cmd == "run":
+        return _run_command(rest)
+    mod_name = SUBCOMMAND_MODULES.get(cmd)
+    if mod_name is None:
+        print(f"unknown command: {cmd!r}\n{_usage()}", file=sys.stderr)
+        return 2
+    import importlib
 
-        sys.argv = ["dynamo-tpu hub", *rest]
-        hub_server.main()
-        return 0
-    print(f"unknown command: {cmd!r}", file=sys.stderr)
-    return 2
+    mod = importlib.import_module(mod_name)
+    sys.argv = [f"dynamo-tpu {cmd}", *rest]
+    mod.main()
+    return 0
 
 
 if __name__ == "__main__":

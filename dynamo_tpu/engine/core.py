@@ -144,6 +144,7 @@ class InferenceEngine:
         self._closed = False
         self.steps = 0
         self._partial: _PartialPrefill | None = None
+        self._clear_cache_requested = False
 
     # -- events ------------------------------------------------------------
 
@@ -202,6 +203,18 @@ class InferenceEngine:
         if not token_ids:
             yield {"token_ids": [], "finish_reason": "error",
                    "error": "empty token_ids"}
+            return
+        if request.get("embedding_request"):
+            # standalone forward (no KV pages touched): safe to dispatch
+            # off the step loop; JAX serializes device execution
+            try:
+                emb = await asyncio.to_thread(self._embed, token_ids)
+            except Exception as e:  # noqa: BLE001
+                yield {"token_ids": [], "finish_reason": "error",
+                       "error": f"embedding failed: {e}"}
+                return
+            yield {"token_ids": [], "embedding": emb,
+                   "finish_reason": "stop"}
             return
         if len(token_ids) >= self.config.max_context:
             yield {"token_ids": [], "finish_reason": "error",
@@ -291,8 +304,22 @@ class InferenceEngine:
                     )
                 await asyncio.sleep(0.05)
 
+    def request_clear_cache(self) -> None:
+        """Admin: drop every inactive prefix-cache page (ref the HTTP
+        service's clear_kv_blocks route + block-manager controller). The
+        flag is honored on the step loop — the allocator's owner — so no
+        locking against in-flight decode."""
+        self._clear_cache_requested = True
+        self._wake.set()
+
     async def _step(self) -> bool:
         did = False
+        if self._clear_cache_requested:
+            self._clear_cache_requested = False
+            n = self.allocator.clear_cache()
+            log.info("admin clear_kv_blocks: evicted %d cached pages", n)
+            self._publish_metrics()
+            did = True
         # 1) advance an in-flight chunked prefill, or admit one waiting
         # request (prefill); either way decode still runs below, so a long
         # prompt only ever steals one chunk's worth of device time per step
@@ -349,6 +376,17 @@ class InferenceEngine:
                 {"token_ids": [], "finish_reason": "error",
                  "error": f"prefill failed: {e}"},
             )
+
+    def _embed(self, token_ids: list[int]) -> list[float]:
+        """Pooled sequence embedding (bucketed pad for compile reuse)."""
+        bucket = self.config.bucket_for(len(token_ids))
+        padded = np.zeros((bucket,), np.int32)
+        padded[: len(token_ids)] = token_ids
+        emb = llama.embed_forward(
+            self.spec, self.params, jnp.asarray(padded),
+            jnp.asarray(len(token_ids), jnp.int32),
+        )
+        return np.asarray(emb, np.float32).tolist()
 
     def prefix_hit_tokens(self, token_ids: list[int]) -> int:
         """How many leading prompt tokens are locally cached — G1 device

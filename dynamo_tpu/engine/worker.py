@@ -43,6 +43,7 @@ async def launch_engine_worker(
     model: str = "tiny-test",
     model_path: str | None = None,
     model_name: str | None = None,
+    model_type: str = "chat",
     tokenizer: str = "mock",
     engine_config: EngineConfig | None = None,
     spec: ModelSpec | None = None,
@@ -136,6 +137,7 @@ async def launch_engine_worker(
         served, _card = await register_llm(
             drt, ep, handler.generate,
             model_name=model_name or spec.name,
+            model_type=model_type,
             tokenizer=tokenizer,
             context_length=cfg.max_context,
             kv_block_size=cfg.page_size,
@@ -146,6 +148,27 @@ async def launch_engine_worker(
             metadata={"engine": "jax", "role": mode},
         )
         comp_path = f"{namespace}/{component}"
+
+    # admin endpoint: control-plane ops (ref block_manager controller.rs /
+    # the HTTP clear_kv_blocks route); endpoint-scoped instance keys keep
+    # it invisible to generate-routing clients
+    async def admin_handler(request, context):
+        if request.get("op") == "clear_kv_blocks":
+            engine.request_clear_cache()
+            yield {"ok": True}
+        elif request.get("op") == "cache_status":
+            yield {
+                "ok": True,
+                "active_pages": engine.allocator.active_pages,
+                "cached_pages": engine.allocator.evictable_pages,
+                "free_pages": engine.allocator.free_pages,
+            }
+        else:
+            yield {"ok": False, "error": f"unknown op {request.get('op')!r}"}
+
+    admin_component = prefill_component if mode == "prefill" else component
+    admin_ep = drt.namespace(namespace).component(admin_component).endpoint("admin")
+    await admin_ep.serve(admin_handler, metadata={"role": "admin"})
 
     engine.frontdoor = handler
     wid = served.instance.instance_id
@@ -259,6 +282,7 @@ async def _amain(args: argparse.Namespace) -> None:
         model=args.model,
         model_path=args.model_path,
         model_name=args.model_name,
+        model_type=args.model_type,
         tokenizer=args.tokenizer,
         engine_config=ecfg,
         router_mode=args.router_mode,
@@ -286,6 +310,8 @@ def main() -> None:
                    help="local checkpoint dir (config.json + *.safetensors); "
                         "overrides --model")
     p.add_argument("--model-name", default=None, help="served model name")
+    p.add_argument("--model-type", default="chat",
+                   choices=["chat", "completions", "embeddings"])
     p.add_argument("--tokenizer", default="mock")
     p.add_argument("--page-size", type=int, default=16)
     p.add_argument("--num-pages", type=int, default=2048)

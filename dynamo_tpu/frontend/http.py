@@ -25,7 +25,7 @@ from aiohttp import web
 
 from dynamo_tpu.frontend.protocols import new_request_id
 from dynamo_tpu.frontend.watcher import ModelManager, ModelPipeline
-from dynamo_tpu.runtime.context import Context
+from dynamo_tpu.runtime.context import Context, StreamError
 from dynamo_tpu.runtime.metrics import MetricsRegistry
 
 log = logging.getLogger("dynamo.http")
@@ -39,19 +39,23 @@ class HttpFrontend:
         host: str = "0.0.0.0",
         port: int = 8000,
         metrics: MetricsRegistry | None = None,
+        drt=None,  # DistributedRuntime: enables admin routes
     ):
         self.manager = manager
         self.host = host
         self.port = port
         self.metrics = metrics or MetricsRegistry()
+        self._drt = drt
         self._runner: web.AppRunner | None = None
         self.app = web.Application()
         self.app.add_routes(
             [
                 web.post("/v1/chat/completions", self.chat_completions),
                 web.post("/v1/completions", self.completions),
+                web.post("/v1/responses", self.responses),
                 web.post("/v1/embeddings", self.embeddings),
                 web.get("/v1/models", self.models),
+                web.post("/clear_kv_blocks", self.clear_kv_blocks),
                 web.get("/health", self.health),
                 web.get("/live", self.health),
                 web.get("/ready", self.health),
@@ -253,6 +257,167 @@ class HttpFrontend:
             ctx.stop_generating()
         await resp.write_eof()
         return resp
+
+    async def responses(self, request: web.Request) -> web.StreamResponse:
+        """OpenAI Responses API surface (/v1/responses, ref http service
+        openai.rs route list): maps input onto the chat pipeline; streams
+        response.output_text.delta events or returns one response object."""
+        try:
+            body = await request.json()
+        except json.JSONDecodeError:
+            return _error(400, "invalid JSON body")
+        pipe, err = self._pipeline_or_error(body)
+        if err is not None:
+            return err
+        model = pipe.card.name
+        inp = body.get("input", "")
+        messages = (
+            inp if isinstance(inp, list)
+            else [{"role": "user", "content": str(inp)}]
+        )
+        chat_body = {
+            "model": model,
+            "messages": messages,
+            "max_tokens": body.get("max_output_tokens"),
+            "temperature": body.get("temperature"),
+            "top_p": body.get("top_p"),
+        }
+        chat_body = {k: v for k, v in chat_body.items() if v is not None}
+        ctx = Context(request_id=new_request_id())
+        rid = f"resp_{ctx.id}"
+        try:
+            preprocessed = pipe.preprocessor.preprocess(chat_body)
+        except ValueError as e:
+            return _error(400, str(e))
+        prompt_tokens = len(preprocessed["token_ids"])
+        deltas = self._timed_stream(
+            pipe.generate(preprocessed, ctx), model, time.monotonic()
+        )
+
+        if body.get("stream"):
+            resp = web.StreamResponse(
+                headers={"Content-Type": "text/event-stream",
+                         "Cache-Control": "no-store"}
+            )
+            await resp.prepare(request)
+
+            async def send(event: str, payload: dict) -> None:
+                await resp.write(
+                    f"event: {event}\ndata: {json.dumps(payload)}\n\n".encode()
+                )
+
+            await send("response.created",
+                       {"response": {"id": rid, "status": "in_progress"}})
+            n_out = 0
+            try:
+                async for d in deltas:
+                    n_out += len(d.get("token_ids") or ())
+                    if d.get("finish_reason") == "error":
+                        await send("response.failed", {
+                            "response": {
+                                "id": rid, "status": "failed",
+                                "error": {"message": d.get("error")
+                                          or "generation error"},
+                            }
+                        })
+                        await resp.write_eof()
+                        return resp
+                    if d.get("text"):
+                        await send(
+                            "response.output_text.delta",
+                            {"delta": d["text"], "item_id": rid},
+                        )
+                await send("response.completed", {
+                    "response": {
+                        "id": rid, "status": "completed",
+                        "usage": {"input_tokens": prompt_tokens,
+                                  "output_tokens": n_out},
+                    }
+                })
+            except (ConnectionResetError, asyncio.CancelledError, StreamError):
+                ctx.stop_generating()
+                raise
+            await resp.write_eof()
+            self._mark_completed(model, prompt_tokens)
+            return resp
+
+        try:
+            agg = await pipe.preprocessor.aggregate_chat(
+                deltas, request_id=ctx.id, prompt_tokens=prompt_tokens,
+                request=body,
+            )
+        except StreamError as e:
+            ctx.stop_generating()
+            return _error(502, f"generation failed: {e}")
+        if agg["choices"][0]["finish_reason"] == "error":
+            return _error(502, "generation error")
+        msg = agg["choices"][0]["message"]
+        self._mark_completed(model, prompt_tokens)
+        return web.json_response({
+            "id": rid,
+            "object": "response",
+            "created_at": agg["created"],
+            "status": "completed",
+            "model": model,
+            "output": [{
+                "type": "message",
+                "id": f"msg_{ctx.id}",
+                "role": "assistant",
+                "status": "completed",
+                "content": [{
+                    "type": "output_text",
+                    "text": msg.get("content") or "",
+                    "annotations": [],
+                }],
+            }],
+            "usage": {
+                "input_tokens": prompt_tokens,
+                "output_tokens": agg["usage"]["completion_tokens"],
+                "total_tokens": agg["usage"]["total_tokens"],
+            },
+        })
+
+    async def clear_kv_blocks(self, request: web.Request) -> web.Response:
+        """Admin: evict every worker's inactive prefix-cache pages (ref
+        http/service/clear_kv_blocks.rs -> worker admin endpoints)."""
+        if self._drt is None:
+            return _error(501, "admin plane unavailable (no runtime handle)")
+        results: dict[str, Any] = {}
+        # discover every component exposing an admin endpoint — NOT via
+        # model cards: prefill workers register no card but do register
+        # admin (disagg deployments must clear both pools)
+        instance_keys = await self._drt.hub.get_prefix("v1/instances/")
+        admin_components: set[tuple[str, str]] = set()
+        for key in instance_keys:
+            parts = key.split("/")
+            # v1/instances/{ns}/{component}/{endpoint}/{instance}
+            if len(parts) >= 6 and parts[4] == "admin":
+                admin_components.add((parts[2], parts[3]))
+        for ns, comp in sorted(admin_components):
+            ep = self._drt.namespace(ns).component(comp).endpoint("admin")
+            client = await ep.client().start()
+            try:
+                try:
+                    await client.wait_for_instances(1, timeout=2)
+                except TimeoutError:
+                    results[f"{ns}/{comp}"] = {"error": "no admin instances"}
+                    continue
+                acks = 0
+                for inst in client.instances():
+                    try:
+                        async for item in client.call_instance(
+                            inst.instance_id, {"op": "clear_kv_blocks"},
+                            Context(),
+                        ):
+                            if isinstance(item, dict) and item.get("ok"):
+                                acks += 1
+                            break
+                    except StreamError:
+                        pass
+                results[f"{ns}/{comp}"] = {"workers_cleared": acks}
+            finally:
+                await client.close()
+        return web.json_response({"results": results})
 
     async def embeddings(self, request: web.Request) -> web.Response:
         try:

@@ -61,6 +61,13 @@ class KvRouter:
         if self._started:
             return self
         self._started = True
+        # late-start catch-up = snapshot (compacted base) + event replay
+        # (recent tail) — ref kv_router.rs RADIX_STATE_BUCKET restore
+        try:
+            await self.load_snapshot()
+        except Exception:  # noqa: BLE001
+            log.warning("radix snapshot restore failed; replay-only start",
+                        exc_info=True)
         loop = asyncio.get_running_loop()
         self._tasks.append(loop.create_task(self._consume_events()))
         self._tasks.append(loop.create_task(self._consume_metrics()))
@@ -70,15 +77,39 @@ class KvRouter:
 
     async def _consume_events(self) -> None:
         subject = KV_EVENT_SUBJECT.format(component=self.component_path)
+        events_since_snapshot = 0
         try:
             # replay: catch up on events published before this router started
-            async for _subj, payload in self.hub.subscribe(subject, replay=True):
+            async for _subj, payload, seq in self.hub.subscribe(
+                subject, replay=True, with_seq=True
+            ):
                 try:
                     ev = RouterEvent.from_dict(payload)
                     self.tree.apply_event(ev.worker_id, ev.event)
                 except (KeyError, ValueError, TypeError):
                     # one malformed event must not kill the consumer
                     log.warning("dropping malformed kv event: %r", payload)
+                    continue
+                events_since_snapshot += 1
+                if events_since_snapshot >= self.config.snapshot_threshold:
+                    # compaction (ref router_snapshot_threshold,
+                    # kv_router.rs:66-71): persist the radix state, then
+                    # trim ONLY the retained events this snapshot covers
+                    # (<= seq) — later events a late router hasn't seen
+                    # must survive for its replay.
+                    events_since_snapshot = 0
+                    try:
+                        await self.save_snapshot()
+                        dropped = await self.hub.purge_subject(
+                            subject, up_to_seq=seq
+                        )
+                        log.info(
+                            "radix snapshot saved; purged %d covered events",
+                            dropped,
+                        )
+                    except Exception:  # noqa: BLE001
+                        log.warning("snapshot compaction failed",
+                                    exc_info=True)
         except asyncio.CancelledError:
             pass
         except ConnectionError:

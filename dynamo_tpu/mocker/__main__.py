@@ -32,6 +32,7 @@ async def launch_mock_worker(
     model_name: str = "mock-model",
     register_card: bool = False,
     router_mode: str = "kv",
+    model_type: str = "chat",
     tool_call_parser: str | None = None,
     reasoning_parser: str | None = None,
 ) -> tuple[MockEngine, object]:
@@ -44,6 +45,7 @@ async def launch_mock_worker(
         served, _card = await register_llm(
             drt, ep, engine.generate,
             model_name=model_name,
+            model_type=model_type,
             tokenizer="mock",
             kv_block_size=config.block_size,
             router_mode=router_mode,

@@ -105,6 +105,18 @@ class MockEngine:
     ) -> AsyncIterator[dict[str, Any]]:
         cfg = self.config
         token_ids: list[int] = list(request.get("token_ids") or [])
+        if request.get("embedding_request"):
+            # deterministic fake embedding: seeded by the token ids, so
+            # identical inputs embed identically (frontend E2E tests)
+            import random as _random
+
+            rng = _random.Random(hash(tuple(token_ids)) & 0xFFFFFFFF)
+            yield {
+                "token_ids": [],
+                "embedding": [round(rng.uniform(-1, 1), 6) for _ in range(8)],
+                "finish_reason": "stop",
+            }
+            return
         stop = request.get("stop_conditions") or {}
         max_tokens = int(stop.get("max_tokens") or 16)
         ignore_eos = bool(stop.get("ignore_eos", True))

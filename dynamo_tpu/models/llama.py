@@ -444,6 +444,39 @@ def _insert_kv_pages_impl(k_pages, v_pages, page_ids, k_blocks, v_blocks):
 insert_kv_pages = jax.jit(_insert_kv_pages_impl, donate_argnums=(0, 1))
 
 
+# ------------------------------------------------------------- embeddings
+
+
+def embed_forward_impl(
+    spec: ModelSpec,
+    params: Params,
+    tokens: jax.Array,  # [T_pad] int32 (padded)
+    num_tokens: jax.Array,  # scalar: real token count
+) -> jax.Array:
+    """Sequence embedding: mean-pool the final-norm hidden states over the
+    real tokens, L2-normalized — the serving surface behind /v1/embeddings
+    (ref: the embeddings path of the HTTP service, http/service/openai.rs
+    /v1/embeddings; engine side delegated in the reference, native here).
+    Returns [hidden_size] float32."""
+    T = tokens.shape[0]
+    positions = jnp.arange(T)
+    x = params["embed"][tokens]
+    for lp in params["layers"]:
+        h = rms_norm(x, lp["attn_norm"], spec.rms_eps)
+        q, k, v = _attn_qkv(spec, lp, h, positions)
+        attn = causal_attention(q, k, v, positions, num_tokens)
+        x = x + attn.reshape(T, -1) @ lp["wo"]
+        h = rms_norm(x, lp["mlp_norm"], spec.rms_eps)
+        x = x + _ffn(spec, lp, h)
+    xn = rms_norm(x, params["final_norm"], spec.rms_eps).astype(jnp.float32)
+    mask = (positions < num_tokens)[:, None].astype(jnp.float32)
+    pooled = (xn * mask).sum(axis=0) / jnp.maximum(mask.sum(), 1.0)
+    return pooled / jnp.maximum(jnp.linalg.norm(pooled), 1e-9)
+
+
+embed_forward = jax.jit(embed_forward_impl, static_argnums=(0,))
+
+
 # -------------------------------------------------------------- reference
 
 

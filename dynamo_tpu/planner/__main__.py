@@ -67,6 +67,17 @@ def build_planner(args, hub=None) -> SlaPlanner:
 
     worker_counts = None
     if hub is not None:
+        def _count_workers(keys: dict) -> int:
+            # v1/instances/{ns}/{component}/{endpoint}/{id}: count serving
+            # endpoints, excluding the control-plane "admin" one (endpoint
+            # names are configurable, so don't hardcode "generate")
+            n = 0
+            for key in keys:
+                parts = key.split("/")
+                if len(parts) >= 6 and parts[4] != "admin":
+                    n += 1
+            return n
+
         async def worker_counts():
             p = await hub.get_prefix(
                 f"v1/instances/{cfg.namespace}/{cfg.prefill_component}/"
@@ -74,7 +85,7 @@ def build_planner(args, hub=None) -> SlaPlanner:
             d = await hub.get_prefix(
                 f"v1/instances/{cfg.namespace}/{cfg.decode_component}/"
             )
-            return len(p), len(d)
+            return _count_workers(p), _count_workers(d)
 
     return SlaPlanner(
         cfg, prefill, decode, connector=connector,

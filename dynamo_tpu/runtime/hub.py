@@ -93,6 +93,19 @@ class Hub:
     async def publish(self, subject: str, payload: Any) -> None:
         raise NotImplementedError
 
+    async def purge_subject(
+        self, subject: str, keep_last: int = 0,
+        up_to_seq: int | None = None,
+    ) -> int:
+        """Drop retained history for ``subject`` (snapshot compaction:
+        after a consumer persists a snapshot, replay for late starters
+        only needs the uncovered tail). With ``up_to_seq`` only events
+        whose publish sequence is <= that value are dropped — the caller
+        passes the seq of the last event its snapshot covers, so nothing
+        unseen is ever lost; otherwise all but the newest ``keep_last``
+        drop. Returns the number of events dropped."""
+        raise NotImplementedError
+
     def subscribe(
         self, subject: str, *, replay: bool = False
     ) -> AsyncIterator[tuple[str, Any]]:
@@ -124,7 +137,8 @@ class InMemoryHub(Hub):
     RETAIN_PER_SUBJECT = 65536
 
     def __init__(self) -> None:
-        self._retained: dict[str, deque] = {}  # subject -> recent payloads
+        self._retained: dict[str, deque] = {}  # subject -> (seq, payload)
+        self._subject_seq: dict[str, int] = {}  # publish counter per subject
         self._kv: dict[str, Any] = {}
         self._key_lease: dict[str, int] = {}
         self._leases: dict[int, _Lease] = {}
@@ -245,28 +259,52 @@ class InMemoryHub(Hub):
     async def publish(self, subject: str, payload: Any) -> None:
         if subject not in self._retained:
             self._retained[subject] = deque(maxlen=self.RETAIN_PER_SUBJECT)
-        self._retained[subject].append(payload)
+        seq = self._subject_seq.get(subject, 0) + 1
+        self._subject_seq[subject] = seq
+        self._retained[subject].append((seq, payload))
         for pattern, q in self._subs:
             if fnmatch.fnmatchcase(subject, pattern):
-                q.put_nowait((subject, payload))
+                q.put_nowait((subject, payload, seq))
+
+    async def purge_subject(
+        self, subject: str, keep_last: int = 0,
+        up_to_seq: int | None = None,
+    ) -> int:
+        dropped = 0
+        for subj in list(self._retained):
+            if not fnmatch.fnmatchcase(subj, subject):
+                continue
+            dq = self._retained[subj]
+            if up_to_seq is not None:
+                while dq and dq[0][0] <= up_to_seq:
+                    dq.popleft()
+                    dropped += 1
+            else:
+                while len(dq) > keep_last:
+                    dq.popleft()
+                    dropped += 1
+        return dropped
 
     async def subscribe(
-        self, subject: str, *, replay: bool = False
-    ) -> AsyncIterator[tuple[str, Any]]:
+        self, subject: str, *, replay: bool = False, with_seq: bool = False
+    ) -> AsyncIterator[tuple]:
         # Snapshot history, then register live - both synchronous, so no gap
         # (single-threaded event loop) and no duplicates.
-        backlog: list[tuple[str, Any]] = []
+        backlog: list[tuple[str, Any, int]] = []
         if replay:
             for subj in sorted(self._retained):
                 if fnmatch.fnmatchcase(subj, subject):
-                    backlog.extend((subj, p) for p in self._retained[subj])
+                    backlog.extend(
+                        (subj, p, s) for s, p in self._retained[subj]
+                    )
         q: asyncio.Queue = asyncio.Queue()
         self._subs.append((subject, q))
         try:
             for item in backlog:
-                yield item
+                yield item if with_seq else item[:2]
             while True:
-                yield await q.get()
+                item = await q.get()
+                yield item if with_seq else item[:2]
         finally:
             self._subs.remove((subject, q))
 

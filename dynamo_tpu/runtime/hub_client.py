@@ -147,16 +147,28 @@ class RemoteHub(Hub):
     async def publish(self, subject: str, payload: Any) -> None:
         await self._call("publish", subject=subject, payload=payload)
 
+    async def purge_subject(
+        self, subject: str, keep_last: int = 0,
+        up_to_seq: int | None = None,
+    ) -> int:
+        return await self._call(
+            "purge_subject", subject=subject, keep_last=keep_last,
+            up_to_seq=up_to_seq,
+        )
+
     async def subscribe(
-        self, subject: str, *, replay: bool = False
-    ) -> AsyncIterator[tuple[str, Any]]:
+        self, subject: str, *, replay: bool = False, with_seq: bool = False
+    ) -> AsyncIterator[tuple]:
         mid, q = await self._open_stream("subscribe", subject=subject, replay=replay)
         try:
             while True:
                 item = await q.get()
                 if item is None:
                     raise ConnectionError("hub connection lost during subscribe")
-                yield item["subject"], item["payload"]
+                if with_seq:
+                    yield item["subject"], item["payload"], item.get("seq", 0)
+                else:
+                    yield item["subject"], item["payload"]
         finally:
             await self._close_stream(mid)
 

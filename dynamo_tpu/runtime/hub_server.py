@@ -129,6 +129,11 @@ class HubServer:
             elif op == "publish":
                 await hub.publish(msg["subject"], msg["payload"])
                 result = True
+            elif op == "purge_subject":
+                result = await hub.purge_subject(
+                    msg["subject"], msg.get("keep_last", 0),
+                    up_to_seq=msg.get("up_to_seq"),
+                )
             elif op == "put_object":
                 await self._put_object(msg["bucket"], msg["name"], msg["data"])
                 result = True
@@ -179,8 +184,11 @@ class HubServer:
 
     async def _stream_subscribe(self, mid: int, subject: str, replay: bool, send) -> None:
         try:
-            async for subj, payload in self.hub.subscribe(subject, replay=replay):
-                await send({"id": mid, "stream": {"subject": subj, "payload": payload}})
+            async for subj, payload, seq in self.hub.subscribe(
+                subject, replay=replay, with_seq=True
+            ):
+                await send({"id": mid, "stream": {
+                    "subject": subj, "payload": payload, "seq": seq}})
         except asyncio.CancelledError:
             pass
         except (ConnectionResetError, BrokenPipeError):

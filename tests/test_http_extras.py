@@ -1,0 +1,140 @@
+"""HTTP surface extras: /v1/embeddings (live engine), /v1/responses,
+/clear_kv_blocks admin route."""
+
+import asyncio
+import json
+
+import aiohttp
+import numpy as np
+import pytest
+
+from dynamo_tpu.engine.config import EngineConfig, ModelSpec
+from dynamo_tpu.engine.worker import launch_engine_worker
+from dynamo_tpu.frontend.http import HttpFrontend
+from dynamo_tpu.frontend.watcher import ModelManager, ModelWatcher
+from dynamo_tpu.runtime.distributed import DistributedRuntime
+from dynamo_tpu.runtime.hub import InMemoryHub
+
+pytestmark = pytest.mark.integration
+
+TINY = ModelSpec(
+    name="tiny-test", vocab_size=272, hidden_size=32, intermediate_size=64,
+    num_layers=2, num_heads=4, num_kv_heads=2, head_dim=8, dtype="float32",
+)
+
+
+async def _engine_stack(model_type="chat"):
+    drt = DistributedRuntime(InMemoryHub())
+    ecfg = EngineConfig(
+        page_size=4, num_pages=128, max_pages_per_seq=32,
+        max_decode_slots=4, prefill_buckets=(32, 64, 128),
+    )
+    engine, _ = await launch_engine_worker(
+        drt, model="tiny-test", spec=TINY, engine_config=ecfg,
+        model_name="tiny-test", model_type=model_type,
+    )
+    manager = ModelManager()
+    watcher = await ModelWatcher(drt, manager).start()
+    await watcher.wait_for_model("tiny-test", timeout=10)
+    frontend = HttpFrontend(manager, host="127.0.0.1", port=0, drt=drt)
+    await frontend.start()
+    return drt, engine, watcher, frontend
+
+
+async def test_embeddings_route_over_live_engine():
+    drt, engine, watcher, frontend = await _engine_stack("embeddings")
+    base = f"http://127.0.0.1:{frontend.port}"
+    try:
+        async with aiohttp.ClientSession() as sess:
+            async with sess.post(
+                f"{base}/v1/embeddings",
+                json={"model": "tiny-test", "input": ["hello", "world"]},
+            ) as r:
+                assert r.status == 200, await r.text()
+                body = await r.json()
+            assert body["object"] == "list"
+            assert len(body["data"]) == 2
+            e0 = np.asarray(body["data"][0]["embedding"])
+            assert e0.shape == (TINY.hidden_size,)
+            assert abs(np.linalg.norm(e0) - 1.0) < 1e-3  # L2-normalized
+            # deterministic: same input -> same embedding
+            async with sess.post(
+                f"{base}/v1/embeddings",
+                json={"model": "tiny-test", "input": "hello"},
+            ) as r:
+                again = (await r.json())["data"][0]["embedding"]
+            np.testing.assert_allclose(e0, np.asarray(again), rtol=1e-6)
+    finally:
+        await frontend.stop()
+        await watcher.close()
+        await engine.close()
+        await drt.close()
+
+
+async def test_responses_route():
+    drt, engine, watcher, frontend = await _engine_stack()
+    base = f"http://127.0.0.1:{frontend.port}"
+    try:
+        async with aiohttp.ClientSession() as sess:
+            async with sess.post(
+                f"{base}/v1/responses",
+                json={"model": "tiny-test", "input": "say hi",
+                      "max_output_tokens": 5},
+            ) as r:
+                assert r.status == 200, await r.text()
+                body = await r.json()
+            assert body["object"] == "response"
+            assert body["status"] == "completed"
+            assert body["output"][0]["content"][0]["type"] == "output_text"
+            assert body["usage"]["output_tokens"] == 5
+
+            # streaming event protocol
+            events = []
+            async with sess.post(
+                f"{base}/v1/responses",
+                json={"model": "tiny-test", "input": "stream",
+                      "max_output_tokens": 4, "stream": True},
+            ) as r:
+                assert r.status == 200
+                async for line in r.content:
+                    if line.startswith(b"event: "):
+                        events.append(line[7:].strip().decode())
+            assert events[0] == "response.created"
+            assert events[-1] == "response.completed"
+            assert "response.output_text.delta" in events
+    finally:
+        await frontend.stop()
+        await watcher.close()
+        await engine.close()
+        await drt.close()
+
+
+async def test_clear_kv_blocks_admin():
+    drt, engine, watcher, frontend = await _engine_stack()
+    base = f"http://127.0.0.1:{frontend.port}"
+    try:
+        async with aiohttp.ClientSession() as sess:
+            # warm the prefix cache
+            async with sess.post(
+                f"{base}/v1/completions",
+                json={"model": "tiny-test", "prompt": "warm me up please",
+                      "max_tokens": 2, "ignore_eos": True},
+            ) as r:
+                assert r.status == 200
+            assert engine.allocator.evictable_pages > 0
+
+            async with sess.post(f"{base}/clear_kv_blocks") as r:
+                assert r.status == 200, await r.text()
+                body = await r.json()
+            assert body["results"]["dynamo/backend"]["workers_cleared"] == 1
+            # the step loop honors the flag
+            for _ in range(100):
+                if engine.allocator.evictable_pages == 0:
+                    break
+                await asyncio.sleep(0.02)
+            assert engine.allocator.evictable_pages == 0
+    finally:
+        await frontend.stop()
+        await watcher.close()
+        await engine.close()
+        await drt.close()

@@ -281,3 +281,59 @@ async def test_kv_push_router_full_path():
     await pub.close()
     await kv_router.close()
     await drt.close()
+
+
+async def test_snapshot_compaction_and_restore():
+    """Event-volume-triggered compaction (ref router_snapshot_threshold):
+    after the threshold, the router persists its radix state and trims the
+    hub's retained event history; a late-started router restores snapshot +
+    short replay and reaches the same routing view."""
+    import asyncio
+
+    from dynamo_tpu.kv_router.protocols import (
+        BlockStored,
+        KvCacheEvent,
+        RouterConfig,
+        RouterEvent,
+    )
+    from dynamo_tpu.kv_router.router import KV_EVENT_SUBJECT, KvRouter
+    from dynamo_tpu.runtime.hub import InMemoryHub
+
+    hub = InMemoryHub()
+    comp = "dyn/backend"
+    subject = KV_EVENT_SUBJECT.format(component=comp)
+    cfg = RouterConfig(block_size=4, snapshot_threshold=10)
+
+    r1 = await KvRouter(hub, comp, cfg).start()
+    # publish 200 stored-block events for worker 7 (chained hashes)
+    parent = None
+    for i in range(200):
+        ev = RouterEvent(
+            worker_id=7,
+            event=KvCacheEvent(
+                kind="stored",
+                stored=(BlockStored(
+                    sequence_hash=1000 + i,
+                    parent_sequence_hash=parent if parent is not None else 0,
+                ),),
+            ),
+        )
+        parent = 1000 + i
+        await hub.publish(subject, ev.to_dict())
+    for _ in range(500):
+        retained = hub._retained.get(subject)
+        if retained is not None and len(retained) <= 70:
+            break
+        await asyncio.sleep(0.01)
+    # compaction ran: retained history trimmed to the keep_last tail
+    assert len(hub._retained[subject]) <= 70
+
+    # late router: snapshot + short replay reproduce the worker's blocks
+    r2 = await KvRouter(hub, comp, cfg).start()
+    for _ in range(100):
+        if r2.tree.find_matches([1000, 1001]).scores.get(7) == 2:
+            break
+        await asyncio.sleep(0.01)
+    assert r2.tree.find_matches([1000, 1001]).scores.get(7) == 2
+    await r1.close()
+    await r2.close()
