@@ -57,6 +57,7 @@ class _Slot:
     last_token: int = 0
     sample_seed: int = 0  # per-request PRNG seed (reproducible if client-set)
     stalled_steps: int = 0  # consecutive steps skipped waiting for pages
+    logprobs: int | None = None  # None=off, N=sampled+top-N per token
 
 
 @dataclass
@@ -580,6 +581,7 @@ class InferenceEngine:
             last_token=last_token,
             sample_seed=int(self._opt(sampling, "seed", self._seed_counter))
             & 0xFFFFFFFF,
+            logprobs=(req.get("output_options") or {}).get("logprobs"),
         )
 
     def _prefill_chunk_max(self) -> int:
@@ -755,18 +757,38 @@ class InferenceEngine:
 
         # sample the first token from prefill logits
         tok = self._sample_single(logits, slot)
+        entry = None
+        if slot.logprobs is not None:
+            entry = self._logprob_entry(logits, tok, slot.logprobs)
         disagg = waiting.request.get("disagg") or {}
         if (
             (disagg.get("kv_transfer") or {}).get("do_remote_decode")
             and self.transfer_source is not None
         ):
             # disagg prefill: stage KV to host, hand off, free device pages
-            self._export_and_finish(slot, sp, token_ids, tok)
+            self._export_and_finish(slot, sp, token_ids, tok, entry)
             return
-        self._emit_token(slot_idx, slot, tok)
+        self._emit_token(slot_idx, slot, tok, logprob_entry=entry)
+
+    def _logprob_entry(self, logits: jax.Array, tok: int, n: int) -> dict:
+        from dynamo_tpu.engine.sampling import token_logprobs
+
+        picked, ti, tv = token_logprobs(
+            logits[None, :], jnp.asarray([tok], jnp.int32), max(n, 1)
+        )
+        ti, tv = np.asarray(ti), np.asarray(tv)
+        return {
+            "id": tok,
+            "logprob": float(np.asarray(picked)[0]),
+            "top": [
+                {"id": int(ti[0, t]), "logprob": float(tv[0, t])}
+                for t in range(n)
+            ],
+        }
 
     def _export_and_finish(
-        self, slot: _Slot, sp: SeqPages, token_ids: list[int], tok: int
+        self, slot: _Slot, sp: SeqPages, token_ids: list[int], tok: int,
+        logprob_entry: dict | None = None,
     ) -> None:
         """Prefill-worker handoff: export prompt KV pages for remote decode."""
         page_ids = jnp.asarray(np.asarray(sp.pages, np.int32))
@@ -782,11 +804,15 @@ class InferenceEngine:
         )
         pages, sp.pages = sp.pages, []  # ownership ends here (see _prefill)
         self.allocator.release(pages)
-        self._post(
-            slot.out_q,
-            {"token_ids": [tok], "finish_reason": "length",
-             "kv_transfer_params": params},
-        )
+        item: dict[str, Any] = {
+            "token_ids": [tok], "finish_reason": "length",
+            "kv_transfer_params": params,
+        }
+        if logprob_entry is not None:
+            # the decode handler relays this first-token item to the
+            # client, so its logprob entry must ride along
+            item["logprobs"] = [logprob_entry]
+        self._post(slot.out_q, item)
         self._publish_metrics()
 
     def _resume_from_remote(self, slot_idx: int, waiting: _Waiting) -> None:
@@ -937,7 +963,17 @@ class InferenceEngine:
         if not active.any():
             return
 
-        sampled, self.k_pages, self.v_pages = llama.decode_steps(
+        # logprobs are per-batch: any slot asking turns them on for the
+        # dispatch (unrequested slots just don't emit them)
+        n_lp = 0
+        for s in self._slots:
+            if s is not None and s.logprobs is not None:
+                n_lp = max(n_lp, s.logprobs, 1)
+        # belt-and-braces: the preprocessor caps at 20, direct callers get
+        # clamped instead of crashing the shared step (top_k needs k <= V)
+        n_lp = min(n_lp, 32, self.spec.vocab_size)
+
+        result = llama.decode_steps(
             self.spec,
             self.params,
             jnp.asarray(tokens),
@@ -952,8 +988,17 @@ class InferenceEngine:
             jnp.asarray(seeds),
             jnp.asarray(steps),
             n_steps=n_burst,
+            n_logprobs=n_lp,
             mesh=self.mesh,
         )
+        if n_lp > 0:
+            sampled, lp, top_i, top_v, self.k_pages, self.v_pages = result
+            lp = np.asarray(lp)
+            top_i = np.asarray(top_i)
+            top_v = np.asarray(top_v)
+        else:
+            sampled, self.k_pages, self.v_pages = result
+            lp = top_i = top_v = None
         sampled = np.asarray(sampled)  # [B, n_burst]
         self.steps += n_burst
 
@@ -974,9 +1019,23 @@ class InferenceEngine:
         # phase 2: stream tokens, finish slots
         for i, (toks, finish) in burst.items():
             slot = self._slots[i]
+            item: dict[str, Any] = {"token_ids": toks, "finish_reason": finish}
+            if slot.logprobs is not None and lp is not None:
+                item["logprobs"] = [
+                    {
+                        "id": int(sampled[i, j]),
+                        "logprob": float(lp[i, j]),
+                        "top": [
+                            {"id": int(top_i[i, j, t]),
+                             "logprob": float(top_v[i, j, t])}
+                            for t in range(slot.logprobs)
+                        ],
+                    }
+                    for j in range(len(toks))
+                ]
             if finish is not None:
                 self._finish(i, slot, finish, emit=False)
-            self._post(slot.out_q, {"token_ids": toks, "finish_reason": finish})
+            self._post(slot.out_q, item)
 
         if self.steps % 16 < n_burst:
             self._publish_metrics()
@@ -1044,7 +1103,10 @@ class InferenceEngine:
                     slot.pages.hashes[i] = blk.sequence_hash
                     self._queue_offload(blk.sequence_hash, slot.pages.pages[i], i)
 
-    def _emit_token(self, slot_idx: int, slot: _Slot, tok: int) -> None:
+    def _emit_token(
+        self, slot_idx: int, slot: _Slot, tok: int,
+        logprob_entry: dict | None = None,
+    ) -> None:
         """Record + stream one sampled token; place slot or finish."""
         finish = self._accept_token(slot, tok)
         if finish is not None:
@@ -1055,7 +1117,10 @@ class InferenceEngine:
             self._finish(slot_idx, slot, finish, emit=False)
         else:
             self._slots[slot_idx] = slot
-        self._post(slot.out_q, {"token_ids": [tok], "finish_reason": finish})
+        item: dict[str, Any] = {"token_ids": [tok], "finish_reason": finish}
+        if logprob_entry is not None:
+            item["logprobs"] = [logprob_entry]
+        self._post(slot.out_q, item)
 
     def _finish(
         self, slot_idx: int, slot: _Slot, reason: str,

@@ -16,6 +16,27 @@ import jax.numpy as jnp
 NEG_INF = -1e30
 
 
+@partial(jax.jit, static_argnames=("n_top",))
+def token_logprobs(
+    logits: jax.Array,  # [B, V] f32
+    sampled: jax.Array,  # [B] int32
+    n_top: int = 0,
+) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """Log-probabilities for sampled tokens (+ top-n alternatives).
+
+    Returns (sampled_logprob [B], top_ids [B, n], top_logprobs [B, n]);
+    n = max(n_top, 1) to keep shapes static (callers slice). Role of the
+    reference's logprob surface (lib/llm/src/perf/logprobs.rs + OpenAI
+    logprobs fields) computed on device from the step's logits.
+    """
+    lse = jax.nn.logsumexp(logits, axis=-1, keepdims=True)
+    logprobs = logits - lse  # [B, V]
+    picked = jnp.take_along_axis(logprobs, sampled[:, None], axis=1)[:, 0]
+    n = max(n_top, 1)
+    top_vals, top_ids = jax.lax.top_k(logprobs, n)
+    return picked, top_ids.astype(jnp.int32), top_vals
+
+
 @partial(jax.jit, donate_argnums=())
 def sample_tokens(
     logits: jax.Array,  # [B, V] f32

@@ -239,6 +239,24 @@ def _kvbm_config_from_args(args: argparse.Namespace):
 
 
 async def _amain(args: argparse.Namespace) -> None:
+    from dynamo_tpu.parallel.multihost import initialize_multihost, is_leader
+
+    if initialize_multihost(
+        args.coordinator_address, args.num_processes, args.process_id
+    ):
+        if not is_leader():
+            # A follower must NOT register its own endpoint identity
+            # (SURVEY §7 hard part (d): one logical worker = many hosts,
+            # single leader identity) and cannot yet serve: the engine's
+            # dispatches originate on the leader, and multi-controller JAX
+            # requires every process to issue the same programs — the
+            # leader-driven mirror loop is the outstanding piece. Park so
+            # the process neither registers nor desynchronizes the slice.
+            import asyncio as _aio
+
+            print("MULTIHOST_FOLLOWER (parked: engine mirror loop is "
+                  "leader-driven serving's missing piece)", flush=True)
+            await _aio.Event().wait()
     rcfg = RuntimeConfig.from_env()
     if args.hub:
         rcfg.hub_address = args.hub
@@ -348,6 +366,12 @@ def main() -> None:
                    help="canary probe interval (s)")
     p.add_argument("--health-timeout", type=float, default=5.0,
                    help="canary probe timeout (s)")
+    p.add_argument("--coordinator-address", default=None,
+                   help="multi-host jax.distributed coordinator "
+                        "(or DYN_COORDINATOR); all hosts of one worker "
+                        "slice run this process")
+    p.add_argument("--num-processes", type=int, default=None)
+    p.add_argument("--process-id", type=int, default=None)
     args = p.parse_args()
     if (args.kvbm_disk_mb > 0 or args.kvbm_disk_dir) and args.kvbm_host_mb <= 0:
         p.error("--kvbm-disk-* requires --kvbm-host-mb > 0 (KVBM is off)")

@@ -54,6 +54,15 @@ class Backend:
                         finish = out["finish_reason"] = "stop"
                         break
 
+            if out.get("logprobs"):
+                # align with any token truncation above; attach token text
+                entries = list(out["logprobs"])[: len(tokens)]
+                for e in entries:
+                    e["token"] = self.tokenizer.decode([e["id"]])
+                    for t in e.get("top", ()):
+                        t["token"] = self.tokenizer.decode([t["id"]])
+                out["logprobs"] = entries
+
             delta_text = decoder.push(tokens) if tokens else ""
             if finish is not None:
                 delta_text += decoder.flush()

@@ -25,6 +25,8 @@ from aiohttp import web
 
 from dynamo_tpu.frontend.protocols import new_request_id
 from dynamo_tpu.frontend.watcher import ModelManager, ModelPipeline
+from dynamo_tpu.runtime import tracing
+from dynamo_tpu.runtime.compute import ComputePool
 from dynamo_tpu.runtime.context import Context, StreamError
 from dynamo_tpu.runtime.metrics import MetricsRegistry
 
@@ -46,6 +48,7 @@ class HttpFrontend:
         self.port = port
         self.metrics = metrics or MetricsRegistry()
         self._drt = drt
+        self._compute = ComputePool()
         self._runner: web.AppRunner | None = None
         self.app = web.Application()
         self.app.add_routes(
@@ -106,6 +109,7 @@ class HttpFrontend:
     async def stop(self) -> None:
         if self._runner is not None:
             await self._runner.cleanup()
+        self._compute.shutdown()
 
     # -- helpers -----------------------------------------------------------
 
@@ -144,12 +148,23 @@ class HttpFrontend:
             self._m_requests.labels(str(body.get("model")), route, str(err.status)).inc()
             return err
         model = pipe.card.name
-        ctx = Context(request_id=new_request_id())
+        # W3C trace context: join the client's trace or start one; the
+        # traceparent rides Context.headers to workers (runtime/tracing.py)
+        trace_headers = {
+            k.lower(): v for k, v in request.headers.items()
+            if k.lower() == tracing.TRACEPARENT
+        }
+        tracing.ensure_trace(trace_headers)
+        ctx = Context(request_id=new_request_id(), headers=trace_headers)
         t_start = time.monotonic()
         self._m_inflight.labels(model).inc()
         try:
             try:
-                preprocessed = pipe.preprocessor.preprocess(body)
+                # CPU-bound render+tokenize runs on the compute pool, not
+                # the serving event loop (ref compute/pool.rs)
+                preprocessed = await self._compute.run(
+                    pipe.preprocessor.preprocess, body
+                )
             except ValueError as e:
                 self._m_requests.labels(model, route, "400").inc()
                 return _error(400, str(e))
@@ -283,10 +298,17 @@ class HttpFrontend:
             "top_p": body.get("top_p"),
         }
         chat_body = {k: v for k, v in chat_body.items() if v is not None}
-        ctx = Context(request_id=new_request_id())
+        trace_headers = {
+            k.lower(): v for k, v in request.headers.items()
+            if k.lower() == tracing.TRACEPARENT
+        }
+        tracing.ensure_trace(trace_headers)
+        ctx = Context(request_id=new_request_id(), headers=trace_headers)
         rid = f"resp_{ctx.id}"
         try:
-            preprocessed = pipe.preprocessor.preprocess(chat_body)
+            preprocessed = await self._compute.run(
+                pipe.preprocessor.preprocess, chat_body
+            )
         except ValueError as e:
             return _error(400, str(e))
         prompt_tokens = len(preprocessed["token_ids"])

@@ -110,6 +110,20 @@ class OpenAIPreprocessor:
         stop = request.get("stop")
         if isinstance(stop, str):
             stop = [stop]
+        # OpenAI logprob knobs: chat uses logprobs=true + top_logprobs=N,
+        # completions uses logprobs=N
+        lp = request.get("logprobs")
+        if lp is True:
+            logprobs = int(request.get("top_logprobs") or 0)
+        elif isinstance(lp, int) and not isinstance(lp, bool):
+            logprobs = lp
+        else:
+            logprobs = None
+        if logprobs is not None and not (0 <= logprobs <= 20):
+            # OpenAI caps top_logprobs at 20; unbounded N would also feed a
+            # static top-k size into the shared decode step (recompiles /
+            # k > vocab crashes affecting co-batched requests)
+            raise ValueError("logprobs/top_logprobs must be between 0 and 20")
         return make_preprocessed_request(
             token_ids,
             max_tokens=max_tokens,
@@ -124,7 +138,25 @@ class OpenAIPreprocessor:
             annotations=list(request.get("nvext", {}).get("annotations", []))
             if isinstance(request.get("nvext"), dict)
             else [],
+            logprobs=logprobs,
         )
+
+    @staticmethod
+    def _chat_logprob_content(entries: list[dict]) -> list[dict]:
+        """Engine logprob entries -> OpenAI chat logprobs.content items."""
+        return [
+            {
+                "token": e.get("token", ""),
+                "logprob": e["logprob"],
+                "bytes": list(e.get("token", "").encode("utf-8")),
+                "top_logprobs": [
+                    {"token": t.get("token", ""), "logprob": t["logprob"],
+                     "bytes": list(t.get("token", "").encode("utf-8"))}
+                    for t in e.get("top", ())
+                ],
+            }
+            for e in entries
+        ]
 
     # -- backward: backend deltas -> OpenAI objects ------------------------
 
@@ -159,19 +191,25 @@ class OpenAIPreprocessor:
         tool_index = 0
         saw_tool_calls = False
 
-        def chunk_for(delta: dict[str, Any], finish: str | None):
+        def chunk_for(delta: dict[str, Any], finish: str | None,
+                      logprobs: list[dict] | None = None):
             nonlocal first
             if first:
                 delta = {"role": "assistant", **delta}
                 first = False
+            choice: dict[str, Any] = {
+                "index": 0, "delta": delta, "finish_reason": finish
+            }
+            if logprobs:
+                choice["logprobs"] = {
+                    "content": self._chat_logprob_content(logprobs)
+                }
             return {
                 "id": rid,
                 "object": "chat.completion.chunk",
                 "created": created,
                 "model": self.model_name,
-                "choices": [
-                    {"index": 0, "delta": delta, "finish_reason": finish}
-                ],
+                "choices": [choice],
             }
 
         async for d in deltas:
@@ -225,6 +263,7 @@ class OpenAIPreprocessor:
                     delta,
                     finish if (finish is not None and i == len(pending) - 1)
                     else None,
+                    logprobs=d.get("logprobs") if i == 0 else None,
                 )
         if include_usage:
             yield {
@@ -253,10 +292,12 @@ class OpenAIPreprocessor:
         text_parts: list[str] = []
         completion_tokens = 0
         finish = "stop"
+        lp_entries: list[dict] = []
         async for d in deltas:
             if d.get("text"):
                 text_parts.append(d["text"])
             completion_tokens += len(d.get("token_ids", ()))
+            lp_entries.extend(d.get("logprobs") or ())
             if d.get("finish_reason"):
                 finish = d["finish_reason"]
         text = "".join(text_parts)
@@ -285,23 +326,38 @@ class OpenAIPreprocessor:
                 message["content"] = text
         else:
             message["content"] = text
+        choice: dict[str, Any] = {
+            "index": 0,
+            "message": message,
+            "finish_reason": finish,
+        }
+        if lp_entries:
+            choice["logprobs"] = {
+                "content": self._chat_logprob_content(lp_entries)
+            }
         return {
             "id": rid,
             "object": "chat.completion",
             "created": now_unix(),
             "model": self.model_name,
-            "choices": [
-                {
-                    "index": 0,
-                    "message": message,
-                    "finish_reason": finish,
-                }
-            ],
+            "choices": [choice],
             "usage": {
                 "prompt_tokens": prompt_tokens,
                 "completion_tokens": completion_tokens,
                 "total_tokens": prompt_tokens + completion_tokens,
             },
+        }
+
+    @staticmethod
+    def _completions_logprobs(entries: list[dict]) -> dict[str, Any]:
+        """Engine logprob entries -> classic completions logprobs block."""
+        return {
+            "tokens": [e.get("token", "") for e in entries],
+            "token_logprobs": [e["logprob"] for e in entries],
+            "top_logprobs": [
+                {t.get("token", ""): t["logprob"] for t in e.get("top", ())}
+                for e in entries
+            ],
         }
 
     async def postprocess_completions_stream(
@@ -313,18 +369,19 @@ class OpenAIPreprocessor:
         rid = request_id or new_request_id()
         created = now_unix()
         async for d in deltas:
+            choice: dict[str, Any] = {
+                "index": 0,
+                "text": d.get("text", ""),
+                "finish_reason": d.get("finish_reason"),
+            }
+            if d.get("logprobs"):
+                choice["logprobs"] = self._completions_logprobs(d["logprobs"])
             yield {
                 "id": rid,
                 "object": "text_completion",
                 "created": created,
                 "model": self.model_name,
-                "choices": [
-                    {
-                        "index": 0,
-                        "text": d.get("text", ""),
-                        "finish_reason": d.get("finish_reason"),
-                    }
-                ],
+                "choices": [choice],
             }
 
     async def aggregate_completions(
@@ -338,20 +395,25 @@ class OpenAIPreprocessor:
         text_parts: list[str] = []
         completion_tokens = 0
         finish = "stop"
+        lp_entries: list[dict] = []
         async for d in deltas:
             if d.get("text"):
                 text_parts.append(d["text"])
             completion_tokens += len(d.get("token_ids", ()))
+            lp_entries.extend(d.get("logprobs") or ())
             if d.get("finish_reason"):
                 finish = d["finish_reason"]
+        choice: dict[str, Any] = {
+            "index": 0, "text": "".join(text_parts), "finish_reason": finish
+        }
+        if lp_entries:
+            choice["logprobs"] = self._completions_logprobs(lp_entries)
         return {
             "id": rid,
             "object": "text_completion",
             "created": now_unix(),
             "model": self.model_name,
-            "choices": [
-                {"index": 0, "text": "".join(text_parts), "finish_reason": finish}
-            ],
+            "choices": [choice],
             "usage": {
                 "prompt_tokens": prompt_tokens,
                 "completion_tokens": completion_tokens,

@@ -38,6 +38,7 @@ def make_preprocessed_request(
     min_tokens: int = 0,
     eos_token_ids: list[int] | None = None,
     annotations: list[str] | None = None,
+    logprobs: int | None = None,  # None=off, N=top-N alternatives
 ) -> dict[str, Any]:
     return {
         "token_ids": token_ids,
@@ -59,6 +60,7 @@ def make_preprocessed_request(
             "min_tokens": min_tokens,
         },
         "eos_token_ids": eos_token_ids or [],
+        "output_options": {"logprobs": logprobs},
         "backend_instance_id": None,
         "estimated_prefix_hit_num_blocks": None,
         "annotations": annotations or [],

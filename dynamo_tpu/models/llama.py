@@ -377,26 +377,32 @@ def decode_steps_impl(
     seeds: jax.Array,  # [B] uint32
     steps: jax.Array,  # [B] int32: tokens generated so far per slot
     n_steps: int = 1,  # static: decode steps per dispatch
+    n_logprobs: int = 0,  # static: 0=off, N=sampled+top-N logprobs
     mesh: Mesh | None = None,  # static
-) -> tuple[jax.Array, jax.Array, jax.Array]:
+):
     """``n_steps`` decode iterations + on-device sampling in ONE dispatch.
 
-    Returns (sampled [B, n_steps], k_pages, v_pages). Amortizes host
+    Returns (sampled [B, n_steps], k_pages, v_pages) — plus, when
+    ``n_logprobs`` > 0, (sampled_logprobs [B, n], top_ids [B, n, N],
+    top_logprobs [B, n, N]) between sampled and the caches. Amortizes host
     dispatch and device-sync cost over n steps (the same reason vLLM grew
-    multi-step scheduling): only [B, n] int32 crosses to the host per
+    multi-step scheduling): only small arrays cross to the host per
     dispatch. Callers must pre-extend block tables so every active slot
     has page room for n more tokens; EOS inside a burst is handled
     host-side by discarding the tail. Sampling keys fold in the per-slot
     generated-count so bursts reproduce the per-request RNG stream exactly
     (engine/sampling.py contract).
     """
-    from dynamo_tpu.engine.sampling import sample_tokens
+    from dynamo_tpu.engine.sampling import sample_tokens, token_logprobs
 
     B = tokens.shape[0]
     out0 = jnp.zeros((B, n_steps), jnp.int32)
+    lp0 = jnp.zeros((B, n_steps), jnp.float32)
+    ti0 = jnp.zeros((B, n_steps, max(n_logprobs, 1)), jnp.int32)
+    tv0 = jnp.zeros((B, n_steps, max(n_logprobs, 1)), jnp.float32)
 
     def body(i, carry):
-        toks, lens, kp, vp, out = carry
+        toks, lens, kp, vp, out, lp, ti, tv = carry
         logits, kp, vp = decode_forward_impl(
             spec, params, toks, block_tables, lens, kp, vp, active, mesh=mesh
         )
@@ -405,19 +411,27 @@ def decode_steps_impl(
         )
         nxt = jnp.where(active, nxt, toks)
         out = out.at[:, i].set(nxt)
-        return nxt, lens + active.astype(jnp.int32), kp, vp, out
+        if n_logprobs > 0:
+            picked, top_i, top_v = token_logprobs(logits, nxt, n_logprobs)
+            lp = lp.at[:, i].set(picked)
+            ti = ti.at[:, i].set(top_i)
+            tv = tv.at[:, i].set(top_v)
+        return nxt, lens + active.astype(jnp.int32), kp, vp, out, lp, ti, tv
 
-    _toks, _lens, k_pages, v_pages, out = jax.lax.fori_loop(
-        0, n_steps, body, (tokens, seq_lens, k_pages, v_pages, out0),
+    _toks, _lens, k_pages, v_pages, out, lp, ti, tv = jax.lax.fori_loop(
+        0, n_steps, body,
+        (tokens, seq_lens, k_pages, v_pages, out0, lp0, ti0, tv0),
         unroll=False,
     )
+    if n_logprobs > 0:
+        return out, lp, ti, tv, k_pages, v_pages
     return out, k_pages, v_pages
 
 
 decode_steps = jax.jit(
     decode_steps_impl,
     static_argnums=(0,),
-    static_argnames=("n_steps", "mesh"),
+    static_argnames=("n_steps", "n_logprobs", "mesh"),
     donate_argnums=(5, 6),
 )
 

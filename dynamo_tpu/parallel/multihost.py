@@ -1,0 +1,66 @@
+"""Multi-host bootstrap: one logical worker spanning several TPU hosts.
+
+Role of the reference's engine multinode bootstrap (MPI world for TRT-LLM,
+--dist-init-addr for SGLang; SURVEY §2.4 maps these to "JAX distributed
+init (coordinator)"): every host of a multi-host slice runs the same
+worker process, calls ``initialize_multihost`` before any jax use, and
+jax.distributed wires the hosts into one runtime whose ``jax.devices()``
+spans the full slice. Meshes built afterwards (parallel/mesh.py) then
+shard across hosts over ICI/DCN automatically.
+
+Leader identity (SURVEY §7 hard part (d)): only process 0 registers the
+endpoint/model card — followers compute in the same SPMD programs but are
+invisible to routers, mirroring KvbmLeader/Worker's single-identity model.
+Env fallbacks: DYN_COORDINATOR, DYN_NUM_PROCESSES, DYN_PROCESS_ID (set by
+the launcher / K8s indexed job).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+
+log = logging.getLogger("dynamo.multihost")
+
+
+def initialize_multihost(
+    coordinator_address: str | None = None,
+    num_processes: int | None = None,
+    process_id: int | None = None,
+) -> bool:
+    """Join the multi-host JAX runtime; no-op single-process when unset.
+
+    Returns True when distributed init ran. Must be called before the
+    first jax computation in the process.
+    """
+    coordinator_address = coordinator_address or os.environ.get(
+        "DYN_COORDINATOR"
+    )
+    if num_processes is None and os.environ.get("DYN_NUM_PROCESSES"):
+        num_processes = int(os.environ["DYN_NUM_PROCESSES"])
+    if process_id is None and os.environ.get("DYN_PROCESS_ID"):
+        process_id = int(os.environ["DYN_PROCESS_ID"])
+
+    if not coordinator_address or not num_processes or num_processes <= 1:
+        return False
+
+    import jax
+
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+    log.info(
+        "joined multi-host runtime: process %d/%d via %s (%d devices total)",
+        jax.process_index(), num_processes, coordinator_address,
+        jax.device_count(),
+    )
+    return True
+
+
+def is_leader() -> bool:
+    """Process 0 owns registration/serving; followers only compute."""
+    import jax
+
+    return jax.process_index() == 0

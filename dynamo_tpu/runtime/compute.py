@@ -1,0 +1,34 @@
+"""Compute pool: CPU-bound work off the event loop.
+
+Role of the reference's rayon<->tokio bridge (lib/runtime/src/compute/,
+pool.rs:156): tokenization and chat-template rendering are CPU-bound and
+must not stall the serving event loop. A bounded thread pool is the Python
+analogue (the GIL releases inside HF tokenizers' Rust core, so real
+parallelism where it matters)."""
+
+from __future__ import annotations
+
+import asyncio
+import functools
+import os
+from concurrent.futures import ThreadPoolExecutor
+
+__all__ = ["ComputePool"]
+
+
+class ComputePool:
+    def __init__(self, max_workers: int | None = None):
+        if max_workers is None:
+            max_workers = min(8, (os.cpu_count() or 2))
+        self._ex = ThreadPoolExecutor(
+            max_workers=max_workers, thread_name_prefix="dyn-compute"
+        )
+
+    async def run(self, fn, *args, **kwargs):
+        loop = asyncio.get_running_loop()
+        return await loop.run_in_executor(
+            self._ex, functools.partial(fn, *args, **kwargs)
+        )
+
+    def shutdown(self) -> None:
+        self._ex.shutdown(wait=False, cancel_futures=True)

@@ -1,0 +1,128 @@
+"""Distributed tracing: W3C traceparent propagation + JSONL spans.
+
+Role of the reference's tracing stack (lib/runtime/src/logging.rs:72-87,
+:147 — OTEL/OTLP exporter with W3C context propagation across
+HTTP->NATS->worker hops). This environment has no OTLP collector or
+opentelemetry package, so spans are emitted as structured JSONL log
+records carrying trace_id/span_id/parent — the same correlation model,
+greppable and collector-ingestable. The ``traceparent`` header follows
+https://www.w3.org/TR/trace-context/ (version 00) so external clients and
+proxies interoperate.
+
+Propagation: the frontend extracts/creates a traceparent per request and
+stashes it in Context.headers; the transport carries headers to workers
+(runtime/transport.py frame field); workers bind the trace with
+``bind_trace(context.headers)`` so their spans join the request's trace.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import contextvars
+import json
+import logging
+import secrets
+import time
+from dataclasses import dataclass
+
+log = logging.getLogger("dynamo.trace")
+
+TRACEPARENT = "traceparent"
+
+_current: contextvars.ContextVar["TraceContext | None"] = contextvars.ContextVar(
+    "dynamo_trace", default=None
+)
+
+
+@dataclass(frozen=True)
+class TraceContext:
+    trace_id: str  # 32 hex chars
+    span_id: str  # 16 hex chars
+    sampled: bool = True
+
+    def to_traceparent(self) -> str:
+        flags = "01" if self.sampled else "00"
+        return f"00-{self.trace_id}-{self.span_id}-{flags}"
+
+    def child(self) -> "TraceContext":
+        return TraceContext(self.trace_id, _new_span_id(), self.sampled)
+
+
+def _new_span_id() -> str:
+    return secrets.token_hex(8)
+
+
+def new_trace() -> TraceContext:
+    return TraceContext(secrets.token_hex(16), _new_span_id())
+
+
+def parse_traceparent(header: str | None) -> TraceContext | None:
+    """W3C header -> TraceContext; None on absent/malformed."""
+    if not header:
+        return None
+    parts = header.strip().split("-")
+    if len(parts) != 4:
+        return None
+    version, trace_id, span_id, flags = parts
+    if (
+        len(version) != 2 or len(trace_id) != 32 or len(span_id) != 16
+        or trace_id == "0" * 32 or span_id == "0" * 16
+    ):
+        return None
+    try:
+        sampled = bool(int(flags, 16) & 1)
+    except ValueError:
+        return None
+    return TraceContext(trace_id.lower(), span_id.lower(), sampled)
+
+
+def current_trace() -> TraceContext | None:
+    return _current.get()
+
+
+def ensure_trace(headers: dict[str, str] | None = None) -> TraceContext:
+    """Extract the incoming trace or start a new one; writes the (child)
+    traceparent back into ``headers`` so downstream hops continue it."""
+    incoming = parse_traceparent((headers or {}).get(TRACEPARENT))
+    tc = incoming.child() if incoming else new_trace()
+    if headers is not None:
+        headers[TRACEPARENT] = tc.to_traceparent()
+    _current.set(tc)
+    return tc
+
+
+def bind_trace(headers: dict[str, str] | None) -> TraceContext | None:
+    """Worker side: join the caller's trace from propagated headers."""
+    tc = parse_traceparent((headers or {}).get(TRACEPARENT))
+    if tc is not None:
+        tc = tc.child()
+        _current.set(tc)
+    return tc
+
+
+@contextlib.contextmanager
+def span(name: str, **attrs):
+    """Timed span under the current trace, emitted as one JSONL record."""
+    parent = _current.get()
+    tc = parent.child() if parent else new_trace()
+    token = _current.set(tc)
+    t0 = time.monotonic()
+    error: str | None = None
+    try:
+        yield tc
+    except BaseException as e:
+        error = f"{type(e).__name__}: {e}"
+        raise
+    finally:
+        _current.reset(token)
+        record = {
+            "span": name,
+            "trace_id": tc.trace_id,
+            "span_id": tc.span_id,
+            "parent_span_id": parent.span_id if parent else None,
+            "duration_ms": round((time.monotonic() - t0) * 1e3, 3),
+            **attrs,
+        }
+        if error:
+            record["error"] = error
+        log.info("%s", json.dumps(record))

@@ -118,6 +118,10 @@ class EndpointServer:
                     ctx = Context(
                         request_id=msg["req"], headers=msg.get("headers") or {}
                     )
+                    # join the caller's W3C trace (runtime/tracing.py)
+                    from dynamo_tpu.runtime.tracing import bind_trace
+
+                    bind_trace(ctx.headers)
                     contexts[msg["req"]] = ctx
                     task = asyncio.ensure_future(
                         self._serve_request(msg, ctx, send, contexts)

@@ -138,3 +138,63 @@ async def test_clear_kv_blocks_admin():
         await watcher.close()
         await engine.close()
         await drt.close()
+
+
+async def test_logprobs_surface():
+    """Logprobs end-to-end: engine computes sampled + top-N on device,
+    OpenAI surfaces them (chat content entries + classic completions
+    block). Greedy sampling means the sampled token's logprob equals the
+    best alternative's."""
+    drt, engine, watcher, frontend = await _engine_stack()
+    base = f"http://127.0.0.1:{frontend.port}"
+    try:
+        async with aiohttp.ClientSession() as sess:
+            async with sess.post(
+                f"{base}/v1/completions",
+                json={"model": "tiny-test", "prompt": "probe", "max_tokens": 4,
+                      "ignore_eos": True, "logprobs": 2, "temperature": 0.0},
+            ) as r:
+                assert r.status == 200, await r.text()
+                body = await r.json()
+            lp = body["choices"][0]["logprobs"]
+            assert len(lp["tokens"]) == 4
+            assert all(v <= 0.0 for v in lp["token_logprobs"])
+            assert all(len(t) == 2 for t in lp["top_logprobs"])
+            # greedy: sampled logprob == best top logprob
+            assert abs(lp["token_logprobs"][0] - max(lp["top_logprobs"][0].values())) < 1e-5
+
+            async with sess.post(
+                f"{base}/v1/chat/completions",
+                json={"model": "tiny-test",
+                      "messages": [{"role": "user", "content": "hi"}],
+                      "max_tokens": 3, "ignore_eos": True,
+                      "logprobs": True, "top_logprobs": 2},
+            ) as r:
+                assert r.status == 200, await r.text()
+                chat = await r.json()
+            content = chat["choices"][0]["logprobs"]["content"]
+            assert len(content) == 3
+            assert len(content[0]["top_logprobs"]) == 2
+
+            # streaming chunks carry per-token logprobs too
+            seen = 0
+            async with sess.post(
+                f"{base}/v1/chat/completions",
+                json={"model": "tiny-test",
+                      "messages": [{"role": "user", "content": "hi"}],
+                      "max_tokens": 3, "ignore_eos": True, "stream": True,
+                      "logprobs": True, "top_logprobs": 1},
+            ) as r:
+                async for line in r.content:
+                    if not line.startswith(b"data: ") or b"[DONE]" in line:
+                        continue
+                    chunk = json.loads(line[len(b"data: "):])
+                    for ch in chunk.get("choices", []):
+                        if ch.get("logprobs"):
+                            seen += len(ch["logprobs"]["content"])
+            assert seen == 3
+    finally:
+        await frontend.stop()
+        await watcher.close()
+        await engine.close()
+        await drt.close()
