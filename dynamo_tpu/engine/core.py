@@ -581,8 +581,18 @@ class InferenceEngine:
             last_token=last_token,
             sample_seed=int(self._opt(sampling, "seed", self._seed_counter))
             & 0xFFFFFFFF,
-            logprobs=(req.get("output_options") or {}).get("logprobs"),
+            logprobs=self._clamp_logprobs(
+                (req.get("output_options") or {}).get("logprobs")
+            ),
         )
+
+    def _clamp_logprobs(self, n) -> int | None:
+        """Single chokepoint for the logprob width: the OpenAI surface caps
+        at 20, direct engine callers get clamped (top_k needs k <= V, and
+        emit indexing must stay inside the computed arrays)."""
+        if n is None:
+            return None
+        return max(0, min(int(n), 20, self.spec.vocab_size - 1))
 
     def _prefill_chunk_max(self) -> int:
         cfg = self.config
@@ -965,13 +975,13 @@ class InferenceEngine:
 
         # logprobs are per-batch: any slot asking turns them on for the
         # dispatch (unrequested slots just don't emit them)
-        n_lp = 0
-        for s in self._slots:
-            if s is not None and s.logprobs is not None:
-                n_lp = max(n_lp, s.logprobs, 1)
-        # belt-and-braces: the preprocessor caps at 20, direct callers get
-        # clamped instead of crashing the shared step (top_k needs k <= V)
-        n_lp = min(n_lp, 32, self.spec.vocab_size)
+        # one fixed width when ANY slot wants logprobs: n_logprobs is a
+        # static jit arg, so per-batch-composition widths would recompile
+        # the fused decode program every time the mix changes
+        wants_lp = any(
+            s is not None and s.logprobs is not None for s in self._slots
+        )
+        n_lp = min(20, self.spec.vocab_size - 1) if wants_lp else 0
 
         result = llama.decode_steps(
             self.spec,

@@ -80,6 +80,16 @@ class Backend:
                     out["text"] = full[emitted_text_len:hit]
                     out["finish_reason"] = "stop"
                     emitted_text_len = hit
+                    if out.get("logprobs"):
+                        # drop entries for tokens past the stop string
+                        # (OpenAI truncates logprobs with the text)
+                        kept, seen = [], 0
+                        for e in out["logprobs"]:
+                            if seen >= len(out["text"]):
+                                break
+                            kept.append(e)
+                            seen += len(e.get("token", ""))
+                        out["logprobs"] = kept
                     yield out
                     context.stop_generating()
                     return

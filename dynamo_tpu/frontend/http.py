@@ -126,6 +126,17 @@ class HttpFrontend:
             )
         return pipe, None
 
+    def _traced_context(self, request: web.Request) -> Context:
+        """Per-request Context joined to the client's W3C trace (or a new
+        one); the traceparent rides Context.headers to workers
+        (runtime/tracing.py)."""
+        headers: dict[str, str] = {}
+        incoming = request.headers.get(tracing.TRACEPARENT)
+        if incoming:
+            headers[tracing.TRACEPARENT] = incoming
+        tracing.ensure_trace(headers)
+        return Context(request_id=new_request_id(), headers=headers)
+
     # -- routes ------------------------------------------------------------
 
     async def chat_completions(self, request: web.Request) -> web.StreamResponse:
@@ -148,14 +159,7 @@ class HttpFrontend:
             self._m_requests.labels(str(body.get("model")), route, str(err.status)).inc()
             return err
         model = pipe.card.name
-        # W3C trace context: join the client's trace or start one; the
-        # traceparent rides Context.headers to workers (runtime/tracing.py)
-        trace_headers = {
-            k.lower(): v for k, v in request.headers.items()
-            if k.lower() == tracing.TRACEPARENT
-        }
-        tracing.ensure_trace(trace_headers)
-        ctx = Context(request_id=new_request_id(), headers=trace_headers)
+        ctx = self._traced_context(request)
         t_start = time.monotonic()
         self._m_inflight.labels(model).inc()
         try:
@@ -298,12 +302,7 @@ class HttpFrontend:
             "top_p": body.get("top_p"),
         }
         chat_body = {k: v for k, v in chat_body.items() if v is not None}
-        trace_headers = {
-            k.lower(): v for k, v in request.headers.items()
-            if k.lower() == tracing.TRACEPARENT
-        }
-        tracing.ensure_trace(trace_headers)
-        ctx = Context(request_id=new_request_id(), headers=trace_headers)
+        ctx = self._traced_context(request)
         rid = f"resp_{ctx.id}"
         try:
             preprocessed = await self._compute.run(

@@ -190,6 +190,7 @@ class OpenAIPreprocessor:
         reasoning = self._reasoning()
         tool_index = 0
         saw_tool_calls = False
+        held_lp: list[dict] = []  # logprob entries from jailed deltas
 
         def chunk_for(delta: dict[str, Any], finish: str | None,
                       logprobs: list[dict] | None = None):
@@ -258,12 +259,19 @@ class OpenAIPreprocessor:
             # silence is the point
             if not pending and (jail is None or finish is not None):
                 pending.append({})
+            # logprob entries ride the first emitted chunk; while the jail
+            # holds a delta back entirely they accumulate (clients align
+            # logprobs.content to tokens, so none may be dropped)
+            held_lp.extend(d.get("logprobs") or ())
             for i, delta in enumerate(pending):
+                lp_out = None
+                if i == 0 and held_lp:
+                    lp_out, held_lp = held_lp, []
                 yield chunk_for(
                     delta,
                     finish if (finish is not None and i == len(pending) - 1)
                     else None,
-                    logprobs=d.get("logprobs") if i == 0 else None,
+                    logprobs=lp_out,
                 )
         if include_usage:
             yield {

@@ -139,6 +139,14 @@ def _decode_attention_tpu(
     DMA bursts than our one-page-at-a-time kernel, so decode sits much
     closer to the HBM roofline. Same layout contract as ours:
     k_pages/v_pages [KH, num_pages, page, D], block_tables [B, P]."""
+    if (os.environ.get("DYNAMO_ATTN") or "").strip() == "v2":
+        from dynamo_tpu.ops.pallas.paged_attention_v2 import (
+            paged_decode_attention_v2,
+        )
+
+        return paged_decode_attention_v2(
+            q, k_pages, v_pages, block_tables, seq_lens
+        )
     from jax.experimental.pallas.ops.tpu.paged_attention import (
         paged_attention,
     )

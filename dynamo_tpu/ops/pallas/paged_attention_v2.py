@@ -1,0 +1,176 @@
+"""Pallas TPU kernel v2 (EXPERIMENT): paged decode attention, all-KV-heads
+DMAs.
+
+Status: correctness-verified (interpret mode matches the pure-JAX
+reference) but measured SLOWER than the jax library kernel on v5e in the
+end-to-end serving path — the per-sequence grid's [KH*G, KH*page] block-
+diagonal matmuls cost more than the DMA-issue savings buy. Kept as the
+starting point for a page-major-layout variant (where per-page all-head
+slices are contiguous, not strided); enable with DYNAMO_ATTN=v2.
+
+Why v2 was tried: the per-(sequence, kv-head) grid designs (our v1 and
+the jax library kernel) issue one DMA per head per page — 4-8 KB each at
+common page sizes, which leaves decode DMA-ISSUE-bound. This kernel runs
+one program per SEQUENCE and fetches each page for ALL kv heads in a
+single strided copy (``k_pages[:, page]`` -> [KH, page, D] — the same
+aligned-slice trick as ops/pallas/kv_write.py), cutting issues by KH x.
+
+Compute folds the GQA groups into ONE matmul per page instead of KH small
+ones: q flattens to [KH*G, D], the page's keys to [KH*page, D], and the
+[KH*G, KH*page] score matrix is masked down to its block diagonal (a row
+in group kh only sees columns of kv head kh). The off-diagonal FLOPs are
+wasted, but at decode shapes the MXU is latency- not FLOP-bound, and one
+[16, 128] x [128, 256] matmul beats 8 tiny ones by a wide margin. Online
+softmax (flash-style m/l/acc) runs across pages with double-buffered
+prefetch.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30
+
+
+def _decode_kernel_v2(
+    # scalar prefetch (SMEM)
+    block_tables_ref,  # [B, P] int32
+    seq_lens_ref,  # [B] int32
+    # inputs
+    q_ref,  # [1, KH, G, D] VMEM (this sequence's query heads, pre-scaled)
+    k_pages_ref,  # [KH, num_pages, page, D] ANY/HBM
+    v_pages_ref,
+    # outputs
+    o_ref,  # [1, KH, G, D] VMEM
+    # scratch
+    k_buf,  # [2, KH, page, D] VMEM
+    v_buf,
+    sems,  # DMA sems [2, 2]
+    *,
+    page_size: int,
+):
+    b = pl.program_id(0)
+    seq_len = seq_lens_ref[b]
+    n_pages = pl.cdiv(seq_len, page_size)
+
+    KH, G, D = q_ref.shape[1], q_ref.shape[2], q_ref.shape[3]
+    page = page_size
+    qf = q_ref[0].reshape(KH * G, D).astype(jnp.float32)  # [KH*G, D]
+
+    def k_dma(slot, i):
+        p = block_tables_ref[b, i]
+        return pltpu.make_async_copy(
+            k_pages_ref.at[:, p], k_buf.at[slot], sems.at[0, slot]
+        )
+
+    def v_dma(slot, i):
+        p = block_tables_ref[b, i]
+        return pltpu.make_async_copy(
+            v_pages_ref.at[:, p], v_buf.at[slot], sems.at[1, slot]
+        )
+
+    @pl.when(n_pages > 0)
+    def _():
+        k_dma(0, 0).start()
+        v_dma(0, 0).start()
+
+    # block-diagonal mask rows/cols: row r belongs to kv head r // G,
+    # column c to kv head c // page
+    row_kh = jax.lax.broadcasted_iota(jnp.int32, (KH * G, KH * page), 0) // G
+    col_kh = jax.lax.broadcasted_iota(jnp.int32, (KH * G, KH * page), 1) // page
+    col_tok = jax.lax.broadcasted_iota(jnp.int32, (KH * G, KH * page), 1) % page
+    same_head = row_kh == col_kh
+
+    def body(i, state):
+        m, l, acc = state
+        slot = jax.lax.rem(i, 2)
+        nxt = 1 - slot
+
+        @pl.when(i + 1 < n_pages)
+        def _():
+            k_dma(nxt, i + 1).start()
+            v_dma(nxt, i + 1).start()
+
+        k_dma(slot, i).wait()
+        v_dma(slot, i).wait()
+        kf = k_buf[slot].reshape(KH * page, D).astype(jnp.float32)
+        vf = v_buf[slot].reshape(KH * page, D).astype(jnp.float32)
+
+        scores = jax.lax.dot_general(
+            qf, kf, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # [KH*G, KH*page]
+        valid = same_head & (col_tok + i * page < seq_len)
+        scores = jnp.where(valid, scores, NEG_INF)
+
+        m_new = jnp.maximum(m, jnp.max(scores, axis=-1, keepdims=True))
+        alpha = jnp.exp(m - m_new)
+        probs = jnp.exp(scores - m_new)  # masked cols underflow to 0
+        l_new = l * alpha + jnp.sum(probs, axis=-1, keepdims=True)
+        acc_new = acc * alpha + jax.lax.dot_general(
+            probs, vf, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        return m_new, l_new, acc_new
+
+    m0 = jnp.full((KH * G, 1), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((KH * G, 1), jnp.float32)
+    acc0 = jnp.zeros((KH * G, D), jnp.float32)
+    m, l, acc = jax.lax.fori_loop(0, n_pages, body, (m0, l0, acc0))
+
+    out = acc / jnp.maximum(l, 1e-30)
+    o_ref[0] = out.reshape(KH, G, D).astype(o_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def paged_decode_attention_v2(
+    q: jax.Array,  # [B, H, D]
+    k_pages: jax.Array,  # [KH, num_pages, page, D]
+    v_pages: jax.Array,
+    block_tables: jax.Array,  # [B, P] int32
+    seq_lens: jax.Array,  # [B] int32 (length INCLUDING the new token)
+    *,
+    interpret: bool = False,
+) -> jax.Array:
+    """Decode attention over the paged cache; same contract as v1/lib."""
+    B, H, D = q.shape
+    KH, _, page_size, _ = k_pages.shape
+    G = H // KH
+    scale = 1.0 / jnp.sqrt(jnp.asarray(D, jnp.float32))
+    q4 = (q.reshape(B, KH, G, D).astype(jnp.float32) * scale).astype(q.dtype)
+
+    kernel = functools.partial(_decode_kernel_v2, page_size=page_size)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(B,),
+        in_specs=[
+            pl.BlockSpec(
+                (1, KH, G, D), lambda b, *_: (b, 0, 0, 0),
+                memory_space=pltpu.VMEM,
+            ),
+            pl.BlockSpec(memory_space=pltpu.ANY),
+            pl.BlockSpec(memory_space=pltpu.ANY),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, KH, G, D), lambda b, *_: (b, 0, 0, 0),
+            memory_space=pltpu.VMEM,
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((2, KH, page_size, D), k_pages.dtype),
+            pltpu.VMEM((2, KH, page_size, D), v_pages.dtype),
+            pltpu.SemaphoreType.DMA((2, 2)),
+        ],
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((B, KH, G, D), q.dtype),
+        interpret=interpret,
+    )(block_tables.astype(jnp.int32), seq_lens.astype(jnp.int32), q4,
+      k_pages, v_pages)
+    return out.reshape(B, H, D)

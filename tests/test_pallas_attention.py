@@ -103,3 +103,18 @@ def test_lib_pages_per_compute_block():
         got = _lib_pages_per_compute_block(bt)
         assert got == want, (P, got, want)
         assert P % got == 0
+
+
+def test_v2_kernel_matches_reference_interpret():
+    """Experimental all-KV-heads kernel (ops/pallas/paged_attention_v2):
+    block-diagonal masking + online softmax must match the pure-JAX form."""
+    from dynamo_tpu.ops.pallas.paged_attention_v2 import (
+        paged_decode_attention_v2,
+    )
+
+    q, k, v, bt, lens = _setup(B=3, H=8, KH=4, pages_per_seq=3, seed=9)
+    ref = paged_decode_attention(q, k, v, bt, lens)
+    got = paged_decode_attention_v2(q, k, v, bt, lens, interpret=True)
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(ref), rtol=2e-5, atol=2e-5
+    )
