@@ -446,17 +446,13 @@ class InferenceEngine:
                 self.allocator.release([cached.pop()])
 
         # KVBM onboard: consecutive blocks beyond the G1 hit that live in
-        # host/disk tiers get pulled back into fresh device pages
+        # host/disk/remote tiers get pulled back into fresh device pages
+        # (get_consecutive batches any G4 hub I/O into one round)
         onboard: list[tuple[Any, Any]] = []
         if self.kvbm is not None:
             limit = needed_pages if full_prefix_ok else (n_tokens - 1) // page_size
-            i = len(cached)
-            while i < min(limit, len(hashes)):
-                blk = self.kvbm.get(hashes[i])
-                if blk is None:
-                    break
-                onboard.append(blk)
-                i += 1
+            wanted = hashes[len(cached) : min(limit, len(hashes))]
+            onboard = self.kvbm.get_consecutive(wanted)
 
         sp = SeqPages(request_id=request_id)
         sp.pages = list(cached)

@@ -95,9 +95,14 @@ async def launch_engine_worker(
 
     kvbm = None
     if kvbm_config is not None:
+        import asyncio as _aio
+
         from dynamo_tpu.kvbm import KvBlockManager
 
-        kvbm = KvBlockManager(kvbm_config)
+        kvbm = KvBlockManager(
+            kvbm_config, hub=drt.hub, loop=_aio.get_running_loop(),
+            namespace=namespace,
+        )
 
     engine = InferenceEngine(
         spec, cfg, mesh=mesh, params=params,
@@ -235,6 +240,7 @@ def _kvbm_config_from_args(args: argparse.Namespace):
         host_bytes=args.kvbm_host_mb * 1024 * 1024,
         disk_bytes=args.kvbm_disk_mb * 1024 * 1024,
         disk_dir=args.kvbm_disk_dir,
+        remote_max_blocks=args.kvbm_remote_blocks,
     )
 
 
@@ -359,6 +365,9 @@ def main() -> None:
     p.add_argument("--kvbm-disk-mb", type=int, default=0,
                    help="disk KV tier budget in MiB (0 = no disk tier)")
     p.add_argument("--kvbm-disk-dir", default=None)
+    p.add_argument("--kvbm-remote-blocks", type=int, default=0,
+                   help="G4 remote-tier block cap in the hub object store "
+                        "(0 = off); shared across workers")
     p.add_argument("--health-port", type=int, default=-1,
                    help="system status server port (0 = ephemeral, "
                         "-1 = health subsystem off)")

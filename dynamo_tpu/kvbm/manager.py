@@ -8,18 +8,21 @@ Ties the pools together behind two calls the engine uses on its hot paths
   get(sh)          — onboard probe at prefill admission; a G3 hit is
                      promoted to G2 on the way up
 
-Lookup order is G2 then G3. Stats counters feed worker metrics.
+Lookup order is G2, G3, then G4 (hub object store — shared across
+workers; ref distributed/leader.rs G4 remote tier role). Hits promote
+upward. Stats counters feed worker metrics.
 """
 
 from __future__ import annotations
 
 import logging
+import queue
 import threading
 from dataclasses import dataclass, field
 
 import numpy as np
 
-from dynamo_tpu.kvbm.pool import DiskBlockPool, HostBlockPool
+from dynamo_tpu.kvbm.pool import DiskBlockPool, HostBlockPool, RemoteBlockPool
 
 log = logging.getLogger("dynamo.kvbm")
 
@@ -33,6 +36,8 @@ class KvbmConfig:
     # shallower are offloaded (0 = offload everything). Deep blocks are the
     # least likely to be shared. Ref: offload/filter.rs.
     max_offload_depth_blocks: int = 0
+    # G4 remote tier (hub object store, shared ACROSS workers); 0 disables
+    remote_max_blocks: int = 0
 
 
 @dataclass
@@ -40,6 +45,7 @@ class KvbmStats:
     offloaded: int = 0
     onboard_hits_host: int = 0
     onboard_hits_disk: int = 0
+    onboard_hits_remote: int = 0
     onboard_misses: int = 0
 
     def to_dict(self) -> dict[str, int]:
@@ -47,8 +53,15 @@ class KvbmStats:
 
 
 class KvBlockManager:
-    def __init__(self, config: KvbmConfig | None = None):
+    def __init__(self, config: KvbmConfig | None = None, *, hub=None,
+                 loop=None, namespace: str = "dynamo"):
         self.config = config or KvbmConfig()
+        self.remote: RemoteBlockPool | None = None
+        if self.config.remote_max_blocks > 0 and hub is not None and loop is not None:
+            self.remote = RemoteBlockPool(
+                hub, loop, max_blocks=self.config.remote_max_blocks,
+                namespace=namespace,
+            )
         self.disk: DiskBlockPool | None = None
         if self.config.disk_bytes > 0 and self.config.disk_dir:
             self.disk = DiskBlockPool(self.config.disk_dir, self.config.disk_bytes)
@@ -60,6 +73,23 @@ class KvBlockManager:
         )
         self.stats = KvbmStats()
         self._lock = threading.Lock()
+        # G4 writes go through a dedicated best-effort writer: a slow/hung
+        # hub must not back up the offload thread and starve the purely
+        # LOCAL host tier (offload.py's queue is bounded and drops)
+        self._remote_q: queue.Queue | None = None
+        if self.remote is not None:
+            self._remote_q = queue.Queue(maxsize=128)
+            threading.Thread(
+                target=self._remote_writer, name="kvbm-g4-writer", daemon=True
+            ).start()
+
+    def _remote_writer(self) -> None:
+        while True:
+            sh, k, v = self._remote_q.get()
+            try:
+                self.remote.put(sh, k, v)
+            except Exception:  # noqa: BLE001
+                log.warning("g4 write failed", exc_info=True)
 
     def should_offload(self, block_index: int) -> bool:
         d = self.config.max_offload_depth_blocks
@@ -67,12 +97,21 @@ class KvBlockManager:
 
     def offer(self, sh: int, k: np.ndarray, v: np.ndarray) -> None:
         """Write-through insert from a sealed G1 page."""
-        if self.host.put(sh, np.ascontiguousarray(k), np.ascontiguousarray(v)):
+        k = np.ascontiguousarray(k)
+        v = np.ascontiguousarray(v)
+        if self.host.put(sh, k, v):
             with self._lock:
                 self.stats.offloaded += 1
+        if self._remote_q is not None:
+            # queue for G4 so OTHER workers can onboard this prefix;
+            # best-effort — a full queue (sick hub) just drops
+            try:
+                self._remote_q.put_nowait((sh, k, v))
+            except queue.Full:
+                pass
 
-    def get(self, sh: int) -> tuple[np.ndarray, np.ndarray] | None:
-        """Onboard probe: G2 then G3 (with promotion)."""
+    def _get_local(self, sh: int):
+        """G2 then G3, with promotion; no hub I/O."""
         blk = self.host.get(sh)
         if blk is not None:
             with self._lock:
@@ -85,11 +124,56 @@ class KvBlockManager:
                 with self._lock:
                     self.stats.onboard_hits_disk += 1
                 return blk
+        return None
+
+    def get(self, sh: int) -> tuple[np.ndarray, np.ndarray] | None:
+        """Onboard probe: G2, G3, then G4 (with promotion)."""
+        blk = self._get_local(sh)
+        if blk is not None:
+            return blk
+        if self.remote is not None:
+            blk = self.remote.get(sh)
+            if blk is not None:
+                self.host.put(sh, blk[0], blk[1])
+                with self._lock:
+                    self.stats.onboard_hits_remote += 1
+                return blk
         with self._lock:
             self.stats.onboard_misses += 1
         return None
 
+    def get_consecutive(self, hashes: list) -> list:
+        """Longest onboardable prefix of ``hashes`` (the admission-path
+        call): local tiers walk block by block, then the remaining tail is
+        fetched from G4 in ONE concurrent batch — bounding the engine
+        admission thread to a single round of hub I/O instead of an RTT
+        per block."""
+        out = []
+        i = 0
+        while i < len(hashes):
+            blk = self._get_local(hashes[i])
+            if blk is None:
+                break
+            out.append(blk)
+            i += 1
+        if self.remote is not None and i < len(hashes):
+            fetched = self.remote.get_many(list(hashes[i:]))
+            while i < len(hashes) and hashes[i] in fetched:
+                blk = fetched[hashes[i]]
+                self.host.put(hashes[i], blk[0], blk[1])
+                with self._lock:
+                    self.stats.onboard_hits_remote += 1
+                out.append(blk)
+                i += 1
+        if i < len(hashes):
+            with self._lock:
+                self.stats.onboard_misses += 1
+        return out
+
     def __contains__(self, sh: int) -> bool:
+        # the remote tier is intentionally excluded: __contains__ backs the
+        # advisory routing probe (engine prefix_hit_tokens) and must stay
+        # local/cheap; remote hits surface through get() at admission
         return sh in self.host or (self.disk is not None and sh in self.disk)
 
     def clear(self) -> None:

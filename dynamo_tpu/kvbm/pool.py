@@ -196,3 +196,134 @@ class DiskBlockPool:
             self._order.clear()
             self.used_bytes = 0
             self._save_index()
+
+
+class RemoteBlockPool:
+    """G4 remote tier: KV blocks in the hub object store, shared ACROSS
+    workers (ref: CacheLevel::G4 remote storage, block_manager.rs:62-74).
+
+    The cross-worker property is the point: a prefix offloaded by worker A
+    onboards on worker B without recompute — the single-cluster analogue
+    of the reference's remote/object-storage tier. Blocks serialize as a
+    JSON header (shapes/dtype) + raw bytes. Writes are capped per process
+    (``max_blocks``); the store itself does no eviction, so deployments
+    size the bucket budget via the cap. All hub I/O hops through the
+    event loop with a timeout (callers sit on engine worker threads).
+    """
+
+    BUCKET = "kvbm-g4"
+
+    def __init__(self, hub, loop, *, max_blocks: int = 4096,
+                 timeout_s: float = 5.0, namespace: str = "dynamo"):
+        import asyncio
+
+        self._asyncio = asyncio
+        self.hub = hub
+        self.loop = loop
+        self.max_blocks = max_blocks
+        self.timeout_s = timeout_s
+        self.bucket = f"{self.BUCKET}-{namespace}"
+        self._written: set[int] = set()  # hashes this process has stored
+        self._lock = threading.Lock()
+
+    def _call(self, coro):
+        fut = self._asyncio.run_coroutine_threadsafe(coro, self.loop)
+        try:
+            return fut.result(self.timeout_s)
+        except TimeoutError:
+            # leave nothing in flight: a hung hub must not accumulate
+            # coroutines each pinning a multi-MB payload
+            fut.cancel()
+            raise
+
+    @staticmethod
+    def _name(sh: int) -> str:
+        return f"{sh:016x}"
+
+    def put(self, sh: int, k: np.ndarray, v: np.ndarray) -> bool:
+        with self._lock:
+            if sh in self._written:
+                return True  # re-sealed hot prefix: already stored
+            if len(self._written) >= self.max_blocks:
+                return False
+            self._written.add(sh)
+        header = json.dumps({
+            "shape": list(k.shape), "dtype": k.dtype.name,
+        }).encode()
+        payload = (
+            len(header).to_bytes(4, "big") + header
+            + k.tobytes() + v.tobytes()
+        )
+        try:
+            self._call(self.hub.put_object(self.bucket, self._name(sh), payload))
+            return True
+        except Exception:  # noqa: BLE001 - remote tier is best-effort
+            log.warning("g4 put failed for %x", sh, exc_info=True)
+            with self._lock:
+                self._written.discard(sh)
+            return False
+
+    @staticmethod
+    def _decode(data: bytes) -> tuple[np.ndarray, np.ndarray] | None:
+        if not data:
+            return None
+        hlen = int.from_bytes(data[:4], "big")
+        header = json.loads(data[4 : 4 + hlen])
+        shape = tuple(header["shape"])
+        try:
+            dtype = np.dtype(header["dtype"])
+        except TypeError:
+            import ml_dtypes
+
+            dtype = np.dtype(getattr(ml_dtypes, header["dtype"]))
+        n = int(np.prod(shape)) * dtype.itemsize
+        body = data[4 + hlen:]
+        if len(body) < 2 * n:
+            raise ValueError("g4 payload shorter than header claims")
+        k = np.frombuffer(body[:n], dtype=dtype).reshape(shape)
+        v = np.frombuffer(body[n : 2 * n], dtype=dtype).reshape(shape)
+        return k, v
+
+    def get(self, sh: int) -> tuple[np.ndarray, np.ndarray] | None:
+        # everything is best-effort: a malformed/foreign object (other
+        # deployment sharing the bucket, partial write) is a MISS, never a
+        # failed admission
+        try:
+            data = self._call(self.hub.get_object(self.bucket, self._name(sh)))
+            return self._decode(data)
+        except Exception:  # noqa: BLE001
+            log.warning("g4 get failed for %x", sh, exc_info=True)
+            return None
+
+    def get_many(
+        self, shs: list[int]
+    ) -> dict[int, tuple[np.ndarray, np.ndarray]]:
+        """Concurrent fetch of several blocks — ONE round of hub I/O
+        instead of a blocking RTT per block (callers hold the engine
+        admission thread)."""
+        if not shs:
+            return {}
+
+        async def _gather():
+            return await self._asyncio.gather(
+                *(self.hub.get_object(self.bucket, self._name(sh))
+                  for sh in shs),
+                return_exceptions=True,
+            )
+
+        try:
+            results = self._call(_gather())
+        except Exception:  # noqa: BLE001
+            log.warning("g4 batch get failed", exc_info=True)
+            return {}
+        out: dict[int, tuple[np.ndarray, np.ndarray]] = {}
+        for sh, data in zip(shs, results):
+            if isinstance(data, BaseException):
+                continue
+            try:
+                blk = self._decode(data)
+            except Exception:  # noqa: BLE001
+                continue
+            if blk is not None:
+                out[sh] = blk
+        return out
