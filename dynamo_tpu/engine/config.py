@@ -117,6 +117,11 @@ class EngineConfig:
     # scheduling analogue): amortizes host dispatch + token sync; tokens
     # stream in bursts of this size, EOS overshoot is discarded host-side
     decode_steps_per_dispatch: int = 1
+    # pipeline one decode burst: dispatch k+1 (tokens chained on device)
+    # before syncing k's results, hiding dispatch/transfer latency behind
+    # device execution. Adds one burst of stop-detection lag; admissions
+    # and cancels flush first.
+    pipeline_decode: bool = False
     # chunked prefill (ref: vLLM max_num_batched_tokens pass-through):
     # prompts whose uncached tail exceeds this run as a sequence of
     # chunk-sized prefill steps interleaved with decode, so one long

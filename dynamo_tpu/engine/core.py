@@ -146,6 +146,7 @@ class InferenceEngine:
         self.steps = 0
         self._partial: _PartialPrefill | None = None
         self._clear_cache_requested = False
+        self._pipeline: dict | None = None  # dispatched-unprocessed burst
 
     # -- events ------------------------------------------------------------
 
@@ -286,6 +287,7 @@ class InferenceEngine:
                 log.exception("engine step failed; failing in-flight requests")
                 # queued offloads may reference pages about to be released
                 self._pending_offload.clear()
+                self._pipeline = None  # discard any in-flight burst
                 if self._partial is not None:
                     p, self._partial = self._partial, None
                     self.allocator.release(p.sp.pages)
@@ -315,6 +317,19 @@ class InferenceEngine:
 
     async def _step(self) -> bool:
         did = False
+        if self._pipeline is not None:
+            # the in-flight burst must land before anything mutates the
+            # batch under it: admissions, cancels, admin cache ops
+            needs_admit = self._partial is not None or (
+                any(s is None for s in self._slots)
+                and not self._waiting.empty()
+            )
+            stopped = any(
+                s is not None and s.context.is_stopped for s in self._slots
+            )
+            if needs_admit or stopped or self._clear_cache_requested:
+                await asyncio.to_thread(self._flush_pipeline)
+                did = True
         if self._clear_cache_requested:
             self._clear_cache_requested = False
             n = self.allocator.clear_cache()
@@ -906,7 +921,47 @@ class InferenceEngine:
         analogue of vLLM's multi-step scheduling). Tokens sampled past a
         mid-burst EOS/stop are discarded host-side; their cache writes land
         either on the trash page or in pages released when the slot
-        finishes."""
+        finishes.
+
+        ``pipeline_decode=True`` adds one burst of pipelining: burst k+1
+        dispatches with its fed tokens CHAINED ON DEVICE from burst k's
+        sampled output, and only then is burst k's host copy processed —
+        the device executes k+1 while the host pays the transfer/RTT and
+        bookkeeping for k. Stops are detected one burst late (discarded
+        garbage, as with mid-burst EOS); admissions, cancels, and admin ops
+        flush the pipeline first (_step)."""
+        if self.config.pipeline_decode:
+            pending = self._pipeline
+            self._pipeline = None
+            batch = self._build_batch(pending)
+            if batch is None:
+                if pending is not None:
+                    self._process_burst(pending)
+                return
+            results = self._dispatch_burst(batch, chain=pending)
+            if pending is not None:
+                self._process_burst(pending)
+            self._pipeline = {"batch": batch, "results": results}
+            return
+        batch = self._build_batch(None)
+        if batch is None:
+            return
+        results = self._dispatch_burst(batch, chain=None)
+        self._process_burst({"batch": batch, "results": results})
+
+    def _flush_pipeline(self) -> None:
+        """Process the in-flight burst (pipelined mode) so slot state is
+        exact before admissions/cancels/admin mutate the batch."""
+        pending, self._pipeline = self._pipeline, None
+        if pending is not None:
+            self._process_burst(pending)
+
+    def _build_batch(self, pending: dict | None) -> dict | None:
+        """Assemble host-side arrays for the next burst.
+
+        ``pending`` (pipelined mode) is the dispatched-but-unprocessed
+        burst: its participants have ``extra`` tokens already scheduled on
+        device, so sequence lengths/pages/RNG-steps advance past them."""
         cfg = self.config
         B = cfg.max_decode_slots
         tokens = np.zeros((B,), np.int32)
@@ -922,24 +977,41 @@ class InferenceEngine:
         MAX_STALL = 2000  # steps a slot may wait for a free page
         capacity = cfg.max_context
 
+        extra = np.zeros((B,), np.int32)
+        if pending is not None:
+            pb = pending["batch"]
+            for i in range(B):
+                if pb["active"][i] and self._slot_matches(i, pb):
+                    extra[i] = pb["n_burst"]
+
         # burst size: bounded by every ready slot's room to the context cap
         # (an overshooting position would clamp-index into a LIVE page)
         n_burst = cfg.decode_steps_per_dispatch
-        for slot in self._slots:
+        for i, slot in enumerate(self._slots):
             if slot is not None and not slot.context.is_stopped:
-                n_burst = max(1, min(n_burst, capacity - slot.seq_len))
+                n_burst = max(
+                    1, min(n_burst, capacity - slot.seq_len - int(extra[i]))
+                )
 
         for i, slot in enumerate(self._slots):
             if slot is None:
                 continue
             if slot.context.is_stopped:
-                self._finish(i, slot, "cancelled")
+                if pending is None:
+                    self._finish(i, slot, "cancelled")
+                # pipelined: _step flushed before cancels normally; a race
+                # here just skips the slot — the next (flushed) step
+                # finishes it
+                continue
+            if slot.remaining <= extra[i]:
+                # the in-flight burst already covers this slot's budget
                 continue
             # pages for every token this burst will EMIT (overshoot beyond
             # ``remaining`` scatters to the trash page via the zero-padded
             # block-table row)
-            need = min(slot.remaining, n_burst)
-            last_page = (slot.seq_len + need - 1) // cfg.page_size
+            sched_len = slot.seq_len + int(extra[i])
+            need = min(slot.remaining - int(extra[i]), n_burst)
+            last_page = (sched_len + need - 1) // cfg.page_size
             stalled = False
             while last_page >= slot.pages.num_pages:
                 try:
@@ -957,56 +1029,99 @@ class InferenceEngine:
                 continue
             slot.stalled_steps = 0
             active[i] = True
-            tokens[i] = slot.last_token
+            tokens[i] = slot.last_token  # chained on device when pipelined
             block_tables[i, : slot.pages.num_pages] = slot.pages.pages
-            seq_lens[i] = slot.seq_len + 1  # including the new token
+            seq_lens[i] = sched_len + 1  # including the new token
             temps[i] = slot.temperature
             topk[i] = slot.top_k
             topp[i] = slot.top_p
             seeds[i] = slot.sample_seed
-            steps[i] = slot.generated
+            steps[i] = slot.generated + int(extra[i])
 
         if not active.any():
-            return
+            return None
 
-        # logprobs are per-batch: any slot asking turns them on for the
-        # dispatch (unrequested slots just don't emit them)
-        # one fixed width when ANY slot wants logprobs: n_logprobs is a
-        # static jit arg, so per-batch-composition widths would recompile
-        # the fused decode program every time the mix changes
+        # one fixed logprob width when ANY slot asks: n_logprobs is a
+        # static jit arg, so per-batch widths would recompile the fused
+        # decode program every time the mix changes
         wants_lp = any(
             s is not None and s.logprobs is not None for s in self._slots
         )
         n_lp = min(20, self.spec.vocab_size - 1) if wants_lp else 0
 
+        return {
+            "n_burst": n_burst,
+            "n_lp": n_lp,
+            "active": active,
+            "participants": {
+                i: self._slots[i].request_id
+                for i in range(B)
+                if active[i]
+            },
+            "tokens": tokens,
+            "block_tables": block_tables,
+            "seq_lens": seq_lens,
+            "temps": temps,
+            "topk": topk,
+            "topp": topp,
+            "seeds": seeds,
+            "steps": steps,
+        }
+
+    def _slot_matches(self, i: int, batch: dict) -> bool:
+        slot = self._slots[i]
+        return slot is not None and slot.request_id == batch["participants"].get(i)
+
+    def _dispatch_burst(self, batch: dict, chain: dict | None):
+        """Issue the fused decode; feed tokens from the in-flight burst's
+        device output when chaining (no host sync on the feed path)."""
+        tokens_in = jnp.asarray(batch["tokens"])
+        if chain is not None:
+            prev_sampled = chain["results"][0]  # device [B, n_prev]
+            prev_active = jnp.asarray(chain["batch"]["active"])
+            tokens_in = jnp.where(prev_active, prev_sampled[:, -1], tokens_in)
         result = llama.decode_steps(
             self.spec,
             self.params,
-            jnp.asarray(tokens),
-            jnp.asarray(block_tables),
-            jnp.asarray(seq_lens),
+            tokens_in,
+            jnp.asarray(batch["block_tables"]),
+            jnp.asarray(batch["seq_lens"]),
             self.k_pages,
             self.v_pages,
-            jnp.asarray(active),
-            jnp.asarray(temps),
-            jnp.asarray(topk),
-            jnp.asarray(topp),
-            jnp.asarray(seeds),
-            jnp.asarray(steps),
-            n_steps=n_burst,
-            n_logprobs=n_lp,
+            jnp.asarray(batch["active"]),
+            jnp.asarray(batch["temps"]),
+            jnp.asarray(batch["topk"]),
+            jnp.asarray(batch["topp"]),
+            jnp.asarray(batch["seeds"]),
+            jnp.asarray(batch["steps"]),
+            n_steps=batch["n_burst"],
+            n_logprobs=batch["n_lp"],
             mesh=self.mesh,
         )
-        if n_lp > 0:
+        if batch["n_lp"] > 0:
             sampled, lp, top_i, top_v, self.k_pages, self.v_pages = result
-            lp = np.asarray(lp)
-            top_i = np.asarray(top_i)
-            top_v = np.asarray(top_v)
         else:
             sampled, self.k_pages, self.v_pages = result
             lp = top_i = top_v = None
-        sampled = np.asarray(sampled)  # [B, n_burst]
-        self.steps += n_burst
+        self.steps += batch["n_burst"]
+        return (sampled, lp, top_i, top_v)
+
+    def _process_burst(self, pending: dict) -> None:
+        """Sync a dispatched burst's tokens to host; apply stop semantics,
+        seal pages, stream items. Participant request-ids guard against a
+        slot that finished (and was discarded) between dispatch and
+        processing."""
+        batch = pending["batch"]
+        sampled_dev, lp_dev, ti_dev, tv_dev = pending["results"]
+        n_burst = batch["n_burst"]
+        active = batch["active"]
+        sampled = np.asarray(sampled_dev)  # [B, n_burst]
+        if lp_dev is not None:
+            lp = np.asarray(lp_dev)
+            top_i = np.asarray(ti_dev)
+            top_v = np.asarray(tv_dev)
+        else:
+            lp = top_i = top_v = None
 
         # phase 1: decide per-slot emit counts, advance cache state, seal.
         # Must fully precede phase 2: a finishing neighbor releases pages,
@@ -1014,7 +1129,7 @@ class InferenceEngine:
         # offload extraction reads it.
         burst: dict[int, tuple[list[int], str | None]] = {}
         for i, slot in enumerate(self._slots):
-            if slot is None or not active[i]:
+            if slot is None or not active[i] or not self._slot_matches(i, batch):
                 continue
             toks, finish = self._decide_burst(slot, sampled[i, :n_burst])
             burst[i] = (toks, finish)
