@@ -102,14 +102,18 @@ def serving_measurement(spec, page_size: int) -> dict:
     from dynamo_tpu.engine.core import InferenceEngine
     from dynamo_tpu.runtime.context import Context
 
-    N_REQ, ISL, OSL, SLOTS = 32, 128, 48, 16
+    N_REQ, ISL, OSL, SLOTS = 32, 128, 48, 32
     cfg = EngineConfig(
         page_size=page_size,
         num_pages=SLOTS * 16 + 64,
         max_pages_per_seq=16,
         max_decode_slots=SLOTS,
         prefill_buckets=(128, 256),
-        decode_steps_per_dispatch=8,
+        # bursts big enough that device compute covers the host sync
+        # round-trip, pipelined so burst k+1 computes while k's tokens
+        # cross back to the host
+        decode_steps_per_dispatch=16,
+        pipeline_decode=True,
     )
 
     async def run() -> dict:
@@ -212,12 +216,32 @@ def main() -> None:
     )  # compile
     toks.block_until_ready()
 
-    t0 = time.perf_counter()
-    toks, lens, gen, k_pages, v_pages = run(
-        STEPS, toks, lens, gen, k_pages, v_pages
-    )
-    toks.block_until_ready()
-    dt = time.perf_counter() - t0
+    # the tunneled device runtime's block_until_ready occasionally returns
+    # early, yielding a physically impossible number; a host copy cannot
+    # lie, so use it as the arbiter (outside the timed window when block
+    # was honest) and re-measure if the two disagree wildly. Retries reset
+    # lens/gen to the post-warmup values: the cache only has page room for
+    # WARMUP+STEPS tokens, so continuing from advanced state would decode
+    # past capacity (page content is timing-irrelevant garbage either way).
+    toks0, lens0_t, gen0_t = toks, lens, gen
+    for _attempt in range(5):
+        toks, lens, gen = toks0, lens0_t, gen0_t
+        t0 = time.perf_counter()
+        toks, lens, gen, k_pages, v_pages = run(
+            STEPS, toks, lens, gen, k_pages, v_pages
+        )
+        toks.block_until_ready()
+        dt = time.perf_counter() - t0
+        _ = np.asarray(toks)
+        dt_verified = time.perf_counter() - t0
+        if dt_verified < 2 * dt:
+            break
+        print(
+            f"# block_until_ready returned early ({dt:.4f}s vs verified "
+            f"{dt_verified:.4f}s); remeasuring",
+            file=sys.stderr,
+        )
+        dt = dt_verified
 
     n_chips = 1  # single-chip bench (driver runs on one real TPU chip)
     value = B * STEPS / dt / n_chips

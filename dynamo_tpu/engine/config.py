@@ -110,9 +110,16 @@ class EngineConfig:
     page_size: int = 16  # tokens per page (= router block_size granularity)
     num_pages: int = 2048  # HBM page budget (per shard)
     max_pages_per_seq: int = 64  # max context = page_size * this
-    # batching
-    max_decode_slots: int = 8  # concurrent sequences in the decode batch
+    # batching. None = auto-size from the page budget: enough slots that
+    # decode batch, not slot count, is the limiter, while every slot can
+    # still hold a full-length context out of the pool
+    max_decode_slots: int | None = 8
     prefill_buckets: tuple[int, ...] = (64, 128, 256, 512, 1024, 2048, 4096)
+    # per-step prefill admission token budget (ref: vLLM
+    # max_num_batched_tokens): waiting prompts are admitted (each a bucketed
+    # prefill dispatch) until the budget is spent, so a queue of short
+    # prompts lands in one step instead of one per step
+    max_prefill_tokens_per_step: int = 2048
     # decode model steps fused per device dispatch (vLLM multi-step
     # scheduling analogue): amortizes host dispatch + token sync; tokens
     # stream in bursts of this size, EOS overshoot is discarded host-side
@@ -136,6 +143,12 @@ class EngineConfig:
     seed: int = 0
     # scheduler
     step_idle_sleep_s: float = 0.002
+
+    def __post_init__(self) -> None:
+        if self.max_decode_slots is None:
+            self.max_decode_slots = max(
+                8, min(64, self.num_pages // max(1, self.max_pages_per_seq))
+            )
 
     @property
     def max_context(self) -> int:

@@ -1,9 +1,10 @@
 """InferenceEngine: continuous batching over the paged JAX model.
 
 The engine is the TPU-native replacement for the reference's delegated
-engines (vLLM et al.). One background step loop owns the device:
+engines (vLLM et al.). One dedicated step THREAD owns the device (no
+per-step event-loop round-trips — dispatch latency goes straight to ITL):
 
-  admit -> prefill (one request per step, bucketed static shape)
+  admit -> prefill (token-budgeted batch of waiting prompts per step)
         -> decode (all active slots, one fixed-shape step)
         -> sample on device -> stream tokens to per-request queues
 
@@ -18,7 +19,9 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import queue
 import threading
+import time
 from dataclasses import dataclass, field
 from typing import Any, AsyncIterator
 
@@ -28,7 +31,7 @@ import numpy as np
 
 from dynamo_tpu.engine.cache import OutOfPages, PageAllocator, SeqPages
 from dynamo_tpu.engine.config import EngineConfig, ModelSpec
-from dynamo_tpu.engine.sampling import sample_tokens
+from dynamo_tpu.engine.sampling import sample_tokens, token_logprobs
 from dynamo_tpu.kv_router.protocols import ForwardPassMetrics
 from dynamo_tpu.models import llama
 from dynamo_tpu.runtime.context import Context
@@ -136,12 +139,12 @@ class InferenceEngine:
             on_evict=self._on_evict,
         )
         self._slots: list[_Slot | None] = [None] * self.config.max_decode_slots
-        self._waiting: asyncio.Queue[_Waiting] = asyncio.Queue()
+        self._waiting: queue.Queue[_Waiting] = queue.Queue()
         self._seed_counter = self.config.seed
-        self._loop_task: asyncio.Task | None = None
+        self._thread: threading.Thread | None = None
         self._loop: asyncio.AbstractEventLoop | None = None
         self._loop_thread: int | None = None
-        self._wake = asyncio.Event()
+        self._wake = threading.Event()
         self._closed = False
         self.steps = 0
         self._partial: _PartialPrefill | None = None
@@ -180,17 +183,31 @@ class InferenceEngine:
     # -- public API --------------------------------------------------------
 
     async def start(self) -> "InferenceEngine":
-        if self._loop_task is None or self._loop_task.done():
+        if self._thread is None or not self._thread.is_alive():
             self._loop = asyncio.get_running_loop()
             self._loop_thread = threading.get_ident()
-            self._loop_task = self._loop.create_task(self._step_loop())
+            self._thread = threading.Thread(
+                target=self._thread_loop, name="engine-step", daemon=True
+            )
+            self._thread.start()
         return self
+
+    @property
+    def is_dead(self) -> bool:
+        """True when the step thread exited WITHOUT an orderly close —
+        the watchdog signal (ref VllmEngineMonitor / EngineDeadError)."""
+        return (
+            self._thread is not None
+            and not self._thread.is_alive()
+            and not self._closed
+        )
 
     async def close(self) -> None:
         self._closed = True
         self._wake.set()
-        if self._loop_task is not None:
-            self._loop_task.cancel()
+        if self._thread is not None and self._thread.is_alive():
+            # the thread exits at the next step boundary
+            await asyncio.to_thread(self._thread.join, 10.0)
         if self.offload is not None:
             # blocking join (may wait on an in-flight DMA) — keep it off
             # the event loop
@@ -253,7 +270,7 @@ class InferenceEngine:
                        "error": f"kv transfer pull failed: {e}"}
                 return
         out_q: asyncio.Queue = asyncio.Queue()
-        await self._waiting.put(_Waiting(request, context, out_q))
+        self._waiting.put_nowait(_Waiting(request, context, out_q))
         self._wake.set()
         while True:
             item = await out_q.get()
@@ -265,10 +282,12 @@ class InferenceEngine:
 
     # -- step loop ---------------------------------------------------------
 
-    async def _step_loop(self) -> None:
+    def _thread_loop(self) -> None:
+        """The step thread: owns the device, never touches the event loop
+        except via thread-safe _post. Blocking waits are fine here."""
         while not self._closed:
             try:
-                did_work = await self._step()
+                did_work = self._step()
                 if not did_work:
                     self._wake.clear()
                     if (
@@ -276,11 +295,9 @@ class InferenceEngine:
                         and not any(self._slots)
                         and self._partial is None
                     ):
-                        await self._wake.wait()
+                        self._wake.wait()
                     else:
-                        await asyncio.sleep(self.config.step_idle_sleep_s)
-            except asyncio.CancelledError:
-                return
+                        self._wake.wait(self.config.step_idle_sleep_s)
             except Exception:  # noqa: BLE001
                 # fail every in-flight request, then KEEP SERVING: one bad
                 # step must not brick the worker
@@ -291,9 +308,10 @@ class InferenceEngine:
                 if self._partial is not None:
                     p, self._partial = self._partial, None
                     self.allocator.release(p.sp.pages)
-                    p.waiting.out_q.put_nowait(
+                    self._post(
+                        p.waiting.out_q,
                         {"token_ids": [], "finish_reason": "error",
-                         "error": "engine step failure"}
+                         "error": "engine step failure"},
                     )
                 for i, slot in enumerate(self._slots):
                     if slot is not None:
@@ -301,11 +319,12 @@ class InferenceEngine:
                 while not self._waiting.empty():
                     w = self._waiting.get_nowait()
                     self._drop_staged_kv(w.request)
-                    w.out_q.put_nowait(
+                    self._post(
+                        w.out_q,
                         {"token_ids": [], "finish_reason": "error",
-                         "error": "engine step failure"}
+                         "error": "engine step failure"},
                     )
-                await asyncio.sleep(0.05)
+                time.sleep(0.05)
 
     def request_clear_cache(self) -> None:
         """Admin: drop every inactive prefix-cache page (ref the HTTP
@@ -315,7 +334,7 @@ class InferenceEngine:
         self._clear_cache_requested = True
         self._wake.set()
 
-    async def _step(self) -> bool:
+    def _step(self) -> bool:
         did = False
         if self._pipeline is not None:
             # the in-flight burst must land before anything mutates the
@@ -328,7 +347,7 @@ class InferenceEngine:
                 s is not None and s.context.is_stopped for s in self._slots
             )
             if needs_admit or stopped or self._clear_cache_requested:
-                await asyncio.to_thread(self._flush_pipeline)
+                self._flush_pipeline()
                 did = True
         if self._clear_cache_requested:
             self._clear_cache_requested = False
@@ -336,34 +355,71 @@ class InferenceEngine:
             log.info("admin clear_kv_blocks: evicted %d cached pages", n)
             self._publish_metrics()
             did = True
-        # 1) advance an in-flight chunked prefill, or admit one waiting
-        # request (prefill); either way decode still runs below, so a long
-        # prompt only ever steals one chunk's worth of device time per step
+        # 1) advance an in-flight chunked prefill, or admit waiting requests
+        # up to a per-step token budget (ref: vLLM max_num_batched_tokens
+        # scheduling — many short prompts enter in ONE step instead of
+        # serializing one admission behind every decode step); decode still
+        # runs below, so prefills steal at most a budget's worth of device
+        # time per step
         if self._partial is not None:
-            await asyncio.to_thread(self._advance_partial_safe)
+            self._advance_partial_safe()
             did = True
             self._publish_metrics()
         else:
-            free_idx = next(
-                (i for i, s in enumerate(self._slots) if s is None), None
-            )
-            if free_idx is not None and not self._waiting.empty():
+            budget = self.config.max_prefill_tokens_per_step
+            admitted = False
+            pending: list[tuple] = []
+            reserved: set[int] = set()
+            while self._partial is None:
+                free_idx = next(
+                    (
+                        i
+                        for i, s in enumerate(self._slots)
+                        if s is None and i not in reserved
+                    ),
+                    None,
+                )
+                if free_idx is None or self._waiting.empty():
+                    break
+                cost = len(
+                    self._peek_waiting_tokens() or ()
+                ) or 1
+                cost = min(cost, self._prefill_chunk_max())
+                if admitted and cost > budget:
+                    break  # first admission always proceeds
                 waiting = self._waiting.get_nowait()
                 if waiting.context.is_stopped:
                     self._drop_staged_kv(waiting.request)
-                    waiting.out_q.put_nowait(
-                        {"token_ids": [], "finish_reason": "cancelled"}
+                    self._post(
+                        waiting.out_q,
+                        {"token_ids": [], "finish_reason": "cancelled"},
                     )
                 else:
-                    await asyncio.to_thread(self._prefill_safe, free_idx, waiting)
+                    rec = self._prefill_safe(free_idx, waiting)
+                    if rec is not None:
+                        pending.append(rec)
+                        reserved.add(free_idx)
+                    budget -= cost
+                    admitted = True
                 did = True
+            if pending:
+                self._complete_admissions(pending)
+            if did:
                 self._publish_metrics()
 
         # 2) one decode step over active slots
         if any(s is not None for s in self._slots):
-            await asyncio.to_thread(self._decode_step)
+            self._decode_step()
             did = True
         return did
+
+    def _peek_waiting_tokens(self) -> list | None:
+        """Prompt tokens of the next waiting request without dequeuing (the
+        step thread is the only consumer, so the head is stable)."""
+        with self._waiting.mutex:
+            if not self._waiting.queue:
+                return None
+            return self._waiting.queue[0].request.get("token_ids")
 
     @staticmethod
     def _drop_staged_kv(request: dict[str, Any]) -> None:
@@ -377,14 +433,18 @@ class InferenceEngine:
 
     # -- prefill (runs in thread) ------------------------------------------
 
-    def _prefill_safe(self, slot_idx: int, waiting: _Waiting) -> None:
-        """Per-request error isolation: a bad request must not kill the loop."""
+    def _prefill_safe(self, slot_idx: int, waiting: _Waiting) -> tuple | None:
+        """Per-request error isolation: a bad request must not kill the loop.
+
+        Returns a pending-admission record (see _prefill_with_pages) when
+        the prompt finished its forward and awaits first-token sampling;
+        None when handled fully (disagg resume, chunked start, error)."""
         try:
             disagg = waiting.request.get("disagg") or {}
             if disagg.get("mode") == "decode" and disagg.get("kv_transfer"):
                 self._resume_from_remote(slot_idx, waiting)
-            else:
-                self._prefill(slot_idx, waiting)
+                return None
+            return self._prefill(slot_idx, waiting)
         except Exception as e:  # noqa: BLE001
             log.exception("prefill failed for %s", waiting.context.id)
             self._post(
@@ -392,6 +452,7 @@ class InferenceEngine:
                 {"token_ids": [], "finish_reason": "error",
                  "error": f"prefill failed: {e}"},
             )
+            return None
 
     def _embed(self, token_ids: list[int]) -> list[float]:
         """Pooled sequence embedding (bucketed pad for compile reuse)."""
@@ -609,7 +670,7 @@ class InferenceEngine:
         cfg = self.config
         return min(cfg.max_prefill_chunk_tokens, cfg.prefill_buckets[-1])
 
-    def _prefill(self, slot_idx: int, waiting: _Waiting) -> None:
+    def _prefill(self, slot_idx: int, waiting: _Waiting) -> tuple | None:
         cfg = self.config
         req = waiting.request
         token_ids = list(req["token_ids"])
@@ -628,12 +689,12 @@ class InferenceEngine:
                 {"token_ids": [], "finish_reason": "error",
                  "error": "kv pages exhausted"},
             )
-            return
+            return None
         start_pos = sp.cached_prefix_pages * cfg.page_size
         tail = len(token_ids) - start_pos
 
         try:
-            self._prefill_with_pages(
+            return self._prefill_with_pages(
                 slot_idx, waiting, seq, sp, token_ids, max_tokens,
                 start_pos, tail,
             )
@@ -648,7 +709,13 @@ class InferenceEngine:
     def _prefill_with_pages(
         self, slot_idx, waiting, seq, sp, token_ids, max_tokens,
         start_pos, tail,
-    ) -> None:
+    ) -> tuple | None:
+        """Run the prompt's forward. Returns a pending-admission record
+        ``(slot_idx, waiting, seq, sp, token_ids, max_tokens, logits)``
+        with logits still ON DEVICE — first-token sampling is batched
+        across all admissions of the step (_complete_admissions) so the
+        step pays ONE device->host sync, not one per prompt. Returns None
+        when a chunked prefill was started instead."""
         cfg = self.config
         use_ring = (
             self.mesh is not None
@@ -677,24 +744,126 @@ class InferenceEngine:
                 jnp.asarray(tail, jnp.int32),
                 mesh=self.mesh,
             )
-            self._finish_prefill(
-                slot_idx, waiting, seq, sp, token_ids, max_tokens, logits
-            )
-            return
+            self._seal_prompt_blocks(sp, seq)
+            self._drain_offload()
+            return (slot_idx, waiting, seq, sp, token_ids, max_tokens, logits)
 
         chunk_max = self._prefill_chunk_max()
         end = min(start_pos + chunk_max, len(token_ids))
         logits = self._run_prefill_chunk(sp, token_ids, start_pos, end)
         if end == len(token_ids):
-            self._finish_prefill(
-                slot_idx, waiting, seq, sp, token_ids, max_tokens, logits
+            self._seal_prompt_blocks(sp, seq)
+            self._drain_offload()
+            return (slot_idx, waiting, seq, sp, token_ids, max_tokens, logits)
+        # long prompt: remaining chunks advance on subsequent steps,
+        # interleaved with decode (_step)
+        self._partial = _PartialPrefill(
+            slot_idx, waiting, seq, sp, token_ids, end, max_tokens
+        )
+        return None
+
+    def _complete_admissions(self, pending: list[tuple]) -> None:
+        """Sample every admitted prompt's first token in ONE batched call —
+        one device->host sync per step regardless of admission count (the
+        sync round-trip dominates TTFT when the host is far from the
+        chip). Batch width pads to one static width (max_decode_slots) so
+        sample_tokens keeps a single compiled shape: every extra jit
+        compile costs whole seconds on TPU and would stall serving the
+        first time each admission count appears."""
+        recs: list[tuple] = []
+        try:
+            for slot_idx, waiting, seq, sp, token_ids, max_tokens, logits in pending:
+                slot = self._make_slot(
+                    waiting, seq, sp,
+                    seq_len=len(token_ids), remaining=max_tokens,
+                    last_token=token_ids[-1],
+                )
+                recs.append((slot_idx, waiting, slot, logits, token_ids, sp))
+            n = len(recs)
+            bucket = max(n, self.config.max_decode_slots)
+            stacked = jnp.stack(
+                [r[3] for r in recs] + [recs[0][3]] * (bucket - n)
             )
-        else:
-            # long prompt: remaining chunks advance on subsequent steps,
-            # interleaved with decode (_step)
-            self._partial = _PartialPrefill(
-                slot_idx, waiting, seq, sp, token_ids, end, max_tokens
+            temps = np.zeros((bucket,), np.float32)
+            topk = np.zeros((bucket,), np.int32)
+            topp = np.ones((bucket,), np.float32)
+            seeds = np.zeros((bucket,), np.uint32)
+            gens = np.zeros((bucket,), np.int32)
+            for i, (_si, _w, slot, _l, _t, _sp) in enumerate(recs):
+                temps[i] = slot.temperature
+                topk[i] = slot.top_k
+                topp[i] = slot.top_p
+                seeds[i] = slot.sample_seed
+                gens[i] = slot.generated
+            sampled_dev = sample_tokens(
+                stacked, jnp.asarray(temps), jnp.asarray(topk),
+                jnp.asarray(topp), jnp.asarray(seeds), jnp.asarray(gens),
             )
+            # logprobs, when any admitted prompt wants them, batch over the
+            # same stacked logits: one more fused sync, not one per record
+            lp = top_i = top_v = None
+            if any(r[2].logprobs is not None for r in recs):
+                n_lp = min(20, self.spec.vocab_size - 1)
+                picked, ti, tv = token_logprobs(stacked, sampled_dev, n_lp)
+                toks, lp, top_i, top_v = jax.device_get(
+                    (sampled_dev, picked, ti, tv)
+                )
+            else:
+                toks = np.asarray(sampled_dev)
+        except Exception as e:  # noqa: BLE001
+            log.exception("batched admission completion failed")
+            for _si, waiting, _seq, sp, _t, _m, _l in pending:
+                self.allocator.release(sp.pages)
+                sp.pages = []
+                self._post(
+                    waiting.out_q,
+                    {"token_ids": [], "finish_reason": "error",
+                     "error": f"prefill failed: {e}"},
+                )
+            return
+
+        for i, (slot_idx, waiting, slot, logits, token_ids, sp) in enumerate(recs):
+            # per-record isolation: one bad emit (disagg export, handoff)
+            # must not strand the step's other admissions
+            try:
+                tok = int(toks[i])
+                entry = None
+                if slot.logprobs is not None and lp is not None:
+                    entry = {
+                        "id": tok,
+                        "logprob": float(lp[i]),
+                        "top": [
+                            {"id": int(top_i[i, t]),
+                             "logprob": float(top_v[i, t])}
+                            for t in range(slot.logprobs)
+                        ],
+                    }
+                disagg = waiting.request.get("disagg") or {}
+                if (
+                    (disagg.get("kv_transfer") or {}).get("do_remote_decode")
+                    and self.transfer_source is not None
+                ):
+                    # disagg prefill: stage KV to host, hand off, free pages
+                    self._export_and_finish(slot, sp, token_ids, tok, entry)
+                    continue
+                self._emit_token(slot_idx, slot, tok, logprob_entry=entry)
+            except Exception as e:  # noqa: BLE001
+                log.exception(
+                    "admission emit failed for %s", waiting.context.id
+                )
+                if self._slots[slot_idx] is slot:
+                    self._finish(
+                        slot_idx, slot, "error",
+                        error=f"admission failed: {e}",
+                    )
+                else:
+                    self.allocator.release(sp.pages)
+                    sp.pages = []
+                    self._post(
+                        waiting.out_q,
+                        {"token_ids": [], "finish_reason": "error",
+                         "error": f"admission failed: {e}"},
+                    )
 
     def _run_prefill_chunk(
         self, sp: SeqPages, token_ids: list[int], start: int, end: int
@@ -750,62 +919,12 @@ class InferenceEngine:
         p.done = end
         if end == len(p.token_ids):
             self._partial = None
-            self._finish_prefill(
-                p.slot_idx, p.waiting, p.seq, p.sp, p.token_ids,
-                p.max_tokens, logits,
-            )
-
-    def _finish_prefill(
-        self,
-        slot_idx: int,
-        waiting: _Waiting,
-        seq: TokenBlockSequence,
-        sp: SeqPages,
-        token_ids: list[int],
-        max_tokens: int,
-        logits: jax.Array,
-    ) -> None:
-        """Common prefill tail: seal pages, sample first token, enter decode
-        (or hand off KV for disagg prefill workers)."""
-        # seal prompt pages whose block is complete (skip already-cached)
-        self._seal_prompt_blocks(sp, seq)
-        self._drain_offload()
-        slot = self._make_slot(
-            waiting, seq, sp,
-            seq_len=len(token_ids), remaining=max_tokens,
-            last_token=token_ids[-1],
-        )
-
-        # sample the first token from prefill logits
-        tok = self._sample_single(logits, slot)
-        entry = None
-        if slot.logprobs is not None:
-            entry = self._logprob_entry(logits, tok, slot.logprobs)
-        disagg = waiting.request.get("disagg") or {}
-        if (
-            (disagg.get("kv_transfer") or {}).get("do_remote_decode")
-            and self.transfer_source is not None
-        ):
-            # disagg prefill: stage KV to host, hand off, free device pages
-            self._export_and_finish(slot, sp, token_ids, tok, entry)
-            return
-        self._emit_token(slot_idx, slot, tok, logprob_entry=entry)
-
-    def _logprob_entry(self, logits: jax.Array, tok: int, n: int) -> dict:
-        from dynamo_tpu.engine.sampling import token_logprobs
-
-        picked, ti, tv = token_logprobs(
-            logits[None, :], jnp.asarray([tok], jnp.int32), max(n, 1)
-        )
-        ti, tv = np.asarray(ti), np.asarray(tv)
-        return {
-            "id": tok,
-            "logprob": float(np.asarray(picked)[0]),
-            "top": [
-                {"id": int(ti[0, t]), "logprob": float(tv[0, t])}
-                for t in range(n)
-            ],
-        }
+            self._seal_prompt_blocks(p.sp, p.seq)
+            self._drain_offload()
+            self._complete_admissions([
+                (p.slot_idx, p.waiting, p.seq, p.sp, p.token_ids,
+                 p.max_tokens, logits)
+            ])
 
     def _export_and_finish(
         self, slot: _Slot, sp: SeqPages, token_ids: list[int], tok: int,
@@ -1197,17 +1316,6 @@ class InferenceEngine:
         return toks, finish
 
     # -- helpers -----------------------------------------------------------
-
-    def _sample_single(self, logits: jax.Array, slot: _Slot) -> int:
-        tok = sample_tokens(
-            logits[None, :],
-            jnp.asarray([slot.temperature], jnp.float32),
-            jnp.asarray([slot.top_k], jnp.int32),
-            jnp.asarray([slot.top_p], jnp.float32),
-            jnp.asarray([slot.sample_seed], jnp.uint32),
-            jnp.asarray([slot.generated], jnp.int32),
-        )
-        return int(np.asarray(tok)[0])
 
     def _maybe_seal(self, slot: _Slot) -> None:
         """Seal the page whose block just completed (if any)."""

@@ -208,14 +208,27 @@ def prefill_forward_impl(
     """
     T = tokens.shape[0]
     idx = jnp.arange(T)
-    valid = idx < num_tokens
     positions = start_pos + idx  # absolute positions of new tokens
     page_size = k_pages.shape[3]
 
-    # padded positions scatter to the trash page
-    page_idx_raw = block_table[positions // page_size]
-    safe_page = jnp.where(valid, page_idx_raw, TRASH_PAGE)
-    offset = positions % page_size
+    # Page-granular KV write: prefix-cache hits and chunk boundaries are
+    # page-aligned (engine invariant), so the T new tokens start at a page
+    # boundary and land as whole [page_size, D] tiles — one scatter over
+    # T/page indices instead of T token rows (XLA lowers tile scatters an
+    # order of magnitude faster on TPU; the trailing tile stays
+    # contiguous). Garbage in a partial tail page sits beyond num_tokens:
+    # masked in attention, overwritten as decode appends. Fully-padded
+    # pages go to the trash page (duplicate trash indices are fine).
+    n_pg = T // page_size
+    page_starts = start_pos + jnp.arange(n_pg) * page_size
+    pg_idx_raw = block_table[page_starts // page_size]
+    safe_pg = jnp.where(
+        page_starts < start_pos + num_tokens, pg_idx_raw, TRASH_PAGE
+    )
+
+    def to_tiles(arr):  # [T, KH, D] -> [n_pg, KH, page, D]
+        kh, hd = arr.shape[1], arr.shape[2]
+        return arr.reshape(n_pg, page_size, kh, hd).transpose(0, 2, 1, 3)
 
     x = params["embed"][tokens]  # [T, d]
     kv_len = start_pos + num_tokens
@@ -223,10 +236,11 @@ def prefill_forward_impl(
     for li, lp in enumerate(params["layers"]):
         h = rms_norm(x, lp["attn_norm"], spec.rms_eps)
         q, k, v = _attn_qkv(spec, lp, h, positions)
-        # li/safe_page/offset are all advanced indices split by the ':'
-        # slice, so the broadcast dim moves to the FRONT: update is [T, KH, D]
-        k_pages = k_pages.at[li, :, safe_page, offset].set(k)
-        v_pages = v_pages.at[li, :, safe_page, offset].set(v)
+        # li (scalar) and safe_pg (vector) are advanced indices split by
+        # the ':' slice -> broadcast dim moves to the FRONT: update is
+        # [n_pg, KH, page, D]
+        k_pages = k_pages.at[li, :, safe_pg].set(to_tiles(k))
+        v_pages = v_pages.at[li, :, safe_pg].set(to_tiles(v))
         k_ctx = gather_pages(k_pages[li], block_table)  # [max_ctx, kvh, D]
         v_ctx = gather_pages(v_pages[li], block_table)
         attn = causal_attention(q, k_ctx, v_ctx, positions, kv_len)
@@ -268,11 +282,17 @@ def prefill_forward_ring_impl(
 
     T = tokens.shape[0]
     idx = jnp.arange(T)
-    valid = idx < num_tokens
     page_size = k_pages.shape[3]
-    page_idx_raw = block_table[idx // page_size]
-    safe_page = jnp.where(valid, page_idx_raw, TRASH_PAGE)
-    offset = idx % page_size
+    # page-granular tile writes (see prefill_forward_impl): ring prefill is
+    # cold (start 0), so the prompt starts page-aligned by construction
+    n_pg = T // page_size
+    page_starts = jnp.arange(n_pg) * page_size
+    pg_idx_raw = block_table[page_starts // page_size]
+    safe_pg = jnp.where(page_starts < num_tokens, pg_idx_raw, TRASH_PAGE)
+
+    def to_tiles(arr):  # [T, KH, D] -> [n_pg, KH, page, D]
+        kh, hd = arr.shape[1], arr.shape[2]
+        return arr.reshape(n_pg, page_size, kh, hd).transpose(0, 2, 1, 3)
 
     sp_spec = NamedSharding(mesh, P("sp", None))
     x = params["embed"][tokens]
@@ -281,10 +301,8 @@ def prefill_forward_ring_impl(
     for li, lp in enumerate(params["layers"]):
         h = rms_norm(x, lp["attn_norm"], spec.rms_eps)
         q, k, v = _attn_qkv(spec, lp, h, idx)
-        # li/safe_page/offset are all advanced indices split by the ':'
-        # slice, so the broadcast dim moves to the FRONT: update is [T, KH, D]
-        k_pages = k_pages.at[li, :, safe_page, offset].set(k)
-        v_pages = v_pages.at[li, :, safe_page, offset].set(v)
+        k_pages = k_pages.at[li, :, safe_pg].set(to_tiles(k))
+        v_pages = v_pages.at[li, :, safe_pg].set(to_tiles(v))
         attn = ring_attention(q, k, v, mesh=mesh)
         x = x + attn.reshape(T, spec.num_heads * spec.head_dim) @ lp["wo"]
         h = rms_norm(x, lp["mlp_norm"], spec.rms_eps)

@@ -274,6 +274,9 @@ class EngineMonitor:
         self._task = asyncio.get_running_loop().create_task(self._watch())
 
     def _engine_dead(self) -> bool:
+        dead = getattr(self.engine, "is_dead", None)
+        if dead is not None:
+            return bool(dead)
         task = getattr(self.engine, "_loop_task", None)
         return task is not None and task.done()
 

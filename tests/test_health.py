@@ -140,8 +140,13 @@ async def test_engine_monitor_shuts_down_on_dead_loop():
     )
     monitor = EngineMonitor(drt, engine, interval_s=0.05)
     try:
-        # simulate an engine death (not an orderly close)
-        engine._loop_task.cancel()
+        # simulate an engine death (not an orderly close): a BaseException
+        # escapes the step thread's Exception recovery and kills it
+        def _boom() -> bool:
+            raise BaseException("simulated engine death")  # noqa: TRY002
+
+        engine._step = _boom
+        engine._wake.set()
         await asyncio.sleep(0)
         await _wait_for(lambda: drt._closed, what="runtime shutdown")
         # instance deregistered from the hub

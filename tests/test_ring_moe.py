@@ -104,14 +104,22 @@ def test_ring_prefill_matches_reference_forward():
     np.testing.assert_allclose(
         np.asarray(logits), np.asarray(ref[-1]), atol=2e-4
     )
-    # KV written by the ring path must equal the plain paged path's
+    # KV written by the ring path must equal the plain paged path's for
+    # every REAL token row. (Partial-tail-page rows beyond num_tokens hold
+    # padded-position garbage — masked by attention, overwritten as decode
+    # appends — and the two paths' garbage legitimately differs from layer
+    # 2 on: padded activations see different attention masks.)
     k2, v2 = llama.init_cache(spec, pages + 1, page_size)
     _, k2, v2 = llama.prefill_forward(
         spec, params, jnp.asarray(padded), jnp.asarray(np.pad(bt, (0, 0))),
         jnp.asarray(0, jnp.int32), k2, v2, jnp.asarray(13, jnp.int32),
     )
     np.testing.assert_allclose(
-        np.asarray(k_pages[:, :, 1:5]), np.asarray(k2[:, :, 1:5]), atol=1e-5
+        np.asarray(k_pages[:, :, 1:4]), np.asarray(k2[:, :, 1:4]), atol=1e-5
+    )
+    np.testing.assert_allclose(  # partial page: only its one valid row
+        np.asarray(k_pages[:, :, 4, :1]), np.asarray(k2[:, :, 4, :1]),
+        atol=1e-5,
     )
 
 
