@@ -65,8 +65,9 @@ def bench_spec(on_tpu: bool) -> tuple[ModelSpec, int, int, int]:
         )
         # same workload as BENCH_r01 (B=64, 256-token contexts) so
         # vs_baseline stays apples-to-apples; page=32 measured best on v5e
-        # (fewer, larger attention DMAs than 16; 64 is no better and
-        # coarsens prefix-cache granularity). Env knobs for exploration.
+        # with the v3 deep-pipeline attention kernel (64 halves the DMA
+        # count but its 16KB-per-head strided bursts measure slower
+        # in-model). Env knobs for exploration.
         B = int(os.environ.get("DYNAMO_BENCH_BATCH", "64"))
         page = int(os.environ.get("DYNAMO_BENCH_PAGE", "32"))
         return spec, B, page, max(1, 256 // page)  # 256-token tables

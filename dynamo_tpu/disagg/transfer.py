@@ -1,7 +1,7 @@
 """KV-block transfer plane: the NIXL-RDMA equivalent for TPU serving.
 
 The prefill worker exports finished prompt KV pages (host-staged numpy
-blocks, head-major: shape [L, kv_heads, n_pages, page_size, head_dim]); the decode
+blocks, page-major: shape [L, n_pages, kv_heads, page_size, head_dim]); the decode
 worker pulls them by ``transfer_id`` and scatters them into its own page
 pool. Metadata (transfer_id + address) rides the request/response path —
 exactly the reference's ``kv_transfer_params`` roundtrip
@@ -52,7 +52,7 @@ def _dtype_from_name(name: str):
 
 @dataclass
 class _Export:
-    k: np.ndarray  # [L, kv_heads, n_pages, page_size, head_dim]
+    k: np.ndarray  # [L, n_pages, kv_heads, page_size, head_dim]
     v: np.ndarray
     meta: dict
     created: float = field(default_factory=time.monotonic)

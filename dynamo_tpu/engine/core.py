@@ -549,11 +549,11 @@ class InferenceEngine:
                     np.asarray([sp.pages[i] for i in idxs], np.int32)
                 )
                 # tier blocks are [L, KH, page, D]; insert_kv_pages wants the
-                # n stacked pages on axis 2: [L, KH, n, page, D]
+                # n stacked pages on axis 1: [L, n, KH, page, D] (page-major)
                 self.k_pages, self.v_pages = llama.insert_kv_pages(
                     self.k_pages, self.v_pages, page_ids,
-                    jnp.asarray(np.stack([b[0] for b in onboard], axis=2)),
-                    jnp.asarray(np.stack([b[1] for b in onboard], axis=2)),
+                    jnp.asarray(np.stack([b[0] for b in onboard], axis=1)),
+                    jnp.asarray(np.stack([b[1] for b in onboard], axis=1)),
                 )
             except Exception:
                 self.allocator.release(sp.pages)
@@ -1010,8 +1010,8 @@ class InferenceEngine:
                 )
                 self.k_pages, self.v_pages = llama.insert_kv_pages(
                     self.k_pages, self.v_pages, page_ids,
-                    jnp.asarray(k_blocks[:, :, install]),
-                    jnp.asarray(v_blocks[:, :, install]),
+                    jnp.asarray(k_blocks[:, install]),
+                    jnp.asarray(v_blocks[:, install]),
                 )
             self._seal_prompt_blocks(sp, seq)
             self._drain_offload()

@@ -68,6 +68,6 @@ class OffloadEngine:
                 # np.asarray blocks until the async device->host copy lands
                 k_np, v_np = np.asarray(kb), np.asarray(vb)
                 for i, sh in enumerate(hashes):
-                    self.manager.offer(sh, k_np[:, :, i], v_np[:, :, i])
+                    self.manager.offer(sh, k_np[:, i], v_np[:, i])
             except Exception:  # noqa: BLE001
                 log.exception("offload batch failed")

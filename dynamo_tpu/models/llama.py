@@ -114,23 +114,26 @@ def param_shardings(spec: ModelSpec, mesh: Mesh) -> Params:
 
 
 def cache_shardings(mesh: Mesh) -> tuple[NamedSharding, NamedSharding]:
-    """KV pages [L, kv_heads, pages, page_size, D]: shard kv_heads on tp."""
-    s = NamedSharding(mesh, P(None, "tp", None, None, None))
+    """KV pages [L, pages, kv_heads, page_size, D]: shard kv_heads on tp."""
+    s = NamedSharding(mesh, P(None, None, "tp", None, None))
     return s, s
 
 
 def init_cache(
     spec: ModelSpec, num_pages: int, page_size: int, dtype=None
 ) -> tuple[jax.Array, jax.Array]:
-    """K and V page arrays [L, kv_heads, num_pages, page_size, head_dim].
+    """K and V page arrays [L, num_pages, kv_heads, page_size, head_dim].
 
-    Head-major layout: a page DMA for one kv head slices only leading dims,
-    keeping the trailing (page_size, head_dim) tile contiguous — the layout
-    the Pallas decode kernel (and Mosaic's tiling rules) require. ``num_pages``
-    must already include the trash page (index 0).
+    PAGE-MAJOR layout: one page's KV for ALL heads is a single contiguous
+    [kv_heads, page_size, head_dim] block, so the decode kernels move a
+    page with ONE DMA descriptor. (The previous head-major layout made the
+    same slice a strided copy that expands to kv_heads descriptors — and
+    decode attention is DMA-descriptor-bound, not bandwidth-bound: see
+    ops/pallas/paged_attention_v3.py.) ``num_pages`` must already include
+    the trash page (index 0).
     """
     dtype = dtype or jnp.dtype(spec.dtype)
-    shape = (spec.num_layers, spec.num_kv_heads, num_pages, page_size, spec.head_dim)
+    shape = (spec.num_layers, num_pages, spec.num_kv_heads, page_size, spec.head_dim)
     return jnp.zeros(shape, dtype), jnp.zeros(shape, dtype)
 
 
@@ -236,11 +239,8 @@ def prefill_forward_impl(
     for li, lp in enumerate(params["layers"]):
         h = rms_norm(x, lp["attn_norm"], spec.rms_eps)
         q, k, v = _attn_qkv(spec, lp, h, positions)
-        # li (scalar) and safe_pg (vector) are advanced indices split by
-        # the ':' slice -> broadcast dim moves to the FRONT: update is
-        # [n_pg, KH, page, D]
-        k_pages = k_pages.at[li, :, safe_pg].set(to_tiles(k))
-        v_pages = v_pages.at[li, :, safe_pg].set(to_tiles(v))
+        k_pages = k_pages.at[li, safe_pg].set(to_tiles(k))
+        v_pages = v_pages.at[li, safe_pg].set(to_tiles(v))
         k_ctx = gather_pages(k_pages[li], block_table)  # [max_ctx, kvh, D]
         v_ctx = gather_pages(v_pages[li], block_table)
         attn = causal_attention(q, k_ctx, v_ctx, positions, kv_len)
@@ -301,8 +301,8 @@ def prefill_forward_ring_impl(
     for li, lp in enumerate(params["layers"]):
         h = rms_norm(x, lp["attn_norm"], spec.rms_eps)
         q, k, v = _attn_qkv(spec, lp, h, idx)
-        k_pages = k_pages.at[li, :, safe_pg].set(to_tiles(k))
-        v_pages = v_pages.at[li, :, safe_pg].set(to_tiles(v))
+        k_pages = k_pages.at[li, safe_pg].set(to_tiles(k))
+        v_pages = v_pages.at[li, safe_pg].set(to_tiles(v))
         attn = ring_attention(q, k, v, mesh=mesh)
         x = x + attn.reshape(T, spec.num_heads * spec.head_dim) @ lp["wo"]
         h = rms_norm(x, lp["mlp_norm"], spec.rms_eps)
@@ -458,18 +458,19 @@ decode_steps = jax.jit(
 
 
 def _extract_kv_pages_impl(k_pages, v_pages, page_ids):
-    """Gather whole pages for transfer: -> [L, kvh, n, page, D] x2."""
-    return k_pages[:, :, page_ids], v_pages[:, :, page_ids]
+    """Gather whole pages for transfer: -> [L, n, kvh, page, D] x2."""
+    return k_pages[:, page_ids], v_pages[:, page_ids]
 
 
 extract_kv_pages = jax.jit(_extract_kv_pages_impl)
 
 
 def _insert_kv_pages_impl(k_pages, v_pages, page_ids, k_blocks, v_blocks):
-    """Scatter transferred pages into the local pools (donated)."""
+    """Scatter transferred pages into the local pools (donated).
+    Blocks are page-major stacks [L, n, kvh, page, D]."""
     return (
-        k_pages.at[:, :, page_ids].set(k_blocks),
-        v_pages.at[:, :, page_ids].set(v_blocks),
+        k_pages.at[:, page_ids].set(k_blocks),
+        v_pages.at[:, page_ids].set(v_blocks),
     )
 
 

@@ -1,7 +1,8 @@
 """Attention ops over the paged KV cache (pure-JAX reference forms).
 
-The paged layout: per layer, K and V live in page arrays of shape
-``[num_pages, page_size, num_kv_heads, head_dim]``; a sequence's pages are
+The paged layout is PAGE-MAJOR: per layer, K and V live in page arrays of
+shape ``[num_pages, num_kv_heads, page_size, head_dim]`` (one page = one
+contiguous all-heads block = one DMA descriptor); a sequence's pages are
 listed in its row of ``block_tables [B, max_pages_per_seq]``. This is the
 TPU-first replacement for the reference's engine-internal (vLLM) paged
 attention + its block-copy CUDA kernel (lib/llm/src/kernels/block_copy.cu):
@@ -41,13 +42,13 @@ def repeat_kv(x: jax.Array, n_rep: int) -> jax.Array:
 
 
 def gather_pages(
-    pages: jax.Array,  # [kv_heads, num_pages, page_size, head_dim]
+    pages: jax.Array,  # [num_pages, kv_heads, page_size, head_dim]
     block_table: jax.Array,  # [max_pages_per_seq] int32
 ) -> jax.Array:
     """Materialize one sequence's KV as [max_ctx, kv_heads, head_dim]."""
-    toks = pages[:, block_table]  # [H, P, page, D]
-    H, P, page, D = toks.shape
-    return toks.reshape(H, P * page, D).swapaxes(0, 1)
+    toks = pages[block_table]  # [P, H, page, D]
+    P, H, page, D = toks.shape
+    return toks.transpose(0, 2, 1, 3).reshape(P * page, H, D)
 
 
 def causal_attention(
@@ -80,16 +81,16 @@ def causal_attention(
 
 def paged_decode_attention(
     q: jax.Array,  # [B, heads, D] (one new token per sequence)
-    k_pages: jax.Array,  # [kv_heads, num_pages, page_size, D]
-    v_pages: jax.Array,  # [kv_heads, num_pages, page_size, D]
+    k_pages: jax.Array,  # [num_pages, kv_heads, page_size, D]
+    v_pages: jax.Array,  # [num_pages, kv_heads, page_size, D]
     block_tables: jax.Array,  # [B, max_pages_per_seq]
     seq_lens: jax.Array,  # [B] context length INCLUDING the new token
 ) -> jax.Array:
     """Decode-step attention: each query attends to its full paged context.
 
     Pure-JAX reference: gathers [B, max_ctx, kv_heads, D] then masked
-    attention. The Pallas kernel (ops/pallas/paged_attention.py) computes
-    the same thing without materializing the gather.
+    attention. The Pallas kernel (ops/pallas/paged_attention_v3.py)
+    computes the same thing without materializing the gather.
     """
     B, H, D = q.shape
     page_size = k_pages.shape[2]
@@ -114,18 +115,6 @@ def paged_decode_attention(
     return out.astype(q.dtype)
 
 
-def _lib_pages_per_compute_block(block_tables: jax.Array) -> int:
-    """Page chunk per kernel grid step: enough pages that each DMA burst
-    amortizes its issue latency (measured on v5e: 8 pages/chunk is ~3.7x
-    faster than 1 page/chunk at page_size=16), but never more than a
-    sequence can hold."""
-    P = block_tables.shape[1]
-    ppcb = 8
-    while ppcb > 1 and P % ppcb:
-        ppcb //= 2
-    return ppcb
-
-
 def _decode_attention_tpu(
     q: jax.Array,
     k_pages: jax.Array,
@@ -133,31 +122,44 @@ def _decode_attention_tpu(
     block_tables: jax.Array,
     seq_lens: jax.Array,
 ) -> jax.Array:
-    """Real-TPU decode attention: JAX's shipped multi-page paged-attention
-    TPU kernel (jax.experimental.pallas.ops.tpu.paged_attention), which
-    prefetches ``pages_per_compute_block`` KV pages per grid step — larger
-    DMA bursts than our one-page-at-a-time kernel, so decode sits much
-    closer to the HBM roofline. Same layout contract as ours:
-    k_pages/v_pages [KH, num_pages, page, D], block_tables [B, P]."""
-    if (os.environ.get("DYNAMO_ATTN") or "").strip() == "v2":
-        from dynamo_tpu.ops.pallas.paged_attention_v2 import (
-            paged_decode_attention_v2,
+    """Real-TPU decode attention: our v3 kernel (deep-pipelined windowed
+    DMA + cross-program prefetch over the page-major pool — see
+    ops/pallas/paged_attention_v3.py); its windowing bounds VMEM for any
+    table size, so it is the only production path. ``DYNAMO_ATTN=lib``
+    selects JAX's library multi-page kernel for comparison runs — it
+    wants the old head-major layout, so the transpose is paid per call
+    (debug only). Layout contract everywhere else:
+    k_pages/v_pages [num_pages, KH, page, D], block_tables [B, P]."""
+    choice = (os.environ.get("DYNAMO_ATTN") or "").strip()
+    if choice == "lib":
+        from jax.experimental.pallas.ops.tpu.paged_attention import (
+            paged_attention,
         )
 
-        return paged_decode_attention_v2(
+        P = block_tables.shape[1]
+        ppcb = 8
+        while ppcb > 1 and P % ppcb:
+            ppcb //= 2
+        scale = 1.0 / jnp.sqrt(jnp.asarray(q.shape[-1], jnp.float32))
+        q = (q.astype(jnp.float32) * scale).astype(q.dtype)
+        return paged_attention(
+            q,
+            k_pages.transpose(1, 0, 2, 3),
+            v_pages.transpose(1, 0, 2, 3),
+            seq_lens,
+            block_tables,
+            pages_per_compute_block=ppcb,
+        )
+    from dynamo_tpu.ops.pallas.paged_attention_v3 import (
+        paged_decode_attention_v3,
+        v3_supported,
+    )
+
+    if choice == "v3" or v3_supported(k_pages, block_tables):
+        return paged_decode_attention_v3(
             q, k_pages, v_pages, block_tables, seq_lens
         )
-    from jax.experimental.pallas.ops.tpu.paged_attention import (
-        paged_attention,
-    )
-
-    # the library kernel applies no softmax scaling — pre-scale q
-    scale = 1.0 / jnp.sqrt(jnp.asarray(q.shape[-1], jnp.float32))
-    q = (q.astype(jnp.float32) * scale).astype(q.dtype)
-    return paged_attention(
-        q, k_pages, v_pages, seq_lens, block_tables,
-        pages_per_compute_block=_lib_pages_per_compute_block(block_tables),
-    )
+    return paged_decode_attention(q, k_pages, v_pages, block_tables, seq_lens)
 
 
 def paged_decode_attention_auto(
@@ -182,8 +184,8 @@ def paged_decode_attention_auto(
     if use_pallas():
         from jax.sharding import PartitionSpec as P
 
-        from dynamo_tpu.ops.pallas.paged_attention import (
-            paged_decode_attention_pallas,
+        from dynamo_tpu.ops.pallas.paged_attention_v3 import (
+            paged_decode_attention_v3,
         )
 
         on_tpu = jax.default_backend() == "tpu"
@@ -192,7 +194,7 @@ def paged_decode_attention_auto(
         else:
             # off-TPU (tests): our kernel in interpret mode
             kernel = functools.partial(
-                paged_decode_attention_pallas, interpret=True
+                paged_decode_attention_v3, interpret=True
             )
         if mesh is not None and mesh.shape.get("tp", 1) > 1:
             kernel = jax.shard_map(
@@ -200,8 +202,8 @@ def paged_decode_attention_auto(
                 mesh=mesh,
                 in_specs=(
                     P(None, "tp", None),  # q: heads sharded
-                    P("tp", None, None, None),  # k_pages: kv heads sharded
-                    P("tp", None, None, None),
+                    P(None, "tp", None, None),  # k_pages: kv heads sharded
+                    P(None, "tp", None, None),
                     P(None, None),  # block tables replicated
                     P(None),  # seq lens replicated
                 ),

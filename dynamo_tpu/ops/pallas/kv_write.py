@@ -7,9 +7,10 @@ step), dwarfing the actual bytes moved (128 KB). A direct row DMA is
 impossible (Mosaic requires HBM slices aligned to the (8, 128) tile; a
 single token row slices the sublane dim to 1), so this kernel does a
 pipelined read-modify-write at page granularity instead: for each batch
-row, DMA the whole destination page for ALL kv heads in one strided copy
-([KH, page, D], one issue), splice the new token row in VMEM, and DMA it
-back — double-buffered across grid steps so the next page loads while the
+row, DMA the whole destination page — in the page-major pool layout
+([num_pages, KH, page, D]) a page is ONE contiguous [KH, page, D] block,
+a single DMA descriptor — splice the new token row in VMEM, and DMA it
+back, double-buffered across grid steps so the next page loads while the
 current one is modified and stored.
 
 Decode writes one row per sequence; sequences never share their tail page
@@ -40,7 +41,7 @@ def _kv_write_kernel(
     # inputs
     k_new_ref,  # [1, KH, D] VMEM block (this program's row)
     v_new_ref,  # [1, KH, D] VMEM block
-    k_pages_in,  # [L, KH, P, page, D] ANY (aliased with k_out)
+    k_pages_in,  # [L, P, KH, page, D] ANY (aliased with k_out)
     v_pages_in,
     # outputs (ANY, aliased)
     k_out_ref,
@@ -61,13 +62,13 @@ def _kv_write_kernel(
     def in_copy(pages_ref, buf, ch, j, s):
         page = dst_page_ref[j]
         return pltpu.make_async_copy(
-            pages_ref.at[layer, :, page], buf.at[s], in_sems.at[ch, s]
+            pages_ref.at[layer, page], buf.at[s], in_sems.at[ch, s]
         )
 
     def out_copy(buf, out_ref, ch, j, s):
         page = dst_page_ref[j]
         return pltpu.make_async_copy(
-            buf.at[s], out_ref.at[layer, :, page], out_sems.at[ch, s]
+            buf.at[s], out_ref.at[layer, page], out_sems.at[ch, s]
         )
 
     @pl.when(i == 0)
@@ -115,7 +116,7 @@ def _kv_write_kernel(
 
 @functools.partial(jax.jit, static_argnames=("layer", "interpret"))
 def kv_write_pallas(
-    k_pages: jax.Array,  # [L, KH, P, page, D]
+    k_pages: jax.Array,  # [L, P, KH, page, D]
     v_pages: jax.Array,
     k_new: jax.Array,  # [N, KH, D]
     v_new: jax.Array,
@@ -178,7 +179,7 @@ def kv_write_pallas(
 
 
 def write_new_kv(
-    k_pages: jax.Array,  # [L, KH, P, page, D]
+    k_pages: jax.Array,  # [L, P, KH, page, D]
     v_pages: jax.Array,
     k_new: jax.Array,  # [N, KH, D]
     v_new: jax.Array,
@@ -206,25 +207,25 @@ def write_new_kv(
                 kernel,
                 mesh=mesh,
                 in_specs=(
-                    P(None, "tp", None, None, None),  # k_pages
-                    P(None, "tp", None, None, None),
+                    P(None, None, "tp", None, None),  # k_pages
+                    P(None, None, "tp", None, None),
                     P(None, "tp", None),  # k_new: heads sharded
                     P(None, "tp", None),
                     P(None),  # dst_page replicated
                     P(None),
                 ),
                 out_specs=(
-                    P(None, "tp", None, None, None),
-                    P(None, "tp", None, None, None),
+                    P(None, None, "tp", None, None),
+                    P(None, None, "tp", None, None),
                 ),
                 check_vma=False,
             )
         return kernel(k_pages, v_pages, k_new, v_new, dst_page, dst_off)
     return (
-        k_pages.at[layer, :, dst_page, dst_off].set(
+        k_pages.at[layer, dst_page, :, dst_off].set(
             k_new.astype(k_pages.dtype)
         ),
-        v_pages.at[layer, :, dst_page, dst_off].set(
+        v_pages.at[layer, dst_page, :, dst_off].set(
             v_new.astype(v_pages.dtype)
         ),
     )

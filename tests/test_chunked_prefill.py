@@ -118,6 +118,12 @@ async def test_chunked_prefill_cancel_mid_flight():
     ctx.stop_generating()
     items = await task
     assert items[-1]["finish_reason"] in ("cancelled", "stop", "length")
-    # all pages back (cache may retain sealed prefix pages; active = 0)
+    # all pages back (cache may retain sealed prefix pages; active = 0).
+    # The step THREAD may be a beat behind the client-visible stream end
+    # under load, so poll briefly instead of asserting instantaneously.
+    for _ in range(200):
+        if engine.allocator.active_pages == 0:
+            break
+        await asyncio.sleep(0.01)
     assert engine.allocator.active_pages == 0
     await engine.close()

@@ -108,16 +108,16 @@ def test_paged_decode_attention_ignores_other_pages():
     """A sequence's attention must only read its own pages."""
     kvh, d, ps = 2, 8, 4
     key = jax.random.PRNGKey(2)
-    k_pages = jax.random.normal(key, (kvh, 16, ps, d))
-    v_pages = jax.random.normal(jax.random.fold_in(key, 1), (kvh, 16, ps, d))
+    k_pages = jax.random.normal(key, (16, kvh, ps, d))
+    v_pages = jax.random.normal(jax.random.fold_in(key, 1), (16, kvh, ps, d))
     q = jax.random.normal(jax.random.fold_in(key, 2), (1, 4, d))
 
     bt = np.zeros((1, 4), np.int32)
     bt[0, 0] = 3
     out1 = paged_decode_attention(q, k_pages, v_pages, jnp.asarray(bt), jnp.asarray([3]))
     # trash other pages; result must not change
-    k2 = k_pages.at[:, 5].set(999.0)
-    v2 = v_pages.at[:, 5].set(999.0)
+    k2 = k_pages.at[5].set(999.0)
+    v2 = v_pages.at[5].set(999.0)
     out2 = paged_decode_attention(q, k2, v2, jnp.asarray(bt), jnp.asarray([3]))
     np.testing.assert_allclose(np.asarray(out1), np.asarray(out2))
 

@@ -12,8 +12,8 @@ from dynamo_tpu.ops.pallas.kv_write import kv_write_pallas, write_new_kv
 def _setup(L=2, KH=2, P=6, page=4, D=8, N=3, seed=0):
     key = jax.random.PRNGKey(seed)
     ks = jax.random.split(key, 4)
-    k_pages = jax.random.normal(ks[0], (L, KH, P, page, D), jnp.float32)
-    v_pages = jax.random.normal(ks[1], (L, KH, P, page, D), jnp.float32)
+    k_pages = jax.random.normal(ks[0], (L, P, KH, page, D), jnp.float32)
+    v_pages = jax.random.normal(ks[1], (L, P, KH, page, D), jnp.float32)
     k_new = jax.random.normal(ks[2], (N, KH, D), jnp.float32)
     v_new = jax.random.normal(ks[3], (N, KH, D), jnp.float32)
     dst_page = jnp.asarray([1, 3, 5][:N], jnp.int32)
@@ -23,8 +23,8 @@ def _setup(L=2, KH=2, P=6, page=4, D=8, N=3, seed=0):
 
 def _scatter_ref(k_pages, v_pages, k_new, v_new, dst_page, dst_off, layer):
     return (
-        k_pages.at[layer, :, dst_page, dst_off].set(k_new),
-        v_pages.at[layer, :, dst_page, dst_off].set(v_new),
+        k_pages.at[layer, dst_page, :, dst_off].set(k_new),
+        v_pages.at[layer, dst_page, :, dst_off].set(v_new),
     )
 
 
@@ -51,7 +51,7 @@ def test_trash_page_rows():
         k_pages, v_pages, k_new, v_new, dp, do, layer=0, interpret=True,
     )
     np.testing.assert_allclose(
-        np.asarray(got_k[:, :, 1:]), np.asarray(k_pages[:, :, 1:])
+        np.asarray(got_k[:, 1:]), np.asarray(k_pages[:, 1:])
     )
     np.testing.assert_allclose(np.asarray(got_k[1]), np.asarray(k_pages[1]))
 

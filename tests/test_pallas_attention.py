@@ -1,4 +1,9 @@
-"""Pallas paged decode attention vs the pure-JAX reference (interpret mode)."""
+"""Pallas paged decode attention (v3) vs the pure-JAX reference.
+
+The v3 kernel (ops/pallas/paged_attention_v3.py) runs in interpret mode
+off-TPU; the pure-JAX gather form (ops/attention.py) is the ground truth.
+Layout is page-major: k/v_pages [num_pages, KH, page, D].
+"""
 
 import numpy as np
 
@@ -6,7 +11,10 @@ import jax
 import jax.numpy as jnp
 
 from dynamo_tpu.ops.attention import paged_decode_attention
-from dynamo_tpu.ops.pallas.paged_attention import paged_decode_attention_pallas
+from dynamo_tpu.ops.pallas.paged_attention_v3 import (
+    paged_decode_attention_v3,
+    v3_supported,
+)
 
 
 def _setup(B=4, H=8, KH=4, D=128, page_size=16, pages_per_seq=4, seed=0,
@@ -15,10 +23,10 @@ def _setup(B=4, H=8, KH=4, D=128, page_size=16, pages_per_seq=4, seed=0,
     num_pages = 1 + B * pages_per_seq
     q = jnp.asarray(rng.standard_normal((B, H, D)), dtype)
     k_pages = jnp.asarray(
-        rng.standard_normal((KH, num_pages, page_size, D)), dtype
+        rng.standard_normal((num_pages, KH, page_size, D)), dtype
     )
     v_pages = jnp.asarray(
-        rng.standard_normal((KH, num_pages, page_size, D)), dtype
+        rng.standard_normal((num_pages, KH, page_size, D)), dtype
     )
     bt = np.zeros((B, pages_per_seq), np.int32)
     for i in range(B):
@@ -34,7 +42,7 @@ def _setup(B=4, H=8, KH=4, D=128, page_size=16, pages_per_seq=4, seed=0,
 def test_matches_reference_f32():
     q, k, v, bt, lens = _setup()
     ref = paged_decode_attention(q, k, v, bt, lens)
-    got = paged_decode_attention_pallas(q, k, v, bt, lens, interpret=True)
+    got = paged_decode_attention_v3(q, k, v, bt, lens, interpret=True)
     np.testing.assert_allclose(
         np.asarray(got), np.asarray(ref), rtol=2e-5, atol=2e-5
     )
@@ -43,7 +51,7 @@ def test_matches_reference_f32():
 def test_matches_reference_bf16():
     q, k, v, bt, lens = _setup(dtype=jnp.bfloat16, seed=3)
     ref = paged_decode_attention(q, k, v, bt, lens).astype(jnp.float32)
-    got = paged_decode_attention_pallas(
+    got = paged_decode_attention_v3(
         q, k, v, bt, lens, interpret=True
     ).astype(jnp.float32)
     np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
@@ -55,7 +63,7 @@ def test_short_and_full_seq_lens():
     for lens in ([1, 1, 1, 1], [64, 64, 64, 64], [1, 17, 33, 64]):
         lens = jnp.asarray(lens, jnp.int32)
         ref = paged_decode_attention(q, k, v, bt, lens)
-        got = paged_decode_attention_pallas(q, k, v, bt, lens, interpret=True)
+        got = paged_decode_attention_v3(q, k, v, bt, lens, interpret=True)
         np.testing.assert_allclose(
             np.asarray(got), np.asarray(ref), rtol=2e-5, atol=2e-5
         )
@@ -73,8 +81,8 @@ def test_shard_map_tp_dispatch(monkeypatch):
     mesh = make_mesh(tp=4, dp=2)
     ref = paged_decode_attention(q, k, v, bt, lens)
     qs = jax.device_put(q, NamedSharding(mesh, P(None, "tp", None)))
-    ks = jax.device_put(k, NamedSharding(mesh, P("tp", None, None, None)))
-    vs = jax.device_put(v, NamedSharding(mesh, P("tp", None, None, None)))
+    ks = jax.device_put(k, NamedSharding(mesh, P(None, "tp", None, None)))
+    vs = jax.device_put(v, NamedSharding(mesh, P(None, "tp", None, None)))
     got = paged_decode_attention_auto(qs, ks, vs, bt, lens, mesh=mesh)
     np.testing.assert_allclose(
         np.asarray(got), np.asarray(ref), rtol=2e-5, atol=2e-5
@@ -85,36 +93,39 @@ def test_gqa_group_mapping():
     # H != KH exercises the group reshape; make head contents distinct
     q, k, v, bt, lens = _setup(B=2, H=8, KH=2, pages_per_seq=2, seed=11)
     ref = paged_decode_attention(q, k, v, bt, lens)
-    got = paged_decode_attention_pallas(q, k, v, bt, lens, interpret=True)
+    got = paged_decode_attention_v3(q, k, v, bt, lens, interpret=True)
     np.testing.assert_allclose(
         np.asarray(got), np.asarray(ref), rtol=2e-5, atol=2e-5
     )
 
 
-def test_lib_pages_per_compute_block():
-    """The real-TPU dispatch picks a page chunk that divides the per-seq
-    page count (library kernel requires P % ppcb == 0)."""
-    import jax.numpy as jnp
-
-    from dynamo_tpu.ops.attention import _lib_pages_per_compute_block
-
-    for P, want in ((16, 8), (8, 8), (12, 4), (6, 2), (5, 1), (4, 4), (1, 1)):
-        bt = jnp.zeros((2, P), jnp.int32)
-        got = _lib_pages_per_compute_block(bt)
-        assert got == want, (P, got, want)
-        assert P % got == 0
-
-
-def test_v2_kernel_matches_reference_interpret():
-    """Experimental all-KV-heads kernel (ops/pallas/paged_attention_v2):
-    block-diagonal masking + online softmax must match the pure-JAX form."""
-    from dynamo_tpu.ops.pallas.paged_attention_v2 import (
-        paged_decode_attention_v2,
+def test_duplicate_trash_pages_in_table():
+    """Short sequences' tables are zero-padded: every program re-reads the
+    trash page; masking must keep those columns out of the softmax."""
+    q, k, v, bt, _ = _setup(seed=13)
+    bt = jnp.asarray(np.where(np.arange(bt.shape[1]) < 2, np.asarray(bt), 0))
+    lens = jnp.asarray([3, 17, 32, 9], jnp.int32)  # all within 2 pages
+    ref = paged_decode_attention(q, k, v, bt, lens)
+    got = paged_decode_attention_v3(q, k, v, bt, lens, interpret=True)
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(ref), rtol=2e-5, atol=2e-5
     )
 
-    q, k, v, bt, lens = _setup(B=3, H=8, KH=4, pages_per_seq=3, seed=9)
+
+def test_windowed_chunks_match_reference(monkeypatch):
+    """Tables larger than one VMEM window stream in chunks with online
+    softmax; a tiny forced window exercises the multi-chunk merge path
+    (including a partial last chunk: 5 pages at window 2)."""
+    import dynamo_tpu.ops.pallas.paged_attention_v3 as v3mod
+
+    q, k, v, bt, lens = _setup(B=3, H=8, KH=4, pages_per_seq=5, seed=17)
     ref = paged_decode_attention(q, k, v, bt, lens)
-    got = paged_decode_attention_v2(q, k, v, bt, lens, interpret=True)
+    # window of 2 pages -> 3 chunks (last partial)
+    monkeypatch.setattr(
+        v3mod, "_WINDOW_SLOT_BYTES", 2 * 4 * 16 * 128 * 4
+    )
+    got = v3mod.paged_decode_attention_v3(q, k, v, bt, lens, interpret=True)
+    assert v3mod._window_pages(4, 16, 128, 4, 5) == 2
     np.testing.assert_allclose(
         np.asarray(got), np.asarray(ref), rtol=2e-5, atol=2e-5
     )

@@ -115,10 +115,10 @@ def test_ring_prefill_matches_reference_forward():
         jnp.asarray(0, jnp.int32), k2, v2, jnp.asarray(13, jnp.int32),
     )
     np.testing.assert_allclose(
-        np.asarray(k_pages[:, :, 1:4]), np.asarray(k2[:, :, 1:4]), atol=1e-5
+        np.asarray(k_pages[:, 1:4]), np.asarray(k2[:, 1:4]), atol=1e-5
     )
     np.testing.assert_allclose(  # partial page: only its one valid row
-        np.asarray(k_pages[:, :, 4, :1]), np.asarray(k2[:, :, 4, :1]),
+        np.asarray(k_pages[:, 4, :, :1]), np.asarray(k2[:, 4, :, :1]),
         atol=1e-5,
     )
 
