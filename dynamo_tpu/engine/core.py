@@ -97,10 +97,19 @@ class InferenceEngine:
         metrics_publisher=None,
         transfer_source=None,
         kvbm=None,
+        spmd=None,
     ):
         self.spec = spec
         self.transfer_source = transfer_source
         self.kvbm = kvbm
+        # multi-host: SpmdLeader broadcasting every serving-path dispatch
+        # so follower processes replay the same SPMD programs
+        # (parallel/spmd.py). Pipelined decode chains tokens ON DEVICE
+        # between bursts, which followers could not replay from host
+        # descriptors — force it off.
+        self.spmd = spmd
+        if spmd is not None and config is not None:
+            config.pipeline_decode = False
         self.offload = None
         if kvbm is not None:
             from dynamo_tpu.kvbm.offload import OffloadEngine
@@ -224,6 +233,11 @@ class InferenceEngine:
                    "error": "empty token_ids"}
             return
         if request.get("embedding_request"):
+            if self.spmd is not None:
+                # embed_forward is not in the follower replay protocol
+                yield {"token_ids": [], "finish_reason": "error",
+                       "error": "embeddings unsupported on multi-host workers"}
+                return
             # standalone forward (no KV pages touched): safe to dispatch
             # off the step loop; JAX serializes device execution
             try:
@@ -734,6 +748,12 @@ class InferenceEngine:
             padded[:tail] = token_ids[start_pos:]
             block_table = np.zeros((cfg.max_pages_per_seq,), np.int32)
             block_table[: sp.num_pages] = sp.pages
+            if self.spmd is not None:
+                self.spmd.publish(
+                    "ring_prefill",
+                    {"num_tokens": tail},
+                    {"tokens": padded, "block_table": block_table},
+                )
             logits, self.k_pages, self.v_pages = llama.prefill_forward_ring(
                 self.spec,
                 self.params,
@@ -781,9 +801,18 @@ class InferenceEngine:
                 recs.append((slot_idx, waiting, slot, logits, token_ids, sp))
             n = len(recs)
             bucket = max(n, self.config.max_decode_slots)
-            stacked = jnp.stack(
-                [r[3] for r in recs] + [recs[0][3]] * (bucket - n)
-            )
+            if self.spmd is not None:
+                # multi-host: prefill logits are global (replicated) arrays;
+                # stacking them on device would be a collective program the
+                # followers don't replay. Pull the replicated copies to host
+                # and sample as a purely LOCAL program instead — legal for
+                # one process alone in multi-controller JAX.
+                rows = [np.asarray(r[3], np.float32) for r in recs]
+                stacked = np.stack(rows + [rows[0]] * (bucket - n))
+            else:
+                stacked = jnp.stack(
+                    [r[3] for r in recs] + [recs[0][3]] * (bucket - n)
+                )
             temps = np.zeros((bucket,), np.float32)
             topk = np.zeros((bucket,), np.int32)
             topp = np.ones((bucket,), np.float32)
@@ -876,6 +905,12 @@ class InferenceEngine:
         padded[: len(new_tokens)] = new_tokens
         block_table = np.zeros((cfg.max_pages_per_seq,), np.int32)
         block_table[: sp.num_pages] = sp.pages
+        if self.spmd is not None:
+            self.spmd.publish(
+                "prefill",
+                {"start": start, "num_tokens": len(new_tokens)},
+                {"tokens": padded, "block_table": block_table},
+            )
         logits, self.k_pages, self.v_pages = llama.prefill_forward(
             self.spec,
             self.params,
@@ -885,6 +920,7 @@ class InferenceEngine:
             self.k_pages,
             self.v_pages,
             jnp.asarray(len(new_tokens), jnp.int32),
+            mesh=self.mesh,
         )
         return logits
 
@@ -1194,6 +1230,22 @@ class InferenceEngine:
     def _dispatch_burst(self, batch: dict, chain: dict | None):
         """Issue the fused decode; feed tokens from the in-flight burst's
         device output when chaining (no host sync on the feed path)."""
+        if self.spmd is not None:
+            self.spmd.publish(
+                "decode",
+                {"n_steps": batch["n_burst"], "n_lp": batch["n_lp"]},
+                {
+                    "tokens": batch["tokens"],
+                    "block_tables": batch["block_tables"],
+                    "seq_lens": batch["seq_lens"],
+                    "active": batch["active"].astype(np.int8),
+                    "temps": batch["temps"],
+                    "topk": batch["topk"],
+                    "topp": batch["topp"],
+                    "seeds": batch["seeds"],
+                    "steps": batch["steps"],
+                },
+            )
         tokens_in = jnp.asarray(batch["tokens"])
         if chain is not None:
             prev_sampled = chain["results"][0]  # device [B, n_prev]

@@ -57,6 +57,7 @@ async def launch_engine_worker(
     always_remote_prefill: bool = False,
     kvbm_config=None,
     health=None,  # HealthCheckManager: canary-probe this worker's endpoint
+    spmd=None,  # SpmdLeader: multi-host dispatch broadcast (leader only)
 ) -> tuple[InferenceEngine, object]:
     """Build + register one engine worker in this process.
 
@@ -106,7 +107,7 @@ async def launch_engine_worker(
 
     engine = InferenceEngine(
         spec, cfg, mesh=mesh, params=params,
-        transfer_source=transfer_source, kvbm=kvbm,
+        transfer_source=transfer_source, kvbm=kvbm, spmd=spmd,
     )
 
     if mode == "prefill":
@@ -231,6 +232,25 @@ def _has_tokenizer_files(model_path: str) -> bool:
     )
 
 
+def _build_engine_shell(args: argparse.Namespace, ecfg: EngineConfig):
+    """Follower-side engine: identical spec/config/mesh/params to the
+    leader's (deterministic init), but its step loop never starts — the
+    SPMD replay drives the jitted entry points directly."""
+    mesh = None
+    if ecfg.tp > 1 or ecfg.dp > 1 or ecfg.sp > 1 or ecfg.ep > 1:
+        from dynamo_tpu.parallel.mesh import make_mesh
+
+        mesh = make_mesh(tp=ecfg.tp, dp=ecfg.dp, sp=ecfg.sp, ep=ecfg.ep)
+    params = None
+    if args.model_path:
+        from dynamo_tpu.models.loader import load_model_dir
+
+        spec, params = load_model_dir(args.model_path, mesh=mesh)
+    else:
+        spec = ModelSpec.preset(args.model)
+    return InferenceEngine(spec, ecfg, mesh=mesh, params=params)
+
+
 def _kvbm_config_from_args(args: argparse.Namespace):
     if args.kvbm_host_mb <= 0:
         return None
@@ -247,26 +267,6 @@ def _kvbm_config_from_args(args: argparse.Namespace):
 async def _amain(args: argparse.Namespace) -> None:
     from dynamo_tpu.parallel.multihost import initialize_multihost, is_leader
 
-    if initialize_multihost(
-        args.coordinator_address, args.num_processes, args.process_id
-    ):
-        if not is_leader():
-            # A follower must NOT register its own endpoint identity
-            # (SURVEY §7 hard part (d): one logical worker = many hosts,
-            # single leader identity) and cannot yet serve: the engine's
-            # dispatches originate on the leader, and multi-controller JAX
-            # requires every process to issue the same programs — the
-            # leader-driven mirror loop is the outstanding piece. Park so
-            # the process neither registers nor desynchronizes the slice.
-            import asyncio as _aio
-
-            print("MULTIHOST_FOLLOWER (parked: engine mirror loop is "
-                  "leader-driven serving's missing piece)", flush=True)
-            await _aio.Event().wait()
-    rcfg = RuntimeConfig.from_env()
-    if args.hub:
-        rcfg.hub_address = args.hub
-    drt = DistributedRuntime(await connect_hub(rcfg.hub_address), rcfg)
     ecfg = EngineConfig(
         page_size=args.page_size,
         num_pages=args.num_pages,
@@ -276,6 +276,53 @@ async def _amain(args: argparse.Namespace) -> None:
         sp=args.sp,
         ep=args.ep,
     )
+    spmd_leader = None
+    multihost = initialize_multihost(
+        args.coordinator_address, args.num_processes, args.process_id
+    )
+    if multihost:
+        if args.mode != "aggregated" or args.kvbm_host_mb > 0:
+            raise SystemExit(
+                "multi-host workers support aggregated mode without KVBM "
+                "(disagg export / tier offload are not in the follower "
+                "replay protocol yet)"
+            )
+        if ecfg.tp * ecfg.dp * ecfg.sp * ecfg.ep <= 1:
+            raise SystemExit(
+                "multi-host workers need mesh axes spanning the slice "
+                "(e.g. --tp 2); a 1-device mesh would leave the follower "
+                "hosts idle"
+            )
+        group = f"{args.namespace}/{args.component}/{args.endpoint}"
+        if not is_leader():
+            # Follower: one logical worker = many hosts with a single
+            # leader identity (SURVEY §7 hard part (d)). The follower
+            # holds identical device state and REPLAYS the leader's
+            # dispatch stream so the SPMD collectives line up — it never
+            # registers, serves, or samples (parallel/spmd.py).
+            from dynamo_tpu.parallel.spmd import SpmdFollower
+
+            rcfg = RuntimeConfig.from_env()
+            if args.hub:
+                rcfg.hub_address = args.hub
+            hub = await connect_hub(rcfg.hub_address)
+            engine = _build_engine_shell(args, ecfg)
+            print("MULTIHOST_FOLLOWER_READY", flush=True)
+            await SpmdFollower(hub, group, engine).run()
+            return
+    rcfg = RuntimeConfig.from_env()
+    if args.hub:
+        rcfg.hub_address = args.hub
+    drt = DistributedRuntime(await connect_hub(rcfg.hub_address), rcfg)
+    if multihost:
+        import asyncio as _aio
+
+        from dynamo_tpu.parallel.spmd import SpmdLeader
+
+        group = f"{args.namespace}/{args.component}/{args.endpoint}"
+        spmd_leader = SpmdLeader(
+            drt.hub, _aio.get_running_loop(), group
+        )
     health = None
     status_server = None
     if args.health_port >= 0:
@@ -318,6 +365,7 @@ async def _amain(args: argparse.Namespace) -> None:
         max_local_prefill_length=args.max_local_prefill_length,
         always_remote_prefill=args.always_remote_prefill,
         kvbm_config=_kvbm_config_from_args(args),
+        spmd=spmd_leader,
     )
     print("ENGINE_READY", flush=True)
     await drt.runtime.wait_for_shutdown()

@@ -200,9 +200,10 @@ def prefill_forward_impl(
     tokens: jax.Array,  # [T_pad] int32 (padded)
     block_table: jax.Array,  # [max_pages_per_seq] int32
     start_pos: jax.Array,  # scalar: cached-prefix length (tokens)
-    k_pages: jax.Array,  # [L, num_pages, page, kvh, D] (donated)
+    k_pages: jax.Array,  # [L, num_pages, kvh, page, D] (donated)
     v_pages: jax.Array,
     num_tokens: jax.Array,  # scalar: real token count in ``tokens``
+    mesh: Mesh | None = None,  # static: replicate logits across the mesh
 ) -> tuple[jax.Array, jax.Array, jax.Array]:
     """Process one prompt; writes KV pages; returns (last_logits, k, v).
 
@@ -251,11 +252,23 @@ def prefill_forward_impl(
 
     last = jnp.clip(num_tokens - 1, 0, T - 1)
     logits = _logits(spec, params, x[last])  # [V]
+    logits = _replicate(logits, mesh)
     return logits, k_pages, v_pages
 
 
+def _replicate(x: jax.Array, mesh: Mesh | None) -> jax.Array:
+    """Pin an output to fully-replicated across the mesh. Sampling runs on
+    the leader's host (multi-host) or outside the SPMD program, so every
+    process must hold an addressable full copy — without the constraint
+    GSPMD may leave e.g. tp-sharded logits that only exist shard-wise."""
+    if mesh is None:
+        return x
+    return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, P()))
+
+
 prefill_forward = jax.jit(
-    prefill_forward_impl, static_argnums=(0,), donate_argnums=(5, 6)
+    prefill_forward_impl, static_argnums=(0,), static_argnames=("mesh",),
+    donate_argnums=(5, 6),
 )
 
 
@@ -311,6 +324,7 @@ def prefill_forward_ring_impl(
 
     last = jnp.clip(num_tokens - 1, 0, T - 1)
     logits = _logits(spec, params, x[last])
+    logits = _replicate(logits, mesh)
     return logits, k_pages, v_pages
 
 
@@ -441,8 +455,10 @@ def decode_steps_impl(
         (tokens, seq_lens, k_pages, v_pages, out0, lp0, ti0, tv0),
         unroll=False,
     )
+    out = _replicate(out, mesh)
     if n_logprobs > 0:
-        return out, lp, ti, tv, k_pages, v_pages
+        return (out, _replicate(lp, mesh), _replicate(ti, mesh),
+                _replicate(tv, mesh), k_pages, v_pages)
     return out, k_pages, v_pages
 
 

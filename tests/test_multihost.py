@@ -1,0 +1,154 @@
+"""Real multi-process multi-host serving (SURVEY §7 hard part (d)).
+
+Two OS processes — leader + follower — join one jax.distributed runtime
+(CPU backend, 1 device each), build a tp=2 mesh SPANNING the processes,
+and serve a request through the real frontend. This fails if leader
+identity breaks (both register, or none), if mesh construction over the
+global device set breaks, or if the SPMD replay protocol
+(parallel/spmd.py) desynchronizes — the leader's first cross-process
+collective would hang and the request would time out.
+
+Ref: the reference's multinode engine bootstrap
+(components/backends/trtllm/multinode/, sglang --dist-init-addr).
+"""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+import time
+import urllib.request
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _env(extra=None):
+    env = {
+        **os.environ,
+        "PYTHONPATH": REPO,
+        "JAX_PLATFORMS": "cpu",
+        # one CPU device per process: the tp=2 mesh must span processes
+        "XLA_FLAGS": "--xla_force_host_platform_device_count=1",
+    }
+    env.update(extra or {})
+    return env
+
+
+def _spawn(args, ready_prefix, procs, timeout=120.0, env=None):
+    p = subprocess.Popen(
+        [sys.executable, *args], stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT, text=True, cwd=REPO, env=env or _env(),
+    )
+    procs.append(p)
+    deadline = time.time() + timeout
+    lines = []
+    while time.time() < deadline:
+        line = p.stdout.readline()
+        if not line:
+            raise RuntimeError(
+                f"{args}: exited rc={p.poll()} before {ready_prefix!r}\n"
+                + "".join(lines[-40:])
+            )
+        lines.append(line)
+        line = line.strip()
+        if line.startswith(ready_prefix):
+            return p, line.split("=", 1)[-1] if "=" in line else line
+    raise RuntimeError(f"{args}: timed out waiting for {ready_prefix!r}")
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def test_two_process_worker_serves_through_frontend():
+    procs: list[subprocess.Popen] = []
+    try:
+        _hub_p, hub_addr = _spawn(
+            ["-m", "dynamo_tpu.runtime.hub_server", "--port", "0"],
+            "DYNAMO_HUB=", procs,
+            env={**os.environ, "PYTHONPATH": REPO},
+        )
+        coord = f"127.0.0.1:{_free_port()}"
+        worker_args = [
+            "-m", "dynamo_tpu.engine.worker", "--hub", hub_addr,
+            "--model", "tiny-test", "--tp", "2",
+            "--page-size", "4", "--num-pages", "64",
+            "--max-pages-per-seq", "8", "--max-decode-slots", "2",
+            "--coordinator-address", coord, "--num-processes", "2",
+        ]
+        # follower first (its jax.distributed.initialize blocks until the
+        # leader connects; both must be alive before either proceeds)
+        follower = subprocess.Popen(
+            [sys.executable, *worker_args, "--process-id", "1"],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+            cwd=REPO, env=_env(),
+        )
+        procs.append(follower)
+        _leader_p, _ = _spawn(
+            [*worker_args, "--process-id", "0"], "ENGINE_READY", procs,
+        )
+
+        _frontend_p, http_addr = _spawn(
+            ["-m", "dynamo_tpu.frontend", "--hub", hub_addr,
+             "--host", "127.0.0.1", "--port", "0"],
+            "DYNAMO_HTTP=", procs,
+            env={**os.environ, "PYTHONPATH": REPO},
+        )
+        base = f"http://{http_addr}"
+
+        # model discovery
+        deadline = time.time() + 30
+        models = []
+        while time.time() < deadline and not models:
+            with urllib.request.urlopen(f"{base}/v1/models", timeout=5) as r:
+                models = json.load(r)["data"]
+            if not models:
+                time.sleep(0.2)
+        assert [m["id"] for m in models] == ["tiny-test"]
+
+        # a real completion through frontend -> leader -> 2-process SPMD
+        req = urllib.request.Request(
+            f"{base}/v1/completions",
+            data=json.dumps({
+                "model": "tiny-test", "prompt": "multi host hello",
+                "max_tokens": 4, "temperature": 0.0, "ignore_eos": True,
+            }).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req, timeout=90) as r:
+            assert r.status == 200
+            body = json.load(r)
+        assert body["usage"]["completion_tokens"] == 4
+        assert body["choices"][0]["text"]
+
+        # leader-only identity: exactly ONE instance registered
+        import asyncio
+
+        from dynamo_tpu.runtime.hub_client import RemoteHub
+
+        async def instances():
+            hub = await RemoteHub.connect(hub_addr)
+            try:
+                return await hub.get_prefix("v1/instances/")
+            finally:
+                await hub.close()
+
+        inst = asyncio.run(instances())
+        gen = [k for k in inst if "/generate/" in k]
+        # the leader also registers its admin endpoint; the GENERATE
+        # identity must be single (followers register nothing)
+        assert len(gen) == 1, f"expected 1 generate instance, got {list(inst)}"
+        assert follower.poll() is None  # follower alive, replaying
+    finally:
+        for p in procs:
+            p.terminate()
+        for p in procs:
+            try:
+                p.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                p.kill()
