@@ -64,6 +64,41 @@ _LOCAL_SOURCES: dict[str, "KvTransferSource"] = {}
 _LOCAL_LOCK = threading.Lock()
 
 
+def shard_layout(x) -> tuple[int, list[tuple[int, object]]] | None:
+    """(axis, [(start, shard_array), ...]) when ``x``'s addressable shards
+    tile exactly ONE axis (the TP pattern: KV blocks sharded over the
+    kv-head axis across this process's devices), sorted by start and
+    deduplicated (replication repeats an index on several devices).
+    None for anything else — callers fall back to host staging.
+    """
+    shards = getattr(x, "addressable_shards", None)
+    if not shards or not getattr(x, "is_fully_addressable", False):
+        return None
+    axis = None
+    seen: dict[int, object] = {}
+    for sh in shards:
+        nontrivial = [
+            d
+            for d, sl in enumerate(sh.index)
+            if not (
+                (sl.start in (0, None))
+                and (sl.stop is None or sl.stop == x.shape[d])
+            )
+        ]
+        if len(nontrivial) != 1:
+            return None  # replicated or multi-axis tiling
+        a = nontrivial[0]
+        if axis is None:
+            axis = a
+        elif axis != a:
+            return None
+        seen.setdefault(sh.index[a].start or 0, sh.data)
+    parts = sorted(seen.items())
+    if sum(p.shape[axis] for _s, p in parts) != x.shape[axis]:
+        return None
+    return axis, parts
+
+
 class KvTransferSource:
     """Export table + TCP server on the prefill side.
 
@@ -156,8 +191,7 @@ class KvTransferSource:
 
     @staticmethod
     def _device_exportable(x) -> bool:
-        """Device path wants an unsharded jax array (per-shard transfer of
-        TP-sharded pools goes through host staging for now)."""
+        """Single-device jax array: the simple one-pull device path."""
         sharding = getattr(x, "sharding", None)
         return sharding is not None and len(sharding.device_set) == 1
 
@@ -184,24 +218,54 @@ class KvTransferSource:
             "page_size": page_size,
         }
         meta = {"num_tokens": num_tokens, "page_size": page_size}
-        if self._txs is not None and self._device_exportable(k_blocks):
+        if self._txs is not None:
             # the PJRT registration (await_pull) happens lazily when the
             # puller asks ("stage_device" control op): a registration has
             # no cancel API, so registering here would pin the device KV
             # forever for transfers that get released/expired instead of
             # pulled
-            with self._lock:
-                self._exports[tid] = _Export(
-                    k=k_blocks, v=v_blocks, meta=meta, on_done=on_done
+            dev_params = None
+            if self._device_exportable(k_blocks):
+                dev_params = {}
+            else:
+                lay_k = shard_layout(k_blocks)
+                lay_v = shard_layout(v_blocks)
+                if (
+                    lay_k is not None
+                    and lay_v is not None
+                    and lay_k[0] == lay_v[0]
+                    and len(lay_k[1]) == len(lay_v[1])
+                ):
+                    # TP-sharded pool: export PER SHARD — each process-local
+                    # device shard registers as its own pullable entry, and
+                    # the decode side lands each shard straight on its own
+                    # mesh device (ref: NIXL moves TP-sharded blocks rank-
+                    # by-rank, block_manager/block/transfer/nixl.rs)
+                    dev_params = {
+                        "shard_axis": lay_k[0],
+                        "shards": [
+                            {
+                                "start": s,
+                                "k_shape": list(kp.shape),
+                                "v_shape": list(vp.shape),
+                            }
+                            for (s, kp), (_sv, vp) in zip(lay_k[1], lay_v[1])
+                        ],
+                    }
+            if dev_params is not None:
+                with self._lock:
+                    self._exports[tid] = _Export(
+                        k=k_blocks, v=v_blocks, meta=meta, on_done=on_done
+                    )
+                params.update(
+                    device_addr=self.device_addr,
+                    uuid_int=int(tid[:15], 16),
+                    k_shape=list(k_blocks.shape),
+                    v_shape=list(v_blocks.shape),
+                    dtype=np.dtype(k_blocks.dtype).name,
+                    **dev_params,
                 )
-            params.update(
-                device_addr=self.device_addr,
-                uuid_int=int(tid[:15], 16),
-                k_shape=list(k_blocks.shape),
-                v_shape=list(v_blocks.shape),
-                dtype=np.dtype(k_blocks.dtype).name,
-            )
-            return params
+                return params
         k_blocks = np.asarray(k_blocks)
         v_blocks = np.asarray(v_blocks)
         with self._lock:
@@ -240,21 +304,33 @@ class KvTransferSource:
                 # leaks until process end — a narrow window, logged by GC.
                 with self._lock:
                     e = self._exports.get(tid)
-                ok = (
-                    e is not None
-                    and self._txs is not None
-                    and self._device_exportable(e.k)
-                )
-                if ok:
-                    self._txs.await_pull(int(req["uuid_int"]), [e.k, e.v])
+                uuid_int = int(req["uuid_int"])
+                if e is None or self._txs is None:
+                    writer.write(
+                        b'{"ok": false, "error": "not device-stageable"}\n'
+                    )
+                elif self._device_exportable(e.k):
+                    self._txs.await_pull(uuid_int, [e.k, e.v])
                     with self._lock:
                         if tid in self._exports:
                             self._exports[tid].meta["device_staged"] = True
                     writer.write(b'{"ok": true}\n')
                 else:
-                    writer.write(
-                        b'{"ok": false, "error": "not device-stageable"}\n'
-                    )
+                    lay_k, lay_v = shard_layout(e.k), shard_layout(e.v)
+                    if lay_k is None or lay_v is None:
+                        writer.write(
+                            b'{"ok": false, "error": "not device-stageable"}\n'
+                        )
+                    else:
+                        # one registration per TP shard pair, uuid offset i+1
+                        for i, ((_sk, kp), (_sv, vp)) in enumerate(
+                            zip(lay_k[1], lay_v[1])
+                        ):
+                            self._txs.await_pull(uuid_int + 1 + i, [kp, vp])
+                        with self._lock:
+                            if tid in self._exports:
+                                self._exports[tid].meta["device_staged"] = True
+                        writer.write(b'{"ok": true}\n')
                 await writer.drain()
                 return
             if op != "pull":
@@ -330,11 +406,58 @@ def _tcp_request(addr: str, obj: dict, timeout: float = 10.0) -> dict:
         return json.loads(f.readline())
 
 
-def _pull_device(params: dict) -> tuple[object, object, dict]:
-    """Device-to-device pull over the PJRT transfer plane."""
+def _dest_tp_devices(mesh, n_shards: int) -> list | None:
+    """Destination devices for per-shard pulls: the mesh's "tp" axis order.
+    None when the mesh can't absorb the shards directly (different tp
+    width, or other mesh axes >1 — replication would need extra copies
+    the per-shard path doesn't do yet)."""
+    if mesh is None or "tp" not in mesh.axis_names:
+        return None
+    if mesh.shape["tp"] != n_shards:
+        return None
+    if any(v > 1 for a, v in mesh.shape.items() if a != "tp"):
+        return None
+    tp_i = list(mesh.axis_names).index("tp")
+    arr = np.asarray(mesh.devices)
+    return list(np.moveaxis(arr, tp_i, -1).reshape(-1))
+
+
+def _device_conn(addr: str):
     import jax
     from jax.experimental import transfer as jtx
-    from jax.sharding import SingleDeviceSharding
+
+    with _DEVICE_CONNS_LOCK:
+        conn = _DEVICE_CONNS.get(addr)
+        if conn is None:
+            server = jtx.start_transfer_server(jax.devices()[0].client)
+            conn = server.connect(addr)
+            _DEVICE_CONNS[addr] = conn
+            # keep the local server alive with its connection
+            _DEVICE_CONNS[addr + "#server"] = server
+    return conn
+
+
+def _pull_device(params: dict, mesh=None) -> tuple[object, object, dict]:
+    """Device-to-device pull over the PJRT transfer plane.
+
+    Single-source-device exports land on the puller's device 0. TP-sharded
+    exports ("shards" in params) pull PER SHARD, each landing directly on
+    the corresponding device of the puller's mesh tp axis, then assemble
+    into one global array with the destination sharding — no host staging
+    anywhere (ref NIXL's rank-wise block transfer, nixl.rs).
+    """
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec, SingleDeviceSharding
+
+    shards = params.get("shards")
+    dt = _dtype_from_name(params["dtype"])
+    if shards:
+        dest = _dest_tp_devices(mesh, len(shards))
+        if dest is None:
+            raise RuntimeError(
+                f"no tp destination for {len(shards)}-shard pull "
+                f"(mesh={getattr(mesh, 'shape', None)})"
+            )
 
     # ask the source to register the arrays with its PJRT server now
     staged = _tcp_request(
@@ -345,39 +468,62 @@ def _pull_device(params: dict) -> tuple[object, object, dict]:
     if not staged.get("ok"):
         raise RuntimeError(f"device stage refused: {staged.get('error')}")
 
-    addr = params["device_addr"]
-    with _DEVICE_CONNS_LOCK:
-        conn = _DEVICE_CONNS.get(addr)
-        if conn is None:
-            server = jtx.start_transfer_server(jax.devices()[0].client)
-            conn = server.connect(addr)
-            _DEVICE_CONNS[addr] = conn
-            # keep the local server alive with its connection
-            _DEVICE_CONNS[addr + "#server"] = server
-    sh = SingleDeviceSharding(jax.devices()[0])
-    dt = _dtype_from_name(params["dtype"])
-    k, v = conn.pull(
-        params["uuid_int"],
-        [
-            jax.ShapeDtypeStruct(tuple(params["k_shape"]), dt, sharding=sh),
-            jax.ShapeDtypeStruct(tuple(params["v_shape"]), dt, sharding=sh),
-        ],
-    )
-    jax.block_until_ready((k, v))
+    conn = _device_conn(params["device_addr"])
     meta = {
         k_: params[k_] for k_ in ("num_tokens", "page_size") if k_ in params
     }
-    # the payload has landed: let the source drop its reference
+    if not shards:
+        sh = SingleDeviceSharding(jax.devices()[0])
+        k, v = conn.pull(
+            params["uuid_int"],
+            [
+                jax.ShapeDtypeStruct(tuple(params["k_shape"]), dt, sharding=sh),
+                jax.ShapeDtypeStruct(tuple(params["v_shape"]), dt, sharding=sh),
+            ],
+        )
+        jax.block_until_ready((k, v))
+        release_kv_blocks(params)
+        return k, v, meta
+
+    axis = int(params["shard_axis"])
+    k_parts, v_parts = [], []
+    for i, spec_i in enumerate(shards):
+        sh = SingleDeviceSharding(dest[i])
+        kp, vp = conn.pull(
+            params["uuid_int"] + 1 + i,
+            [
+                jax.ShapeDtypeStruct(tuple(spec_i["k_shape"]), dt, sharding=sh),
+                jax.ShapeDtypeStruct(tuple(spec_i["v_shape"]), dt, sharding=sh),
+            ],
+        )
+        k_parts.append(kp)
+        v_parts.append(vp)
+    jax.block_until_ready((k_parts, v_parts))
+    ndim = len(params["k_shape"])
+    pspec = PartitionSpec(*(
+        "tp" if d == axis else None for d in range(ndim)
+    ))
+    sharding = NamedSharding(mesh, pspec)
+    k = jax.make_array_from_single_device_arrays(
+        tuple(params["k_shape"]), sharding, k_parts
+    )
+    v = jax.make_array_from_single_device_arrays(
+        tuple(params["v_shape"]), sharding, v_parts
+    )
     release_kv_blocks(params)
     return k, v, meta
 
 
-def pull_kv_blocks(params: dict, timeout: float = 30.0) -> tuple[np.ndarray, np.ndarray, dict]:
+def pull_kv_blocks(
+    params: dict, timeout: float = 30.0, mesh=None
+) -> tuple[np.ndarray, np.ndarray, dict]:
     """Pull exported KV blocks. Blocking — call from a worker thread.
 
     Returns (k_blocks, v_blocks, meta) — jax arrays on the device path,
     numpy otherwise. In-process sources are zero-copy; cross-process
-    prefers device-to-device (PJRT transfer), then TCP host staging.
+    prefers device-to-device (PJRT transfer; ``mesh`` is the puller's
+    mesh, needed to land TP-sharded exports shard-by-shard), then TCP
+    host staging.
     """
     tid = params["transfer_id"]
     src = _LOCAL_SOURCES.get(params.get("source_uid", ""))
@@ -391,7 +537,7 @@ def pull_kv_blocks(params: dict, timeout: float = 30.0) -> tuple[np.ndarray, np.
 
     if params.get("device_addr"):
         try:
-            return _pull_device(params)
+            return _pull_device(params, mesh=mesh)
         except Exception:  # noqa: BLE001
             log.warning(
                 "device KV pull failed; falling back to host staging",

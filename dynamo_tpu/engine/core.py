@@ -277,7 +277,7 @@ class InferenceEngine:
                 return
             try:
                 disagg["_staged_kv"] = await asyncio.to_thread(
-                    pull_kv_blocks, kvp
+                    lambda: pull_kv_blocks(kvp, mesh=self.mesh)
                 )
             except Exception as e:  # noqa: BLE001
                 yield {"token_ids": [], "finish_reason": "error",
@@ -1019,7 +1019,7 @@ class InferenceEngine:
         else:
             # direct callers (tests, bypassing generate): blocking pull on
             # this admission thread
-            k_blocks, v_blocks, meta = pull_kv_blocks(kvp)
+            k_blocks, v_blocks, meta = pull_kv_blocks(kvp, mesh=self.mesh)
         if int(meta.get("page_size", cfg.page_size)) != cfg.page_size:
             raise ValueError("page_size mismatch between prefill and decode")
 
