@@ -139,6 +139,7 @@ class EngineConfig:
     dp: int = 1
     sp: int = 1  # sequence/context parallel (ring-attention prefill)
     ep: int = 1  # expert parallel (MoE)
+    pp: int = 1  # pipeline parallel (layer stages; parallel/pipeline.py)
     # sampling
     seed: int = 0
     # scheduler

@@ -2,6 +2,7 @@
 
 Axes convention (used by all shardings in models/ and engine/):
   dp - data parallel (engine-level replica within one worker)
+  pp - pipeline parallel (layer stages; parallel/pipeline.py)
   tp - tensor parallel (attention heads / MLP columns)
   ep - expert parallel (MoE experts; aliases tp devices unless distinct)
   sp - sequence/context parallel (ring attention)
@@ -23,14 +24,17 @@ def make_mesh(
     dp: int = 1,
     sp: int = 1,
     ep: int = 1,
+    pp: int = 1,
     devices: list | None = None,
 ) -> Mesh:
     devices = devices if devices is not None else jax.devices()
-    need = tp * dp * sp * ep
+    need = tp * dp * sp * ep * pp
     if need > len(devices):
         raise ValueError(
-            f"mesh needs {need} devices (dp={dp} sp={sp} ep={ep} tp={tp}), "
-            f"have {len(devices)}"
+            f"mesh needs {need} devices (dp={dp} pp={pp} sp={sp} ep={ep} "
+            f"tp={tp}), have {len(devices)}"
         )
-    arr = np.array(devices[:need]).reshape(dp, sp, ep, tp)
-    return Mesh(arr, ("dp", "sp", "ep", "tp"))
+    # pp outermost after dp: stage boundaries land on the coarsest
+    # interconnect hops; tp innermost rides the fastest ICI links
+    arr = np.array(devices[:need]).reshape(dp, pp, sp, ep, tp)
+    return Mesh(arr, ("dp", "pp", "sp", "ep", "tp"))

@@ -1,0 +1,134 @@
+"""Pipeline parallelism (parallel/pipeline.py): pp-staged prefill/decode
+must match the single-device reference bit-for-close on an 8-device CPU
+mesh, composed with dp and tp (dryun exercises the same factorization)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from dynamo_tpu.engine.config import ModelSpec
+from dynamo_tpu.models import llama
+from dynamo_tpu.parallel.mesh import make_mesh
+from dynamo_tpu.parallel.pipeline import (
+    pp_cache_shardings,
+    pp_decode_step,
+    pp_param_shardings,
+    pp_prefill,
+    stack_params,
+)
+
+SPEC = ModelSpec(
+    name="pp-test", vocab_size=96, hidden_size=32, intermediate_size=64,
+    num_layers=4, num_heads=4, num_kv_heads=2, head_dim=8, dtype="float32",
+    tie_embeddings=False,
+)
+PAGE = 4
+
+
+def _pp_setup(mesh, num_pages):
+    params = llama.init_params(SPEC, jax.random.PRNGKey(0))
+    stacked = stack_params(SPEC, params)
+    shardings = pp_param_shardings(SPEC, mesh)
+    pp_params = jax.tree.map(
+        lambda p, s: jax.device_put(p, s), stacked, shardings
+    )
+    k_pages, v_pages = llama.init_cache(SPEC, num_pages, PAGE)
+    ks, vs = pp_cache_shardings(mesh)
+    return params, pp_params, jax.device_put(k_pages, ks), jax.device_put(
+        v_pages, vs
+    )
+
+
+def test_pp_prefill_matches_reference():
+    mesh = make_mesh(pp=2, tp=2, dp=2)
+    params, pp_params, k_pages, v_pages = _pp_setup(mesh, 16)
+    T = 16
+    tokens = jnp.asarray(np.arange(T) % SPEC.vocab_size, jnp.int32)
+    bt = jnp.asarray([1, 2, 3, 4, 0, 0, 0, 0], jnp.int32)
+
+    logits, k_pages, v_pages = pp_prefill(
+        SPEC, pp_params, tokens, bt, k_pages, v_pages,
+        jnp.asarray(T, jnp.int32), mesh=mesh,
+    )
+    ref = llama.reference_forward(SPEC, params, tokens)
+    np.testing.assert_allclose(
+        np.asarray(logits), np.asarray(ref[-1]), atol=2e-4, rtol=1e-4
+    )
+
+    # KV pages written by the pipeline == the plain paged path's
+    k2, v2 = llama.init_cache(SPEC, 16, PAGE)
+    _, k2, v2 = llama.prefill_forward(
+        SPEC, params, tokens, bt, jnp.asarray(0, jnp.int32), k2, v2,
+        jnp.asarray(T, jnp.int32),
+    )
+    np.testing.assert_allclose(
+        np.asarray(k_pages[:, 1:5]), np.asarray(k2[:, 1:5]), atol=1e-5
+    )
+    np.testing.assert_allclose(
+        np.asarray(v_pages[:, 1:5]), np.asarray(v2[:, 1:5]), atol=1e-5
+    )
+
+
+def test_pp_decode_step_matches_single_device():
+    """dp=2 x pp=2 x tp=2: one decode step over 8 slots must reproduce
+    single-device decode_forward logits AND cache writes."""
+    mesh = make_mesh(pp=2, tp=2, dp=2)
+    B, pps = 8, 2
+    num_pages = 1 + B * pps
+    params, pp_params, k_pages, v_pages = _pp_setup(mesh, num_pages)
+
+    rng = np.random.default_rng(0)
+    bt = np.zeros((B, pps), np.int32)
+    for i in range(B):
+        bt[i] = np.arange(1 + i * pps, 1 + (i + 1) * pps)
+    tokens = jnp.asarray(rng.integers(3, SPEC.vocab_size, B), jnp.int32)
+    seq_lens = jnp.asarray(rng.integers(2, PAGE * pps, B), jnp.int32)
+    active = jnp.ones((B,), bool)
+
+    # seed both caches with identical random context
+    k_init = rng.standard_normal(
+        (SPEC.num_layers, num_pages, SPEC.num_kv_heads, PAGE, SPEC.head_dim)
+    ).astype(np.float32)
+    v_init = rng.standard_normal(k_init.shape).astype(np.float32)
+    ks, vs = pp_cache_shardings(mesh)
+    k_pages = jax.device_put(jnp.asarray(k_init), ks)
+    v_pages = jax.device_put(jnp.asarray(v_init), vs)
+
+    logits, k_pages, v_pages = pp_decode_step(
+        SPEC, pp_params, tokens, jnp.asarray(bt), seq_lens,
+        k_pages, v_pages, active, mesh=mesh,
+    )
+
+    k1, v1 = jnp.asarray(k_init), jnp.asarray(v_init)
+    want, k1, v1 = llama.decode_forward(
+        SPEC, params, tokens, jnp.asarray(bt), seq_lens, k1, v1, active
+    )
+    np.testing.assert_allclose(
+        np.asarray(logits), np.asarray(want), atol=3e-4, rtol=1e-3
+    )
+    np.testing.assert_allclose(
+        np.asarray(k_pages[:, 1:]), np.asarray(k1[:, 1:]), atol=1e-5
+    )
+    np.testing.assert_allclose(
+        np.asarray(v_pages[:, 1:]), np.asarray(v1[:, 1:]), atol=1e-5
+    )
+
+
+def test_pp_requires_divisible_batch():
+    mesh = make_mesh(pp=2, tp=2, dp=2)
+    params, pp_params, k_pages, v_pages = _pp_setup(mesh, 8)
+    with pytest.raises(ValueError, match="must divide pp"):
+        pp_decode_step(
+            SPEC, pp_params, jnp.zeros((3,), jnp.int32),
+            jnp.zeros((3, 2), jnp.int32), jnp.ones((3,), jnp.int32),
+            k_pages, v_pages, jnp.ones((3,), bool), mesh=mesh,
+        )
+
+
+def test_stack_params_rejects_moe():
+    moe = ModelSpec.tiny_moe()
+    params = llama.init_params(moe, jax.random.PRNGKey(0))
+    with pytest.raises(NotImplementedError, match="dense layers only"):
+        stack_params(moe, params)
