@@ -64,11 +64,19 @@ def spec_from_hf_config(cfg: dict, name: str | None = None) -> ModelSpec:
     heads = int(cfg["num_attention_heads"])
     hidden = int(cfg["hidden_size"])
     moe = {}
-    if model_type == "mixtral" or cfg.get("num_local_experts"):
+    n_experts = int(
+        cfg.get("num_local_experts") or cfg.get("num_experts") or 0
+    )
+    if model_type in ("mixtral", "qwen2_moe", "qwen3_moe", "gpt_oss") or n_experts:
         moe = dict(
-            num_experts=int(cfg.get("num_local_experts", 0)),
-            num_experts_per_token=int(cfg.get("num_experts_per_tok", 2)),
-            moe_intermediate_size=int(cfg["intermediate_size"]),
+            num_experts=n_experts,
+            num_experts_per_token=int(
+                cfg.get("num_experts_per_tok")
+                or cfg.get("experts_per_token") or 2
+            ),
+            moe_intermediate_size=int(
+                cfg.get("moe_intermediate_size") or cfg["intermediate_size"]
+            ),
         )
     return ModelSpec(
         name=name or cfg.get("_name_or_path") or model_type,
@@ -112,14 +120,46 @@ def hf_config_from_spec(spec: ModelSpec) -> dict:
 # ------------------------------------------------------------------- name map
 
 
-def _dest_map(spec: ModelSpec) -> dict[str, tuple[tuple, bool, str | None]]:
-    """HF tensor name -> ((pytree path), transpose, dtype-override)."""
+def _moe_scheme(names: set[str] | None) -> str:
+    """Which MoE tensor-naming convention a checkpoint uses.
+
+    mixtral:  model.layers.N.block_sparse_moe.gate.weight + experts.E.w{1,2,3}
+    qwen_moe: model.layers.N.mlp.gate.weight + experts.E.{gate,up,down}_proj
+    gpt_oss:  model.layers.N.mlp.router.weight + FUSED 3D
+              experts.gate_up_proj [E, d, 2f] (gate/up interleaved on the
+              last axis) and experts.down_proj [E, f, d]
+    """
+    if not names:
+        return "mixtral"
+    for n in names:
+        if ".block_sparse_moe." in n:
+            return "mixtral"
+        if ".mlp.experts.gate_up_proj" in n:
+            return "gpt_oss"
+        if ".mlp.experts.0." in n:
+            return "qwen_moe"
+    return "mixtral"
+
+
+def _dest_map(
+    spec: ModelSpec, names: set[str] | None = None
+) -> dict[str, tuple[tuple, bool, str | None]]:
+    """HF tensor name -> ((pytree path), transpose, dtype-override).
+
+    ``names`` (the checkpoint's tensor set) selects the MoE naming scheme;
+    gpt-oss fused expert tensors are handled separately in load_params
+    (they split, which this map cannot express). gpt-oss architectural
+    extras — attention sinks, per-layer sliding windows, projection
+    biases, clamped swiglu — are NOT modeled; those tensors are skipped
+    with a warning and the load is an approximation for such checkpoints.
+    """
     m: dict[str, tuple[tuple, bool, str | None]] = {
         "model.embed_tokens.weight": (("embed",), False, None),
         "model.norm.weight": (("final_norm",), False, None),
     }
     if not spec.tie_embeddings:
         m["lm_head.weight"] = (("lm_head",), True, None)
+    scheme = _moe_scheme(names) if spec.num_experts else None
     for i in range(spec.num_layers):
         p = f"model.layers.{i}."
         li = ("layers", i)
@@ -129,13 +169,24 @@ def _dest_map(spec: ModelSpec) -> dict[str, tuple[tuple, bool, str | None]]:
                          ("v_proj", "wv"), ("o_proj", "wo")):
             m[p + f"self_attn.{hf}.weight"] = (li + (ours,), True, None)
         if spec.num_experts:
-            mp = p + "block_sparse_moe."
-            m[mp + "gate.weight"] = (li + ("moe", "router"), True, "float32")
-            for e in range(spec.num_experts):
-                ep = mp + f"experts.{e}."
-                m[ep + "w1.weight"] = (li + ("moe", "w_gate", e), True, None)
-                m[ep + "w3.weight"] = (li + ("moe", "w_up", e), True, None)
-                m[ep + "w2.weight"] = (li + ("moe", "w_down", e), True, None)
+            if scheme == "mixtral":
+                mp = p + "block_sparse_moe."
+                m[mp + "gate.weight"] = (li + ("moe", "router"), True, "float32")
+                for e in range(spec.num_experts):
+                    ep = mp + f"experts.{e}."
+                    m[ep + "w1.weight"] = (li + ("moe", "w_gate", e), True, None)
+                    m[ep + "w3.weight"] = (li + ("moe", "w_up", e), True, None)
+                    m[ep + "w2.weight"] = (li + ("moe", "w_down", e), True, None)
+            elif scheme == "qwen_moe":
+                mp = p + "mlp."
+                m[mp + "gate.weight"] = (li + ("moe", "router"), True, "float32")
+                for e in range(spec.num_experts):
+                    ep = mp + f"experts.{e}."
+                    m[ep + "gate_proj.weight"] = (li + ("moe", "w_gate", e), True, None)
+                    m[ep + "up_proj.weight"] = (li + ("moe", "w_up", e), True, None)
+                    m[ep + "down_proj.weight"] = (li + ("moe", "w_down", e), True, None)
+            else:  # gpt_oss: router here; fused experts in load_params
+                m[p + "mlp.router.weight"] = (li + ("moe", "router"), True, "float32")
         else:
             for hf, ours in (("gate_proj", "w_gate"), ("up_proj", "w_up"),
                              ("down_proj", "w_down")):
@@ -182,7 +233,6 @@ def load_params(
     from safetensors import safe_open
 
     dtype = dtype or spec.dtype
-    dest = _dest_map(spec)
     files = sorted(
         os.path.join(model_dir, f)
         for f in os.listdir(model_dir)
@@ -190,6 +240,12 @@ def load_params(
     )
     if not files:
         raise FileNotFoundError(f"no .safetensors files in {model_dir}")
+    all_names: set[str] = set()
+    for path_file in files:
+        with safe_open(path_file, framework="numpy") as f:
+            all_names.update(f.keys())
+    dest = _dest_map(spec, all_names)
+    fused_gpt_oss = spec.num_experts and _moe_scheme(all_names) == "gpt_oss"
 
     params: Params = {}
     seen: set[str] = set()
@@ -208,10 +264,26 @@ def load_params(
             x = jax.device_put(x, _tree_get(shardings, path))
         _tree_set(params, path, x)
 
+    skipped_extras: list[str] = []
     for path_file in files:
         with safe_open(path_file, framework="numpy") as f:
             for name in f.keys():
                 if name not in dest:
+                    if fused_gpt_oss and name.endswith(
+                        (".mlp.experts.gate_up_proj", ".mlp.experts.down_proj")
+                    ):
+                        # fused 3D expert tensors, already [in, out] per
+                        # expert; gate/up interleave on the last axis
+                        li = ("layers", int(name.split(".")[2]), "moe")
+                        arr = f.get_tensor(name)
+                        if name.endswith("gate_up_proj"):
+                            place(li + ("w_gate",), arr[..., 0::2], dtype)
+                            place(li + ("w_up",), arr[..., 1::2], dtype)
+                        else:
+                            place(li + ("w_down",), arr, dtype)
+                        seen.add(name)
+                    elif name.endswith(("_bias", ".bias", ".sinks")):
+                        skipped_extras.append(name)
                     continue
                 path, transpose, dt_override = dest[name]
                 arr = f.get_tensor(name)
@@ -237,7 +309,22 @@ def load_params(
                 else:
                     place(path, arr, dt)
 
-    missing = set(dest) - seen
+    dest_expected = set(dest)
+    if fused_gpt_oss:
+        dest_expected |= {
+            f"model.layers.{i}.mlp.experts.{t}"
+            for i in range(spec.num_layers)
+            for t in ("gate_up_proj", "down_proj")
+        }
+    if skipped_extras:
+        import logging
+
+        logging.getLogger("dynamo.loader").warning(
+            "skipped %d unsupported tensors (biases/sinks are not modeled; "
+            "the load approximates such checkpoints), e.g. %s",
+            len(skipped_extras), sorted(skipped_extras)[:3],
+        )
+    missing = dest_expected - seen
     if missing:
         raise ValueError(
             f"checkpoint {model_dir} missing {len(missing)} tensors, e.g. "

@@ -27,6 +27,9 @@ class ReasoningConfig:
     start_marker: str
     end_marker: str
     starts_in_reasoning: bool = False
+    # channel-protocol markup to drop from the CONTENT stream (harmony's
+    # final-channel framing); stream-safe via a MarkerMatcher filter
+    strip_markers: tuple = ()
 
 
 REASONING_PARSERS: dict[str, ReasoningConfig] = {
@@ -35,6 +38,17 @@ REASONING_PARSERS: dict[str, ReasoningConfig] = {
                                    starts_in_reasoning=True),
     "granite": ReasoningConfig(
         "Here is my thought process:", "Here is my response:"
+    ),
+    # gpt-oss harmony channels (ref lib/parsers/src/reasoning/gpt_oss):
+    # analysis channel = reasoning; final channel framing stripped from
+    # content. (Tool-call commentary channels are consumed upstream by
+    # the harmony jail before text reaches this parser.)
+    "gpt_oss": ReasoningConfig(
+        "<|channel|>analysis<|message|>", "<|end|>",
+        strip_markers=(
+            "<|start|>assistant", "<|channel|>final<|message|>",
+            "<|return|>", "<|end|>",
+        ),
     ),
 }
 
@@ -52,12 +66,35 @@ def make_reasoning_parser(name: str | None) -> "ReasoningParser | None":
     return ReasoningParser(cfg)
 
 
+class _StripFilter:
+    """Delete protocol markers from a text stream (chunk-boundary safe)."""
+
+    def __init__(self, markers: tuple):
+        self._matcher = MarkerMatcher(list(markers))
+
+    def feed(self, text: str) -> str:
+        out: list[str] = []
+        while text:
+            clean, marker, rest = self._matcher.feed(text)
+            out.append(clean)
+            if marker is None:
+                break
+            text = rest
+        return "".join(out)
+
+    def flush(self) -> str:
+        return self._matcher.flush()
+
+
 class ReasoningParser:
     def __init__(self, cfg: ReasoningConfig):
         self.cfg = cfg
         self.in_reasoning = cfg.starts_in_reasoning
         self._matcher = MarkerMatcher(
             [cfg.end_marker if self.in_reasoning else cfg.start_marker]
+        )
+        self._strip = (
+            _StripFilter(cfg.strip_markers) if cfg.strip_markers else None
         )
 
     def _switch(self) -> None:
@@ -72,6 +109,8 @@ class ReasoningParser:
         content: list[str] = []
         while text:
             clean, marker, rest = self._matcher.feed(text)
+            if not self.in_reasoning and self._strip is not None:
+                clean = self._strip.feed(clean)
             (reasoning if self.in_reasoning else content).append(clean)
             if marker is None:
                 break
@@ -83,4 +122,6 @@ class ReasoningParser:
         held = self._matcher.flush()
         if self.in_reasoning:
             return held, ""
+        if self._strip is not None:
+            held = self._strip.feed(held) + self._strip.flush()
         return "", held

@@ -72,6 +72,14 @@ TOOL_PARSERS: dict[str, ToolCallConfig] = {
                     bare_json_start=True),
     "phi4": _cfg(start_markers=["functools"], end_markers=[]),
     "pythonic": _cfg(format="pythonic", start_markers=["["], end_markers=["]"]),
+    # gpt-oss harmony channels (ref lib/parsers/src/tool_calling/harmony/):
+    # <|channel|>commentary to=functions.NAME <|constrain|>json
+    # <|message|>{...args...}<|call|>
+    "harmony": _cfg(
+        format="harmony",
+        start_markers=["<|channel|>commentary to="],
+        end_markers=["<|call|>"],
+    ),
     "default": _cfg(),
 }
 
@@ -149,6 +157,23 @@ def _calls_from_objects(objs: list[dict], cfg: ToolCallConfig) -> list[ToolCall]
             args = json.dumps(args)
         calls.append(ToolCall(name=name, arguments=args))
     return calls
+
+
+def _parse_harmony_region(region: str) -> list[ToolCall]:
+    """One harmony commentary region (start marker already stripped):
+    ``functions.get_weather <|constrain|>json<|message|>{"city": "x"}``.
+    The recipient header names the function; the payload after
+    <|message|> is its (usually JSON) arguments."""
+    head, sep, payload = region.partition("<|message|>")
+    if not sep:
+        return []
+    name = head.split("<|")[0].strip()
+    name = name.removeprefix("functions.")
+    if not name:
+        return []
+    objs = _json_candidates(payload)
+    args = json.dumps(objs[0]) if objs else payload.strip()
+    return [ToolCall(name=name, arguments=args)]
 
 
 def _parse_pythonic(payload: str) -> list[ToolCall]:
@@ -234,7 +259,10 @@ def parse_tool_calls(
             payload, rest = region[:end_idx], region[end_idx + len(end_marker):]
         else:
             payload, rest = region, ""
-        calls.extend(_calls_from_objects(_json_candidates(payload), cfg))
+        if cfg.format == "harmony":
+            calls.extend(_parse_harmony_region(payload))
+        else:
+            calls.extend(_calls_from_objects(_json_candidates(payload), cfg))
         if not rest:
             break
     return calls, "".join(normal).strip()

@@ -1,0 +1,323 @@
+"""gpt-oss serving pieces: harmony tool-call format, gpt_oss reasoning
+channels, and the gpt-oss / Qwen-MoE checkpoint name schemes.
+
+Ref: lib/parsers/src/tool_calling/harmony/, reasoning/gpt_oss,
+recipes/gpt-oss-120b (the round-2 verdict's "decorative preset" item).
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+import aiohttp
+import jax
+import jax.numpy as jnp
+
+from dynamo_tpu.engine.config import ModelSpec
+from dynamo_tpu.models import llama
+from dynamo_tpu.parsers.jail import JailedStream
+from dynamo_tpu.parsers.reasoning import make_reasoning_parser
+from dynamo_tpu.parsers.tool_calls import make_tool_config, parse_tool_calls
+
+HARMONY_CALL = (
+    "<|channel|>commentary to=functions.get_weather <|constrain|>json"
+    '<|message|>{"city": "Tokyo", "unit": "c"}<|call|>'
+)
+
+
+def test_parse_harmony_call():
+    cfg = make_tool_config("harmony")
+    calls, normal = parse_tool_calls(
+        "planning...\n" + HARMONY_CALL, cfg
+    )
+    assert len(calls) == 1
+    assert calls[0].name == "get_weather"
+    assert json.loads(calls[0].arguments) == {"city": "Tokyo", "unit": "c"}
+    assert normal == "planning..."
+
+
+def test_parse_harmony_multiple_calls():
+    text = HARMONY_CALL + (
+        "<|channel|>commentary to=functions.lookup<|message|>"
+        '{"q": "x"}<|call|>'
+    )
+    calls, _ = parse_tool_calls(text, make_tool_config("harmony"))
+    assert [c.name for c in calls] == ["get_weather", "lookup"]
+
+
+def test_harmony_jail_streams_split_chunks():
+    """The call arrives in tiny deltas; the jail must hold the region and
+    emit one parsed tool call, never leaking protocol text."""
+    jail = JailedStream(make_tool_config("harmony"))
+    events = []
+    text = "thinking " + HARMONY_CALL
+    for i in range(0, len(text), 7):
+        events.extend(jail.feed(text[i : i + 7]))
+    events.extend(jail.finish())
+    contents = "".join(t for kind, t in events if kind == "content")
+    calls = [c for kind, cs in events if kind == "tool_calls" for c in cs]
+    assert len(calls) == 1 and calls[0].name == "get_weather"
+    assert "<|channel|>" not in contents
+    assert "thinking" in contents
+
+
+def test_gpt_oss_reasoning_channels():
+    p = make_reasoning_parser("gpt_oss")
+    text = (
+        "<|channel|>analysis<|message|>let me think<|end|>"
+        "<|start|>assistant<|channel|>final<|message|>The answer is 4."
+        "<|return|>"
+    )
+    reasoning, content = [], []
+    for i in range(0, len(text), 9):
+        r, c = p.feed(text[i : i + 9])
+        reasoning.append(r)
+        content.append(c)
+    r, c = p.finish()
+    reasoning.append(r)
+    content.append(c)
+    assert "".join(reasoning) == "let me think"
+    assert "".join(content) == "The answer is 4."
+
+
+# -------------------------------------------------------- checkpoint schemes
+
+
+MOE_SPEC = ModelSpec(
+    name="tiny-oss", vocab_size=96, hidden_size=32, intermediate_size=48,
+    num_layers=2, num_heads=4, num_kv_heads=2, head_dim=8, dtype="float32",
+    tie_embeddings=False, num_experts=4, num_experts_per_token=2,
+    moe_intermediate_size=48,
+)
+
+
+def _write_gpt_oss_checkpoint(params, tmpdir: str) -> None:
+    """Our param tree -> gpt-oss-named safetensors (fused interleaved
+    gate_up, [in, out] expert layout, router.weight) + config.json."""
+    from safetensors.numpy import save_file
+
+    t = {}
+    t["model.embed_tokens.weight"] = np.asarray(params["embed"])
+    t["model.norm.weight"] = np.asarray(params["final_norm"])
+    t["lm_head.weight"] = np.ascontiguousarray(np.asarray(params["lm_head"]).T)
+    for i, lp in enumerate(params["layers"]):
+        p = f"model.layers.{i}."
+        t[p + "input_layernorm.weight"] = np.asarray(lp["attn_norm"])
+        t[p + "post_attention_layernorm.weight"] = np.asarray(lp["mlp_norm"])
+        for hf, ours in (("q_proj", "wq"), ("k_proj", "wk"),
+                         ("v_proj", "wv"), ("o_proj", "wo")):
+            t[p + f"self_attn.{hf}.weight"] = np.ascontiguousarray(
+                np.asarray(lp[ours]).T
+            )
+        moe = lp["moe"]
+        t[p + "mlp.router.weight"] = np.ascontiguousarray(
+            np.asarray(moe["router"]).T
+        )
+        wg, wu = np.asarray(moe["w_gate"]), np.asarray(moe["w_up"])
+        fused = np.zeros(
+            (wg.shape[0], wg.shape[1], 2 * wg.shape[2]), wg.dtype
+        )
+        fused[..., 0::2] = wg
+        fused[..., 1::2] = wu
+        t[p + "mlp.experts.gate_up_proj"] = fused
+        t[p + "mlp.experts.down_proj"] = np.asarray(moe["w_down"])
+        # unsupported extras the loader must SKIP (with a warning)
+        t[p + "self_attn.sinks"] = np.zeros((4,), np.float32)
+        t[p + "mlp.experts.gate_up_proj_bias"] = np.zeros(
+            (wg.shape[0], 2 * wg.shape[2]), np.float32
+        )
+    save_file(t, os.path.join(tmpdir, "model.safetensors"))
+    cfg = {
+        "model_type": "gpt_oss",
+        "vocab_size": MOE_SPEC.vocab_size,
+        "hidden_size": MOE_SPEC.hidden_size,
+        "intermediate_size": MOE_SPEC.moe_intermediate_size,
+        "num_hidden_layers": MOE_SPEC.num_layers,
+        "num_attention_heads": MOE_SPEC.num_heads,
+        "num_key_value_heads": MOE_SPEC.num_kv_heads,
+        "head_dim": MOE_SPEC.head_dim,
+        "num_local_experts": MOE_SPEC.num_experts,
+        "num_experts_per_tok": MOE_SPEC.num_experts_per_token,
+        "tie_word_embeddings": False,
+        "torch_dtype": "float32",
+    }
+    with open(os.path.join(tmpdir, "config.json"), "w") as f:
+        json.dump(cfg, f)
+
+
+def test_load_gpt_oss_named_checkpoint(tmp_path):
+    from dynamo_tpu.models.loader import load_model_dir
+
+    params = llama.init_params(MOE_SPEC, jax.random.PRNGKey(7))
+    _write_gpt_oss_checkpoint(params, str(tmp_path))
+    spec2, params2 = load_model_dir(str(tmp_path), dtype="float32")
+    assert spec2.num_experts == 4
+    tokens = jnp.asarray(np.arange(9) % 96, jnp.int32)
+    want = llama.reference_forward(MOE_SPEC, params, tokens)
+    got = llama.reference_forward(spec2, params2, tokens)
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(want), atol=1e-4, rtol=1e-4
+    )
+
+
+def test_load_qwen_moe_named_checkpoint(tmp_path):
+    from dynamo_tpu.models.loader import load_model_dir
+
+    from safetensors.numpy import save_file
+
+    params = llama.init_params(MOE_SPEC, jax.random.PRNGKey(8))
+    t = {}
+    t["model.embed_tokens.weight"] = np.asarray(params["embed"])
+    t["model.norm.weight"] = np.asarray(params["final_norm"])
+    t["lm_head.weight"] = np.ascontiguousarray(np.asarray(params["lm_head"]).T)
+    for i, lp in enumerate(params["layers"]):
+        p = f"model.layers.{i}."
+        t[p + "input_layernorm.weight"] = np.asarray(lp["attn_norm"])
+        t[p + "post_attention_layernorm.weight"] = np.asarray(lp["mlp_norm"])
+        for hf, ours in (("q_proj", "wq"), ("k_proj", "wk"),
+                         ("v_proj", "wv"), ("o_proj", "wo")):
+            t[p + f"self_attn.{hf}.weight"] = np.ascontiguousarray(
+                np.asarray(lp[ours]).T
+            )
+        moe = lp["moe"]
+        t[p + "mlp.gate.weight"] = np.ascontiguousarray(
+            np.asarray(moe["router"]).T
+        )
+        for e in range(MOE_SPEC.num_experts):
+            ep = p + f"mlp.experts.{e}."
+            t[ep + "gate_proj.weight"] = np.ascontiguousarray(
+                np.asarray(moe["w_gate"][e]).T
+            )
+            t[ep + "up_proj.weight"] = np.ascontiguousarray(
+                np.asarray(moe["w_up"][e]).T
+            )
+            t[ep + "down_proj.weight"] = np.ascontiguousarray(
+                np.asarray(moe["w_down"][e]).T
+            )
+    save_file(t, os.path.join(str(tmp_path), "model.safetensors"))
+    with open(os.path.join(str(tmp_path), "config.json"), "w") as f:
+        json.dump({
+            "model_type": "qwen3_moe",
+            "vocab_size": 96, "hidden_size": 32, "intermediate_size": 48,
+            "moe_intermediate_size": 48, "num_hidden_layers": 2,
+            "num_attention_heads": 4, "num_key_value_heads": 2,
+            "head_dim": 8, "num_experts": 4, "num_experts_per_tok": 2,
+            "tie_word_embeddings": False,
+        }, f)
+    spec2, params2 = load_model_dir(str(tmp_path), dtype="float32")
+    tokens = jnp.asarray(np.arange(9) % 96, jnp.int32)
+    want = llama.reference_forward(MOE_SPEC, params, tokens)
+    got = llama.reference_forward(spec2, params2, tokens)
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(want), atol=1e-4, rtol=1e-4
+    )
+
+
+# --------------------------------------------------------------- serving e2e
+
+
+async def test_harmony_tool_calls_over_http_sse():
+    """Harmony-format call text through the real chat surface (echo mocker
+    supplies deterministic 'generation'): parsed tool_calls stream out,
+    protocol text never leaks."""
+    from dynamo_tpu.frontend.http import HttpFrontend
+    from dynamo_tpu.frontend.watcher import ModelManager, ModelWatcher
+    from dynamo_tpu.mocker.__main__ import launch_mock_worker
+    from dynamo_tpu.mocker.engine import MockEngineConfig
+    from dynamo_tpu.runtime.distributed import DistributedRuntime
+    from dynamo_tpu.runtime.hub import InMemoryHub
+
+    drt = DistributedRuntime(InMemoryHub())
+    cfg = MockEngineConfig(
+        block_size=4, total_kv_blocks=512, speedup_ratio=500.0,
+        echo_prompt=True,
+    )
+    await launch_mock_worker(
+        drt, "dyn", "backend", "generate", cfg,
+        model_name="oss-echo", register_card=True,
+        tool_call_parser="harmony",
+    )
+    manager = ModelManager()
+    watcher = await ModelWatcher(drt, manager).start()
+    await watcher.wait_for_model("oss-echo", timeout=5)
+    frontend = HttpFrontend(manager, host="127.0.0.1", port=0)
+    await frontend.start()
+    base = f"http://127.0.0.1:{frontend.port}"
+    try:
+        async with aiohttp.ClientSession() as sess:
+            payload = {
+                "model": "oss-echo",
+                "messages": [{"role": "user", "content": HARMONY_CALL}],
+                "tools": [{"type": "function", "function": {
+                    "name": "get_weather", "parameters": {}}}],
+                "max_tokens": 400,
+                "stream": True,
+            }
+            tool_deltas, contents, finishes = [], [], []
+            async with sess.post(
+                f"{base}/v1/chat/completions", json=payload
+            ) as r:
+                assert r.status == 200, await r.text()
+                async for line in r.content:
+                    if not line.startswith(b"data: ") or b"[DONE]" in line:
+                        continue
+                    chunk = json.loads(line[len(b"data: "):])
+                    for ch in chunk.get("choices", []):
+                        d = ch.get("delta", {})
+                        if d.get("tool_calls"):
+                            tool_deltas.extend(d["tool_calls"])
+                        if d.get("content"):
+                            contents.append(d["content"])
+                        if ch.get("finish_reason"):
+                            finishes.append(ch["finish_reason"])
+            assert tool_deltas, (contents, finishes)
+            assert tool_deltas[0]["function"]["name"] == "get_weather"
+            assert json.loads(tool_deltas[0]["function"]["arguments"]) == {
+                "city": "Tokyo", "unit": "c"
+            }
+            assert "<|channel|>" not in "".join(contents)
+            assert finishes[-1] == "tool_calls"
+    finally:
+        await frontend.stop()
+        watcher.close()
+        await drt.close()
+
+
+async def test_gpt_oss_checkpoint_serves_chat(tmp_path):
+    """preset-shaped weights in gpt-oss tensor format -> loaded engine ->
+    streamed chat completion with the harmony parser attached."""
+    from dynamo_tpu.engine.config import EngineConfig
+    from dynamo_tpu.engine.worker import launch_engine_worker
+    from dynamo_tpu.runtime.context import Context
+    from dynamo_tpu.runtime.distributed import DistributedRuntime
+    from dynamo_tpu.runtime.hub import InMemoryHub
+
+    params = llama.init_params(MOE_SPEC, jax.random.PRNGKey(9))
+    _write_gpt_oss_checkpoint(params, str(tmp_path))
+
+    drt = DistributedRuntime(InMemoryHub())
+    engine, _served = await launch_engine_worker(
+        drt, model_path=str(tmp_path),
+        engine_config=EngineConfig(
+            page_size=4, num_pages=64, max_pages_per_seq=8,
+            max_decode_slots=2, prefill_buckets=(16, 32),
+        ),
+        tool_call_parser="harmony",
+        reasoning_parser="gpt_oss",
+    )
+    try:
+        toks = []
+        async for item in engine.generate(
+            {"token_ids": list(range(10, 22)),
+             "stop_conditions": {"max_tokens": 6, "ignore_eos": True},
+             "sampling": {"temperature": 0.0}},
+            Context("oss-e2e"),
+        ):
+            toks.extend(item.get("token_ids") or [])
+        assert len(toks) == 6
+        assert all(0 <= t < MOE_SPEC.vocab_size for t in toks)
+    finally:
+        await engine.close()
+        await drt.close()
