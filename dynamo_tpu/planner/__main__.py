@@ -16,7 +16,11 @@ import sys
 
 import numpy as np
 
-from dynamo_tpu.planner.connector import LoggingConnector, VirtualConnector
+from dynamo_tpu.planner.connector import (
+    LoggingConnector,
+    ProcessConnector,
+    VirtualConnector,
+)
 from dynamo_tpu.planner.core import (
     FrontendMetricsSource,
     PlannerConfig,
@@ -54,11 +58,21 @@ def build_planner(args, hub=None) -> SlaPlanner:
         decode_component=args.decode_component,
         prefill_component=args.prefill_component,
     )
-    connector = (
-        VirtualConnector(hub, cfg.namespace, cfg.model)
-        if hub is not None and not args.no_operation
-        else LoggingConnector()
-    )
+    if args.no_operation or hub is None:
+        connector = LoggingConnector()
+    elif args.connector == "process":
+        # closes the loop locally: this planner process spawns/retires
+        # mocker workers itself (ref tests/planner scaling runs)
+        from dynamo_tpu.runtime.distributed import DistributedRuntime
+
+        connector = ProcessConnector(
+            DistributedRuntime(hub), cfg.namespace,
+            component=cfg.decode_component,
+            prefill_component=cfg.prefill_component,
+            model_name=cfg.model or "mock-model",
+        )
+    else:
+        connector = VirtualConnector(hub, cfg.namespace, cfg.model)
     source = (
         FrontendMetricsSource(args.metrics_url, cfg.model)
         if args.metrics_url
@@ -135,6 +149,11 @@ def main() -> None:
     p.add_argument("--no-correction", action="store_true")
     p.add_argument("--no-operation", action="store_true",
                    help="log decisions without writing to the hub")
+    p.add_argument("--connector", default="virtual",
+                   choices=["virtual", "process"],
+                   help="virtual: publish desired counts to the hub for a "
+                        "supervisor; process: spawn/retire local mocker "
+                        "workers directly (self-contained scaling loop)")
     p.add_argument("--prefill-component", default="prefill")
     p.add_argument("--decode-component", default="backend")
     p.add_argument("--profile-dir", default=None,

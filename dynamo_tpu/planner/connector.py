@@ -75,3 +75,85 @@ async def read_desired_replicas(hub, namespace: str) -> DesiredReplicas | None:
     if raw is None:
         return None
     return DesiredReplicas(**raw)
+
+
+class ProcessConnector:
+    """Close the scaling loop WITHOUT Kubernetes: converge actual worker
+    processes to the planner's desired counts by spawning/retiring local
+    mocker workers (ref: KubernetesConnector patches DynamoGraphDeployment
+    replicas and the operator reconciles pods — here the connector IS the
+    reconciler). Retiring drains: the endpoint deregisters first, so the
+    router stops picking the worker before it disappears.
+
+    ``spawn(role, index)`` must return a ``ServedEndpoint``-bearing worker
+    handle ``(engine, served)``; the default spawner launches mocker
+    workers on this runtime — the same fleet the reference scales in
+    tests/planner/.
+    """
+
+    def __init__(
+        self,
+        drt,
+        namespace: str,
+        *,
+        component: str = "backend",
+        prefill_component: str = "prefill",
+        endpoint: str = "generate",
+        model_name: str = "mock-model",
+        spawn=None,
+        mock_config=None,
+    ):
+        self.drt = drt
+        self.namespace = namespace
+        self.component = component
+        self.prefill_component = prefill_component
+        self.endpoint = endpoint
+        self.model_name = model_name
+        self._spawn = spawn or self._spawn_mocker
+        self._mock_config = mock_config
+        self._workers: dict[str, list] = {"prefill": [], "decode": []}
+        self.history: list[DesiredReplicas] = []
+
+    def replica_counts(self) -> dict[str, int]:
+        return {k: len(v) for k, v in self._workers.items()}
+
+    async def _spawn_mocker(self, role: str, index: int):
+        from dynamo_tpu.mocker.__main__ import launch_mock_worker
+        from dynamo_tpu.mocker.engine import MockEngineConfig
+
+        cfg = self._mock_config or MockEngineConfig(
+            block_size=16, total_kv_blocks=1024, speedup_ratio=100.0
+        )
+        component = (
+            self.prefill_component if role == "prefill" else self.component
+        )
+        # the FIRST decode worker registers the model card so the frontend
+        # discovers the model; replicas only add serving capacity
+        return await launch_mock_worker(
+            self.drt, self.namespace, component, self.endpoint, cfg,
+            model_name=self.model_name,
+            register_card=(role == "decode" and index == 0),
+        )
+
+    async def set_replicas(self, desired: DesiredReplicas) -> None:
+        self.history.append(desired)
+        for role, want in (("prefill", desired.prefill),
+                           ("decode", desired.decode)):
+            pool = self._workers[role]
+            while len(pool) < want:
+                pool.append(await self._spawn(role, len(pool)))
+            while len(pool) > max(want, 0):
+                engine, served = pool.pop()
+                await served.shutdown(drain=True)
+                close = getattr(engine, "close", None)
+                if close is not None:
+                    res = close()
+                    if hasattr(res, "__await__"):
+                        await res
+        log.info(
+            "process connector converged: prefill=%d decode=%d",
+            len(self._workers["prefill"]), len(self._workers["decode"]),
+        )
+
+    async def close(self) -> None:
+        await self.set_replicas(DesiredReplicas(prefill=0, decode=0))
