@@ -28,6 +28,14 @@ class ModelSpec:
     num_experts: int = 0
     num_experts_per_token: int = 0
     moe_intermediate_size: int = 0
+    n_shared_experts: int = 0  # always-on dense experts (DeepSeek)
+    first_k_dense: int = 0  # leading layers with plain dense MLP
+    # MLA (DeepSeek-family latent attention; 0 = plain GQA attention)
+    kv_lora_rank: int = 0  # latent dim d_c (the per-token KV cache row)
+    qk_nope_head_dim: int = 0
+    qk_rope_head_dim: int = 0  # decoupled-RoPE key dim, shared across heads
+    v_head_dim: int = 0
+    q_lora_rank: int = 0  # query low-rank compression (0 = full q_proj)
 
     @classmethod
     def llama3_8b(cls) -> "ModelSpec":
@@ -90,14 +98,50 @@ class ModelSpec:
         )
 
     @classmethod
+    def deepseek_r1(cls) -> "ModelSpec":
+        """DeepSeek-R1/V3 (ref recipes/deepseek-r1/): MLA + wide MoE with
+        one shared expert and 3 leading dense layers."""
+        return cls(
+            name="deepseek-r1", vocab_size=129280, hidden_size=7168,
+            intermediate_size=18432, num_layers=61, num_heads=128,
+            num_kv_heads=128, head_dim=128, tie_embeddings=False,
+            rope_theta=10000.0,
+            num_experts=256, num_experts_per_token=8,
+            moe_intermediate_size=2048, n_shared_experts=1,
+            first_k_dense=3,
+            kv_lora_rank=512, qk_nope_head_dim=128, qk_rope_head_dim=64,
+            v_head_dim=128, q_lora_rank=1536,
+        )
+
+    @classmethod
+    def tiny_deepseek(cls) -> "ModelSpec":
+        """Toy MLA+MoE spec: the deepseek-r1 architecture at test scale."""
+        return cls(
+            name="tiny-deepseek", vocab_size=96, hidden_size=32,
+            intermediate_size=64, num_layers=3, num_heads=4,
+            num_kv_heads=4, head_dim=16, dtype="float32",
+            tie_embeddings=False,
+            num_experts=4, num_experts_per_token=2,
+            moe_intermediate_size=32, n_shared_experts=1, first_k_dense=1,
+            kv_lora_rank=16, qk_nope_head_dim=16, qk_rope_head_dim=8,
+            v_head_dim=16, q_lora_rank=24,
+        )
+
+    @property
+    def is_mla(self) -> bool:
+        return self.kv_lora_rank > 0
+
+    @classmethod
     def preset(cls, name: str) -> "ModelSpec":
         presets = {
             "tiny-test": cls.tiny,
             "tiny-moe": cls.tiny_moe,
+            "tiny-deepseek": cls.tiny_deepseek,
             "llama-3-8b": cls.llama3_8b,
             "llama-3-70b": cls.llama3_70b,
             "mixtral-8x7b": cls.mixtral_8x7b,
             "gpt-oss-120b": cls.gpt_oss_120b,
+            "deepseek-r1": cls.deepseek_r1,
         }
         if name in presets:
             return presets[name]()

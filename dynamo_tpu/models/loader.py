@@ -65,7 +65,8 @@ def spec_from_hf_config(cfg: dict, name: str | None = None) -> ModelSpec:
     hidden = int(cfg["hidden_size"])
     moe = {}
     n_experts = int(
-        cfg.get("num_local_experts") or cfg.get("num_experts") or 0
+        cfg.get("num_local_experts") or cfg.get("num_experts")
+        or cfg.get("n_routed_experts") or 0
     )
     if model_type in ("mixtral", "qwen2_moe", "qwen3_moe", "gpt_oss") or n_experts:
         moe = dict(
@@ -90,6 +91,14 @@ def spec_from_hf_config(cfg: dict, name: str | None = None) -> ModelSpec:
         rope_theta=float(cfg.get("rope_theta", 500000.0)),
         rms_eps=float(cfg.get("rms_norm_eps", 1e-5)),
         tie_embeddings=bool(cfg.get("tie_word_embeddings", False)),
+        # DeepSeek-family extras (0/absent on other models)
+        n_shared_experts=int(cfg.get("n_shared_experts") or 0),
+        first_k_dense=int(cfg.get("first_k_dense_replace") or 0),
+        kv_lora_rank=int(cfg.get("kv_lora_rank") or 0),
+        qk_nope_head_dim=int(cfg.get("qk_nope_head_dim") or 0),
+        qk_rope_head_dim=int(cfg.get("qk_rope_head_dim") or 0),
+        v_head_dim=int(cfg.get("v_head_dim") or 0),
+        q_lora_rank=int(cfg.get("q_lora_rank") or 0),
         **moe,
     )
 
@@ -139,6 +148,52 @@ def _moe_scheme(names: set[str] | None) -> str:
         if ".mlp.experts.0." in n:
             return "qwen_moe"
     return "mixtral"
+
+
+def _dest_map_mla(
+    spec: ModelSpec,
+) -> dict[str, tuple[tuple, bool, str | None]]:
+    """DeepSeek-family (MLA) tensor names -> models/mla.py tree paths.
+    ``kv_b_proj`` (the fused per-head W_uk/W_uv) splits in load_params."""
+    m: dict[str, tuple[tuple, bool, str | None]] = {
+        "model.embed_tokens.weight": (("embed",), False, None),
+        "model.norm.weight": (("final_norm",), False, None),
+    }
+    if not spec.tie_embeddings:
+        m["lm_head.weight"] = (("lm_head",), True, None)
+    for i in range(spec.num_layers):
+        p = f"model.layers.{i}."
+        li = ("layers", i)
+        m[p + "input_layernorm.weight"] = (li + ("attn_norm",), False, None)
+        m[p + "post_attention_layernorm.weight"] = (li + ("mlp_norm",), False, None)
+        m[p + "self_attn.o_proj.weight"] = (li + ("wo",), True, None)
+        m[p + "self_attn.kv_a_proj_with_mqa.weight"] = (
+            li + ("w_kv_a",), True, None
+        )
+        m[p + "self_attn.kv_a_layernorm.weight"] = (li + ("kv_norm",), False, None)
+        if spec.q_lora_rank:
+            m[p + "self_attn.q_a_proj.weight"] = (li + ("wq_a",), True, None)
+            m[p + "self_attn.q_a_layernorm.weight"] = (li + ("q_norm",), False, None)
+            m[p + "self_attn.q_b_proj.weight"] = (li + ("wq_b",), True, None)
+        else:
+            m[p + "self_attn.q_proj.weight"] = (li + ("wq",), True, None)
+        if spec.num_experts and i >= spec.first_k_dense:
+            m[p + "mlp.gate.weight"] = (li + ("moe", "router"), True, "float32")
+            for e in range(spec.num_experts):
+                ep = p + f"mlp.experts.{e}."
+                m[ep + "gate_proj.weight"] = (li + ("moe", "w_gate", e), True, None)
+                m[ep + "up_proj.weight"] = (li + ("moe", "w_up", e), True, None)
+                m[ep + "down_proj.weight"] = (li + ("moe", "w_down", e), True, None)
+            if spec.n_shared_experts:
+                sp_ = p + "mlp.shared_experts."
+                m[sp_ + "gate_proj.weight"] = (li + ("shared", "w_gate"), True, None)
+                m[sp_ + "up_proj.weight"] = (li + ("shared", "w_up"), True, None)
+                m[sp_ + "down_proj.weight"] = (li + ("shared", "w_down"), True, None)
+        else:
+            for hf, ours in (("gate_proj", "w_gate"), ("up_proj", "w_up"),
+                             ("down_proj", "w_down")):
+                m[p + f"mlp.{hf}.weight"] = (li + (ours,), True, None)
+    return m
 
 
 def _dest_map(
@@ -244,8 +299,14 @@ def load_params(
     for path_file in files:
         with safe_open(path_file, framework="numpy") as f:
             all_names.update(f.keys())
-    dest = _dest_map(spec, all_names)
-    fused_gpt_oss = spec.num_experts and _moe_scheme(all_names) == "gpt_oss"
+    if spec.kv_lora_rank:
+        dest = _dest_map_mla(spec)
+        fused_gpt_oss = False
+    else:
+        dest = _dest_map(spec, all_names)
+        fused_gpt_oss = bool(
+            spec.num_experts and _moe_scheme(all_names) == "gpt_oss"
+        )
 
     params: Params = {}
     seen: set[str] = set()
@@ -254,6 +315,11 @@ def load_params(
 
     shardings = None
     if mesh is not None:
+        if spec.kv_lora_rank:
+            raise NotImplementedError(
+                "TP shardings for MLA checkpoints are not wired yet; "
+                "load without a mesh"
+            )
         from dynamo_tpu.models.llama import param_shardings
 
         shardings = param_shardings(spec, mesh)
@@ -269,7 +335,24 @@ def load_params(
         with safe_open(path_file, framework="numpy") as f:
             for name in f.keys():
                 if name not in dest:
-                    if fused_gpt_oss and name.endswith(
+                    if spec.kv_lora_rank and name.endswith(
+                        "self_attn.kv_b_proj.weight"
+                    ):
+                        # fused per-head up-projections [H*(dn+dv), dc]:
+                        # split into w_uk [H, dc, dn] / w_uv [H, dc, dv]
+                        li = ("layers", int(name.split(".")[2]))
+                        arr = f.get_tensor(name)
+                        H, dn, dv = (spec.num_heads, spec.qk_nope_head_dim,
+                                     spec.v_head_dim)
+                        arr = arr.reshape(H, dn + dv, spec.kv_lora_rank)
+                        place(li + ("w_uk",),
+                              np.ascontiguousarray(
+                                  arr[:, :dn].transpose(0, 2, 1)), dtype)
+                        place(li + ("w_uv",),
+                              np.ascontiguousarray(
+                                  arr[:, dn:].transpose(0, 2, 1)), dtype)
+                        seen.add(name)
+                    elif fused_gpt_oss and name.endswith(
                         (".mlp.experts.gate_up_proj", ".mlp.experts.down_proj")
                     ):
                         # fused 3D expert tensors, already [in, out] per
@@ -310,6 +393,11 @@ def load_params(
                     place(path, arr, dt)
 
     dest_expected = set(dest)
+    if spec.kv_lora_rank:
+        dest_expected |= {
+            f"model.layers.{i}.self_attn.kv_b_proj.weight"
+            for i in range(spec.num_layers)
+        }
     if fused_gpt_oss:
         dest_expected |= {
             f"model.layers.{i}.mlp.experts.{t}"

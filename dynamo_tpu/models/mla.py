@@ -1,0 +1,386 @@
+"""Multi-head Latent Attention (MLA): the DeepSeek-V2/V3/R1 attention.
+
+The reference serves DeepSeek-R1 through engine configs
+(recipes/deepseek-r1/sglang-wideep/tep16p-dep16d-disagg.yaml) and leaves
+MLA to the engine; here the engine is ours, so MLA is implemented
+TPU-natively. What makes MLA special for serving:
+
+- The KV cache stores ONE latent vector per token — ``kv_lora_rank``
+  compressed dims plus a small decoupled-RoPE key (``qk_rope_head_dim``)
+  SHARED across heads — instead of per-head K and V. For R1
+  (128 heads, d_c=512, d_r=64) that is ~14x less KV memory than GQA at
+  the same head count, which is why wide-EP decode fits at all.
+- Decode runs in the ABSORBED form: q_nope folds through W_uk so scores
+  are taken directly against cached latents, and the attention output is
+  re-expanded through W_uv afterwards — per step the cache traffic is
+  the latent stream, never materialized per-head K/V.
+
+Paged cache layout: ``[L, num_pages, page_size, d_c + d_r]`` — no head
+axis (the latent is shared), page-major like the GQA pool, and
+compatible with the engine's page/block bookkeeping. Rows gather by
+block table with plain XLA ops; MLA decode is far less gather-bound
+than GQA (one row per token, not KH) so the Pallas treatment is not the
+first bottleneck here.
+
+The DeepSeek block composes MLA with the MoE FFN (models/moe.py) plus
+``n_shared_experts`` always-on dense experts; the first
+``first_k_dense`` layers use a plain dense MLP (DeepSeek's
+first_k_dense_replace). RoPE is the standard half-split form (YaRN
+long-context scaling not yet applied).
+
+Parity contract: ``reference_forward`` computes the plain non-absorbed
+attention; the paged prefill/decode must match it (tests/test_mla.py).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from dynamo_tpu.engine.config import ModelSpec
+from dynamo_tpu.models.llama import TRASH_PAGE, _logits, rms_norm, rope
+
+Params = dict[str, Any]
+
+NEG_INF = -1e30
+
+
+def latent_dim(spec: ModelSpec) -> int:
+    return spec.kv_lora_rank + spec.qk_rope_head_dim
+
+
+# ---------------------------------------------------------------- init
+
+
+def init_params(spec: ModelSpec, key: jax.Array) -> Params:
+    """Random-init DeepSeek-family params (MLA + MoE/dense FFN)."""
+    assert spec.kv_lora_rank > 0, "not an MLA spec"
+    dtype = jnp.dtype(spec.dtype)
+    d = spec.hidden_size
+    H = spec.num_heads
+    dn, dr, dv = spec.qk_nope_head_dim, spec.qk_rope_head_dim, spec.v_head_dim
+    dc = spec.kv_lora_rank
+    keys = iter(jax.random.split(key, 8 + spec.num_layers * 12))
+
+    def dense(k, shape, scale=None):
+        scale = scale or (1.0 / jnp.sqrt(shape[0]))
+        return (jax.random.normal(k, shape, jnp.float32) * scale).astype(dtype)
+
+    params: Params = {
+        "embed": dense(next(keys), (spec.vocab_size, d), scale=0.02),
+        "final_norm": jnp.ones((d,), dtype),
+        "layers": [],
+    }
+    if not spec.tie_embeddings:
+        params["lm_head"] = dense(next(keys), (d, spec.vocab_size))
+    for li in range(spec.num_layers):
+        layer: Params = {
+            "attn_norm": jnp.ones((d,), dtype),
+            "mlp_norm": jnp.ones((d,), dtype),
+            "w_kv_a": dense(next(keys), (d, dc + dr)),
+            "kv_norm": jnp.ones((dc,), dtype),
+            "w_uk": dense(next(keys), (H, dc, dn), scale=1.0 / jnp.sqrt(dc)),
+            "w_uv": dense(next(keys), (H, dc, dv), scale=1.0 / jnp.sqrt(dc)),
+            "wo": dense(next(keys), (H * dv, d)),
+        }
+        if spec.q_lora_rank:
+            layer["wq_a"] = dense(next(keys), (d, spec.q_lora_rank))
+            layer["q_norm"] = jnp.ones((spec.q_lora_rank,), dtype)
+            layer["wq_b"] = dense(
+                next(keys), (spec.q_lora_rank, H * (dn + dr))
+            )
+        else:
+            layer["wq"] = dense(next(keys), (d, H * (dn + dr)))
+        if spec.num_experts and li >= spec.first_k_dense:
+            from dynamo_tpu.models import moe
+
+            layer["moe"] = moe.init_moe_layer(spec, next(keys))
+            if spec.n_shared_experts:
+                f = spec.moe_intermediate_size * spec.n_shared_experts
+                layer["shared"] = {
+                    "w_gate": dense(next(keys), (d, f)),
+                    "w_up": dense(next(keys), (d, f)),
+                    "w_down": dense(next(keys), (f, d)),
+                }
+        else:
+            layer["w_gate"] = dense(next(keys), (d, spec.intermediate_size))
+            layer["w_up"] = dense(next(keys), (d, spec.intermediate_size))
+            layer["w_down"] = dense(next(keys), (spec.intermediate_size, d))
+        params["layers"].append(layer)
+    return params
+
+
+def init_cache(
+    spec: ModelSpec, num_pages: int, page_size: int, dtype=None
+) -> jax.Array:
+    """Latent cache [L, num_pages, page_size, d_c + d_r] (page 0 = trash).
+    ONE array — MLA has no separate K and V pools."""
+    dtype = dtype or jnp.dtype(spec.dtype)
+    return jnp.zeros(
+        (spec.num_layers, num_pages, page_size, latent_dim(spec)), dtype
+    )
+
+
+# --------------------------------------------------------------- pieces
+
+
+def _q_heads(spec: ModelSpec, lp: Params, h: jax.Array, positions) -> tuple:
+    """-> (q_nope [T, H, dn], q_rope [T, H, dr]) with RoPE applied."""
+    T = h.shape[0]
+    H, dn, dr = spec.num_heads, spec.qk_nope_head_dim, spec.qk_rope_head_dim
+    if spec.q_lora_rank:
+        q = rms_norm(h @ lp["wq_a"], lp["q_norm"], spec.rms_eps) @ lp["wq_b"]
+    else:
+        q = h @ lp["wq"]
+    q = q.reshape(T, H, dn + dr)
+    q_nope, q_rope = q[..., :dn], q[..., dn:]
+    return q_nope, rope(q_rope, positions, spec.rope_theta)
+
+
+def _latent_row(spec: ModelSpec, lp: Params, h: jax.Array, positions):
+    """-> cache rows [T, d_c + d_r]: normalized latent + roped shared key."""
+    dc = spec.kv_lora_rank
+    kv_a = h @ lp["w_kv_a"]
+    c = rms_norm(kv_a[:, :dc], lp["kv_norm"], spec.rms_eps)
+    k_r = rope(kv_a[:, None, dc:], positions, spec.rope_theta)[:, 0]
+    return jnp.concatenate([c, k_r], axis=-1)
+
+
+def _absorbed_attention(
+    spec: ModelSpec,
+    lp: Params,
+    q_nope: jax.Array,  # [T, H, dn]
+    q_rope: jax.Array,  # [T, H, dr]
+    rows: jax.Array,  # [S, d_c + d_r] cached latents (+ self rows)
+    mask: jax.Array,  # [T, S] bool
+) -> jax.Array:
+    """Latent-space attention -> per-head outputs [T, H, dv]."""
+    dc = spec.kv_lora_rank
+    scale = 1.0 / jnp.sqrt(
+        jnp.asarray(spec.qk_nope_head_dim + spec.qk_rope_head_dim, jnp.float32)
+    )
+    c, k_r = rows[:, :dc], rows[:, dc:]
+    # absorb W_uk: q_lat[t,h,:] = q_nope[t,h,:] @ w_uk[h].T  -> [T, H, dc]
+    q_lat = jnp.einsum("thn,hcn->thc", q_nope.astype(jnp.float32),
+                       lp["w_uk"].astype(jnp.float32))
+    scores = (
+        jnp.einsum("thc,sc->ths", q_lat, c.astype(jnp.float32))
+        + jnp.einsum("thr,sr->ths", q_rope.astype(jnp.float32),
+                     k_r.astype(jnp.float32))
+    ) * scale
+    scores = jnp.where(mask[:, None, :], scores, NEG_INF)
+    probs = jax.nn.softmax(scores, axis=-1)
+    o_lat = jnp.einsum("ths,sc->thc", probs, c.astype(jnp.float32))
+    return jnp.einsum("thc,hcv->thv", o_lat,
+                      lp["w_uv"].astype(jnp.float32))
+
+
+def _ffn(spec: ModelSpec, li: int, lp: Params, x: jax.Array) -> jax.Array:
+    if "moe" in lp:
+        from dynamo_tpu.models import moe
+
+        out = moe.moe_mlp(spec, lp["moe"], x)
+        if "shared" in lp:
+            sh = lp["shared"]
+            out = out + (
+                jax.nn.silu(x @ sh["w_gate"]) * (x @ sh["w_up"])
+            ) @ sh["w_down"]
+        return out
+    return (jax.nn.silu(x @ lp["w_gate"]) * (x @ lp["w_up"])) @ lp["w_down"]
+
+
+# ------------------------------------------------------------- reference
+
+
+def reference_forward(
+    spec: ModelSpec, params: Params, tokens: jax.Array
+) -> jax.Array:
+    """Plain NON-absorbed MLA forward (per-head K/V materialized) — the
+    numerical ground truth the paged/absorbed paths must match."""
+    T = tokens.shape[0]
+    positions = jnp.arange(T)
+    x = params["embed"][tokens]
+    dn = spec.qk_nope_head_dim
+    scale = 1.0 / jnp.sqrt(jnp.asarray(dn + spec.qk_rope_head_dim, jnp.float32))
+    mask = positions[:, None] >= positions[None, :]
+    for li, lp in enumerate(params["layers"]):
+        h = rms_norm(x, lp["attn_norm"], spec.rms_eps)
+        q_nope, q_rope = _q_heads(spec, lp, h, positions)
+        rows = _latent_row(spec, lp, h, positions)
+        c, k_r = rows[:, : spec.kv_lora_rank], rows[:, spec.kv_lora_rank:]
+        k_nope = jnp.einsum("sc,hcn->shn", c.astype(jnp.float32),
+                            lp["w_uk"].astype(jnp.float32))
+        v = jnp.einsum("sc,hcv->shv", c.astype(jnp.float32),
+                       lp["w_uv"].astype(jnp.float32))
+        scores = (
+            jnp.einsum("thn,shn->ths", q_nope.astype(jnp.float32), k_nope)
+            + jnp.einsum("thr,sr->ths", q_rope.astype(jnp.float32),
+                         k_r.astype(jnp.float32))
+        ) * scale
+        scores = jnp.where(mask[:, None, :], scores, NEG_INF)
+        probs = jax.nn.softmax(scores, axis=-1)
+        attn = jnp.einsum("ths,shv->thv", probs, v)
+        x = x + attn.reshape(T, -1).astype(x.dtype) @ lp["wo"]
+        hh = rms_norm(x, lp["mlp_norm"], spec.rms_eps)
+        x = x + _ffn(spec, li, lp, hh)
+    return _logits_all(spec, params, x)
+
+
+def _logits_all(spec, params, x):
+    xn = rms_norm(x, params["final_norm"], spec.rms_eps)
+    head = params["embed"].T if spec.tie_embeddings else params["lm_head"]
+    return (xn @ head).astype(jnp.float32)
+
+
+# ----------------------------------------------------------------- paged
+
+
+def _gather_rows(cache_l: jax.Array, block_table: jax.Array) -> jax.Array:
+    """[num_pages, page, D] + [P] -> [P*page, D]."""
+    rows = cache_l[block_table]  # [P, page, D]
+    P, page, D = rows.shape
+    return rows.reshape(P * page, D)
+
+
+def prefill_forward_impl(
+    spec: ModelSpec,
+    params: Params,
+    tokens: jax.Array,  # [T_pad]
+    block_table: jax.Array,  # [max_pages_per_seq]
+    start_pos: jax.Array,  # scalar (page-aligned)
+    cache: jax.Array,  # [L, pages, page, D] (donated)
+    num_tokens: jax.Array,  # scalar
+) -> tuple[jax.Array, jax.Array]:
+    """One prompt; writes latent rows page-granularly; returns
+    (last_logits, cache). Mirrors llama.prefill_forward_impl."""
+    T = tokens.shape[0]
+    idx = jnp.arange(T)
+    positions = start_pos + idx
+    page_size = cache.shape[2]
+    n_pg = T // page_size
+    page_starts = start_pos + jnp.arange(n_pg) * page_size
+    pg_idx = block_table[page_starts // page_size]
+    safe_pg = jnp.where(
+        page_starts < start_pos + num_tokens, pg_idx, TRASH_PAGE
+    )
+    x = params["embed"][tokens]
+    kv_len = start_pos + num_tokens
+    max_ctx = block_table.shape[0] * page_size
+    ctx_pos = jnp.arange(max_ctx)
+    for li, lp in enumerate(params["layers"]):
+        h = rms_norm(x, lp["attn_norm"], spec.rms_eps)
+        q_nope, q_rope = _q_heads(spec, lp, h, positions)
+        new_rows = _latent_row(spec, lp, h, positions)
+        cache = cache.at[li, safe_pg].set(
+            new_rows.reshape(n_pg, page_size, -1).astype(cache.dtype)
+        )
+        rows = _gather_rows(cache[li], block_table)  # [max_ctx, D]
+        mask = (ctx_pos[None, :] <= positions[:, None]) & (
+            ctx_pos[None, :] < kv_len
+        )
+        attn = _absorbed_attention(spec, lp, q_nope, q_rope, rows, mask)
+        x = x + attn.reshape(T, -1).astype(x.dtype) @ lp["wo"]
+        hh = rms_norm(x, lp["mlp_norm"], spec.rms_eps)
+        x = x + _ffn(spec, li, lp, hh)
+    last = jnp.clip(num_tokens - 1, 0, T - 1)
+    return _logits_all(spec, params, x)[last], cache
+
+
+prefill_forward = jax.jit(
+    prefill_forward_impl, static_argnums=(0,), donate_argnums=(5,)
+)
+
+
+def decode_forward_impl(
+    spec: ModelSpec,
+    params: Params,
+    tokens: jax.Array,  # [B]
+    block_tables: jax.Array,  # [B, P]
+    seq_lens: jax.Array,  # [B] incl. the new token
+    cache: jax.Array,  # donated
+    active: jax.Array,  # [B] bool
+) -> tuple[jax.Array, jax.Array]:
+    """One decode step (absorbed latent attention); returns (logits, cache)."""
+    B = tokens.shape[0]
+    page_size = cache.shape[2]
+    positions = seq_lens - 1
+    page_idx = jnp.take_along_axis(
+        block_tables, (positions // page_size)[:, None], axis=1
+    )[:, 0]
+    safe_page = jnp.where(active, page_idx, TRASH_PAGE)
+    offset = positions % page_size
+    max_ctx = block_tables.shape[1] * page_size
+    ctx_pos = jnp.arange(max_ctx)
+    x = params["embed"][tokens]
+    for li, lp in enumerate(params["layers"]):
+        h = rms_norm(x, lp["attn_norm"], spec.rms_eps)
+        q_nope, q_rope = _q_heads(spec, lp, h, positions)
+        new_rows = _latent_row(spec, lp, h, positions)  # [B, D]
+        cache = cache.at[li, safe_page, offset].set(
+            new_rows.astype(cache.dtype)
+        )
+        rows = jax.vmap(lambda bt: _gather_rows(cache[li], bt))(
+            block_tables
+        )  # [B, max_ctx, D]
+        mask = ctx_pos[None, :] < seq_lens[:, None]  # [B, max_ctx]
+        attn = jax.vmap(
+            lambda qn, qr, r, m: _absorbed_attention(
+                spec, lp, qn[None], qr[None], r, m[None]
+            )[0]
+        )(q_nope, q_rope, rows, mask)
+        x = x + attn.reshape(B, -1).astype(x.dtype) @ lp["wo"]
+        hh = rms_norm(x, lp["mlp_norm"], spec.rms_eps)
+        x = x + _ffn(spec, li, lp, hh)
+    return _logits_all(spec, params, x), cache
+
+
+decode_forward = jax.jit(
+    decode_forward_impl, static_argnums=(0,), donate_argnums=(5,)
+)
+
+
+def decode_steps_impl(
+    spec: ModelSpec,
+    params: Params,
+    tokens: jax.Array,
+    block_tables: jax.Array,
+    seq_lens: jax.Array,
+    cache: jax.Array,
+    active: jax.Array,
+    temperature: jax.Array,
+    top_k: jax.Array,
+    top_p: jax.Array,
+    seeds: jax.Array,
+    steps: jax.Array,
+    n_steps: int = 1,
+):
+    """Fused multi-step MLA decode + on-device sampling (the serving hot
+    loop; mirrors llama.decode_steps for the GQA family)."""
+    from dynamo_tpu.engine.sampling import sample_tokens
+
+    B = tokens.shape[0]
+    out0 = jnp.zeros((B, n_steps), jnp.int32)
+
+    def body(i, carry):
+        toks, lens, cache, out = carry
+        logits, cache = decode_forward_impl(
+            spec, params, toks, block_tables, lens, cache, active
+        )
+        nxt = sample_tokens(logits, temperature, top_k, top_p, seeds,
+                            steps + i)
+        nxt = jnp.where(active, nxt, toks)
+        return (nxt, lens + active.astype(jnp.int32), cache,
+                out.at[:, i].set(nxt))
+
+    _t, _l, cache, out = jax.lax.fori_loop(
+        0, n_steps, body, (tokens, seq_lens, cache, out0)
+    )
+    return out, cache
+
+
+decode_steps = jax.jit(
+    decode_steps_impl, static_argnums=(0,), static_argnames=("n_steps",)
+)

@@ -1,0 +1,222 @@
+"""MLA (DeepSeek-family latent attention): paged/absorbed forms vs the
+dense non-absorbed reference (models/mla.py)."""
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from dynamo_tpu.engine.config import ModelSpec
+from dynamo_tpu.models import mla
+
+SPEC = ModelSpec.tiny_deepseek()
+PAGE = 4
+
+
+def test_preset_expressible():
+    r1 = ModelSpec.preset("deepseek-r1")
+    assert r1.is_mla and r1.kv_lora_rank == 512 and r1.num_experts == 256
+    # the whole point of MLA: the per-token cache row is the latent, an
+    # order of magnitude under per-head K+V at the same head count
+    assert mla.latent_dim(r1) == 576
+    assert r1.num_heads * r1.head_dim * 2 / mla.latent_dim(r1) > 50
+
+
+def test_paged_prefill_matches_reference():
+    params = mla.init_params(SPEC, jax.random.PRNGKey(0))
+    T = 11
+    tokens = np.arange(T) % SPEC.vocab_size
+    ref = mla.reference_forward(SPEC, params, jnp.asarray(tokens, jnp.int32))
+
+    padded = np.zeros((16,), np.int32)
+    padded[:T] = tokens
+    cache = mla.init_cache(SPEC, 8, PAGE)
+    bt = jnp.asarray([1, 2, 3, 4, 0, 0, 0, 0], jnp.int32)
+    logits, cache = mla.prefill_forward(
+        SPEC, params, jnp.asarray(padded), bt, jnp.asarray(0, jnp.int32),
+        cache, jnp.asarray(T, jnp.int32),
+    )
+    np.testing.assert_allclose(
+        np.asarray(logits), np.asarray(ref[T - 1]), atol=2e-4, rtol=1e-4
+    )
+
+
+def test_paged_decode_continues_prefill():
+    """prefill + N absorbed decode steps == the dense reference run over
+    the full (greedy-extended) sequence, token for token."""
+    params = mla.init_params(SPEC, jax.random.PRNGKey(1))
+    T, N = 7, 5
+    tokens = list(np.arange(5, 5 + T) % SPEC.vocab_size)
+
+    # dense greedy chain (ground truth)
+    seq = list(tokens)
+    for _ in range(N):
+        lg = mla.reference_forward(
+            SPEC, params, jnp.asarray(seq, jnp.int32)
+        )
+        seq.append(int(np.argmax(np.asarray(lg[-1]))))
+    want = seq[T:]
+
+    # paged: prefill then decode_forward steps
+    padded = np.zeros((16,), np.int32)
+    padded[:T] = tokens
+    cache = mla.init_cache(SPEC, 8, PAGE)
+    bt1 = jnp.asarray([1, 2, 3, 4, 0, 0, 0, 0], jnp.int32)
+    logits, cache = mla.prefill_forward(
+        SPEC, params, jnp.asarray(padded), bt1, jnp.asarray(0, jnp.int32),
+        cache, jnp.asarray(T, jnp.int32),
+    )
+    got = [int(np.argmax(np.asarray(logits)))]
+    B = 1
+    bts = jnp.asarray([[1, 2, 3, 4, 0, 0, 0, 0]], jnp.int32)
+    lens = jnp.asarray([T + 1], jnp.int32)
+    active = jnp.ones((B,), bool)
+    toks = jnp.asarray([got[-1]], jnp.int32)
+    for _ in range(N - 1):
+        lg, cache = mla.decode_forward(
+            SPEC, params, toks, bts, lens, cache, active
+        )
+        nxt = int(np.argmax(np.asarray(lg[0])))
+        got.append(nxt)
+        toks = jnp.asarray([nxt], jnp.int32)
+        lens = lens + 1
+    assert got == want
+
+
+def test_fused_decode_steps_matches_stepwise():
+    params = mla.init_params(SPEC, jax.random.PRNGKey(2))
+    B, pps = 2, 2
+    cache0 = np.asarray(
+        jax.random.normal(
+            jax.random.PRNGKey(3),
+            (SPEC.num_layers, 1 + B * pps, PAGE, mla.latent_dim(SPEC)),
+            jnp.float32,
+        )
+    )
+    bt = jnp.asarray([[1, 2], [3, 4]], jnp.int32)
+    tokens = jnp.asarray([4, 9], jnp.int32)
+    seq_lens = jnp.asarray([3, 5], jnp.int32)
+    active = jnp.ones((B,), bool)
+    temps = jnp.asarray([0.0, 0.7], jnp.float32)
+    topk = jnp.zeros((B,), jnp.int32)
+    topp = jnp.ones((B,), jnp.float32)
+    seeds = jnp.asarray([1, 2], jnp.uint32)
+    gen = jnp.zeros((B,), jnp.int32)
+
+    from dynamo_tpu.engine.sampling import sample_tokens
+
+    c1 = jnp.asarray(cache0)
+    toks, lens, g = tokens, seq_lens, gen
+    want = []
+    for i in range(3):
+        lg, c1 = mla.decode_forward(SPEC, params, toks, bt, lens, c1, active)
+        nxt = sample_tokens(lg, temps, topk, topp, seeds, g)
+        want.append(np.asarray(nxt))
+        toks, lens, g = nxt, lens + 1, g + 1
+    want = np.stack(want, axis=1)
+
+    out, _c2 = mla.decode_steps(
+        SPEC, params, tokens, bt, seq_lens, jnp.asarray(cache0), active,
+        temps, topk, topp, seeds, gen, n_steps=3,
+    )
+    np.testing.assert_array_equal(np.asarray(out), want)
+
+
+def test_deepseek_checkpoint_loads(tmp_path):
+    """DeepSeek-named safetensors (q-LoRA, kv_a_proj_with_mqa, fused
+    kv_b_proj, routed+shared experts, first-k-dense) -> mla params with
+    forward parity vs the source tree."""
+    import json as _json
+    import os
+
+    from safetensors.numpy import save_file
+
+    from dynamo_tpu.models.loader import load_model_dir
+
+    params = mla.init_params(SPEC, jax.random.PRNGKey(5))
+    t = {}
+    t["model.embed_tokens.weight"] = np.asarray(params["embed"])
+    t["model.norm.weight"] = np.asarray(params["final_norm"])
+    t["lm_head.weight"] = np.ascontiguousarray(np.asarray(params["lm_head"]).T)
+    H, dn, dv, dc = (SPEC.num_heads, SPEC.qk_nope_head_dim, SPEC.v_head_dim,
+                     SPEC.kv_lora_rank)
+    for i, lp in enumerate(params["layers"]):
+        p = f"model.layers.{i}."
+        t[p + "input_layernorm.weight"] = np.asarray(lp["attn_norm"])
+        t[p + "post_attention_layernorm.weight"] = np.asarray(lp["mlp_norm"])
+        t[p + "self_attn.o_proj.weight"] = np.ascontiguousarray(
+            np.asarray(lp["wo"]).T
+        )
+        t[p + "self_attn.kv_a_proj_with_mqa.weight"] = np.ascontiguousarray(
+            np.asarray(lp["w_kv_a"]).T
+        )
+        t[p + "self_attn.kv_a_layernorm.weight"] = np.asarray(lp["kv_norm"])
+        t[p + "self_attn.q_a_proj.weight"] = np.ascontiguousarray(
+            np.asarray(lp["wq_a"]).T
+        )
+        t[p + "self_attn.q_a_layernorm.weight"] = np.asarray(lp["q_norm"])
+        t[p + "self_attn.q_b_proj.weight"] = np.ascontiguousarray(
+            np.asarray(lp["wq_b"]).T
+        )
+        # fused kv_b: [H*(dn+dv), dc] from w_uk [H, dc, dn] / w_uv [H, dc, dv]
+        kb = np.concatenate(
+            [np.asarray(lp["w_uk"]).transpose(0, 2, 1),
+             np.asarray(lp["w_uv"]).transpose(0, 2, 1)], axis=1
+        ).reshape(H * (dn + dv), dc)
+        t[p + "self_attn.kv_b_proj.weight"] = np.ascontiguousarray(kb)
+        if "moe" in lp:
+            moe = lp["moe"]
+            t[p + "mlp.gate.weight"] = np.ascontiguousarray(
+                np.asarray(moe["router"]).T
+            )
+            for e in range(SPEC.num_experts):
+                ep = p + f"mlp.experts.{e}."
+                t[ep + "gate_proj.weight"] = np.ascontiguousarray(
+                    np.asarray(moe["w_gate"][e]).T)
+                t[ep + "up_proj.weight"] = np.ascontiguousarray(
+                    np.asarray(moe["w_up"][e]).T)
+                t[ep + "down_proj.weight"] = np.ascontiguousarray(
+                    np.asarray(moe["w_down"][e]).T)
+            sh = lp["shared"]
+            t[p + "mlp.shared_experts.gate_proj.weight"] = (
+                np.ascontiguousarray(np.asarray(sh["w_gate"]).T))
+            t[p + "mlp.shared_experts.up_proj.weight"] = (
+                np.ascontiguousarray(np.asarray(sh["w_up"]).T))
+            t[p + "mlp.shared_experts.down_proj.weight"] = (
+                np.ascontiguousarray(np.asarray(sh["w_down"]).T))
+        else:
+            for hf, ours in (("gate_proj", "w_gate"), ("up_proj", "w_up"),
+                             ("down_proj", "w_down")):
+                t[p + f"mlp.{hf}.weight"] = np.ascontiguousarray(
+                    np.asarray(lp[ours]).T)
+    save_file(t, os.path.join(str(tmp_path), "model.safetensors"))
+    with open(os.path.join(str(tmp_path), "config.json"), "w") as f:
+        _json.dump({
+            "model_type": "deepseek_v3",
+            "vocab_size": SPEC.vocab_size, "hidden_size": SPEC.hidden_size,
+            "intermediate_size": SPEC.intermediate_size,
+            "moe_intermediate_size": SPEC.moe_intermediate_size,
+            "num_hidden_layers": SPEC.num_layers,
+            "num_attention_heads": SPEC.num_heads,
+            "num_key_value_heads": SPEC.num_kv_heads,
+            "head_dim": SPEC.head_dim,
+            "rope_theta": SPEC.rope_theta,
+            "n_routed_experts": SPEC.num_experts,
+            "num_experts_per_tok": SPEC.num_experts_per_token,
+            "n_shared_experts": SPEC.n_shared_experts,
+            "first_k_dense_replace": SPEC.first_k_dense,
+            "kv_lora_rank": SPEC.kv_lora_rank,
+            "qk_nope_head_dim": SPEC.qk_nope_head_dim,
+            "qk_rope_head_dim": SPEC.qk_rope_head_dim,
+            "v_head_dim": SPEC.v_head_dim,
+            "q_lora_rank": SPEC.q_lora_rank,
+            "tie_word_embeddings": False,
+        }, f)
+    spec2, params2 = load_model_dir(str(tmp_path), dtype="float32")
+    assert spec2.is_mla and spec2.kv_lora_rank == SPEC.kv_lora_rank
+    tokens = jnp.asarray(np.arange(9) % SPEC.vocab_size, jnp.int32)
+    want = mla.reference_forward(SPEC, params, tokens)
+    got = mla.reference_forward(spec2, params2, tokens)
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(want), atol=1e-4, rtol=1e-4
+    )
