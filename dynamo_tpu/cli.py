@@ -7,6 +7,8 @@ echo, dyn://}``):
   dynamo-tpu run --in http --out engine --model-path /ckpt   one-process
       serving stack (in-memory hub + worker + OpenAI frontend)
   dynamo-tpu run --in text --out echo                        interactive REPL
+  dynamo-tpu run --in batch:reqs.jsonl --out engine          offline batch:
+      one JSON result line per input line (ref Input::Batch, input.rs:32)
   dynamo-tpu hub|frontend|worker|mocker|router|planner ...   launch the
       corresponding service process (same as python -m dynamo_tpu.<mod>)
   dynamo-tpu bench|profile ...                               load generator /
@@ -100,6 +102,42 @@ async def _arun(args: argparse.Namespace) -> None:
         await drt.runtime.wait_for_shutdown()
         return
 
+    if args.inp.startswith("batch:"):
+        import json
+
+        from dynamo_tpu.runtime.context import Context
+
+        path = args.inp[len("batch:"):]
+        pipe = manager.get(model_name)
+        reqs = [json.loads(ln) for ln in open(path) if ln.strip()]
+        sem = asyncio.Semaphore(args.batch_concurrency)
+
+        async def one(i: int, req: dict) -> dict:
+            body = {"model": model_name, "max_tokens": args.max_tokens}
+            body.update(req)
+            if "messages" not in body and "prompt" in body:
+                body["messages"] = [
+                    {"role": "user", "content": body.pop("prompt")}
+                ]
+            pre = pipe.preprocessor.preprocess(body)
+            text: list[str] = []
+            async with sem:
+                async for d in pipe.generate(pre, Context()):
+                    if d.get("text"):
+                        text.append(d["text"])
+            return {"index": i, "text": "".join(text)}
+
+        results = await asyncio.gather(
+            *(one(i, r) for i, r in enumerate(reqs))
+        )
+        out = open(args.output, "w") if args.output else sys.stdout
+        for r in results:
+            out.write(json.dumps(r) + "\n")
+        if args.output:
+            out.close()
+            print(f"BATCH_DONE n={len(results)} -> {args.output}", flush=True)
+        return
+
     if args.inp == "text":
         from dynamo_tpu.runtime.context import Context
 
@@ -129,7 +167,7 @@ async def _arun(args: argparse.Namespace) -> None:
 def _run_command(rest: list[str]) -> int:
     p = argparse.ArgumentParser(prog="dynamo-tpu run")
     p.add_argument("--in", dest="inp", default="http",
-                   choices=["http", "text"])
+                   help="http | text | batch:FILE.jsonl")
     p.add_argument("--out", default="mocker",
                    choices=["engine", "mocker", "echo"])
     p.add_argument("--model", default="tiny-test",
@@ -143,7 +181,13 @@ def _run_command(rest: list[str]) -> int:
     p.add_argument("--tp", type=int, default=1)
     p.add_argument("--max-tokens", type=int, default=128)
     p.add_argument("--speedup-ratio", type=float, default=1.0)
+    p.add_argument("--output", default=None,
+                   help="batch mode: write JSONL results here (default "
+                        "stdout)")
+    p.add_argument("--batch-concurrency", type=int, default=8)
     args = p.parse_args(rest)
+    if args.inp not in ("http", "text") and not args.inp.startswith("batch:"):
+        p.error(f"unknown --in {args.inp!r} (http | text | batch:FILE)")
     try:
         asyncio.run(_arun(args))
     except KeyboardInterrupt:

@@ -1,0 +1,13 @@
+#!/usr/bin/env bash
+# Llama-3-8B aggregated single worker (BASELINE config 1).
+# One process: in-memory hub + JAX engine worker + OpenAI HTTP frontend.
+#   MODEL_PATH=/ckpt ./agg.sh     # real weights (else random-weight preset)
+set -euo pipefail
+cd "$(dirname "$0")/../.."
+ARGS=(run --in http --out engine --port "${PORT:-8000}")
+if [ -n "${MODEL_PATH:-}" ]; then
+  ARGS+=(--model-path "$MODEL_PATH")
+else
+  ARGS+=(--model "${MODEL:-llama-3-8b}")
+fi
+exec python -m dynamo_tpu.cli "${ARGS[@]}"

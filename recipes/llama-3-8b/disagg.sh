@@ -1,0 +1,22 @@
+#!/usr/bin/env bash
+# Llama-3-8B disaggregated: 1 prefill + 1 decode worker, KV-aware routing
+# (BASELINE config 2; ref docs/architecture/disagg_serving.md).
+# Spawns: hub, prefill worker, decode worker, OpenAI frontend.
+set -euo pipefail
+cd "$(dirname "$0")/../.."
+PORT="${PORT:-8000}"
+MODEL_ARGS=(--model "${MODEL:-llama-3-8b}")
+[ -n "${MODEL_PATH:-}" ] && MODEL_ARGS=(--model-path "$MODEL_PATH")
+
+python -m dynamo_tpu.runtime.hub_server --port 0 > /tmp/dyn-hub.out &
+HUB_PID=$!
+trap 'kill $(jobs -p) 2>/dev/null' EXIT
+until grep -q DYNAMO_HUB /tmp/dyn-hub.out 2>/dev/null; do sleep 0.2; done
+HUB=$(grep -m1 DYNAMO_HUB /tmp/dyn-hub.out | cut -d= -f2)
+echo "hub: $HUB"
+
+python -m dynamo_tpu.engine.worker --hub "$HUB" "${MODEL_ARGS[@]}" \
+  --mode prefill &
+python -m dynamo_tpu.engine.worker --hub "$HUB" "${MODEL_ARGS[@]}" \
+  --mode decode --max-local-prefill-length "${MAX_LOCAL_PREFILL:-128}" &
+exec python -m dynamo_tpu.frontend --hub "$HUB" --host 0.0.0.0 --port "$PORT"

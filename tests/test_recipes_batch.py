@@ -1,0 +1,72 @@
+"""Recipes (recipes/) + batch input mode (ref Input::Batch, input.rs:32):
+the smoke configs must reproduce from the recipe files alone."""
+
+import json
+import os
+import subprocess
+import sys
+import time
+import urllib.request
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+ENV = {
+    **os.environ, "PYTHONPATH": REPO, "JAX_PLATFORMS": "cpu",
+    "MODEL": "tiny-test", "PORT": "0",
+}
+
+
+def test_agg_recipe_serves():
+    p = subprocess.Popen(
+        ["bash", "recipes/llama-3-8b/agg.sh"], cwd=REPO, env=ENV,
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+    )
+    try:
+        addr, deadline = None, time.time() + 120
+        while time.time() < deadline and addr is None:
+            line = p.stdout.readline()
+            if not line:
+                raise RuntimeError(f"recipe exited rc={p.poll()}")
+            if line.startswith("DYNAMO_HTTP="):
+                addr = line.strip().split("=", 1)[1]
+        assert addr, "no DYNAMO_HTTP line"
+        req = urllib.request.Request(
+            f"http://{addr}/v1/completions",
+            data=json.dumps({
+                "model": "tiny-test", "prompt": "recipe smoke",
+                "max_tokens": 4, "ignore_eos": True,
+            }).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req, timeout=60) as r:
+            body = json.load(r)
+        assert body["usage"]["completion_tokens"] == 4
+    finally:
+        p.terminate()
+        try:
+            p.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            p.kill()
+
+
+def test_batch_input_mode(tmp_path):
+    reqs = tmp_path / "reqs.jsonl"
+    reqs.write_text(
+        "\n".join(
+            json.dumps({"prompt": f"q {i}", "max_tokens": 3,
+                        "ignore_eos": True})
+            for i in range(4)
+        )
+    )
+    out = tmp_path / "out.jsonl"
+    r = subprocess.run(
+        [sys.executable, "-m", "dynamo_tpu.cli", "run",
+         "--in", f"batch:{reqs}", "--out", "engine", "--model", "tiny-test",
+         "--output", str(out)],
+        cwd=REPO, env={**os.environ, "PYTHONPATH": REPO,
+                       "JAX_PLATFORMS": "cpu"},
+        capture_output=True, text=True, timeout=300,
+    )
+    assert r.returncode == 0, r.stdout + r.stderr
+    lines = [json.loads(ln) for ln in out.read_text().splitlines()]
+    assert [ln["index"] for ln in lines] == [0, 1, 2, 3]
+    assert all(ln["text"] for ln in lines)
