@@ -1,13 +1,16 @@
-"""Distributed tracing: W3C traceparent propagation + JSONL spans.
+"""Distributed tracing: W3C traceparent propagation + JSONL spans +
+optional OTLP/HTTP export.
 
 Role of the reference's tracing stack (lib/runtime/src/logging.rs:72-87,
 :147 — OTEL/OTLP exporter with W3C context propagation across
-HTTP->NATS->worker hops). This environment has no OTLP collector or
-opentelemetry package, so spans are emitted as structured JSONL log
-records carrying trace_id/span_id/parent — the same correlation model,
-greppable and collector-ingestable. The ``traceparent`` header follows
-https://www.w3.org/TR/trace-context/ (version 00) so external clients and
-proxies interoperate.
+HTTP->NATS->worker hops). Spans are always emitted as structured JSONL
+log records carrying trace_id/span_id/parent; when an OTLP endpoint is
+configured (``DYN_OTLP_ENDPOINT`` or ``set_otlp_endpoint``), the same
+spans also batch to ``{endpoint}/v1/traces`` as OTLP/HTTP JSON — the
+opentelemetry package is not required; the request body is built by
+hand to the OTLP spec, so any standard collector ingests it. The
+``traceparent`` header follows https://www.w3.org/TR/trace-context/
+(version 00) so external clients and proxies interoperate.
 
 Propagation: the frontend extracts/creates a traceparent per request and
 stashes it in Context.headers; the transport carries headers to workers
@@ -21,8 +24,12 @@ import contextlib
 import contextvars
 import json
 import logging
+import os
+import queue
 import secrets
+import threading
 import time
+import urllib.request
 from dataclasses import dataclass
 
 log = logging.getLogger("dynamo.trace")
@@ -102,11 +109,13 @@ def bind_trace(headers: dict[str, str] | None) -> TraceContext | None:
 
 @contextlib.contextmanager
 def span(name: str, **attrs):
-    """Timed span under the current trace, emitted as one JSONL record."""
+    """Timed span under the current trace, emitted as one JSONL record
+    (and to the OTLP exporter when configured)."""
     parent = _current.get()
     tc = parent.child() if parent else new_trace()
     token = _current.set(tc)
     t0 = time.monotonic()
+    start_ns = time.time_ns()
     error: str | None = None
     try:
         yield tc
@@ -115,14 +124,143 @@ def span(name: str, **attrs):
         raise
     finally:
         _current.reset(token)
+        dur_ms = round((time.monotonic() - t0) * 1e3, 3)
         record = {
             "span": name,
             "trace_id": tc.trace_id,
             "span_id": tc.span_id,
             "parent_span_id": parent.span_id if parent else None,
-            "duration_ms": round((time.monotonic() - t0) * 1e3, 3),
+            "duration_ms": dur_ms,
             **attrs,
         }
         if error:
             record["error"] = error
         log.info("%s", json.dumps(record))
+        exporter = _exporter()
+        if exporter is not None:
+            exporter.enqueue(
+                name, tc, parent, start_ns,
+                start_ns + int(dur_ms * 1e6), attrs, error,
+            )
+
+
+# ------------------------------------------------------------ OTLP export
+
+
+class OtlpExporter:
+    """Batching OTLP/HTTP JSON exporter (ref logging.rs otlp_exporter_
+    enabled). Spans queue from any thread; a daemon thread batches and
+    POSTs to ``{endpoint}/v1/traces``. Failures drop batches with a
+    warning — tracing must never take serving down."""
+
+    def __init__(self, endpoint: str, *, service_name: str = "dynamo-tpu",
+                 flush_interval_s: float = 1.0, max_batch: int = 256):
+        self.url = endpoint.rstrip("/") + "/v1/traces"
+        self.service_name = service_name
+        self.flush_interval_s = flush_interval_s
+        self.max_batch = max_batch
+        self._q: queue.Queue = queue.Queue(maxsize=4096)
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._run, name="otlp-export", daemon=True
+        )
+        self._thread.start()
+
+    def enqueue(self, name, tc, parent, start_ns, end_ns, attrs, error):
+        span = {
+            "traceId": tc.trace_id,
+            "spanId": tc.span_id,
+            "name": name,
+            "kind": 1,  # SPAN_KIND_INTERNAL
+            "startTimeUnixNano": str(start_ns),
+            "endTimeUnixNano": str(end_ns),
+            "attributes": [
+                {"key": k, "value": {"stringValue": str(v)}}
+                for k, v in attrs.items()
+            ],
+            "status": (
+                {"code": 2, "message": error} if error else {"code": 1}
+            ),
+        }
+        if parent is not None:
+            span["parentSpanId"] = parent.span_id
+        try:
+            self._q.put_nowait(span)
+        except queue.Full:
+            pass  # drop under backpressure
+
+    def _drain(self, timeout: float) -> list[dict]:
+        spans: list[dict] = []
+        try:
+            spans.append(self._q.get(timeout=timeout))
+            while len(spans) < self.max_batch:
+                spans.append(self._q.get_nowait())
+        except queue.Empty:
+            pass
+        return spans
+
+    def _post(self, spans: list[dict]) -> None:
+        body = json.dumps({
+            "resourceSpans": [{
+                "resource": {"attributes": [{
+                    "key": "service.name",
+                    "value": {"stringValue": self.service_name},
+                }]},
+                "scopeSpans": [{
+                    "scope": {"name": "dynamo_tpu.runtime.tracing"},
+                    "spans": spans,
+                }],
+            }]
+        }).encode()
+        req = urllib.request.Request(
+            self.url, data=body,
+            headers={"Content-Type": "application/json"},
+        )
+        urllib.request.urlopen(req, timeout=5).read()
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            spans = self._drain(self.flush_interval_s)
+            if not spans:
+                continue
+            try:
+                self._post(spans)
+            except Exception:  # noqa: BLE001
+                log.warning("OTLP export failed (%d spans dropped)",
+                            len(spans))
+
+    def flush(self, timeout: float = 5.0) -> None:
+        """Best-effort synchronous drain (tests, shutdown)."""
+        deadline = time.monotonic() + timeout
+        while not self._q.empty() and time.monotonic() < deadline:
+            time.sleep(0.02)
+        # one extra beat for the in-flight POST
+        time.sleep(0.05)
+
+    def close(self) -> None:
+        self.flush()
+        self._stop.set()
+
+
+_otlp: OtlpExporter | None = None
+_otlp_checked = False
+
+
+def set_otlp_endpoint(endpoint: str | None, **kw) -> OtlpExporter | None:
+    """Install (or clear, with None) the process-wide OTLP exporter."""
+    global _otlp, _otlp_checked
+    if _otlp is not None:
+        _otlp.close()
+    _otlp = OtlpExporter(endpoint, **kw) if endpoint else None
+    _otlp_checked = True
+    return _otlp
+
+
+def _exporter() -> OtlpExporter | None:
+    global _otlp, _otlp_checked
+    if not _otlp_checked:
+        _otlp_checked = True
+        env = (os.environ.get("DYN_OTLP_ENDPOINT") or "").strip()
+        if env:
+            _otlp = OtlpExporter(env)
+    return _otlp

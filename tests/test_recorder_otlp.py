@@ -1,0 +1,160 @@
+"""Recorder (JSONL event record/replay, ref recorder.rs:30) and OTLP
+span export (ref logging.rs:72-87)."""
+
+import asyncio
+import http.server
+import io
+import json
+import threading
+
+import numpy as np
+
+from dynamo_tpu.runtime.hub import InMemoryHub
+from dynamo_tpu.runtime.recorder import (
+    EventRecorder,
+    load_recording,
+    replay_events,
+)
+
+
+async def test_recorded_mocker_session_replays_deterministically(tmp_path):
+    """Record a mocker session's KV events; replaying the file into a
+    fresh hub rebuilds the EXACT radix state the live router held."""
+    from dynamo_tpu.kv_router.indexer import RadixTree
+    from dynamo_tpu.kv_router.protocols import KV_EVENT_SUBJECT, RouterEvent
+    from dynamo_tpu.mocker.__main__ import launch_mock_worker
+    from dynamo_tpu.mocker.engine import MockEngineConfig
+    from dynamo_tpu.runtime.context import Context
+    from dynamo_tpu.runtime.distributed import DistributedRuntime
+    from dynamo_tpu.tokens import TokenBlockSequence
+
+    drt = DistributedRuntime(InMemoryHub())
+    path = tmp_path / "session.jsonl"
+    sink = open(path, "w")
+    rec = EventRecorder(drt.hub, "kv_events.*", sink).start()
+
+    cfg = MockEngineConfig(block_size=4, total_kv_blocks=256,
+                           speedup_ratio=500.0)
+    engine, _ = await launch_mock_worker(
+        drt, "dynamo", "backend", "generate", cfg
+    )
+    rng = np.random.default_rng(0)
+    live_tree = RadixTree()
+
+    async def drive(prompt):
+        async for _ in engine.generate(
+            {"token_ids": prompt,
+             "stop_conditions": {"max_tokens": 6, "ignore_eos": True}},
+            Context(),
+        ):
+            pass
+
+    prompts = [list(rng.integers(5, 250, 16)) for _ in range(4)]
+    prompts.append(prompts[0][:12])  # shared prefix traffic
+    for pr in prompts:
+        await drive([int(t) for t in pr])
+    await asyncio.sleep(0.3)  # flush interval of the publisher
+
+    # mirror the live stream into a radix tree (what the router holds)
+    subject = KV_EVENT_SUBJECT.format(component="dynamo/backend")
+    sub = drt.hub.subscribe(subject, replay=True)
+    try:
+        while True:
+            _s, payload = await asyncio.wait_for(sub.__anext__(), 0.2)
+            ev = RouterEvent.from_dict(payload)
+            live_tree.apply_event(ev.worker_id, ev.event)
+    except (asyncio.TimeoutError, StopAsyncIteration):
+        pass
+
+    await rec.close()
+    assert rec.count > 0
+    records = load_recording(str(path))
+    assert records and all(r["subject"] == subject for r in records)
+
+    # replay into a FRESH hub -> identical radix state
+    hub2 = InMemoryHub()
+    n = await replay_events(hub2, str(path))
+    assert n == len(records)
+    replay_tree = RadixTree()
+    sub2 = hub2.subscribe(subject, replay=True)
+    try:
+        while True:
+            _s, payload = await asyncio.wait_for(sub2.__anext__(), 0.2)
+            ev = RouterEvent.from_dict(payload)
+            replay_tree.apply_event(ev.worker_id, ev.event)
+    except (asyncio.TimeoutError, StopAsyncIteration):
+        pass
+
+    assert replay_tree.snapshot() == live_tree.snapshot()
+    # and the routing-visible view agrees on a real query
+    hashes = TokenBlockSequence.from_tokens(
+        [int(t) for t in prompts[0]], 4
+    ).sequence_hashes()
+    assert (
+        replay_tree.find_matches(hashes).scores
+        == live_tree.find_matches(hashes).scores
+        != {}
+    )
+    await drt.close()
+
+
+class _Collector(http.server.BaseHTTPRequestHandler):
+    received: list[dict] = []
+
+    def do_POST(self):  # noqa: N802
+        body = self.rfile.read(int(self.headers["Content-Length"]))
+        _Collector.received.append(
+            {"path": self.path, "body": json.loads(body)}
+        )
+        self.send_response(200)
+        self.end_headers()
+        self.wfile.write(b"{}")
+
+    def log_message(self, *a):  # quiet
+        pass
+
+
+def test_otlp_spans_reach_local_collector():
+    from dynamo_tpu.runtime import tracing
+
+    _Collector.received.clear()
+    srv = http.server.HTTPServer(("127.0.0.1", 0), _Collector)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    try:
+        exporter = tracing.set_otlp_endpoint(
+            f"http://127.0.0.1:{srv.server_port}",
+            flush_interval_s=0.05,
+        )
+        with tracing.span("serve.request", model="tiny") as tc:
+            with tracing.span("engine.decode", step=1):
+                pass
+        exporter.flush()
+        # wait for the batch POST to land
+        for _ in range(100):
+            if _Collector.received:
+                break
+            import time
+
+            time.sleep(0.02)
+        assert _Collector.received, "collector saw no OTLP batch"
+        req = _Collector.received[0]
+        assert req["path"] == "/v1/traces"
+        rs = req["body"]["resourceSpans"][0]
+        svc = rs["resource"]["attributes"][0]
+        assert svc["key"] == "service.name"
+        spans = rs["scopeSpans"][0]["spans"]
+        by_name = {s["name"]: s for s in spans}
+        assert set(by_name) == {"serve.request", "engine.decode"}
+        parent = by_name["serve.request"]
+        child = by_name["engine.decode"]
+        assert parent["traceId"] == child["traceId"] == tc.trace_id
+        assert child["parentSpanId"] == parent["spanId"]
+        assert int(child["endTimeUnixNano"]) >= int(
+            child["startTimeUnixNano"]
+        )
+        assert {"key": "model", "value": {"stringValue": "tiny"}} in (
+            parent["attributes"]
+        )
+    finally:
+        tracing.set_otlp_endpoint(None)
+        srv.shutdown()
