@@ -63,6 +63,8 @@ class HttpFrontend:
                 web.get("/live", self.health),
                 web.get("/ready", self.health),
                 web.get("/metrics", self.prometheus),
+                web.get("/openapi.json", self.openapi),
+                web.get("/docs", self.docs),
             ]
         )
         m = self.metrics
@@ -138,6 +140,75 @@ class HttpFrontend:
         return Context(request_id=new_request_id(), headers=headers)
 
     # -- routes ------------------------------------------------------------
+
+    async def openapi(self, request) -> "web.Response":
+        """OpenAPI 3 description of the served surface (ref http/service/
+        openapi_docs.rs). Models list reflects live discovery."""
+        models = sorted(self.manager.names())
+        def op(summary, tag, stream=False, method="post"):
+            body = {
+                "summary": summary,
+                "tags": [tag],
+                "responses": {"200": {"description": "OK"}},
+            }
+            if method == "post":
+                body["requestBody"] = {
+                    "content": {"application/json": {"schema": {
+                        "type": "object",
+                        "properties": {"model": {
+                            "type": "string", "enum": models or None,
+                        }},
+                    }}}
+                }
+            if stream:
+                body["responses"]["200"]["description"] = (
+                    "OK (SSE stream when request sets stream=true)"
+                )
+            return {method: body}
+
+        spec = {
+            "openapi": "3.0.3",
+            "info": {
+                "title": "dynamo-tpu OpenAI-compatible frontend",
+                "version": "0.3.0",
+            },
+            "paths": {
+                "/v1/chat/completions": op(
+                    "Chat completion", "openai", stream=True),
+                "/v1/completions": op("Text completion", "openai",
+                                      stream=True),
+                "/v1/responses": op("Responses API", "openai"),
+                "/v1/embeddings": op("Embeddings", "openai"),
+                "/v1/models": op("Discovered models", "openai",
+                                 method="get"),
+                "/clear_kv_blocks": op("Evict inactive prefix-cache pages "
+                                       "on every worker", "admin"),
+                "/health": op("Liveness", "ops", method="get"),
+                "/metrics": op("Prometheus exposition", "ops", method="get"),
+            },
+        }
+        return web.json_response(spec)
+
+    async def docs(self, request) -> "web.Response":
+        """Minimal human-readable API index (no JS bundle dependencies)."""
+        spec = await self.openapi(request)
+        import json as _json
+
+        paths = _json.loads(spec.text)["paths"]
+        rows = "".join(
+            f"<tr><td><code>{next(iter(ops)).upper()}</code></td>"
+            f"<td><code>{path}</code></td>"
+            f"<td>{next(iter(ops.values()))['summary']}</td></tr>"
+            for path, ops in paths.items()
+        )
+        html = (
+            "<html><head><title>dynamo-tpu API</title></head><body>"
+            "<h1>dynamo-tpu OpenAI-compatible frontend</h1>"
+            "<p>Machine-readable spec: <a href='/openapi.json'>"
+            "/openapi.json</a></p>"
+            f"<table border=1 cellpadding=6>{rows}</table></body></html>"
+        )
+        return web.Response(text=html, content_type="text/html")
 
     async def chat_completions(self, request: web.Request) -> web.StreamResponse:
         return await self._completions_common(request, chat=True)

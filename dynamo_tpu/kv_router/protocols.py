@@ -108,6 +108,9 @@ class ForwardPassMetrics:
     waiting_requests: int = 0
     running_requests: int = 0
     prefill_tokens_queued: int = 0
+    # cumulative MoE capacity-dropped expert slots (quality signal; 0 for
+    # dense models — see models/moe.py capacity semantics)
+    moe_dropped_slots: int = 0
     data_parallel_rank: int = 0
 
     @property

@@ -185,6 +185,15 @@ def _ffn(spec: ModelSpec, lp: Params, x: jax.Array) -> jax.Array:
     return _mlp(lp, x)
 
 
+def _ffn_counted(spec: ModelSpec, lp: Params, x: jax.Array):
+    """_ffn + dropped-slot count (0 for dense layers)."""
+    if spec.num_experts:
+        from dynamo_tpu.models import moe
+
+        return moe.moe_mlp(spec, lp["moe"], x, return_dropped=True)
+    return _mlp(lp, x), jnp.zeros((), jnp.int32)
+
+
 def _logits(spec: ModelSpec, params: Params, x: jax.Array) -> jax.Array:
     x = rms_norm(x, params["final_norm"], spec.rms_eps)
     head = params["embed"].T if spec.tie_embeddings else params["lm_head"]
@@ -236,6 +245,7 @@ def prefill_forward_impl(
 
     x = params["embed"][tokens]  # [T, d]
     kv_len = start_pos + num_tokens
+    moe_dropped = jnp.zeros((), jnp.int32)
 
     for li, lp in enumerate(params["layers"]):
         h = rms_norm(x, lp["attn_norm"], spec.rms_eps)
@@ -248,12 +258,14 @@ def prefill_forward_impl(
         attn = attn.reshape(T, spec.num_heads * spec.head_dim)
         x = x + attn @ lp["wo"]
         h = rms_norm(x, lp["mlp_norm"], spec.rms_eps)
-        x = x + _ffn(spec, lp, h)
+        f, d = _ffn_counted(spec, lp, h)
+        x = x + f
+        moe_dropped = moe_dropped + d
 
     last = jnp.clip(num_tokens - 1, 0, T - 1)
     logits = _logits(spec, params, x[last])  # [V]
     logits = _replicate(logits, mesh)
-    return logits, k_pages, v_pages
+    return logits, k_pages, v_pages, _replicate(moe_dropped, mesh)
 
 
 def _replicate(x: jax.Array, mesh: Mesh | None) -> jax.Array:
@@ -311,6 +323,7 @@ def prefill_forward_ring_impl(
     x = params["embed"][tokens]
     x = jax.lax.with_sharding_constraint(x, sp_spec)
 
+    moe_dropped = jnp.zeros((), jnp.int32)
     for li, lp in enumerate(params["layers"]):
         h = rms_norm(x, lp["attn_norm"], spec.rms_eps)
         q, k, v = _attn_qkv(spec, lp, h, idx)
@@ -319,13 +332,15 @@ def prefill_forward_ring_impl(
         attn = ring_attention(q, k, v, mesh=mesh)
         x = x + attn.reshape(T, spec.num_heads * spec.head_dim) @ lp["wo"]
         h = rms_norm(x, lp["mlp_norm"], spec.rms_eps)
-        x = x + _ffn(spec, lp, h)
+        f, d = _ffn_counted(spec, lp, h)
+        x = x + f
+        moe_dropped = moe_dropped + d
         x = jax.lax.with_sharding_constraint(x, sp_spec)
 
     last = jnp.clip(num_tokens - 1, 0, T - 1)
     logits = _logits(spec, params, x[last])
     logits = _replicate(logits, mesh)
-    return logits, k_pages, v_pages
+    return logits, k_pages, v_pages, _replicate(moe_dropped, mesh)
 
 
 prefill_forward_ring = jax.jit(

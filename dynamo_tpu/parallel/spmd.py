@@ -117,14 +117,15 @@ class SpmdFollower:
             # every branch matches one leader dispatch site in
             # engine/core.py; keep in lockstep with it
             if op == "prefill":
-                _logits, eng.k_pages, eng.v_pages = llama.prefill_forward(
+                _logits, eng.k_pages, eng.v_pages, _d = llama.prefill_forward(
                     spec, eng.params,
                     jnp_i32(ar["tokens"]), jnp_i32(ar["block_table"]),
                     jnp_scalar(sc["start"]), eng.k_pages, eng.v_pages,
                     jnp_scalar(sc["num_tokens"]), mesh=mesh,
                 )
             elif op == "ring_prefill":
-                _logits, eng.k_pages, eng.v_pages = llama.prefill_forward_ring(
+                (_logits, eng.k_pages, eng.v_pages,
+                 _d) = llama.prefill_forward_ring(
                     spec, eng.params,
                     jnp_i32(ar["tokens"]), jnp_i32(ar["block_table"]),
                     eng.k_pages, eng.v_pages,

@@ -53,7 +53,7 @@ def test_prefill_matches_reference_forward():
     block_table = np.zeros((cfg.max_pages_per_seq,), np.int32)
     block_table[:2] = [1, 2]  # 7 tokens -> 2 pages of 4
 
-    logits, k_pages, v_pages = llama.prefill_forward(
+    logits, k_pages, v_pages, _d = llama.prefill_forward(
         SPEC, params, jnp.asarray(padded), jnp.asarray(block_table),
         jnp.asarray(0, jnp.int32), k_pages, v_pages,
         jnp.asarray(len(tokens), jnp.int32),
@@ -79,7 +79,7 @@ def test_decode_matches_reference_forward():
     padded[: len(tokens)] = tokens
     block_table = np.zeros((cfg.max_pages_per_seq,), np.int32)
     block_table[:2] = [1, 2]
-    _, k_pages, v_pages = llama.prefill_forward(
+    _, k_pages, v_pages, _d = llama.prefill_forward(
         SPEC, params, jnp.asarray(padded), jnp.asarray(block_table),
         jnp.asarray(0, jnp.int32), k_pages, v_pages,
         jnp.asarray(len(tokens), jnp.int32),
@@ -312,7 +312,7 @@ def test_tp_sharded_prefill_matches_single_device():
     padded[: len(tokens)] = tokens
     block_table = np.zeros((cfg.max_pages_per_seq,), np.int32)
     block_table[:2] = [1, 2]
-    logits, _, _ = llama.prefill_forward(
+    logits, _, _, _d = llama.prefill_forward(
         SPEC, params_sharded, jnp.asarray(padded), jnp.asarray(block_table),
         jnp.asarray(0, jnp.int32), k_pages, v_pages,
         jnp.asarray(len(tokens), jnp.int32),

@@ -198,3 +198,30 @@ async def test_logprobs_surface():
         await watcher.close()
         await engine.close()
         await drt.close()
+
+
+async def test_openapi_and_docs_routes():
+    """GET /openapi.json (machine-readable surface, ref openapi_docs.rs)
+    and /docs (human index) on a live frontend."""
+    import aiohttp
+
+    from dynamo_tpu.frontend.http import HttpFrontend
+    from dynamo_tpu.frontend.watcher import ModelManager
+
+    frontend = HttpFrontend(ModelManager(), host="127.0.0.1", port=0)
+    await frontend.start()
+    base = f"http://127.0.0.1:{frontend.port}"
+    try:
+        async with aiohttp.ClientSession() as sess:
+            async with sess.get(f"{base}/openapi.json") as r:
+                assert r.status == 200
+                spec = await r.json()
+            assert spec["openapi"].startswith("3.")
+            assert "/v1/chat/completions" in spec["paths"]
+            assert "post" in spec["paths"]["/v1/chat/completions"]
+            async with sess.get(f"{base}/docs") as r:
+                assert r.status == 200
+                html = await r.text()
+            assert "/openapi.json" in html and "/v1/completions" in html
+    finally:
+        await frontend.stop()

@@ -59,7 +59,7 @@ def test_pp_prefill_matches_reference():
 
     # KV pages written by the pipeline == the plain paged path's
     k2, v2 = llama.init_cache(SPEC, 16, PAGE)
-    _, k2, v2 = llama.prefill_forward(
+    _, k2, v2, _d = llama.prefill_forward(
         SPEC, params, tokens, bt, jnp.asarray(0, jnp.int32), k2, v2,
         jnp.asarray(T, jnp.int32),
     )
