@@ -582,16 +582,18 @@ class InferenceEngine:
         if onboard:
             idxs = range(len(cached), len(cached) + len(onboard))
             try:
-                page_ids = jnp.asarray(
-                    np.asarray([sp.pages[i] for i in idxs], np.int32)
+                page_ids = np.asarray(
+                    [sp.pages[i] for i in idxs], np.int32
                 )
-                # tier blocks are [L, KH, page, D]; insert_kv_pages wants the
-                # n stacked pages on axis 1: [L, n, KH, page, D] (page-major)
-                self.k_pages, self.v_pages = llama.insert_kv_pages(
-                    self.k_pages, self.v_pages, page_ids,
-                    jnp.asarray(np.stack([b[0] for b in onboard], axis=1)),
-                    jnp.asarray(np.stack([b[1] for b in onboard], axis=1)),
-                )
+                hs = [hashes[i] for i in idxs]
+                if self.spmd is not None:
+                    # every process of the logical worker installs its own
+                    # shard of these blocks (ref KvbmLeader coordinating
+                    # workers, distributed/leader.rs:126)
+                    self.spmd.publish(
+                        "kv_onboard", {"hashes": hs}, {"page_ids": page_ids}
+                    )
+                self.onboard_from_tiers(hs, page_ids, blocks=onboard)
             except Exception:
                 self.allocator.release(sp.pages)
                 raise
@@ -624,6 +626,68 @@ class InferenceEngine:
             if offload:
                 self._queue_offload(blk.sequence_hash, sp.pages[i], i)
 
+    def onboard_from_tiers(
+        self, hashes: list[int], page_ids: np.ndarray, blocks=None
+    ) -> None:
+        """Install tier-cached blocks into device pages. On a multi-host
+        worker each process holds (and installs) only ITS SHARD; the
+        global block array assembles from process-local data so the one
+        jitted insert runs identically everywhere. A follower tier miss
+        zero-fills that shard LOUDLY — tiers are deterministic mirrors of
+        the same offload stream, so a miss means lost state (e.g. a
+        restarted follower), and hanging the slice would be worse."""
+        if blocks is None:
+            blocks = []
+            for h in hashes:
+                b = self.kvbm.get(h) if self.kvbm is not None else None
+                if b is None:
+                    log.error(
+                        "kvbm onboard MISS for %x: zero-filling this "
+                        "process's shard", h,
+                    )
+                blocks.append(b)
+            if all(b is None for b in blocks):
+                template = None
+            else:
+                template = next(b for b in blocks if b is not None)
+            if template is None:
+                shard = (
+                    self.k_pages.addressable_shards[0].data
+                    if not getattr(self.k_pages, "is_fully_addressable", True)
+                    else self.k_pages
+                )
+                zshape = (shard.shape[0], shard.shape[2], shard.shape[3],
+                          shard.shape[4])
+                template = (
+                    np.zeros(zshape, np.dtype(self.spec.dtype)),
+                ) * 2
+            blocks = [
+                b if b is not None else (np.zeros_like(np.asarray(template[0])),
+                                         np.zeros_like(np.asarray(template[1])))
+                for b in blocks
+            ]
+        log.info("kvbm onboard n=%d pages=%s", len(blocks),
+                 page_ids[: 4].tolist())
+        # tier blocks are [L, KH(local), page, D]; insert wants the n
+        # stacked pages on axis 1: [L, n, KH, page, D] (page-major)
+        k_stack = np.stack([np.asarray(b[0]) for b in blocks], axis=1)
+        v_stack = np.stack([np.asarray(b[1]) for b in blocks], axis=1)
+        if self.k_pages is not None and not getattr(
+            self.k_pages, "is_fully_addressable", True
+        ):
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            sharding = NamedSharding(
+                self.mesh, P(None, None, "tp", None, None)
+            )
+            kb = jax.make_array_from_process_local_data(sharding, k_stack)
+            vb = jax.make_array_from_process_local_data(sharding, v_stack)
+        else:
+            kb, vb = jnp.asarray(k_stack), jnp.asarray(v_stack)
+        self.k_pages, self.v_pages = llama.insert_kv_pages(
+            self.k_pages, self.v_pages, jnp.asarray(page_ids), kb, vb
+        )
+
     # -- KVBM offload (device -> host tiers) -------------------------------
 
     def _queue_offload(self, sh: int, page: int, block_index: int) -> None:
@@ -648,6 +712,13 @@ class InferenceEngine:
             bucket *= 2
         ids = np.zeros((bucket,), np.int32)  # pad with trash page 0
         ids[:n] = [p for _s, p, _i in batch]
+        if self.spmd is not None:
+            # followers extract the same pages and offload THEIR shards
+            self.spmd.publish(
+                "kv_offload",
+                {"hashes": [s for s, _p, _i in batch]},
+                {"page_ids": ids},
+            )
         kb, vb = llama.extract_kv_pages(self.k_pages, self.v_pages, jnp.asarray(ids))
         try:
             kb.copy_to_host_async()

@@ -100,9 +100,14 @@ async def launch_engine_worker(
 
         from dynamo_tpu.kvbm import KvBlockManager
 
+        import jax as _jax
+
+        kvbm_ns = namespace
+        if _jax.process_count() > 1:
+            kvbm_ns = f"{namespace}.s{_jax.process_index()}"
         kvbm = KvBlockManager(
             kvbm_config, hub=drt.hub, loop=_aio.get_running_loop(),
-            namespace=namespace,
+            namespace=kvbm_ns,
         )
 
     engine = InferenceEngine(
@@ -168,6 +173,10 @@ async def launch_engine_worker(
                 "active_pages": engine.allocator.active_pages,
                 "cached_pages": engine.allocator.evictable_pages,
                 "free_pages": engine.allocator.free_pages,
+                "kvbm": (
+                    engine.kvbm.stats.to_dict()
+                    if engine.kvbm is not None else None
+                ),
             }
         else:
             yield {"ok": False, "error": f"unknown op {request.get('op')!r}"}
@@ -232,10 +241,15 @@ def _has_tokenizer_files(model_path: str) -> bool:
     )
 
 
-def _build_engine_shell(args: argparse.Namespace, ecfg: EngineConfig):
+def _build_engine_shell(args: argparse.Namespace, ecfg: EngineConfig, hub=None):
     """Follower-side engine: identical spec/config/mesh/params to the
     leader's (deterministic init), but its step loop never starts — the
-    SPMD replay drives the jitted entry points directly."""
+    SPMD replay drives the jitted entry points directly. With KVBM
+    enabled the follower holds its OWN tier pools: the replayed
+    kv_offload/kv_onboard ops move this process's shard of every block
+    (ref KvbmWorker, block_manager/distributed/worker.rs)."""
+    import asyncio as _aio
+
     mesh = None
     if ecfg.tp > 1 or ecfg.dp > 1 or ecfg.sp > 1 or ecfg.ep > 1:
         from dynamo_tpu.parallel.mesh import make_mesh
@@ -248,7 +262,20 @@ def _build_engine_shell(args: argparse.Namespace, ecfg: EngineConfig):
         spec, params = load_model_dir(args.model_path, mesh=mesh)
     else:
         spec = ModelSpec.preset(args.model)
-    return InferenceEngine(spec, ecfg, mesh=mesh, params=params)
+    kvbm = None
+    kvbm_cfg = _kvbm_config_from_args(args)
+    if kvbm_cfg is not None:
+        import jax as _jax
+
+        from dynamo_tpu.kvbm import KvBlockManager
+
+        kvbm = KvBlockManager(
+            kvbm_cfg, hub=hub, loop=_aio.get_event_loop() if hub else None,
+            # per-shard G4 namespace: each process's remote blocks are its
+            # own shard, keyed apart
+            namespace=f"{args.namespace}.s{_jax.process_index()}",
+        )
+    return InferenceEngine(spec, ecfg, mesh=mesh, params=params, kvbm=kvbm)
 
 
 def _kvbm_config_from_args(args: argparse.Namespace):
@@ -281,11 +308,10 @@ async def _amain(args: argparse.Namespace) -> None:
         args.coordinator_address, args.num_processes, args.process_id
     )
     if multihost:
-        if args.mode != "aggregated" or args.kvbm_host_mb > 0:
+        if args.mode != "aggregated":
             raise SystemExit(
-                "multi-host workers support aggregated mode without KVBM "
-                "(disagg export / tier offload are not in the follower "
-                "replay protocol yet)"
+                "multi-host workers support aggregated mode (disagg "
+                "export is not in the follower replay protocol yet)"
             )
         if ecfg.tp * ecfg.dp * ecfg.sp * ecfg.ep <= 1:
             raise SystemExit(
@@ -306,7 +332,7 @@ async def _amain(args: argparse.Namespace) -> None:
             if args.hub:
                 rcfg.hub_address = args.hub
             hub = await connect_hub(rcfg.hub_address)
-            engine = _build_engine_shell(args, ecfg)
+            engine = _build_engine_shell(args, ecfg, hub=hub)
             print("MULTIHOST_FOLLOWER_READY", flush=True)
             await SpmdFollower(hub, group, engine).run()
             return

@@ -21,6 +21,39 @@ log = logging.getLogger("dynamo.kvbm.offload")
 _STOP = object()
 
 
+def to_local_np(arr) -> np.ndarray:
+    """This process's host view of a (possibly multi-process) device array.
+
+    Fully-addressable arrays convert whole. For arrays sharded across
+    processes (one logical worker spanning hosts), each process holds
+    ONLY its tile — concatenate the addressable shards along their tiled
+    axis, so each process's KVBM tier stores exactly its shard of every
+    block (ref KvbmLeader/Worker: workers move their own shards,
+    block_manager/distributed/worker.rs)."""
+    if getattr(arr, "is_fully_addressable", True):
+        return np.asarray(arr)
+    shards = {}
+    axis = None
+    for sh in arr.addressable_shards:
+        nontrivial = [
+            d for d, sl in enumerate(sh.index)
+            if not ((sl.start in (0, None))
+                    and (sl.stop is None or sl.stop == arr.shape[d]))
+        ]
+        if len(nontrivial) != 1:
+            raise ValueError(
+                f"unsupported shard tiling for offload: {sh.index}"
+            )
+        a = nontrivial[0]
+        if axis is None:
+            axis = a
+        elif axis != a:
+            raise ValueError("multi-axis sharding not offloadable")
+        shards.setdefault(sh.index[a].start or 0, sh.data)
+    parts = [np.asarray(p) for _s, p in sorted(shards.items())]
+    return np.concatenate(parts, axis=axis)
+
+
 class OffloadEngine:
     def __init__(self, manager, *, max_queue: int = 64):
         self.manager = manager
@@ -65,8 +98,8 @@ class OffloadEngine:
                 hashes.set()
                 continue
             try:
-                # np.asarray blocks until the async device->host copy lands
-                k_np, v_np = np.asarray(kb), np.asarray(vb)
+                # to_local_np blocks until the async device->host copy lands
+                k_np, v_np = to_local_np(kb), to_local_np(vb)
                 for i, sh in enumerate(hashes):
                     self.manager.offer(sh, k_np[:, i], v_np[:, i])
             except Exception:  # noqa: BLE001

@@ -131,6 +131,26 @@ class SpmdFollower:
                     eng.k_pages, eng.v_pages,
                     jnp_scalar(sc["num_tokens"]), mesh=mesh,
                 )
+            elif op == "kv_offload":
+                # mirror the leader's tier offload: extract the SAME pages
+                # (this process keeps its shard) and offer them to the
+                # local KVBM tiers (ref KvbmWorker, distributed/worker.rs)
+                ids = jnp_i32(ar["page_ids"])
+                kb, vb = llama.extract_kv_pages(eng.k_pages, eng.v_pages, ids)
+                try:
+                    kb.copy_to_host_async()
+                    vb.copy_to_host_async()
+                except AttributeError:
+                    pass
+                if eng.offload is not None:
+                    eng.offload.submit(
+                        [int(h) for h in sc["hashes"]], kb, vb
+                    )
+            elif op == "kv_onboard":
+                eng.onboard_from_tiers(
+                    [int(h) for h in sc["hashes"]],
+                    ar["page_ids"].astype(np.int32),
+                )
             elif op == "decode":
                 import jax.numpy as jnp
 

@@ -152,3 +152,111 @@ def test_two_process_worker_serves_through_frontend():
                 p.wait(timeout=10)
             except subprocess.TimeoutExpired:
                 p.kill()
+
+
+def test_two_process_worker_kvbm_offload_onboard():
+    """Distributed KVBM (ref KvbmLeader/Worker): a 2-process tp=2 worker
+    offloads each process's SHARD of sealed blocks to its own host tier;
+    after the device prefix cache is cleared, re-serving the same prompt
+    onboards the shards on BOTH processes — greedy output must be
+    identical, proving the reassembled KV content is right (zero-filled
+    or missing shards would change the logits)."""
+    import asyncio
+
+    procs: list[subprocess.Popen] = []
+    try:
+        _hub_p, hub_addr = _spawn(
+            ["-m", "dynamo_tpu.runtime.hub_server", "--port", "0"],
+            "DYNAMO_HUB=", procs,
+            env={**os.environ, "PYTHONPATH": REPO},
+        )
+        coord = f"127.0.0.1:{_free_port()}"
+        worker_args = [
+            "-m", "dynamo_tpu.engine.worker", "--hub", hub_addr,
+            "--model", "tiny-test", "--tp", "2",
+            "--page-size", "4", "--num-pages", "64",
+            "--max-pages-per-seq", "8", "--max-decode-slots", "2",
+            "--kvbm-host-mb", "16",
+            "--coordinator-address", coord, "--num-processes", "2",
+        ]
+        follower = subprocess.Popen(
+            [sys.executable, *worker_args, "--process-id", "1"],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+            cwd=REPO, env=_env(),
+        )
+        procs.append(follower)
+        _leader_p, _ = _spawn(
+            [*worker_args, "--process-id", "0"], "ENGINE_READY", procs,
+        )
+        _frontend_p, http_addr = _spawn(
+            ["-m", "dynamo_tpu.frontend", "--hub", hub_addr,
+             "--host", "127.0.0.1", "--port", "0"],
+            "DYNAMO_HTTP=", procs,
+            env={**os.environ, "PYTHONPATH": REPO},
+        )
+        base = f"http://{http_addr}"
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            with urllib.request.urlopen(f"{base}/v1/models", timeout=5) as r:
+                if json.load(r)["data"]:
+                    break
+            time.sleep(0.2)
+
+        def complete():
+            req = urllib.request.Request(
+                f"{base}/v1/completions",
+                data=json.dumps({
+                    "model": "tiny-test",
+                    "prompt": "kvbm onboard prefix",
+                    "max_tokens": 6, "temperature": 0.0, "ignore_eos": True,
+                }).encode(),
+                headers={"Content-Type": "application/json"},
+            )
+            with urllib.request.urlopen(req, timeout=90) as r:
+                return json.load(r)["choices"][0]["text"]
+
+        first = complete()
+        time.sleep(1.5)  # let the offload thread offer sealed blocks
+
+        # drop every inactive device page -> next admission must onboard
+        req = urllib.request.Request(
+            f"{base}/clear_kv_blocks", data=b"{}",
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req, timeout=30) as r:
+            assert r.status == 200
+        time.sleep(0.5)
+
+        second = complete()
+        assert second == first
+
+        # the leader really onboarded from a tier (not recompute-only)
+        from dynamo_tpu.runtime.hub_client import RemoteHub
+        from dynamo_tpu.runtime.distributed import DistributedRuntime
+
+        async def stats():
+            drt = DistributedRuntime(await RemoteHub.connect(hub_addr))
+            try:
+                client = (drt.namespace("dynamo").component("backend")
+                          .endpoint("admin").client())
+                await client.start()
+                inst = (await client.wait_for_instances(1, timeout=10))[0]
+                from dynamo_tpu.runtime.context import Context
+
+                async for item in client.call_instance(
+                    inst.instance_id, {"op": "cache_status"}, Context()
+                ):
+                    return item
+            finally:
+                await drt.close()
+
+        st = asyncio.run(stats())
+        assert st["kvbm"]["onboard_hits_host"] > 0, st
+    finally:
+        for p in procs:
+            p.terminate()
+        for p in procs:
+            try:
+                p.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                p.kill()
