@@ -109,3 +109,8 @@ class Backend:
             yield out
             if out.get("finish_reason") is not None:
                 return
+
+
+def make_operator(sink, *, tokenizer) -> "Backend":
+    """Operator-registry factory (runtime/pipeline.py): sink-first form."""
+    return Backend(tokenizer, sink)

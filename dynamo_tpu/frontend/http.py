@@ -42,6 +42,7 @@ class HttpFrontend:
         port: int = 8000,
         metrics: MetricsRegistry | None = None,
         drt=None,  # DistributedRuntime: enables admin routes
+        audit=None,  # AuditBus (default: env-configured, see runtime/audit)
     ):
         self.manager = manager
         self.host = host
@@ -51,6 +52,9 @@ class HttpFrontend:
         self._compute = ComputePool()
         self._runner: web.AppRunner | None = None
         self.app = web.Application()
+        from dynamo_tpu.runtime.audit import AuditBus
+
+        self.audit = audit if audit is not None else AuditBus()
         self.app.add_routes(
             [
                 web.post("/v1/chat/completions", self.chat_completions),
@@ -266,6 +270,7 @@ class HttpFrontend:
                 resp = await self._sse(request, pp, ctx)
                 self._m_requests.labels(model, route, "200").inc()
                 self._mark_completed(model, prompt_tokens)
+                self._audit(route, model, ctx, body, 200, t_start)
                 return resp
             else:
                 agg = (
@@ -280,15 +285,44 @@ class HttpFrontend:
                 )
                 self._m_requests.labels(model, route, "200").inc()
                 self._mark_completed(model, prompt_tokens)
+                self._audit(
+                    route, model, ctx, body, 200, t_start,
+                    finish_reason=(agg.get("choices") or [{}])[0].get(
+                        "finish_reason"
+                    ),
+                    output_tokens=(agg.get("usage") or {}).get(
+                        "completion_tokens", 0
+                    ),
+                )
                 return web.json_response(agg)
         except Exception as e:  # noqa: BLE001
             log.exception("request %s failed", ctx.id)
             ctx.stop_generating()
             self._m_requests.labels(model, route, "500").inc()
+            self._audit(route, model, ctx, body, 500, t_start, error=str(e))
             return _error(500, f"internal error: {e}")
         finally:
             self._m_inflight.labels(model).dec()
             self._m_duration.labels(model).observe(time.monotonic() - t_start)
+
+    def _audit(
+        self, route: str, model: str, ctx, body: dict, status: int,
+        t_start: float, *, finish_reason=None, output_tokens: int = 0,
+        error: str | None = None,
+    ) -> None:
+        """Emit one audit record AFTER the response completes (ref
+        lib/llm/src/audit/: bus + sinks off the request path)."""
+        if not self.audit.enabled:
+            return
+        from dynamo_tpu.runtime.audit import AuditRecord
+
+        self.audit.emit(AuditRecord.make(
+            route=route, model=model, request_id=ctx.id, request=body,
+            status=status, finish_reason=finish_reason,
+            output_tokens=output_tokens,
+            duration_ms=(time.monotonic() - t_start) * 1e3,
+            error=error,
+        ))
 
     def _mark_completed(self, model: str, prompt_tokens: int) -> None:
         """ISL/OSL averages for the SLA planner: counted only when the

@@ -67,3 +67,8 @@ class Migration:
                 # fresh child context: the old request id may be poisoned on
                 # the dead worker's peers
                 context = context.child(f"{context.id}-m{self.migration_limit - attempts_left}")
+
+
+def make_operator(sink, **kwargs) -> "Migration":
+    """Operator-registry factory (runtime/pipeline.py): sink-first form."""
+    return Migration(sink, **kwargs)

@@ -103,8 +103,21 @@ async def build_pipeline(
             push, kv_router, salt=card.runtime_config.get("kv_salt")
         )
 
-    migration = Migration(router_engine, migration_limit=card.migration_limit)
-    backend = Backend(tokenizer, migration)
+    # chains are data through the generic operator registry (ref
+    # pipeline/nodes.rs + registry.rs): cards may splice extra operators
+    # via runtime_config["operators"] (name or [name, kwargs] entries)
+    # between the backend and the router
+    from dynamo_tpu.runtime.pipeline import build_chain
+
+    extra = list(card.runtime_config.get("operators") or [])
+    backend = build_chain(
+        [
+            ("backend", {"tokenizer": tokenizer}),
+            *extra,
+            ("migration", {"migration_limit": card.migration_limit}),
+        ],
+        router_engine,
+    )
     preprocessor = OpenAIPreprocessor(
         tokenizer,
         model_name=card.name,

@@ -35,6 +35,7 @@ async def launch_mock_worker(
     model_type: str = "chat",
     tool_call_parser: str | None = None,
     reasoning_parser: str | None = None,
+    runtime_config: dict | None = None,
 ) -> tuple[MockEngine, object]:
     """Serve one mock worker; returns (engine, served_handle)."""
     engine = MockEngine(config)
@@ -51,6 +52,7 @@ async def launch_mock_worker(
             router_mode=router_mode,
             tool_call_parser=tool_call_parser,
             reasoning_parser=reasoning_parser,
+            runtime_config=runtime_config,
             metadata={"engine": "mocker", "dp_rank": config.data_parallel_rank},
         )
     else:

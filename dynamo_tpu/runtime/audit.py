@@ -1,0 +1,127 @@
+"""Request/response audit bus (ref lib/llm/src/audit/ — bus + sinks).
+
+Every completed request on the serving surface can emit one audit
+record — who asked for what, what came back, how long it took — to
+pluggable sinks. Records are emitted AFTER the response finishes (audit
+must never sit on the request path); a slow sink drops records rather
+than applying backpressure.
+
+Sinks: JSONL file (greppable, the recorder's format family) and hub
+subject (retained, so an auditor can attach late). ``DYN_AUDIT_PATH``
+env enables the file sink process-wide.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+import os
+import time
+from typing import Any
+
+log = logging.getLogger("dynamo.audit")
+
+AUDIT_SUBJECT = "audit/{namespace}/requests"
+
+
+class AuditRecord(dict):
+    """One request's audit entry (a dict; keys stay wire-stable)."""
+
+    @classmethod
+    def make(
+        cls,
+        *,
+        route: str,
+        model: str | None,
+        request_id: str,
+        request: dict[str, Any],
+        status: int,
+        finish_reason: str | None = None,
+        output_tokens: int = 0,
+        duration_ms: float = 0.0,
+        error: str | None = None,
+    ) -> "AuditRecord":
+        rec = cls(
+            ts=time.time(),
+            route=route,
+            model=model,
+            request_id=request_id,
+            status=status,
+            finish_reason=finish_reason,
+            output_tokens=output_tokens,
+            duration_ms=round(duration_ms, 3),
+            # request essentials only: prompts can be huge and sensitive;
+            # sinks get sizes + sampling knobs, not content (the reference
+            # gates content capture the same way)
+            request={
+                "messages_count": len(request.get("messages") or []),
+                "prompt_chars": len(str(request.get("prompt") or "")),
+                "max_tokens": request.get("max_tokens"),
+                "temperature": request.get("temperature"),
+                "stream": bool(request.get("stream")),
+                "tools": len(request.get("tools") or []),
+            },
+        )
+        if error:
+            rec["error"] = error
+        return rec
+
+
+class JsonlSink:
+    def __init__(self, path: str):
+        self._f = open(path, "a")
+
+    def emit(self, rec: AuditRecord) -> None:
+        self._f.write(json.dumps(rec) + "\n")
+        self._f.flush()
+
+    def close(self) -> None:
+        self._f.close()
+
+
+class HubSink:
+    """Publish to a retained hub subject (fire-and-forget)."""
+
+    def __init__(self, hub, namespace: str = "dynamo"):
+        self.hub = hub
+        self.subject = AUDIT_SUBJECT.format(namespace=namespace)
+
+    def emit(self, rec: AuditRecord) -> None:
+        asyncio.ensure_future(self.hub.publish(self.subject, dict(rec)))
+
+    def close(self) -> None:
+        pass
+
+
+class AuditBus:
+    def __init__(self) -> None:
+        self.sinks: list = []
+        self.emitted = 0
+        path = (os.environ.get("DYN_AUDIT_PATH") or "").strip()
+        if path:
+            self.sinks.append(JsonlSink(path))
+
+    @property
+    def enabled(self) -> bool:
+        return bool(self.sinks)
+
+    def add_sink(self, sink) -> "AuditBus":
+        self.sinks.append(sink)
+        return self
+
+    def emit(self, rec: AuditRecord) -> None:
+        for sink in self.sinks:
+            try:
+                sink.emit(rec)
+            except Exception:  # noqa: BLE001
+                log.warning("audit sink failed (record dropped)",
+                            exc_info=True)
+        self.emitted += 1
+
+    def close(self) -> None:
+        for sink in self.sinks:
+            try:
+                sink.close()
+            except Exception:  # noqa: BLE001
+                pass

@@ -225,3 +225,61 @@ async def test_openapi_and_docs_routes():
             assert "/openapi.json" in html and "/v1/completions" in html
     finally:
         await frontend.stop()
+
+
+async def test_audit_bus_records_requests(tmp_path):
+    """Audit records (ref lib/llm/src/audit/) land in the JSONL sink for
+    aggregated, streamed, and failed requests — sizes/knobs only, never
+    prompt content."""
+    import aiohttp
+
+    from dynamo_tpu.frontend.http import HttpFrontend
+    from dynamo_tpu.frontend.watcher import ModelManager, ModelWatcher
+    from dynamo_tpu.mocker.__main__ import launch_mock_worker
+    from dynamo_tpu.mocker.engine import MockEngineConfig
+    from dynamo_tpu.runtime.audit import AuditBus, JsonlSink
+    from dynamo_tpu.runtime.distributed import DistributedRuntime
+    from dynamo_tpu.runtime.hub import InMemoryHub
+
+    drt = DistributedRuntime(InMemoryHub())
+    await launch_mock_worker(
+        drt, "dyn", "backend", "generate",
+        MockEngineConfig(block_size=4, speedup_ratio=500.0),
+        model_name="audited", register_card=True,
+    )
+    manager = ModelManager()
+    watcher = await ModelWatcher(drt, manager).start()
+    await watcher.wait_for_model("audited", timeout=5)
+    path = tmp_path / "audit.jsonl"
+    bus = AuditBus().add_sink(JsonlSink(str(path)))
+    frontend = HttpFrontend(manager, host="127.0.0.1", port=0, audit=bus)
+    await frontend.start()
+    base = f"http://127.0.0.1:{frontend.port}"
+    try:
+        async with aiohttp.ClientSession() as sess:
+            payload = {
+                "model": "audited", "max_tokens": 4, "ignore_eos": True,
+                "messages": [{"role": "user", "content": "secret words"}],
+            }
+            async with sess.post(f"{base}/v1/chat/completions",
+                                 json=payload) as r:
+                assert r.status == 200
+            async with sess.post(
+                f"{base}/v1/chat/completions",
+                json={**payload, "stream": True},
+            ) as r:
+                async for _ in r.content:
+                    pass
+        recs = [json.loads(ln) for ln in open(path)]
+        assert len(recs) == 2
+        assert {r["route"] for r in recs} == {"chat"}
+        assert all(r["status"] == 200 for r in recs)
+        assert recs[0]["request"]["messages_count"] == 1
+        assert recs[0]["output_tokens"] == 4
+        # never the content
+        assert "secret" not in open(path).read()
+        assert all(r["request_id"] for r in recs)
+    finally:
+        await frontend.stop()
+        watcher.close()
+        await drt.close()

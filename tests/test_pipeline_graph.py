@@ -1,0 +1,107 @@
+"""Generic operator graph (runtime/pipeline.py, ref pipeline/nodes.rs +
+registry.rs): chains as data, custom operator splicing."""
+
+import pytest
+
+from dynamo_tpu.runtime.context import Context
+from dynamo_tpu.runtime.pipeline import OperatorRegistry, build_chain, registry
+
+
+class Sink:
+    async def generate(self, request, context):
+        yield {"token_ids": [1], "finish_reason": None}
+        yield {"token_ids": [2], "finish_reason": "stop"}
+
+
+class Tag:
+    """Test operator: tags every delta with its name (order-visible)."""
+
+    def __init__(self, sink, *, name):
+        self.sink = sink
+        self.name = name
+
+    async def generate(self, request, context):
+        async for d in self.sink.generate(request, context):
+            yield {**d, "tags": [*d.get("tags", []), self.name]}
+
+
+async def _drain(engine):
+    out = []
+    async for d in engine.generate({}, Context()):
+        out.append(d)
+    return out
+
+
+async def test_chain_order_outermost_first():
+    reg = OperatorRegistry()
+    reg.register("tag", lambda sink, **kw: Tag(sink, **kw))
+    chain = build_chain(
+        [("tag", {"name": "outer"}), ("tag", {"name": "inner"})],
+        Sink(), reg=reg,
+    )
+    items = await _drain(chain)
+    # inner wraps the sink, outer wraps inner: tags append inner->outer
+    assert items[0]["tags"] == ["inner", "outer"]
+    assert items[-1]["finish_reason"] == "stop"
+
+
+def test_unknown_operator_raises():
+    with pytest.raises(KeyError, match="unknown pipeline operator"):
+        build_chain(["nope"], Sink(), reg=OperatorRegistry())
+
+
+async def test_builtin_lazy_operators_resolve():
+    from dynamo_tpu.frontend.migration import Migration
+
+    assert {"backend", "migration"} <= set(registry.names())
+    chain = build_chain(
+        [("migration", {"migration_limit": 2})], Sink()
+    )
+    assert isinstance(chain, Migration)
+    items = await _drain(chain)
+    assert [d["token_ids"] for d in items] == [[1], [2]]
+
+
+async def test_card_operators_splice_into_model_pipeline():
+    """A model card's runtime_config["operators"] inserts custom stages
+    into the live serving chain (the registry's reason to exist)."""
+    from dynamo_tpu.frontend.watcher import ModelManager, ModelWatcher
+    from dynamo_tpu.mocker.__main__ import launch_mock_worker
+    from dynamo_tpu.mocker.engine import MockEngineConfig
+    from dynamo_tpu.runtime.distributed import DistributedRuntime
+    from dynamo_tpu.runtime.hub import InMemoryHub
+
+    seen = []
+
+    class Probe:
+        def __init__(self, sink, **_kw):
+            self.sink = sink
+
+        async def generate(self, request, context):
+            seen.append(context.id)
+            async for d in self.sink.generate(request, context):
+                yield d
+
+    registry.register("probe", lambda sink, **kw: Probe(sink, **kw))
+    drt = DistributedRuntime(InMemoryHub())
+    await launch_mock_worker(
+        drt, "dyn", "backend", "generate",
+        MockEngineConfig(block_size=4, speedup_ratio=500.0),
+        model_name="spliced", register_card=True,
+        runtime_config={"operators": ["probe"]},
+    )
+    manager = ModelManager()
+    watcher = await ModelWatcher(drt, manager).start()
+    await watcher.wait_for_model("spliced", timeout=5)
+    pipe = manager.get("spliced")
+    pre = pipe.preprocessor.preprocess({
+        "model": "spliced", "max_tokens": 3, "ignore_eos": True,
+        "messages": [{"role": "user", "content": "hi"}],
+    })
+    out = []
+    async for d in pipe.generate(pre, Context("probe-req")):
+        out.append(d)
+    assert seen == ["probe-req"]
+    assert out
+    watcher.close()
+    await drt.close()
