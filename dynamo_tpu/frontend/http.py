@@ -251,6 +251,14 @@ class HttpFrontend:
             deltas = pipe.generate(preprocessed, ctx)
             timed = self._timed_stream(deltas, model, t_start)
 
+            # streamed requests: observe the delta stream so the audit
+            # record carries real output tokens / finish reason, and a
+            # mid-stream failure (delivered to the client as an SSE error
+            # event over an already-200 response) is recorded as an error
+            audit_state = {"tokens": 0, "finish": None, "error": None}
+            if body.get("stream") and self.audit.enabled:
+                timed = self._observe_for_audit(timed, audit_state)
+
             if body.get("stream"):
                 pp = (
                     pipe.preprocessor.postprocess_chat_stream(
@@ -270,7 +278,12 @@ class HttpFrontend:
                 resp = await self._sse(request, pp, ctx)
                 self._m_requests.labels(model, route, "200").inc()
                 self._mark_completed(model, prompt_tokens)
-                self._audit(route, model, ctx, body, 200, t_start)
+                self._audit(
+                    route, model, ctx, body, 200, t_start,
+                    finish_reason=audit_state["finish"],
+                    output_tokens=audit_state["tokens"],
+                    error=audit_state["error"],
+                )
                 return resp
             else:
                 agg = (
@@ -304,6 +317,20 @@ class HttpFrontend:
         finally:
             self._m_inflight.labels(model).dec()
             self._m_duration.labels(model).observe(time.monotonic() - t_start)
+
+    @staticmethod
+    async def _observe_for_audit(stream, state: dict):
+        try:
+            async for d in stream:
+                state["tokens"] += len(d.get("token_ids") or ())
+                if d.get("finish_reason"):
+                    state["finish"] = d["finish_reason"]
+                if d.get("error"):
+                    state["error"] = str(d["error"])
+                yield d
+        except Exception as e:  # noqa: BLE001
+            state["error"] = str(e)
+            raise
 
     def _audit(
         self, route: str, model: str, ctx, body: dict, status: int,

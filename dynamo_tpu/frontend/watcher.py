@@ -16,8 +16,6 @@ import logging
 from dataclasses import dataclass
 from typing import Any, AsyncIterator
 
-from dynamo_tpu.frontend.backend_op import Backend
-from dynamo_tpu.frontend.migration import Migration
 from dynamo_tpu.frontend.model_card import MDC_ROOT, ModelDeploymentCard
 from dynamo_tpu.frontend.preprocessor import OpenAIPreprocessor
 from dynamo_tpu.frontend.tokenizer import load_tokenizer

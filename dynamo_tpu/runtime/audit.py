@@ -69,15 +69,50 @@ class AuditRecord(dict):
 
 
 class JsonlSink:
-    def __init__(self, path: str):
+    """File sink with a writer thread: emit() only enqueues, so a slow
+    or network-mounted disk never stalls the serving event loop (the
+    module contract). A full queue drops records."""
+
+    def __init__(self, path: str, *, max_queue: int = 1024):
+        import queue as _queue
+        import threading
+
         self._f = open(path, "a")
+        self._q: "_queue.Queue" = _queue.Queue(maxsize=max_queue)
+        self._stop = object()
+        self._thread = threading.Thread(
+            target=self._run, name="audit-jsonl", daemon=True
+        )
+        self._thread.start()
+
+    def _run(self) -> None:
+        while True:
+            rec = self._q.get()
+            if rec is self._stop:
+                self._f.close()
+                return
+            try:
+                self._f.write(json.dumps(rec) + "\n")
+                self._f.flush()
+            except Exception:  # noqa: BLE001
+                log.warning("audit jsonl write failed", exc_info=True)
 
     def emit(self, rec: AuditRecord) -> None:
-        self._f.write(json.dumps(rec) + "\n")
-        self._f.flush()
+        try:
+            self._q.put_nowait(rec)
+        except Exception:  # noqa: BLE001
+            pass  # full queue: drop, never block serving
+
+    def flush(self, timeout: float = 5.0) -> None:
+        import time as _time
+
+        deadline = _time.monotonic() + timeout
+        while not self._q.empty() and _time.monotonic() < deadline:
+            _time.sleep(0.01)
 
     def close(self) -> None:
-        self._f.close()
+        self._q.put(self._stop)
+        self._thread.join(timeout=5)
 
 
 class HubSink:
@@ -86,9 +121,13 @@ class HubSink:
     def __init__(self, hub, namespace: str = "dynamo"):
         self.hub = hub
         self.subject = AUDIT_SUBJECT.format(namespace=namespace)
+        # the loop holds only weak task refs: keep publishes alive
+        self._tasks: set = set()
 
     def emit(self, rec: AuditRecord) -> None:
-        asyncio.ensure_future(self.hub.publish(self.subject, dict(rec)))
+        task = asyncio.ensure_future(self.hub.publish(self.subject, dict(rec)))
+        self._tasks.add(task)
+        task.add_done_callback(self._tasks.discard)
 
     def close(self) -> None:
         pass

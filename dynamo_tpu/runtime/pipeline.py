@@ -83,10 +83,19 @@ def build_chain(ops: list, sink: Any, *, reg: OperatorRegistry | None = None):
     """
     reg = reg or registry
     engine = sink
-    normalized = [
-        (op, {}) if isinstance(op, str) else (op[0], dict(op[1]))
-        for op in ops
-    ]
+    normalized = []
+    for op in ops:
+        if isinstance(op, str):
+            normalized.append((op, {}))
+        elif isinstance(op, (list, tuple)) and len(op) == 2 and isinstance(
+            op[0], str
+        ) and isinstance(op[1], dict):
+            normalized.append((op[0], dict(op[1])))
+        else:
+            raise ValueError(
+                f"bad pipeline operator entry {op!r}: expected \"name\" "
+                "or [name, kwargs]"
+            )
     for name, kwargs in reversed(normalized):
         engine = reg.build(name, engine, **kwargs)
     return engine

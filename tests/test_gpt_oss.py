@@ -281,7 +281,7 @@ async def test_harmony_tool_calls_over_http_sse():
             assert finishes[-1] == "tool_calls"
     finally:
         await frontend.stop()
-        watcher.close()
+        await watcher.close()
         await drt.close()
 
 

@@ -270,16 +270,19 @@ async def test_audit_bus_records_requests(tmp_path):
             ) as r:
                 async for _ in r.content:
                     pass
+        bus.sinks[0].flush()
         recs = [json.loads(ln) for ln in open(path)]
         assert len(recs) == 2
         assert {r["route"] for r in recs} == {"chat"}
         assert all(r["status"] == 200 for r in recs)
         assert recs[0]["request"]["messages_count"] == 1
-        assert recs[0]["output_tokens"] == 4
+        # BOTH aggregated and streamed records carry real token counts
+        assert all(r["output_tokens"] == 4 for r in recs), recs
+        assert all(r["finish_reason"] for r in recs), recs
         # never the content
         assert "secret" not in open(path).read()
         assert all(r["request_id"] for r in recs)
     finally:
         await frontend.stop()
-        watcher.close()
+        await watcher.close()
         await drt.close()

@@ -84,24 +84,28 @@ async def test_card_operators_splice_into_model_pipeline():
 
     registry.register("probe", lambda sink, **kw: Probe(sink, **kw))
     drt = DistributedRuntime(InMemoryHub())
-    await launch_mock_worker(
-        drt, "dyn", "backend", "generate",
-        MockEngineConfig(block_size=4, speedup_ratio=500.0),
-        model_name="spliced", register_card=True,
-        runtime_config={"operators": ["probe"]},
-    )
-    manager = ModelManager()
-    watcher = await ModelWatcher(drt, manager).start()
-    await watcher.wait_for_model("spliced", timeout=5)
-    pipe = manager.get("spliced")
-    pre = pipe.preprocessor.preprocess({
-        "model": "spliced", "max_tokens": 3, "ignore_eos": True,
-        "messages": [{"role": "user", "content": "hi"}],
-    })
-    out = []
-    async for d in pipe.generate(pre, Context("probe-req")):
-        out.append(d)
-    assert seen == ["probe-req"]
-    assert out
-    watcher.close()
-    await drt.close()
+    try:
+        await launch_mock_worker(
+            drt, "dyn", "backend", "generate",
+            MockEngineConfig(block_size=4, speedup_ratio=500.0),
+            model_name="spliced", register_card=True,
+            runtime_config={"operators": ["probe"]},
+        )
+        manager = ModelManager()
+        watcher = await ModelWatcher(drt, manager).start()
+        await watcher.wait_for_model("spliced", timeout=5)
+        pipe = manager.get("spliced")
+        pre = pipe.preprocessor.preprocess({
+            "model": "spliced", "max_tokens": 3, "ignore_eos": True,
+            "messages": [{"role": "user", "content": "hi"}],
+        })
+        out = []
+        async for d in pipe.generate(pre, Context("probe-req")):
+            out.append(d)
+        assert seen == ["probe-req"]
+        assert out
+        await watcher.close()
+    finally:
+        # the registry is a process-wide singleton: do not leak the probe
+        registry._factories.pop("probe", None)
+        await drt.close()

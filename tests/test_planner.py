@@ -358,6 +358,6 @@ async def test_process_connector_scales_live_fleet():
             assert await completion_ok(sess)
     finally:
         await frontend.stop()
-        watcher.close()
+        await watcher.close()
         await conn.close()
         await drt.close()
