@@ -149,7 +149,10 @@ def serving_measurement(spec, page_size: int) -> dict:
                     total_tokens += n
                 last = now
 
-        await asyncio.gather(*(one(i, False) for i in range(4)))  # warmup
+        # warmup compiles both admission shapes: a concurrent wave (packed
+        # batch prefill) and a straggler (single-prompt program)
+        await asyncio.gather(*(one(i, False) for i in range(4)))
+        await one(99, False)
         t0 = time.perf_counter()
         await asyncio.gather(*(one(i, True) for i in range(N_REQ)))
         wall = time.perf_counter() - t0

@@ -164,6 +164,10 @@ class EngineConfig:
     # prefill dispatch) until the budget is spent, so a queue of short
     # prompts lands in one step instead of one per step
     max_prefill_tokens_per_step: int = 2048
+    # packed prefill width: same-bucket admissions batch into ONE dispatch
+    # of exactly this many prompt rows (padded; larger groups chunk) —
+    # one compiled shape per bucket, N prompts per host round-trip
+    prefill_pack_size: int = 8
     # decode model steps fused per device dispatch (vLLM multi-step
     # scheduling analogue): amortizes host dispatch + token sync; tokens
     # stream in bursts of this size, EOS overshoot is discarded host-side

@@ -406,6 +406,7 @@ class InferenceEngine:
             budget = self.config.max_prefill_tokens_per_step
             admitted = False
             pending: list[tuple] = []
+            preps: list[dict] = []
             reserved: set[int] = set()
             while self._partial is None:
                 free_idx = next(
@@ -432,13 +433,18 @@ class InferenceEngine:
                         {"token_ids": [], "finish_reason": "cancelled"},
                     )
                 else:
-                    rec = self._prefill_safe(free_idx, waiting)
-                    if rec is not None:
-                        pending.append(rec)
+                    out = self._prefill_safe(free_idx, waiting)
+                    if isinstance(out, dict):
+                        preps.append(out)
+                        reserved.add(free_idx)
+                    elif out is not None:
+                        pending.append(out)
                         reserved.add(free_idx)
                     budget -= cost
                     admitted = True
                 did = True
+            # packed prefill: all same-bucket preps in ONE dispatch each
+            pending.extend(self._run_packed_prefills(preps))
             if pending:
                 self._complete_admissions(pending)
             if did:
@@ -470,11 +476,13 @@ class InferenceEngine:
 
     # -- prefill (runs in thread) ------------------------------------------
 
-    def _prefill_safe(self, slot_idx: int, waiting: _Waiting) -> tuple | None:
+    def _prefill_safe(
+        self, slot_idx: int, waiting: _Waiting
+    ) -> tuple | dict | None:
         """Per-request error isolation: a bad request must not kill the loop.
 
-        Returns a pending-admission record (see _prefill_with_pages) when
-        the prompt finished its forward and awaits first-token sampling;
+        Returns a prep dict (forward deferred to _run_packed_prefills), a
+        pending-admission record (ring path: forward already ran), or
         None when handled fully (disagg resume, chunked start, error)."""
         try:
             disagg = waiting.request.get("disagg") or {}
@@ -866,18 +874,117 @@ class InferenceEngine:
             return (slot_idx, waiting, seq, sp, token_ids, max_tokens, logits)
 
         chunk_max = self._prefill_chunk_max()
-        end = min(start_pos + chunk_max, len(token_ids))
-        logits = self._run_prefill_chunk(sp, token_ids, start_pos, end)
-        if end == len(token_ids):
-            self._seal_prompt_blocks(sp, seq)
-            self._drain_offload()
-            return (slot_idx, waiting, seq, sp, token_ids, max_tokens, logits)
+        if start_pos + chunk_max >= len(token_ids):
+            # fits one dispatch: defer the forward to the PACKED prefill
+            # stage, which lands every same-bucket admission of this step
+            # in a single jit call (_run_packed_prefills)
+            return {
+                "slot_idx": slot_idx, "waiting": waiting, "seq": seq,
+                "sp": sp, "token_ids": token_ids, "max_tokens": max_tokens,
+                "start_pos": start_pos, "tail": tail,
+            }
         # long prompt: remaining chunks advance on subsequent steps,
         # interleaved with decode (_step)
+        end = start_pos + chunk_max
+        logits = self._run_prefill_chunk(sp, token_ids, start_pos, end)
         self._partial = _PartialPrefill(
             slot_idx, waiting, seq, sp, token_ids, end, max_tokens
         )
         return None
+
+    def _run_packed_prefills(self, preps: list[dict]) -> list[tuple]:
+        """Execute deferred admissions: same-bucket prompts batch into one
+        ``prefill_forward_batch`` dispatch (N padded to a power of two so
+        the compiled-shape set stays bounded); singletons take the
+        already-compiled single-prompt program. Returns pending-admission
+        records for _complete_admissions."""
+        if not preps:
+            return []
+        cfg = self.config
+        records: list[tuple] = []
+        groups: dict[int, list[dict]] = {}
+        for p in preps:
+            groups.setdefault(cfg.bucket_for(p["tail"]), []).append(p)
+        slices: list[tuple[int, list[dict]]] = []
+        for bucket, group in sorted(groups.items()):
+            # ONE packed width per bucket (jit compiles cost seconds on
+            # TPU, so organic group sizes would stall serving every time
+            # a new size appeared): chunk to pack_size, pad the remainder
+            for i in range(0, len(group), cfg.prefill_pack_size):
+                slices.append((bucket, group[i : i + cfg.prefill_pack_size]))
+        for bucket, group in slices:
+            if len(group) == 1:
+                rec = self._single_prefill_record(group[0])
+                if rec is not None:
+                    records.append(rec)
+                continue
+            nb = cfg.prefill_pack_size
+            tokens = np.zeros((nb, bucket), np.int32)
+            bts = np.zeros((nb, cfg.max_pages_per_seq), np.int32)
+            starts = np.zeros((nb,), np.int32)
+            nts = np.zeros((nb,), np.int32)  # padded rows: 0 -> trash page
+            for i, p in enumerate(group):
+                tail_toks = p["token_ids"][p["start_pos"]:]
+                tokens[i, : len(tail_toks)] = tail_toks
+                bts[i, : p["sp"].num_pages] = p["sp"].pages
+                starts[i] = p["start_pos"]
+                nts[i] = p["tail"]
+            try:
+                if self.spmd is not None:
+                    self.spmd.publish(
+                        "prefill_batch", {},
+                        {"tokens": tokens, "block_tables": bts,
+                         "start": starts, "num_tokens": nts},
+                    )
+                logits, self.k_pages, self.v_pages, dropped = (
+                    llama.prefill_forward_batch(
+                        self.spec, self.params, jnp.asarray(tokens),
+                        jnp.asarray(bts), jnp.asarray(starts),
+                        self.k_pages, self.v_pages, jnp.asarray(nts),
+                        mesh=self.mesh,
+                    )
+                )
+                self._note_moe_dropped(dropped)
+            except Exception as e:  # noqa: BLE001
+                log.exception("packed prefill failed (%d prompts)", n)
+                for p in group:
+                    self.allocator.release(p["sp"].pages)
+                    p["sp"].pages = []
+                    self._post(
+                        p["waiting"].out_q,
+                        {"token_ids": [], "finish_reason": "error",
+                         "error": f"prefill failed: {e}"},
+                    )
+                continue
+            for i, p in enumerate(group):
+                self._seal_prompt_blocks(p["sp"], p["seq"])
+                records.append((
+                    p["slot_idx"], p["waiting"], p["seq"], p["sp"],
+                    p["token_ids"], p["max_tokens"], logits[i],
+                ))
+        self._drain_offload()
+        return records
+
+    def _single_prefill_record(self, p: dict) -> tuple | None:
+        try:
+            logits = self._run_prefill_chunk(
+                p["sp"], p["token_ids"], p["start_pos"], len(p["token_ids"])
+            )
+            self._seal_prompt_blocks(p["sp"], p["seq"])
+            return (
+                p["slot_idx"], p["waiting"], p["seq"], p["sp"],
+                p["token_ids"], p["max_tokens"], logits,
+            )
+        except Exception as e:  # noqa: BLE001
+            log.exception("prefill failed for %s", p["waiting"].context.id)
+            self.allocator.release(p["sp"].pages)
+            p["sp"].pages = []
+            self._post(
+                p["waiting"].out_q,
+                {"token_ids": [], "finish_reason": "error",
+                 "error": f"prefill failed: {e}"},
+            )
+            return None
 
     def _complete_admissions(self, pending: list[tuple]) -> None:
         """Sample every admitted prompt's first token in ONE batched call —

@@ -284,6 +284,86 @@ prefill_forward = jax.jit(
 )
 
 
+def prefill_forward_batch_impl(
+    spec: ModelSpec,
+    params: Params,
+    tokens: jax.Array,  # [N, T_pad] int32 (padded)
+    block_tables: jax.Array,  # [N, max_pages_per_seq] int32
+    start_pos: jax.Array,  # [N] cached-prefix lengths (page-aligned)
+    k_pages: jax.Array,  # donated
+    v_pages: jax.Array,
+    num_tokens: jax.Array,  # [N] real token counts
+    mesh: Mesh | None = None,  # static
+) -> tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
+    """N prompts in ONE dispatch — the packed-prefill admission path.
+
+    A queue of same-bucket prompts lands as one jit call instead of N:
+    matmuls batch over [N, T, d] (the MXU sees N*T rows), the per-layer
+    KV write is ONE page-tile scatter over all N*T/page pages, and
+    attention runs per prompt over its own table. This is what takes
+    admission TTFT from O(N * dispatch) to O(dispatch): dispatch and
+    host<->device round-trips dominate short prefills, especially when
+    the host is far from the chip.
+
+    Returns (last_logits [N, V], k_pages, v_pages, moe_dropped).
+    """
+    N, T = tokens.shape
+    page_size = k_pages.shape[3]
+    idx = jnp.arange(T)
+    positions = start_pos[:, None] + idx[None, :]  # [N, T]
+    n_pg = T // page_size
+    page_starts = start_pos[:, None] + (
+        jnp.arange(n_pg) * page_size
+    )[None, :]  # [N, n_pg]
+    pg_idx_raw = jnp.take_along_axis(
+        block_tables, page_starts // page_size, axis=1
+    )
+    valid_pg = page_starts < (start_pos + num_tokens)[:, None]
+    safe_pg = jnp.where(valid_pg, pg_idx_raw, TRASH_PAGE).reshape(N * n_pg)
+
+    def to_tiles(arr):  # [N, T, KH, D] -> [N*n_pg, KH, page, D]
+        kh, hd = arr.shape[2], arr.shape[3]
+        return arr.reshape(N * n_pg, page_size, kh, hd).transpose(0, 2, 1, 3)
+
+    x = params["embed"][tokens]  # [N, T, d]
+    kv_len = start_pos + num_tokens  # [N]
+    moe_dropped = jnp.zeros((), jnp.int32)
+
+    for li, lp in enumerate(params["layers"]):
+        h = rms_norm(x, lp["attn_norm"], spec.rms_eps)
+        q = (h @ lp["wq"]).reshape(N, T, spec.num_heads, spec.head_dim)
+        k = (h @ lp["wk"]).reshape(N, T, spec.num_kv_heads, spec.head_dim)
+        v = (h @ lp["wv"]).reshape(N, T, spec.num_kv_heads, spec.head_dim)
+        q = jax.vmap(rope, in_axes=(0, 0, None))(q, positions, spec.rope_theta)
+        k = jax.vmap(rope, in_axes=(0, 0, None))(k, positions, spec.rope_theta)
+        k_pages = k_pages.at[li, safe_pg].set(to_tiles(k))
+        v_pages = v_pages.at[li, safe_pg].set(to_tiles(v))
+
+        def one_attn(q_i, bt_i, pos_i, kvl_i, kp=k_pages, vp=v_pages, li=li):
+            k_ctx = gather_pages(kp[li], bt_i)
+            v_ctx = gather_pages(vp[li], bt_i)
+            return causal_attention(q_i, k_ctx, v_ctx, pos_i, kvl_i)
+
+        attn = jax.vmap(one_attn)(q, block_tables, positions, kv_len)
+        x = x + attn.reshape(N, T, -1) @ lp["wo"]
+        h = rms_norm(x, lp["mlp_norm"], spec.rms_eps)
+        f, d = _ffn_counted(spec, lp, h.reshape(N * T, -1))
+        x = x + f.reshape(N, T, -1)
+        moe_dropped = moe_dropped + d
+
+    last = jnp.clip(num_tokens - 1, 0, T - 1)  # [N]
+    x_last = jnp.take_along_axis(x, last[:, None, None], axis=1)[:, 0]
+    logits = _logits(spec, params, x_last)  # [N, V]
+    logits = _replicate(logits, mesh)
+    return logits, k_pages, v_pages, _replicate(moe_dropped, mesh)
+
+
+prefill_forward_batch = jax.jit(
+    prefill_forward_batch_impl, static_argnums=(0,),
+    static_argnames=("mesh",), donate_argnums=(5, 6),
+)
+
+
 def prefill_forward_ring_impl(
     spec: ModelSpec,
     params: Params,

@@ -131,6 +131,14 @@ class SpmdFollower:
                     eng.k_pages, eng.v_pages,
                     jnp_scalar(sc["num_tokens"]), mesh=mesh,
                 )
+            elif op == "prefill_batch":
+                (_lg, eng.k_pages, eng.v_pages,
+                 _d) = llama.prefill_forward_batch(
+                    spec, eng.params,
+                    jnp_i32(ar["tokens"]), jnp_i32(ar["block_tables"]),
+                    jnp_i32(ar["start"]), eng.k_pages, eng.v_pages,
+                    jnp_i32(ar["num_tokens"]), mesh=mesh,
+                )
             elif op == "kv_offload":
                 # mirror the leader's tier offload: extract the SAME pages
                 # (this process keeps its shard) and offer them to the

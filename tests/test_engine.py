@@ -333,3 +333,54 @@ async def test_engine_on_tp_mesh_generates():
     assert len(out) == 4
     assert out[-1]["finish_reason"] == "length"
     await eng.close()
+
+
+def test_packed_prefill_matches_singles():
+    """prefill_forward_batch == N sequential prefill_forward calls:
+    logits per prompt and every written page identical; padded rows
+    (num_tokens=0) touch only the trash page."""
+    key = jax.random.PRNGKey(9)
+    params = llama.init_params(SPEC, key)
+    cfg = small_config()
+    page, mpps = cfg.page_size, cfg.max_pages_per_seq
+    rng = np.random.default_rng(0)
+
+    prompts = [list(rng.integers(3, SPEC.vocab_size, n)) for n in (7, 12, 9)]
+    T = 16
+    N = 4  # one padded row
+    tokens = np.zeros((N, T), np.int32)
+    bts = np.zeros((N, mpps), np.int32)
+    starts = np.zeros((N,), np.int32)
+    nts = np.zeros((N,), np.int32)
+    next_page = 1
+    for i, pr in enumerate(prompts):
+        tokens[i, : len(pr)] = pr
+        npg = (len(pr) + page - 1) // page
+        bts[i, :npg] = np.arange(next_page, next_page + npg)
+        next_page += npg
+        nts[i] = len(pr)
+
+    kb, vb = llama.init_cache(SPEC, cfg.num_pages + 1, page)
+    lg_b, kb, vb, _d = llama.prefill_forward_batch(
+        SPEC, params, jnp.asarray(tokens), jnp.asarray(bts),
+        jnp.asarray(starts), kb, vb, jnp.asarray(nts),
+    )
+
+    ks, vs = llama.init_cache(SPEC, cfg.num_pages + 1, page)
+    for i, pr in enumerate(prompts):
+        lg_s, ks, vs, _d2 = llama.prefill_forward(
+            SPEC, params, jnp.asarray(tokens[i]), jnp.asarray(bts[i]),
+            jnp.asarray(0, jnp.int32), ks, vs, jnp.asarray(nts[i], jnp.int32),
+        )
+        np.testing.assert_allclose(
+            np.asarray(lg_b[i]), np.asarray(lg_s), rtol=2e-4, atol=2e-4
+        )
+    # every live page written identically (trash page 0 excluded)
+    np.testing.assert_allclose(
+        np.asarray(kb[:, 1:next_page]), np.asarray(ks[:, 1:next_page]),
+        atol=1e-5,
+    )
+    np.testing.assert_allclose(
+        np.asarray(vb[:, 1:next_page]), np.asarray(vs[:, 1:next_page]),
+        atol=1e-5,
+    )
