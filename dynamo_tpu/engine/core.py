@@ -34,6 +34,7 @@ from dynamo_tpu.engine.config import EngineConfig, ModelSpec
 from dynamo_tpu.engine.sampling import sample_tokens, token_logprobs
 from dynamo_tpu.kv_router.protocols import ForwardPassMetrics
 from dynamo_tpu.models import llama
+from dynamo_tpu.models.family import get_family
 from dynamo_tpu.runtime.context import Context
 from dynamo_tpu.tokens import TokenBlockSequence
 
@@ -122,22 +123,28 @@ class InferenceEngine:
         self.events = event_publisher
         self.metrics = metrics_publisher
 
+        self.fam = get_family(spec)
+        if mesh is not None and not self.fam.supports_mesh:
+            raise ValueError(
+                f"{type(self.fam).__name__} does not support meshes yet; "
+                "run this model family single-device"
+            )
         key = jax.random.PRNGKey(self.config.seed)
         if params is None:
-            params = llama.init_params(spec, key)
+            params = self.fam.init_params(spec, key)
         if mesh is not None:
-            shardings = llama.param_shardings(spec, mesh)
+            shardings = self.fam.param_shardings(spec, mesh)
             params = jax.tree.map(
                 lambda p, s: jax.device_put(p, s), params, shardings
             )
         self.params = params
 
         # +1 page: index 0 is the trash page
-        self.k_pages, self.v_pages = llama.init_cache(
+        self.k_pages, self.v_pages = self.fam.init_cache(
             spec, self.config.num_pages + 1, self.config.page_size
         )
         if mesh is not None:
-            ks, vs = llama.cache_shardings(mesh)
+            ks, vs = self.fam.cache_shardings(mesh)
             self.k_pages = jax.device_put(self.k_pages, ks)
             self.v_pages = jax.device_put(self.v_pages, vs)
 
@@ -256,6 +263,10 @@ class InferenceEngine:
                    "error": "empty token_ids"}
             return
         if request.get("embedding_request"):
+            if not self.fam.supports_embeddings:
+                yield {"token_ids": [], "finish_reason": "error",
+                       "error": f"embeddings unsupported for {self.spec.name}"}
+                return
             if self.spmd is not None:
                 # embed_forward is not in the follower replay protocol
                 yield {"token_ids": [], "finish_reason": "error",
@@ -504,7 +515,7 @@ class InferenceEngine:
         bucket = self.config.bucket_for(len(token_ids))
         padded = np.zeros((bucket,), np.int32)
         padded[: len(token_ids)] = token_ids
-        emb = llama.embed_forward(
+        emb = self.fam.embed_forward(
             self.spec, self.params, jnp.asarray(padded),
             jnp.asarray(len(token_ids), jnp.int32),
         )
@@ -692,7 +703,7 @@ class InferenceEngine:
             vb = jax.make_array_from_process_local_data(sharding, v_stack)
         else:
             kb, vb = jnp.asarray(k_stack), jnp.asarray(v_stack)
-        self.k_pages, self.v_pages = llama.insert_kv_pages(
+        self.k_pages, self.v_pages = self.fam.insert_pages(
             self.k_pages, self.v_pages, jnp.asarray(page_ids), kb, vb
         )
 
@@ -727,7 +738,7 @@ class InferenceEngine:
                 {"hashes": [s for s, _p, _i in batch]},
                 {"page_ids": ids},
             )
-        kb, vb = llama.extract_kv_pages(self.k_pages, self.v_pages, jnp.asarray(ids))
+        kb, vb = self.fam.extract_pages(self.k_pages, self.v_pages, jnp.asarray(ids))
         try:
             kb.copy_to_host_async()
             vb.copy_to_host_async()
@@ -778,7 +789,7 @@ class InferenceEngine:
         """Single chokepoint for the logprob width: the OpenAI surface caps
         at 20, direct engine callers get clamped (top_k needs k <= V, and
         emit indexing must stay inside the computed arrays)."""
-        if n is None:
+        if n is None or not self.fam.supports_logprobs:
             return None
         return max(0, min(int(n), 20, self.spec.vocab_size - 1))
 
@@ -835,6 +846,7 @@ class InferenceEngine:
         cfg = self.config
         use_ring = (
             self.mesh is not None
+            and self.fam.supports_ring_prefill
             and self.mesh.shape.get("sp", 1) > 1
             and start_pos == 0
             and tail <= cfg.prefill_buckets[-1]
@@ -857,7 +869,7 @@ class InferenceEngine:
                     {"tokens": padded, "block_table": block_table},
                 )
             logits, self.k_pages, self.v_pages, dropped = (
-                llama.prefill_forward_ring(
+                self.fam.prefill_ring(
                     self.spec,
                     self.params,
                     jnp.asarray(padded),
@@ -906,12 +918,15 @@ class InferenceEngine:
         for p in preps:
             groups.setdefault(cfg.bucket_for(p["tail"]), []).append(p)
         slices: list[tuple[int, list[dict]]] = []
+        pack = (
+            cfg.prefill_pack_size if self.fam.supports_packed_prefill else 1
+        )
         for bucket, group in sorted(groups.items()):
             # ONE packed width per bucket (jit compiles cost seconds on
             # TPU, so organic group sizes would stall serving every time
             # a new size appeared): chunk to pack_size, pad the remainder
-            for i in range(0, len(group), cfg.prefill_pack_size):
-                slices.append((bucket, group[i : i + cfg.prefill_pack_size]))
+            for i in range(0, len(group), pack):
+                slices.append((bucket, group[i : i + pack]))
         for bucket, group in slices:
             if len(group) == 1:
                 rec = self._single_prefill_record(group[0])
@@ -937,7 +952,7 @@ class InferenceEngine:
                          "start": starts, "num_tokens": nts},
                     )
                 logits, self.k_pages, self.v_pages, dropped = (
-                    llama.prefill_forward_batch(
+                    self.fam.prefill_batch(
                         self.spec, self.params, jnp.asarray(tokens),
                         jnp.asarray(bts), jnp.asarray(starts),
                         self.k_pages, self.v_pages, jnp.asarray(nts),
@@ -1115,7 +1130,7 @@ class InferenceEngine:
                 {"start": start, "num_tokens": len(new_tokens)},
                 {"tokens": padded, "block_table": block_table},
             )
-        logits, self.k_pages, self.v_pages, dropped = llama.prefill_forward(
+        logits, self.k_pages, self.v_pages, dropped = self.fam.prefill(
             self.spec,
             self.params,
             jnp.asarray(padded),
@@ -1173,7 +1188,7 @@ class InferenceEngine:
     ) -> None:
         """Prefill-worker handoff: export prompt KV pages for remote decode."""
         page_ids = jnp.asarray(np.asarray(sp.pages, np.int32))
-        kb, vb = llama.extract_kv_pages(self.k_pages, self.v_pages, page_ids)
+        kb, vb = self.fam.extract_pages(self.k_pages, self.v_pages, page_ids)
         # device arrays go straight to the transfer plane: with a live PJRT
         # transfer server the decode worker pulls device-to-device and the
         # payload never stages through host numpy
@@ -1249,7 +1264,7 @@ class InferenceEngine:
                 page_ids = jnp.asarray(
                     np.asarray([sp.pages[i] for i in install], np.int32)
                 )
-                self.k_pages, self.v_pages = llama.insert_kv_pages(
+                self.k_pages, self.v_pages = self.fam.insert_pages(
                     self.k_pages, self.v_pages, page_ids,
                     jnp.asarray(k_blocks[:, install]),
                     jnp.asarray(v_blocks[:, install]),
@@ -1404,7 +1419,7 @@ class InferenceEngine:
         # one fixed logprob width when ANY slot asks: n_logprobs is a
         # static jit arg, so per-batch widths would recompile the fused
         # decode program every time the mix changes
-        wants_lp = any(
+        wants_lp = self.fam.supports_logprobs and any(
             s is not None and s.logprobs is not None for s in self._slots
         )
         n_lp = min(20, self.spec.vocab_size - 1) if wants_lp else 0
@@ -1456,7 +1471,7 @@ class InferenceEngine:
             prev_sampled = chain["results"][0]  # device [B, n_prev]
             prev_active = jnp.asarray(chain["batch"]["active"])
             tokens_in = jnp.where(prev_active, prev_sampled[:, -1], tokens_in)
-        result = llama.decode_steps(
+        result = self.fam.decode_steps(
             self.spec,
             self.params,
             tokens_in,

@@ -1,0 +1,169 @@
+"""Model-family dispatch: one engine, multiple attention architectures.
+
+The engine's hot loop (engine/core.py) is family-agnostic: it drives a
+small adapter surface — params/cache init, prefill, fused decode, page
+extract/insert — and the adapter maps it onto the family's functional
+core. Two families today:
+
+- ``GqaFamily``: llama/mistral/mixtral/qwen/gpt-oss (models/llama.py) —
+  paged K and V pools, GQA attention, the full feature matrix (packed
+  prefill, ring prefill, meshes, logprobs, embeddings).
+- ``MlaFamily``: DeepSeek-V2/V3/R1 (models/mla.py) — ONE latent cache
+  array. The engine's (k_pages, v_pages) plumbing carries the latent
+  cache as ``k_pages`` and a tiny inert placeholder as ``v_pages`` so
+  page bookkeeping, KVBM tier blocks, and transfer metadata flow
+  unchanged. Capability flags gate what MLA does not support yet
+  (packed/ring prefill, meshes, logprobs, embeddings) — the engine
+  falls back to the single-prompt paths and rejects the rest cleanly.
+
+Ref: the reference delegates this dispatch to its engines (vLLM model
+registry); here it is explicit and small.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from dynamo_tpu.engine.config import ModelSpec
+
+__all__ = ["get_family", "GqaFamily", "MlaFamily"]
+
+
+class GqaFamily:
+    """llama-family adapter: thin passthrough to models/llama.py."""
+
+    supports_packed_prefill = True
+    supports_ring_prefill = True
+    supports_mesh = True
+    supports_logprobs = True
+    supports_embeddings = True
+
+    def __init__(self):
+        from dynamo_tpu.models import llama
+
+        self.m = llama
+
+    def init_params(self, spec, key):
+        return self.m.init_params(spec, key)
+
+    def param_shardings(self, spec, mesh):
+        return self.m.param_shardings(spec, mesh)
+
+    def cache_shardings(self, mesh):
+        return self.m.cache_shardings(mesh)
+
+    def init_cache(self, spec, num_pages, page_size):
+        return self.m.init_cache(spec, num_pages, page_size)
+
+    def prefill(self, spec, params, tokens, bt, start, k, v, n, mesh=None):
+        return self.m.prefill_forward(
+            spec, params, tokens, bt, start, k, v, n, mesh=mesh
+        )
+
+    def prefill_batch(self, spec, params, tokens, bts, starts, k, v, ns,
+                      mesh=None):
+        return self.m.prefill_forward_batch(
+            spec, params, tokens, bts, starts, k, v, ns, mesh=mesh
+        )
+
+    def prefill_ring(self, spec, params, tokens, bt, k, v, n, mesh):
+        return self.m.prefill_forward_ring(
+            spec, params, tokens, bt, k, v, n, mesh=mesh
+        )
+
+    def decode_steps(self, spec, params, tokens, bts, lens, k, v, active,
+                     temps, topk, topp, seeds, steps, *, n_steps, n_logprobs,
+                     mesh=None):
+        return self.m.decode_steps(
+            spec, params, tokens, bts, lens, k, v, active, temps, topk,
+            topp, seeds, steps, n_steps=n_steps, n_logprobs=n_logprobs,
+            mesh=mesh,
+        )
+
+    def extract_pages(self, k, v, page_ids):
+        return self.m.extract_kv_pages(k, v, page_ids)
+
+    def insert_pages(self, k, v, page_ids, kb, vb):
+        return self.m.insert_kv_pages(k, v, page_ids, kb, vb)
+
+    def embed_forward(self, spec, params, tokens, num_tokens):
+        return self.m.embed_forward(spec, params, tokens, num_tokens)
+
+
+class MlaFamily:
+    """DeepSeek MLA adapter: latent cache rides the k_pages slot; the
+    v_pages slot carries an inert [1] placeholder everywhere."""
+
+    supports_packed_prefill = False
+    supports_ring_prefill = False
+    supports_mesh = False
+    supports_logprobs = False
+    supports_embeddings = False
+
+    def __init__(self):
+        from dynamo_tpu.models import mla
+
+        self.m = mla
+
+    def init_params(self, spec, key):
+        return self.m.init_params(spec, key)
+
+    def param_shardings(self, spec, mesh):
+        raise NotImplementedError("MLA TP shardings are not wired yet")
+
+    def cache_shardings(self, mesh):
+        raise NotImplementedError("MLA cache shardings are not wired yet")
+
+    def init_cache(self, spec, num_pages, page_size):
+        cache = self.m.init_cache(spec, num_pages, page_size)
+        return cache, jnp.zeros((1,), jnp.int8)  # inert v_pages placeholder
+
+    def prefill(self, spec, params, tokens, bt, start, k, v, n, mesh=None):
+        logits, cache = self.m.prefill_forward(
+            spec, params, tokens, bt, start, k, n
+        )
+        # engine contract: (logits, k, v, moe_dropped)
+        return logits, cache, v, jnp.zeros((), jnp.int32)
+
+    def decode_steps(self, spec, params, tokens, bts, lens, k, v, active,
+                     temps, topk, topp, seeds, steps, *, n_steps, n_logprobs,
+                     mesh=None):
+        out, cache = self.m.decode_steps(
+            spec, params, tokens, bts, lens, k, active, temps, topk, topp,
+            seeds, steps, n_steps=n_steps,
+        )
+        return out, cache, v
+
+    def extract_pages(self, k, v, page_ids):
+        # latent blocks [L, n, page, D]; the v slot stays inert (kept in
+        # kvbm/transfer payloads so block plumbing is shape-agnostic)
+        blocks = _extract_latent(k, page_ids)
+        n = page_ids.shape[0]
+        return blocks, jnp.zeros((1, n), jnp.int8)
+
+    def insert_pages(self, k, v, page_ids, kb, vb):
+        return _insert_latent(k, page_ids, kb), v
+
+    def embed_forward(self, spec, params, tokens, num_tokens):
+        raise NotImplementedError("MLA embeddings are not wired yet")
+
+
+@jax.jit
+def _extract_latent(cache, page_ids):
+    return cache[:, page_ids]
+
+
+@jax.jit
+def _insert_latent_impl(cache, page_ids, blocks):
+    return cache.at[:, page_ids].set(blocks)
+
+
+def _insert_latent(cache, page_ids, blocks):
+    return _insert_latent_impl(cache, page_ids, jnp.asarray(blocks))
+
+
+def get_family(spec: ModelSpec) -> Any:
+    return MlaFamily() if spec.is_mla else GqaFamily()
