@@ -234,7 +234,12 @@ class InferenceEngine:
     @property
     def is_dead(self) -> bool:
         """True when the step thread exited WITHOUT an orderly close —
-        the watchdog signal (ref VllmEngineMonitor / EngineDeadError)."""
+        the watchdog signal (ref VllmEngineMonitor / EngineDeadError).
+        A broken SPMD broadcast plane counts: once a descriptor publish
+        is lost, followers are out of lockstep and the next multi-host
+        collective would hang — surface it instead of deadlocking."""
+        if self.spmd is not None and not self.spmd.healthy and not self._closed:
+            return True
         return (
             self._thread is not None
             and not self._thread.is_alive()
@@ -961,7 +966,7 @@ class InferenceEngine:
                 )
                 self._note_moe_dropped(dropped)
             except Exception as e:  # noqa: BLE001
-                log.exception("packed prefill failed (%d prompts)", n)
+                log.exception("packed prefill failed (%d prompts)", len(group))
                 for p in group:
                     self.allocator.release(p["sp"].pages)
                     p["sp"].pages = []

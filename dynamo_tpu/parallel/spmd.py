@@ -71,6 +71,32 @@ class SpmdLeader:
         self.hub = hub
         self.loop = loop
         self.subject = SUBJECT_FMT.format(group=group)
+        # broadcast-plane health: a STICKY latch. One lost descriptor
+        # leaves followers permanently out of lockstep (there is no
+        # re-sync protocol), so a later successful publish must NOT
+        # clear the flag — the broken plane has to stay VISIBLE
+        # (EngineMonitor surfaces `healthy`) rather than silently
+        # deadlocking the next collective.
+        self.publish_failures = 0
+        self._broken = False
+
+    @property
+    def healthy(self) -> bool:
+        return not self._broken
+
+    def _on_publish_done(self, fut) -> None:
+        if fut.cancelled():
+            exc: BaseException | None = asyncio.CancelledError()
+        else:
+            exc = fut.exception()
+        if exc is not None:
+            self.publish_failures += 1
+            self._broken = True
+            log.error(
+                "spmd descriptor publish failed (%d total): %s — "
+                "followers are no longer in lockstep", self.publish_failures,
+                exc,
+            )
 
     def publish(self, op: str, scalars: dict[str, Any] | None = None,
                 arrays: dict[str, np.ndarray] | None = None) -> None:
@@ -79,9 +105,10 @@ class SpmdLeader:
             "scalars": scalars or {},
             "arrays": {k: _enc(np.asarray(v)) for k, v in (arrays or {}).items()},
         }
-        asyncio.run_coroutine_threadsafe(
+        fut = asyncio.run_coroutine_threadsafe(
             self.hub.publish(self.subject, msg), self.loop
         )
+        fut.add_done_callback(self._on_publish_done)
 
     def stop(self) -> None:
         self.publish("stop")

@@ -335,6 +335,58 @@ async def test_engine_on_tp_mesh_generates():
     await eng.close()
 
 
+def test_packed_prefill_failure_isolated_and_pages_released():
+    """A raising prefill_batch must fail ONLY its group's requests,
+    release their KV pages, and leave the engine able to admit new
+    prompts (the error handler previously NameError'd on an undefined
+    variable, failing every in-flight request and leaking the pages)."""
+    from dynamo_tpu.engine.core import _Waiting
+
+    eng = InferenceEngine(SPEC, small_config())
+    free0 = eng.allocator.free_pages
+
+    def make_preps():
+        preps = []
+        for i, n in enumerate((5, 6)):  # same bucket (8)
+            w = _Waiting(
+                request={
+                    "token_ids": list(range(3, 3 + n)),
+                    "stop_conditions": {"max_tokens": 4, "ignore_eos": True},
+                },
+                context=Context(),
+                out_q=asyncio.Queue(),
+            )
+            prep = eng._prefill(i, w)
+            assert isinstance(prep, dict)  # deferred to the packed stage
+            preps.append(prep)
+        return preps
+
+    preps = make_preps()
+    real_fam = eng.fam
+
+    class _Boom:
+        def __getattr__(self, k):
+            return getattr(real_fam, k)
+
+        def prefill_batch(self, *a, **kw):
+            raise RuntimeError("boom")
+
+    eng.fam = _Boom()
+    records = eng._run_packed_prefills(preps)
+    assert records == []
+    for prep in preps:
+        item = prep["waiting"].out_q.get_nowait()
+        assert item["finish_reason"] == "error"
+        assert "boom" in item["error"]
+        assert prep["sp"].pages == []
+    assert eng.allocator.free_pages == free0  # nothing leaked
+
+    # the engine recovers: the same admissions succeed afterwards
+    eng.fam = real_fam
+    records = eng._run_packed_prefills(make_preps())
+    assert len(records) == 2
+
+
 def test_packed_prefill_matches_singles():
     """prefill_forward_batch == N sequential prefill_forward calls:
     logits per prompt and every written page identical; padded rows
