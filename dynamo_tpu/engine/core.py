@@ -211,6 +211,14 @@ class InferenceEngine:
                 )
             )
 
+    def _spmd_broken(self, reason: str) -> None:
+        """A device dispatch failed AFTER its descriptor went out: the
+        followers replayed a program the leader abandoned, so multi-host
+        lockstep is gone — latch the plane broken (surfaced by is_dead)
+        instead of deadlocking the next collective."""
+        if self.spmd is not None:
+            self.spmd.mark_broken(reason)
+
     def _post(self, q: asyncio.Queue, item: Any) -> None:
         """Thread-safe queue put: compute threads must not touch asyncio
         primitives directly."""
@@ -355,6 +363,7 @@ class InferenceEngine:
                 # fail every in-flight request, then KEEP SERVING: one bad
                 # step must not brick the worker
                 log.exception("engine step failed; failing in-flight requests")
+                self._spmd_broken("step failed after descriptors published")
                 # queued offloads may reference pages about to be released
                 self._pending_offload.clear()
                 self._pipeline = None  # discard any in-flight burst
@@ -967,6 +976,7 @@ class InferenceEngine:
                 self._note_moe_dropped(dropped)
             except Exception as e:  # noqa: BLE001
                 log.exception("packed prefill failed (%d prompts)", len(group))
+                self._spmd_broken("packed prefill failed after publish")
                 for p in group:
                     self.allocator.release(p["sp"].pages)
                     p["sp"].pages = []
@@ -997,6 +1007,7 @@ class InferenceEngine:
             )
         except Exception as e:  # noqa: BLE001
             log.exception("prefill failed for %s", p["waiting"].context.id)
+            self._spmd_broken("prefill failed after publish")
             self.allocator.release(p["sp"].pages)
             p["sp"].pages = []
             self._post(

@@ -12,9 +12,10 @@ core. Two families today:
   array. The engine's (k_pages, v_pages) plumbing carries the latent
   cache as ``k_pages`` and a tiny inert placeholder as ``v_pages`` so
   page bookkeeping, KVBM tier blocks, and transfer metadata flow
-  unchanged. Capability flags gate what MLA does not support yet
-  (packed/ring prefill, meshes, logprobs, embeddings) — the engine
-  falls back to the single-prompt paths and rejects the rest cleanly.
+  unchanged. Supports meshes (tp over heads, ep over experts,
+  replicated latent cache) and packed prefill; capability flags gate
+  the rest (ring prefill, logprobs, embeddings) — the engine falls
+  back to the single-prompt paths and rejects the rest cleanly.
 
 Ref: the reference delegates this dispatch to its engines (vLLM model
 registry); here it is explicit and small.
@@ -95,11 +96,18 @@ class GqaFamily:
 
 class MlaFamily:
     """DeepSeek MLA adapter: latent cache rides the k_pages slot; the
-    v_pages slot carries an inert [1] placeholder everywhere."""
+    v_pages slot carries an inert [1] placeholder everywhere.
 
-    supports_packed_prefill = False
+    Mesh story (deepseek-r1-class serving): per-head work shards over
+    "tp", experts over "ep" (mla.param_shardings), and the latent cache
+    replicates — it has no head axis and is ~14x smaller than GQA KV, so
+    every rank decodes against a local copy with no gather collective.
+    Ref topology: recipes/deepseek-r1/sglang-wideep/
+    tep16p-dep16d-disagg.yaml:63 (--ep-size 16)."""
+
+    supports_packed_prefill = True
     supports_ring_prefill = False
-    supports_mesh = False
+    supports_mesh = True
     supports_logprobs = False
     supports_embeddings = False
 
@@ -112,10 +120,11 @@ class MlaFamily:
         return self.m.init_params(spec, key)
 
     def param_shardings(self, spec, mesh):
-        raise NotImplementedError("MLA TP shardings are not wired yet")
+        return self.m.param_shardings(spec, mesh)
 
     def cache_shardings(self, mesh):
-        raise NotImplementedError("MLA cache shardings are not wired yet")
+        s = self.m.cache_shardings(mesh)
+        return s, s  # placeholder v_pages is replicated too
 
     def init_cache(self, spec, num_pages, page_size):
         cache = self.m.init_cache(spec, num_pages, page_size)
@@ -123,9 +132,16 @@ class MlaFamily:
 
     def prefill(self, spec, params, tokens, bt, start, k, v, n, mesh=None):
         logits, cache = self.m.prefill_forward(
-            spec, params, tokens, bt, start, k, n
+            spec, params, tokens, bt, start, k, n, mesh=mesh
         )
         # engine contract: (logits, k, v, moe_dropped)
+        return logits, cache, v, jnp.zeros((), jnp.int32)
+
+    def prefill_batch(self, spec, params, tokens, bts, starts, k, v, ns,
+                      mesh=None):
+        logits, cache = self.m.prefill_forward_batch(
+            spec, params, tokens, bts, starts, k, ns, mesh=mesh
+        )
         return logits, cache, v, jnp.zeros((), jnp.int32)
 
     def decode_steps(self, spec, params, tokens, bts, lens, k, v, active,
@@ -133,7 +149,7 @@ class MlaFamily:
                      mesh=None):
         out, cache = self.m.decode_steps(
             spec, params, tokens, bts, lens, k, active, temps, topk, topp,
-            seeds, steps, n_steps=n_steps,
+            seeds, steps, n_steps=n_steps, mesh=mesh,
         )
         return out, cache, v
 

@@ -40,8 +40,12 @@ from typing import Any
 import jax
 import jax.numpy as jnp
 
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
 from dynamo_tpu.engine.config import ModelSpec
-from dynamo_tpu.models.llama import TRASH_PAGE, _logits, rms_norm, rope
+from dynamo_tpu.models.llama import (
+    TRASH_PAGE, _logits, _replicate, rms_norm, rope,
+)
 
 Params = dict[str, Any]
 
@@ -122,6 +126,71 @@ def init_cache(
     return jnp.zeros(
         (spec.num_layers, num_pages, page_size, latent_dim(spec)), dtype
     )
+
+
+def param_shardings(spec: ModelSpec, mesh: Mesh) -> Params:
+    """TP shardings for MLA: the head axis is the parallel axis.
+
+    The latent path (w_kv_a, kv_norm) is REPLICATED — the whole point of
+    MLA is that the per-token latent is tiny and shared across heads, so
+    every tp rank computes the full latent row locally (no collective)
+    and per-head work (q projection, absorbed w_uk/w_uv, wo) shards over
+    "tp". Experts shard over "ep" via moe_layer_shardings, matching the
+    wide-EP layout the reference deploys DeepSeek-R1 with
+    (recipes/deepseek-r1/sglang-wideep/tep16p-dep16d-disagg.yaml:63)."""
+
+    def ns(*axes):
+        return NamedSharding(mesh, P(*axes))
+
+    layers = []
+    for li in range(spec.num_layers):
+        layer: Params = {
+            "attn_norm": ns(),
+            "mlp_norm": ns(),
+            "w_kv_a": ns(),
+            "kv_norm": ns(),
+            "w_uk": ns("tp", None, None),  # heads
+            "w_uv": ns("tp", None, None),
+            "wo": ns("tp", None),  # row-parallel over flattened heads
+        }
+        if spec.q_lora_rank:
+            layer["wq_a"] = ns()
+            layer["q_norm"] = ns()
+            layer["wq_b"] = ns(None, "tp")  # column (heads major)
+        else:
+            layer["wq"] = ns(None, "tp")
+        if spec.num_experts and li >= spec.first_k_dense:
+            from dynamo_tpu.models import moe
+
+            layer["moe"] = moe.moe_layer_shardings(mesh)
+            if spec.n_shared_experts:
+                layer["shared"] = {
+                    "w_gate": ns(None, "tp"),
+                    "w_up": ns(None, "tp"),
+                    "w_down": ns("tp", None),
+                }
+        else:
+            layer["w_gate"] = ns(None, "tp")
+            layer["w_up"] = ns(None, "tp")
+            layer["w_down"] = ns("tp", None)
+        layers.append(layer)
+    out = {
+        "embed": ns(None, "tp"),
+        "final_norm": ns(),
+        "layers": layers,
+    }
+    if not spec.tie_embeddings:
+        out["lm_head"] = ns(None, "tp")
+    return out
+
+
+def cache_shardings(mesh: Mesh) -> NamedSharding:
+    """Latent cache [L, pages, page, d_c + d_r]: REPLICATED across the
+    mesh. There is no head axis to split — the latent row is shared by
+    every head — and at ~14x compression vs GQA the duplication is the
+    cheap side of the trade (each rank attends against its local copy
+    with zero gather collectives in the decode hot loop)."""
+    return NamedSharding(mesh, P())
 
 
 # --------------------------------------------------------------- pieces
@@ -253,6 +322,7 @@ def prefill_forward_impl(
     start_pos: jax.Array,  # scalar (page-aligned)
     cache: jax.Array,  # [L, pages, page, D] (donated)
     num_tokens: jax.Array,  # scalar
+    mesh: Mesh | None = None,  # static: replicate logits across the mesh
 ) -> tuple[jax.Array, jax.Array]:
     """One prompt; writes latent rows page-granularly; returns
     (last_logits, cache). Mirrors llama.prefill_forward_impl."""
@@ -286,11 +356,82 @@ def prefill_forward_impl(
         hh = rms_norm(x, lp["mlp_norm"], spec.rms_eps)
         x = x + _ffn(spec, li, lp, hh)
     last = jnp.clip(num_tokens - 1, 0, T - 1)
-    return _logits_all(spec, params, x)[last], cache
+    return _replicate(_logits_all(spec, params, x)[last], mesh), cache
 
 
 prefill_forward = jax.jit(
-    prefill_forward_impl, static_argnums=(0,), donate_argnums=(5,)
+    prefill_forward_impl, static_argnums=(0,),
+    static_argnames=("mesh",), donate_argnums=(5,)
+)
+
+
+def prefill_forward_batch_impl(
+    spec: ModelSpec,
+    params: Params,
+    tokens: jax.Array,  # [N, T_pad]
+    block_tables: jax.Array,  # [N, max_pages_per_seq]
+    start_pos: jax.Array,  # [N] (page-aligned)
+    cache: jax.Array,  # donated
+    num_tokens: jax.Array,  # [N]
+    mesh: Mesh | None = None,  # static
+) -> tuple[jax.Array, jax.Array]:
+    """N prompts in ONE dispatch — MLA's packed-prefill admission path
+    (mirrors llama.prefill_forward_batch_impl: matmuls batch over
+    [N, T, d], the latent write is one page-tile scatter, absorbed
+    attention runs per prompt over its own table). Returns
+    (last_logits [N, V], cache)."""
+    N, T = tokens.shape
+    page_size = cache.shape[2]
+    idx = jnp.arange(T)
+    positions = start_pos[:, None] + idx[None, :]  # [N, T]
+    n_pg = T // page_size
+    page_starts = start_pos[:, None] + (
+        jnp.arange(n_pg) * page_size
+    )[None, :]  # [N, n_pg]
+    pg_idx_raw = jnp.take_along_axis(
+        block_tables, page_starts // page_size, axis=1
+    )
+    valid_pg = page_starts < (start_pos + num_tokens)[:, None]
+    safe_pg = jnp.where(valid_pg, pg_idx_raw, TRASH_PAGE).reshape(N * n_pg)
+
+    x = params["embed"][tokens]  # [N, T, d]
+    kv_len = start_pos + num_tokens  # [N]
+    max_ctx = block_tables.shape[1] * page_size
+    ctx_pos = jnp.arange(max_ctx)
+    for li, lp in enumerate(params["layers"]):
+        h = rms_norm(x, lp["attn_norm"], spec.rms_eps)
+        q_nope, q_rope = jax.vmap(
+            lambda hh, pos: _q_heads(spec, lp, hh, pos)
+        )(h, positions)  # [N, T, H, dn] / [N, T, H, dr]
+        new_rows = jax.vmap(
+            lambda hh, pos: _latent_row(spec, lp, hh, pos)
+        )(h, positions)  # [N, T, D]
+        cache = cache.at[li, safe_pg].set(
+            new_rows.reshape(N * n_pg, page_size, -1).astype(cache.dtype)
+        )
+
+        def one_attn(qn, qr, bt, pos, kvl, cache_l=cache[li], lp=lp):
+            rows = _gather_rows(cache_l, bt)  # [max_ctx, D]
+            mask = (ctx_pos[None, :] <= pos[:, None]) & (
+                ctx_pos[None, :] < kvl
+            )
+            return _absorbed_attention(spec, lp, qn, qr, rows, mask)
+
+        attn = jax.vmap(one_attn)(
+            q_nope, q_rope, block_tables, positions, kv_len
+        )  # [N, T, H, dv]
+        x = x + attn.reshape(N, T, -1).astype(x.dtype) @ lp["wo"]
+        hh = rms_norm(x, lp["mlp_norm"], spec.rms_eps)
+        x = x + _ffn(spec, li, lp, hh.reshape(N * T, -1)).reshape(N, T, -1)
+
+    last = jnp.clip(num_tokens - 1, 0, T - 1)  # [N]
+    x_last = jnp.take_along_axis(x, last[:, None, None], axis=1)[:, 0]
+    return _replicate(_logits_all(spec, params, x_last), mesh), cache
+
+
+prefill_forward_batch = jax.jit(
+    prefill_forward_batch_impl, static_argnums=(0,),
+    static_argnames=("mesh",), donate_argnums=(5,)
 )
 
 
@@ -302,6 +443,7 @@ def decode_forward_impl(
     seq_lens: jax.Array,  # [B] incl. the new token
     cache: jax.Array,  # donated
     active: jax.Array,  # [B] bool
+    mesh: Mesh | None = None,  # static
 ) -> tuple[jax.Array, jax.Array]:
     """One decode step (absorbed latent attention); returns (logits, cache)."""
     B = tokens.shape[0]
@@ -334,11 +476,12 @@ def decode_forward_impl(
         x = x + attn.reshape(B, -1).astype(x.dtype) @ lp["wo"]
         hh = rms_norm(x, lp["mlp_norm"], spec.rms_eps)
         x = x + _ffn(spec, li, lp, hh)
-    return _logits_all(spec, params, x), cache
+    return _replicate(_logits_all(spec, params, x), mesh), cache
 
 
 decode_forward = jax.jit(
-    decode_forward_impl, static_argnums=(0,), donate_argnums=(5,)
+    decode_forward_impl, static_argnums=(0,),
+    static_argnames=("mesh",), donate_argnums=(5,)
 )
 
 
@@ -356,6 +499,7 @@ def decode_steps_impl(
     seeds: jax.Array,
     steps: jax.Array,
     n_steps: int = 1,
+    mesh: Mesh | None = None,  # static
 ):
     """Fused multi-step MLA decode + on-device sampling (the serving hot
     loop; mirrors llama.decode_steps for the GQA family)."""
@@ -367,7 +511,8 @@ def decode_steps_impl(
     def body(i, carry):
         toks, lens, cache, out = carry
         logits, cache = decode_forward_impl(
-            spec, params, toks, block_tables, lens, cache, active
+            spec, params, toks, block_tables, lens, cache, active,
+            mesh=mesh,
         )
         nxt = sample_tokens(logits, temperature, top_k, top_p, seeds,
                             steps + i)
@@ -378,9 +523,10 @@ def decode_steps_impl(
     _t, _l, cache, out = jax.lax.fori_loop(
         0, n_steps, body, (tokens, seq_lens, cache, out0)
     )
-    return out, cache
+    return _replicate(out, mesh), cache
 
 
 decode_steps = jax.jit(
-    decode_steps_impl, static_argnums=(0,), static_argnames=("n_steps",)
+    decode_steps_impl, static_argnums=(0,),
+    static_argnames=("n_steps", "mesh"),
 )

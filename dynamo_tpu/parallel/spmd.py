@@ -84,6 +84,15 @@ class SpmdLeader:
     def healthy(self) -> bool:
         return not self._broken
 
+    def mark_broken(self, reason: str) -> None:
+        """Latch the plane broken for a POST-publish failure: the local
+        dispatch raised after its descriptor already went out, so
+        followers replayed (or are blocked inside) a program the leader
+        abandoned — lockstep is gone even though the publish worked."""
+        if not self._broken:
+            log.error("spmd plane broken: %s", reason)
+        self._broken = True
+
     def _on_publish_done(self, fut) -> None:
         if fut.cancelled():
             exc: BaseException | None = asyncio.CancelledError()
@@ -129,9 +138,8 @@ class SpmdFollower:
         self.engine = engine
 
     async def run(self) -> None:
-        from dynamo_tpu.models import llama
-
         eng = self.engine
+        fam = eng.fam  # family adapter: replay works for GQA AND MLA
         spec, mesh = eng.spec, eng.mesh
         log.info("spmd follower replaying %s", self.subject)
         async for _subj, msg in self.hub.subscribe(self.subject, replay=True):
@@ -142,9 +150,11 @@ class SpmdFollower:
                 log.info("spmd follower: leader stopped")
                 return
             # every branch matches one leader dispatch site in
-            # engine/core.py; keep in lockstep with it
+            # engine/core.py; keep in lockstep with it. All model calls
+            # go through the family adapter so the compiled programs are
+            # the leader's exact entry points for this architecture.
             if op == "prefill":
-                _logits, eng.k_pages, eng.v_pages, _d = llama.prefill_forward(
+                _logits, eng.k_pages, eng.v_pages, _d = fam.prefill(
                     spec, eng.params,
                     jnp_i32(ar["tokens"]), jnp_i32(ar["block_table"]),
                     jnp_scalar(sc["start"]), eng.k_pages, eng.v_pages,
@@ -152,7 +162,7 @@ class SpmdFollower:
                 )
             elif op == "ring_prefill":
                 (_logits, eng.k_pages, eng.v_pages,
-                 _d) = llama.prefill_forward_ring(
+                 _d) = fam.prefill_ring(
                     spec, eng.params,
                     jnp_i32(ar["tokens"]), jnp_i32(ar["block_table"]),
                     eng.k_pages, eng.v_pages,
@@ -160,7 +170,7 @@ class SpmdFollower:
                 )
             elif op == "prefill_batch":
                 (_lg, eng.k_pages, eng.v_pages,
-                 _d) = llama.prefill_forward_batch(
+                 _d) = fam.prefill_batch(
                     spec, eng.params,
                     jnp_i32(ar["tokens"]), jnp_i32(ar["block_tables"]),
                     jnp_i32(ar["start"]), eng.k_pages, eng.v_pages,
@@ -171,7 +181,7 @@ class SpmdFollower:
                 # (this process keeps its shard) and offer them to the
                 # local KVBM tiers (ref KvbmWorker, distributed/worker.rs)
                 ids = jnp_i32(ar["page_ids"])
-                kb, vb = llama.extract_kv_pages(eng.k_pages, eng.v_pages, ids)
+                kb, vb = fam.extract_pages(eng.k_pages, eng.v_pages, ids)
                 try:
                     kb.copy_to_host_async()
                     vb.copy_to_host_async()
@@ -189,7 +199,7 @@ class SpmdFollower:
             elif op == "decode":
                 import jax.numpy as jnp
 
-                result = llama.decode_steps(
+                result = fam.decode_steps(
                     spec, eng.params,
                     jnp_i32(ar["tokens"]), jnp_i32(ar["block_tables"]),
                     jnp_i32(ar["seq_lens"]), eng.k_pages, eng.v_pages,

@@ -1,6 +1,8 @@
 """MLA (DeepSeek-family latent attention): paged/absorbed forms vs the
 dense non-absorbed reference (models/mla.py)."""
 
+import asyncio
+
 import numpy as np
 
 import jax
@@ -120,6 +122,143 @@ def test_fused_decode_steps_matches_stepwise():
         temps, topk, topp, seeds, gen, n_steps=3,
     )
     np.testing.assert_array_equal(np.asarray(out), want)
+
+
+def test_packed_prefill_matches_singles():
+    """MLA prefill_forward_batch == N sequential prefill_forward calls:
+    per-prompt logits and every written latent page identical."""
+    params = mla.init_params(SPEC, jax.random.PRNGKey(7))
+    rng = np.random.default_rng(1)
+    prompts = [list(rng.integers(3, SPEC.vocab_size, n)) for n in (7, 11, 5)]
+    T, N, mpps = 12, 4, 4  # one padded row
+    tokens = np.zeros((N, T), np.int32)
+    bts = np.zeros((N, mpps), np.int32)
+    starts = np.zeros((N,), np.int32)
+    nts = np.zeros((N,), np.int32)
+    next_page = 1
+    for i, pr in enumerate(prompts):
+        tokens[i, : len(pr)] = pr
+        npg = (len(pr) + PAGE - 1) // PAGE
+        bts[i, :npg] = np.arange(next_page, next_page + npg)
+        next_page += npg
+        nts[i] = len(pr)
+
+    cb = mla.init_cache(SPEC, 16, PAGE)
+    lg_b, cb = mla.prefill_forward_batch(
+        SPEC, params, jnp.asarray(tokens), jnp.asarray(bts),
+        jnp.asarray(starts), cb, jnp.asarray(nts),
+    )
+
+    cs = mla.init_cache(SPEC, 16, PAGE)
+    for i, pr in enumerate(prompts):
+        lg_s, cs = mla.prefill_forward(
+            SPEC, params, jnp.asarray(tokens[i]), jnp.asarray(bts[i]),
+            jnp.asarray(0, jnp.int32), cs, jnp.asarray(nts[i], jnp.int32),
+        )
+        np.testing.assert_allclose(
+            np.asarray(lg_b[i]), np.asarray(lg_s), rtol=2e-4, atol=2e-4
+        )
+    np.testing.assert_allclose(
+        np.asarray(cb[:, 1:next_page]), np.asarray(cs[:, 1:next_page]),
+        atol=1e-5,
+    )
+
+
+def test_mesh_prefill_decode_match_single_device():
+    """The SAME MLA programs under a tp=2 x ep=2 mesh (params sharded per
+    param_shardings, latent cache replicated) produce single-device
+    numerics — the deepseek-r1 scaling contract (VERDICT r3 item 1)."""
+    from dynamo_tpu.parallel.mesh import make_mesh
+
+    params = mla.init_params(SPEC, jax.random.PRNGKey(11))
+    T = 11
+    tokens = np.zeros((16,), np.int32)
+    tokens[:T] = np.arange(T) % SPEC.vocab_size
+    bt = jnp.asarray([1, 2, 3, 4, 0, 0, 0, 0], jnp.int32)
+
+    # single device
+    c0 = mla.init_cache(SPEC, 16, PAGE)
+    lg0, c0 = mla.prefill_forward(
+        SPEC, params, jnp.asarray(tokens), bt, jnp.asarray(0, jnp.int32),
+        c0, jnp.asarray(T, jnp.int32),
+    )
+
+    mesh = make_mesh(tp=2, ep=2)
+    sharded = jax.tree.map(
+        lambda p, s: jax.device_put(p, s), params,
+        mla.param_shardings(SPEC, mesh),
+    )
+    cm = jax.device_put(mla.init_cache(SPEC, 16, PAGE),
+                        mla.cache_shardings(mesh))
+    lgm, cm = mla.prefill_forward(
+        SPEC, sharded, jnp.asarray(tokens), bt, jnp.asarray(0, jnp.int32),
+        cm, jnp.asarray(T, jnp.int32), mesh=mesh,
+    )
+    np.testing.assert_allclose(
+        np.asarray(lgm), np.asarray(lg0), rtol=2e-4, atol=2e-4
+    )
+
+    # fused greedy decode continues identically on both
+    toks = jnp.asarray([int(np.argmax(np.asarray(lg0)))], jnp.int32)
+    bts = bt[None]
+    lens = jnp.asarray([T + 1], jnp.int32)
+    active = jnp.ones((1,), bool)
+    temps = jnp.zeros((1,), jnp.float32)
+    topk = jnp.zeros((1,), jnp.int32)
+    topp = jnp.ones((1,), jnp.float32)
+    seeds = jnp.zeros((1,), jnp.uint32)
+    gen = jnp.zeros((1,), jnp.int32)
+    out0, _ = mla.decode_steps(
+        SPEC, params, toks, bts, lens, c0, active, temps, topk, topp,
+        seeds, gen, n_steps=4,
+    )
+    outm, _ = mla.decode_steps(
+        SPEC, sharded, toks, bts, lens, cm, active, temps, topk, topp,
+        seeds, gen, n_steps=4, mesh=mesh,
+    )
+    np.testing.assert_array_equal(np.asarray(outm), np.asarray(out0))
+
+
+async def test_deepseek_serves_through_engine_on_mesh():
+    """tiny-deepseek through the REAL engine on a tp=2 x ep=2 mesh,
+    packed prefill on: output must equal the single-device engine's."""
+    from dynamo_tpu.engine.config import EngineConfig
+    from dynamo_tpu.engine.core import InferenceEngine
+    from dynamo_tpu.parallel.mesh import make_mesh
+    from dynamo_tpu.runtime.context import Context
+
+    cfg = dict(
+        page_size=4, num_pages=64, max_pages_per_seq=8,
+        max_decode_slots=2, prefill_buckets=(16, 32),
+    )
+
+    async def run(engine, prompt):
+        out = []
+        async for item in engine.generate(
+            {"token_ids": list(prompt),
+             "sampling": {"temperature": 0.0},
+             "stop_conditions": {"max_tokens": 6, "ignore_eos": True}},
+            Context(),
+        ):
+            assert item.get("finish_reason") != "error", item
+            out.extend(item.get("token_ids") or [])
+        return out
+
+    prompt = list(range(11, 24))
+    e0 = InferenceEngine(SPEC, EngineConfig(**cfg))
+    want = await run(e0, prompt)
+    await e0.close()
+
+    em = InferenceEngine(SPEC, EngineConfig(**cfg), mesh=make_mesh(tp=2, ep=2))
+    got = await run(em, prompt)
+    # two concurrent same-bucket prompts: the packed MLA path under mesh
+    got2, got3 = await asyncio.gather(
+        run(em, prompt), run(em, list(range(30, 44)))
+    )
+    await em.close()
+    assert got == want
+    assert got2 == want
+    assert len(got3) == 6
 
 
 def test_deepseek_checkpoint_loads(tmp_path):
