@@ -36,6 +36,43 @@ class ModelSpec:
     qk_rope_head_dim: int = 0  # decoupled-RoPE key dim, shared across heads
     v_head_dim: int = 0
     q_lora_rank: int = 0  # query low-rank compression (0 = full q_proj)
+    # gpt-oss attention extras (ref recipes/gpt-oss-120b; HF GptOssConfig)
+    sliding_window: int = 0  # 0 = full attention everywhere
+    layer_types: tuple[str, ...] = ()  # per-layer "sliding_attention" /
+    # "full_attention"; empty + sliding_window>0 = every layer windowed
+    attn_sinks: bool = False  # learned per-head sink logits in softmax
+    attn_bias: bool = False  # q/k/v/o projection biases
+    moe_bias: bool = False  # router + expert (gate_up/down) biases
+    swiglu_limit: float = 0.0  # clamped swiglu bound (gpt-oss 7.0); 0 = off
+    swiglu_alpha: float = 0.0  # swish slope inside clamp (gpt-oss 1.702)
+    # YaRN rope scaling (gpt-oss, DeepSeek-R1; HF _compute_yarn_parameters)
+    rope_scaling_factor: float = 0.0  # 0 = no scaling
+    rope_orig_max_pos: int = 0
+    rope_beta_fast: float = 32.0
+    rope_beta_slow: float = 1.0
+    rope_mscale: float = 0.0  # 0 = unset
+    rope_mscale_all_dim: float = 0.0
+    rope_truncate: bool = True  # floor/ceil the correction range bounds
+    # checkpoint stores rope dims pair-interleaved (DeepSeek MLA weights);
+    # the loader de-interleaves q_rope/k_rope projection columns to our
+    # half-split convention — exact, since both sides of every rope-dim
+    # dot product get the same permutation
+    rope_interleave: bool = False
+
+    def attn_window(self, li: int) -> int:
+        """Sliding-window size for layer ``li`` (0 = full attention)."""
+        if not self.sliding_window:
+            return 0
+        if self.layer_types:
+            return (
+                self.sliding_window
+                if self.layer_types[li] == "sliding_attention" else 0
+            )
+        return self.sliding_window
+
+    @property
+    def has_attn_extras(self) -> bool:
+        return bool(self.sliding_window or self.attn_sinks)
 
     @classmethod
     def llama3_8b(cls) -> "ModelSpec":
@@ -88,13 +125,46 @@ class ModelSpec:
 
     @classmethod
     def gpt_oss_120b(cls) -> "ModelSpec":
-        """Wide-EP config (ref: engine_configs gpt-oss-120b recipes)."""
+        """Wide-EP config (ref: engine_configs gpt-oss-120b recipes), with
+        the full attention feature set: alternating sliding-window/full
+        layers, attention sinks, projection + expert biases, clamped
+        swiglu, YaRN rope (HF GptOssConfig values)."""
         return cls(
             name="gpt-oss-120b", vocab_size=201088, hidden_size=2880,
             intermediate_size=2880, num_layers=36, num_heads=64,
             num_kv_heads=8, head_dim=64, tie_embeddings=False,
+            rope_theta=150000.0,
             num_experts=128, num_experts_per_token=4,
             moe_intermediate_size=2880,
+            sliding_window=128,
+            layer_types=tuple(
+                "sliding_attention" if i % 2 == 0 else "full_attention"
+                for i in range(36)
+            ),
+            attn_sinks=True, attn_bias=True, moe_bias=True,
+            swiglu_limit=7.0, swiglu_alpha=1.702,
+            rope_scaling_factor=32.0, rope_orig_max_pos=4096,
+            rope_truncate=False,
+        )
+
+    @classmethod
+    def tiny_gpt_oss(cls) -> "ModelSpec":
+        """Toy gpt-oss architecture at test scale: every flagship
+        attention extra on (sinks, alternating sliding windows, biases,
+        clamped swiglu, YaRN)."""
+        return cls(
+            name="tiny-gpt-oss", vocab_size=96, hidden_size=32,
+            intermediate_size=32, num_layers=2, num_heads=4,
+            num_kv_heads=2, head_dim=8, dtype="float32",
+            tie_embeddings=False, rope_theta=150000.0,
+            num_experts=4, num_experts_per_token=2,
+            moe_intermediate_size=32,
+            sliding_window=8,
+            layer_types=("sliding_attention", "full_attention"),
+            attn_sinks=True, attn_bias=True, moe_bias=True,
+            swiglu_limit=7.0, swiglu_alpha=1.702,
+            rope_scaling_factor=32.0, rope_orig_max_pos=4096,
+            rope_truncate=False,
         )
 
     @classmethod
@@ -106,6 +176,9 @@ class ModelSpec:
             intermediate_size=18432, num_layers=61, num_heads=128,
             num_kv_heads=128, head_dim=128, tie_embeddings=False,
             rope_theta=10000.0,
+            rope_scaling_factor=40.0, rope_orig_max_pos=4096,
+            rope_mscale=1.0, rope_mscale_all_dim=1.0,
+            rope_interleave=True,
             num_experts=256, num_experts_per_token=8,
             moe_intermediate_size=2048, n_shared_experts=1,
             first_k_dense=3,
@@ -137,6 +210,7 @@ class ModelSpec:
             "tiny-test": cls.tiny,
             "tiny-moe": cls.tiny_moe,
             "tiny-deepseek": cls.tiny_deepseek,
+            "tiny-gpt-oss": cls.tiny_gpt_oss,
             "llama-3-8b": cls.llama3_8b,
             "llama-3-70b": cls.llama3_70b,
             "mixtral-8x7b": cls.mixtral_8x7b,

@@ -211,13 +211,23 @@ class InferenceEngine:
                 )
             )
 
-    def _spmd_broken(self, reason: str) -> None:
+    def _spmd_mark(self) -> int:
+        """Publish-count watermark for scoping failures to actual sends."""
+        return self.spmd.publish_count if self.spmd is not None else 0
+
+    def _spmd_broken(self, reason: str, since: int | None = None) -> None:
         """A device dispatch failed AFTER its descriptor went out: the
         followers replayed a program the leader abandoned, so multi-host
         lockstep is gone — latch the plane broken (surfaced by is_dead)
-        instead of deadlocking the next collective."""
-        if self.spmd is not None:
-            self.spmd.mark_broken(reason)
+        instead of deadlocking the next collective. With ``since`` (a
+        _spmd_mark watermark), only latch if something was actually
+        published after it — failures before any publish are recoverable
+        and must NOT kill the worker."""
+        if self.spmd is None:
+            return
+        if since is not None and self.spmd.publish_count == since:
+            return
+        self.spmd.mark_broken(reason)
 
     def _post(self, q: asyncio.Queue, item: Any) -> None:
         """Thread-safe queue put: compute threads must not touch asyncio
@@ -348,6 +358,7 @@ class InferenceEngine:
         except via thread-safe _post. Blocking waits are fine here."""
         while not self._closed:
             try:
+                step_mark = self._spmd_mark()
                 did_work = self._step()
                 if not did_work:
                     self._wake.clear()
@@ -363,7 +374,9 @@ class InferenceEngine:
                 # fail every in-flight request, then KEEP SERVING: one bad
                 # step must not brick the worker
                 log.exception("engine step failed; failing in-flight requests")
-                self._spmd_broken("step failed after descriptors published")
+                self._spmd_broken(
+                    "step failed after descriptors published", since=step_mark
+                )
                 # queued offloads may reference pages about to be released
                 self._pending_offload.clear()
                 self._pipeline = None  # discard any in-flight burst
@@ -958,6 +971,7 @@ class InferenceEngine:
                 bts[i, : p["sp"].num_pages] = p["sp"].pages
                 starts[i] = p["start_pos"]
                 nts[i] = p["tail"]
+            pmark = self._spmd_mark()
             try:
                 if self.spmd is not None:
                     self.spmd.publish(
@@ -976,7 +990,9 @@ class InferenceEngine:
                 self._note_moe_dropped(dropped)
             except Exception as e:  # noqa: BLE001
                 log.exception("packed prefill failed (%d prompts)", len(group))
-                self._spmd_broken("packed prefill failed after publish")
+                self._spmd_broken(
+                    "packed prefill failed after publish", since=pmark
+                )
                 for p in group:
                     self.allocator.release(p["sp"].pages)
                     p["sp"].pages = []
@@ -996,6 +1012,7 @@ class InferenceEngine:
         return records
 
     def _single_prefill_record(self, p: dict) -> tuple | None:
+        pmark = self._spmd_mark()
         try:
             logits = self._run_prefill_chunk(
                 p["sp"], p["token_ids"], p["start_pos"], len(p["token_ids"])
@@ -1007,7 +1024,7 @@ class InferenceEngine:
             )
         except Exception as e:  # noqa: BLE001
             log.exception("prefill failed for %s", p["waiting"].context.id)
-            self._spmd_broken("prefill failed after publish")
+            self._spmd_broken("prefill failed after publish", since=pmark)
             self.allocator.release(p["sp"].pages)
             p["sp"].pages = []
             self._post(

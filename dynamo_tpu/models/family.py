@@ -42,10 +42,14 @@ class GqaFamily:
     supports_logprobs = True
     supports_embeddings = True
 
-    def __init__(self):
+    def __init__(self, spec: Any | None = None):
         from dynamo_tpu.models import llama
 
         self.m = llama
+        # ring attention has no sink/sliding-window support: gpt-oss-like
+        # specs fall back to chunked prefill for long prompts
+        if spec is not None and spec.has_attn_extras:
+            self.supports_ring_prefill = False
 
     def init_params(self, spec, key):
         return self.m.init_params(spec, key)
@@ -182,4 +186,4 @@ def _insert_latent(cache, page_ids, blocks):
 
 
 def get_family(spec: ModelSpec) -> Any:
-    return MlaFamily() if spec.is_mla else GqaFamily()
+    return MlaFamily() if spec.is_mla else GqaFamily(spec)

@@ -65,6 +65,15 @@ def init_params(spec: ModelSpec, key: jax.Array) -> Params:
             "wo": dense(next(keys), (nh * hd, d)),
             "mlp_norm": jnp.ones((d,), dtype),
         }
+        if spec.attn_bias:
+            layer.update(
+                bq=jnp.zeros((nh * hd,), dtype),
+                bk=jnp.zeros((nkv * hd,), dtype),
+                bv=jnp.zeros((nkv * hd,), dtype),
+                bo=jnp.zeros((d,), dtype),
+            )
+        if spec.attn_sinks:
+            layer["sinks"] = jnp.zeros((nh,), dtype)
         if spec.num_experts:
             from dynamo_tpu.models import moe
 
@@ -93,10 +102,14 @@ def param_shardings(spec: ModelSpec, mesh: Mesh) -> Params:
         "wo": ns("tp", None),  # row
         "mlp_norm": ns(),
     }
+    if spec.attn_bias:
+        layer.update(bq=ns("tp"), bk=ns("tp"), bv=ns("tp"), bo=ns())
+    if spec.attn_sinks:
+        layer["sinks"] = ns("tp")  # per-query-head, rides the head shards
     if spec.num_experts:
         from dynamo_tpu.models import moe
 
-        layer["moe"] = moe.moe_layer_shardings(mesh)
+        layer["moe"] = moe.moe_layer_shardings(mesh, spec)
     else:
         layer.update(
             w_gate=ns(None, "tp"),
@@ -146,14 +159,80 @@ def rms_norm(x: jax.Array, w: jax.Array, eps: float) -> jax.Array:
     return (xf * jax.lax.rsqrt(var + eps)).astype(x.dtype) * w
 
 
-def rope(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:
-    """Rotary embedding. x: [T, heads, D], positions: [T]."""
+def yarn_get_mscale(scale: float, m: float = 1.0) -> float:
+    """HF yarn_get_mscale: the single source for the YaRN attention
+    temperature formula (shared by yarn_freqs and mla.softmax_scale)."""
+    import math
+
+    return 0.1 * m * math.log(scale) + 1.0 if scale > 1 else 1.0
+
+
+def yarn_freqs(spec: ModelSpec, dim: int):
+    """YaRN-corrected inverse frequencies + cos/sin attention factor.
+
+    Returns ``(inv_freq [dim//2] | None, attention_factor)``; None = no
+    scaling configured. Semantics match HF ``_compute_yarn_parameters``
+    (transformers modeling_rope_utils) so checkpoints that ship YaRN
+    configs — gpt-oss (factor 32, truncate off) and DeepSeek-R1 (factor
+    40, mscale 1) — reproduce HF numerics exactly."""
+    import math
+
+    import numpy as np
+
+    if not spec.rope_scaling_factor:
+        return None, 1.0
+    base, factor = spec.rope_theta, spec.rope_scaling_factor
+    orig = spec.rope_orig_max_pos
+    half = dim // 2
+    pos_freqs = base ** (np.arange(0, half, dtype=np.float64) * 2 / dim)
+    inv_extra = 1.0 / pos_freqs
+    inv_inter = 1.0 / (factor * pos_freqs)
+
+    def corr_dim(n_rot: float) -> float:
+        return (dim * math.log(orig / (n_rot * 2 * math.pi))) / (
+            2 * math.log(base)
+        )
+
+    low = corr_dim(spec.rope_beta_fast)
+    high = corr_dim(spec.rope_beta_slow)
+    if spec.rope_truncate:
+        low, high = math.floor(low), math.ceil(high)
+    low, high = max(low, 0), min(high, dim - 1)
+    if low == high:
+        high += 0.001
+    ramp = np.clip(
+        (np.arange(half, dtype=np.float64) - low) / (high - low), 0, 1
+    )
+    ext_factor = 1.0 - ramp
+    inv = inv_inter * (1 - ext_factor) + inv_extra * ext_factor
+    if spec.rope_mscale and spec.rope_mscale_all_dim:
+        att = yarn_get_mscale(factor, spec.rope_mscale) / yarn_get_mscale(
+            factor, spec.rope_mscale_all_dim
+        )
+    else:
+        att = yarn_get_mscale(factor)
+    return inv.astype(np.float32), float(att)
+
+
+def rope(
+    x: jax.Array, positions: jax.Array, theta: float,
+    *, inv_freq=None, scale: float = 1.0,
+) -> jax.Array:
+    """Rotary embedding. x: [T, heads, D], positions: [T]. ``inv_freq``
+    overrides the plain theta schedule (YaRN); ``scale`` multiplies the
+    rotated output (YaRN attention factor — HF folds it into cos/sin,
+    which is the same linear map)."""
     D = x.shape[-1]
     half = D // 2
-    freqs = 1.0 / (theta ** (jnp.arange(0, half, dtype=jnp.float32) / half))
+    if inv_freq is None:
+        freqs = 1.0 / (
+            theta ** (jnp.arange(0, half, dtype=jnp.float32) / half)
+        )
+    else:
+        freqs = jnp.asarray(inv_freq, jnp.float32)
     angles = positions[:, None].astype(jnp.float32) * freqs[None, :]  # [T, half]
-    cos = jnp.cos(angles)[:, None, :]  # [T, 1, half]
-    sin = jnp.sin(angles)[:, None, :]
+    cos = jnp.cos(angles)[:, None, :] * scale  # [T, 1, half]
+    sin = jnp.sin(angles)[:, None, :] * scale
     x1, x2 = x[..., :half], x[..., half:]
     xf1, xf2 = x1.astype(jnp.float32), x2.astype(jnp.float32)
     return jnp.concatenate(
@@ -161,15 +240,31 @@ def rope(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:
     ).astype(x.dtype)
 
 
+def rope_spec(spec: ModelSpec, x: jax.Array, positions: jax.Array) -> jax.Array:
+    """spec-driven rope: plain theta schedule, or YaRN when configured."""
+    inv, att = yarn_freqs(spec, x.shape[-1])
+    return rope(x, positions, spec.rope_theta, inv_freq=inv, scale=att)
+
+
 def _attn_qkv(spec: ModelSpec, lp: Params, x: jax.Array, positions: jax.Array):
     """x: [T, d] -> q [T, nh, hd], k/v [T, nkv, hd] with rope applied."""
     T = x.shape[0]
-    q = (x @ lp["wq"]).reshape(T, spec.num_heads, spec.head_dim)
-    k = (x @ lp["wk"]).reshape(T, spec.num_kv_heads, spec.head_dim)
-    v = (x @ lp["wv"]).reshape(T, spec.num_kv_heads, spec.head_dim)
-    q = rope(q, positions, spec.rope_theta)
-    k = rope(k, positions, spec.rope_theta)
+    q = x @ lp["wq"]
+    k = x @ lp["wk"]
+    v = x @ lp["wv"]
+    if spec.attn_bias:
+        q, k, v = q + lp["bq"], k + lp["bk"], v + lp["bv"]
+    q = q.reshape(T, spec.num_heads, spec.head_dim)
+    k = k.reshape(T, spec.num_kv_heads, spec.head_dim)
+    v = v.reshape(T, spec.num_kv_heads, spec.head_dim)
+    q = rope_spec(spec, q, positions)
+    k = rope_spec(spec, k, positions)
     return q, k, v
+
+
+def _o_proj(spec: ModelSpec, lp: Params, attn: jax.Array) -> jax.Array:
+    out = attn @ lp["wo"]
+    return out + lp["bo"] if spec.attn_bias else out
 
 
 def _mlp(lp: Params, x: jax.Array) -> jax.Array:
@@ -254,9 +349,12 @@ def prefill_forward_impl(
         v_pages = v_pages.at[li, safe_pg].set(to_tiles(v))
         k_ctx = gather_pages(k_pages[li], block_table)  # [max_ctx, kvh, D]
         v_ctx = gather_pages(v_pages[li], block_table)
-        attn = causal_attention(q, k_ctx, v_ctx, positions, kv_len)
+        attn = causal_attention(
+            q, k_ctx, v_ctx, positions, kv_len,
+            window=spec.attn_window(li), sinks=lp.get("sinks"),
+        )
         attn = attn.reshape(T, spec.num_heads * spec.head_dim)
-        x = x + attn @ lp["wo"]
+        x = x + _o_proj(spec, lp, attn)
         h = rms_norm(x, lp["mlp_norm"], spec.rms_eps)
         f, d = _ffn_counted(spec, lp, h)
         x = x + f
@@ -331,21 +429,30 @@ def prefill_forward_batch_impl(
 
     for li, lp in enumerate(params["layers"]):
         h = rms_norm(x, lp["attn_norm"], spec.rms_eps)
-        q = (h @ lp["wq"]).reshape(N, T, spec.num_heads, spec.head_dim)
-        k = (h @ lp["wk"]).reshape(N, T, spec.num_kv_heads, spec.head_dim)
-        v = (h @ lp["wv"]).reshape(N, T, spec.num_kv_heads, spec.head_dim)
-        q = jax.vmap(rope, in_axes=(0, 0, None))(q, positions, spec.rope_theta)
-        k = jax.vmap(rope, in_axes=(0, 0, None))(k, positions, spec.rope_theta)
+        q = h @ lp["wq"]
+        k = h @ lp["wk"]
+        v = h @ lp["wv"]
+        if spec.attn_bias:
+            q, k, v = q + lp["bq"], k + lp["bk"], v + lp["bv"]
+        q = q.reshape(N, T, spec.num_heads, spec.head_dim)
+        k = k.reshape(N, T, spec.num_kv_heads, spec.head_dim)
+        v = v.reshape(N, T, spec.num_kv_heads, spec.head_dim)
+        q = jax.vmap(lambda a, p: rope_spec(spec, a, p))(q, positions)
+        k = jax.vmap(lambda a, p: rope_spec(spec, a, p))(k, positions)
         k_pages = k_pages.at[li, safe_pg].set(to_tiles(k))
         v_pages = v_pages.at[li, safe_pg].set(to_tiles(v))
 
-        def one_attn(q_i, bt_i, pos_i, kvl_i, kp=k_pages, vp=v_pages, li=li):
+        def one_attn(q_i, bt_i, pos_i, kvl_i, kp=k_pages, vp=v_pages, li=li,
+                     lp=lp):
             k_ctx = gather_pages(kp[li], bt_i)
             v_ctx = gather_pages(vp[li], bt_i)
-            return causal_attention(q_i, k_ctx, v_ctx, pos_i, kvl_i)
+            return causal_attention(
+                q_i, k_ctx, v_ctx, pos_i, kvl_i,
+                window=spec.attn_window(li), sinks=lp.get("sinks"),
+            )
 
         attn = jax.vmap(one_attn)(q, block_tables, positions, kv_len)
-        x = x + attn.reshape(N, T, -1) @ lp["wo"]
+        x = x + _o_proj(spec, lp, attn.reshape(N, T, -1))
         h = rms_norm(x, lp["mlp_norm"], spec.rms_eps)
         f, d = _ffn_counted(spec, lp, h.reshape(N * T, -1))
         x = x + f.reshape(N, T, -1)
@@ -410,7 +517,9 @@ def prefill_forward_ring_impl(
         k_pages = k_pages.at[li, safe_pg].set(to_tiles(k))
         v_pages = v_pages.at[li, safe_pg].set(to_tiles(v))
         attn = ring_attention(q, k, v, mesh=mesh)
-        x = x + attn.reshape(T, spec.num_heads * spec.head_dim) @ lp["wo"]
+        x = x + _o_proj(
+            spec, lp, attn.reshape(T, spec.num_heads * spec.head_dim)
+        )
         h = rms_norm(x, lp["mlp_norm"], spec.rms_eps)
         f, d = _ffn_counted(spec, lp, h)
         x = x + f
@@ -461,21 +570,27 @@ def decode_forward_impl(
     for li, lp in enumerate(params["layers"]):
         h = rms_norm(x, lp["attn_norm"], spec.rms_eps)
         # per-slot single-token qkv: vmap the [T=1] path
-        q = (h @ lp["wq"]).reshape(B, spec.num_heads, spec.head_dim)
-        k = (h @ lp["wk"]).reshape(B, spec.num_kv_heads, spec.head_dim)
-        v = (h @ lp["wv"]).reshape(B, spec.num_kv_heads, spec.head_dim)
-        q = rope(q, positions, spec.rope_theta)
-        k = rope(k, positions, spec.rope_theta)
+        q = h @ lp["wq"]
+        k = h @ lp["wk"]
+        v = h @ lp["wv"]
+        if spec.attn_bias:
+            q, k, v = q + lp["bq"], k + lp["bk"], v + lp["bv"]
+        q = q.reshape(B, spec.num_heads, spec.head_dim)
+        k = k.reshape(B, spec.num_kv_heads, spec.head_dim)
+        v = v.reshape(B, spec.num_kv_heads, spec.head_dim)
+        q = rope_spec(spec, q, positions)
+        k = rope_spec(spec, k, positions)
         # new-token KV rows land via DMA kernel on TPU (XLA scatter is
         # ~0.35ms/layer on v5e — see ops/pallas/kv_write.py), scatter off-TPU
         k_pages, v_pages = write_new_kv(
             k_pages, v_pages, k, v, safe_page, offset, layer=li, mesh=mesh
         )
         attn = paged_decode_attention_auto(
-            q, k_pages[li], v_pages[li], block_tables, seq_lens, mesh=mesh
+            q, k_pages[li], v_pages[li], block_tables, seq_lens, mesh=mesh,
+            window=spec.attn_window(li), sinks=lp.get("sinks"),
         )
         attn = attn.reshape(B, spec.num_heads * spec.head_dim)
-        x = x + attn @ lp["wo"]
+        x = x + _o_proj(spec, lp, attn)
         h = rms_norm(x, lp["mlp_norm"], spec.rms_eps)
         x = x + _ffn(spec, lp, h)
 
@@ -605,11 +720,14 @@ def embed_forward_impl(
     T = tokens.shape[0]
     positions = jnp.arange(T)
     x = params["embed"][tokens]
-    for lp in params["layers"]:
+    for li, lp in enumerate(params["layers"]):
         h = rms_norm(x, lp["attn_norm"], spec.rms_eps)
         q, k, v = _attn_qkv(spec, lp, h, positions)
-        attn = causal_attention(q, k, v, positions, num_tokens)
-        x = x + attn.reshape(T, -1) @ lp["wo"]
+        attn = causal_attention(
+            q, k, v, positions, num_tokens,
+            window=spec.attn_window(li), sinks=lp.get("sinks"),
+        )
+        x = x + _o_proj(spec, lp, attn.reshape(T, -1))
         h = rms_norm(x, lp["mlp_norm"], spec.rms_eps)
         x = x + _ffn(spec, lp, h)
     xn = rms_norm(x, params["final_norm"], spec.rms_eps).astype(jnp.float32)
@@ -632,11 +750,14 @@ def reference_forward(
     T = tokens.shape[0]
     positions = jnp.arange(T)
     x = params["embed"][tokens]
-    for lp in params["layers"]:
+    for li, lp in enumerate(params["layers"]):
         h = rms_norm(x, lp["attn_norm"], spec.rms_eps)
         q, k, v = _attn_qkv(spec, lp, h, positions)
-        attn = causal_attention(q, k, v, positions, jnp.asarray(T))
-        x = x + attn.reshape(T, -1) @ lp["wo"]
+        attn = causal_attention(
+            q, k, v, positions, jnp.asarray(T),
+            window=spec.attn_window(li), sinks=lp.get("sinks"),
+        )
+        x = x + _o_proj(spec, lp, attn.reshape(T, -1))
         h = rms_norm(x, lp["mlp_norm"], spec.rms_eps)
         x = x + _ffn(spec, lp, h)
     xn = rms_norm(x, params["final_norm"], spec.rms_eps)

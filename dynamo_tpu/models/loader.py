@@ -79,6 +79,45 @@ def spec_from_hf_config(cfg: dict, name: str | None = None) -> ModelSpec:
                 cfg.get("moe_intermediate_size") or cfg["intermediate_size"]
             ),
         )
+    # gpt-oss attention extras: sinks + per-layer sliding windows +
+    # projection/expert biases + clamped swiglu (HF GptOssConfig)
+    extras: dict = {}
+    if model_type == "gpt_oss":
+        n_layers = int(cfg["num_hidden_layers"])
+        extras = dict(
+            sliding_window=int(cfg.get("sliding_window") or 0),
+            # HF GptOssConfig defaults to alternating sliding/full when
+            # layer_types is absent — mirror that, not all-sliding
+            layer_types=tuple(
+                cfg.get("layer_types")
+                or ("sliding_attention" if i % 2 == 0 else "full_attention"
+                    for i in range(n_layers))
+            ),
+            attn_sinks=True,
+            attn_bias=bool(cfg.get("attention_bias", True)),
+            moe_bias=True,
+            swiglu_limit=float(cfg.get("swiglu_limit") or 7.0),
+            swiglu_alpha=1.702,
+        )
+    if model_type in ("deepseek_v2", "deepseek_v3"):
+        # DeepSeek MLA checkpoints store rope dims pair-interleaved
+        # (HF DeepseekV3Config.rope_interleave defaults True)
+        extras["rope_interleave"] = bool(cfg.get("rope_interleave", True))
+    # YaRN rope scaling (gpt-oss, DeepSeek-R1)
+    rs = cfg.get("rope_scaling") or {}
+    if (rs.get("rope_type") or rs.get("type")) == "yarn":
+        extras.update(
+            rope_scaling_factor=float(rs["factor"]),
+            rope_orig_max_pos=int(
+                rs.get("original_max_position_embeddings")
+                or cfg.get("max_position_embeddings") or 4096
+            ),
+            rope_beta_fast=float(rs.get("beta_fast") or 32),
+            rope_beta_slow=float(rs.get("beta_slow") or 1),
+            rope_mscale=float(rs.get("mscale") or 0),
+            rope_mscale_all_dim=float(rs.get("mscale_all_dim") or 0),
+            rope_truncate=bool(rs.get("truncate", True)),
+        )
     return ModelSpec(
         name=name or cfg.get("_name_or_path") or model_type,
         vocab_size=int(cfg["vocab_size"]),
@@ -91,6 +130,13 @@ def spec_from_hf_config(cfg: dict, name: str | None = None) -> ModelSpec:
         rope_theta=float(cfg.get("rope_theta", 500000.0)),
         rms_eps=float(cfg.get("rms_norm_eps", 1e-5)),
         tie_embeddings=bool(cfg.get("tie_word_embeddings", False)),
+        # transformers >= 4.56 writes "dtype"; older wrote "torch_dtype"
+        dtype=(
+            ckpt_dtype
+            if (ckpt_dtype := cfg.get("dtype") or cfg.get("torch_dtype"))
+            in ("bfloat16", "float32", "float16")
+            else "bfloat16"
+        ),
         # DeepSeek-family extras (0/absent on other models)
         n_shared_experts=int(cfg.get("n_shared_experts") or 0),
         first_k_dense=int(cfg.get("first_k_dense_replace") or 0),
@@ -100,6 +146,7 @@ def spec_from_hf_config(cfg: dict, name: str | None = None) -> ModelSpec:
         v_head_dim=int(cfg.get("v_head_dim") or 0),
         q_lora_rank=int(cfg.get("q_lora_rank") or 0),
         **moe,
+        **extras,
     )
 
 
@@ -202,11 +249,10 @@ def _dest_map(
     """HF tensor name -> ((pytree path), transpose, dtype-override).
 
     ``names`` (the checkpoint's tensor set) selects the MoE naming scheme;
-    gpt-oss fused expert tensors are handled separately in load_params
-    (they split, which this map cannot express). gpt-oss architectural
-    extras — attention sinks, per-layer sliding windows, projection
-    biases, clamped swiglu — are NOT modeled; those tensors are skipped
-    with a warning and the load is an approximation for such checkpoints.
+    gpt-oss fused expert tensors (weights AND biases) are handled
+    separately in load_params (they split, which this map cannot
+    express). gpt-oss attention sinks, projection biases, and router
+    bias map here when the spec enables them.
     """
     m: dict[str, tuple[tuple, bool, str | None]] = {
         "model.embed_tokens.weight": (("embed",), False, None),
@@ -223,6 +269,12 @@ def _dest_map(
         for hf, ours in (("q_proj", "wq"), ("k_proj", "wk"),
                          ("v_proj", "wv"), ("o_proj", "wo")):
             m[p + f"self_attn.{hf}.weight"] = (li + (ours,), True, None)
+        if spec.attn_bias:
+            for hf, ours in (("q_proj", "bq"), ("k_proj", "bk"),
+                             ("v_proj", "bv"), ("o_proj", "bo")):
+                m[p + f"self_attn.{hf}.bias"] = (li + (ours,), False, None)
+        if spec.attn_sinks:
+            m[p + "self_attn.sinks"] = (li + ("sinks",), False, None)
         if spec.num_experts:
             if scheme == "mixtral":
                 mp = p + "block_sparse_moe."
@@ -242,6 +294,10 @@ def _dest_map(
                     m[ep + "down_proj.weight"] = (li + ("moe", "w_down", e), True, None)
             else:  # gpt_oss: router here; fused experts in load_params
                 m[p + "mlp.router.weight"] = (li + ("moe", "router"), True, "float32")
+                if spec.moe_bias:
+                    m[p + "mlp.router.bias"] = (
+                        li + ("moe", "router_bias"), False, "float32"
+                    )
         else:
             for hf, ours in (("gate_proj", "w_gate"), ("up_proj", "w_up"),
                              ("down_proj", "w_down")):
@@ -365,6 +421,18 @@ def load_params(
                         else:
                             place(li + ("w_down",), arr, dtype)
                         seen.add(name)
+                    elif fused_gpt_oss and spec.moe_bias and name.endswith(
+                        (".mlp.experts.gate_up_proj_bias",
+                         ".mlp.experts.down_proj_bias")
+                    ):
+                        li = ("layers", int(name.split(".")[2]), "moe")
+                        arr = f.get_tensor(name)
+                        if name.endswith("gate_up_proj_bias"):
+                            place(li + ("b_gate",), arr[..., 0::2], dtype)
+                            place(li + ("b_up",), arr[..., 1::2], dtype)
+                        else:
+                            place(li + ("b_down",), arr, dtype)
+                        seen.add(name)
                     elif name.endswith(("_bias", ".bias", ".sinks")):
                         skipped_extras.append(name)
                     continue
@@ -372,6 +440,8 @@ def load_params(
                 arr = f.get_tensor(name)
                 if transpose:
                     arr = np.ascontiguousarray(arr.T)
+                if spec.kv_lora_rank and spec.rope_interleave:
+                    arr = _deinterleave_rope_cols(spec, name, arr)
                 seen.add(name)
                 dt = dt_override or dtype
                 if len(path) >= 2 and isinstance(path[-1], int) and path[-2] in (
@@ -399,17 +469,20 @@ def load_params(
             for i in range(spec.num_layers)
         }
     if fused_gpt_oss:
+        tails = ["gate_up_proj", "down_proj"]
+        if spec.moe_bias:
+            tails += ["gate_up_proj_bias", "down_proj_bias"]
         dest_expected |= {
             f"model.layers.{i}.mlp.experts.{t}"
             for i in range(spec.num_layers)
-            for t in ("gate_up_proj", "down_proj")
+            for t in tails
         }
     if skipped_extras:
         import logging
 
         logging.getLogger("dynamo.loader").warning(
-            "skipped %d unsupported tensors (biases/sinks are not modeled; "
-            "the load approximates such checkpoints), e.g. %s",
+            "skipped %d tensors with no destination in this spec "
+            "(unexpected for supported architectures), e.g. %s",
             len(skipped_extras), sorted(skipped_extras)[:3],
         )
     missing = dest_expected - seen
@@ -419,6 +492,31 @@ def load_params(
             f"{sorted(missing)[:4]}"
         )
     return params
+
+
+def _deinterleave_rope_cols(
+    spec: ModelSpec, name: str, arr: np.ndarray
+) -> np.ndarray:
+    """DeepSeek ``rope_interleave`` handling: checkpoint rope dims are
+    pair-interleaved ([x0, y0, x1, y1, ...]); our rope is half-split
+    ([x0, x1, ..., y0, y1, ...]). Permuting the q_rope and k_rope
+    PROJECTION COLUMNS at load is exact — rope dims only ever meet in
+    q.k dot products, and both sides get the same permutation (HF
+    instead keeps the weights and swaps in apply_rotary_pos_emb_interleave).
+    ``arr`` is already transposed to [in, out]."""
+    dr = spec.qk_rope_head_dim
+    perm = np.concatenate([np.arange(0, dr, 2), np.arange(1, dr, 2)])
+    if name.endswith(("self_attn.q_b_proj.weight", "self_attn.q_proj.weight")):
+        H, dn = spec.num_heads, spec.qk_nope_head_dim
+        out = arr.reshape(arr.shape[0], H, dn + dr)
+        out = np.concatenate([out[..., :dn], out[..., dn:][..., perm]], axis=-1)
+        return np.ascontiguousarray(out.reshape(arr.shape))
+    if name.endswith("self_attn.kv_a_proj_with_mqa.weight"):
+        dc = spec.kv_lora_rank
+        return np.ascontiguousarray(
+            np.concatenate([arr[:, :dc], arr[:, dc:][:, perm]], axis=1)
+        )
+    return arr
 
 
 def _np_dtype(dt: str):

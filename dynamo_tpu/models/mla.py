@@ -25,8 +25,9 @@ first bottleneck here.
 The DeepSeek block composes MLA with the MoE FFN (models/moe.py) plus
 ``n_shared_experts`` always-on dense experts; the first
 ``first_k_dense`` layers use a plain dense MLP (DeepSeek's
-first_k_dense_replace). RoPE is the standard half-split form (YaRN
-long-context scaling not yet applied).
+first_k_dense_replace). RoPE is the standard half-split form, with YaRN
+frequency correction when the spec configures it (DeepSeek-R1 ships
+factor 40 / mscale 1 — llama.yarn_freqs, HF-parity semantics).
 
 Parity contract: ``reference_forward`` computes the plain non-absorbed
 attention; the paged prefill/decode must match it (tests/test_mla.py).
@@ -44,7 +45,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from dynamo_tpu.engine.config import ModelSpec
 from dynamo_tpu.models.llama import (
-    TRASH_PAGE, _logits, _replicate, rms_norm, rope,
+    TRASH_PAGE, _logits, _replicate, rms_norm, rope_spec,
 )
 
 Params = dict[str, Any]
@@ -54,6 +55,22 @@ NEG_INF = -1e30
 
 def latent_dim(spec: ModelSpec) -> int:
     return spec.kv_lora_rank + spec.qk_rope_head_dim
+
+
+def softmax_scale(spec: ModelSpec) -> float:
+    """MLA attention scale: 1/sqrt(dn+dr), times the YaRN mscale^2
+    correction when the checkpoint ships mscale_all_dim (HF
+    DeepseekV3Attention multiplies its scaling by
+    yarn_get_mscale(factor, mscale_all_dim)^2 — R1: (0.1*ln(40)+1)^2)."""
+    import math
+
+    from dynamo_tpu.models.llama import yarn_get_mscale
+
+    base = 1.0 / math.sqrt(spec.qk_nope_head_dim + spec.qk_rope_head_dim)
+    if spec.rope_scaling_factor and spec.rope_mscale_all_dim:
+        m = yarn_get_mscale(spec.rope_scaling_factor, spec.rope_mscale_all_dim)
+        base *= m * m
+    return base
 
 
 # ---------------------------------------------------------------- init
@@ -162,7 +179,7 @@ def param_shardings(spec: ModelSpec, mesh: Mesh) -> Params:
         if spec.num_experts and li >= spec.first_k_dense:
             from dynamo_tpu.models import moe
 
-            layer["moe"] = moe.moe_layer_shardings(mesh)
+            layer["moe"] = moe.moe_layer_shardings(mesh, spec)
             if spec.n_shared_experts:
                 layer["shared"] = {
                     "w_gate": ns(None, "tp"),
@@ -206,7 +223,7 @@ def _q_heads(spec: ModelSpec, lp: Params, h: jax.Array, positions) -> tuple:
         q = h @ lp["wq"]
     q = q.reshape(T, H, dn + dr)
     q_nope, q_rope = q[..., :dn], q[..., dn:]
-    return q_nope, rope(q_rope, positions, spec.rope_theta)
+    return q_nope, rope_spec(spec, q_rope, positions)
 
 
 def _latent_row(spec: ModelSpec, lp: Params, h: jax.Array, positions):
@@ -214,7 +231,7 @@ def _latent_row(spec: ModelSpec, lp: Params, h: jax.Array, positions):
     dc = spec.kv_lora_rank
     kv_a = h @ lp["w_kv_a"]
     c = rms_norm(kv_a[:, :dc], lp["kv_norm"], spec.rms_eps)
-    k_r = rope(kv_a[:, None, dc:], positions, spec.rope_theta)[:, 0]
+    k_r = rope_spec(spec, kv_a[:, None, dc:], positions)[:, 0]
     return jnp.concatenate([c, k_r], axis=-1)
 
 
@@ -228,9 +245,7 @@ def _absorbed_attention(
 ) -> jax.Array:
     """Latent-space attention -> per-head outputs [T, H, dv]."""
     dc = spec.kv_lora_rank
-    scale = 1.0 / jnp.sqrt(
-        jnp.asarray(spec.qk_nope_head_dim + spec.qk_rope_head_dim, jnp.float32)
-    )
+    scale = jnp.asarray(softmax_scale(spec), jnp.float32)
     c, k_r = rows[:, :dc], rows[:, dc:]
     # absorb W_uk: q_lat[t,h,:] = q_nope[t,h,:] @ w_uk[h].T  -> [T, H, dc]
     q_lat = jnp.einsum("thn,hcn->thc", q_nope.astype(jnp.float32),
@@ -273,7 +288,7 @@ def reference_forward(
     positions = jnp.arange(T)
     x = params["embed"][tokens]
     dn = spec.qk_nope_head_dim
-    scale = 1.0 / jnp.sqrt(jnp.asarray(dn + spec.qk_rope_head_dim, jnp.float32))
+    scale = jnp.asarray(softmax_scale(spec), jnp.float32)
     mask = positions[:, None] >= positions[None, :]
     for li, lp in enumerate(params["layers"]):
         h = rms_norm(x, lp["attn_norm"], spec.rms_eps)

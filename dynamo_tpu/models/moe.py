@@ -32,26 +32,40 @@ def init_moe_layer(spec: ModelSpec, key: jax.Array) -> Params:
         scale = scale or (1.0 / jnp.sqrt(shape[-2]))
         return (jax.random.normal(k, shape, jnp.float32) * scale).astype(dtype)
 
-    return {
+    out = {
         "router": dense(k1, (d, e), scale=0.02).astype(jnp.float32),
         "w_gate": dense(k2, (e, d, f)),
         "w_up": dense(k3, (e, d, f)),
         "w_down": dense(k4, (e, f, d)),
     }
+    if spec.moe_bias:  # gpt-oss: router + expert biases
+        out["router_bias"] = jnp.zeros((e,), jnp.float32)
+        out["b_gate"] = jnp.zeros((e, f), dtype)
+        out["b_up"] = jnp.zeros((e, f), dtype)
+        out["b_down"] = jnp.zeros((e, d), dtype)
+    return out
 
 
-def moe_layer_shardings(mesh: Mesh) -> Params:
+def moe_layer_shardings(mesh: Mesh, spec: ModelSpec | None = None) -> Params:
     """Experts sharded over "ep", expert-FFN columns over "tp"."""
 
     def ns(*axes):
         return NamedSharding(mesh, P(*axes))
 
-    return {
+    out = {
         "router": ns(),
         "w_gate": ns("ep", None, "tp"),
         "w_up": ns("ep", None, "tp"),
         "w_down": ns("ep", "tp", None),
     }
+    if spec is not None and spec.moe_bias:
+        out.update(
+            router_bias=ns(),
+            b_gate=ns("ep", "tp"),
+            b_up=ns("ep", "tp"),
+            b_down=ns("ep", None),
+        )
+    return out
 
 
 def expert_capacity(
@@ -93,9 +107,12 @@ def moe_mlp(
     E, k = spec.num_experts, spec.num_experts_per_token
     C = expert_capacity(T, E, k, capacity_factor)
 
-    probs = jax.nn.softmax(
-        x.astype(jnp.float32) @ lp["router"], axis=-1
-    )  # [T, E]
+    router_logits = x.astype(jnp.float32) @ lp["router"]
+    if "router_bias" in lp:
+        router_logits = router_logits + lp["router_bias"]
+    # softmax-all + top-k renormalize == softmax over the top-k logits
+    # (HF gpt-oss GptOssTopKRouter): same selection, same weights
+    probs = jax.nn.softmax(router_logits, axis=-1)  # [T, E]
     topv, topi = jax.lax.top_k(probs, k)  # [T, k]
     topv = topv / jnp.maximum(topv.sum(axis=-1, keepdims=True), 1e-9)
 
@@ -117,9 +134,22 @@ def moe_mlp(
     dispatch = (combine > 0.0).astype(x.dtype)
 
     xe = jnp.einsum("td,tec->ecd", x, dispatch)  # [E, C, d]
-    h = jax.nn.silu(jnp.einsum("ecd,edf->ecf", xe, lp["w_gate"]))
-    h = h * jnp.einsum("ecd,edf->ecf", xe, lp["w_up"])
+    g = jnp.einsum("ecd,edf->ecf", xe, lp["w_gate"])
+    u = jnp.einsum("ecd,edf->ecf", xe, lp["w_up"])
+    if "b_gate" in lp:
+        g = g + lp["b_gate"][:, None, :]
+        u = u + lp["b_up"][:, None, :]
+    if spec.swiglu_limit:
+        # gpt-oss clamped swiglu (HF GptOssExperts.forward): gate capped
+        # above, linear clamped both ways, swish slope alpha, (up + 1)
+        g = jnp.minimum(g, spec.swiglu_limit)
+        u = jnp.clip(u, -spec.swiglu_limit, spec.swiglu_limit)
+        h = g * jax.nn.sigmoid(spec.swiglu_alpha * g) * (u + 1.0)
+    else:
+        h = jax.nn.silu(g) * u
     out_e = jnp.einsum("ecf,efd->ecd", h, lp["w_down"])  # [E, C, d]
+    if "b_down" in lp:
+        out_e = out_e + lp["b_down"][:, None, :]
     out = jnp.einsum(
         "ecd,tec->td", out_e.astype(jnp.float32), combine
     ).astype(x.dtype)

@@ -57,10 +57,17 @@ def causal_attention(
     v: jax.Array,  # [S, kv_heads, D]
     q_positions: jax.Array,  # [T] absolute positions of the queries
     kv_len: jax.Array,  # scalar: number of valid kv tokens
+    *,
+    window: int = 0,  # sliding window (0 = full); key j needs j > pos - window
+    sinks: jax.Array | None = None,  # [H] learned sink logits (gpt-oss)
 ) -> jax.Array:
     """Causal attention of new queries over (cached + new) keys.
 
-    Key j is visible to query i iff j <= q_positions[i] and j < kv_len.
+    Key j is visible to query i iff j <= q_positions[i] and j < kv_len
+    (and, with a sliding window, j > q_positions[i] - window). ``sinks``
+    adds a per-head learned logit to the softmax normalization — a
+    virtual key with zero value the head can dump probability mass on
+    (gpt-oss attention; HF eager_attention_forward concat semantics).
     Returns [T, heads, D]. Softmax in f32 regardless of input dtype.
     """
     T, H, D = q.shape
@@ -73,8 +80,18 @@ def causal_attention(
     logits = logits * scale
     kv_pos = jnp.arange(S)[None, :]  # [1, S]
     mask = (kv_pos <= q_positions[:, None]) & (kv_pos < kv_len)  # [T, S]
+    if window:
+        mask &= kv_pos > q_positions[:, None] - window
     logits = jnp.where(mask[None, :, :], logits, NEG_INF)
-    probs = jax.nn.softmax(logits, axis=-1)
+    if sinks is not None:
+        sink_col = jnp.broadcast_to(
+            sinks.astype(jnp.float32)[:, None, None], (H, T, 1)
+        )
+        probs = jax.nn.softmax(
+            jnp.concatenate([logits, sink_col], axis=-1), axis=-1
+        )[..., :S]
+    else:
+        probs = jax.nn.softmax(logits, axis=-1)
     out = jnp.einsum("hts,shd->thd", probs, v.astype(jnp.float32))
     return out.astype(q.dtype)
 
@@ -85,6 +102,9 @@ def paged_decode_attention(
     v_pages: jax.Array,  # [num_pages, kv_heads, page_size, D]
     block_tables: jax.Array,  # [B, max_pages_per_seq]
     seq_lens: jax.Array,  # [B] context length INCLUDING the new token
+    *,
+    window: int = 0,
+    sinks: jax.Array | None = None,  # [H]
 ) -> jax.Array:
     """Decode-step attention: each query attends to its full paged context.
 
@@ -108,9 +128,21 @@ def paged_decode_attention(
     logits = jnp.einsum(
         "bhd,bshd->bhs", q.astype(jnp.float32), k.astype(jnp.float32)
     ) * scale
-    mask = jnp.arange(max_ctx)[None, :] < seq_lens[:, None]  # [B, max_ctx]
+    kv_pos = jnp.arange(max_ctx)[None, :]
+    mask = kv_pos < seq_lens[:, None]  # [B, max_ctx]
+    if window:
+        # decode query position = seq_len - 1: keys j >= seq_len - window
+        mask &= kv_pos >= seq_lens[:, None] - window
     logits = jnp.where(mask[:, None, :], logits, NEG_INF)
-    probs = jax.nn.softmax(logits, axis=-1)
+    if sinks is not None:
+        sink_col = jnp.broadcast_to(
+            sinks.astype(jnp.float32)[None, :, None], (B, H, 1)
+        )
+        probs = jax.nn.softmax(
+            jnp.concatenate([logits, sink_col], axis=-1), axis=-1
+        )[..., :max_ctx]
+    else:
+        probs = jax.nn.softmax(logits, axis=-1)
     out = jnp.einsum("bhs,bshd->bhd", probs, v.astype(jnp.float32))
     return out.astype(q.dtype)
 
@@ -121,6 +153,9 @@ def _decode_attention_tpu(
     v_pages: jax.Array,
     block_tables: jax.Array,
     seq_lens: jax.Array,
+    *,
+    window: int = 0,
+    sinks: jax.Array | None = None,
 ) -> jax.Array:
     """Real-TPU decode attention: our v3 kernel (deep-pipelined windowed
     DMA + cross-program prefetch over the page-major pool — see
@@ -131,7 +166,7 @@ def _decode_attention_tpu(
     (debug only). Layout contract everywhere else:
     k_pages/v_pages [num_pages, KH, page, D], block_tables [B, P]."""
     choice = (os.environ.get("DYNAMO_ATTN") or "").strip()
-    if choice == "lib":
+    if choice == "lib" and window == 0 and sinks is None:
         from jax.experimental.pallas.ops.tpu.paged_attention import (
             paged_attention,
         )
@@ -157,9 +192,13 @@ def _decode_attention_tpu(
 
     if choice == "v3" or v3_supported(k_pages, block_tables):
         return paged_decode_attention_v3(
-            q, k_pages, v_pages, block_tables, seq_lens
+            q, k_pages, v_pages, block_tables, seq_lens,
+            window=window, sinks=sinks,
         )
-    return paged_decode_attention(q, k_pages, v_pages, block_tables, seq_lens)
+    return paged_decode_attention(
+        q, k_pages, v_pages, block_tables, seq_lens,
+        window=window, sinks=sinks,
+    )
 
 
 def paged_decode_attention_auto(
@@ -169,6 +208,9 @@ def paged_decode_attention_auto(
     block_tables: jax.Array,
     seq_lens: jax.Array,
     mesh=None,
+    *,
+    window: int = 0,
+    sinks: jax.Array | None = None,
 ) -> jax.Array:
     """Dispatch: Pallas kernel on TPU, pure-JAX gather elsewhere.
 
@@ -176,7 +218,8 @@ def paged_decode_attention_auto(
     heads and KV heads are both head-sharded, every GQA group is fully
     local to its shard, so the kernel needs zero collectives (pallas_call
     itself has no SPMD partitioning rule — without shard_map GSPMD would
-    all-gather the whole KV cache every step).
+    all-gather the whole KV cache every step). Sinks are per-query-head
+    and shard with the heads.
 
     DYNAMO_PALLAS=1 off-TPU runs the kernel in interpret mode (slow; lets
     the whole engine be driven through the kernel path on CPU).
@@ -190,25 +233,42 @@ def paged_decode_attention_auto(
 
         on_tpu = jax.default_backend() == "tpu"
         if on_tpu:
-            kernel = _decode_attention_tpu
+            base = functools.partial(_decode_attention_tpu, window=window)
         else:
             # off-TPU (tests): our kernel in interpret mode
-            kernel = functools.partial(
-                paged_decode_attention_v3, interpret=True
+            base = functools.partial(
+                paged_decode_attention_v3, interpret=True, window=window
+            )
+        if sinks is not None:
+            kernel = lambda q_, k_, v_, bt_, sl_, s_: base(  # noqa: E731
+                q_, k_, v_, bt_, sl_, sinks=s_
+            )
+        else:
+            kernel = lambda q_, k_, v_, bt_, sl_: base(  # noqa: E731
+                q_, k_, v_, bt_, sl_
             )
         if mesh is not None and mesh.shape.get("tp", 1) > 1:
+            in_specs = [
+                P(None, "tp", None),  # q: heads sharded
+                P(None, "tp", None, None),  # k_pages: kv heads sharded
+                P(None, "tp", None, None),
+                P(None, None),  # block tables replicated
+                P(None),  # seq lens replicated
+            ]
+            if sinks is not None:
+                in_specs.append(P("tp"))  # per-query-head sinks
             kernel = jax.shard_map(
                 kernel,
                 mesh=mesh,
-                in_specs=(
-                    P(None, "tp", None),  # q: heads sharded
-                    P(None, "tp", None, None),  # k_pages: kv heads sharded
-                    P(None, "tp", None, None),
-                    P(None, None),  # block tables replicated
-                    P(None),  # seq lens replicated
-                ),
+                in_specs=tuple(in_specs),
                 out_specs=P(None, "tp", None),
                 check_vma=False,
             )
-        return kernel(q, k_pages, v_pages, block_tables, seq_lens)
-    return paged_decode_attention(q, k_pages, v_pages, block_tables, seq_lens)
+        args = (q, k_pages, v_pages, block_tables, seq_lens)
+        if sinks is not None:
+            args = args + (sinks,)
+        return kernel(*args)
+    return paged_decode_attention(
+        q, k_pages, v_pages, block_tables, seq_lens,
+        window=window, sinks=sinks,
+    )

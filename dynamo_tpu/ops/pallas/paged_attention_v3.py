@@ -70,16 +70,18 @@ def _decode_kernel_v3(
     q_ref,  # [1, KH, G, D] VMEM (this sequence's query heads, pre-scaled)
     k_pages_ref,  # [num_pages, KH, page, D] ANY/HBM
     v_pages_ref,
-    # outputs
-    o_ref,  # [1, KH, G, D] VMEM
-    # scratch
-    kv_buf,  # [2, 2, Pw, KH, page, D] VMEM (chunk buffer, k/v, window)
-    sems,  # DMA sems [2, 2, Pw]
-    *,
+    *rest,  # [sinks_ref [KH, G] VMEM when has_sinks,] o_ref, kv_buf, sems
     page_size: int,
     pages_per_seq: int,
     window_pages: int,
+    window: int = 0,  # sliding window in tokens (0 = full attention)
+    has_sinks: bool = False,  # per-head sink logits in the softmax denom
 ):
+    if has_sinks:
+        sinks_ref, o_ref, kv_buf, sems = rest
+    else:
+        sinks_ref = None
+        o_ref, kv_buf, sems = rest
     b = pl.program_id(0)
     nb = pl.num_programs(0)
     P, Pw = pages_per_seq, window_pages
@@ -159,6 +161,11 @@ def _decode_kernel_v3(
         gp = c * Pw + col_page  # global page index
         pos = gp * page + col_tok
         valid = (col_kh == row_kh) & (pos < seq_len) & (gp < P)
+        if window:
+            # decode query sits at seq_len - 1: with a sliding window
+            # only keys j >= seq_len - window are visible (gpt-oss
+            # per-layer sliding attention)
+            valid &= pos >= seq_len - window
         scores = jnp.where(valid, scores, NEG_INF)
 
         m_new = jnp.maximum(m, jnp.max(scores, axis=-1, keepdims=True))
@@ -171,6 +178,14 @@ def _decode_kernel_v3(
         )
         m = m_new
 
+    if has_sinks:
+        # merge the per-head sink logit as one more flash chunk: a virtual
+        # key with value 0 — contributes exp(sink) to the denominator only
+        # (HF gpt-oss eager_attention_forward concat-then-drop semantics)
+        sink = sinks_ref[...].reshape(KH * G, 1).astype(jnp.float32)
+        m_f = jnp.maximum(m, sink)
+        l = l * jnp.exp(m - m_f) + jnp.exp(sink - m_f)
+        acc = acc * jnp.exp(m - m_f)
     out = acc / jnp.maximum(l, 1e-30)
     o_ref[0] = out.reshape(KH, G, D).astype(o_ref.dtype)
 
@@ -180,7 +195,7 @@ def v3_supported(k_pages: jax.Array, block_tables: jax.Array) -> bool:
     return True
 
 
-@functools.partial(jax.jit, static_argnames=("interpret",))
+@functools.partial(jax.jit, static_argnames=("interpret", "window"))
 def paged_decode_attention_v3(
     q: jax.Array,  # [B, H, D]
     k_pages: jax.Array,  # [num_pages, KH, page, D]
@@ -188,6 +203,8 @@ def paged_decode_attention_v3(
     block_tables: jax.Array,  # [B, P] int32
     seq_lens: jax.Array,  # [B] int32 (length INCLUDING the new token)
     *,
+    window: int = 0,  # sliding window tokens (0 = full attention)
+    sinks: jax.Array | None = None,  # [H] learned sink logits
     interpret: bool = False,
 ) -> jax.Array:
     """Decode attention over the page-major paged cache."""
@@ -198,24 +215,37 @@ def paged_decode_attention_v3(
     Pw = _window_pages(KH, page_size, D, k_pages.dtype.itemsize, P)
     scale = 1.0 / jnp.sqrt(jnp.asarray(D, jnp.float32))
     q4 = (q.reshape(B, KH, G, D).astype(jnp.float32) * scale).astype(q.dtype)
+    has_sinks = sinks is not None
 
     kernel = functools.partial(
         _decode_kernel_v3,
         page_size=page_size,
         pages_per_seq=P,
         window_pages=Pw,
+        window=window,
+        has_sinks=has_sinks,
     )
+    in_specs = [
+        pl.BlockSpec(
+            (1, KH, G, D), lambda b, *_: (b, 0, 0, 0),
+            memory_space=pltpu.VMEM,
+        ),
+        pl.BlockSpec(memory_space=pltpu.ANY),
+        pl.BlockSpec(memory_space=pltpu.ANY),
+    ]
+    inputs = [block_tables.astype(jnp.int32), seq_lens.astype(jnp.int32),
+              q4, k_pages, v_pages]
+    if has_sinks:
+        in_specs.append(
+            pl.BlockSpec(
+                (KH, G), lambda b, *_: (0, 0), memory_space=pltpu.VMEM
+            )
+        )
+        inputs.append(sinks.reshape(KH, G))
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
         grid=(B,),
-        in_specs=[
-            pl.BlockSpec(
-                (1, KH, G, D), lambda b, *_: (b, 0, 0, 0),
-                memory_space=pltpu.VMEM,
-            ),
-            pl.BlockSpec(memory_space=pltpu.ANY),
-            pl.BlockSpec(memory_space=pltpu.ANY),
-        ],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec(
             (1, KH, G, D), lambda b, *_: (b, 0, 0, 0),
             memory_space=pltpu.VMEM,
@@ -230,6 +260,5 @@ def paged_decode_attention_v3(
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((B, KH, G, D), q.dtype),
         interpret=interpret,
-    )(block_tables.astype(jnp.int32), seq_lens.astype(jnp.int32), q4,
-      k_pages, v_pages)
+    )(*inputs)
     return out.reshape(B, H, D)

@@ -78,6 +78,7 @@ class SpmdLeader:
         # (EngineMonitor surfaces `healthy`) rather than silently
         # deadlocking the next collective.
         self.publish_failures = 0
+        self.publish_count = 0  # monotonic; lets callers scope failures
         self._broken = False
 
     @property
@@ -114,6 +115,7 @@ class SpmdLeader:
             "scalars": scalars or {},
             "arrays": {k: _enc(np.asarray(v)) for k, v in (arrays or {}).items()},
         }
+        self.publish_count += 1
         fut = asyncio.run_coroutine_threadsafe(
             self.hub.publish(self.subject, msg), self.loop
         )

@@ -93,73 +93,117 @@ MOE_SPEC = ModelSpec(
 )
 
 
-def _write_gpt_oss_checkpoint(params, tmpdir: str) -> None:
-    """Our param tree -> gpt-oss-named safetensors (fused interleaved
-    gate_up, [in, out] expert layout, router.weight) + config.json."""
-    from safetensors.numpy import save_file
+def _tiny_hf_gpt_oss(tmpdir: str):
+    """Random-init a REAL HF GptOssForCausalLM (sinks, alternating sliding
+    windows, projection + expert biases, clamped swiglu, YaRN) and save
+    it as safetensors — the golden source for checkpoint fidelity."""
+    torch = pytest.importorskip("torch")
+    tfm = pytest.importorskip("transformers")
+    if not hasattr(tfm, "GptOssForCausalLM"):
+        pytest.skip("transformers too old for GptOss")
+    from transformers import GptOssConfig, GptOssForCausalLM
 
-    t = {}
-    t["model.embed_tokens.weight"] = np.asarray(params["embed"])
-    t["model.norm.weight"] = np.asarray(params["final_norm"])
-    t["lm_head.weight"] = np.ascontiguousarray(np.asarray(params["lm_head"]).T)
-    for i, lp in enumerate(params["layers"]):
-        p = f"model.layers.{i}."
-        t[p + "input_layernorm.weight"] = np.asarray(lp["attn_norm"])
-        t[p + "post_attention_layernorm.weight"] = np.asarray(lp["mlp_norm"])
-        for hf, ours in (("q_proj", "wq"), ("k_proj", "wk"),
-                         ("v_proj", "wv"), ("o_proj", "wo")):
-            t[p + f"self_attn.{hf}.weight"] = np.ascontiguousarray(
-                np.asarray(lp[ours]).T
-            )
-        moe = lp["moe"]
-        t[p + "mlp.router.weight"] = np.ascontiguousarray(
-            np.asarray(moe["router"]).T
-        )
-        wg, wu = np.asarray(moe["w_gate"]), np.asarray(moe["w_up"])
-        fused = np.zeros(
-            (wg.shape[0], wg.shape[1], 2 * wg.shape[2]), wg.dtype
-        )
-        fused[..., 0::2] = wg
-        fused[..., 1::2] = wu
-        t[p + "mlp.experts.gate_up_proj"] = fused
-        t[p + "mlp.experts.down_proj"] = np.asarray(moe["w_down"])
-        # unsupported extras the loader must SKIP (with a warning)
-        t[p + "self_attn.sinks"] = np.zeros((4,), np.float32)
-        t[p + "mlp.experts.gate_up_proj_bias"] = np.zeros(
-            (wg.shape[0], 2 * wg.shape[2]), np.float32
-        )
-    save_file(t, os.path.join(tmpdir, "model.safetensors"))
-    cfg = {
-        "model_type": "gpt_oss",
-        "vocab_size": MOE_SPEC.vocab_size,
-        "hidden_size": MOE_SPEC.hidden_size,
-        "intermediate_size": MOE_SPEC.moe_intermediate_size,
-        "num_hidden_layers": MOE_SPEC.num_layers,
-        "num_attention_heads": MOE_SPEC.num_heads,
-        "num_key_value_heads": MOE_SPEC.num_kv_heads,
-        "head_dim": MOE_SPEC.head_dim,
-        "num_local_experts": MOE_SPEC.num_experts,
-        "num_experts_per_tok": MOE_SPEC.num_experts_per_token,
-        "tie_word_embeddings": False,
-        "torch_dtype": "float32",
-    }
-    with open(os.path.join(tmpdir, "config.json"), "w") as f:
-        json.dump(cfg, f)
+    cfg = GptOssConfig(
+        vocab_size=96, hidden_size=32, intermediate_size=32,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        head_dim=8, num_local_experts=4, num_experts_per_tok=2,
+        sliding_window=8,
+        layer_types=["sliding_attention", "full_attention"],
+        rope_theta=150000.0,
+        rope_scaling={
+            "rope_type": "yarn", "factor": 32.0, "beta_fast": 32.0,
+            "beta_slow": 1.0, "original_max_position_embeddings": 4096,
+            "truncate": False,
+        },
+        max_position_embeddings=4096, tie_word_embeddings=False,
+        swiglu_limit=7.0, attention_bias=True, rms_norm_eps=1e-5,
+    )
+    cfg._attn_implementation = "eager"
+    torch.manual_seed(0)
+    model = GptOssForCausalLM(cfg).to(torch.float32).eval()
+    with torch.no_grad():
+        # non-trivial sinks/biases so parity actually exercises them
+        for n, p in model.named_parameters():
+            if n.endswith(".sinks") or "bias" in n:
+                p.copy_(torch.randn_like(p) * 0.5)
+    model.save_pretrained(tmpdir)
+    return model
 
 
-def test_load_gpt_oss_named_checkpoint(tmp_path):
+def test_gpt_oss_golden_logits_vs_hf(tmp_path):
+    """HF checkpoint -> our loader -> reference_forward: logits must match
+    HF transformers' GptOssForCausalLM on CPU (VERDICT r3 item 3 'done'
+    criterion). Covers sinks, per-layer sliding windows, q/k/v/o biases,
+    router/expert biases, clamped swiglu, and YaRN rope in one shot."""
+    torch = pytest.importorskip("torch")
+
     from dynamo_tpu.models.loader import load_model_dir
 
-    params = llama.init_params(MOE_SPEC, jax.random.PRNGKey(7))
-    _write_gpt_oss_checkpoint(params, str(tmp_path))
-    spec2, params2 = load_model_dir(str(tmp_path), dtype="float32")
-    assert spec2.num_experts == 4
-    tokens = jnp.asarray(np.arange(9) % 96, jnp.int32)
-    want = llama.reference_forward(MOE_SPEC, params, tokens)
-    got = llama.reference_forward(spec2, params2, tokens)
-    np.testing.assert_allclose(
-        np.asarray(got), np.asarray(want), atol=1e-4, rtol=1e-4
+    model = _tiny_hf_gpt_oss(str(tmp_path))
+    tokens = np.arange(13) % 96
+    with torch.no_grad():
+        want = model(torch.tensor(tokens)[None]).logits[0].float().numpy()
+
+    spec, params = load_model_dir(str(tmp_path), dtype="float32")
+    assert spec.attn_sinks and spec.attn_bias and spec.moe_bias
+    assert spec.sliding_window == 8
+    assert spec.layer_types == ("sliding_attention", "full_attention")
+    assert spec.swiglu_limit == 7.0 and spec.rope_scaling_factor == 32.0
+    assert not spec.rope_truncate
+    got = np.asarray(
+        llama.reference_forward(spec, params, jnp.asarray(tokens, jnp.int32))
     )
+    np.testing.assert_allclose(got, want, atol=3e-4, rtol=2e-4)
+
+
+def test_gpt_oss_paged_serving_matches_hf_greedy(tmp_path):
+    """The SERVING path (paged prefill + paged decode with sinks/windows)
+    greedy-decodes the same tokens HF does from the same checkpoint."""
+    torch = pytest.importorskip("torch")
+
+    from dynamo_tpu.models.loader import load_model_dir
+
+    model = _tiny_hf_gpt_oss(str(tmp_path))
+    spec, params = load_model_dir(str(tmp_path), dtype="float32")
+
+    T, N = 11, 5
+    prompt = list(np.arange(5, 5 + T) % 96)
+
+    # HF greedy chain
+    seq = list(prompt)
+    with torch.no_grad():
+        for _ in range(N):
+            lg = model(torch.tensor(seq)[None]).logits[0, -1]
+            seq.append(int(torch.argmax(lg)))
+    want = seq[T:]
+
+    # ours: paged prefill + stepwise paged decode
+    page = 4
+    cache_pages = 16
+    k_pages, v_pages = llama.init_cache(spec, cache_pages, page, dtype="float32")
+    padded = np.zeros((16,), np.int32)
+    padded[:T] = prompt
+    bt = np.zeros((8,), np.int32)
+    bt[:4] = [1, 2, 3, 4]
+    logits, k_pages, v_pages, _d = llama.prefill_forward(
+        spec, params, jnp.asarray(padded), jnp.asarray(bt),
+        jnp.asarray(0, jnp.int32), k_pages, v_pages,
+        jnp.asarray(T, jnp.int32),
+    )
+    got = [int(np.argmax(np.asarray(logits)))]
+    bts = jnp.asarray(bt[None])
+    lens = jnp.asarray([T + 1], jnp.int32)
+    toks = jnp.asarray([got[-1]], jnp.int32)
+    for _ in range(N - 1):
+        lg, k_pages, v_pages = llama.decode_forward(
+            spec, params, toks, bts, lens, k_pages, v_pages,
+            jnp.ones((1,), bool),
+        )
+        nxt = int(np.argmax(np.asarray(lg[0])))
+        got.append(nxt)
+        toks = jnp.asarray([nxt], jnp.int32)
+        lens = lens + 1
+    assert got == want
 
 
 def test_load_qwen_moe_named_checkpoint(tmp_path):
@@ -294,8 +338,7 @@ async def test_gpt_oss_checkpoint_serves_chat(tmp_path):
     from dynamo_tpu.runtime.distributed import DistributedRuntime
     from dynamo_tpu.runtime.hub import InMemoryHub
 
-    params = llama.init_params(MOE_SPEC, jax.random.PRNGKey(9))
-    _write_gpt_oss_checkpoint(params, str(tmp_path))
+    _tiny_hf_gpt_oss(str(tmp_path))
 
     drt = DistributedRuntime(InMemoryHub())
     engine, _served = await launch_engine_worker(

@@ -158,3 +158,43 @@ def test_missing_tensor_raises(tmp_path):
         assert "missing" in str(e)
     else:
         raise AssertionError("expected ValueError for missing tensor")
+
+
+def test_yarn_freqs_match_hf():
+    """yarn_freqs == HF _compute_yarn_parameters for both flagship
+    configs: gpt-oss (truncate off) and DeepSeek-R1 (mscale ratio)."""
+    import pytest
+
+    torch = pytest.importorskip("torch")
+    pytest.importorskip("transformers")
+    from transformers import PretrainedConfig
+    from transformers.modeling_rope_utils import _compute_yarn_parameters
+
+    from dynamo_tpu.engine.config import ModelSpec
+    from dynamo_tpu.models.llama import yarn_freqs
+
+    cases = [
+        # (spec, dim, hf rope_scaling dict)
+        (ModelSpec.gpt_oss_120b(), 64,
+         {"rope_type": "yarn", "factor": 32.0, "beta_fast": 32.0,
+          "beta_slow": 1.0, "original_max_position_embeddings": 4096,
+          "truncate": False}),
+        (ModelSpec.deepseek_r1(), 64,
+         {"rope_type": "yarn", "factor": 40.0, "beta_fast": 32.0,
+          "beta_slow": 1.0, "original_max_position_embeddings": 4096,
+          "mscale": 1.0, "mscale_all_dim": 1.0}),
+    ]
+    for spec, dim, rs in cases:
+        hf_cfg = PretrainedConfig(
+            rope_theta=spec.rope_theta, hidden_size=dim,
+            num_attention_heads=1, head_dim=dim,
+            max_position_embeddings=spec.rope_orig_max_pos,
+            rope_scaling=rs,
+        )
+        want_inv, want_att = _compute_yarn_parameters(hf_cfg, torch.device("cpu"))
+        got_inv, got_att = yarn_freqs(spec, dim)
+        np.testing.assert_allclose(
+            got_inv, want_inv.numpy(), rtol=1e-6, atol=0,
+            err_msg=spec.name,
+        )
+        assert abs(got_att - want_att) < 1e-9, spec.name

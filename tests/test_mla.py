@@ -350,6 +350,8 @@ def test_deepseek_checkpoint_loads(tmp_path):
             "v_head_dim": SPEC.v_head_dim,
             "q_lora_rank": SPEC.q_lora_rank,
             "tie_word_embeddings": False,
+            # synthetic params were written in our half-split rope layout
+            "rope_interleave": False,
         }, f)
     spec2, params2 = load_model_dir(str(tmp_path), dtype="float32")
     assert spec2.is_mla and spec2.kv_lora_rank == SPEC.kv_lora_rank
@@ -359,6 +361,58 @@ def test_deepseek_checkpoint_loads(tmp_path):
     np.testing.assert_allclose(
         np.asarray(got), np.asarray(want), atol=1e-4, rtol=1e-4
     )
+
+
+def test_mla_golden_logits_vs_hf(tmp_path):
+    """HF DeepseekV3 checkpoint -> our loader -> mla.reference_forward:
+    logits must match HF transformers on CPU. All layers dense
+    (first_k_dense_replace = num_layers) so this isolates the MLA
+    attention stack: q/kv LoRA, interleaved-rope weight layout
+    (rope_interleave), YaRN freq correction, and the mscale^2 softmax
+    scale (HF DeepseekV3Attention.__init__)."""
+    import pytest
+
+    torch = pytest.importorskip("torch")
+    tfm = pytest.importorskip("transformers")
+    if not hasattr(tfm, "DeepseekV3ForCausalLM"):
+        pytest.skip("transformers too old for DeepseekV3")
+    from transformers import DeepseekV3Config, DeepseekV3ForCausalLM
+
+    from dynamo_tpu.models.loader import load_model_dir
+
+    cfg = DeepseekV3Config(
+        vocab_size=96, hidden_size=32, intermediate_size=48,
+        moe_intermediate_size=32, num_hidden_layers=2,
+        num_attention_heads=4, num_key_value_heads=4,
+        n_routed_experts=4, num_experts_per_tok=2, n_shared_experts=1,
+        first_k_dense_replace=2,  # dense everywhere: attention-only golden
+        kv_lora_rank=16, q_lora_rank=24,
+        qk_nope_head_dim=16, qk_rope_head_dim=8, v_head_dim=16,
+        rope_theta=10000.0,
+        rope_scaling={
+            "rope_type": "yarn", "factor": 40.0, "beta_fast": 32.0,
+            "beta_slow": 1.0, "original_max_position_embeddings": 4096,
+            "mscale": 1.0, "mscale_all_dim": 1.0,
+        },
+        max_position_embeddings=4096, tie_word_embeddings=False,
+        attention_bias=False,
+    )
+    cfg._attn_implementation = "eager"
+    torch.manual_seed(2)
+    model = DeepseekV3ForCausalLM(cfg).to(torch.float32).eval()
+    model.save_pretrained(str(tmp_path))
+
+    tokens = np.arange(11) % 96
+    with torch.no_grad():
+        want = model(torch.tensor(tokens)[None]).logits[0].float().numpy()
+
+    spec, params = load_model_dir(str(tmp_path), dtype="float32")
+    assert spec.is_mla and spec.rope_interleave
+    assert spec.rope_scaling_factor == 40.0 and spec.rope_mscale_all_dim == 1.0
+    got = np.asarray(
+        mla.reference_forward(spec, params, jnp.asarray(tokens, jnp.int32))
+    )
+    np.testing.assert_allclose(got, want, atol=3e-4, rtol=2e-4)
 
 
 async def test_deepseek_serves_through_engine():

@@ -129,3 +129,70 @@ def test_windowed_chunks_match_reference(monkeypatch):
     np.testing.assert_allclose(
         np.asarray(got), np.asarray(ref), rtol=2e-5, atol=2e-5
     )
+
+
+def test_sliding_window_matches_reference():
+    """gpt-oss per-layer sliding windows: the kernel's window mask must
+    match the pure-JAX form for windows smaller and larger than the
+    context."""
+    q, k, v, bt, lens = _setup(seed=19)
+    for window in (4, 16, 33, 1000):
+        ref = paged_decode_attention(q, k, v, bt, lens, window=window)
+        got = paged_decode_attention_v3(
+            q, k, v, bt, lens, window=window, interpret=True
+        )
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(ref), rtol=2e-5, atol=2e-5,
+            err_msg=f"window={window}",
+        )
+
+
+def test_sinks_match_reference():
+    """gpt-oss attention sinks: the kernel folds the per-head sink logit
+    into the flash-softmax denominator; must equal the concat-softmax
+    reference, including combined with a sliding window and across the
+    multi-chunk merge path."""
+    rng = np.random.default_rng(23)
+    q, k, v, bt, lens = _setup(seed=21)
+    H = q.shape[1]
+    sinks = jnp.asarray(rng.standard_normal((H,)) * 2.0, jnp.float32)
+    ref = paged_decode_attention(q, k, v, bt, lens, sinks=sinks)
+    got = paged_decode_attention_v3(
+        q, k, v, bt, lens, sinks=sinks, interpret=True
+    )
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(ref), rtol=2e-5, atol=2e-5
+    )
+    # window + sinks together (the gpt-oss sliding layers)
+    ref = paged_decode_attention(q, k, v, bt, lens, window=8, sinks=sinks)
+    got = paged_decode_attention_v3(
+        q, k, v, bt, lens, window=8, sinks=sinks, interpret=True
+    )
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(ref), rtol=2e-5, atol=2e-5
+    )
+
+
+def test_sinks_shard_map_tp_dispatch(monkeypatch):
+    """Sinks shard with the query heads under the tp shard_map path."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from dynamo_tpu.ops.attention import paged_decode_attention_auto
+    from dynamo_tpu.parallel.mesh import make_mesh
+
+    monkeypatch.setenv("DYNAMO_PALLAS", "1")
+    rng = np.random.default_rng(29)
+    q, k, v, bt, lens = _setup(B=2, H=8, KH=4, pages_per_seq=2, seed=27)
+    sinks = jnp.asarray(rng.standard_normal((8,)), jnp.float32)
+    mesh = make_mesh(tp=4, dp=2)
+    ref = paged_decode_attention(q, k, v, bt, lens, window=8, sinks=sinks)
+    qs = jax.device_put(q, NamedSharding(mesh, P(None, "tp", None)))
+    ks = jax.device_put(k, NamedSharding(mesh, P(None, "tp", None, None)))
+    vs = jax.device_put(v, NamedSharding(mesh, P(None, "tp", None, None)))
+    ss = jax.device_put(sinks, NamedSharding(mesh, P("tp")))
+    got = paged_decode_attention_auto(
+        qs, ks, vs, bt, lens, mesh=mesh, window=8, sinks=ss
+    )
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(ref), rtol=2e-5, atol=2e-5
+    )
