@@ -16,6 +16,13 @@ reference publishes no absolute tok/s — BASELINE.md): bytes touched per
 step (weights + KV read/write), achieved HBM GB/s, and the fraction of the
 chip's peak HBM bandwidth. ``vs_baseline`` is the ratio against the newest
 recorded ``BENCH_r*.json`` at the repo root, 1.0 when none exists.
+
+The ``serving`` section is a sustained closed-loop concurrency LADDER
+through the real engine (the aiperf-equivalent measurement the reference
+uses — benchmarks/llm/perf.sh): per rung, N streams each keep one request
+open; only tokens inside a steady-state window count; TTFT/ITL p50/p99 and
+output tok/s per rung, plus the best rung's fraction of the matched-batch
+raw-decode ceiling.
 """
 
 from __future__ import annotations
@@ -92,27 +99,49 @@ def prior_value() -> float | None:
     return value
 
 
-def serving_measurement(spec, page_size: int) -> dict:
-    """Engine-path numbers: TTFT/ITL/throughput through InferenceEngine
-    (scheduler + chunked prefill + multi-step decode + sampling + streams),
-    not raw jit calls — the VERDICT r1 'bench the product' item. Random
-    weights; latency/throughput don't care."""
+def serving_measurement(spec, page_size: int, on_tpu: bool) -> dict:
+    """Sustained-load serving ladder through the REAL engine (scheduler +
+    packed/chunked prefill + multi-step pipelined decode + sampling +
+    streams) — the aiperf-equivalent measurement BASELINE.md calls for
+    (ref benchmarks/llm/perf.sh concurrency sweeps).
+
+    Closed-loop concurrency ladder: per rung, N streams each hold one
+    request open at all times (finish -> immediately submit the next).
+    Every rung runs a warmup phase (compile + fill the batch) and then a
+    fixed steady-state window; only tokens/latencies inside the window
+    count. Reported per rung: output tok/s (per chip), TTFT/ITL p50/p99.
+    Random weights; latency/throughput don't care."""
     import asyncio
 
     from dynamo_tpu.engine.config import EngineConfig
     from dynamo_tpu.engine.core import InferenceEngine
     from dynamo_tpu.runtime.context import Context
 
-    N_REQ, ISL, OSL, SLOTS = 32, 128, 48, 32
+    ISL, OSL = 128, 48
+    if on_tpu:
+        SLOTS = 64
+        rungs = [8, 16, 32, 64]
+        warm_s = float(os.environ.get("DYNAMO_BENCH_WARM_SECS", "6"))
+        window_s = float(os.environ.get("DYNAMO_BENCH_RUNG_SECS", "20"))
+    else:  # CPU smoke: tiny model, tiny ladder
+        SLOTS = 8
+        rungs = [2, 4]
+        warm_s, window_s = 2.0, 4.0
+    # table width sized to the workload: ISL+OSL = 176 tokens = 6 pages
+    # at page 32 — a wider table would still be FETCHED only up to the
+    # live length (the kernel's per-page seq_len guard), but block-table
+    # padding rows cost host-side bytes per dispatch
+    pps = max(1, (ISL + OSL + page_size - 1) // page_size + 2)
     cfg = EngineConfig(
         page_size=page_size,
-        num_pages=SLOTS * 16 + 64,
-        max_pages_per_seq=16,
+        num_pages=SLOTS * pps + 64,
+        max_pages_per_seq=pps,
         max_decode_slots=SLOTS,
         prefill_buckets=(128, 256),
         # bursts big enough that device compute covers the host sync
         # round-trip, pipelined so burst k+1 computes while k's tokens
-        # cross back to the host
+        # cross back to the host; bursts shorten automatically while
+        # admissions are pending (decode_steps_admit_pending)
         decode_steps_per_dispatch=16,
         pipeline_decode=True,
     )
@@ -121,54 +150,96 @@ def serving_measurement(spec, page_size: int) -> dict:
         engine = InferenceEngine(spec, cfg)
         await engine.start()
         rng = np.random.default_rng(0)
-        ttfts: list[float] = []
-        itls: list[float] = []
-        total_tokens = 0
 
-        async def one(i: int, record: bool):
-            nonlocal total_tokens
+        async def one_rung(n_streams: int) -> dict:
+            stop = asyncio.Event()
+            state = {"w0": None, "w1": None}
+            ttfts: list[float] = []
+            itls: list[float] = []
+            tok_times: list[float] = []
+
+            async def stream(sid: int):
+                while not stop.is_set():
+                    toks = rng.integers(3, spec.vocab_size, ISL).tolist()
+                    t0 = time.perf_counter()
+                    last = None
+                    async for item in engine.generate(
+                        {"token_ids": toks,
+                         "stop_conditions": {"max_tokens": OSL,
+                                             "ignore_eos": True},
+                         "sampling": {"temperature": 0.0}},
+                        Context(f"bench-{n_streams}-{sid}"),
+                    ):
+                        n = len(item.get("token_ids") or ())
+                        if not n:
+                            continue
+                        now = time.perf_counter()
+                        w0 = state["w0"]
+                        in_win = w0 is not None and now >= w0 and (
+                            state["w1"] is None
+                        )
+                        if in_win:
+                            if last is None:
+                                ttfts.append(now - t0)
+                            else:
+                                itls.extend([(now - last) / n] * n)
+                            tok_times.extend([now] * n)
+                        last = now
+
+            tasks = [asyncio.create_task(stream(i)) for i in range(n_streams)]
+            await asyncio.sleep(warm_s)
+            state["w0"] = time.perf_counter()
+            await asyncio.sleep(window_s)
+            state["w1"] = time.perf_counter()
+            stop.set()
+            await asyncio.gather(*tasks)
+            w0, w1 = state["w0"], state["w1"]
+            n_tok = sum(1 for t in tok_times if w0 <= t <= w1)
+
+            def pct(xs, p):
+                if not xs:
+                    return None
+                xs = sorted(xs)
+                return round(
+                    xs[min(len(xs) - 1, int(p * len(xs)))] * 1e3, 2
+                )
+
+            return {
+                "concurrency": n_streams,
+                "output_tok_per_s": round(n_tok / (w1 - w0), 1),
+                "ttft_ms_p50": pct(ttfts, 0.5),
+                "ttft_ms_p99": pct(ttfts, 0.99),
+                "itl_ms_p50": pct(itls, 0.5),
+                "itl_ms_p99": pct(itls, 0.99),
+            }
+
+        # global warmup: compile every serving shape ONCE before rung 1
+        # (packed + single prefill, the decode burst programs, the batched
+        # first-token sample) so the first rung's window measures steady
+        # state, not compilation
+        async def warm_one(i: int):
             toks = rng.integers(3, spec.vocab_size, ISL).tolist()
-            t0 = time.perf_counter()
-            last = None
-            async for item in engine.generate(
+            async for _ in engine.generate(
                 {"token_ids": toks,
-                 "stop_conditions": {"max_tokens": OSL, "ignore_eos": True},
+                 "stop_conditions": {"max_tokens": 4, "ignore_eos": True},
                  "sampling": {"temperature": 0.0}},
-                Context(f"bench-{i}"),
+                Context(f"bench-warm-{i}"),
             ):
-                n = len(item.get("token_ids") or ())
-                if not n:
-                    continue
-                now = time.perf_counter()
-                if record:
-                    if last is None:
-                        ttfts.append(now - t0)
-                    else:
-                        # bursts deliver several tokens per item
-                        itls.extend([(now - last) / n] * n)
-                    total_tokens += n
-                last = now
+                pass
 
-        # warmup compiles both admission shapes: a concurrent wave (packed
-        # batch prefill) and a straggler (single-prompt program)
-        await asyncio.gather(*(one(i, False) for i in range(4)))
-        await one(99, False)
-        t0 = time.perf_counter()
-        await asyncio.gather(*(one(i, True) for i in range(N_REQ)))
-        wall = time.perf_counter() - t0
+        await asyncio.gather(*(warm_one(i) for i in range(max(rungs))))
+        await warm_one(9999)  # straggler: the single-prompt program
+
+        out_rungs = [await one_rung(n) for n in rungs]
         await engine.close()
-
-        def pct(xs, p):
-            xs = sorted(xs)
-            return round(xs[min(len(xs) - 1, int(p * len(xs)))] * 1e3, 2)
-
+        best = max(out_rungs, key=lambda r: r["output_tok_per_s"])
         return {
-            "requests": N_REQ, "isl": ISL, "osl": OSL, "slots": SLOTS,
-            "output_tok_per_s": round(total_tokens / wall, 1),
-            "ttft_ms_p50": pct(ttfts, 0.5),
-            "ttft_ms_p99": pct(ttfts, 0.99),
-            "itl_ms_p50": pct(itls, 0.5),
-            "itl_ms_p99": pct(itls, 0.99),
+            "mode": "closed-loop ladder",
+            "isl": ISL, "osl": OSL, "slots": SLOTS,
+            "warmup_s": warm_s, "window_s": window_s,
+            "rungs": out_rungs,
+            "output_tok_per_s": best["output_tok_per_s"],
+            "best_concurrency": best["concurrency"],
         }
 
     return asyncio.run(run())
@@ -280,7 +351,20 @@ def main() -> None:
         "device": kind,
     }
     if os.environ.get("DYNAMO_BENCH_SERVING", "1") not in ("0", "false"):
-        out["serving"] = serving_measurement(spec, page_size)
+        out["serving"] = serving_measurement(spec, page_size, on_tpu)
+        # serving efficiency vs the raw-decode ceiling this same run just
+        # measured (VERDICT r3: >= 60% is the bar). Prefer the rung whose
+        # concurrency matches the raw batch; fall back to the top rung so
+        # the metric is always present.
+        rungs = out["serving"]["rungs"]
+        top = next(
+            (r for r in rungs if r["concurrency"] == B),
+            max(rungs, key=lambda r: r["concurrency"]),
+        )
+        out["serving"]["frac_of_raw_decode"] = round(
+            top["output_tok_per_s"] / value, 3
+        )
+        out["serving"]["frac_rung_concurrency"] = top["concurrency"]
     print(json.dumps(out))
 
 

@@ -251,6 +251,23 @@ class EngineConfig:
     # device execution. Adds one burst of stop-detection lag; admissions
     # and cancels flush first.
     pipeline_decode: bool = False
+    # in-flight decode bursts when pipelined. Depth 2 is what hides a
+    # remote host: burst k's token download (started at dispatch) has a
+    # full burst of device time to land before the host consumes it, so
+    # steady-state cycles track device time, not the d2h RTT. Stops are
+    # detected up to depth*burst tokens late (overshoot discarded).
+    pipeline_depth: int = 2
+    # admission first tokens sampled on device and materialized a step
+    # later (never blocks the step thread on the d2h RTT); off = the
+    # synchronous sample-and-emit path
+    async_admissions: bool = True
+    # decode burst cap during RAMP-UP: applies only while prompts are
+    # waiting AND the batch is under half full (n_active*2 < slots) —
+    # there, a full burst would make each queued prompt wait burst *
+    # step_ms before its prefill, inflating TTFT. At >= 50% occupancy
+    # full bursts win (admissions interleave without flushing the
+    # pipeline). 0 = never cap.
+    decode_steps_admit_pending: int = 4
     # chunked prefill (ref: vLLM max_num_batched_tokens pass-through):
     # prompts whose uncached tail exceeds this run as a sequence of
     # chunk-sized prefill steps interleaved with decode, so one long

@@ -62,6 +62,10 @@ class _Slot:
     sample_seed: int = 0  # per-request PRNG seed (reproducible if client-set)
     stalled_steps: int = 0  # consecutive steps skipped waiting for pages
     logprobs: int | None = None  # None=off, N=sampled+top-N per token
+    # async admission: the first sampled token is still ON DEVICE (it
+    # feeds the next decode burst there); the host value materializes one
+    # step later without ever blocking the step thread on the d2h RTT
+    first_pending: bool = False
 
 
 @dataclass
@@ -165,7 +169,14 @@ class InferenceEngine:
         self.steps = 0
         self._partial: _PartialPrefill | None = None
         self._clear_cache_requested = False
-        self._pipeline: dict | None = None  # dispatched-unprocessed burst
+        # dispatched-but-unprocessed decode bursts, oldest first (max
+        # length = config.pipeline_depth when pipeline_decode)
+        self._pipeline: list[dict] = []
+        # async first-token waves, oldest first: each holds a device
+        # sample whose host copy is in flight; waves touch disjoint live
+        # slots (slot-identity guards handle reuse), so they materialize
+        # independently as their copies land
+        self._admit_waves: list[dict] = []
         self._moe_dropped_dev = None  # device-side running drop count
         self.moe_dropped_slots = 0  # last fetched total (metrics surface)
         self._metrics_publishes = 0
@@ -379,7 +390,8 @@ class InferenceEngine:
                 )
                 # queued offloads may reference pages about to be released
                 self._pending_offload.clear()
-                self._pipeline = None  # discard any in-flight burst
+                self._pipeline = []  # discard in-flight bursts
+                self._admit_waves.clear()  # slots error out in the sweep
                 if self._partial is not None:
                     p, self._partial = self._partial, None
                     self.allocator.release(p.sp.pages)
@@ -400,6 +412,13 @@ class InferenceEngine:
                          "error": "engine step failure"},
                     )
                 time.sleep(0.05)
+        # orderly exit: land any in-flight burst and admission wave so
+        # streaming clients get their final items instead of hanging
+        try:
+            self._flush_pipeline()
+            self._materialize_waves(force=True)
+        except Exception:  # noqa: BLE001
+            log.exception("final flush on close failed")
 
     def request_clear_cache(self) -> None:
         """Admin: drop every inactive prefix-cache page (ref the HTTP
@@ -411,17 +430,32 @@ class InferenceEngine:
 
     def _step(self) -> bool:
         did = False
-        if self._pipeline is not None:
-            # the in-flight burst must land before anything mutates the
-            # batch under it: admissions, cancels, admin cache ops
-            needs_admit = self._partial is not None or (
-                any(s is None for s in self._slots)
-                and not self._waiting.empty()
-            )
+        if self._admit_waves:
+            # land admission waves LAZILY: each once its device value is
+            # ready (the d2h then costs just the residual RTT), or after
+            # a bounded age so first tokens never stall forever. Blocking
+            # the step thread on a download still queued behind device
+            # work would serialize the whole pipeline.
+            did |= self._materialize_waves()
+        if self._pipeline:
+            # cancels and admin cache ops need exact slot state: land the
+            # in-flight burst first. Plain ADMISSIONS do not: the device
+            # stream is in-order (prefills enqueue behind the burst), page
+            # eviction only touches refcount-0 pages (active slots hold
+            # refs), a known-free slot stays free until burst processing,
+            # and _build_batch/_process_burst guard by active mask +
+            # request id — so admitting without a flush keeps the decode
+            # pipeline deep instead of paying a host sync per admission
+            # wave. Chunked-prefill advance keeps the flush (its slot
+            # bookkeeping interleaves with the partial's reserved slot).
             stopped = any(
                 s is not None and s.context.is_stopped for s in self._slots
             )
-            if needs_admit or stopped or self._clear_cache_requested:
+            if (
+                self._partial is not None
+                or stopped
+                or self._clear_cache_requested
+            ):
                 self._flush_pipeline()
                 did = True
         if self._clear_cache_requested:
@@ -442,6 +476,11 @@ class InferenceEngine:
             self._publish_metrics()
         else:
             budget = self.config.max_prefill_tokens_per_step
+            # the budget exists to bound how long prefills stall RUNNING
+            # decode streams; on a cold batch (nothing decoding) it only
+            # serializes admissions across steps and inflates TTFT —
+            # admit everything the slots can hold in one step instead
+            decoding = any(s is not None for s in self._slots)
             admitted = False
             pending: list[tuple] = []
             preps: list[dict] = []
@@ -461,7 +500,7 @@ class InferenceEngine:
                     self._peek_waiting_tokens() or ()
                 ) or 1
                 cost = min(cost, self._prefill_chunk_max())
-                if admitted and cost > budget:
+                if admitted and cost > budget and decoding:
                     break  # first admission always proceeds
                 waiting = self._waiting.get_nowait()
                 if waiting.context.is_stopped:
@@ -491,6 +530,11 @@ class InferenceEngine:
         # 2) one decode step over active slots
         if any(s is not None for s in self._slots):
             self._decode_step()
+            did = True
+        elif self._pipeline:
+            # every participant finished early (e.g. lazy-materialized
+            # first tokens exhausting 1-token budgets): drain stale bursts
+            self._flush_pipeline()
             did = True
         return did
 
@@ -1035,13 +1079,45 @@ class InferenceEngine:
             return None
 
     def _complete_admissions(self, pending: list[tuple]) -> None:
-        """Sample every admitted prompt's first token in ONE batched call —
-        one device->host sync per step regardless of admission count (the
-        sync round-trip dominates TTFT when the host is far from the
-        chip). Batch width pads to one static width (max_decode_slots) so
+        """Sample every admitted prompt's first token in ONE batched call.
+
+        Default (async) path: the sampled tokens STAY ON DEVICE — they
+        feed the next decode burst through a device-side gather
+        (_dispatch_burst admit feed) while their host copy rides a
+        copy_to_host_async and materializes at the NEXT step
+        (_materialize_admissions). The step thread never blocks on the
+        d2h round-trip, which is the whole serving bottleneck when the
+        host is far from the chip (measured ~80 ms per fresh download on
+        the tunneled TPU — one blocking sync per admission wave halved
+        steady-state throughput).
+
+        Sync fallback (host needs the token value NOW): multi-host SPMD
+        (logits pulled host-side anyway), logprob requests, and disagg
+        remote-prefill handoffs.
+
+        Batch width pads to one static width (max_decode_slots) so
         sample_tokens keeps a single compiled shape: every extra jit
         compile costs whole seconds on TPU and would stall serving the
         first time each admission count appears."""
+        use_async = (
+            self.config.async_admissions
+            and self.spmd is None
+            and not any(
+                (r[1].request.get("output_options") or {}).get("logprobs")
+                is not None
+                and self.fam.supports_logprobs
+                for r in pending
+            )
+            and not any(
+                ((r[1].request.get("disagg") or {}).get("kv_transfer") or {})
+                .get("do_remote_decode")
+                and self.transfer_source is not None
+                for r in pending
+            )
+        )
+        if use_async:
+            self._complete_admissions_async(pending)
+            return
         recs: list[tuple] = []
         try:
             for slot_idx, waiting, seq, sp, token_ids, max_tokens, logits in pending:
@@ -1051,35 +1127,11 @@ class InferenceEngine:
                     last_token=token_ids[-1],
                 )
                 recs.append((slot_idx, waiting, slot, logits, token_ids, sp))
-            n = len(recs)
-            bucket = max(n, self.config.max_decode_slots)
-            if self.spmd is not None:
-                # multi-host: prefill logits are global (replicated) arrays;
-                # stacking them on device would be a collective program the
-                # followers don't replay. Pull the replicated copies to host
-                # and sample as a purely LOCAL program instead — legal for
-                # one process alone in multi-controller JAX.
-                rows = [np.asarray(r[3], np.float32) for r in recs]
-                stacked = np.stack(rows + [rows[0]] * (bucket - n))
-            else:
-                stacked = jnp.stack(
-                    [r[3] for r in recs] + [recs[0][3]] * (bucket - n)
-                )
-            temps = np.zeros((bucket,), np.float32)
-            topk = np.zeros((bucket,), np.int32)
-            topp = np.ones((bucket,), np.float32)
-            seeds = np.zeros((bucket,), np.uint32)
-            gens = np.zeros((bucket,), np.int32)
-            for i, (_si, _w, slot, _l, _t, _sp) in enumerate(recs):
-                temps[i] = slot.temperature
-                topk[i] = slot.top_k
-                topp[i] = slot.top_p
-                seeds[i] = slot.sample_seed
-                gens[i] = slot.generated
-            sampled_dev = sample_tokens(
-                stacked, jnp.asarray(temps), jnp.asarray(topk),
-                jnp.asarray(topp), jnp.asarray(seeds), jnp.asarray(gens),
+            stacked, sample_args = self._admission_sample_inputs(
+                [r[2] for r in recs], [r[3] for r in recs],
+                on_device=self.spmd is None,
             )
+            sampled_dev = sample_tokens(stacked, *sample_args)
             # logprobs, when any admitted prompt wants them, batch over the
             # same stacked logits: one more fused sync, not one per record
             lp = top_i = top_v = None
@@ -1145,6 +1197,149 @@ class InferenceEngine:
                         {"token_ids": [], "finish_reason": "error",
                          "error": f"admission failed: {e}"},
                     )
+
+    def _admission_sample_inputs(self, slots: list, logits_rows: list,
+                                 *, on_device: bool):
+        """Shared first-token sample batch for BOTH admission paths:
+        logits rows padded to one static width (max_decode_slots) plus
+        the per-slot sampling params. The RNG step is always 0 — these
+        are first tokens (the async path pre-advances ``generated`` for
+        burst bookkeeping, which must not shift the sample stream).
+        ``on_device=False`` stacks on host: under multi-host SPMD the
+        replicated logits must not become a collective program the
+        followers don't replay."""
+        n = len(slots)
+        bucket = max(n, self.config.max_decode_slots)
+        if on_device:
+            stacked = jnp.stack(
+                list(logits_rows) + [logits_rows[0]] * (bucket - n)
+            )
+        else:
+            rows = [np.asarray(r, np.float32) for r in logits_rows]
+            stacked = np.stack(rows + [rows[0]] * (bucket - n))
+        temps = np.zeros((bucket,), np.float32)
+        topk = np.zeros((bucket,), np.int32)
+        topp = np.ones((bucket,), np.float32)
+        seeds = np.zeros((bucket,), np.uint32)
+        gens = np.zeros((bucket,), np.int32)  # first token: RNG step 0
+        for i, slot in enumerate(slots):
+            temps[i] = slot.temperature
+            topk[i] = slot.top_k
+            topp[i] = slot.top_p
+            seeds[i] = slot.sample_seed
+        return stacked, (
+            jnp.asarray(temps), jnp.asarray(topk), jnp.asarray(topp),
+            jnp.asarray(seeds), jnp.asarray(gens),
+        )
+
+    def _complete_admissions_async(self, pending: list[tuple]) -> None:
+        """Async admission completion: sample first tokens on device,
+        start their d2h copy, install the slots with ``first_pending``
+        set, and return WITHOUT waiting. The next decode burst feeds the
+        new slots' tokens straight from the device sample
+        (_dispatch_burst admit feed); the host values materialize at the
+        next step (_materialize_waves)."""
+        recs: list[tuple] = []
+        try:
+            for slot_idx, waiting, seq, sp, token_ids, max_tokens, logits in pending:
+                # counters PRE-advanced past the first token (its value is
+                # still in flight): bursts built before materialization
+                # see the same generated/remaining the sync path would
+                slot = self._make_slot(
+                    waiting, seq, sp,
+                    seq_len=len(token_ids), remaining=max_tokens - 1,
+                    generated=1, last_token=token_ids[-1],
+                )
+                slot.first_pending = True
+                recs.append((slot_idx, waiting, slot, token_ids, sp, logits))
+            stacked, sample_args = self._admission_sample_inputs(
+                [r[2] for r in recs], [r[5] for r in recs], on_device=True
+            )
+            sampled_dev = sample_tokens(stacked, *sample_args)
+            try:
+                sampled_dev.copy_to_host_async()
+            except AttributeError:
+                pass
+        except Exception as e:  # noqa: BLE001
+            log.exception("async admission completion failed")
+            for _si, waiting, _seq, sp, _t, _m, _l in pending:
+                self.allocator.release(sp.pages)
+                sp.pages = []
+                self._post(
+                    waiting.out_q,
+                    {"token_ids": [], "finish_reason": "error",
+                     "error": f"prefill failed: {e}"},
+                )
+            return
+        for slot_idx, _w, slot, _t, _sp, _l in recs:
+            self._slots[slot_idx] = slot
+        self._admit_waves.append(
+            {"dev": sampled_dev, "recs": recs, "fed": set(), "age": 0}
+        )
+
+    def _materialize_waves(self, force: bool = False) -> bool:
+        """Land every admission wave whose device sample is ready (or
+        aged out / forced). Waves cover disjoint LIVE slots, so landing
+        one never depends on another — slot-identity guards skip records
+        whose slot was reused since."""
+        did = False
+        keep: list[dict] = []
+        for ap in self._admit_waves:
+            ap["age"] += 1
+            ready = getattr(ap["dev"], "is_ready", lambda: True)()
+            # age >= 2: two full cycles have passed since the sample was
+            # enqueued — its copy has crossed the wire by now, so the
+            # asarray costs ~nothing even when is_ready under-reports
+            # (observed on the tunneled runtime)
+            if force or ready or ap["age"] >= 2:
+                self._materialize_one(ap)
+                did = True
+            else:
+                keep.append(ap)
+        self._admit_waves = keep
+        return did
+
+    def _materialize_one(self, ap: dict) -> None:
+        """Land one async admission wave: read the (long-since-arrived)
+        first tokens, append them to each slot's sequence, apply stop
+        semantics, and stream the first items."""
+        try:
+            toks = np.asarray(ap["dev"])
+        except Exception as e:  # noqa: BLE001
+            log.exception("admission materialization failed")
+            for slot_idx, _w, slot, _t, _sp, _l in ap["recs"]:
+                if self._slots[slot_idx] is slot:
+                    self._finish(
+                        slot_idx, slot, "error",
+                        error=f"admission failed: {e}",
+                    )
+            return
+        for i, (slot_idx, _waiting, slot, _token_ids, _sp, _l) in enumerate(
+            ap["recs"]
+        ):
+            if self._slots[slot_idx] is not slot:
+                continue  # finished/cancelled since admission
+            tok = int(toks[i])
+            slot.seq.append(tok)
+            slot.last_token = tok
+            slot.first_pending = False
+            # stop semantics of _accept_token, with counters pre-advanced
+            finish = None
+            if (
+                not slot.ignore_eos
+                and slot.generated >= slot.min_tokens
+                and tok in slot.eos_ids
+            ):
+                finish = "stop"
+            elif tok in slot.stop_token_ids and slot.generated >= slot.min_tokens:
+                finish = "stop"
+            elif slot.remaining <= 0:
+                finish = "length"
+            if finish is not None:
+                self._finish(slot_idx, slot, finish, emit=False)
+            self._post(
+                slot.out_q, {"token_ids": [tok], "finish_reason": finish}
+            )
 
     def _run_prefill_chunk(
         self, sp: SeqPages, token_ids: list[int], start: int, end: int
@@ -1331,25 +1526,28 @@ class InferenceEngine:
         either on the trash page or in pages released when the slot
         finishes.
 
-        ``pipeline_decode=True`` adds one burst of pipelining: burst k+1
-        dispatches with its fed tokens CHAINED ON DEVICE from burst k's
-        sampled output, and only then is burst k's host copy processed —
-        the device executes k+1 while the host pays the transfer/RTT and
-        bookkeeping for k. Stops are detected one burst late (discarded
-        garbage, as with mid-burst EOS); admissions, cancels, and admin ops
-        flush the pipeline first (_step)."""
+        ``pipeline_decode=True`` keeps up to ``pipeline_depth`` bursts in
+        flight: each new burst dispatches with its fed tokens CHAINED ON
+        DEVICE from the in-flight bursts' sampled outputs, and only the
+        OLDEST burst's host copy is processed per step. Depth 2 is what
+        makes a remote host free: burst k's token download (started at
+        dispatch) has a full burst of device execution to cross the wire
+        before the host reads it — cycles track device time, not the d2h
+        round-trip. Stops are detected up to depth bursts late (discarded
+        garbage, as with mid-burst EOS); cancels and admin ops flush the
+        pipeline first (_step)."""
         if self.config.pipeline_decode:
-            pending = self._pipeline
-            self._pipeline = None
-            batch = self._build_batch(pending)
+            batch = self._build_batch(self._pipeline)
             if batch is None:
-                if pending is not None:
-                    self._process_burst(pending)
+                if self._pipeline:
+                    self._process_burst(self._pipeline.pop(0))
                 return
-            results = self._dispatch_burst(batch, chain=pending)
-            if pending is not None:
-                self._process_burst(pending)
-            self._pipeline = {"batch": batch, "results": results}
+            results = self._dispatch_burst(
+                batch, chain=self._pipeline or None
+            )
+            self._pipeline.append({"batch": batch, "results": results})
+            if len(self._pipeline) > max(1, self.config.pipeline_depth):
+                self._process_burst(self._pipeline.pop(0))
             return
         batch = self._build_batch(None)
         if batch is None:
@@ -1358,18 +1556,19 @@ class InferenceEngine:
         self._process_burst({"batch": batch, "results": results})
 
     def _flush_pipeline(self) -> None:
-        """Process the in-flight burst (pipelined mode) so slot state is
-        exact before admissions/cancels/admin mutate the batch."""
-        pending, self._pipeline = self._pipeline, None
-        if pending is not None:
-            self._process_burst(pending)
+        """Process every in-flight burst (pipelined mode) so slot state is
+        exact before cancels/admin mutate the batch."""
+        pending, self._pipeline = self._pipeline, []
+        for pb in pending:
+            self._process_burst(pb)
 
-    def _build_batch(self, pending: dict | None) -> dict | None:
+    def _build_batch(self, pending: list[dict] | None) -> dict | None:
         """Assemble host-side arrays for the next burst.
 
-        ``pending`` (pipelined mode) is the dispatched-but-unprocessed
-        burst: its participants have ``extra`` tokens already scheduled on
-        device, so sequence lengths/pages/RNG-steps advance past them."""
+        ``pending`` (pipelined mode) holds the dispatched-but-unprocessed
+        bursts, oldest first: their participants have ``extra`` tokens
+        already scheduled on device, so sequence lengths/pages/RNG-steps
+        advance past them."""
         cfg = self.config
         B = cfg.max_decode_slots
         tokens = np.zeros((B,), np.int32)
@@ -1386,15 +1585,26 @@ class InferenceEngine:
         capacity = cfg.max_context
 
         extra = np.zeros((B,), np.int32)
-        if pending is not None:
-            pb = pending["batch"]
+        for p in pending or ():
+            pb = p["batch"]
             for i in range(B):
                 if pb["active"][i] and self._slot_matches(i, pb):
-                    extra[i] = pb["n_burst"]
+                    extra[i] += pb["n_burst"]
 
         # burst size: bounded by every ready slot's room to the context cap
         # (an overshooting position would clamp-index into a LIVE page)
         n_burst = cfg.decode_steps_per_dispatch
+        n_active = sum(s is not None for s in self._slots)
+        if (
+            cfg.decode_steps_admit_pending
+            and not self._waiting.empty()
+            and n_active * 2 < len(self._slots)
+        ):
+            # ramp-up: the batch is mostly empty and prompts are waiting —
+            # short bursts get the next admission wave in sooner. At high
+            # occupancy full bursts win (admissions no longer flush the
+            # pipeline, so they are cheap to interleave).
+            n_burst = max(1, min(n_burst, cfg.decode_steps_admit_pending))
         for i, slot in enumerate(self._slots):
             if slot is not None and not slot.context.is_stopped:
                 n_burst = max(
@@ -1405,7 +1615,7 @@ class InferenceEngine:
             if slot is None:
                 continue
             if slot.context.is_stopped:
-                if pending is None:
+                if not pending:
                     self._finish(i, slot, "cancelled")
                 # pipelined: _step flushed before cancels normally; a race
                 # here just skips the slot — the next (flushed) step
@@ -1480,9 +1690,12 @@ class InferenceEngine:
         slot = self._slots[i]
         return slot is not None and slot.request_id == batch["participants"].get(i)
 
-    def _dispatch_burst(self, batch: dict, chain: dict | None):
-        """Issue the fused decode; feed tokens from the in-flight burst's
-        device output when chaining (no host sync on the feed path)."""
+    def _dispatch_burst(self, batch: dict, chain: list[dict] | None):
+        """Issue the fused decode; feed tokens from the in-flight bursts'
+        device outputs when chaining (no host sync on the feed path).
+        ``chain`` is oldest-first; newer bursts override older rows, so a
+        slot inactive in the newest burst (page-stalled for one burst)
+        still feeds from its latest on-device token."""
         if self.spmd is not None:
             self.spmd.publish(
                 "decode",
@@ -1500,10 +1713,36 @@ class InferenceEngine:
                 },
             )
         tokens_in = jnp.asarray(batch["tokens"])
-        if chain is not None:
-            prev_sampled = chain["results"][0]  # device [B, n_prev]
-            prev_active = jnp.asarray(chain["batch"]["active"])
+        for prev in chain or ():
+            prev_sampled = prev["results"][0]  # device [B, n_prev]
+            prev_active = jnp.asarray(prev["batch"]["active"])
             tokens_in = jnp.where(prev_active, prev_sampled[:, -1], tokens_in)
+        for ap in self._admit_waves:
+            # freshly admitted slots: feed their first token from the
+            # device-side admission sample (its host copy is still in
+            # flight — see _complete_admissions_async). Feed each slot's
+            # FIRST burst only: later bursts dispatched before the wave
+            # materializes must chain from the newer on-device samples,
+            # not re-feed token 0.
+            B = len(self._slots)
+            mask = np.zeros((B,), bool)
+            idx = np.zeros((B,), np.int32)
+            for row, (slot_idx, _w, slot, _t, _sp, _l) in enumerate(
+                ap["recs"]
+            ):
+                if (
+                    self._slots[slot_idx] is slot
+                    and slot.first_pending
+                    and batch["active"][slot_idx]
+                    and slot_idx not in ap["fed"]
+                ):
+                    mask[slot_idx] = True
+                    idx[slot_idx] = row
+                    ap["fed"].add(slot_idx)
+            if mask.any():
+                tokens_in = jnp.where(
+                    jnp.asarray(mask), ap["dev"][jnp.asarray(idx)], tokens_in
+                )
         result = self.fam.decode_steps(
             self.spec,
             self.params,
@@ -1528,6 +1767,13 @@ class InferenceEngine:
             sampled, self.k_pages, self.v_pages = result
             lp = top_i = top_v = None
         self.steps += batch["n_burst"]
+        # start the tokens' d2h NOW: by processing time (a cycle later)
+        # the copy has landed and the host asarray is free — the fresh
+        # ~80ms download RTT rides under the next burst's execution
+        try:
+            sampled.copy_to_host_async()
+        except AttributeError:
+            pass
         return (sampled, lp, top_i, top_v)
 
     def _process_burst(self, pending: dict) -> None:
@@ -1535,6 +1781,21 @@ class InferenceEngine:
         seal pages, stream items. Participant request-ids guard against a
         slot that finished (and was discarded) between dispatch and
         processing."""
+        if self._admit_waves:
+            # a burst containing slots whose first token hasn't landed
+            # cannot be processed yet — sequence order requires the first
+            # token before burst tokens. Force down exactly those waves.
+            part = pending["batch"]["active"]
+            keep = []
+            for ap in self._admit_waves:
+                if any(
+                    self._slots[si] is s and s.first_pending and part[si]
+                    for si, _w, s, _t, _sp, _l in ap["recs"]
+                ):
+                    self._materialize_one(ap)
+                else:
+                    keep.append(ap)
+            self._admit_waves = keep
         batch = pending["batch"]
         sampled_dev, lp_dev, ti_dev, tv_dev = pending["results"]
         n_burst = batch["n_burst"]

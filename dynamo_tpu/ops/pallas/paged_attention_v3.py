@@ -31,9 +31,13 @@ kernel runs 16 layers in 0.9ms where the full head-major version needs
   the table fits one window (the common serving shape).
 
 Window size is chosen so VMEM stays bounded for ANY table length —
-there is no large-table fallback path. All window pages are fetched
-unconditionally (short sequences re-read the trash page; masking
-handles correctness) — fixed DMA count, no dynamic control flow.
+there is no large-table fallback path. WHOLE window chunks outside a
+sequence's live range (or outside its sliding window) are skipped on
+the prefetched seq_len — decode DMA tracks the actual context, not the
+table width, for any table longer than one window. The guard is chunk-
+granular on purpose: per-page guards measured ~20% slower (branches
+between copy starts break the back-to-back DMA issue). Skipped buffer
+slots hold stale data; masking handles correctness (V sanitized).
 
 Reference counterpart: the engine-internal paged attention the
 reference delegates to vLLM, plus its block-copy kernel
@@ -87,32 +91,56 @@ def _decode_kernel_v3(
     P, Pw = pages_per_seq, window_pages
     n_chunks = (P + Pw - 1) // Pw  # static
 
+    def chunk_live(seq, chunk):
+        """Whether this window chunk intersects the sequence's live (and,
+        for sliding layers, windowed) range. CHUNK granularity on purpose:
+        a per-page guard was measured ~20% slower at near-full tables —
+        branches between copy starts break the back-to-back DMA issue the
+        kernel exists for — while chunk guards keep each window's issue
+        burst intact and still skip whole windows of a long table that a
+        short context (or a sliding window) never reads."""
+        live = chunk * Pw * page_size < seq_lens_ref[seq]
+        if window:
+            live &= (chunk * Pw + Pw) * page_size > seq_lens_ref[seq] - window
+        return live
+
     def issue(buf, seq, chunk):
         """Start one window's page copies (K and V). ``chunk`` is static;
-        pages past P are skipped at trace time (their buffer slots hold
-        stale data, masked out by the global-page validity check)."""
-        for p in range(Pw):
-            gp = chunk * Pw + p
-            if gp >= P:
-                break
-            pid = block_tables_ref[seq, gp]
-            pltpu.make_async_copy(
-                k_pages_ref.at[pid], kv_buf.at[buf, 0, p], sems.at[buf, 0, p]
-            ).start()
-            pltpu.make_async_copy(
-                v_pages_ref.at[pid], kv_buf.at[buf, 1, p], sems.at[buf, 1, p]
-            ).start()
+        pages past P are skipped at trace time; whole chunks past the live
+        range are skipped at run time (chunk_live). Skipped slots hold
+        stale data, masked out by the validity check (V sanitized)."""
 
-    def wait(buf, chunk):
-        for p in range(Pw):
-            if chunk * Pw + p >= P:
-                break
-            pltpu.make_async_copy(
-                k_pages_ref.at[0], kv_buf.at[buf, 0, p], sems.at[buf, 0, p]
-            ).wait()
-            pltpu.make_async_copy(
-                v_pages_ref.at[0], kv_buf.at[buf, 1, p], sems.at[buf, 1, p]
-            ).wait()
+        @pl.when(chunk_live(seq, chunk))
+        def _():
+            for p in range(Pw):
+                gp = chunk * Pw + p
+                if gp >= P:
+                    break
+                pid = block_tables_ref[seq, gp]
+                pltpu.make_async_copy(
+                    k_pages_ref.at[pid], kv_buf.at[buf, 0, p],
+                    sems.at[buf, 0, p],
+                ).start()
+                pltpu.make_async_copy(
+                    v_pages_ref.at[pid], kv_buf.at[buf, 1, p],
+                    sems.at[buf, 1, p],
+                ).start()
+
+    def wait(buf, seq, chunk):
+        # must mirror issue() exactly: wait only on copies that started
+        @pl.when(chunk_live(seq, chunk))
+        def _():
+            for p in range(Pw):
+                if chunk * Pw + p >= P:
+                    break
+                pltpu.make_async_copy(
+                    k_pages_ref.at[0], kv_buf.at[buf, 0, p],
+                    sems.at[buf, 0, p],
+                ).wait()
+                pltpu.make_async_copy(
+                    v_pages_ref.at[0], kv_buf.at[buf, 1, p],
+                    sems.at[buf, 1, p],
+                ).wait()
 
     # global chunk counter g = b * n_chunks + c; buffer = g % 2. Chunk 0
     # of program 0 is issued here; every other chunk is prefetched by its
@@ -151,9 +179,14 @@ def _decode_kernel_v3(
             def _(nxt=nxt):
                 issue(nxt, b + 1, 0)
 
-        wait(buf, c)
+        wait(buf, b, c)
         kf = kv_buf[buf, 0].reshape(Nw, D).astype(jnp.float32)
         vf = kv_buf[buf, 1].reshape(Nw, D).astype(jnp.float32)
+        # slots whose fetch was skipped hold UNINITIALIZED VMEM: garbage
+        # K only feeds masked score columns (where -> NEG_INF), but a
+        # non-finite V would turn 0-prob x V into NaN in the acc matmul —
+        # sanitize. (K needs no guard; NaN scores land on valid=False.)
+        vf = jnp.where(jnp.isfinite(vf), vf, 0.0)
         scores = jax.lax.dot_general(
             qf, kf, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
