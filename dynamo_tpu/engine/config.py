@@ -246,10 +246,12 @@ class EngineConfig:
     # scheduling analogue): amortizes host dispatch + token sync; tokens
     # stream in bursts of this size, EOS overshoot is discarded host-side
     decode_steps_per_dispatch: int = 1
-    # pipeline one decode burst: dispatch k+1 (tokens chained on device)
-    # before syncing k's results, hiding dispatch/transfer latency behind
-    # device execution. Adds one burst of stop-detection lag; admissions
-    # and cancels flush first.
+    # pipelined decode bursts: dispatch ahead with fed tokens chained on
+    # device, syncing results pipeline_depth bursts late — dispatch and
+    # d2h transfer latency hide behind device execution. Stops are
+    # detected up to pipeline_depth * decode_steps_per_dispatch tokens
+    # late (overshoot discarded). Cancels and admin ops flush the
+    # pipeline; admissions interleave WITHOUT flushing.
     pipeline_decode: bool = False
     # in-flight decode bursts when pipelined. Depth 2 is what hides a
     # remote host: burst k's token download (started at dispatch) has a

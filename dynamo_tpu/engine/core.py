@@ -1714,9 +1714,22 @@ class InferenceEngine:
             )
         tokens_in = jnp.asarray(batch["tokens"])
         for prev in chain or ():
+            pb = prev["batch"]
             prev_sampled = prev["results"][0]  # device [B, n_prev]
-            prev_active = jnp.asarray(prev["batch"]["active"])
-            tokens_in = jnp.where(prev_active, prev_sampled[:, -1], tokens_in)
+            # guard rows by request identity, exactly like _build_batch's
+            # `extra` accumulation: a slot freed (EOS in an older burst)
+            # and reused by a NEW request must not have the dead
+            # request's stale in-flight token override its first token
+            valid = np.fromiter(
+                (
+                    pb["active"][i] and self._slot_matches(i, pb)
+                    for i in range(len(self._slots))
+                ),
+                dtype=bool, count=len(self._slots),
+            )
+            tokens_in = jnp.where(
+                jnp.asarray(valid), prev_sampled[:, -1], tokens_in
+            )
         for ap in self._admit_waves:
             # freshly admitted slots: feed their first token from the
             # device-side admission sample (its host copy is still in

@@ -272,7 +272,13 @@ class HttpFrontend:
                     )
                     if chat
                     else pipe.preprocessor.postprocess_completions_stream(
-                        timed, request_id=ctx.id
+                        timed, request_id=ctx.id,
+                        include_usage=bool(
+                            (body.get("stream_options") or {}).get(
+                                "include_usage"
+                            )
+                        ),
+                        prompt_tokens=prompt_tokens,
                     )
                 )
                 resp = await self._sse(request, pp, ctx)

@@ -373,10 +373,14 @@ class OpenAIPreprocessor:
         deltas: AsyncIterator[dict[str, Any]],
         *,
         request_id: str | None = None,
+        include_usage: bool = False,
+        prompt_tokens: int = 0,
     ) -> AsyncIterator[dict[str, Any]]:
         rid = request_id or new_request_id()
         created = now_unix()
+        completion_tokens = 0
         async for d in deltas:
+            completion_tokens += len(d.get("token_ids", ()))
             choice: dict[str, Any] = {
                 "index": 0,
                 "text": d.get("text", ""),
@@ -390,6 +394,21 @@ class OpenAIPreprocessor:
                 "created": created,
                 "model": self.model_name,
                 "choices": [choice],
+            }
+        if include_usage:
+            # OpenAI stream_options.include_usage: one final chunk with
+            # empty choices and the token accounting
+            yield {
+                "id": rid,
+                "object": "text_completion",
+                "created": created,
+                "model": self.model_name,
+                "choices": [],
+                "usage": {
+                    "prompt_tokens": prompt_tokens,
+                    "completion_tokens": completion_tokens,
+                    "total_tokens": prompt_tokens + completion_tokens,
+                },
             }
 
     async def aggregate_completions(

@@ -1,0 +1,211 @@
+"""Worker-kill fault tolerance, end to end over the REAL transport.
+
+A live worker process is SIGKILLed mid-stream under load; the stream must
+complete through a second worker with the generated tokens carried over
+(Migration operator), the client seeing one uninterrupted token stream.
+Ref: /root/reference/tests/fault_tolerance/test_request_migration.py —
+the reference kills a vLLM worker with `kill -9` and asserts the frontend
+round-robin + migration finish the request on the survivor.
+
+Deterministic kill-targeting: the stream STARTS while worker A is the
+only instance (so it must be serving it); worker B registers afterwards;
+then A dies. The process harness mirrors tests/test_multihost.py.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+import urllib.request
+
+import pytest
+
+pytestmark = pytest.mark.e2e
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+MAX_TOKENS = 160
+
+
+def _env():
+    return {
+        **os.environ,
+        "PYTHONPATH": REPO,
+        "JAX_PLATFORMS": "cpu",
+        # fast lease expiry so the dead worker's instance key drops while
+        # the test still runs (default 10s)
+        "DYN_LEASE_TTL_S": "3.0",
+        "DYN_KEEPALIVE_INTERVAL_S": "1.0",
+    }
+
+
+def _spawn(args, ready_prefix, procs, timeout=120.0):
+    p = subprocess.Popen(
+        [sys.executable, *args], stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT, text=True, cwd=REPO, env=_env(),
+    )
+    procs.append(p)
+    deadline = time.time() + timeout
+    lines = []
+    while time.time() < deadline:
+        line = p.stdout.readline()
+        if not line:
+            raise RuntimeError(
+                f"{args}: exited rc={p.poll()} before {ready_prefix!r}\n"
+                + "".join(lines[-40:])
+            )
+        lines.append(line)
+        line = line.strip()
+        if line.startswith(ready_prefix):
+            return p, line.split("=", 1)[-1] if "=" in line else line
+    raise RuntimeError(f"{args}: timed out waiting for {ready_prefix!r}")
+
+
+def _worker_args(hub_addr):
+    return [
+        "-m", "dynamo_tpu.engine.worker", "--hub", hub_addr,
+        "--model", "tiny-test",
+        "--page-size", "4", "--num-pages", "256",
+        "--max-pages-per-seq", "64", "--max-decode-slots", "2",
+    ]
+
+
+def _instances(hub_addr):
+    import asyncio
+
+    from dynamo_tpu.runtime.hub_client import RemoteHub
+
+    async def go():
+        hub = await RemoteHub.connect(hub_addr)
+        try:
+            keys = await hub.get_prefix("v1/instances/")
+            return [k for k in keys if "/generate/" in k]
+        finally:
+            await hub.close()
+
+    return asyncio.run(go())
+
+
+def test_worker_sigkill_mid_stream_migrates():
+    procs: list[subprocess.Popen] = []
+    try:
+        _hub_p, hub_addr = _spawn(
+            ["-m", "dynamo_tpu.runtime.hub_server", "--port", "0"],
+            "DYNAMO_HUB=", procs,
+        )
+        worker_a, _ = _spawn(_worker_args(hub_addr), "ENGINE_READY", procs)
+        _frontend_p, http_addr = _spawn(
+            ["-m", "dynamo_tpu.frontend", "--hub", hub_addr,
+             "--host", "127.0.0.1", "--port", "0"],
+            "DYNAMO_HTTP=", procs,
+        )
+        base = f"http://{http_addr}"
+
+        deadline = time.time() + 30
+        models = []
+        while time.time() < deadline and not models:
+            with urllib.request.urlopen(f"{base}/v1/models", timeout=5) as r:
+                models = json.load(r)["data"]
+            if not models:
+                time.sleep(0.2)
+        assert [m["id"] for m in models] == ["tiny-test"]
+
+        # start the stream while A is the ONLY worker: it must serve it
+        req = urllib.request.Request(
+            f"{base}/v1/completions",
+            data=json.dumps({
+                "model": "tiny-test", "prompt": "kill resilience",
+                "max_tokens": MAX_TOKENS, "temperature": 0.0,
+                "ignore_eos": True, "stream": True,
+                "stream_options": {"include_usage": True},
+            }).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        resp = urllib.request.urlopen(req, timeout=120)
+        assert resp.status == 200
+
+        chunks: list[dict] = []
+
+        def read_events(until_tokens: int | None):
+            """Consume SSE lines; stop after ``until_tokens`` text chunks
+            (None = run to [DONE])."""
+            n = sum(
+                1 for c in chunks
+                if (c.get("choices") or [{}])[0].get("text")
+            )
+            while True:
+                line = resp.readline().decode()
+                if not line:
+                    return False
+                line = line.strip()
+                if not line.startswith("data:"):
+                    continue
+                payload = line[5:].strip()
+                if payload == "[DONE]":
+                    return True
+                chunks.append(json.loads(payload))
+                ch = chunks[-1].get("choices") or []
+                if ch and ch[0].get("text"):
+                    n += 1
+                    if until_tokens is not None and n >= until_tokens:
+                        return False
+
+        # a few tokens flow from A
+        read_events(10)
+
+        # worker B comes up (identical params: same preset + seed)
+        worker_b, _ = _spawn(_worker_args(hub_addr), "ENGINE_READY", procs)
+        deadline = time.time() + 20
+        while time.time() < deadline and len(_instances(hub_addr)) < 2:
+            time.sleep(0.2)
+        assert len(_instances(hub_addr)) == 2
+
+        # SIGKILL the serving worker mid-stream
+        worker_a.send_signal(signal.SIGKILL)
+
+        # the stream must COMPLETE through B via Migration (generated
+        # tokens carried over; budget shrunk accordingly)
+        done = read_events(None)
+        assert done, "stream ended without [DONE]"
+        finishes = [
+            c["choices"][0].get("finish_reason")
+            for c in chunks
+            if c.get("choices") and c["choices"][0].get("finish_reason")
+        ]
+        assert finishes == ["length"], finishes
+        # every requested token arrived exactly once across the kill
+        # (detokenized chunks may merge/hold tokens; usage counts tokens)
+        usages = [c["usage"] for c in chunks if c.get("usage")]
+        assert usages, "no usage chunk (include_usage)"
+        assert usages[-1]["completion_tokens"] == MAX_TOKENS, usages[-1]
+
+        # the dead worker's lease expires -> its instance key drops; the
+        # survivor remains (ref: lease-based liveness, kv_router watch)
+        deadline = time.time() + 15
+        while time.time() < deadline and len(_instances(hub_addr)) != 1:
+            time.sleep(0.5)
+        assert len(_instances(hub_addr)) == 1
+
+        # and the system still serves new requests
+        req2 = urllib.request.Request(
+            f"{base}/v1/completions",
+            data=json.dumps({
+                "model": "tiny-test", "prompt": "after the crash",
+                "max_tokens": 4, "temperature": 0.0, "ignore_eos": True,
+            }).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req2, timeout=60) as r:
+            body = json.load(r)
+        assert body["usage"]["completion_tokens"] == 4
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.terminate()
+        for p in procs:
+            try:
+                p.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                p.kill()
