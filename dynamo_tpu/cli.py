@@ -27,6 +27,7 @@ SUBCOMMAND_MODULES = {
     "worker": "dynamo_tpu.engine.worker",
     "mocker": "dynamo_tpu.mocker.__main__",
     "router": "dynamo_tpu.kv_router.service",
+    "encoder": "dynamo_tpu.multimodal.worker",
     "planner": "dynamo_tpu.planner.__main__",
     "bench": "benchmarks.loadgen",
     "profile": "benchmarks.profile_sla",

@@ -868,13 +868,60 @@ class InferenceEngine:
         cfg = self.config
         return min(cfg.max_prefill_chunk_tokens, cfg.prefill_buckets[-1])
 
+    def _decode_multimodal(self, req: dict) -> dict | None:
+        """Validate + decode the request's multimodal payload (encoder
+        rows + placeholder positions). Returns None for text requests."""
+        mm = req.get("multimodal")
+        if not mm:
+            return None
+        if "embeds_b64" not in mm:
+            raise ValueError(
+                "multimodal request reached the engine without embeddings "
+                "(is an encode worker registered?)"
+            )
+        if not getattr(self.fam, "supports_multimodal", False):
+            raise ValueError(
+                f"{type(self.fam).__name__} does not support image input"
+            )
+        import base64 as _b64
+        import hashlib as _hl
+
+        raw = _b64.b64decode(mm["embeds_b64"])
+        embeds = np.frombuffer(
+            raw, dtype=np.dtype(mm.get("dtype", "float32"))
+        ).reshape(mm["shape"]).astype(np.float32)
+        positions = np.asarray(mm.get("positions") or (), np.int32)
+        if embeds.ndim != 2 or embeds.shape[0] != positions.shape[0]:
+            raise ValueError(
+                f"multimodal rows {embeds.shape} do not match "
+                f"{positions.shape[0]} placeholder positions"
+            )
+        if embeds.shape[1] != self.spec.hidden_size:
+            raise ValueError(
+                f"multimodal embedding width {embeds.shape[1]} != model "
+                f"hidden size {self.spec.hidden_size}"
+            )
+        # cache-partitioning salt: identical prompts with DIFFERENT images
+        # share placeholder token ids, so unsalted block hashes would
+        # alias across images (and against text prompts). Salting by the
+        # embedding digest keeps prefix reuse exact: same prompt + same
+        # image rehits, anything else misses. (ref tokens.rs SaltHash)
+        return {
+            "embeds": embeds,
+            "positions": positions,
+            "salt": _hl.sha256(raw).hexdigest()[:16],
+        }
+
     def _prefill(self, slot_idx: int, waiting: _Waiting) -> tuple | None:
         cfg = self.config
         req = waiting.request
         token_ids = list(req["token_ids"])
         max_tokens = self._decode_budget(req, len(token_ids))
+        mm = self._decode_multimodal(req)
 
-        seq = TokenBlockSequence.from_tokens(token_ids, cfg.page_size)
+        seq = TokenBlockSequence.from_tokens(
+            token_ids, cfg.page_size, salt=mm["salt"] if mm else None
+        )
         needed_pages = (len(token_ids) + cfg.page_size - 1) // cfg.page_size
         try:
             sp = self._acquire_prompt_pages(
@@ -894,7 +941,7 @@ class InferenceEngine:
         try:
             return self._prefill_with_pages(
                 slot_idx, waiting, seq, sp, token_ids, max_tokens,
-                start_pos, tail,
+                start_pos, tail, mm=mm,
             )
         except BaseException:
             # anything after acquisition failing must hand the pages back
@@ -906,7 +953,7 @@ class InferenceEngine:
 
     def _prefill_with_pages(
         self, slot_idx, waiting, seq, sp, token_ids, max_tokens,
-        start_pos, tail,
+        start_pos, tail, mm: dict | None = None,
     ) -> tuple | None:
         """Run the prompt's forward. Returns a pending-admission record
         ``(slot_idx, waiting, seq, sp, token_ids, max_tokens, logits)``
@@ -915,6 +962,22 @@ class InferenceEngine:
         step pays ONE device->host sync, not one per prompt. Returns None
         when a chunked prefill was started instead."""
         cfg = self.config
+        if mm is not None:
+            # multimodal: ONE immediate dispatch with embedding injection
+            # (no packed batching, no ring; chunking would split the
+            # placeholder span across dispatches)
+            if start_pos + self._prefill_chunk_max() < len(token_ids):
+                raise ValueError(
+                    "multimodal prompt exceeds a single prefill dispatch "
+                    f"({len(token_ids) - start_pos} uncached tokens > "
+                    f"max_prefill_chunk_tokens {self._prefill_chunk_max()})"
+                )
+            logits = self._run_prefill_chunk(
+                sp, token_ids, start_pos, len(token_ids), mm=mm
+            )
+            self._seal_prompt_blocks(sp, seq)  # salted hashes: cache-safe
+            self._drain_offload()
+            return (slot_idx, waiting, seq, sp, token_ids, max_tokens, logits)
         use_ring = (
             self.mesh is not None
             and self.fam.supports_ring_prefill
@@ -1342,9 +1405,13 @@ class InferenceEngine:
             )
 
     def _run_prefill_chunk(
-        self, sp: SeqPages, token_ids: list[int], start: int, end: int
+        self, sp: SeqPages, token_ids: list[int], start: int, end: int,
+        mm: dict | None = None,
     ) -> jax.Array:
-        """One bucketed prefill forward over token positions [start, end)."""
+        """One bucketed prefill forward over token positions [start, end).
+        ``mm``: multimodal embedding rows injected at their (window-
+        relative) placeholder positions; rows covered by the cached
+        prefix are skipped (the salted cache already holds their KV)."""
         cfg = self.config
         new_tokens = token_ids[start:end]
         bucket = cfg.bucket_for(len(new_tokens))
@@ -1352,11 +1419,31 @@ class InferenceEngine:
         padded[: len(new_tokens)] = new_tokens
         block_table = np.zeros((cfg.max_pages_per_seq,), np.int32)
         block_table[: sp.num_pages] = sp.pages
+        mm_kwargs: dict[str, Any] = {}
+        mm_arrays: dict[str, np.ndarray] = {}
+        if mm is not None:
+            rel = mm["positions"] - start
+            keep = rel >= 0
+            rel = rel[keep]
+            rows = mm["embeds"][keep]
+            # pad to a power-of-two width (>= 8): one compiled shape per
+            # width tier; padded positions point past the bucket -> the
+            # injection scatter drops them
+            m = max(8, 1 << max(0, int(rel.shape[0]) - 1).bit_length())
+            pos_pad = np.full((m,), bucket, np.int32)
+            pos_pad[: rel.shape[0]] = rel
+            emb_pad = np.zeros((m, self.spec.hidden_size), np.float32)
+            emb_pad[: rows.shape[0]] = rows
+            mm_arrays = {"mm_embeds": emb_pad, "mm_pos": pos_pad}
+            mm_kwargs = {
+                "mm_embeds": jnp.asarray(emb_pad),
+                "mm_pos": jnp.asarray(pos_pad),
+            }
         if self.spmd is not None:
             self.spmd.publish(
                 "prefill",
                 {"start": start, "num_tokens": len(new_tokens)},
-                {"tokens": padded, "block_table": block_table},
+                {"tokens": padded, "block_table": block_table, **mm_arrays},
             )
         logits, self.k_pages, self.v_pages, dropped = self.fam.prefill(
             self.spec,
@@ -1368,6 +1455,7 @@ class InferenceEngine:
             self.v_pages,
             jnp.asarray(len(new_tokens), jnp.int32),
             mesh=self.mesh,
+            **mm_kwargs,
         )
         self._note_moe_dropped(dropped)
         return logits
@@ -1471,7 +1559,13 @@ class InferenceEngine:
         if int(meta.get("page_size", cfg.page_size)) != cfg.page_size:
             raise ValueError("page_size mismatch between prefill and decode")
 
-        seq = TokenBlockSequence.from_tokens(token_ids, cfg.page_size)
+        # multimodal resume: the sealed blocks hold IMAGE-conditioned KV —
+        # hash them under the same image salt the prefill side used, or
+        # identical placeholder token ids would alias across images
+        mm = self._decode_multimodal(req)
+        seq = TokenBlockSequence.from_tokens(
+            token_ids, cfg.page_size, salt=mm["salt"] if mm else None
+        )
         needed_pages = (len(token_ids) + cfg.page_size - 1) // cfg.page_size
         try:
             sp = self._acquire_prompt_pages(

@@ -51,6 +51,8 @@ async def launch_engine_worker(
     tool_call_parser: str | None = None,
     reasoning_parser: str | None = None,
     mode: str = "aggregated",
+    mm_tokens_per_image: int = 0,
+    image_token_id: int = 0,
     prefill_component: str = PREFILL_COMPONENT,
     prefill_router_mode: str = "kv",
     max_local_prefill_length: int = 128,
@@ -155,6 +157,8 @@ async def launch_engine_worker(
             router_mode=router_mode,
             tool_call_parser=tool_call_parser,
             reasoning_parser=reasoning_parser,
+            mm_tokens_per_image=mm_tokens_per_image,
+            image_token_id=image_token_id,
             runtime_config={"engine": "jax", "tp": cfg.tp, "mode": mode},
             metadata={"engine": "jax", "role": mode},
         )
@@ -386,6 +390,8 @@ async def _amain(args: argparse.Namespace) -> None:
         tool_call_parser=args.tool_call_parser,
         reasoning_parser=args.reasoning_parser,
         mode=args.mode,
+        mm_tokens_per_image=args.mm_tokens_per_image,
+        image_token_id=args.image_token_id,
         prefill_component=args.prefill_component,
         prefill_router_mode=args.prefill_router_mode,
         max_local_prefill_length=args.max_local_prefill_length,
@@ -427,6 +433,10 @@ def main() -> None:
                         "mistral, pythonic, ...)")
     p.add_argument("--reasoning-parser", default=None,
                    help="reasoning parser name (basic, deepseek_r1, granite)")
+    p.add_argument("--mm-tokens-per-image", type=int, default=0,
+                   help="placeholder tokens per image (0 = text-only); "
+                        "requires an encode worker on the namespace")
+    p.add_argument("--image-token-id", type=int, default=0)
     p.add_argument("--mode", default="aggregated",
                    choices=["aggregated", "prefill", "decode"])
     p.add_argument("--prefill-component", default=PREFILL_COMPONENT)

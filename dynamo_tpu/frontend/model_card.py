@@ -35,6 +35,10 @@ class ModelDeploymentCard:
     chat_template: str | None = None
     tool_call_parser: str | None = None  # parsers.TOOL_PARSERS key
     reasoning_parser: str | None = None  # parsers.REASONING_PARSERS key
+    # multimodal: placeholder tokens spliced per image (0 = text-only);
+    # the engine overwrites them with encoder embedding rows at prefill
+    mm_tokens_per_image: int = 0
+    image_token_id: int = 0
     runtime_config: dict[str, Any] = field(default_factory=dict)
 
     def key_for(self, instance_id: int) -> str:
@@ -72,6 +76,8 @@ async def register_llm(
     router_mode: str = "kv",
     tool_call_parser: str | None = None,
     reasoning_parser: str | None = None,
+    mm_tokens_per_image: int = 0,
+    image_token_id: int = 0,
     runtime_config: dict[str, Any] | None = None,
     metadata: dict[str, Any] | None = None,
 ):
@@ -93,6 +99,8 @@ async def register_llm(
         router_mode=router_mode,
         tool_call_parser=tool_call_parser,
         reasoning_parser=reasoning_parser,
+        mm_tokens_per_image=mm_tokens_per_image,
+        image_token_id=image_token_id,
         runtime_config=runtime_config or {},
     )
     served = await endpoint.serve(

@@ -35,6 +35,8 @@ class OpenAIPreprocessor:
         default_max_tokens: int = 256,
         tool_call_parser: str | None = None,
         reasoning_parser: str | None = None,
+        mm_tokens_per_image: int = 0,
+        image_token_id: int = 0,
     ):
         self.tokenizer = tokenizer
         self.model_name = model_name
@@ -42,6 +44,9 @@ class OpenAIPreprocessor:
         self.default_max_tokens = default_max_tokens
         self.tool_call_parser = tool_call_parser
         self.reasoning_parser = reasoning_parser
+        # multimodal: 0 = text-only model (image content parts rejected)
+        self.mm_tokens_per_image = mm_tokens_per_image
+        self.image_token_id = image_token_id
         # fail fast on unknown parser names: a typo must break worker
         # startup, not every subsequent chat request
         from dynamo_tpu.parsers import make_reasoning_parser, make_tool_config
@@ -68,6 +73,68 @@ class OpenAIPreprocessor:
 
     # -- forward: OpenAI request -> PreprocessedRequest --------------------
 
+    IMAGE_MARKER = "<|mm_image|>"
+
+    def _flatten_content(
+        self, request: dict[str, Any]
+    ) -> tuple[dict[str, Any], list[str]]:
+        """OpenAI content-part lists -> string contents + image refs.
+
+        Text parts concatenate; each image_url part becomes an inline
+        marker (spliced into placeholder tokens after rendering) and its
+        URL collects in order. Ref: the template-level multimodal prompt
+        handling of lib/llm/src/preprocessor/prompt/template/oai.rs."""
+        if "messages" not in request:
+            return request, []
+        has_images = any(
+            isinstance(m.get("content"), list)
+            and any(
+                isinstance(p, dict) and p.get("type") == "image_url"
+                for p in m["content"]
+            )
+            for m in request["messages"]
+        )
+
+        def clean(text: str) -> str:
+            # the marker is RESERVED while images are present: a literal
+            # occurrence in user text would desync the marker/image count
+            # when positions are recovered from the rendered prompt
+            return (
+                text.replace(self.IMAGE_MARKER, "") if has_images else text
+            )
+
+        images: list[str] = []
+        msgs = []
+        changed = False
+        for m in request["messages"]:
+            c = m.get("content")
+            if isinstance(c, list):
+                parts: list[str] = []
+                for part in c:
+                    ptype = part.get("type") if isinstance(part, dict) else None
+                    if ptype == "text":
+                        parts.append(clean(str(part.get("text") or "")))
+                    elif ptype == "image_url":
+                        iu = part.get("image_url")
+                        url = iu.get("url") if isinstance(iu, dict) else iu
+                        if not url:
+                            raise ValueError("image_url part without url")
+                        images.append(url)
+                        parts.append(self.IMAGE_MARKER)
+                    else:
+                        raise ValueError(
+                            f"unsupported content part type {ptype!r}"
+                        )
+                m = {**m, "content": "".join(parts)}
+                changed = True
+            elif has_images and isinstance(c, str) and self.IMAGE_MARKER in c:
+                m = {**m, "content": clean(c)}
+                changed = True
+            msgs.append(m)
+        if not changed:
+            return request, images
+        return {**request, "messages": msgs}, images
+
     def render_prompt(self, request: dict[str, Any]) -> str:
         if "messages" in request:
             messages = request["messages"]
@@ -90,10 +157,49 @@ class OpenAIPreprocessor:
             prompt = "".join(prompt)
         return prompt
 
+    def _tokenize_with_images(
+        self, prompt: str, n_images: int
+    ) -> tuple[list[int], list[int]]:
+        """Tokenize around image markers, splicing ``mm_tokens_per_image``
+        placeholder ids per image. Returns (token_ids, placeholder
+        positions — absolute prompt positions the engine overwrites with
+        the encoder's embedding rows)."""
+        segs = prompt.split(self.IMAGE_MARKER)
+        if len(segs) - 1 != n_images:
+            raise ValueError(
+                "image markers and image parts diverged (chat template "
+                "dropped message content?)"
+            )
+        token_ids: list[int] = []
+        positions: list[int] = []
+        for i, seg in enumerate(segs):
+            if seg:
+                token_ids.extend(self.tokenizer.encode(seg))
+            if i < n_images:
+                start = len(token_ids)
+                positions.extend(
+                    range(start, start + self.mm_tokens_per_image)
+                )
+                token_ids.extend(
+                    [self.image_token_id] * self.mm_tokens_per_image
+                )
+        return token_ids, positions
+
     def preprocess(self, request: dict[str, Any]) -> dict[str, Any]:
         """OpenAI chat/completions request (dict) -> PreprocessedRequest."""
+        request, images = self._flatten_content(request)
+        if images and not self.mm_tokens_per_image:
+            raise ValueError(
+                f"model {self.model_name!r} does not accept image input"
+            )
         prompt = self.render_prompt(request)
-        token_ids = self.tokenizer.encode(prompt)
+        if images:
+            token_ids, mm_positions = self._tokenize_with_images(
+                prompt, len(images)
+            )
+        else:
+            token_ids = self.tokenizer.encode(prompt)
+            mm_positions = []
         if len(token_ids) >= self.context_length:
             raise ValueError(
                 f"prompt ({len(token_ids)} tokens) exceeds context length "
@@ -124,7 +230,7 @@ class OpenAIPreprocessor:
             # static top-k size into the shared decode step (recompiles /
             # k > vocab crashes affecting co-batched requests)
             raise ValueError("logprobs/top_logprobs must be between 0 and 20")
-        return make_preprocessed_request(
+        pre = make_preprocessed_request(
             token_ids,
             max_tokens=max_tokens,
             temperature=request.get("temperature"),
@@ -140,6 +246,11 @@ class OpenAIPreprocessor:
             else [],
             logprobs=logprobs,
         )
+        if images:
+            # image refs ride to the MultimodalEncode operator, which
+            # swaps them for embeddings before routing (EPD encode hop)
+            pre["multimodal"] = {"images": images, "positions": mm_positions}
+        return pre
 
     @staticmethod
     def _chat_logprob_content(entries: list[dict]) -> list[dict]:

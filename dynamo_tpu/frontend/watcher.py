@@ -32,13 +32,16 @@ log = logging.getLogger("dynamo.discovery")
 class ModelPipeline:
     card: ModelDeploymentCard
     preprocessor: OpenAIPreprocessor
-    engine: Any  # Backend chain: Backend(Migration(router))
+    engine: Any  # Backend chain: Backend(MmEncode?(Migration(router)))
     push_router: PushRouter
     kv_router: KvRouter | None
+    encode_router: PushRouter | None = None  # multimodal encode hop
 
     async def close(self) -> None:
         if self.kv_router is not None:
             await self.kv_router.close()
+        if self.encode_router is not None:
+            await self.encode_router.client.close()
         await self.push_router.client.close()
 
     def generate(self, preprocessed: dict, context: Context) -> AsyncIterator[dict]:
@@ -108,9 +111,29 @@ async def build_pipeline(
     from dynamo_tpu.runtime.pipeline import build_chain
 
     extra = list(card.runtime_config.get("operators") or [])
+    # multimodal cards get the encode hop: image refs resolve to
+    # embeddings via the encoder component BEFORE migration/routing
+    encode_router: PushRouter | None = None
+    mm_ops: list = []
+    if card.mm_tokens_per_image:
+        from dynamo_tpu.multimodal.worker import (
+            ENCODER_COMPONENT,
+            ENCODER_ENDPOINT,
+        )
+
+        enc_ep = (
+            drt.namespace(card.namespace)
+            .component(ENCODER_COMPONENT)
+            .endpoint(ENCODER_ENDPOINT)
+        )
+        encode_router = await PushRouter.from_endpoint(
+            enc_ep, RouterMode.ROUND_ROBIN
+        )
+        mm_ops = [("mm_encode", {"encode_router": encode_router})]
     backend = build_chain(
         [
             ("backend", {"tokenizer": tokenizer}),
+            *mm_ops,
             *extra,
             ("migration", {"migration_limit": card.migration_limit}),
         ],
@@ -123,6 +146,8 @@ async def build_pipeline(
         chat_template=card.chat_template,
         tool_call_parser=card.tool_call_parser,
         reasoning_parser=card.reasoning_parser,
+        mm_tokens_per_image=card.mm_tokens_per_image,
+        image_token_id=card.image_token_id,
     )
     return ModelPipeline(
         card=card,
@@ -130,6 +155,7 @@ async def build_pipeline(
         engine=backend,
         push_router=push,
         kv_router=kv_router,
+        encode_router=encode_router,
     )
 
 

@@ -223,11 +223,15 @@ class KvPushRouter:
         self.kv_router.update_workers(self.push_router.client.instance_ids())
 
         pinned = request.get("backend_instance_id")
+        # per-request cache-partition salt (multimodal: image digest) —
+        # must match the engine's salted block hashes or overlap
+        # estimates are systematically wrong for image traffic
+        req_salt = (request.get("multimodal") or {}).get("salt") or self.salt
         if pinned is not None:
             worker_id, overlap = pinned, 0
         else:
             worker_id, overlap = self.kv_router.find_best_match(
-                context.id, token_ids, salt=self.salt
+                context.id, token_ids, salt=req_salt
             )
         request = dict(request)
         request["estimated_prefix_hit_num_blocks"] = overlap

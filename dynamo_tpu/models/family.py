@@ -41,6 +41,7 @@ class GqaFamily:
     supports_mesh = True
     supports_logprobs = True
     supports_embeddings = True
+    supports_multimodal = True  # prefill embedding injection (EPD)
 
     def __init__(self, spec: Any | None = None):
         from dynamo_tpu.models import llama
@@ -63,9 +64,11 @@ class GqaFamily:
     def init_cache(self, spec, num_pages, page_size):
         return self.m.init_cache(spec, num_pages, page_size)
 
-    def prefill(self, spec, params, tokens, bt, start, k, v, n, mesh=None):
+    def prefill(self, spec, params, tokens, bt, start, k, v, n, mesh=None,
+                mm_embeds=None, mm_pos=None):
         return self.m.prefill_forward(
-            spec, params, tokens, bt, start, k, v, n, mesh=mesh
+            spec, params, tokens, bt, start, k, v, n, mesh=mesh,
+            mm_embeds=mm_embeds, mm_pos=mm_pos,
         )
 
     def prefill_batch(self, spec, params, tokens, bts, starts, k, v, ns,
@@ -114,6 +117,7 @@ class MlaFamily:
     supports_mesh = True
     supports_logprobs = False
     supports_embeddings = False
+    supports_multimodal = False
 
     def __init__(self):
         from dynamo_tpu.models import mla

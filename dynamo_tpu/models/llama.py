@@ -308,11 +308,16 @@ def prefill_forward_impl(
     v_pages: jax.Array,
     num_tokens: jax.Array,  # scalar: real token count in ``tokens``
     mesh: Mesh | None = None,  # static: replicate logits across the mesh
+    mm_embeds: jax.Array | None = None,  # [M, d] multimodal embedding rows
+    mm_pos: jax.Array | None = None,  # [M] window-relative positions (pad >= T)
 ) -> tuple[jax.Array, jax.Array, jax.Array]:
     """Process one prompt; writes KV pages; returns (last_logits, k, v).
 
     Attention runs over the gathered paged context (cached prefix + newly
     written tokens), so prefix-cache hits skip recompute of cached tokens.
+    ``mm_embeds``/``mm_pos``: encoder rows overwrite the placeholder
+    tokens' embeddings (multimodal EPD injection — one masked scatter;
+    padded positions >= T drop).
     """
     T = tokens.shape[0]
     idx = jnp.arange(T)
@@ -339,6 +344,8 @@ def prefill_forward_impl(
         return arr.reshape(n_pg, page_size, kh, hd).transpose(0, 2, 1, 3)
 
     x = params["embed"][tokens]  # [T, d]
+    if mm_embeds is not None:
+        x = x.at[mm_pos].set(mm_embeds.astype(x.dtype), mode="drop")
     kv_len = start_pos + num_tokens
     moe_dropped = jnp.zeros((), jnp.int32)
 

@@ -1,0 +1,20 @@
+"""Multimodal serving (EPD: Encode -> Prefill -> Decode).
+
+Image content parts in chat requests flow through a dedicated ENCODE
+worker that turns images into embedding rows; the engine injects those
+rows at the prompt's image-placeholder positions during prefill.
+Mirror of the reference's multimodal components
+(examples/multimodal/components/encode_worker.py, processor.py;
+components/src/dynamo/sglang/request_handlers/multimodal/
+encode_worker_handler.py) redesigned for this stack: the encoder is a
+first-class runtime component discovered like any worker, embeddings
+travel as one base64 tensor on the existing push transport, and the
+engine-side injection is a single masked scatter in the prefill jit.
+"""
+
+from dynamo_tpu.multimodal.encoder import (
+    MockVisionEncoder,
+    load_image_bytes,
+)
+
+__all__ = ["MockVisionEncoder", "load_image_bytes"]

@@ -1,0 +1,79 @@
+"""Vision encoders for the multimodal EPD path.
+
+``MockVisionEncoder`` is the CI/test encoder (the reference's multimodal
+tests run mock encoders the same way): deterministic embeddings seeded by
+the image CONTENT digest, so the same image always produces the same
+rows and different images measurably change the model's output — which
+is exactly what the E2E tests assert. A real vision tower (ViT in JAX)
+drops in behind the same ``encode`` interface.
+
+Images arrive as OpenAI ``image_url`` values. In this zero-egress
+environment only ``data:`` URIs (base64) and local ``file://`` paths are
+fetchable; http(s) URLs raise cleanly.
+"""
+
+from __future__ import annotations
+
+import base64
+import hashlib
+import os
+
+import numpy as np
+
+__all__ = ["MockVisionEncoder", "load_image_bytes"]
+
+
+def load_image_bytes(url: str) -> bytes:
+    """Fetch one image's raw bytes from a data: URI or file:// path."""
+    if url.startswith("data:"):
+        # data:[<mediatype>][;base64],<payload>
+        try:
+            header, payload = url.split(",", 1)
+        except ValueError as e:
+            raise ValueError(f"malformed data URI: {url[:40]}...") from e
+        if ";base64" in header:
+            return base64.b64decode(payload)
+        return payload.encode()
+    if url.startswith("file://"):
+        # file reads from untrusted request input are an arbitrary-file
+        # oracle on the encode worker host — explicit opt-in only
+        # (tests / trusted single-tenant deployments)
+        if os.environ.get("DYNAMO_MM_ALLOW_FILE_URLS") not in ("1", "true"):
+            raise ValueError(
+                "file:// image_url is disabled "
+                "(set DYNAMO_MM_ALLOW_FILE_URLS=1 to opt in)"
+            )
+        with open(url[len("file://"):], "rb") as f:
+            return f.read()
+    raise ValueError(
+        "only data: URIs and file:// paths are supported for image_url "
+        f"(got {url[:40]!r}...)"
+    )
+
+
+class MockVisionEncoder:
+    """Deterministic content-seeded embeddings: [tokens_per_image, hidden]
+    rows per image, unit-scale normal values from a digest-seeded RNG."""
+
+    def __init__(self, hidden_size: int, tokens_per_image: int = 4,
+                 scale: float = 1.0):
+        self.hidden_size = hidden_size
+        self.tokens_per_image = tokens_per_image
+        self.scale = scale
+
+    def encode(self, images: list[bytes]) -> np.ndarray:
+        """-> [n_images * tokens_per_image, hidden_size] float32."""
+        rows = []
+        for img in images:
+            seed = int.from_bytes(
+                hashlib.sha256(img).digest()[:8], "little"
+            )
+            rng = np.random.default_rng(seed)
+            rows.append(
+                rng.standard_normal(
+                    (self.tokens_per_image, self.hidden_size)
+                ).astype(np.float32) * self.scale
+            )
+        if not rows:
+            return np.zeros((0, self.hidden_size), np.float32)
+        return np.concatenate(rows, axis=0)

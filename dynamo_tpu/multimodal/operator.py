@@ -1,0 +1,89 @@
+"""MultimodalEncode operator: the frontend half of the EPD encode hop.
+
+Sits in the model pipeline between the backend op and migration
+(Backend -> MultimodalEncode -> Migration -> router): requests whose
+preprocessed form carries image refs get them resolved to ONE embeddings
+tensor by the encode worker before routing — once per request, so a
+migration retry reuses the already-encoded rows instead of re-encoding.
+Ref: the processor->encode_worker hop of
+examples/multimodal/components/processor.py.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Any, AsyncIterator
+
+from dynamo_tpu.runtime.context import Context
+
+log = logging.getLogger("dynamo.mm.op")
+
+
+class MultimodalEncode:
+    def __init__(self, downstream, *, encode_router):
+        self.downstream = downstream
+        self.encode_router = encode_router
+
+    async def generate(
+        self, request: dict[str, Any], context: Context
+    ) -> AsyncIterator[dict[str, Any]]:
+        mm = request.get("multimodal")
+        if mm and mm.get("images") and "embeds_b64" not in mm:
+            resp: dict[str, Any] | None = None
+            try:
+                async for item in self.encode_router.generate(
+                    {"images": mm["images"]},
+                    context.child(f"{context.id}-enc"),
+                ):
+                    resp = item
+                    break
+            except Exception as e:  # noqa: BLE001
+                log.exception("encode worker call failed")
+                yield {"token_ids": [], "finish_reason": "error",
+                       "error": f"image encoding unavailable: {e}"}
+                return
+            if not resp or resp.get("error"):
+                yield {"token_ids": [], "finish_reason": "error",
+                       "error": (resp or {}).get("error", "empty encode reply")}
+                return
+            # config-skew check at the hop, not deep in the engine: the
+            # encoder's row count per image must match the model card's
+            # placeholder span
+            tpi = resp.get("tokens_per_image")
+            n_pos = len(mm.get("positions") or ())
+            if tpi and n_pos and len(mm["images"]) * int(tpi) != n_pos:
+                yield {
+                    "token_ids": [], "finish_reason": "error",
+                    "error": (
+                        f"encoder produces {tpi} rows/image but the model "
+                        f"card spliced {n_pos // len(mm['images'])} "
+                        "placeholder tokens/image — align "
+                        "--tokens-per-image with mm_tokens_per_image"
+                    ),
+                }
+                return
+            import base64 as _b64
+            import hashlib as _hl
+
+            enriched = {
+                k: resp[k] for k in ("embeds_b64", "shape", "dtype")
+            }
+            # same digest the engine salts its block hashes with — the
+            # KV router needs it to estimate overlap correctly
+            enriched["salt"] = _hl.sha256(
+                _b64.b64decode(resp["embeds_b64"])
+            ).hexdigest()[:16]
+            request = {
+                **request,
+                # raw image refs stay behind; the engine sees embeddings
+                "multimodal": {
+                    **{k: v for k, v in mm.items() if k != "images"},
+                    **enriched,
+                },
+            }
+        async for item in self.downstream.generate(request, context):
+            yield item
+
+
+def make_operator(sink, **kwargs) -> "MultimodalEncode":
+    return MultimodalEncode(sink, **kwargs)

@@ -1,0 +1,126 @@
+"""Encode worker: the E of the multimodal EPD pipeline.
+
+A runtime component (namespace/encoder/encode) that turns a request's
+image refs into one embeddings tensor, returned base64 over the push
+transport. The frontend's MultimodalEncode operator calls it before
+routing; the engine injects the rows at the prompt's placeholder
+positions. Ref: examples/multimodal/components/encode_worker.py and the
+per-engine encode_worker_handler.py files — here the encoder is just
+another discovered worker on the same data plane.
+
+Run: ``python -m dynamo_tpu.multimodal.worker --hub HOST:PORT \
+      --hidden-size 128 --tokens-per-image 4``
+Prints ``ENCODER_READY`` once registered.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import base64
+import logging
+from typing import Any
+
+import numpy as np
+
+from dynamo_tpu.multimodal.encoder import MockVisionEncoder, load_image_bytes
+
+log = logging.getLogger("dynamo.mm.worker")
+
+ENCODER_COMPONENT = "encoder"
+ENCODER_ENDPOINT = "encode"
+
+
+def embeds_to_wire(arr: np.ndarray) -> dict[str, Any]:
+    arr = np.ascontiguousarray(arr, dtype=np.float32)
+    return {
+        "embeds_b64": base64.b64encode(arr.tobytes()).decode(),
+        "shape": list(arr.shape),
+        "dtype": "float32",
+    }
+
+
+def embeds_from_wire(d: dict[str, Any]) -> np.ndarray:
+    return np.frombuffer(
+        base64.b64decode(d["embeds_b64"]), dtype=np.dtype(d["dtype"])
+    ).reshape(d["shape"])
+
+
+async def launch_encode_worker(
+    drt,
+    *,
+    namespace: str = "dynamo",
+    hidden_size: int,
+    tokens_per_image: int = 4,
+    encoder=None,
+):
+    """Serve the encode endpoint on ``drt``; returns the served handle."""
+    enc = encoder or MockVisionEncoder(hidden_size, tokens_per_image)
+
+    async def handler(request: dict, context):
+        urls = list(request.get("images") or [])
+        try:
+            images = [load_image_bytes(u) for u in urls]
+            rows = enc.encode(images)
+        except Exception as e:  # noqa: BLE001
+            yield {"error": f"image encode failed: {e}"}
+            return
+        out = embeds_to_wire(rows)
+        out["tokens_per_image"] = enc.tokens_per_image
+        yield out
+
+    ep = (
+        drt.namespace(namespace)
+        .component(ENCODER_COMPONENT)
+        .endpoint(ENCODER_ENDPOINT)
+    )
+    served = await ep.serve(
+        handler,
+        metadata={
+            "role": "encoder",
+            "tokens_per_image": enc.tokens_per_image,
+            "hidden_size": hidden_size,
+        },
+    )
+    return served
+
+
+async def _amain(args) -> None:
+    from dynamo_tpu.runtime.config import RuntimeConfig
+    from dynamo_tpu.runtime.distributed import DistributedRuntime
+    from dynamo_tpu.runtime.hub_client import connect_hub
+
+    rcfg = RuntimeConfig.from_env()
+    if args.hub:
+        rcfg.hub_address = args.hub
+    drt = DistributedRuntime(await connect_hub(rcfg.hub_address), rcfg)
+    await launch_encode_worker(
+        drt,
+        namespace=args.namespace,
+        hidden_size=args.hidden_size,
+        tokens_per_image=args.tokens_per_image,
+    )
+    print("ENCODER_READY", flush=True)
+    try:
+        await asyncio.Event().wait()
+    finally:
+        await drt.close()
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser("dynamo-tpu-encode-worker")
+    p.add_argument("--hub", required=True)
+    p.add_argument("--namespace", default="dynamo")
+    p.add_argument("--hidden-size", type=int, required=True)
+    p.add_argument("--tokens-per-image", type=int, default=4)
+    args = p.parse_args(argv)
+    logging.basicConfig(level=logging.INFO)
+    try:
+        asyncio.run(_amain(args))
+    except KeyboardInterrupt:
+        pass
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

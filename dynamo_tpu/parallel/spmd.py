@@ -156,11 +156,21 @@ class SpmdFollower:
             # go through the family adapter so the compiled programs are
             # the leader's exact entry points for this architecture.
             if op == "prefill":
+                import jax.numpy as _jnp
+
+                mm_kwargs = {}
+                if "mm_embeds" in ar:
+                    mm_kwargs = {
+                        "mm_embeds": _jnp.asarray(
+                            ar["mm_embeds"].astype(np.float32)
+                        ),
+                        "mm_pos": jnp_i32(ar["mm_pos"]),
+                    }
                 _logits, eng.k_pages, eng.v_pages, _d = fam.prefill(
                     spec, eng.params,
                     jnp_i32(ar["tokens"]), jnp_i32(ar["block_table"]),
                     jnp_scalar(sc["start"]), eng.k_pages, eng.v_pages,
-                    jnp_scalar(sc["num_tokens"]), mesh=mesh,
+                    jnp_scalar(sc["num_tokens"]), mesh=mesh, **mm_kwargs,
                 )
             elif op == "ring_prefill":
                 (_logits, eng.k_pages, eng.v_pages,

@@ -71,6 +71,9 @@ registry.register_lazy(
 registry.register_lazy(
     "backend", "dynamo_tpu.frontend.backend_op", "make_operator"
 )
+registry.register_lazy(
+    "mm_encode", "dynamo_tpu.multimodal.operator", "make_operator"
+)
 
 
 def build_chain(ops: list, sink: Any, *, reg: OperatorRegistry | None = None):
