@@ -883,13 +883,12 @@ class InferenceEngine:
             raise ValueError(
                 f"{type(self.fam).__name__} does not support image input"
             )
-        import base64 as _b64
-        import hashlib as _hl
+        from dynamo_tpu.multimodal.worker import (
+            embeds_from_wire,
+            salt_from_wire,
+        )
 
-        raw = _b64.b64decode(mm["embeds_b64"])
-        embeds = np.frombuffer(
-            raw, dtype=np.dtype(mm.get("dtype", "float32"))
-        ).reshape(mm["shape"]).astype(np.float32)
+        embeds = embeds_from_wire(mm).astype(np.float32)
         positions = np.asarray(mm.get("positions") or (), np.int32)
         if embeds.ndim != 2 or embeds.shape[0] != positions.shape[0]:
             raise ValueError(
@@ -909,7 +908,7 @@ class InferenceEngine:
         return {
             "embeds": embeds,
             "positions": positions,
-            "salt": _hl.sha256(raw).hexdigest()[:16],
+            "salt": mm.get("salt") or salt_from_wire(mm),
         }
 
     def _prefill(self, slot_idx: int, waiting: _Waiting) -> tuple | None:
@@ -1561,10 +1560,16 @@ class InferenceEngine:
 
         # multimodal resume: the sealed blocks hold IMAGE-conditioned KV —
         # hash them under the same image salt the prefill side used, or
-        # identical placeholder token ids would alias across images
-        mm = self._decode_multimodal(req)
+        # identical placeholder token ids would alias across images.
+        # Prefer the salt the encode operator attached (only the digest
+        # is needed here, not an MB-scale payload decode).
+        mm_req = req.get("multimodal") or {}
+        mm_salt = mm_req.get("salt")
+        if mm_salt is None and mm_req:
+            mm = self._decode_multimodal(req)
+            mm_salt = mm["salt"] if mm else None
         seq = TokenBlockSequence.from_tokens(
-            token_ids, cfg.page_size, salt=mm["salt"] if mm else None
+            token_ids, cfg.page_size, salt=mm_salt
         )
         needed_pages = (len(token_ids) + cfg.page_size - 1) // cfg.page_size
         try:

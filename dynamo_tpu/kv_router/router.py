@@ -247,8 +247,16 @@ class KvPushRouter:
         finally:
             self.kv_router.free(context.id)
 
-    def best_worker_id(self, token_ids: list[int], request_id: str = "probe") -> tuple[int, int]:
-        """Routing decision without dispatch (standalone router service API)."""
-        wid, overlap = self.kv_router.find_best_match(request_id, token_ids, salt=self.salt)
+    def best_worker_id(
+        self, token_ids: list[int], request_id: str = "probe",
+        *, salt: str | None = None,
+    ) -> tuple[int, int]:
+        """Routing decision without dispatch (standalone router service
+        API). ``salt``: per-request cache-partition salt (multimodal
+        image digest) — must match the engine's block hashing or the
+        overlap estimate is systematically wrong for image traffic."""
+        wid, overlap = self.kv_router.find_best_match(
+            request_id, token_ids, salt=salt or self.salt
+        )
         self.kv_router.free(request_id)
         return wid, overlap

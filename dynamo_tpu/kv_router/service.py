@@ -88,7 +88,10 @@ class RouterService:
         self, request: dict[str, Any], context: Context
     ) -> AsyncIterator[dict[str, Any]]:
         token_ids = request.get("token_ids") or []
-        wid, overlap = self.kv_push.best_worker_id(token_ids, context.id)
+        wid, overlap = self.kv_push.best_worker_id(
+            token_ids, context.id,
+            salt=(request.get("multimodal") or {}).get("salt"),
+        )
         yield {"worker_id": wid, "overlap_blocks": overlap,
                "finish_reason": "stop"}
 

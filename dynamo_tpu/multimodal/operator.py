@@ -62,17 +62,21 @@ class MultimodalEncode:
                     ),
                 }
                 return
-            import base64 as _b64
-            import hashlib as _hl
+            missing = [
+                k for k in ("embeds_b64", "shape", "dtype") if k not in resp
+            ]
+            if missing:
+                yield {"token_ids": [], "finish_reason": "error",
+                       "error": f"malformed encode reply: missing {missing}"}
+                return
+            from dynamo_tpu.multimodal.worker import salt_from_wire
 
             enriched = {
                 k: resp[k] for k in ("embeds_b64", "shape", "dtype")
             }
             # same digest the engine salts its block hashes with — the
             # KV router needs it to estimate overlap correctly
-            enriched["salt"] = _hl.sha256(
-                _b64.b64decode(resp["embeds_b64"])
-            ).hexdigest()[:16]
+            enriched["salt"] = salt_from_wire(resp)
             request = {
                 **request,
                 # raw image refs stay behind; the engine sees embeddings

@@ -46,6 +46,16 @@ def embeds_from_wire(d: dict[str, Any]) -> np.ndarray:
     ).reshape(d["shape"])
 
 
+def salt_from_wire(d: dict[str, Any]) -> str:
+    """Cache-partition salt for a wire payload: the embedding digest.
+    SINGLE definition — the operator (router-visible salt) and the
+    engine (block-hash salt) must agree bit for bit."""
+    import hashlib
+
+    raw = base64.b64decode(d["embeds_b64"])
+    return hashlib.sha256(raw).hexdigest()[:16]
+
+
 async def launch_encode_worker(
     drt,
     *,

@@ -1,0 +1,41 @@
+#!/usr/bin/env bash
+# gpt-oss-120b expert-parallel serving (BASELINE config 4).
+# Ref: recipes/gpt-oss-120b engine configs — experts shard over the ep
+# mesh axis, attention heads over tp; harmony tool calls + gpt_oss
+# reasoning channels parse natively.
+#
+# Production: HUB=... MODEL_PATH=/ckpt/gpt-oss-120b ./agg-ep.sh
+# SMOKE=1: the SAME ep x tp topology with the tiny-gpt-oss spec (sinks,
+# sliding windows, biases, clamped swiglu, YaRN all live) on a virtual
+# CPU mesh. Exercised by tests/test_recipes_launch.py.
+set -euo pipefail
+cd "$(dirname "$0")/../.."
+
+EP="${EP:-8}"
+TP="${TP:-2}"
+PAGE="${PAGE:-32}"
+NUM_PAGES="${NUM_PAGES:-4096}"
+SLOTS="${SLOTS:-64}"
+MODEL_ARGS=(--model-path "${MODEL_PATH:-/ckpt/gpt-oss-120b}")
+
+if [ "${SMOKE:-0}" = "1" ]; then
+  export JAX_PLATFORMS=cpu
+  export XLA_FLAGS="--xla_force_host_platform_device_count=4"
+  EP=2 TP=2 PAGE=4 NUM_PAGES=64 SLOTS=2
+  MODEL_ARGS=(--model tiny-gpt-oss)
+fi
+
+HUBLOG=$(mktemp)
+python -m dynamo_tpu.runtime.hub_server --port 0 > "$HUBLOG" &
+trap 'kill $(jobs -p) 2>/dev/null' EXIT
+until grep -q DYNAMO_HUB "$HUBLOG" 2>/dev/null; do sleep 0.2; done
+HUB=$(grep -m1 DYNAMO_HUB "$HUBLOG" | cut -d= -f2)
+echo "hub: $HUB"
+
+python -m dynamo_tpu.engine.worker --hub "$HUB" "${MODEL_ARGS[@]}" \
+  --model-name "${MODEL:-gpt-oss-120b}" \
+  --ep "$EP" --tp "$TP" --page-size "$PAGE" --num-pages "$NUM_PAGES" \
+  --max-decode-slots "$SLOTS" \
+  --tool-call-parser harmony --reasoning-parser gpt_oss &
+exec python -m dynamo_tpu.frontend --hub "$HUB" --host 127.0.0.1 \
+  --port "${PORT:-8000}"
