@@ -1,0 +1,164 @@
+"""Router-quality benchmark: KV-aware routing vs round-robin under
+prefix-structured load.
+
+Reproduces the reference's headline routing measurement
+(benchmarks/router/prefix_ratio_benchmark.py; the 3x-TTFT /
+2x-request-latency claim of docs/architecture/architecture.md:86-91) on
+this stack: a fleet of mock workers (real KV events, prefix-cache-
+dependent prefill timing — mocker/engine.py) serves a workload of G
+prompt groups sharing ``prefix_ratio`` of their tokens; the SAME
+workload runs through the KV-aware router and through random spray (the
+reference compares against random), and
+the TTFT distributions + prefix-hit blocks are compared.
+
+Run: ``python -m benchmarks.router_bench [--workers 4 --groups 8 ...]``
+Prints one JSON line.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import time
+
+import numpy as np
+
+from dynamo_tpu.kv_router.protocols import RouterConfig
+from dynamo_tpu.kv_router.router import KvPushRouter, KvRouter
+from dynamo_tpu.mocker.__main__ import launch_mock_worker
+from dynamo_tpu.mocker.engine import MockEngineConfig
+from dynamo_tpu.runtime.context import Context
+from dynamo_tpu.runtime.distributed import DistributedRuntime
+from dynamo_tpu.runtime.hub import InMemoryHub
+from dynamo_tpu.runtime.push import PushRouter, RouterMode
+
+NS, COMP, EP = "bench", "mock", "generate"
+
+
+def build_workload(args, seed: int = 0) -> list[list[list[int]]]:
+    """``rounds`` waves, one request per group per wave. Each group
+    shares the leading ``prefix_ratio`` of its tokens; the tail is
+    per-request random. Wave structure (the reference benchmark's
+    multi-turn shape): after wave 0, a KV-routed fleet holds each
+    group's prefix warm on ITS worker, while spraying policies keep
+    missing whenever the per-worker cache cannot hold every group."""
+    rng = np.random.default_rng(seed)
+    n_prefix = int(args.isl * args.prefix_ratio)
+    prefixes = [
+        rng.integers(10, 30000, n_prefix).tolist()
+        for _g in range(args.groups)
+    ]
+    waves = []
+    for _r in range(args.rounds):
+        wave = []
+        for g in range(args.groups):
+            tail = rng.integers(10, 30000, args.isl - n_prefix).tolist()
+            wave.append(prefixes[g] + tail)
+        waves.append(wave)
+    return waves
+
+
+async def run_mode(drt, router_engine, waves, args) -> dict:
+    ttfts: list[float] = []  # steady-state only (waves >= 1)
+
+    async def one(tag: str, token_ids: list[int], record: bool):
+        req = {
+            "token_ids": token_ids,
+            "stop_conditions": {"max_tokens": args.osl, "ignore_eos": True},
+            "sampling": {"temperature": 0.0},
+        }
+        t0 = time.perf_counter()
+        async for _item in router_engine.generate(req, Context(tag)):
+            if record:
+                ttfts.append(time.perf_counter() - t0)
+            return
+
+    for r, wave in enumerate(waves):
+        # one concurrent request per group; wave 0 warms, the rest measure
+        await asyncio.gather(*(
+            one(f"rb-{r}-{g}", p, r >= 1) for g, p in enumerate(wave)
+        ))
+
+    def pct(xs, p):
+        xs = sorted(xs)
+        return round(xs[min(len(xs) - 1, int(p * len(xs)))] * 1e3, 2)
+
+    return {
+        "ttft_ms_p50": pct(ttfts, 0.5),
+        "ttft_ms_p90": pct(ttfts, 0.9),
+        "ttft_ms_p99": pct(ttfts, 0.99),
+        "ttft_ms_mean": round(float(np.mean(ttfts)) * 1e3, 2),
+    }
+
+
+async def bench(args) -> dict:
+    out: dict = {
+        "workers": args.workers, "groups": args.groups,
+        "requests": args.groups * args.rounds,
+        "rounds": args.rounds,
+        "isl": args.isl, "osl": args.osl,
+        "prefix_ratio": args.prefix_ratio,
+            }
+    for mode in ("kv", "random"):
+        drt = DistributedRuntime(InMemoryHub())
+        engines = []
+        for _w in range(args.workers):
+            eng, _served = await launch_mock_worker(
+                drt, NS, COMP, EP,
+                MockEngineConfig(
+                    block_size=args.block_size,
+                    speedup_ratio=args.speedup,
+                    total_kv_blocks=args.worker_blocks,
+                ),
+            )
+            engines.append(eng)
+        ep = drt.namespace(NS).component(COMP).endpoint(EP)
+        push = await PushRouter.from_endpoint(
+            ep,
+            RouterMode.DIRECT if mode == "kv" else RouterMode.RANDOM,
+        )
+        kv_router = None
+        router_engine = push
+        if mode == "kv":
+            kv_router = await KvRouter(
+                drt.hub, f"{NS}/{COMP}",
+                RouterConfig(block_size=args.block_size),
+            ).start()
+            router_engine = KvPushRouter(push, kv_router)
+        waves = build_workload(args)
+        out[mode] = await run_mode(drt, router_engine, waves, args)
+        if kv_router is not None:
+            await kv_router.close()
+        await push.client.close()
+        await drt.close()
+    out["ttft_speedup_p50"] = round(
+        out["random"]["ttft_ms_p50"] / max(out["kv"]["ttft_ms_p50"], 1e-9),
+        2,
+    )
+    out["ttft_speedup_mean"] = round(
+        out["random"]["ttft_ms_mean"]
+        / max(out["kv"]["ttft_ms_mean"], 1e-9),
+        2,
+    )
+    return out
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser("router prefix-ratio benchmark")
+    p.add_argument("--workers", type=int, default=4)
+    p.add_argument("--groups", type=int, default=8)
+    p.add_argument("--rounds", type=int, default=8)
+    p.add_argument("--isl", type=int, default=512)
+    p.add_argument("--osl", type=int, default=8)
+    p.add_argument("--prefix-ratio", type=float, default=0.8)
+    p.add_argument("--block-size", type=int, default=16)
+    p.add_argument("--worker-blocks", type=int, default=4096)
+    p.add_argument("--speedup", type=float, default=10.0)
+    args = p.parse_args(argv)
+    print(json.dumps(asyncio.run(bench(args))))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -91,3 +91,30 @@ async def test_profiler_emits_planner_grids(tmp_path):
         await frontend.stop()
         await watcher.close()
         await drt.close()
+
+
+def test_router_prefix_ratio_benchmark_shows_kv_win():
+    """The router-quality benchmark (ref benchmarks/router/
+    prefix_ratio_benchmark.py; the 3x-TTFT routing claim) must show
+    KV-aware routing beating random spray under prefix-structured load
+    with per-worker cache pressure."""
+    import asyncio
+
+    from benchmarks.router_bench import bench
+
+    class A:
+        workers = 4
+        groups = 12
+        rounds = 4
+        isl = 256
+        osl = 4
+        prefix_ratio = 0.8
+        block_size = 16
+        worker_blocks = 96  # holds ~1/3 of the groups: spray thrashes
+        speedup = 4.0
+
+    out = asyncio.run(bench(A()))
+    assert out["kv"]["ttft_ms_p50"] > 0
+    # the margin is intentionally conservative: CI boxes are noisy, and
+    # the claim under test is "KV routing wins", not its exact factor
+    assert out["ttft_speedup_p50"] > 1.25, out
