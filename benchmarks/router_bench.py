@@ -74,10 +74,11 @@ async def run_mode(drt, router_engine, waves, args) -> dict:
                 ttfts.append(time.perf_counter() - t0)
             return
 
+    measure_from = 1 if len(waves) > 1 else 0  # rounds=1: nothing to warm
     for r, wave in enumerate(waves):
         # one concurrent request per group; wave 0 warms, the rest measure
         await asyncio.gather(*(
-            one(f"rb-{r}-{g}", p, r >= 1) for g, p in enumerate(wave)
+            one(f"rb-{r}-{g}", p, r >= measure_from) for g, p in enumerate(wave)
         ))
 
     def pct(xs, p):

@@ -24,6 +24,10 @@ from typing import Any
 from aiohttp import web
 
 from dynamo_tpu.frontend.protocols import new_request_id
+from dynamo_tpu.frontend.validation import (
+    RequestValidationError,
+    validate_request,
+)
 from dynamo_tpu.frontend.watcher import ModelManager, ModelPipeline
 from dynamo_tpu.runtime import tracing
 from dynamo_tpu.runtime.compute import ComputePool
@@ -229,6 +233,11 @@ class HttpFrontend:
         except json.JSONDecodeError:
             self._m_requests.labels("?", route, "400").inc()
             return _error(400, "invalid JSON body")
+        try:
+            validate_request(body, "chat" if chat else "completions")
+        except RequestValidationError as e:
+            self._m_requests.labels(str(body.get("model")) if isinstance(body, dict) else "?", route, "400").inc()
+            return _error(400, str(e), param=e.param)
         pipe, err = self._pipeline_or_error(body)
         if err is not None:
             self._m_requests.labels(str(body.get("model")), route, str(err.status)).inc()
@@ -423,6 +432,10 @@ class HttpFrontend:
             body = await request.json()
         except json.JSONDecodeError:
             return _error(400, "invalid JSON body")
+        try:
+            validate_request(body, "responses")
+        except RequestValidationError as e:
+            return _error(400, str(e), param=e.param)
         pipe, err = self._pipeline_or_error(body)
         if err is not None:
             return err
@@ -583,6 +596,10 @@ class HttpFrontend:
             body = await request.json()
         except json.JSONDecodeError:
             return _error(400, "invalid JSON body")
+        try:
+            validate_request(body, "embeddings")
+        except RequestValidationError as e:
+            return _error(400, str(e), param=e.param)
         pipe, err = self._pipeline_or_error(body)
         if err is not None:
             return err
@@ -590,15 +607,10 @@ class HttpFrontend:
             return _error(
                 400, f"model {pipe.card.name!r} is not an embeddings model"
             )
+        # shape already validated at the edge (validate_request)
         inputs = body.get("input")
         if isinstance(inputs, str):
             inputs = [inputs]
-        if not isinstance(inputs, list) or not all(
-            isinstance(x, str) for x in inputs
-        ):
-            return _error(
-                400, "'input' must be a string or a list of strings"
-            )
         ctx = Context(request_id=new_request_id())
         data = []
         for i, text in enumerate(inputs):
@@ -658,9 +670,12 @@ class HttpFrontend:
         )
 
 
-def _error(status: int, message: str, code: str | None = None) -> web.Response:
+def _error(
+    status: int, message: str, code: str | None = None,
+    param: str | None = None,
+) -> web.Response:
     return web.json_response(
         {"error": {"message": message, "type": "invalid_request_error",
-                   "code": code}},
+                   "param": param, "code": code}},
         status=status,
     )

@@ -1,0 +1,184 @@
+"""Typed request validation for the OpenAI surface.
+
+Role of the reference's typed request layer (lib/async-openai/ forked
+types + the 4xx paths of http/service/openai.rs): malformed bodies fail
+at the EDGE with an OpenAI-style ``invalid_request_error`` naming the
+offending param — not as a 500 from deep inside template rendering or
+the engine. Kept as explicit checks over dicts rather than a schema
+library: the checks ARE the documentation of what the surface accepts,
+and the hot path stays allocation-light.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+__all__ = ["RequestValidationError", "validate_request"]
+
+_ROLES = {"system", "developer", "user", "assistant", "tool"}
+_CONTENT_PART_TYPES = {"text", "image_url"}
+
+
+class RequestValidationError(ValueError):
+    def __init__(self, message: str, param: str | None = None):
+        super().__init__(message)
+        self.param = param
+
+
+def _fail(message: str, param: str | None = None) -> None:
+    raise RequestValidationError(message, param)
+
+
+def _check_number(
+    body: dict, name: str, lo: float | None, hi: float | None,
+    *, integer: bool = False,
+) -> None:
+    v = body.get(name)
+    if v is None:
+        return
+    if isinstance(v, bool) or not isinstance(v, (int, float)):
+        _fail(f"'{name}' must be a number", name)
+    if integer and not isinstance(v, int):
+        _fail(f"'{name}' must be an integer", name)
+    if lo is not None and v < lo:
+        _fail(f"'{name}' must be >= {lo}", name)
+    if hi is not None and v > hi:
+        _fail(f"'{name}' must be <= {hi}", name)
+
+
+def _check_common(body: dict) -> None:
+    _check_number(body, "temperature", 0.0, 2.0)
+    _check_number(body, "top_p", 0.0, 1.0)
+    _check_number(body, "top_k", 0, None, integer=True)
+    _check_number(body, "max_tokens", 1, None, integer=True)
+    _check_number(body, "max_completion_tokens", 1, None, integer=True)
+    _check_number(body, "min_tokens", 0, None, integer=True)
+    _check_number(body, "seed", None, None, integer=True)
+    _check_number(body, "top_logprobs", 0, 20, integer=True)
+    if not isinstance(body.get("stream", False), bool):
+        _fail("'stream' must be a boolean", "stream")
+    stop = body.get("stop")
+    if stop is not None:
+        if isinstance(stop, str):
+            pass
+        elif isinstance(stop, list):
+            if len(stop) > 4:
+                _fail("'stop' accepts at most 4 sequences", "stop")
+            if not all(isinstance(s, str) for s in stop):
+                _fail("'stop' entries must be strings", "stop")
+        else:
+            _fail("'stop' must be a string or list of strings", "stop")
+
+
+def _check_messages(body: dict) -> None:
+    messages = body.get("messages")
+    if not isinstance(messages, list) or not messages:
+        _fail("'messages' must be a non-empty array", "messages")
+    for i, m in enumerate(messages):
+        where = f"messages[{i}]"
+        if not isinstance(m, dict):
+            _fail(f"'{where}' must be an object", where)
+        role = m.get("role")
+        if not isinstance(role, str) or role not in _ROLES:
+            _fail(
+                f"'{where}.role' must be one of {sorted(_ROLES)}",
+                f"{where}.role",
+            )
+        content = m.get("content")
+        if content is None:
+            if role != "assistant" or not m.get("tool_calls"):
+                _fail(f"'{where}.content' is required", f"{where}.content")
+            continue
+        if isinstance(content, str):
+            continue
+        if isinstance(content, list):
+            for j, part in enumerate(content):
+                pw = f"{where}.content[{j}]"
+                if not isinstance(part, dict):
+                    _fail(f"'{pw}' must be an object", pw)
+                ptype = part.get("type")
+                if ptype not in _CONTENT_PART_TYPES:
+                    _fail(
+                        f"'{pw}.type' must be one of "
+                        f"{sorted(_CONTENT_PART_TYPES)}",
+                        f"{pw}.type",
+                    )
+                if ptype == "text" and not isinstance(part.get("text"), str):
+                    _fail(f"'{pw}.text' must be a string", f"{pw}.text")
+                if ptype == "image_url":
+                    iu = part.get("image_url")
+                    url = iu.get("url") if isinstance(iu, dict) else iu
+                    if not isinstance(url, str) or not url:
+                        _fail(
+                            f"'{pw}.image_url.url' must be a non-empty "
+                            "string", f"{pw}.image_url",
+                        )
+            continue
+        _fail(
+            f"'{where}.content' must be a string or array of parts",
+            f"{where}.content",
+        )
+
+
+def _check_tools(body: dict) -> None:
+    tools = body.get("tools")
+    if tools is None:
+        return
+    if not isinstance(tools, list):
+        _fail("'tools' must be an array", "tools")
+    for i, t in enumerate(tools):
+        where = f"tools[{i}]"
+        if not isinstance(t, dict):
+            _fail(f"'{where}' must be an object", where)
+        if t.get("type") != "function":
+            _fail(f"'{where}.type' must be 'function'", f"{where}.type")
+        fn = t.get("function")
+        if not isinstance(fn, dict) or not isinstance(fn.get("name"), str):
+            _fail(
+                f"'{where}.function.name' is required",
+                f"{where}.function",
+            )
+
+
+def validate_request(body: Any, kind: str) -> None:
+    """Validate one request body for ``kind`` in {chat, completions,
+    embeddings, responses}. Raises RequestValidationError (a ValueError)
+    naming the offending param."""
+    if not isinstance(body, dict):
+        _fail("request body must be a JSON object")
+    if kind == "chat":
+        _check_messages(body)
+        _check_tools(body)
+        _check_common(body)
+        lp = body.get("logprobs")
+        if lp is not None and not isinstance(lp, bool):
+            _fail("'logprobs' must be a boolean for chat", "logprobs")
+    elif kind == "completions":
+        prompt = body.get("prompt")
+        if prompt is None:
+            _fail("'prompt' is required", "prompt")
+        if not isinstance(prompt, str):
+            if not isinstance(prompt, list) or not all(
+                isinstance(p, str) for p in prompt
+            ):
+                _fail(
+                    "'prompt' must be a string or list of strings", "prompt"
+                )
+        _check_common(body)
+        lp = body.get("logprobs")
+        if lp is not None and (isinstance(lp, bool) or not isinstance(lp, int)):
+            _fail("'logprobs' must be an integer for completions", "logprobs")
+    elif kind == "embeddings":
+        inp = body.get("input")
+        if inp is None:
+            _fail("'input' is required", "input")
+        if not isinstance(inp, str):
+            if not isinstance(inp, list) or not all(
+                isinstance(p, str) for p in inp
+            ):
+                _fail("'input' must be a string or list of strings", "input")
+    elif kind == "responses":
+        inp = body.get("input")
+        if inp is None:
+            _fail("'input' is required", "input")
+        _check_common(body)

@@ -56,6 +56,19 @@ class KvRouter:
         self.sequences = ActiveSequencesMultiWorker()
         self._tasks: list[asyncio.Task] = []
         self._started = False
+        # retention-boundary accounting: the snapshot records the last
+        # event seq it covers; replay verifies the retained tail reaches
+        # back to it. A nonzero replay_gap means events were dropped past
+        # the hub's retention cap while this router was down — the radix
+        # state is INCOMPLETE until workers republish/expire (surfaced
+        # loudly, never silently).
+        self._snapshot_seq = 0
+        self._last_seq = 0
+        # False only for legacy snapshots without a recorded seq: the
+        # baseline is unknown, so the gap check cannot distinguish
+        # "events purged under an old snapshot" from real loss
+        self._baseline_known = True
+        self.replay_gap = 0
 
     async def start(self) -> "KvRouter":
         if self._started:
@@ -78,11 +91,31 @@ class KvRouter:
     async def _consume_events(self) -> None:
         subject = KV_EVENT_SUBJECT.format(component=self.component_path)
         events_since_snapshot = 0
+        first = True
         try:
             # replay: catch up on events published before this router started
             async for _subj, payload, seq in self.hub.subscribe(
                 subject, replay=True, with_seq=True
             ):
+                if first:
+                    first = False
+                    # retention-boundary check: the tail must reach back
+                    # to the snapshot (or to seq 1 when starting fresh) —
+                    # anything older fell off the hub's retention cap
+                    expected = self._snapshot_seq + 1
+                    if self._baseline_known and seq > expected:
+                        self.replay_gap = seq - expected
+                        log.error(
+                            "kv event replay gap: %d events between "
+                            "snapshot seq %d and the oldest retained seq "
+                            "%d were dropped past the hub retention cap — "
+                            "radix state is incomplete until workers "
+                            "republish or entries expire",
+                            self.replay_gap, self._snapshot_seq, seq,
+                        )
+                if seq <= self._snapshot_seq:
+                    continue  # already folded into the restored snapshot
+                self._last_seq = seq
                 try:
                     ev = RouterEvent.from_dict(payload)
                     self.tree.apply_event(ev.worker_id, ev.event)
@@ -183,7 +216,11 @@ class KvRouter:
     # -- snapshots ---------------------------------------------------------
 
     async def save_snapshot(self) -> None:
-        data = json.dumps(self.tree.snapshot()).encode()
+        data = json.dumps({
+            "seq": self._last_seq,
+            "boot": await self.hub.get_boot_id(),
+            "tree": self.tree.snapshot(),
+        }).encode()
         await self.hub.put_object(
             RADIX_STATE_BUCKET, self.component_path.replace("/", "_"), data
         )
@@ -194,7 +231,29 @@ class KvRouter:
         )
         if not data:
             return False
-        self.tree = RadixTree.restore(json.loads(data))
+        obj = json.loads(data)
+        if isinstance(obj, dict) and "tree" in obj:
+            self._snapshot_seq = int(obj.get("seq") or 0)
+            boot_then = obj.get("boot")
+            boot_now = await self.hub.get_boot_id()
+            if boot_then and boot_now and boot_then != boot_now:
+                # hub restarted since the snapshot: per-subject seq
+                # counters reset, so the recorded baseline is from an
+                # incomparable seq space. Replay everything retained over
+                # the restored tree (stored events re-add; loud, not
+                # silent staleness).
+                log.warning(
+                    "hub rebooted since radix snapshot (boot %s -> %s): "
+                    "seq baseline reset, replaying all retained events",
+                    boot_then, boot_now,
+                )
+                self._snapshot_seq = 0
+            self._last_seq = self._snapshot_seq
+            obj = obj["tree"]
+        else:
+            # legacy snapshot without a seq baseline: gap check impossible
+            self._baseline_known = False
+        self.tree = RadixTree.restore(obj)
         return True
 
     async def close(self) -> None:

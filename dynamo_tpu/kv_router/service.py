@@ -61,7 +61,9 @@ class RouterService:
             f"{self.namespace}/{self.target_component}",
             self.config,
         ).start()
-        await kv.load_snapshot()
+        # NOTE: KvRouter.start() already restored the snapshot and is
+        # replaying the retained tail; a second load here would overwrite
+        # replayed state mid-flight
         self.kv_push = KvPushRouter(push, kv)
 
         comp = self.drt.namespace(self.namespace).component(self.router_component)

@@ -52,6 +52,14 @@ class _Lease:
 class Hub:
     """Abstract hub interface (see module docstring)."""
 
+    async def get_boot_id(self) -> str | None:
+        """Identity of this hub INSTANCE: per-subject seq counters live
+        in hub memory, so two boots have incomparable seq spaces. A
+        consumer persisting seq baselines (the KV router's radix
+        snapshot) must reset them when the boot id changes. None =
+        unknown (older peers)."""
+        return getattr(self, "boot_id", None)
+
     # -- kv ---------------------------------------------------------------
     async def put(self, key: str, value: Any, lease_id: int | None = None) -> None:
         raise NotImplementedError
@@ -137,6 +145,9 @@ class InMemoryHub(Hub):
     RETAIN_PER_SUBJECT = 65536
 
     def __init__(self) -> None:
+        import uuid
+
+        self.boot_id = uuid.uuid4().hex
         self._retained: dict[str, deque] = {}  # subject -> (seq, payload)
         self._subject_seq: dict[str, int] = {}  # publish counter per subject
         self._kv: dict[str, Any] = {}

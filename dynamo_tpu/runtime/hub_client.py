@@ -136,6 +136,12 @@ class RemoteHub(Hub):
     async def grant_lease(self, ttl_s: float) -> int:
         return await self._call("grant_lease", ttl=ttl_s)
 
+    async def get_boot_id(self) -> str | None:
+        try:
+            return await self._call("boot_id")
+        except Exception:  # noqa: BLE001 - older servers: unknown op
+            return None
+
     async def keepalive(self, lease_id: int) -> bool:
         return await self._call("keepalive", lease=lease_id)
 

@@ -147,6 +147,8 @@ class HubServer:
                     self._stream_watch(mid, msg["prefix"], msg.get("initial", True), send)
                 )
                 return  # stream frames only; no immediate ack
+            elif op == "boot_id":
+                result = await self.hub.get_boot_id()
             elif op == "subscribe":
                 streams[mid] = asyncio.ensure_future(
                     self._stream_subscribe(

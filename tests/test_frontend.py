@@ -229,10 +229,11 @@ async def test_http_chat_completion_aggregated_and_models():
             assert body["usage"]["completion_tokens"] == 5
             assert body["choices"][0]["finish_reason"] == "length"
 
-            # unknown model -> 404
+            # unknown model (well-formed body) -> 404
             async with sess.post(
                 f"{base}/v1/chat/completions",
-                json={"model": "nope", "messages": []},
+                json={"model": "nope",
+                      "messages": [{"role": "user", "content": "x"}]},
             ) as r:
                 assert r.status == 404
 

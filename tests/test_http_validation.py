@@ -1,0 +1,135 @@
+"""Edge validation of the OpenAI surface: malformed bodies must fail as
+400 invalid_request_error naming the offending param — never as a 500
+from deep in the pipeline. Ref: the typed request layer the reference
+carries in lib/async-openai/ + http/service/openai.rs error paths."""
+
+import aiohttp
+import pytest
+
+from dynamo_tpu.frontend.validation import (
+    RequestValidationError,
+    validate_request,
+)
+
+pytestmark = pytest.mark.integration
+
+
+# ------------------------------------------------------------- unit level
+
+
+@pytest.mark.parametrize("body,param", [
+    ({"messages": "hi"}, "messages"),
+    ({"messages": []}, "messages"),
+    ({"messages": ["hi"]}, "messages[0]"),
+    ({"messages": [{"content": "x"}]}, "messages[0].role"),
+    ({"messages": [{"role": "emperor", "content": "x"}]}, "messages[0].role"),
+    ({"messages": [{"role": "user"}]}, "messages[0].content"),
+    ({"messages": [{"role": "user", "content": 7}]}, "messages[0].content"),
+    ({"messages": [{"role": "user", "content": [{"type": "video"}]}]},
+     "messages[0].content[0].type"),
+    ({"messages": [{"role": "user", "content": [{"type": "image_url"}]}]},
+     "messages[0].content[0].image_url"),
+    ({"messages": [{"role": "user", "content": "x"}], "tools": {}}, "tools"),
+    ({"messages": [{"role": "user", "content": "x"}],
+      "tools": [{"type": "function", "function": {}}]}, "tools[0].function"),
+    ({"messages": [{"role": "user", "content": "x"}], "temperature": "hot"},
+     "temperature"),
+    ({"messages": [{"role": "user", "content": "x"}], "temperature": 9.0},
+     "temperature"),
+    ({"messages": [{"role": "user", "content": "x"}], "max_tokens": 0},
+     "max_tokens"),
+    ({"messages": [{"role": "user", "content": "x"}], "top_p": 1.5}, "top_p"),
+    ({"messages": [{"role": "user", "content": "x"}],
+      "stop": ["a", "b", "c", "d", "e"]}, "stop"),
+    ({"messages": [{"role": "user", "content": "x"}], "stream": "yes"},
+     "stream"),
+    ({"messages": [{"role": "user", "content": "x"}], "top_logprobs": 30},
+     "top_logprobs"),
+])
+def test_chat_validation_rejects(body, param):
+    with pytest.raises(RequestValidationError) as ei:
+        validate_request(body, "chat")
+    assert ei.value.param == param
+
+
+@pytest.mark.parametrize("body", [
+    {"messages": [{"role": "user", "content": "hello"}]},
+    {"messages": [{"role": "system", "content": "s"},
+                  {"role": "user",
+                   "content": [{"type": "text", "text": "hi"}]}],
+     "temperature": 0.7, "top_p": 0.9, "max_tokens": 5,
+     "stop": ["a"], "stream": True},
+    {"messages": [{"role": "assistant", "content": None,
+                   "tool_calls": [{"id": "1"}]},
+                  {"role": "user", "content": "x"}]},
+    {"messages": [{"role": "user", "content": "x"}],
+     "tools": [{"type": "function",
+                "function": {"name": "f", "parameters": {}}}]},
+])
+def test_chat_validation_accepts(body):
+    validate_request(body, "chat")
+
+
+@pytest.mark.parametrize("kind,body,param", [
+    ("completions", {}, "prompt"),
+    ("completions", {"prompt": 5}, "prompt"),
+    ("completions", {"prompt": ["a", 3]}, "prompt"),
+    ("completions", {"prompt": "x", "logprobs": True}, "logprobs"),
+    ("embeddings", {}, "input"),
+    ("embeddings", {"input": [1, 2]}, "input"),
+    ("responses", {}, "input"),
+])
+def test_other_kinds_reject(kind, body, param):
+    with pytest.raises(RequestValidationError) as ei:
+        validate_request(body, kind)
+    assert ei.value.param == param
+
+
+# ---------------------------------------------------------------- over HTTP
+
+
+async def test_malformed_bodies_are_4xx_at_the_edge():
+    """End to end over the live server: structurally broken requests get
+    OpenAI-style 400s with the param named, and never reach the engine."""
+    import sys
+
+    sys.path.insert(0, "tests")
+    from test_http_extras import _engine_stack
+
+    drt, engine, watcher, frontend = await _engine_stack()
+    base = f"http://127.0.0.1:{frontend.port}"
+    try:
+        async with aiohttp.ClientSession() as sess:
+            cases = [
+                ("/v1/chat/completions",
+                 {"model": "tiny-test", "messages": [{"role": "x"}]}),
+                ("/v1/chat/completions",
+                 {"model": "tiny-test",
+                  "messages": [{"role": "user", "content": [{"t": 1}]}]}),
+                ("/v1/chat/completions",
+                 {"model": "tiny-test",
+                  "messages": [{"role": "user", "content": "hi"}],
+                  "tools": "please"}),
+                ("/v1/completions", {"model": "tiny-test"}),
+                ("/v1/completions",
+                 {"model": "tiny-test", "prompt": "x", "temperature": [1]}),
+                ("/v1/embeddings", {"model": "tiny-test", "input": {}}),
+            ]
+            for route, body in cases:
+                async with sess.post(f"{base}{route}", json=body) as r:
+                    assert r.status == 400, (route, body, await r.text())
+                    err = (await r.json())["error"]
+                    assert err["type"] == "invalid_request_error"
+                    assert err["param"], (route, body, err)
+            # and a well-formed request still serves
+            async with sess.post(
+                f"{base}/v1/chat/completions",
+                json={"model": "tiny-test", "max_tokens": 3,
+                      "ignore_eos": True,
+                      "messages": [{"role": "user", "content": "ok"}]},
+            ) as r:
+                assert r.status == 200, await r.text()
+    finally:
+        await frontend.stop()
+        await watcher.close()
+        await drt.close()
