@@ -109,12 +109,15 @@ class InferenceEngine:
         self.kvbm = kvbm
         # multi-host: SpmdLeader broadcasting every serving-path dispatch
         # so follower processes replay the same SPMD programs
-        # (parallel/spmd.py). Pipelined decode chains tokens ON DEVICE
-        # between bursts, which followers could not replay from host
-        # descriptors — force it off.
+        # (parallel/spmd.py). Pipelined decode replays too (descriptors
+        # carry the chain masks; followers chain from their own pending
+        # results). Async admissions stay leader-local — their device-
+        # side first-token feed has no follower counterpart, so the sync
+        # admission path runs instead (first tokens reach followers via
+        # the next burst's host token array).
         self.spmd = spmd
         if spmd is not None and config is not None:
-            config.pipeline_decode = False
+            config.async_admissions = False
         self.offload = None
         if kvbm is not None:
             from dynamo_tpu.kvbm.offload import OffloadEngine
@@ -1795,37 +1798,46 @@ class InferenceEngine:
         ``chain`` is oldest-first; newer bursts override older rows, so a
         slot inactive in the newest burst (page-stalled for one burst)
         still feeds from its latest on-device token."""
-        if self.spmd is not None:
-            self.spmd.publish(
-                "decode",
-                {"n_steps": batch["n_burst"], "n_lp": batch["n_lp"]},
-                {
-                    "tokens": batch["tokens"],
-                    "block_tables": batch["block_tables"],
-                    "seq_lens": batch["seq_lens"],
-                    "active": batch["active"].astype(np.int8),
-                    "temps": batch["temps"],
-                    "topk": batch["topk"],
-                    "topp": batch["topp"],
-                    "seeds": batch["seeds"],
-                    "steps": batch["steps"],
-                },
-            )
-        tokens_in = jnp.asarray(batch["tokens"])
-        for prev in chain or ():
-            pb = prev["batch"]
-            prev_sampled = prev["results"][0]  # device [B, n_prev]
-            # guard rows by request identity, exactly like _build_batch's
-            # `extra` accumulation: a slot freed (EOS in an older burst)
-            # and reused by a NEW request must not have the dead
-            # request's stale in-flight token override its first token
-            valid = np.fromiter(
+        # chain-validity masks: guard rows by request identity, exactly
+        # like _build_batch's `extra` accumulation — a slot freed (EOS in
+        # an older burst) and reused by a NEW request must not have the
+        # dead request's stale in-flight token override its first token.
+        # Computed ONCE and shipped in the descriptor so followers chain
+        # with bit-identical masks.
+        chain_valids = [
+            np.fromiter(
                 (
-                    pb["active"][i] and self._slot_matches(i, pb)
+                    prev["batch"]["active"][i]
+                    and self._slot_matches(i, prev["batch"])
                     for i in range(len(self._slots))
                 ),
                 dtype=bool, count=len(self._slots),
             )
+            for prev in chain or ()
+        ]
+        if self.spmd is not None:
+            arrays = {
+                "tokens": batch["tokens"],
+                "block_tables": batch["block_tables"],
+                "seq_lens": batch["seq_lens"],
+                "active": batch["active"].astype(np.int8),
+                "temps": batch["temps"],
+                "topk": batch["topk"],
+                "topp": batch["topp"],
+                "seeds": batch["seeds"],
+                "steps": batch["steps"],
+            }
+            for i, v in enumerate(chain_valids):
+                arrays[f"chain_valid_{i}"] = v.astype(np.int8)
+            self.spmd.publish(
+                "decode",
+                {"n_steps": batch["n_burst"], "n_lp": batch["n_lp"],
+                 "n_chain": len(chain_valids)},
+                arrays,
+            )
+        tokens_in = jnp.asarray(batch["tokens"])
+        for valid, prev in zip(chain_valids, chain or ()):
+            prev_sampled = prev["results"][0]  # device [B, n_prev]
             tokens_in = jnp.where(
                 jnp.asarray(valid), prev_sampled[:, -1], tokens_in
             )

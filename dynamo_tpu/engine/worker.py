@@ -303,6 +303,8 @@ async def _amain(args: argparse.Namespace) -> None:
         num_pages=args.num_pages,
         max_pages_per_seq=args.max_pages_per_seq,
         max_decode_slots=args.max_decode_slots,
+        decode_steps_per_dispatch=args.decode_steps_per_dispatch,
+        pipeline_decode=args.decode_steps_per_dispatch > 1,
         tp=args.tp,
         sp=args.sp,
         ep=args.ep,
@@ -350,9 +352,10 @@ async def _amain(args: argparse.Namespace) -> None:
         from dynamo_tpu.parallel.spmd import SpmdLeader
 
         group = f"{args.namespace}/{args.component}/{args.endpoint}"
-        spmd_leader = SpmdLeader(
-            drt.hub, _aio.get_running_loop(), group
-        )
+        spmd_leader = await SpmdLeader(
+            drt.hub, _aio.get_running_loop(), group,
+            host=drt.config.host,
+        ).start()
     health = None
     status_server = None
     if args.health_port >= 0:
@@ -421,6 +424,9 @@ def main() -> None:
     p.add_argument("--num-pages", type=int, default=2048)
     p.add_argument("--max-pages-per-seq", type=int, default=64)
     p.add_argument("--max-decode-slots", type=int, default=8)
+    p.add_argument("--decode-steps-per-dispatch", type=int, default=1,
+                   help=">1 fuses N decode steps per dispatch and enables "
+                        "the pipelined (depth-2) burst schedule")
     p.add_argument("--tp", type=int, default=1)
     p.add_argument("--sp", type=int, default=1,
                    help="sequence-parallel ring-attention prefill width")

@@ -10,7 +10,7 @@ e.g. components/backends/trtllm/multinode/):
 - The LEADER runs the full serving engine (scheduler, paged-cache
   bookkeeping, sampling, streaming). Before every device dispatch on the
   serving path it broadcasts a step descriptor — op tag + the host-side
-  arrays the jit call consumes — on a hub subject.
+  arrays the jit call consumes.
 - Every FOLLOWER holds an identical engine shell (same spec, config,
   deterministic params, same mesh over the same global device set) and
   replays each descriptor with the SAME jitted entry points, so the
@@ -19,28 +19,38 @@ e.g. components/backends/trtllm/multinode/):
   shards); all logits/token results are discarded — the leader is the
   single identity routers and clients see.
 
-The hub stream is retained + seq-ordered (JetStream-style), so a
-follower that connects late replays the backlog in order. Descriptors
-are small (batch metadata, not activations): tokens, block tables,
-sampling params — a few KB per step.
+TRANSPORT: a dedicated leader->follower TCP stream with binary msgpack
+framing (runtime/framing.py) — array payloads travel as raw bytes, no
+base64, no hub round-trip on the dispatch path. The hub carries only the
+leader's descriptor address (``spmd/<group>/addr``); per-connection FIFO
+gives ordering, and a bounded ring buffer replays the backlog to
+followers that connect late (beyond the window, the follower fails
+loudly instead of silently desyncing).
 
-Trade-off: hub round-trips add per-dispatch latency vs. a raw ICI
-broadcast; correctness and testability (the whole flow runs as N local
-CPU processes) come first, and the descriptor plane is swappable.
+PIPELINED decode replays too: burst descriptors carry the chain-validity
+masks, and each follower chains fed tokens from ITS OWN pending burst
+results exactly as the leader does on its shards — multi-host decode
+keeps the deep-pipeline throughput. (Async admissions stay leader-local:
+their first tokens reach followers through the next burst's host token
+array.)
 """
 
 from __future__ import annotations
 
 import asyncio
-import base64
 import logging
+from collections import deque
 from typing import Any
 
 import numpy as np
 
+from dynamo_tpu.runtime.framing import read_frame, write_frame
+
 log = logging.getLogger("dynamo.spmd")
 
-SUBJECT_FMT = "spmd/{group}/steps"
+ADDR_KEY_FMT = "spmd/{group}/addr"
+SUBJECT_FMT = "spmd/{group}/steps"  # legacy hub subject (kept for addr ns)
+RING_FRAMES = 8192  # catch-up window (descriptors)
 
 
 def _enc(arr: np.ndarray) -> dict[str, Any]:
@@ -48,81 +58,154 @@ def _enc(arr: np.ndarray) -> dict[str, Any]:
     return {
         "dtype": arr.dtype.name,
         "shape": list(arr.shape),
-        "b64": base64.b64encode(arr.tobytes()).decode(),
+        "data": arr.tobytes(),  # raw bytes: msgpack bin, no base64
     }
 
 
 def _dec(d: dict[str, Any]) -> np.ndarray:
     return np.frombuffer(
-        base64.b64decode(d["b64"]), dtype=np.dtype(d["dtype"])
+        d["data"], dtype=np.dtype(d["dtype"])
     ).reshape(d["shape"])
 
 
 class SpmdLeader:
-    """Publishes step descriptors from the engine's step THREAD.
+    """Streams step descriptors to followers over direct TCP.
 
-    Publishes are fire-and-forget onto the hub client's event loop: the
-    hub assigns sequence numbers in publish order (FIFO per connection),
-    so followers see the exact dispatch order without the step thread
-    blocking on a network round-trip.
+    ``publish`` is called from the engine's step THREAD and never blocks:
+    it appends to the ring and hands the frame to each connection's
+    writer queue on the event loop. A follower that disconnects after
+    joining, or that asks for history beyond the ring, breaks lockstep
+    permanently — the plane latches broken (surfaced via engine.is_dead).
     """
 
-    def __init__(self, hub, loop: asyncio.AbstractEventLoop, group: str):
+    def __init__(self, hub, loop: asyncio.AbstractEventLoop, group: str,
+                 host: str = "127.0.0.1"):
         self.hub = hub
         self.loop = loop
-        self.subject = SUBJECT_FMT.format(group=group)
-        # broadcast-plane health: a STICKY latch. One lost descriptor
-        # leaves followers permanently out of lockstep (there is no
-        # re-sync protocol), so a later successful publish must NOT
-        # clear the flag — the broken plane has to stay VISIBLE
-        # (EngineMonitor surfaces `healthy`) rather than silently
-        # deadlocking the next collective.
+        self.group = group
+        self.host = host
         self.publish_failures = 0
         self.publish_count = 0  # monotonic; lets callers scope failures
         self._broken = False
+        self._ring: deque[tuple[int, dict]] = deque(maxlen=RING_FRAMES)
+        self._conns: list[asyncio.Queue] = []
+        self._server: asyncio.AbstractServer | None = None
+        self._joined = 0  # followers that completed catch-up handshake
+
+    async def start(self) -> "SpmdLeader":
+        self._server = await asyncio.start_server(
+            self._serve_conn, self.host, 0
+        )
+        port = self._server.sockets[0].getsockname()[1]
+        await self.hub.put(
+            ADDR_KEY_FMT.format(group=self.group), f"{self.host}:{port}"
+        )
+        log.info("spmd leader descriptor plane on %s:%d", self.host, port)
+        return self
 
     @property
     def healthy(self) -> bool:
         return not self._broken
 
     def mark_broken(self, reason: str) -> None:
-        """Latch the plane broken for a POST-publish failure: the local
-        dispatch raised after its descriptor already went out, so
-        followers replayed (or are blocked inside) a program the leader
-        abandoned — lockstep is gone even though the publish worked."""
+        """Latch the plane broken: a lost/failed descriptor (or a local
+        dispatch that failed after its descriptor went out) leaves
+        followers permanently out of lockstep — there is no re-sync
+        protocol, so it must be VISIBLE, not a silent deadlock."""
         if not self._broken:
             log.error("spmd plane broken: %s", reason)
         self._broken = True
 
-    def _on_publish_done(self, fut) -> None:
-        if fut.cancelled():
-            exc: BaseException | None = asyncio.CancelledError()
-        else:
-            exc = fut.exception()
-        if exc is not None:
-            self.publish_failures += 1
-            self._broken = True
-            log.error(
-                "spmd descriptor publish failed (%d total): %s — "
-                "followers are no longer in lockstep", self.publish_failures,
-                exc,
+    async def _serve_conn(self, reader, writer) -> None:
+        peer = writer.get_extra_info("peername")
+        hello = await read_frame(reader)
+        if hello is None:
+            writer.close()
+            return
+        from_seq = int(hello.get("from_seq", 0))
+        oldest = self._ring[0][0] if self._ring else self.publish_count + 1
+        if from_seq + 1 < oldest:
+            # history beyond the catch-up window: joining would silently
+            # desync — refuse loudly
+            await write_frame(writer, {
+                "op": "__reject__",
+                "scalars": {"reason": f"catch-up window exceeded "
+                            f"(need {from_seq + 1}, oldest {oldest})"},
+                "arrays": {},
+            })
+            writer.close()
+            self.mark_broken(
+                f"follower {peer} beyond catch-up window"
             )
+            return
+        # bounded: a wedged follower that stops draining must latch the
+        # plane broken (same loud-failure contract as the ring window),
+        # not grow leader memory without bound
+        q: asyncio.Queue = asyncio.Queue(maxsize=RING_FRAMES)
+        # backlog + live, no gap: single-threaded event loop between the
+        # ring snapshot and the queue registration
+        backlog = [f for s, f in self._ring if s > from_seq]
+        self._conns.append(q)
+        self._joined += 1
+        log.info("spmd follower %s joined (%d backlog frames)",
+                 peer, len(backlog))
+        try:
+            for f in backlog:
+                await write_frame(writer, f)
+            while True:
+                frame = await q.get()
+                await write_frame(writer, frame)
+        except asyncio.CancelledError:
+            raise  # orderly teardown, not a broken plane
+        except (ConnectionError, OSError) as e:
+            self.mark_broken(f"follower {peer} connection lost: {e}")
+        finally:
+            if q in self._conns:
+                self._conns.remove(q)
+            writer.close()
 
     def publish(self, op: str, scalars: dict[str, Any] | None = None,
                 arrays: dict[str, np.ndarray] | None = None) -> None:
         msg = {
             "op": op,
             "scalars": scalars or {},
-            "arrays": {k: _enc(np.asarray(v)) for k, v in (arrays or {}).items()},
+            "arrays": {
+                k: _enc(np.asarray(v)) for k, v in (arrays or {}).items()
+            },
         }
         self.publish_count += 1
-        fut = asyncio.run_coroutine_threadsafe(
-            self.hub.publish(self.subject, msg), self.loop
-        )
-        fut.add_done_callback(self._on_publish_done)
+        seq = self.publish_count
+
+        def _enqueue() -> None:
+            self._ring.append((seq, msg))
+            for q in list(self._conns):
+                try:
+                    q.put_nowait(msg)
+                except asyncio.QueueFull:
+                    self._conns.remove(q)
+                    self.mark_broken(
+                        "follower stopped draining descriptors "
+                        f"({q.qsize()} backlogged)"
+                    )
+
+        try:
+            self.loop.call_soon_threadsafe(_enqueue)
+        except RuntimeError as e:  # loop closed
+            self.publish_failures += 1
+            self.mark_broken(f"descriptor publish failed: {e}")
 
     def stop(self) -> None:
         self.publish("stop")
+
+    async def close(self) -> None:
+        if self._server is not None:
+            self._server.close()
+        try:
+            # drop the advertised address: a follower from a later run
+            # must not connect to this dead leader
+            await self.hub.delete(ADDR_KEY_FMT.format(group=self.group))
+        except Exception:  # noqa: BLE001 - hub may already be gone
+            pass
 
 
 class SpmdFollower:
@@ -136,32 +219,88 @@ class SpmdFollower:
 
     def __init__(self, hub, group: str, engine):
         self.hub = hub
-        self.subject = SUBJECT_FMT.format(group=group)
+        self.group = group
         self.engine = engine
+        # follower-side pipeline mirror: device results of the last
+        # decode bursts, for chain replay (oldest first). Sized from the
+        # engine's pipeline depth — a mirror shorter than the leader's
+        # chain would misalign every mask
+        depth = int(getattr(engine.config, "pipeline_depth", 2) or 2)
+        self._pending: deque = deque(maxlen=max(8, depth + 2))
+
+    async def _leader_addr(self, timeout: float = 60.0) -> str:
+        key = ADDR_KEY_FMT.format(group=self.group)
+        deadline = asyncio.get_running_loop().time() + timeout
+        while True:
+            addr = await self.hub.get(key)
+            if addr:
+                return addr
+            if asyncio.get_running_loop().time() > deadline:
+                raise TimeoutError(f"no spmd leader address at {key}")
+            await asyncio.sleep(0.2)
 
     async def run(self) -> None:
+        import jax.numpy as jnp
+
         eng = self.engine
         fam = eng.fam  # family adapter: replay works for GQA AND MLA
         spec, mesh = eng.spec, eng.mesh
-        log.info("spmd follower replaying %s", self.subject)
-        async for _subj, msg in self.hub.subscribe(self.subject, replay=True):
+        import os
+        import time as _time
+
+        trace = os.environ.get("DYNAMO_SPMD_TRACE") == "1"
+        # the hub key may briefly hold a PREVIOUS leader's address
+        # (leader restarting): retry connect, re-reading the key
+        deadline = asyncio.get_running_loop().time() + 60.0
+        while True:
+            addr = await self._leader_addr()
+            host, port = addr.rsplit(":", 1)
+            try:
+                reader, writer = await asyncio.open_connection(
+                    host, int(port)
+                )
+                break
+            except OSError as e:
+                if asyncio.get_running_loop().time() > deadline:
+                    raise ConnectionError(
+                        f"spmd leader at {addr} unreachable: {e}"
+                    ) from e
+                await asyncio.sleep(0.3)
+        await write_frame(writer, {"from_seq": 0})
+        log.info("spmd follower replaying from %s", addr)
+        t_prev = _time.perf_counter()
+        while True:
+            msg = await read_frame(reader)
+            t_recv = _time.perf_counter()
+            if msg is None:
+                raise ConnectionError(
+                    "spmd descriptor stream closed by leader"
+                )
             op = msg["op"]
+            if trace:
+                print(
+                    f"SPMDTRACE wait={_time.perf_counter() - t_prev:.4f} "
+                    f"op={op}", flush=True,
+                )
             sc = msg["scalars"]
             ar = {k: _dec(v) for k, v in msg["arrays"].items()}
             if op == "stop":
                 log.info("spmd follower: leader stopped")
+                writer.close()
                 return
+            if op == "__reject__":
+                raise RuntimeError(
+                    f"spmd leader rejected join: {sc.get('reason')}"
+                )
             # every branch matches one leader dispatch site in
             # engine/core.py; keep in lockstep with it. All model calls
             # go through the family adapter so the compiled programs are
             # the leader's exact entry points for this architecture.
             if op == "prefill":
-                import jax.numpy as _jnp
-
                 mm_kwargs = {}
                 if "mm_embeds" in ar:
                     mm_kwargs = {
-                        "mm_embeds": _jnp.asarray(
+                        "mm_embeds": jnp.asarray(
                             ar["mm_embeds"].astype(np.float32)
                         ),
                         "mm_pos": jnp_i32(ar["mm_pos"]),
@@ -209,11 +348,27 @@ class SpmdFollower:
                     ar["page_ids"].astype(np.int32),
                 )
             elif op == "decode":
-                import jax.numpy as jnp
-
+                tokens_in = jnp_i32(ar["tokens"])
+                n_chain = int(sc.get("n_chain", 0))
+                if n_chain:
+                    # chain replay: same masks the leader used, against
+                    # THIS process's pending burst results (its shards)
+                    prevs = list(self._pending)[-n_chain:]
+                    if len(prevs) < n_chain:
+                        raise RuntimeError(
+                            f"chain replay misaligned: leader chained "
+                            f"{n_chain} bursts, mirror holds {len(prevs)}"
+                        )
+                    for i, prev in enumerate(prevs):
+                        valid = jnp.asarray(
+                            ar[f"chain_valid_{i}"].astype(bool)
+                        )
+                        tokens_in = jnp.where(
+                            valid, prev[:, -1], tokens_in
+                        )
                 result = fam.decode_steps(
                     spec, eng.params,
-                    jnp_i32(ar["tokens"]), jnp_i32(ar["block_tables"]),
+                    tokens_in, jnp_i32(ar["block_tables"]),
                     jnp_i32(ar["seq_lens"]), eng.k_pages, eng.v_pages,
                     jnp.asarray(ar["active"].astype(bool)),
                     jnp.asarray(ar["temps"]), jnp_i32(ar["topk"]),
@@ -224,8 +379,15 @@ class SpmdFollower:
                     mesh=mesh,
                 )
                 eng.k_pages, eng.v_pages = result[-2], result[-1]
+                self._pending.append(result[0])  # sampled [B, n]
             else:  # pragma: no cover - protocol drift guard
                 raise RuntimeError(f"unknown spmd op {op!r}")
+            if trace:
+                print(
+                    f"SPMDTRACE apply={_time.perf_counter() - t_recv:.4f} "
+                    f"op={op}", flush=True,
+                )
+            t_prev = _time.perf_counter()
 
 
 def jnp_i32(a: np.ndarray):

@@ -139,8 +139,13 @@ class RemoteHub(Hub):
     async def get_boot_id(self) -> str | None:
         try:
             return await self._call("boot_id")
-        except Exception:  # noqa: BLE001 - older servers: unknown op
-            return None
+        except RuntimeError as e:
+            # ONLY the legacy-server case maps to "unknown": transient
+            # RPC failures must propagate, or a blip would silently store
+            # boot=None and disable hub-reboot detection downstream
+            if "unknown op" in str(e):
+                return None
+            raise
 
     async def keepalive(self, lease_id: int) -> bool:
         return await self._call("keepalive", lease=lease_id)
