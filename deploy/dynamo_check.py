@@ -1,0 +1,102 @@
+#!/usr/bin/env python
+"""Deployment diagnostics: one command that tells you what is broken.
+
+Role of the reference's deploy/dynamo_check.py: connect to the hub,
+enumerate instances and model cards, probe the frontend's health and
+metrics, and print a PASS/FAIL table. Exit code 0 iff every check
+passed.
+
+    python deploy/dynamo_check.py --hub HOST:PORT [--frontend HOST:PORT]
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import sys
+import urllib.request
+
+
+async def check_hub(addr: str, out: list) -> dict:
+    from dynamo_tpu.runtime.hub_client import RemoteHub
+
+    try:
+        hub = await RemoteHub.connect(addr)
+    except Exception as e:  # noqa: BLE001
+        out.append(("hub connect", False, str(e)))
+        return {}
+    out.append(("hub connect", True, addr))
+    try:
+        boot = await hub.get_boot_id()
+        out.append(("hub boot id", True, boot or "unknown (older hub)"))
+        instances = await hub.get_prefix("v1/instances/")
+        out.append((
+            "instances", bool(instances),
+            f"{len(instances)} registered" if instances
+            else "none registered",
+        ))
+        cards = await hub.get_prefix("v1/mdc/")
+        models = sorted({
+            (v or {}).get("name") for v in cards.values()
+            if isinstance(v, dict)
+        })
+        out.append((
+            "model cards", bool(cards),
+            ", ".join(str(m) for m in models) or "none",
+        ))
+        return {"instances": instances, "models": models}
+    except Exception as e:  # noqa: BLE001
+        out.append(("hub state", False, str(e)))
+        return {}
+    finally:
+        await hub.close()
+
+
+def check_frontend(addr: str, models: list, out: list) -> None:
+    base = f"http://{addr}"
+    for route, want in (("/health", None), ("/v1/models", None),
+                        ("/metrics", None)):
+        try:
+            with urllib.request.urlopen(base + route, timeout=5) as r:
+                body = r.read().decode()
+                ok = r.status == 200
+        except Exception as e:  # noqa: BLE001
+            out.append((f"frontend {route}", False, str(e)))
+            continue
+        detail = f"{len(body)} bytes"
+        if route == "/v1/models" and ok:
+            served = [m["id"] for m in json.loads(body).get("data", [])]
+            detail = ", ".join(served) or "no models served"
+            ok = bool(served)
+            for m in models or ():
+                if m not in served:
+                    ok = False
+                    detail += f" (card {m!r} not served!)"
+        out.append((f"frontend {route}", ok, detail))
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser("dynamo-tpu deployment check")
+    p.add_argument("--hub", required=True)
+    p.add_argument("--frontend", default=None, help="host:port of the "
+                   "OpenAI frontend (optional)")
+    args = p.parse_args(argv)
+
+    out: list[tuple[str, bool, str]] = []
+    state = asyncio.run(check_hub(args.hub, out))
+    if args.frontend:
+        check_frontend(args.frontend, state.get("models") or [], out)
+
+    width = max(len(n) for n, _o, _d in out)
+    failed = 0
+    for name, ok, detail in out:
+        mark = "PASS" if ok else "FAIL"
+        failed += not ok
+        print(f"{name:<{width}}  {mark}  {detail}")
+    print(f"\n{len(out) - failed}/{len(out)} checks passed")
+    return 1 if failed else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -28,6 +28,7 @@ SUBCOMMAND_MODULES = {
     "mocker": "dynamo_tpu.mocker.__main__",
     "router": "dynamo_tpu.kv_router.service",
     "encoder": "dynamo_tpu.multimodal.worker",
+    "operator": "dynamo_tpu.operator.__main__",
     "planner": "dynamo_tpu.planner.__main__",
     "bench": "benchmarks.loadgen",
     "profile": "benchmarks.profile_sla",

@@ -403,7 +403,14 @@ async def _amain(args: argparse.Namespace) -> None:
         spmd=spmd_leader,
     )
     print("ENGINE_READY", flush=True)
-    await drt.runtime.wait_for_shutdown()
+    try:
+        await drt.runtime.wait_for_shutdown()
+    finally:
+        if spmd_leader is not None:
+            # signal followers + withdraw the advertised address so a
+            # later follower run cannot connect to this dead leader
+            spmd_leader.stop()
+            await spmd_leader.close()
 
 
 def main() -> None:

@@ -1,0 +1,57 @@
+"""Operator process: ``python -m dynamo_tpu.operator --hub ... --name g``.
+
+Reconciles the named DynamoGraphDeployment (hub key ``v1/dgd/{name}``)
+with the chosen backend; ``--backend kubectl`` scales Kubernetes
+deployments instead of local processes. Prints OPERATOR_READY.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import logging
+
+from dynamo_tpu.operator.backends import make_backend
+from dynamo_tpu.operator.controller import Reconciler
+from dynamo_tpu.runtime.hub_client import connect_hub
+from dynamo_tpu.runtime.logging_util import setup_logging
+
+
+async def _amain(args: argparse.Namespace) -> None:
+    hub = await connect_hub(args.hub)
+    backend = (
+        make_backend("kubectl", namespace=args.k8s_namespace)
+        if args.backend == "kubectl"
+        else make_backend("process")
+    )
+    rec = await Reconciler(
+        hub, args.name, backend, interval_s=args.interval
+    ).start()
+    print("OPERATOR_READY", flush=True)
+    try:
+        await asyncio.Event().wait()
+    finally:
+        await rec.close()
+        await hub.close()
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser("dynamo-tpu operator")
+    p.add_argument("--hub", required=True)
+    p.add_argument("--name", default="default",
+                   help="DynamoGraphDeployment name to reconcile")
+    p.add_argument("--backend", default="process",
+                   choices=("process", "kubectl"))
+    p.add_argument("--k8s-namespace", default="default")
+    p.add_argument("--interval", type=float, default=1.0)
+    args = p.parse_args(argv)
+    setup_logging()
+    try:
+        asyncio.run(_amain(args))
+    except KeyboardInterrupt:
+        pass
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

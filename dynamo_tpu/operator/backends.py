@@ -1,0 +1,125 @@
+"""Reconciler backends: how desired replicas become running workers.
+
+ProcessBackend supervises OS processes on this host (the test/CI and
+single-host production path; the reference's operator manages pods the
+same level-triggered way). KubectlBackend shells out to ``kubectl
+scale`` for cluster deployments — the thin path until a full
+client-go-equivalent is warranted.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import subprocess
+import sys
+from typing import Any
+
+from dynamo_tpu.operator.graph import ServiceSpec
+
+log = logging.getLogger("dynamo.operator")
+
+
+class ProcessBackend:
+    """One subprocess per (service, index) replica."""
+
+    def __init__(self, extra_env: dict[str, str] | None = None):
+        import os
+
+        self.env = {**os.environ, **(extra_env or {})}
+        self._procs: dict[tuple[str, int], subprocess.Popen] = {}
+
+    def running(self, service: str) -> int:
+        n = 0
+        for (svc, _i), p in list(self._procs.items()):
+            if svc != service:
+                continue
+            if p.poll() is None:
+                n += 1
+            else:  # crashed replica: forget it so reconcile respawns
+                self._procs.pop((svc, _i))
+        return n
+
+    async def scale(self, spec: ServiceSpec, replicas: int) -> None:
+        # spawn missing indices
+        live = {
+            i for (svc, i), p in self._procs.items()
+            if svc == spec.name and p.poll() is None
+        }
+        for i in range(replicas):
+            if i in live:
+                continue
+            argv = [sys.executable, *spec.command]
+            p = subprocess.Popen(
+                argv, env=self.env,
+                stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+            )
+            self._procs[(spec.name, i)] = p
+            log.info("operator: spawned %s[%d] pid=%d", spec.name, i, p.pid)
+        # stop extras: SIGTERM for graceful deregistration (lease revoke);
+        # the hub reaper sweeps instance keys of anything that dies hard
+        for (svc, i) in sorted(self._procs):
+            if svc == spec.name and i >= replicas:
+                p = self._procs.pop((svc, i))
+                if p.poll() is None:
+                    p.terminate()
+                    log.info(
+                        "operator: stopping %s[%d] pid=%d", svc, i, p.pid
+                    )
+
+    async def close(self) -> None:
+        for p in self._procs.values():
+            if p.poll() is None:
+                p.terminate()
+        deadline = asyncio.get_running_loop().time() + 10
+        for p in self._procs.values():
+            while p.poll() is None:
+                if asyncio.get_running_loop().time() > deadline:
+                    p.kill()
+                    break
+                await asyncio.sleep(0.1)
+        self._procs.clear()
+
+
+class KubectlBackend:
+    """Scale Kubernetes deployments named ``dynamo-{service}``.
+
+    The cluster-side half of the reference's operator reconciliation
+    (controllers patching component Deployments); manifests under
+    deploy/k8s/ create the Deployments this scales."""
+
+    def __init__(self, namespace: str = "default",
+                 name_format: str = "dynamo-{service}"):
+        self.namespace = namespace
+        self.name_format = name_format
+
+    def running(self, service: str) -> int:
+        out = subprocess.run(
+            ["kubectl", "-n", self.namespace, "get", "deployment",
+             self.name_format.format(service=service),
+             "-o", "jsonpath={.status.readyReplicas}"],
+            capture_output=True, text=True,
+        )
+        try:
+            return int(out.stdout.strip() or 0)
+        except ValueError:
+            return 0
+
+    async def scale(self, spec: ServiceSpec, replicas: int) -> None:
+        subprocess.run(
+            ["kubectl", "-n", self.namespace, "scale", "deployment",
+             self.name_format.format(service=spec.name),
+             f"--replicas={replicas}"],
+            check=False,
+        )
+
+    async def close(self) -> None:  # deployments outlive the operator
+        return None
+
+
+def make_backend(kind: str, **kwargs: Any):
+    if kind == "process":
+        return ProcessBackend(**kwargs)
+    if kind == "kubectl":
+        return KubectlBackend(**kwargs)
+    raise ValueError(f"unknown operator backend {kind!r}")

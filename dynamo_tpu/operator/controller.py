@@ -1,0 +1,112 @@
+"""The reconciler: level-triggered convergence of graph deployments.
+
+Control loop shape of the reference's controllers
+(deploy/cloud/operator/internal/controller/): every interval (and on
+desired-state change) compare DESIRED — the DynamoGraphDeployment
+resource, with the SLA planner's desired-replica counts overriding the
+prefill/decode services (ref KubernetesConnector patching DGD replicas,
+planner/kubernetes_connector.py) — against OBSERVED (backend-reported
+running replicas) and converge via the backend. Scale-down sends
+SIGTERM so workers deregister their leases gracefully; anything that
+dies hard loses its lease at TTL and the hub reaper drops its instance
+keys (this stack's equivalent of the reference operator's etcd cleanup
+on scale-down — proven by the worker-kill fault-tolerance test).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+
+from dynamo_tpu.operator.graph import DGD_KEY, DynamoGraphDeployment
+from dynamo_tpu.planner.connector import read_desired_replicas
+
+log = logging.getLogger("dynamo.operator")
+
+
+class Reconciler:
+    def __init__(
+        self,
+        hub,
+        name: str,
+        backend,
+        *,
+        interval_s: float = 1.0,
+        apply_planner_desired: bool = True,
+    ):
+        self.hub = hub
+        self.name = name
+        self.backend = backend
+        self.interval_s = interval_s
+        self.apply_planner_desired = apply_planner_desired
+        self._task: asyncio.Task | None = None
+        self._wake = asyncio.Event()
+        self.reconciles = 0
+
+    async def start(self) -> "Reconciler":
+        loop = asyncio.get_running_loop()
+        self._task = loop.create_task(self._run())
+        loop.create_task(self._watch_desired())
+        return self
+
+    async def _watch_desired(self) -> None:
+        """Edge trigger on top of the level loop: react immediately when
+        the resource (or the planner's desired counts) changes."""
+        try:
+            async for _ev in self.hub.watch_prefix(
+                DGD_KEY.format(name=self.name)
+            ):
+                self._wake.set()
+        except (asyncio.CancelledError, ConnectionError):
+            pass
+
+    async def reconcile_once(self) -> DynamoGraphDeployment | None:
+        dgd = await DynamoGraphDeployment.get(self.hub, self.name)
+        if dgd is None:
+            return None
+        desired_override = None
+        if self.apply_planner_desired:
+            try:
+                desired_override = await read_desired_replicas(
+                    self.hub, dgd.namespace
+                )
+            except Exception:  # noqa: BLE001
+                log.warning("planner desired-replica read failed",
+                            exc_info=True)
+        for svc in dgd.services:
+            replicas = svc.replicas
+            if desired_override is not None and svc.role in (
+                "prefill", "decode"
+            ):
+                replicas = getattr(desired_override, svc.role)
+            have = self.backend.running(svc.name)
+            if have != replicas:
+                log.info(
+                    "reconcile %s/%s: %d -> %d replicas",
+                    self.name, svc.name, have, replicas,
+                )
+                await self.backend.scale(svc, replicas)
+        self.reconciles += 1
+        return dgd
+
+    async def _run(self) -> None:
+        try:
+            while True:
+                try:
+                    await self.reconcile_once()
+                except Exception:  # noqa: BLE001
+                    log.exception("reconcile failed; retrying")
+                try:
+                    await asyncio.wait_for(
+                        self._wake.wait(), timeout=self.interval_s
+                    )
+                    self._wake.clear()
+                except asyncio.TimeoutError:
+                    pass
+        except asyncio.CancelledError:
+            pass
+
+    async def close(self) -> None:
+        if self._task is not None:
+            self._task.cancel()
+        await self.backend.close()

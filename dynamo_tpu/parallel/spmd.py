@@ -49,8 +49,8 @@ from dynamo_tpu.runtime.framing import read_frame, write_frame
 log = logging.getLogger("dynamo.spmd")
 
 ADDR_KEY_FMT = "spmd/{group}/addr"
-SUBJECT_FMT = "spmd/{group}/steps"  # legacy hub subject (kept for addr ns)
-RING_FRAMES = 8192  # catch-up window (descriptors)
+RING_FRAMES = 8192  # catch-up window cap (descriptors)
+RING_BYTES = 64 * 1024 * 1024  # catch-up window cap (payload bytes)
 
 
 def _enc(arr: np.ndarray) -> dict[str, Any]:
@@ -87,10 +87,17 @@ class SpmdLeader:
         self.publish_failures = 0
         self.publish_count = 0  # monotonic; lets callers scope failures
         self._broken = False
-        self._ring: deque[tuple[int, dict]] = deque(maxlen=RING_FRAMES)
+        # catch-up ring: bounded by frames AND payload bytes (decode
+        # descriptors are tens of KB at production batch shapes; an
+        # unbounded byte footprint would pin hundreds of MB per worker)
+        self._ring: deque[tuple[int, dict, int]] = deque()
+        self._ring_bytes = 0
+        # highest seq visible ON THE EVENT LOOP (mutated only in
+        # _enqueue): the join handshake must not race the step thread's
+        # publish_count, which increments before the loop callback runs
+        self._loop_seq = 0
         self._conns: list[asyncio.Queue] = []
         self._server: asyncio.AbstractServer | None = None
-        self._joined = 0  # followers that completed catch-up handshake
 
     async def start(self) -> "SpmdLeader":
         self._server = await asyncio.start_server(
@@ -123,7 +130,7 @@ class SpmdLeader:
             writer.close()
             return
         from_seq = int(hello.get("from_seq", 0))
-        oldest = self._ring[0][0] if self._ring else self.publish_count + 1
+        oldest = self._ring[0][0] if self._ring else self._loop_seq + 1
         if from_seq + 1 < oldest:
             # history beyond the catch-up window: joining would silently
             # desync — refuse loudly
@@ -144,9 +151,8 @@ class SpmdLeader:
         q: asyncio.Queue = asyncio.Queue(maxsize=RING_FRAMES)
         # backlog + live, no gap: single-threaded event loop between the
         # ring snapshot and the queue registration
-        backlog = [f for s, f in self._ring if s > from_seq]
+        backlog = [f for s, f, _n in self._ring if s > from_seq]
         self._conns.append(q)
-        self._joined += 1
         log.info("spmd follower %s joined (%d backlog frames)",
                  peer, len(backlog))
         try:
@@ -176,8 +182,20 @@ class SpmdLeader:
         self.publish_count += 1
         seq = self.publish_count
 
+        nbytes = sum(
+            len(v["data"]) for v in msg["arrays"].values()
+        ) + 256
+
         def _enqueue() -> None:
-            self._ring.append((seq, msg))
+            self._loop_seq = seq
+            self._ring.append((seq, msg, nbytes))
+            self._ring_bytes += nbytes
+            while self._ring and (
+                len(self._ring) > RING_FRAMES
+                or self._ring_bytes > RING_BYTES
+            ):
+                _s, _m, n = self._ring.popleft()
+                self._ring_bytes -= n
             for q in list(self._conns):
                 try:
                     q.put_nowait(msg)
