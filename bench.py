@@ -229,6 +229,13 @@ def serving_measurement(spec, page_size: int, on_tpu: bool) -> dict:
 
         await asyncio.gather(*(warm_one(i) for i in range(max(rungs))))
         await warm_one(9999)  # straggler: the single-prompt program
+        # trickle: low-occupancy closed loop compiles the ramp-up burst
+        # program (decode_steps_admit_pending cap) the full wave never
+        # hits — without this, rung 1's window starts with a compile
+        for r in range(3):
+            await asyncio.gather(
+                *(warm_one(5000 + r * 10 + j) for j in range(4))
+            )
 
         out_rungs = [await one_rung(n) for n in rungs]
         await engine.close()

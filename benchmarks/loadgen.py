@@ -175,8 +175,106 @@ async def run_load(
     return LoadResult(concurrency=concurrency, results=results, wall_s=wall)
 
 
+def arrival_times(args) -> list[tuple[float, int, int]]:
+    """Open-loop schedule: [(t_offset_s, isl, osl)] per request.
+
+    Modes (ref benchmarks/ sin_load_generator + burstgpt/mooncake trace
+    replay):
+      poisson — exponential inter-arrivals at --rate req/s for
+                --duration seconds
+      sin     — Poisson with rate(t) = rate + sin-amp * sin(2*pi*t /
+                sin-period): the diurnal-swing shape SLA planners are
+                tuned against
+      trace   — JSONL replay: {"ts": seconds, "isl": n, "osl": n} per
+                line (timestamps relative to trace start)
+    """
+    import math
+    import random
+
+    rng = random.Random(args.seed)
+    out: list[tuple[float, int, int]] = []
+    if args.arrival == "trace":
+        with open(args.trace) as f:
+            rows = [json.loads(ln) for ln in f if ln.strip()]
+        base = min(float(r["ts"]) for r in rows) if rows else 0.0
+        for r in rows:
+            out.append((
+                float(r["ts"]) - base,
+                int(r.get("isl", args.isl)),
+                int(r.get("osl", args.osl)),
+            ))
+        return sorted(out)
+    t = 0.0
+    while t < args.duration:
+        rate = args.rate
+        if args.arrival == "sin":
+            rate = max(
+                0.05,
+                args.rate
+                + args.sin_amp * math.sin(2 * math.pi * t / args.sin_period),
+            )
+        t += rng.expovariate(rate)
+        if t < args.duration:
+            out.append((t, args.isl, args.osl))
+    return out
+
+
+async def run_open_loop(
+    url: str, model: str, schedule: list[tuple[float, int, int]],
+    *, shared_prefix: float = 0.0, warmup: int = 2, seed: int = 0,
+) -> LoadResult:
+    """Fire requests at scheduled offsets regardless of completions —
+    the open-loop counterpart of run_load (queueing shows up as TTFT)."""
+    import aiohttp
+
+    results: list[RequestResult] = []
+    async with aiohttp.ClientSession(
+        timeout=aiohttp.ClientTimeout(total=600)
+    ) as sess:
+        for i in range(warmup):
+            await run_one(
+                sess, url, model,
+                make_prompt(schedule[0][1] if schedule else 64,
+                            10**6 + i, 0.0, seed),
+                schedule[0][2] if schedule else 8,
+            )
+        t0 = time.perf_counter()
+
+        async def one(i: int, at: float, isl: int, osl: int):
+            delay = at - (time.perf_counter() - t0)
+            if delay > 0:
+                await asyncio.sleep(delay)
+            results.append(
+                await run_one(
+                    sess, url, model,
+                    make_prompt(isl, i, shared_prefix, seed), osl,
+                )
+            )
+
+        await asyncio.gather(
+            *(one(i, at, isl, osl)
+              for i, (at, isl, osl) in enumerate(schedule))
+        )
+        wall = time.perf_counter() - t0
+    return LoadResult(concurrency=0, results=results, wall_s=wall)
+
+
 async def amain(args) -> list[dict]:
     out = []
+    if args.arrival != "closed":
+        schedule = arrival_times(args)
+        res = await run_open_loop(
+            args.url, args.model, schedule,
+            shared_prefix=args.shared_prefix,
+            warmup=args.warmup, seed=args.seed,
+        )
+        s = res.summary()
+        s["arrival"] = args.arrival
+        s["offered_rps"] = round(
+            len(schedule) / max(args.duration, 1e-9), 2
+        ) if args.arrival != "trace" else None
+        print(json.dumps(s), flush=True)
+        return [s]
     for conc in args.concurrency:
         res = await run_load(
             args.url, args.model,
@@ -205,7 +303,21 @@ def main() -> None:
                    help="fraction of the prompt shared across requests")
     p.add_argument("--warmup", type=int, default=2)
     p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--arrival", default="closed",
+                   choices=("closed", "poisson", "sin", "trace"),
+                   help="closed = fixed concurrency ladder; the rest are "
+                        "open-loop arrival processes")
+    p.add_argument("--rate", type=float, default=4.0,
+                   help="open loop: mean arrivals/s")
+    p.add_argument("--duration", type=float, default=30.0,
+                   help="open loop: schedule length (s)")
+    p.add_argument("--sin-amp", type=float, default=2.0)
+    p.add_argument("--sin-period", type=float, default=20.0)
+    p.add_argument("--trace", default=None,
+                   help="arrival=trace: JSONL with ts/isl/osl per line")
     args = p.parse_args()
+    if args.arrival == "trace" and not args.trace:
+        p.error("--arrival trace requires --trace FILE.jsonl")
     args.concurrency = [int(c) for c in str(args.concurrency).split(",")]
     asyncio.run(amain(args))
 

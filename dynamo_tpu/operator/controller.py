@@ -40,13 +40,14 @@ class Reconciler:
         self.interval_s = interval_s
         self.apply_planner_desired = apply_planner_desired
         self._task: asyncio.Task | None = None
+        self._watch_task: asyncio.Task | None = None
         self._wake = asyncio.Event()
         self.reconciles = 0
 
     async def start(self) -> "Reconciler":
         loop = asyncio.get_running_loop()
         self._task = loop.create_task(self._run())
-        loop.create_task(self._watch_desired())
+        self._watch_task = loop.create_task(self._watch_desired())
         return self
 
     async def _watch_desired(self) -> None:
@@ -107,6 +108,11 @@ class Reconciler:
             pass
 
     async def close(self) -> None:
-        if self._task is not None:
-            self._task.cancel()
+        for t in (self._task, self._watch_task):
+            if t is not None:
+                t.cancel()
+                try:
+                    await t
+                except asyncio.CancelledError:
+                    pass
         await self.backend.close()

@@ -21,7 +21,8 @@ DGD_KEY = "v1/dgd/{name}"
 class ServiceSpec:
     name: str
     replicas: int
-    command: list[str]  # argv, appended with per-replica args by backend
+    command: list[str]  # argv; must be self-disambiguating across
+    # replicas (no fixed ports etc. — replicas launch identically)
     component: str = "backend"  # runtime component it registers under
     # planner wiring: "prefill"/"decode" services accept replica
     # overrides from the planner's desired-replicas key

@@ -145,10 +145,12 @@ class SpmdLeader:
                 f"follower {peer} beyond catch-up window"
             )
             return
-        # bounded: a wedged follower that stops draining must latch the
-        # plane broken (same loud-failure contract as the ring window),
-        # not grow leader memory without bound
-        q: asyncio.Queue = asyncio.Queue(maxsize=RING_FRAMES)
+        # bounded SMALL: a follower hundreds of frames behind is already
+        # out of lockstep for serving purposes; a tight queue latches it
+        # broken (loud-failure contract) AND caps the payload bytes each
+        # slow follower can pin (the ring's byte cap would otherwise be
+        # defeated by queue references to evicted frames)
+        q: asyncio.Queue = asyncio.Queue(maxsize=512)
         # backlog + live, no gap: single-threaded event loop between the
         # ring snapshot and the queue registration
         backlog = [f for s, f, _n in self._ring if s > from_seq]

@@ -118,3 +118,54 @@ def test_router_prefix_ratio_benchmark_shows_kv_win():
     # the margin is intentionally conservative: CI boxes are noisy, and
     # the claim under test is "KV routing wins", not its exact factor
     assert out["ttft_speedup_p50"] > 1.25, out
+
+
+async def test_loadgen_open_loop_arrivals(tmp_path):
+    """Open-loop modes (ref sin_load_generator / trace replay): Poisson,
+    sinusoidal, and trace schedules all drive the live stack and report
+    the same metric surface."""
+    import json as _json
+
+    from benchmarks.loadgen import arrival_times, run_open_loop
+
+    class A:
+        arrival = "sin"
+        rate = 20.0
+        duration = 1.5
+        sin_amp = 10.0
+        sin_period = 1.0
+        isl = 48
+        osl = 4
+        seed = 0
+        trace = None
+
+    sched = arrival_times(A())
+    assert sched and all(0 <= t < A.duration for t, _i, _o in sched)
+
+    # trace mode parses and normalizes timestamps
+    trace_file = tmp_path / "trace.jsonl"
+    trace_file.write_text(
+        "".join(
+            _json.dumps({"ts": 100.0 + 0.1 * i, "isl": 32, "osl": 3}) + "\n"
+            for i in range(6)
+        )
+    )
+    A2 = A()
+    A2.arrival, A2.trace = "trace", str(trace_file)
+    tsched = arrival_times(A2)
+    assert len(tsched) == 6 and tsched[0][0] == 0.0
+
+    drt, watcher, frontend = await _stack()
+    try:
+        res = await run_open_loop(
+            f"http://127.0.0.1:{frontend.port}", "bench-model",
+            tsched, warmup=1,
+        )
+        s = res.summary()
+        assert s["errors"] == 0, s
+        assert s["requests"] == 6
+        assert s["ttft_ms"]["p50"] is not None
+    finally:
+        await frontend.stop()
+        await watcher.close()
+        await drt.close()
