@@ -189,7 +189,6 @@ def arrival_times(args) -> list[tuple[float, int, int]]:
                 line (timestamps relative to trace start)
     """
     import math
-    import random
 
     rng = random.Random(args.seed)
     out: list[tuple[float, int, int]] = []
@@ -229,7 +228,10 @@ async def run_open_loop(
 
     results: list[RequestResult] = []
     async with aiohttp.ClientSession(
-        timeout=aiohttp.ClientTimeout(total=600)
+        timeout=aiohttp.ClientTimeout(total=600),
+        # no connection cap: the default 100-connection limit would
+        # silently turn the open loop into a closed loop at 100 in-flight
+        connector=aiohttp.TCPConnector(limit=0),
     ) as sess:
         for i in range(warmup):
             await run_one(

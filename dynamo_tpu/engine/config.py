@@ -30,6 +30,14 @@ class ModelSpec:
     moe_intermediate_size: int = 0
     n_shared_experts: int = 0  # always-on dense experts (DeepSeek)
     first_k_dense: int = 0  # leading layers with plain dense MLP
+    # routing flavor: "softmax" (mixtral/qwen/gpt-oss) or "sigmoid"
+    # (DeepSeek-V3 noaux_tc: sigmoid scores + learned correction bias +
+    # group-limited top-k + routed scaling)
+    moe_scoring: str = "softmax"
+    n_group: int = 0  # expert groups for group-limited routing (0 = off)
+    topk_group: int = 0  # groups each token may route into
+    routed_scaling_factor: float = 1.0
+    norm_topk_prob: bool = True
     # MLA (DeepSeek-family latent attention; 0 = plain GQA attention)
     kv_lora_rank: int = 0  # latent dim d_c (the per-token KV cache row)
     qk_nope_head_dim: int = 0
@@ -180,6 +188,8 @@ class ModelSpec:
             rope_mscale=1.0, rope_mscale_all_dim=1.0,
             rope_interleave=True,
             num_experts=256, num_experts_per_token=8,
+            moe_scoring="sigmoid", n_group=8, topk_group=4,
+            routed_scaling_factor=2.5,
             moe_intermediate_size=2048, n_shared_experts=1,
             first_k_dense=3,
             kv_lora_rank=512, qk_nope_head_dim=128, qk_rope_head_dim=64,
@@ -195,6 +205,8 @@ class ModelSpec:
             num_kv_heads=4, head_dim=16, dtype="float32",
             tie_embeddings=False,
             num_experts=4, num_experts_per_token=2,
+            moe_scoring="sigmoid", n_group=2, topk_group=1,
+            routed_scaling_factor=2.5,
             moe_intermediate_size=32, n_shared_experts=1, first_k_dense=1,
             kv_lora_rank=16, qk_nope_head_dim=16, qk_rope_head_dim=8,
             v_head_dim=16, q_lora_rank=24,

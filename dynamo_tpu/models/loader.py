@@ -103,6 +103,19 @@ def spec_from_hf_config(cfg: dict, name: str | None = None) -> ModelSpec:
         # DeepSeek MLA checkpoints store rope dims pair-interleaved
         # (HF DeepseekV3Config.rope_interleave defaults True)
         extras["rope_interleave"] = bool(cfg.get("rope_interleave", True))
+        if n_experts:
+            # V3 noaux_tc routing (HF DeepseekV3TopkRouter defaults)
+            # fallbacks = the HF DeepseekV3Config class defaults, so a
+            # minimal config.json routes exactly as transformers would
+            extras.update(
+                moe_scoring=str(cfg.get("scoring_func") or "sigmoid"),
+                n_group=int(cfg.get("n_group") or 8),
+                topk_group=int(cfg.get("topk_group") or 4),
+                routed_scaling_factor=float(
+                    cfg.get("routed_scaling_factor") or 2.5
+                ),
+                norm_topk_prob=bool(cfg.get("norm_topk_prob", True)),
+            )
     # YaRN rope scaling (gpt-oss, DeepSeek-R1)
     rs = cfg.get("rope_scaling") or {}
     if (rs.get("rope_type") or rs.get("type")) == "yarn":
@@ -226,6 +239,10 @@ def _dest_map_mla(
             m[p + "self_attn.q_proj.weight"] = (li + ("wq",), True, None)
         if spec.num_experts and i >= spec.first_k_dense:
             m[p + "mlp.gate.weight"] = (li + ("moe", "router"), True, "float32")
+            if spec.moe_scoring == "sigmoid":
+                m[p + "mlp.gate.e_score_correction_bias"] = (
+                    li + ("moe", "score_bias"), False, "float32"
+                )
             for e in range(spec.num_experts):
                 ep = p + f"mlp.experts.{e}."
                 m[ep + "gate_proj.weight"] = (li + ("moe", "w_gate", e), True, None)

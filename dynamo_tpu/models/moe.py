@@ -43,6 +43,10 @@ def init_moe_layer(spec: ModelSpec, key: jax.Array) -> Params:
         out["b_gate"] = jnp.zeros((e, f), dtype)
         out["b_up"] = jnp.zeros((e, f), dtype)
         out["b_down"] = jnp.zeros((e, d), dtype)
+    if spec.moe_scoring == "sigmoid":
+        # DeepSeek-V3 aux-free load balancing: learned per-expert
+        # correction bias shifts SELECTION only, never the weights
+        out["score_bias"] = jnp.zeros((e,), jnp.float32)
     return out
 
 
@@ -65,6 +69,8 @@ def moe_layer_shardings(mesh: Mesh, spec: ModelSpec | None = None) -> Params:
             b_up=ns("ep", "tp"),
             b_down=ns("ep", None),
         )
+    if spec is not None and spec.moe_scoring == "sigmoid":
+        out["score_bias"] = ns()
     return out
 
 
@@ -110,11 +116,35 @@ def moe_mlp(
     router_logits = x.astype(jnp.float32) @ lp["router"]
     if "router_bias" in lp:
         router_logits = router_logits + lp["router_bias"]
-    # softmax-all + top-k renormalize == softmax over the top-k logits
-    # (HF gpt-oss GptOssTopKRouter): same selection, same weights
-    probs = jax.nn.softmax(router_logits, axis=-1)  # [T, E]
-    topv, topi = jax.lax.top_k(probs, k)  # [T, k]
-    topv = topv / jnp.maximum(topv.sum(axis=-1, keepdims=True), 1e-9)
+    if spec.moe_scoring == "sigmoid":
+        # DeepSeek-V3 noaux_tc routing (HF DeepseekV3TopkRouter): sigmoid
+        # scores; the learned correction bias + group-limited top-k pick
+        # the experts, but the combine WEIGHTS come from the unbiased
+        # scores, renormalized and scaled by routed_scaling_factor
+        scores = jax.nn.sigmoid(router_logits)  # [T, E]
+        choice = scores + lp["score_bias"]
+        if spec.n_group > 1:
+            gsz = E // spec.n_group
+            grouped = choice.reshape(T, spec.n_group, gsz)
+            group_scores = jax.lax.top_k(grouped, 2)[0].sum(-1)  # [T, G]
+            _gv, gidx = jax.lax.top_k(group_scores, spec.topk_group)
+            gmask = jax.nn.one_hot(
+                gidx, spec.n_group, dtype=jnp.float32
+            ).sum(axis=1)  # [T, G]
+            choice = jnp.where(
+                jnp.repeat(gmask, gsz, axis=-1) > 0, choice, 0.0
+            )
+        _cv, topi = jax.lax.top_k(choice, k)  # [T, k]
+        topv = jnp.take_along_axis(scores, topi, axis=1)
+        if spec.norm_topk_prob:
+            topv = topv / (topv.sum(axis=-1, keepdims=True) + 1e-20)
+        topv = topv * spec.routed_scaling_factor
+    else:
+        # softmax-all + top-k renormalize == softmax over the top-k
+        # logits (HF gpt-oss GptOssTopKRouter): same selection/weights
+        probs = jax.nn.softmax(router_logits, axis=-1)  # [T, E]
+        topv, topi = jax.lax.top_k(probs, k)  # [T, k]
+        topv = topv / jnp.maximum(topv.sum(axis=-1, keepdims=True), 1e-9)
 
     # position of each (token, choice) within its expert's capacity:
     # running count of prior assignments to the same expert, in flattened

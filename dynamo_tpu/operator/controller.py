@@ -115,4 +115,6 @@ class Reconciler:
                     await t
                 except asyncio.CancelledError:
                     pass
+                except Exception:  # noqa: BLE001 - already-dead task
+                    log.exception("reconciler task died before close")
         await self.backend.close()

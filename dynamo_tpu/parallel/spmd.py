@@ -49,7 +49,7 @@ from dynamo_tpu.runtime.framing import read_frame, write_frame
 log = logging.getLogger("dynamo.spmd")
 
 ADDR_KEY_FMT = "spmd/{group}/addr"
-RING_FRAMES = 8192  # catch-up window cap (descriptors)
+RING_FRAMES = 1024  # catch-up window cap (descriptors)
 RING_BYTES = 64 * 1024 * 1024  # catch-up window cap (payload bytes)
 
 
@@ -145,12 +145,12 @@ class SpmdLeader:
                 f"follower {peer} beyond catch-up window"
             )
             return
-        # bounded SMALL: a follower hundreds of frames behind is already
-        # out of lockstep for serving purposes; a tight queue latches it
-        # broken (loud-failure contract) AND caps the payload bytes each
-        # slow follower can pin (the ring's byte cap would otherwise be
-        # defeated by queue references to evicted frames)
-        q: asyncio.Queue = asyncio.Queue(maxsize=512)
+        # bounded to the SAME window as the catch-up ring: a join within
+        # the advertised window must never be broken by publishes landing
+        # during its backlog drain, while a follower that stops draining
+        # latches loudly once it falls a full window behind (and the
+        # bound caps the payload bytes a slow follower can pin)
+        q: asyncio.Queue = asyncio.Queue(maxsize=RING_FRAMES)
         # backlog + live, no gap: single-threaded event loop between the
         # ring snapshot and the queue registration
         backlog = [f for s, f, _n in self._ring if s > from_seq]
