@@ -14,6 +14,7 @@ seed, ignore_eos, min_tokens); responses carry ``text_output`` (BYTES).
 
 from __future__ import annotations
 
+import json
 import logging
 from typing import Any, AsyncIterator
 
@@ -35,7 +36,7 @@ def _param_value(p: pb.InferParameter):
 
 def _text_output_response(
     model: str, request_id: str, text: str, *, final: bool = False,
-    tokens: int = 0,
+    tokens: int = 0, token_ids: list[int] | None = None,
 ) -> pb.ModelInferResponse:
     resp = pb.ModelInferResponse(
         model_name=model,
@@ -51,10 +52,46 @@ def _text_output_response(
             )
         ],
     )
+    if token_ids is not None:
+        # tokens-out tensor alongside the text (ref tensor.rs token I/O)
+        resp.outputs.append(
+            pb.ModelInferResponse.InferOutputTensor(
+                name="output_ids",
+                datatype="INT32",
+                shape=[len(token_ids)],
+                contents=pb.InferTensorContents(
+                    int_contents=list(token_ids)
+                ),
+            )
+        )
     if final:
         resp.parameters["triton_final_response"].bool_param = True
     if tokens:
         resp.parameters["output_tokens"].int64_param = tokens
+    return resp
+
+
+def _openai_response(
+    model: str, request_id: str, payload: dict, *, final: bool = False
+) -> pb.ModelInferResponse:
+    """OpenAI-over-gRPC: one JSON body in an ``openai_response`` BYTES
+    tensor (ref lib/llm/src/grpc/service/tensor.rs OpenAI passthrough)."""
+    resp = pb.ModelInferResponse(
+        model_name=model,
+        id=request_id,
+        outputs=[
+            pb.ModelInferResponse.InferOutputTensor(
+                name="openai_response",
+                datatype="BYTES",
+                shape=[1],
+                contents=pb.InferTensorContents(
+                    bytes_contents=[json.dumps(payload).encode("utf-8")]
+                ),
+            )
+        ],
+    )
+    if final:
+        resp.parameters["triton_final_response"].bool_param = True
     return resp
 
 
@@ -152,18 +189,35 @@ class KserveGrpcFrontend:
             platform="dynamo-tpu",
             inputs=[
                 t(name="text_input", datatype="BYTES", shape=[1]),
+                t(name="input_ids", datatype="INT32", shape=[-1]),
+                t(name="openai_request", datatype="BYTES", shape=[1]),
                 t(name="streaming", datatype="BOOL", shape=[1]),
             ],
-            outputs=[t(name="text_output", datatype="BYTES", shape=[1])],
+            outputs=[
+                t(name="text_output", datatype="BYTES", shape=[1]),
+                t(name="output_ids", datatype="INT32", shape=[-1]),
+                t(name="openai_response", datatype="BYTES", shape=[1]),
+            ],
         )
 
     # -- inference ---------------------------------------------------------
 
     def _parse_request(self, req: pb.ModelInferRequest):
+        """-> (pipe, body, streaming, mode) with mode in
+        {"text", "tokens", "openai"}:
+
+        text   — ``text_input`` BYTES prompt (+ sampling in parameters)
+        tokens — ``input_ids`` INT32/INT64: tokens-in/tokens-out, the
+                 worker wire protocol over KServe (ref tensor.rs)
+        openai — ``openai_request`` BYTES holding a chat/completions
+                 JSON body; responses carry ``openai_response`` chunks
+        """
         pipe = self.manager.get(req.model_name)
         if pipe is None:
             raise KeyError(f"model {req.model_name!r} not found")
         text = None
+        token_ids: list[int] | None = None
+        openai_body: dict | None = None
         streaming = None  # None = caller's RPC decides the default
         for i, tensor in enumerate(req.inputs):
             if tensor.name == "text_input":
@@ -173,14 +227,51 @@ class KserveGrpcFrontend:
                     raw = req.raw_input_contents[i]
                     # raw BYTES tensors are length-prefixed (u32 LE)
                     text = raw[4:].decode("utf-8") if len(raw) >= 4 else ""
+            elif tensor.name == "input_ids":
+                token_ids = list(
+                    tensor.contents.int_contents
+                    or tensor.contents.int64_contents
+                )
+            elif tensor.name == "openai_request":
+                if not tensor.contents.bytes_contents:
+                    raise ValueError("empty 'openai_request' tensor")
+                try:
+                    openai_body = json.loads(
+                        tensor.contents.bytes_contents[0]
+                    )
+                except json.JSONDecodeError as e:
+                    raise ValueError(f"malformed openai_request: {e}") from e
+                if not isinstance(openai_body, dict):
+                    raise ValueError(
+                        "openai_request must be a JSON object"
+                    )
             elif tensor.name == "streaming":
                 if tensor.contents.bool_contents:
                     streaming = bool(tensor.contents.bool_contents[0])
-        if text is None:
-            raise ValueError("missing 'text_input' input tensor")
 
-        body: dict[str, Any] = {"model": req.model_name, "prompt": text}
         params = {k: _param_value(v) for k, v in req.parameters.items()}
+        if openai_body is not None:
+            from dynamo_tpu.frontend.validation import validate_request
+
+            openai_body["model"] = req.model_name
+            kind = "chat" if "messages" in openai_body else "completions"
+            validate_request(openai_body, kind)
+            if openai_body.get("stream"):
+                streaming = True
+            return pipe, openai_body, streaming, "openai"
+        if token_ids is not None:
+            body: dict[str, Any] = {"token_ids": token_ids}
+            return pipe, self._apply_params(body, params), streaming, "tokens"
+        if text is None:
+            raise ValueError(
+                "missing input tensor: one of text_input / input_ids / "
+                "openai_request"
+            )
+        body = {"model": req.model_name, "prompt": text}
+        return pipe, self._apply_params(body, params), streaming, "text"
+
+    @staticmethod
+    def _apply_params(body: dict[str, Any], params: dict) -> dict[str, Any]:
         for key in ("max_tokens", "min_tokens", "top_k", "seed"):
             if params.get(key) is not None:
                 body[key] = int(params[key])
@@ -189,18 +280,48 @@ class KserveGrpcFrontend:
                 body[key] = float(params[key])
         if params.get("ignore_eos") is not None:
             body["ignore_eos"] = bool(params["ignore_eos"])
-        return pipe, body, streaming
+        return body
+
+    def _preprocess(self, pipe, body: dict[str, Any], mode: str) -> dict:
+        if mode == "tokens":
+            from dynamo_tpu.frontend.protocols import (
+                make_preprocessed_request,
+            )
+
+            token_ids = list(body["token_ids"])
+            ctx_len = pipe.preprocessor.context_length
+            if len(token_ids) >= ctx_len:
+                raise ValueError(
+                    f"input_ids ({len(token_ids)} tokens) exceeds context "
+                    f"length {ctx_len}"
+                )
+            max_tokens = min(
+                int(body.get("max_tokens") or 256),
+                ctx_len - len(token_ids),
+            )
+            return make_preprocessed_request(
+                token_ids,
+                max_tokens=max_tokens,
+                temperature=body.get("temperature"),
+                top_p=body.get("top_p"),
+                top_k=body.get("top_k"),
+                seed=body.get("seed"),
+                ignore_eos=bool(body.get("ignore_eos", False)),
+                min_tokens=int(body.get("min_tokens") or 0),
+                eos_token_ids=[pipe.preprocessor.tokenizer.eos_token_id],
+            )
+        return pipe.preprocessor.preprocess(body)
 
     async def _generate(
-        self, pipe, body: dict[str, Any], ctx: Context
+        self, pipe, body: dict[str, Any], ctx: Context, mode: str = "text"
     ) -> AsyncIterator[dict[str, Any]]:
-        preprocessed = pipe.preprocessor.preprocess(body)
+        preprocessed = self._preprocess(pipe, body, mode)
         async for d in pipe.generate(preprocessed, ctx):
             yield d
 
     async def _model_infer(self, req, grpc_ctx) -> pb.ModelInferResponse:
         try:
-            pipe, body, streaming = self._parse_request(req)
+            pipe, body, streaming, mode = self._parse_request(req)
         except KeyError as e:
             await grpc_ctx.abort(grpc.StatusCode.NOT_FOUND, str(e))
         except ValueError as e:
@@ -213,13 +334,35 @@ class KserveGrpcFrontend:
             )
         rid = req.id or new_request_id()
         ctx = Context(request_id=rid)
+        if mode == "openai":
+            try:
+                pre = pipe.preprocessor.preprocess(body)
+            except ValueError as e:
+                await grpc_ctx.abort(
+                    grpc.StatusCode.INVALID_ARGUMENT, str(e)
+                )
+            prompt_tokens = len(pre["token_ids"])
+            deltas = pipe.generate(pre, ctx)
+            try:
+                if "messages" in body:
+                    agg = await pipe.preprocessor.aggregate_chat(
+                        deltas, request_id=rid,
+                        prompt_tokens=prompt_tokens, request=body,
+                    )
+                else:
+                    agg = await pipe.preprocessor.aggregate_completions(
+                        deltas, request_id=rid, prompt_tokens=prompt_tokens,
+                    )
+            finally:
+                ctx.stop_generating()
+            return _openai_response(req.model_name, rid, agg, final=True)
         parts: list[str] = []
-        tokens = 0
+        out_ids: list[int] = []
         try:
-            async for d in self._generate(pipe, body, ctx):
+            async for d in self._generate(pipe, body, ctx, mode):
                 if d.get("text"):
                     parts.append(d["text"])
-                tokens += len(d.get("token_ids") or ())
+                out_ids.extend(d.get("token_ids") or ())
                 if d.get("finish_reason") == "error":
                     await grpc_ctx.abort(
                         grpc.StatusCode.INTERNAL,
@@ -228,24 +371,79 @@ class KserveGrpcFrontend:
         finally:
             ctx.stop_generating()
         return _text_output_response(
-            req.model_name, rid, "".join(parts), final=True, tokens=tokens
+            req.model_name, rid, "".join(parts), final=True,
+            tokens=len(out_ids),
+            token_ids=out_ids if mode == "tokens" else None,
         )
 
     async def _model_stream_infer(
         self, req, grpc_ctx
     ) -> AsyncIterator[pb.ModelStreamInferResponse]:
         try:
-            pipe, body, streaming = self._parse_request(req)
+            pipe, body, streaming, mode = self._parse_request(req)
         except (KeyError, ValueError) as e:
             yield pb.ModelStreamInferResponse(error_message=str(e))
             return
         rid = req.id or new_request_id()
         ctx = Context(request_id=rid)
         streaming = streaming is not False  # stream RPC defaults to True
+        if mode == "openai":
+            # OpenAI-over-gRPC streaming: one chunk object per response,
+            # exactly the SSE payloads of the HTTP surface
+            try:
+                pre = pipe.preprocessor.preprocess(body)
+            except ValueError as e:
+                yield pb.ModelStreamInferResponse(error_message=str(e))
+                return
+            prompt_tokens = len(pre["token_ids"])
+            deltas = pipe.generate(pre, ctx)
+            chunks = (
+                pipe.preprocessor.postprocess_chat_stream(
+                    deltas, request_id=rid,
+                    include_usage=bool(
+                        (body.get("stream_options") or {}).get(
+                            "include_usage"
+                        )
+                    ),
+                    prompt_tokens=prompt_tokens, request=body,
+                )
+                if "messages" in body
+                else pipe.preprocessor.postprocess_completions_stream(
+                    deltas, request_id=rid,
+                    include_usage=bool(
+                        (body.get("stream_options") or {}).get(
+                            "include_usage"
+                        )
+                    ),
+                    prompt_tokens=prompt_tokens,
+                )
+            )
+            try:
+                # one-chunk lookahead: the final marker must land on the
+                # actual LAST message (include_usage appends a usage
+                # chunk AFTER the finish-reason chunk)
+                prev = None
+                async for chunk in chunks:
+                    if prev is not None:
+                        yield pb.ModelStreamInferResponse(
+                            infer_response=_openai_response(
+                                req.model_name, rid, prev, final=False
+                            )
+                        )
+                    prev = chunk
+                if prev is not None:
+                    yield pb.ModelStreamInferResponse(
+                        infer_response=_openai_response(
+                            req.model_name, rid, prev, final=True
+                        )
+                    )
+            finally:
+                ctx.stop_generating()
+            return
         parts: list[str] = []  # aggregation when streaming=false
-        tokens = 0
+        all_ids: list[int] = []
         try:
-            async for d in self._generate(pipe, body, ctx):
+            async for d in self._generate(pipe, body, ctx, mode):
                 if d.get("finish_reason") == "error":
                     yield pb.ModelStreamInferResponse(
                         error_message=d.get("error") or "generation error"
@@ -257,20 +455,25 @@ class KserveGrpcFrontend:
                     # final response (ref tensor.rs:43-44)
                     if d.get("text"):
                         parts.append(d["text"])
-                    tokens += len(d.get("token_ids") or ())
+                    all_ids.extend(d.get("token_ids") or ())
                     if final:
                         yield pb.ModelStreamInferResponse(
                             infer_response=_text_output_response(
                                 req.model_name, rid, "".join(parts),
-                                final=True, tokens=tokens,
+                                final=True, tokens=len(all_ids),
+                                token_ids=(
+                                    all_ids if mode == "tokens" else None
+                                ),
                             )
                         )
-                elif d.get("text") or final:
+                elif d.get("text") or d.get("token_ids") or final:
+                    ids = list(d.get("token_ids") or ())
                     yield pb.ModelStreamInferResponse(
                         infer_response=_text_output_response(
                             req.model_name, rid, d.get("text") or "",
                             final=final,
-                            tokens=len(d.get("token_ids") or ()),
+                            tokens=len(ids),
+                            token_ids=ids if mode == "tokens" else None,
                         )
                     )
         finally:

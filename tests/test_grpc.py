@@ -166,3 +166,144 @@ async def test_grpc_stream_infer():
         await server.stop()
         await watcher.close()
         await drt.close()
+
+
+async def test_grpc_tokens_in_tokens_out():
+    """input_ids INT32 tensor in -> output_ids tensor out: the tokens
+    wire protocol over KServe (ref grpc/service/tensor.rs)."""
+    drt, watcher, server = await _stack()
+    try:
+        async with grpc.aio.insecure_channel(
+            f"127.0.0.1:{server.port}"
+        ) as ch:
+            req = pb.ModelInferRequest(
+                model_name="grpc-model",
+                id="tok-1",
+                inputs=[
+                    pb.ModelInferRequest.InferInputTensor(
+                        name="input_ids", datatype="INT32", shape=[5],
+                        contents=pb.InferTensorContents(
+                            int_contents=[21, 22, 23, 24, 25]
+                        ),
+                    ),
+                ],
+            )
+            req.parameters["max_tokens"].int64_param = 4
+            req.parameters["ignore_eos"].bool_param = True
+            infer = ch.unary_unary(
+                f"{SERVICE}/ModelInfer",
+                request_serializer=pb.ModelInferRequest.SerializeToString,
+                response_deserializer=pb.ModelInferResponse.FromString,
+            )
+            resp = await infer(req)
+            outs = {t.name: t for t in resp.outputs}
+            assert "output_ids" in outs
+            ids = list(outs["output_ids"].contents.int_contents)
+            assert len(ids) == 4
+            assert resp.parameters["output_tokens"].int64_param == 4
+
+            # streaming variant delivers per-chunk token ids
+            stream = ch.unary_stream(
+                f"{SERVICE}/ModelStreamInfer",
+                request_serializer=pb.ModelInferRequest.SerializeToString,
+                response_deserializer=(
+                    pb.ModelStreamInferResponse.FromString
+                ),
+            )
+            got = []
+            async for r in stream(req):
+                assert not r.error_message, r.error_message
+                for t in r.infer_response.outputs:
+                    if t.name == "output_ids":
+                        got.extend(t.contents.int_contents)
+            assert got == ids  # same greedy tokens, streamed
+    finally:
+        await server.stop()
+        await watcher.close()
+        await drt.close()
+
+
+async def test_grpc_openai_passthrough():
+    """openai_request BYTES tensor carrying a chat body -> aggregated
+    chat.completion (unary) and chunk-per-response streaming, matching
+    the HTTP surface's payloads (ref tensor.rs OpenAI-over-gRPC)."""
+    import json as _json
+
+    drt, watcher, server = await _stack()
+    try:
+        async with grpc.aio.insecure_channel(
+            f"127.0.0.1:{server.port}"
+        ) as ch:
+            def openai_req(stream: bool):
+                body = {
+                    "messages": [{"role": "user", "content": "hello"}],
+                    "max_tokens": 5, "temperature": 0.0,
+                    "ignore_eos": True, "stream": stream,
+                }
+                return pb.ModelInferRequest(
+                    model_name="grpc-model",
+                    inputs=[
+                        pb.ModelInferRequest.InferInputTensor(
+                            name="openai_request", datatype="BYTES",
+                            shape=[1],
+                            contents=pb.InferTensorContents(
+                                bytes_contents=[_json.dumps(body).encode()]
+                            ),
+                        ),
+                    ],
+                )
+
+            infer = ch.unary_unary(
+                f"{SERVICE}/ModelInfer",
+                request_serializer=pb.ModelInferRequest.SerializeToString,
+                response_deserializer=pb.ModelInferResponse.FromString,
+            )
+            resp = await infer(openai_req(False))
+            outs = {t.name: t for t in resp.outputs}
+            agg = _json.loads(outs["openai_response"].contents.bytes_contents[0])
+            assert agg["object"] == "chat.completion"
+            assert agg["usage"]["completion_tokens"] == 5
+
+            stream = ch.unary_stream(
+                f"{SERVICE}/ModelStreamInfer",
+                request_serializer=pb.ModelInferRequest.SerializeToString,
+                response_deserializer=(
+                    pb.ModelStreamInferResponse.FromString
+                ),
+            )
+            chunks = []
+            async for r in stream(openai_req(True)):
+                assert not r.error_message, r.error_message
+                for t in r.infer_response.outputs:
+                    if t.name == "openai_response":
+                        chunks.append(
+                            _json.loads(t.contents.bytes_contents[0])
+                        )
+            assert chunks and chunks[0]["object"] == "chat.completion.chunk"
+            finishes = [
+                c["choices"][0].get("finish_reason")
+                for c in chunks if c.get("choices")
+            ]
+            assert "length" in finishes
+
+            # malformed body -> error surfaced, not a hang
+            bad = pb.ModelInferRequest(
+                model_name="grpc-model",
+                inputs=[
+                    pb.ModelInferRequest.InferInputTensor(
+                        name="openai_request", datatype="BYTES", shape=[1],
+                        contents=pb.InferTensorContents(
+                            bytes_contents=[b'{"messages": "nope"}']
+                        ),
+                    ),
+                ],
+            )
+            try:
+                await infer(bad)
+                raise AssertionError("expected INVALID_ARGUMENT")
+            except grpc.aio.AioRpcError as e:
+                assert e.code() == grpc.StatusCode.INVALID_ARGUMENT
+    finally:
+        await server.stop()
+        await watcher.close()
+        await drt.close()
