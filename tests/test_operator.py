@@ -186,3 +186,32 @@ def test_dynamo_check_cli():
                 p.wait(timeout=10)
             except subprocess.TimeoutExpired:
                 p.kill()
+
+
+def test_kubectl_backend_issues_scale_commands(tmp_path, monkeypatch):
+    """KubectlBackend shells out correctly (stubbed kubectl on PATH):
+    scale commands name the deployment per the name format, and
+    running() parses readyReplicas."""
+    from dynamo_tpu.operator.backends import KubectlBackend
+
+    stub = tmp_path / "kubectl"
+    logf = tmp_path / "calls.log"
+    stub.write_text(
+        "#!/bin/sh\n"
+        # printf, not echo: echo would eat kubectl's leading -n flag
+        f'printf \'%s \' "$@" >> "{logf}"; printf \'\\n\' >> "{logf}"\n'
+        'case "$*" in\n'
+        "  *get*deployment*) printf 3 ;;\n"
+        "esac\n"
+    )
+    stub.chmod(0o755)
+    monkeypatch.setenv("PATH", f"{tmp_path}:{os.environ.get('PATH', '')}")
+    be = KubectlBackend(namespace="prod")
+    assert be.running("decode") == 3
+    asyncio.run(be.scale(_mock_service("h:1", name="decode"), 5))
+    calls = logf.read_text().splitlines()
+    assert any(
+        "scale deployment dynamo-decode --replicas=5" in c
+        for c in calls
+    ), calls
+    assert any("-n prod" in c for c in calls)
