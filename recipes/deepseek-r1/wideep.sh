@@ -15,6 +15,7 @@ set -euo pipefail
 cd "$(dirname "$0")/../.."
 
 EP="${EP:-16}"
+BURST="${BURST:-24}"
 PREFILL_TP="${PREFILL_TP:-16}"
 PAGE="${PAGE:-32}"
 NUM_PAGES="${NUM_PAGES:-8192}"
@@ -25,13 +26,13 @@ MODEL_ARGS=(--model-path "${MODEL_PATH:-/ckpt/deepseek-r1}")
 if [ "${SMOKE:-0}" = "1" ]; then
   export JAX_PLATFORMS=cpu
   export XLA_FLAGS="--xla_force_host_platform_device_count=4"
-  EP=2 PREFILL_TP=2 PAGE=4 NUM_PAGES=64 SLOTS=2 KVBM_MB=8
+  EP=2 PREFILL_TP=2 PAGE=4 NUM_PAGES=64 SLOTS=2 KVBM_MB=8 BURST=4
   MODEL_ARGS=(--model tiny-deepseek)
 fi
 
 COMMON=("${MODEL_ARGS[@]}" --model-name "${MODEL:-deepseek-r1}"
         --page-size "$PAGE" --num-pages "$NUM_PAGES"
-        --max-decode-slots "$SLOTS")
+        --max-decode-slots "$SLOTS" --decode-steps-per-dispatch "$BURST")
 
 case "${ROLE:-all}" in
   decode)

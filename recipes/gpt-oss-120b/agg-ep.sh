@@ -12,6 +12,7 @@ set -euo pipefail
 cd "$(dirname "$0")/../.."
 
 EP="${EP:-8}"
+BURST="${BURST:-24}"
 TP="${TP:-2}"
 PAGE="${PAGE:-32}"
 NUM_PAGES="${NUM_PAGES:-4096}"
@@ -21,7 +22,7 @@ MODEL_ARGS=(--model-path "${MODEL_PATH:-/ckpt/gpt-oss-120b}")
 if [ "${SMOKE:-0}" = "1" ]; then
   export JAX_PLATFORMS=cpu
   export XLA_FLAGS="--xla_force_host_platform_device_count=4"
-  EP=2 TP=2 PAGE=4 NUM_PAGES=64 SLOTS=2
+  EP=2 TP=2 PAGE=4 NUM_PAGES=64 SLOTS=2 BURST=4
   MODEL_ARGS=(--model tiny-gpt-oss)
 fi
 
@@ -35,7 +36,7 @@ echo "hub: $HUB"
 python -m dynamo_tpu.engine.worker --hub "$HUB" "${MODEL_ARGS[@]}" \
   --model-name "${MODEL:-gpt-oss-120b}" \
   --ep "$EP" --tp "$TP" --page-size "$PAGE" --num-pages "$NUM_PAGES" \
-  --max-decode-slots "$SLOTS" \
+  --max-decode-slots "$SLOTS" --decode-steps-per-dispatch "$BURST" \
   --tool-call-parser harmony --reasoning-parser gpt_oss &
 exec python -m dynamo_tpu.frontend --hub "$HUB" --host 127.0.0.1 \
   --port "${PORT:-8000}"

@@ -19,6 +19,7 @@ set -euo pipefail
 cd "$(dirname "$0")/../.."
 
 TP="${TP:-8}"
+BURST="${BURST:-24}"
 PAGE="${PAGE:-32}"
 NUM_PAGES="${NUM_PAGES:-4096}"
 SLOTS="${SLOTS:-64}"
@@ -27,12 +28,13 @@ MODEL_ARGS=(--model-path "${MODEL_PATH:-/ckpt/llama-3-70b}")
 if [ "${SMOKE:-0}" = "1" ]; then
   export JAX_PLATFORMS=cpu
   export XLA_FLAGS="--xla_force_host_platform_device_count=2"
-  TP=2 PAGE=4 NUM_PAGES=64 SLOTS=2
+  TP=2 PAGE=4 NUM_PAGES=64 SLOTS=2 BURST=4
   MODEL_ARGS=(--model tiny-test)
 fi
 
 COMMON=(--tp "$TP" --page-size "$PAGE" --num-pages "$NUM_PAGES"
-        --max-decode-slots "$SLOTS" "${MODEL_ARGS[@]}"
+        --max-decode-slots "$SLOTS" --decode-steps-per-dispatch "$BURST"
+        "${MODEL_ARGS[@]}"
         --model-name "${MODEL:-llama-3-70b}")
 MH=()
 [ -n "${COORDINATOR:-}" ] && MH=(--coordinator-address "$COORDINATOR"
