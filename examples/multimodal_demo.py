@@ -1,0 +1,128 @@
+#!/usr/bin/env python
+"""Multimodal EPD demo: hub + encode worker + engine worker + frontend
+as REAL processes; image chat requests over HTTP. Prints [demo] PASS.
+
+Drives: content-part preprocessing, the encode-worker hop, engine-side
+embedding injection, image-salted prefix caching (same image =
+deterministic, different image = different output).
+"""
+
+import base64
+import json
+import os
+import queue
+import signal
+import subprocess
+import sys
+import threading
+import time
+import urllib.request
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+ENV = {**os.environ, "PYTHONPATH": REPO, "JAX_PLATFORMS": "cpu"}
+
+
+def spawn(args, ready, procs, timeout=120.0):
+    """Start a child and wait for its ready line. A pump thread keeps
+    draining stdout afterwards (a full 64KB pipe would block the child
+    mid-request), and the timeout holds even if the child goes silent."""
+    p = subprocess.Popen(
+        [sys.executable, *args], stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT, text=True, cwd=REPO, env=ENV,
+    )
+    procs.append(p)
+    q: queue.Queue = queue.Queue()
+
+    def pump():
+        for line in p.stdout:
+            q.put(line)
+        q.put(None)
+
+    threading.Thread(target=pump, daemon=True).start()
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        try:
+            line = q.get(timeout=1.0)
+        except queue.Empty:
+            continue
+        if line is None:
+            raise SystemExit(f"[demo] FAIL: {args} died rc={p.poll()}")
+        if line.strip().startswith(ready):
+            return line.strip().split("=", 1)[-1]
+    raise SystemExit(f"[demo] FAIL: {args} never printed {ready}")
+
+
+def ask(base: str, img: bytes) -> str:
+    uri = "data:image/png;base64," + base64.b64encode(img).decode()
+    body = json.dumps({
+        "model": "tiny-mm",
+        "messages": [{"role": "user", "content": [
+            {"type": "text", "text": "describe this"},
+            {"type": "image_url", "image_url": {"url": uri}},
+        ]}],
+        "max_tokens": 6, "temperature": 0.0, "ignore_eos": True,
+    }).encode()
+    req = urllib.request.Request(
+        f"{base}/v1/chat/completions", data=body,
+        headers={"Content-Type": "application/json"},
+    )
+    with urllib.request.urlopen(req, timeout=120) as r:
+        return json.load(r)["choices"][0]["message"]["content"]
+
+
+def main() -> int:
+    procs: list[subprocess.Popen] = []
+    try:
+        hub = spawn(["-m", "dynamo_tpu.runtime.hub_server", "--port", "0"],
+                    "DYNAMO_HUB=", procs)
+        print(f"[demo] hub: {hub}")
+        spawn(["-m", "dynamo_tpu.cli", "encoder", "--hub", hub,
+               "--hidden-size", "128", "--tokens-per-image", "4"],
+              "ENCODER_READY", procs)
+        spawn(["-m", "dynamo_tpu.engine.worker", "--hub", hub,
+               "--model", "tiny-test", "--model-name", "tiny-mm",
+               "--page-size", "4", "--num-pages", "128",
+               "--max-pages-per-seq", "16", "--max-decode-slots", "2",
+               "--mm-tokens-per-image", "4", "--image-token-id", "5"],
+              "ENGINE_READY", procs)
+        http = spawn(["-m", "dynamo_tpu.frontend", "--hub", hub,
+                      "--host", "127.0.0.1", "--port", "0"],
+                     "DYNAMO_HTTP=", procs)
+        base = f"http://{http}"
+        t0 = time.time()
+        models = []
+        while time.time() - t0 < 30 and not models:
+            try:
+                with urllib.request.urlopen(
+                    f"{base}/v1/models", timeout=5
+                ) as r:
+                    models = json.load(r)["data"]
+            except OSError:
+                pass
+            if not models:
+                time.sleep(0.2)
+        if not models:
+            raise SystemExit("[demo] FAIL: model never became ready")
+
+        cat1 = ask(base, b"a cat photo")
+        dog = ask(base, b"a dog photo")
+        cat2 = ask(base, b"a cat photo")
+        print(f"[demo] cat -> {cat1[:32]!r}")
+        print(f"[demo] dog -> {dog[:32]!r}")
+        assert cat1 == cat2, "same image must be deterministic"
+        assert cat1 != dog, "different image must change the output"
+        print("[demo] PASS")
+        return 0
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.send_signal(signal.SIGINT)
+        for p in procs:
+            try:
+                p.wait(5)
+            except Exception:  # noqa: BLE001
+                p.kill()
+
+
+if __name__ == "__main__":
+    sys.exit(main())
