@@ -115,7 +115,7 @@ class MlaFamily:
     supports_packed_prefill = True
     supports_ring_prefill = False
     supports_mesh = True
-    supports_logprobs = False
+    supports_logprobs = True
     supports_embeddings = False
     supports_multimodal = False
 
@@ -155,10 +155,14 @@ class MlaFamily:
     def decode_steps(self, spec, params, tokens, bts, lens, k, v, active,
                      temps, topk, topp, seeds, steps, *, n_steps, n_logprobs,
                      mesh=None):
-        out, cache = self.m.decode_steps(
+        result = self.m.decode_steps(
             spec, params, tokens, bts, lens, k, active, temps, topk, topp,
-            seeds, steps, n_steps=n_steps, mesh=mesh,
+            seeds, steps, n_steps=n_steps, n_logprobs=n_logprobs, mesh=mesh,
         )
+        if n_logprobs > 0:
+            out, lp, ti, tv, cache = result
+            return out, lp, ti, tv, cache, v
+        out, cache = result
         return out, cache, v
 
     def extract_pages(self, k, v, page_ids):

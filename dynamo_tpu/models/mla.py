@@ -514,17 +514,22 @@ def decode_steps_impl(
     seeds: jax.Array,
     steps: jax.Array,
     n_steps: int = 1,
+    n_logprobs: int = 0,  # static: 0=off, N=sampled+top-N logprobs
     mesh: Mesh | None = None,  # static
 ):
     """Fused multi-step MLA decode + on-device sampling (the serving hot
-    loop; mirrors llama.decode_steps for the GQA family)."""
-    from dynamo_tpu.engine.sampling import sample_tokens
+    loop; mirrors llama.decode_steps for the GQA family, including the
+    logprob surface)."""
+    from dynamo_tpu.engine.sampling import sample_tokens, token_logprobs
 
     B = tokens.shape[0]
     out0 = jnp.zeros((B, n_steps), jnp.int32)
+    lp0 = jnp.zeros((B, n_steps), jnp.float32)
+    ti0 = jnp.zeros((B, n_steps, max(n_logprobs, 1)), jnp.int32)
+    tv0 = jnp.zeros((B, n_steps, max(n_logprobs, 1)), jnp.float32)
 
     def body(i, carry):
-        toks, lens, cache, out = carry
+        toks, lens, cache, out, lp, ti, tv = carry
         logits, cache = decode_forward_impl(
             spec, params, toks, block_tables, lens, cache, active,
             mesh=mesh,
@@ -532,16 +537,26 @@ def decode_steps_impl(
         nxt = sample_tokens(logits, temperature, top_k, top_p, seeds,
                             steps + i)
         nxt = jnp.where(active, nxt, toks)
-        return (nxt, lens + active.astype(jnp.int32), cache,
-                out.at[:, i].set(nxt))
+        out = out.at[:, i].set(nxt)
+        if n_logprobs > 0:
+            picked, top_i, top_v = token_logprobs(logits, nxt, n_logprobs)
+            lp = lp.at[:, i].set(picked)
+            ti = ti.at[:, i].set(top_i)
+            tv = tv.at[:, i].set(top_v)
+        return (nxt, lens + active.astype(jnp.int32), cache, out, lp, ti, tv)
 
-    _t, _l, cache, out = jax.lax.fori_loop(
-        0, n_steps, body, (tokens, seq_lens, cache, out0)
+    _t, _l, cache, out, lp, ti, tv = jax.lax.fori_loop(
+        0, n_steps, body,
+        (tokens, seq_lens, cache, out0, lp0, ti0, tv0),
     )
-    return _replicate(out, mesh), cache
+    out = _replicate(out, mesh)
+    if n_logprobs > 0:
+        return (out, _replicate(lp, mesh), _replicate(ti, mesh),
+                _replicate(tv, mesh), cache)
+    return out, cache
 
 
 decode_steps = jax.jit(
     decode_steps_impl, static_argnums=(0,),
-    static_argnames=("n_steps", "mesh"),
+    static_argnames=("n_steps", "n_logprobs", "mesh"),
 )

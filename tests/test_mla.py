@@ -553,3 +553,42 @@ async def test_deepseek_serves_through_frontend():
     assert len(toks) == 5
     await watcher.close()
     await drt.close()
+
+
+async def test_deepseek_logprobs_through_engine():
+    """OpenAI logprobs for the MLA family: per-token sampled + top-N
+    entries, greedy-consistent with the sampled ids."""
+    import math
+
+    from dynamo_tpu.engine.config import EngineConfig
+    from dynamo_tpu.engine.core import InferenceEngine
+    from dynamo_tpu.runtime.context import Context
+
+    engine = InferenceEngine(
+        SPEC,
+        EngineConfig(
+            page_size=4, num_pages=64, max_pages_per_seq=8,
+            max_decode_slots=2, prefill_buckets=(16, 32),
+        ),
+    )
+    entries = []
+    toks = []
+    async for item in engine.generate(
+        {"token_ids": list(range(9, 20)),
+         "sampling": {"temperature": 0.0},
+         "output_options": {"logprobs": 3},
+         "stop_conditions": {"max_tokens": 5, "ignore_eos": True}},
+        Context(),
+    ):
+        assert item.get("finish_reason") != "error", item
+        toks.extend(item.get("token_ids") or [])
+        entries.extend(item.get("logprobs") or [])
+    await engine.close()
+    assert len(toks) == 5
+    assert len(entries) == 5
+    for tok, e in zip(toks, entries):
+        assert e["id"] == tok
+        assert math.isfinite(e["logprob"]) and e["logprob"] <= 0
+        assert len(e["top"]) == 3
+        # greedy: the sampled token IS the argmax -> leads the top list
+        assert e["top"][0]["id"] == tok
