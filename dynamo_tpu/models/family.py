@@ -13,9 +13,9 @@ core. Two families today:
   cache as ``k_pages`` and a tiny inert placeholder as ``v_pages`` so
   page bookkeeping, KVBM tier blocks, and transfer metadata flow
   unchanged. Supports meshes (tp over heads, ep over experts,
-  replicated latent cache) and packed prefill; capability flags gate
-  the rest (ring prefill, logprobs, embeddings) — the engine falls
-  back to the single-prompt paths and rejects the rest cleanly.
+  replicated latent cache), packed prefill, logprobs, and embeddings;
+  ring prefill (long MLA prompts chunk instead) and multimodal stay
+  gated off.
 
 Ref: the reference delegates this dispatch to its engines (vLLM model
 registry); here it is explicit and small.
@@ -113,10 +113,10 @@ class MlaFamily:
     tep16p-dep16d-disagg.yaml:63 (--ep-size 16)."""
 
     supports_packed_prefill = True
-    supports_ring_prefill = False
+    supports_ring_prefill = False  # long MLA prompts take the chunked path
     supports_mesh = True
     supports_logprobs = True
-    supports_embeddings = False
+    supports_embeddings = True
     supports_multimodal = False
 
     def __init__(self):
@@ -176,7 +176,7 @@ class MlaFamily:
         return _insert_latent(k, page_ids, kb), v
 
     def embed_forward(self, spec, params, tokens, num_tokens):
-        raise NotImplementedError("MLA embeddings are not wired yet")
+        return self.m.embed_forward(spec, params, tokens, num_tokens)
 
 
 @jax.jit

@@ -560,3 +560,38 @@ decode_steps = jax.jit(
     decode_steps_impl, static_argnums=(0,),
     static_argnames=("n_steps", "n_logprobs", "mesh"),
 )
+
+
+# ------------------------------------------------------------- embeddings
+
+
+def embed_forward_impl(
+    spec: ModelSpec,
+    params: Params,
+    tokens: jax.Array,  # [T_pad] int32 (padded)
+    num_tokens: jax.Array,  # scalar: real token count
+) -> jax.Array:
+    """Sequence embedding for the MLA family: mean-pooled final-norm
+    hidden states over the real tokens, L2-normalized (mirrors
+    llama.embed_forward_impl — the /v1/embeddings surface)."""
+    T = tokens.shape[0]
+    positions = jnp.arange(T)
+    x = params["embed"][tokens]
+    mask2d = (positions[:, None] >= positions[None, :]) & (
+        positions[None, :] < num_tokens
+    )
+    for li, lp in enumerate(params["layers"]):
+        h = rms_norm(x, lp["attn_norm"], spec.rms_eps)
+        q_nope, q_rope = _q_heads(spec, lp, h, positions)
+        rows = _latent_row(spec, lp, h, positions)
+        attn = _absorbed_attention(spec, lp, q_nope, q_rope, rows, mask2d)
+        x = x + attn.reshape(T, -1).astype(x.dtype) @ lp["wo"]
+        hh = rms_norm(x, lp["mlp_norm"], spec.rms_eps)
+        x = x + _ffn(spec, li, lp, hh)
+    xn = rms_norm(x, params["final_norm"], spec.rms_eps).astype(jnp.float32)
+    valid = (positions < num_tokens)[:, None].astype(jnp.float32)
+    pooled = (xn * valid).sum(axis=0) / jnp.maximum(valid.sum(), 1.0)
+    return pooled / jnp.maximum(jnp.linalg.norm(pooled), 1e-9)
+
+
+embed_forward = jax.jit(embed_forward_impl, static_argnums=(0,))

@@ -592,3 +592,29 @@ async def test_deepseek_logprobs_through_engine():
         assert len(e["top"]) == 3
         # greedy: the sampled token IS the argmax -> leads the top list
         assert e["top"][0]["id"] == tok
+
+
+async def test_deepseek_embeddings_through_engine():
+    """/v1/embeddings surface for the MLA family: unit-norm pooled
+    vectors, deterministic, and distinct inputs separate. (Numerical
+    parity of the underlying attention is covered by the paged/dense
+    reference tests above.)"""
+    from dynamo_tpu.engine.config import EngineConfig
+    from dynamo_tpu.engine.core import InferenceEngine
+
+    engine = InferenceEngine(
+        SPEC,
+        EngineConfig(
+            page_size=4, num_pages=64, max_pages_per_seq=8,
+            max_decode_slots=2, prefill_buckets=(16, 32),
+        ),
+    )
+    v1 = await asyncio.to_thread(engine._embed, list(range(5, 14)))
+    v2 = await asyncio.to_thread(engine._embed, list(range(5, 14)))
+    v3 = await asyncio.to_thread(engine._embed, list(range(30, 41)))
+    await engine.close()
+    v1, v2, v3 = map(np.asarray, (v1, v2, v3))
+    assert v1.shape == (SPEC.hidden_size,)
+    np.testing.assert_allclose(np.linalg.norm(v1), 1.0, rtol=1e-5)
+    np.testing.assert_allclose(v1, v2, rtol=1e-6)
+    assert not np.allclose(v1, v3)
