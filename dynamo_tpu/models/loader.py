@@ -164,12 +164,24 @@ def spec_from_hf_config(cfg: dict, name: str | None = None) -> ModelSpec:
 
 
 def hf_config_from_spec(spec: ModelSpec) -> dict:
+    """Inverse of spec_from_hf_config (save_params / re-export): every
+    architecture field the loader reads must round-trip, or an exported
+    checkpoint silently loses features on reload."""
+    if spec.kv_lora_rank:
+        model_type = "deepseek_v3"
+    elif spec.attn_sinks:
+        model_type = "gpt_oss"
+    elif spec.num_experts:
+        model_type = "mixtral"
+    else:
+        model_type = "llama"
     cfg = {
-        "model_type": "mixtral" if spec.num_experts else "llama",
+        "model_type": model_type,
         "vocab_size": spec.vocab_size,
         "hidden_size": spec.hidden_size,
         "intermediate_size": (
-            spec.moe_intermediate_size if spec.num_experts
+            spec.moe_intermediate_size
+            if spec.num_experts and not spec.kv_lora_rank
             else spec.intermediate_size
         ),
         "num_hidden_layers": spec.num_layers,
@@ -183,6 +195,54 @@ def hf_config_from_spec(spec: ModelSpec) -> dict:
     if spec.num_experts:
         cfg["num_local_experts"] = spec.num_experts
         cfg["num_experts_per_tok"] = spec.num_experts_per_token
+        cfg["moe_intermediate_size"] = spec.moe_intermediate_size
+    if model_type == "gpt_oss":
+        cfg.update(
+            sliding_window=spec.sliding_window,
+            layer_types=list(spec.layer_types),
+            attention_bias=spec.attn_bias,
+            swiglu_limit=spec.swiglu_limit,
+        )
+    if spec.kv_lora_rank:
+        cfg.update(
+            n_routed_experts=spec.num_experts,
+            n_shared_experts=spec.n_shared_experts,
+            first_k_dense_replace=spec.first_k_dense,
+            kv_lora_rank=spec.kv_lora_rank,
+            q_lora_rank=spec.q_lora_rank or None,
+            qk_nope_head_dim=spec.qk_nope_head_dim,
+            qk_rope_head_dim=spec.qk_rope_head_dim,
+            v_head_dim=spec.v_head_dim,
+            scoring_func=spec.moe_scoring,
+            n_group=spec.n_group,
+            topk_group=spec.topk_group,
+            routed_scaling_factor=spec.routed_scaling_factor,
+            norm_topk_prob=spec.norm_topk_prob,
+            # our in-memory params are HALF-SPLIT (load_params permutes
+            # interleaved checkpoints on the way in) — an exported
+            # checkpoint must say so, or reload would de-interleave twice
+            rope_interleave=False,
+        )
+    if spec.rope_scaling_factor:
+        cfg["rope_scaling"] = {
+            "rope_type": "yarn",
+            "factor": spec.rope_scaling_factor,
+            "original_max_position_embeddings": spec.rope_orig_max_pos,
+            "beta_fast": spec.rope_beta_fast,
+            "beta_slow": spec.rope_beta_slow,
+            "truncate": spec.rope_truncate,
+            **(
+                {"mscale": spec.rope_mscale,
+                 "mscale_all_dim": spec.rope_mscale_all_dim}
+                if spec.rope_mscale or spec.rope_mscale_all_dim
+                else {}
+            ),
+        }
+        # HF convention: the POST-scaling context window (the original
+        # lives inside rope_scaling)
+        cfg["max_position_embeddings"] = int(
+            spec.rope_orig_max_pos * spec.rope_scaling_factor
+        )
     return cfg
 
 
@@ -552,7 +612,7 @@ def save_params(
     from safetensors.numpy import save_file
 
     os.makedirs(model_dir, exist_ok=True)
-    dest = _dest_map(spec)
+    dest = _dest_map_mla(spec) if spec.kv_lora_rank else _dest_map(spec)
     tensors: dict[str, np.ndarray] = {}
     for name, (path, transpose, _dt) in dest.items():
         if len(path) >= 2 and isinstance(path[-1], int):
@@ -562,6 +622,19 @@ def save_params(
         if transpose:
             arr = np.ascontiguousarray(arr.T)
         tensors[name] = arr
+    if spec.kv_lora_rank:
+        # re-fuse the per-head up-projections into HF's kv_b_proj layout
+        # (load_params splits them; see the kv_b_proj branch there)
+        H, dn, dv, dc = (spec.num_heads, spec.qk_nope_head_dim,
+                         spec.v_head_dim, spec.kv_lora_rank)
+        for i, lp in enumerate(params["layers"]):
+            fused = np.concatenate(
+                [np.asarray(lp["w_uk"]).transpose(0, 2, 1),
+                 np.asarray(lp["w_uv"]).transpose(0, 2, 1)], axis=1
+            ).reshape(H * (dn + dv), dc)
+            tensors[f"model.layers.{i}.self_attn.kv_b_proj.weight"] = (
+                np.ascontiguousarray(fused)
+            )
 
     shards: list[dict[str, np.ndarray]] = [{}]
     size = 0

@@ -198,3 +198,63 @@ def test_yarn_freqs_match_hf():
             err_msg=spec.name,
         )
         assert abs(got_att - want_att) < 1e-9, spec.name
+
+
+def test_spec_config_round_trip():
+    """hf_config_from_spec o spec_from_hf_config == identity for every
+    architecture field the loader reads — an exported checkpoint must
+    not silently lose features on reload."""
+    from dynamo_tpu.engine.config import ModelSpec
+    from dynamo_tpu.models.loader import (
+        hf_config_from_spec,
+        spec_from_hf_config,
+    )
+
+    for preset in ("tiny-test", "tiny-moe", "tiny-gpt-oss", "tiny-deepseek",
+                   "gpt-oss-120b", "deepseek-r1", "llama-3-70b"):
+        spec = ModelSpec.preset(preset)
+        back = spec_from_hf_config(hf_config_from_spec(spec), name=spec.name)
+        for f in (
+            "vocab_size", "hidden_size", "num_layers", "num_heads",
+            "num_kv_heads", "head_dim", "rope_theta", "tie_embeddings",
+            "num_experts", "num_experts_per_token", "moe_intermediate_size",
+            "n_shared_experts", "first_k_dense", "kv_lora_rank",
+            "q_lora_rank", "qk_nope_head_dim", "qk_rope_head_dim",
+            "v_head_dim", "sliding_window", "layer_types", "attn_sinks",
+            "attn_bias", "moe_bias", "swiglu_limit", "moe_scoring",
+            "n_group", "topk_group", "routed_scaling_factor",
+            "norm_topk_prob", "rope_scaling_factor", "rope_orig_max_pos",
+            "rope_truncate", "rope_mscale", "rope_mscale_all_dim",
+        ):
+            assert getattr(back, f) == getattr(spec, f), (
+                preset, f, getattr(back, f), getattr(spec, f)
+            )
+        # rope_interleave describes the CHECKPOINT layout, not the model:
+        # exported params are always half-split, so the exported config
+        # must say so regardless of what layout was originally loaded
+        if spec.kv_lora_rank:
+            assert back.rope_interleave is False
+
+
+def test_save_params_round_trips_mla(tmp_path):
+    """save_params -> load_model_dir identity for the MLA family (fused
+    kv_b re-assembly, sigmoid-router bias, half-split rope marking)."""
+    import jax
+    import jax.numpy as jnp
+
+    from dynamo_tpu.engine.config import ModelSpec
+    from dynamo_tpu.models import mla
+    from dynamo_tpu.models.loader import load_model_dir, save_params
+
+    spec = ModelSpec.tiny_deepseek()
+    params = mla.init_params(spec, jax.random.PRNGKey(13))
+    save_params(spec, params, str(tmp_path))
+    spec2, params2 = load_model_dir(str(tmp_path), dtype="float32")
+    assert spec2.is_mla and spec2.moe_scoring == "sigmoid"
+    assert not spec2.rope_interleave  # exported layout is half-split
+    tokens = jnp.asarray(np.arange(9) % spec.vocab_size, jnp.int32)
+    want = mla.reference_forward(spec, params, tokens)
+    got = mla.reference_forward(spec2, params2, tokens)
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(want), atol=1e-4, rtol=1e-4
+    )
