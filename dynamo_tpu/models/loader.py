@@ -191,6 +191,8 @@ def hf_config_from_spec(spec: ModelSpec) -> dict:
         "rope_theta": spec.rope_theta,
         "rms_norm_eps": spec.rms_eps,
         "tie_word_embeddings": spec.tie_embeddings,
+        "dtype": spec.dtype,  # transformers >= 4.56 key (loader reads both)
+        "torch_dtype": spec.dtype,
     }
     if spec.num_experts:
         cfg["num_local_experts"] = spec.num_experts
@@ -612,7 +614,17 @@ def save_params(
     from safetensors.numpy import save_file
 
     os.makedirs(model_dir, exist_ok=True)
-    dest = _dest_map_mla(spec) if spec.kv_lora_rank else _dest_map(spec)
+    if spec.kv_lora_rank:
+        dest = _dest_map_mla(spec)
+    elif spec.moe_bias:
+        # gpt-oss exports use the FUSED expert naming (synthesized
+        # below); the name hint selects the gpt_oss scheme so the dest
+        # map carries router(+bias) but not mixtral per-expert entries
+        dest = _dest_map(
+            spec, names={"model.layers.0.mlp.experts.gate_up_proj"}
+        )
+    else:
+        dest = _dest_map(spec)
     tensors: dict[str, np.ndarray] = {}
     for name, (path, transpose, _dt) in dest.items():
         if len(path) >= 2 and isinstance(path[-1], int):
@@ -622,6 +634,28 @@ def save_params(
         if transpose:
             arr = np.ascontiguousarray(arr.T)
         tensors[name] = arr
+    if spec.moe_bias and not spec.kv_lora_rank:
+        # gpt-oss fused expert tensors: re-interleave gate/up (weights
+        # AND biases) the way load_params de-interleaves them
+        for i, lp in enumerate(params["layers"]):
+            moe = lp["moe"]
+            wg = np.asarray(moe["w_gate"])
+            wu = np.asarray(moe["w_up"])
+            fused_w = np.empty(
+                (wg.shape[0], wg.shape[1], 2 * wg.shape[2]), wg.dtype
+            )
+            fused_w[..., 0::2] = wg
+            fused_w[..., 1::2] = wu
+            bg = np.asarray(moe["b_gate"])
+            bu = np.asarray(moe["b_up"])
+            fused_b = np.empty((bg.shape[0], 2 * bg.shape[1]), bg.dtype)
+            fused_b[..., 0::2] = bg
+            fused_b[..., 1::2] = bu
+            p = f"model.layers.{i}.mlp.experts."
+            tensors[p + "gate_up_proj"] = fused_w
+            tensors[p + "gate_up_proj_bias"] = fused_b
+            tensors[p + "down_proj"] = np.asarray(moe["w_down"])
+            tensors[p + "down_proj_bias"] = np.asarray(moe["b_down"])
     if spec.kv_lora_rank:
         # re-fuse the per-head up-projections into HF's kv_b_proj layout
         # (load_params splits them; see the kv_b_proj branch there)

@@ -34,6 +34,15 @@ def use_pallas() -> bool:
     return jax.default_backend() == "tpu"
 
 
+def lane_aligned(head_dim: int) -> bool:
+    """Whether Mosaic DMA page slices are lane-aligned at this head dim
+    (tiling constraint: last dim % 128). The single source for BOTH
+    compiled-kernel dispatch gates (paged_attention_v3.v3_supported and
+    kv_write.write_new_kv); misaligned heads (gpt-oss D=64, toy specs)
+    take the pure-XLA paths on real TPUs."""
+    return head_dim % 128 == 0
+
+
 def repeat_kv(x: jax.Array, n_rep: int) -> jax.Array:
     """[.., S, kv_heads, D] -> [.., S, kv_heads*n_rep, D] (GQA expansion)."""
     if n_rep == 1:

@@ -196,9 +196,13 @@ def write_new_kv(
     ops/attention.py; off-TPU the XLA scatter is both correct and fast
     enough for tests.
     """
-    from dynamo_tpu.ops.attention import use_pallas
+    from dynamo_tpu.ops.attention import lane_aligned, use_pallas
 
-    if use_pallas() and jax.default_backend() == "tpu":
+    if (
+        lane_aligned(k_pages.shape[-1])
+        and use_pallas()
+        and jax.default_backend() == "tpu"
+    ):
         kernel = functools.partial(kv_write_pallas, layer=layer)
         if mesh is not None and mesh.shape.get("tp", 1) > 1:
             from jax.sharding import PartitionSpec as P

@@ -182,11 +182,15 @@ def _decode_kernel_v3(
         wait(buf, b, c)
         kf = kv_buf[buf, 0].reshape(Nw, D).astype(jnp.float32)
         vf = kv_buf[buf, 1].reshape(Nw, D).astype(jnp.float32)
-        # slots whose fetch was skipped hold UNINITIALIZED VMEM: garbage
-        # K only feeds masked score columns (where -> NEG_INF), but a
-        # non-finite V would turn 0-prob x V into NaN in the acc matmul —
-        # sanitize. (K needs no guard; NaN scores land on valid=False.)
-        vf = jnp.where(jnp.isfinite(vf), vf, 0.0)
+        if window or n_chunks > 1:
+            # Only these shapes can SKIP fetches (chunk_live) and hence
+            # read UNINITIALIZED VMEM: garbage K only feeds masked score
+            # columns (where -> NEG_INF), but a non-finite V would turn
+            # 0-prob x V into NaN in the acc matmul — sanitize. With one
+            # always-live full-attention chunk every slot is written, and
+            # skipping the isfinite select also sidesteps a Mosaic
+            # layout-cast failure at small head dims (D=32).
+            vf = jnp.where(jnp.isfinite(vf), vf, 0.0)
         scores = jax.lax.dot_general(
             qf, kf, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
@@ -224,8 +228,14 @@ def _decode_kernel_v3(
 
 
 def v3_supported(k_pages: jax.Array, block_tables: jax.Array) -> bool:
-    """The windowed kernel bounds its VMEM for any table size."""
-    return True
+    """Whether the compiled kernel supports these shapes. The windowed
+    schedule bounds VMEM for any table size, but Mosaic DMA slices must
+    be LANE-ALIGNED: head_dim % 128 == 0 ("Slice shape along dimension 3
+    must be aligned to tiling (128)"). Smaller heads (gpt-oss D=64, toy
+    specs) fall back to the pure-XLA gather path on real TPUs."""
+    from dynamo_tpu.ops.attention import lane_aligned
+
+    return lane_aligned(k_pages.shape[-1])
 
 
 @functools.partial(jax.jit, static_argnames=("interpret", "window"))

@@ -225,6 +225,7 @@ def test_spec_config_round_trip():
             "n_group", "topk_group", "routed_scaling_factor",
             "norm_topk_prob", "rope_scaling_factor", "rope_orig_max_pos",
             "rope_truncate", "rope_mscale", "rope_mscale_all_dim",
+            "dtype",
         ):
             assert getattr(back, f) == getattr(spec, f), (
                 preset, f, getattr(back, f), getattr(spec, f)
@@ -255,6 +256,43 @@ def test_save_params_round_trips_mla(tmp_path):
     tokens = jnp.asarray(np.arange(9) % spec.vocab_size, jnp.int32)
     want = mla.reference_forward(spec, params, tokens)
     got = mla.reference_forward(spec2, params2, tokens)
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(want), atol=1e-4, rtol=1e-4
+    )
+
+
+def test_save_params_round_trips_gpt_oss(tmp_path):
+    """save_params -> load_model_dir identity for the gpt-oss family:
+    fused expert tensors + biases, sinks, projection biases, YaRN config
+    — exported checkpoints must not silently lose learned weights."""
+    import jax
+    import jax.numpy as jnp
+
+    from dynamo_tpu.engine.config import ModelSpec
+    from dynamo_tpu.models import llama
+    from dynamo_tpu.models.loader import load_model_dir, save_params
+
+    spec = ModelSpec.tiny_gpt_oss()
+    params = llama.init_params(spec, jax.random.PRNGKey(17))
+    # non-trivial biases/sinks: the round-trip must carry them
+    key = jax.random.PRNGKey(18)
+    for lp in params["layers"]:
+        for name in ("bq", "bk", "bv", "bo", "sinks"):
+            key, sub = jax.random.split(key)
+            lp[name] = jax.random.normal(sub, lp[name].shape, jnp.float32) * 0.3
+        for name in ("router_bias", "b_gate", "b_up", "b_down"):
+            key, sub = jax.random.split(key)
+            lp["moe"][name] = (
+                jax.random.normal(sub, lp["moe"][name].shape, jnp.float32)
+                * 0.3
+            )
+    save_params(spec, params, str(tmp_path))
+    spec2, params2 = load_model_dir(str(tmp_path))
+    assert spec2.attn_sinks and spec2.moe_bias
+    assert spec2.dtype == spec.dtype  # exported dtype round-trips
+    tokens = jnp.asarray(np.arange(9) % spec.vocab_size, jnp.int32)
+    want = llama.reference_forward(spec, params, tokens)
+    got = llama.reference_forward(spec2, params2, tokens)
     np.testing.assert_allclose(
         np.asarray(got), np.asarray(want), atol=1e-4, rtol=1e-4
     )
