@@ -403,9 +403,14 @@ class SpmdFollower:
             else:  # pragma: no cover - protocol drift guard
                 raise RuntimeError(f"unknown spmd op {op!r}")
             if trace:
+                # n_steps lets tests assert descriptor amortization (one
+                # frame covering N decode steps) without timing anything
+                extra = (
+                    f" n_steps={int(sc['n_steps'])}" if op == "decode" else ""
+                )
                 print(
                     f"SPMDTRACE apply={_time.perf_counter() - t_recv:.4f} "
-                    f"op={op}", flush=True,
+                    f"op={op}{extra}", flush=True,
                 )
             t_prev = _time.perf_counter()
 

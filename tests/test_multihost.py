@@ -14,9 +14,11 @@ Ref: the reference's multinode engine bootstrap
 
 import json
 import os
+import re
 import socket
 import subprocess
 import sys
+import threading
 import time
 import urllib.request
 
@@ -312,7 +314,9 @@ def _measure_itl(procs, hub_addr, n_tokens=48):
     return span / max(tokens, 1) * 1e3
 
 
-def _run_2proc_itl(burst: str) -> float:
+def _run_2proc_itl(burst: str) -> tuple[float, list[int]]:
+    """Returns (per-token ITL ms, n_steps of each decode descriptor frame
+    the follower replayed — from its SPMDTRACE output)."""
     worker_common = [
         "-m", "dynamo_tpu.engine.worker",
         "--model", "tiny-test", "--tp", "2",
@@ -321,6 +325,7 @@ def _run_2proc_itl(burst: str) -> float:
         "--decode-steps-per-dispatch", burst,
     ]
     procs: list[subprocess.Popen] = []
+    follower_lines: list[str] = []
     try:
         _hub, hub = _spawn(
             ["-m", "dynamo_tpu.runtime.hub_server", "--port", "0"],
@@ -333,14 +338,21 @@ def _run_2proc_itl(burst: str) -> float:
             [sys.executable, *worker_common, "--hub", hub, *mh,
              "--process-id", "1"],
             stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
-            cwd=REPO, env=_env(),
+            cwd=REPO, env=_env({"DYNAMO_SPMD_TRACE": "1"}),
         )
         procs.append(follower)
+        # drain the follower's stdout continuously: the trace lines would
+        # otherwise fill the 64 KB pipe buffer and wedge the replay loop
+        reader = threading.Thread(
+            target=lambda: follower_lines.extend(follower.stdout),
+            daemon=True,
+        )
+        reader.start()
         _spawn(
             [*worker_common, "--hub", hub, *mh, "--process-id", "0"],
             "ENGINE_READY", procs,
         )
-        return _measure_itl(procs, hub)
+        itl = _measure_itl(procs, hub)
     finally:
         for p in procs:
             p.terminate()
@@ -349,6 +361,13 @@ def _run_2proc_itl(burst: str) -> float:
                 p.wait(timeout=10)
             except subprocess.TimeoutExpired:
                 p.kill()
+    reader.join(timeout=10)
+    steps = [
+        int(m.group(1))
+        for line in follower_lines
+        if (m := re.search(r"op=decode n_steps=(\d+)", line))
+    ]
+    return itl, steps
 
 
 def test_two_process_dispatch_plane_not_per_step_bound():
@@ -356,15 +375,28 @@ def test_two_process_dispatch_plane_not_per_step_bound():
     per-step round-trip: a 4-step pipelined burst (ONE descriptor frame)
     must deliver per-token latency no worse than single-step dispatch
     (VERDICT r3 item 7: the old JSON-hub plane paid a hub RTT + base64
-    encode per step). On CPU the absolute 2-proc cost is dominated by
-    cross-process COLLECTIVE latency (~6.5 ms per TCP rendezvous,
-    measured independently) that real ICI does not have — the
-    per-token-vs-burst-size ratio is the transport property under test;
-    the < 20% single-vs-multi-process target is a hardware number."""
-    itl_b1 = _run_2proc_itl("1")
-    itl_b4 = _run_2proc_itl("4")
+    encode per step). The deterministic property under test is frame
+    AMORTIZATION, read from the follower's replay trace: at burst=4 the
+    leader ships multi-step descriptors, so decode frames per token drop
+    well below 1. Wall-clock is only a loose backstop — on CPU the
+    absolute 2-proc cost is dominated by cross-process COLLECTIVE
+    latency (~6.5 ms per TCP rendezvous, measured independently) that
+    real ICI does not have, and run-to-run noise makes a tight ITL
+    ratio flaky; the < 20% single-vs-multi-process target is a
+    hardware number."""
+    itl_b1, steps_b1 = _run_2proc_itl("1")
+    itl_b4, steps_b4 = _run_2proc_itl("4")
     print(f"2-proc per-token ITL: burst=1 {itl_b1:.2f}ms, "
-          f"burst=4 pipelined {itl_b4:.2f}ms")
-    # burst amortization must hold across the process boundary (noise
-    # margin; equality is the expected CPU outcome, improvement on ICI)
-    assert itl_b4 < itl_b1 * 1.3, (itl_b1, itl_b4)
+          f"burst=4 pipelined {itl_b4:.2f}ms; frames "
+          f"b1={len(steps_b1)} b4={len(steps_b4)}")
+    # burst=1 plane is strictly per-step
+    assert steps_b1 and all(s == 1 for s in steps_b1), steps_b1
+    # burst=4 plane amortizes: full 4-step frames flow, and on average
+    # each descriptor frame covers >= 2 decode steps (partial frames at
+    # admission/tail are expected, so not a flat all-4 assertion)
+    assert steps_b4 and max(steps_b4) == 4, steps_b4
+    assert len(steps_b4) / sum(steps_b4) <= 0.5, steps_b4
+    # loose wall-clock backstop: per-token cost must not blow up when
+    # steps ride one frame (would indicate per-step serialization
+    # sneaking back in); generous margin for CPU scheduler noise
+    assert itl_b4 < itl_b1 * 2.0, (itl_b1, itl_b4)
