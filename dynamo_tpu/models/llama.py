@@ -26,6 +26,7 @@ from dynamo_tpu.engine.config import ModelSpec
 from dynamo_tpu.ops.attention import (
     causal_attention,
     gather_pages,
+    page_tiles,
     paged_decode_attention_auto,
 )
 from dynamo_tpu.ops.pallas.kv_write import write_new_kv
@@ -145,8 +146,27 @@ def init_cache(
     ops/pallas/paged_attention_v3.py.) ``num_pages`` must already include
     the trash page (index 0).
     """
+    from dynamo_tpu.ops.attention import pool_head_dim
+
+    # The pool head dim may exceed spec.head_dim (pool_head_dim: zero-pad
+    # to the 128-lane tile so lane-misaligned heads like gpt-oss D=64
+    # keep the Mosaic DMA kernels). Writers pad rows, readers slice —
+    # exact for attention; see ops/attention.pool_head_dim.
     dtype = dtype or jnp.dtype(spec.dtype)
-    shape = (spec.num_layers, num_pages, spec.num_kv_heads, page_size, spec.head_dim)
+    pool_d = pool_head_dim(spec.head_dim)
+    shape = (spec.num_layers, num_pages, spec.num_kv_heads, page_size,
+             pool_d)
+    if pool_d != spec.head_dim:
+        import logging
+        import math
+
+        mib = 2 * math.prod(shape) * jnp.dtype(dtype).itemsize / 2**20
+        logging.getLogger(__name__).info(
+            "KV pool lane-padded for Mosaic DMA: head_dim %d -> %d "
+            "(%.0f MiB total, %.2fx the unpadded pool; DYNAMO_POOL_PAD=0 "
+            "to disable)", spec.head_dim, pool_d, mib,
+            pool_d / spec.head_dim,
+        )
     return jnp.zeros(shape, dtype), jnp.zeros(shape, dtype)
 
 
@@ -339,9 +359,8 @@ def prefill_forward_impl(
         page_starts < start_pos + num_tokens, pg_idx_raw, TRASH_PAGE
     )
 
-    def to_tiles(arr):  # [T, KH, D] -> [n_pg, KH, page, D]
-        kh, hd = arr.shape[1], arr.shape[2]
-        return arr.reshape(n_pg, page_size, kh, hd).transpose(0, 2, 1, 3)
+    def to_tiles(arr):  # [T, KH, D] -> [n_pg, KH, page, pool_d]
+        return page_tiles(arr, page_size, k_pages.shape[-1])
 
     x = params["embed"][tokens]  # [T, d]
     if mm_embeds is not None:
@@ -354,8 +373,9 @@ def prefill_forward_impl(
         q, k, v = _attn_qkv(spec, lp, h, positions)
         k_pages = k_pages.at[li, safe_pg].set(to_tiles(k))
         v_pages = v_pages.at[li, safe_pg].set(to_tiles(v))
-        k_ctx = gather_pages(k_pages[li], block_table)  # [max_ctx, kvh, D]
-        v_ctx = gather_pages(v_pages[li], block_table)
+        # [max_ctx, kvh, D] — sliced back to the model dim when padded
+        k_ctx = gather_pages(k_pages[li], block_table)[..., :spec.head_dim]
+        v_ctx = gather_pages(v_pages[li], block_table)[..., :spec.head_dim]
         attn = causal_attention(
             q, k_ctx, v_ctx, positions, kv_len,
             window=spec.attn_window(li), sinks=lp.get("sinks"),
@@ -426,9 +446,8 @@ def prefill_forward_batch_impl(
     valid_pg = page_starts < (start_pos + num_tokens)[:, None]
     safe_pg = jnp.where(valid_pg, pg_idx_raw, TRASH_PAGE).reshape(N * n_pg)
 
-    def to_tiles(arr):  # [N, T, KH, D] -> [N*n_pg, KH, page, D]
-        kh, hd = arr.shape[2], arr.shape[3]
-        return arr.reshape(N * n_pg, page_size, kh, hd).transpose(0, 2, 1, 3)
+    def to_tiles(arr):  # [N, T, KH, D] -> [N*n_pg, KH, page, pool_d]
+        return page_tiles(arr, page_size, k_pages.shape[-1])
 
     x = params["embed"][tokens]  # [N, T, d]
     kv_len = start_pos + num_tokens  # [N]
@@ -451,8 +470,8 @@ def prefill_forward_batch_impl(
 
         def one_attn(q_i, bt_i, pos_i, kvl_i, kp=k_pages, vp=v_pages, li=li,
                      lp=lp):
-            k_ctx = gather_pages(kp[li], bt_i)
-            v_ctx = gather_pages(vp[li], bt_i)
+            k_ctx = gather_pages(kp[li], bt_i)[..., :spec.head_dim]
+            v_ctx = gather_pages(vp[li], bt_i)[..., :spec.head_dim]
             return causal_attention(
                 q_i, k_ctx, v_ctx, pos_i, kvl_i,
                 window=spec.attn_window(li), sinks=lp.get("sinks"),
@@ -509,9 +528,8 @@ def prefill_forward_ring_impl(
     pg_idx_raw = block_table[page_starts // page_size]
     safe_pg = jnp.where(page_starts < num_tokens, pg_idx_raw, TRASH_PAGE)
 
-    def to_tiles(arr):  # [T, KH, D] -> [n_pg, KH, page, D]
-        kh, hd = arr.shape[1], arr.shape[2]
-        return arr.reshape(n_pg, page_size, kh, hd).transpose(0, 2, 1, 3)
+    def to_tiles(arr):  # [T, KH, D] -> [n_pg, KH, page, pool_d]
+        return page_tiles(arr, page_size, k_pages.shape[-1])
 
     sp_spec = NamedSharding(mesh, P("sp", None))
     x = params["embed"][tokens]

@@ -43,6 +43,54 @@ def lane_aligned(head_dim: int) -> bool:
     return head_dim % 128 == 0
 
 
+def pool_head_dim(head_dim: int) -> int:
+    """Head dim of the KV PAGE POOL for a model with ``head_dim`` heads.
+
+    On real TPUs, lane-misaligned heads (gpt-oss D=64) would be locked
+    out of the Mosaic DMA kernels (see lane_aligned). Zero-padding the
+    pool's last dim up to the 128-lane tile is mathematically EXACT for
+    attention — padded q.k dims contribute 0 to every score, padded V
+    columns are sliced off after the kernel — so the pool rounds up and
+    both kernels stay on the fast path, at the cost of pool memory
+    (2x for D=64). Writers pad rows to the pool width; readers slice
+    back to the model dim (models/llama.py, ops/pallas/kv_write.py,
+    paged_decode_attention_auto below).
+
+    ``DYNAMO_POOL_PAD`` overrides: 0 = never pad (fall back to XLA
+    gather paths), 1 = pad even off-TPU (lets CPU tests exercise the
+    padded layout end to end).
+    """
+    env = (os.environ.get("DYNAMO_POOL_PAD") or "").strip().lower()
+    if env in ("0", "false", "off", "no"):
+        return head_dim
+    force = env in ("1", "true", "on", "force")
+    if force or (use_pallas() and jax.default_backend() == "tpu"):
+        return -(-head_dim // 128) * 128
+    return head_dim
+
+
+def pad_heads(x: jax.Array, pool_dim: int) -> jax.Array:
+    """Zero-pad the last (head) dim of [..., D] rows up to the pool
+    width; identity when the pool is unpadded."""
+    d = x.shape[-1]
+    if d == pool_dim:
+        return x
+    pad = [(0, 0)] * (x.ndim - 1) + [(0, pool_dim - d)]
+    return jnp.pad(x, pad)
+
+
+def page_tiles(arr: jax.Array, page_size: int, pool_dim: int) -> jax.Array:
+    """Prefill KV rows -> page-major write tiles, zero-padded to the
+    pool width: [..., T, KH, D] -> [n_tiles, KH, page_size, pool_dim]
+    (leading dims fold into the tile count). The SINGLE tile builder for
+    every prefill pool writer (models/llama.py x3, parallel/pipeline.py)
+    so a lane-padded pool (pool_head_dim) can't be missed by one of
+    them."""
+    arr = pad_heads(arr, pool_dim)
+    kh, hd = arr.shape[-2], arr.shape[-1]
+    return arr.reshape(-1, page_size, kh, hd).transpose(0, 2, 1, 3)
+
+
 def repeat_kv(x: jax.Array, n_rep: int) -> jax.Array:
     """[.., S, kv_heads, D] -> [.., S, kv_heads*n_rep, D] (GQA expansion)."""
     if n_rep == 1:
@@ -114,12 +162,16 @@ def paged_decode_attention(
     *,
     window: int = 0,
     sinks: jax.Array | None = None,  # [H]
+    scale: float | None = None,  # softmax scale (default 1/sqrt(D))
 ) -> jax.Array:
     """Decode-step attention: each query attends to its full paged context.
 
     Pure-JAX reference: gathers [B, max_ctx, kv_heads, D] then masked
     attention. The Pallas kernel (ops/pallas/paged_attention_v3.py)
-    computes the same thing without materializing the gather.
+    computes the same thing without materializing the gather. ``scale``
+    overrides the 1/sqrt(q.shape[-1]) default — needed when q is
+    zero-padded to a wider pool head dim (pool_head_dim) and the true
+    model D differs from the padded width.
     """
     B, H, D = q.shape
     page_size = k_pages.shape[2]
@@ -133,7 +185,8 @@ def paged_decode_attention(
     k = repeat_kv(k, n_rep)  # [B, max_ctx, H, D]
     v = repeat_kv(v, n_rep)
 
-    scale = 1.0 / jnp.sqrt(jnp.asarray(D, jnp.float32))
+    if scale is None:
+        scale = 1.0 / jnp.sqrt(jnp.asarray(D, jnp.float32))
     logits = jnp.einsum(
         "bhd,bshd->bhs", q.astype(jnp.float32), k.astype(jnp.float32)
     ) * scale
@@ -165,6 +218,7 @@ def _decode_attention_tpu(
     *,
     window: int = 0,
     sinks: jax.Array | None = None,
+    scale: float | None = None,
 ) -> jax.Array:
     """Real-TPU decode attention: our v3 kernel (deep-pipelined windowed
     DMA + cross-program prefetch over the page-major pool — see
@@ -184,7 +238,8 @@ def _decode_attention_tpu(
         ppcb = 8
         while ppcb > 1 and P % ppcb:
             ppcb //= 2
-        scale = 1.0 / jnp.sqrt(jnp.asarray(q.shape[-1], jnp.float32))
+        if scale is None:
+            scale = 1.0 / jnp.sqrt(jnp.asarray(q.shape[-1], jnp.float32))
         q = (q.astype(jnp.float32) * scale).astype(q.dtype)
         return paged_attention(
             q,
@@ -202,11 +257,11 @@ def _decode_attention_tpu(
     if choice == "v3" or v3_supported(k_pages, block_tables):
         return paged_decode_attention_v3(
             q, k_pages, v_pages, block_tables, seq_lens,
-            window=window, sinks=sinks,
+            window=window, sinks=sinks, scale=scale,
         )
     return paged_decode_attention(
         q, k_pages, v_pages, block_tables, seq_lens,
-        window=window, sinks=sinks,
+        window=window, sinks=sinks, scale=scale,
     )
 
 
@@ -220,6 +275,7 @@ def paged_decode_attention_auto(
     *,
     window: int = 0,
     sinks: jax.Array | None = None,
+    _scale: float | None = None,  # internal: set by the pad recursion
 ) -> jax.Array:
     """Dispatch: Pallas kernel on TPU, pure-JAX gather elsewhere.
 
@@ -232,7 +288,22 @@ def paged_decode_attention_auto(
 
     DYNAMO_PALLAS=1 off-TPU runs the kernel in interpret mode (slow; lets
     the whole engine be driven through the kernel path on CPU).
+
+    When the pool head dim is wider than the model's (pool_head_dim
+    zero-padding for lane alignment), q is zero-padded to the pool
+    width — the padded dims multiply the pool's zero columns, so every
+    score is unchanged — the softmax scale is pinned to the TRUE model
+    dim, and the padded output columns are sliced off.
     """
+    D = q.shape[-1]
+    pool_d = k_pages.shape[-1]
+    if pool_d != D:
+        out = paged_decode_attention_auto(
+            pad_heads(q, pool_d), k_pages, v_pages, block_tables, seq_lens,
+            mesh, window=window, sinks=sinks, _scale=1.0 / float(D) ** 0.5,
+        )
+        return out[..., :D]
+    scale = _scale
     if use_pallas():
         from jax.sharding import PartitionSpec as P
 
@@ -242,11 +313,14 @@ def paged_decode_attention_auto(
 
         on_tpu = jax.default_backend() == "tpu"
         if on_tpu:
-            base = functools.partial(_decode_attention_tpu, window=window)
+            base = functools.partial(
+                _decode_attention_tpu, window=window, scale=scale
+            )
         else:
             # off-TPU (tests): our kernel in interpret mode
             base = functools.partial(
-                paged_decode_attention_v3, interpret=True, window=window
+                paged_decode_attention_v3, interpret=True, window=window,
+                scale=scale,
             )
         if sinks is not None:
             kernel = lambda q_, k_, v_, bt_, sl_, s_: base(  # noqa: E731
@@ -279,5 +353,5 @@ def paged_decode_attention_auto(
         return kernel(*args)
     return paged_decode_attention(
         q, k_pages, v_pages, block_tables, seq_lens,
-        window=window, sinks=sinks,
+        window=window, sinks=sinks, scale=scale,
     )

@@ -194,9 +194,16 @@ def write_new_kv(
     With a mesh the kernel runs under shard_map over "tp" (KV heads
     sharded, row indices replicated) — mirroring the attention dispatch in
     ops/attention.py; off-TPU the XLA scatter is both correct and fast
-    enough for tests.
+    enough for tests. A pool wider than the model head dim
+    (ops/attention.pool_head_dim zero-padding for lane alignment) gets
+    the new rows zero-padded to the pool width — which is also what
+    keeps this on the DMA-kernel path for e.g. D=64 models.
     """
-    from dynamo_tpu.ops.attention import lane_aligned, use_pallas
+    from dynamo_tpu.ops.attention import lane_aligned, pad_heads, use_pallas
+
+    if k_pages.shape[-1] != k_new.shape[-1]:
+        k_new = pad_heads(k_new, k_pages.shape[-1])
+        v_new = pad_heads(v_new, v_pages.shape[-1])
 
     if (
         lane_aligned(k_pages.shape[-1])

@@ -74,7 +74,7 @@ def _decode_kernel_v3(
     q_ref,  # [1, KH, G, D] VMEM (this sequence's query heads, pre-scaled)
     k_pages_ref,  # [num_pages, KH, page, D] ANY/HBM
     v_pages_ref,
-    *rest,  # [sinks_ref [KH, G] VMEM when has_sinks,] o_ref, kv_buf, sems
+    *rest,  # [sinks_ref [KH*G, 1] f32 VMEM when has_sinks,] o_ref, kv_buf, sems
     page_size: int,
     pages_per_seq: int,
     window_pages: int,
@@ -219,7 +219,7 @@ def _decode_kernel_v3(
         # merge the per-head sink logit as one more flash chunk: a virtual
         # key with value 0 — contributes exp(sink) to the denominator only
         # (HF gpt-oss eager_attention_forward concat-then-drop semantics)
-        sink = sinks_ref[...].reshape(KH * G, 1).astype(jnp.float32)
+        sink = sinks_ref[...]  # [KH*G, 1] f32, pre-shaped by the host
         m_f = jnp.maximum(m, sink)
         l = l * jnp.exp(m - m_f) + jnp.exp(sink - m_f)
         acc = acc * jnp.exp(m - m_f)
@@ -249,6 +249,9 @@ def paged_decode_attention_v3(
     window: int = 0,  # sliding window tokens (0 = full attention)
     sinks: jax.Array | None = None,  # [H] learned sink logits
     interpret: bool = False,
+    scale: float | None = None,  # softmax scale; default 1/sqrt(D). The
+    # caller overrides when q/pool are zero-padded past the true model
+    # dim (ops/attention.pool_head_dim) so scores keep the real 1/sqrt(D)
 ) -> jax.Array:
     """Decode attention over the page-major paged cache."""
     B, H, D = q.shape
@@ -256,7 +259,8 @@ def paged_decode_attention_v3(
     G = H // KH
     P = block_tables.shape[1]
     Pw = _window_pages(KH, page_size, D, k_pages.dtype.itemsize, P)
-    scale = 1.0 / jnp.sqrt(jnp.asarray(D, jnp.float32))
+    if scale is None:
+        scale = 1.0 / jnp.sqrt(jnp.asarray(D, jnp.float32))
     q4 = (q.reshape(B, KH, G, D).astype(jnp.float32) * scale).astype(q.dtype)
     has_sinks = sinks is not None
 
@@ -279,12 +283,16 @@ def paged_decode_attention_v3(
     inputs = [block_tables.astype(jnp.int32), seq_lens.astype(jnp.int32),
               q4, k_pages, v_pages]
     if has_sinks:
+        # already the [KH*G, 1] f32 column the flash merge consumes: an
+        # IN-kernel (KH, G) -> (KH*G, 1) reshape is a vector layout cast
+        # Mosaic cannot lower ("unsupported shape cast" at e.g. 4x4 ->
+        # 16x1), so the host does it
         in_specs.append(
             pl.BlockSpec(
-                (KH, G), lambda b, *_: (0, 0), memory_space=pltpu.VMEM
+                (KH * G, 1), lambda b, *_: (0, 0), memory_space=pltpu.VMEM
             )
         )
-        inputs.append(sinks.reshape(KH, G))
+        inputs.append(sinks.astype(jnp.float32).reshape(KH * G, 1))
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
         grid=(B,),

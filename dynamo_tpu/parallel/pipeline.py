@@ -51,6 +51,7 @@ from dynamo_tpu.engine.config import ModelSpec
 from dynamo_tpu.models.llama import TRASH_PAGE, rms_norm, rope
 from dynamo_tpu.ops.attention import (
     causal_attention,
+    page_tiles,
     paged_decode_attention_auto,
 )
 from dynamo_tpu.ops.pallas.kv_write import write_new_kv
@@ -183,11 +184,9 @@ def _stage_prefill(
     models/llama.py prefill_forward_impl."""
     T = x.shape[0]
     hd = spec.head_dim
-    n_pg = T // page_size
 
-    def to_tiles(arr):
-        kh = arr.shape[1]
-        return arr.reshape(n_pg, page_size, kh, hd).transpose(0, 2, 1, 3)
+    def to_tiles(arr):  # pads to the pool width when lane-padded
+        return page_tiles(arr, page_size, k_pages.shape[-1])
 
     for i in range(n_local):
         h = rms_norm(x, lp["attn_norm"][i], spec.rms_eps)

@@ -196,3 +196,80 @@ def test_sinks_shard_map_tp_dispatch(monkeypatch):
     np.testing.assert_allclose(
         np.asarray(got), np.asarray(ref), rtol=2e-5, atol=2e-5
     )
+
+
+def test_padded_pool_matches_unpadded():
+    """Lane-padded pool (ops/attention.pool_head_dim): a D=64 model whose
+    pool is zero-padded to 128 must produce EXACTLY the unpadded result —
+    padded q.k dims contribute zero to every score, the softmax scale is
+    pinned to the true model dim (1/sqrt(64), NOT 1/sqrt(128)), and the
+    padded output columns slice off. Covers the XLA fallback path, the
+    interpreted kernel path, and window+sinks together (the gpt-oss
+    D=64 shape this padding exists for)."""
+    from dynamo_tpu.ops.attention import (
+        pad_heads,
+        paged_decode_attention_auto,
+    )
+
+    rng = np.random.default_rng(31)
+    q, k, v, bt, lens = _setup(D=64, seed=31)
+    sinks = jnp.asarray(rng.standard_normal((q.shape[1],)), jnp.float32)
+    kp, vp = pad_heads(k, 128), pad_heads(v, 128)
+    assert kp.shape[-1] == 128 and q.shape[-1] == 64
+
+    for kwargs in ({}, {"window": 8, "sinks": sinks}):
+        ref = paged_decode_attention_auto(q, k, v, bt, lens, **kwargs)
+        got = paged_decode_attention_auto(q, kp, vp, bt, lens, **kwargs)
+        assert got.shape == ref.shape
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(ref), rtol=2e-5, atol=2e-5,
+            err_msg=f"XLA path {kwargs.keys()}",
+        )
+
+
+def test_padded_pool_matches_unpadded_kernel(monkeypatch):
+    """Same padded-vs-unpadded equivalence through the v3 kernel
+    (interpret mode): the scale override must reach the kernel's q
+    pre-scaling."""
+    from dynamo_tpu.ops.attention import (
+        pad_heads,
+        paged_decode_attention_auto,
+    )
+
+    monkeypatch.setenv("DYNAMO_PALLAS", "1")
+    q, k, v, bt, lens = _setup(D=64, seed=37)
+    ref = paged_decode_attention(q, k, v, bt, lens)
+    got = paged_decode_attention_auto(
+        q, pad_heads(k, 128), pad_heads(v, 128), bt, lens
+    )
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(ref), rtol=2e-5, atol=2e-5
+    )
+
+
+def test_padded_pool_kv_write_round_trip():
+    """write_new_kv into a lane-padded pool: rows land zero-padded, and a
+    full decode step through the padded pool equals the unpadded one."""
+    from dynamo_tpu.ops.attention import pad_heads
+    from dynamo_tpu.ops.pallas.kv_write import write_new_kv
+
+    rng = np.random.default_rng(41)
+    q, k, v, bt, lens = _setup(D=64, seed=41)
+    B, KH = q.shape[0], k.shape[1]
+    k_new = jnp.asarray(rng.standard_normal((B, KH, 64)), jnp.float32)
+    v_new = jnp.asarray(rng.standard_normal((B, KH, 64)), jnp.float32)
+    dst_page = bt[:, 0]
+    dst_off = jnp.zeros((B,), jnp.int32)
+
+    k1, v1 = write_new_kv(
+        k[None], v[None], k_new, v_new, dst_page, dst_off, layer=0
+    )
+    kp, vp = write_new_kv(
+        pad_heads(k, 128)[None], pad_heads(v, 128)[None],
+        k_new, v_new, dst_page, dst_off, layer=0,
+    )
+    np.testing.assert_array_equal(np.asarray(kp[0][..., :64]),
+                                  np.asarray(k1[0]))
+    np.testing.assert_array_equal(np.asarray(kp[0][..., 64:]), 0.0)
+    np.testing.assert_array_equal(np.asarray(vp[0][..., :64]),
+                                  np.asarray(v1[0]))
