@@ -45,6 +45,24 @@ async def check_hub(addr: str, out: list) -> dict:
             "model cards", bool(cards),
             ", ".join(str(m) for m in models) or "none",
         ))
+        # operator status subresource (written each reconcile pass).
+        # Always PASS: "ready" intentionally lags one reconcile behind a
+        # scale (it is the observed state that pass converged FROM), so
+        # gating the exit code on it would flake right after scale-ups —
+        # the row surfaces convergence state without failing the check
+        statuses = await hub.get_prefix("v1/dgd-status/")
+        for key, st in sorted(statuses.items()):
+            if not isinstance(st, dict):
+                continue
+            name = key.rsplit("/", 1)[-1]
+            per = st.get("services") or {}
+            detail = ", ".join(
+                f"{s} {v.get('ready', '?')}/{v.get('desired', '?')}"
+                for s, v in sorted(per.items())
+            ) or "no services"
+            if not st.get("ready"):
+                detail += " (converging)"
+            out.append((f"graph {name}", True, detail))
         return {"instances": instances, "models": models}
     except Exception as e:  # noqa: BLE001
         out.append(("hub state", False, str(e)))

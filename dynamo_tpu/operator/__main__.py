@@ -20,7 +20,10 @@ from dynamo_tpu.runtime.logging_util import setup_logging
 async def _amain(args: argparse.Namespace) -> None:
     hub = await connect_hub(args.hub)
     backend = (
-        make_backend("kubectl", namespace=args.k8s_namespace)
+        make_backend(
+            "kubectl", namespace=args.k8s_namespace, image=args.k8s_image,
+            hub=args.hub, graph=args.name,
+        )
         if args.backend == "kubectl"
         else make_backend("process")
     )
@@ -43,6 +46,10 @@ def main(argv=None) -> int:
     p.add_argument("--backend", default="process",
                    choices=("process", "kubectl"))
     p.add_argument("--k8s-namespace", default="default")
+    p.add_argument("--k8s-image", default="",
+                   help="container image for MANAGED mode: the operator "
+                   "renders+applies full Deployment/Service objects; "
+                   "empty = scale-only (Deployments created externally)")
     p.add_argument("--interval", type=float, default=1.0)
     args = p.parse_args(argv)
     setup_logging()

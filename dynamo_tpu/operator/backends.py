@@ -2,9 +2,11 @@
 
 ProcessBackend supervises OS processes on this host (the test/CI and
 single-host production path; the reference's operator manages pods the
-same level-triggered way). KubectlBackend shells out to ``kubectl
-scale`` for cluster deployments — the thin path until a full
-client-go-equivalent is warranted.
+same level-triggered way). KubectlBackend drives a cluster through
+``kubectl``: in managed mode it renders and ``apply``s the full
+Deployment/Service objects from the graph resource (the reference
+controller's behavior); without an image it degrades to replica
+patching of externally-created Deployments.
 """
 
 from __future__ import annotations
@@ -82,16 +84,32 @@ class ProcessBackend:
 
 
 class KubectlBackend:
-    """Scale Kubernetes deployments named ``dynamo-{service}``.
+    """Converge Kubernetes Deployments named ``dynamo-{service}``.
 
     The cluster-side half of the reference's operator reconciliation
-    (controllers patching component Deployments); manifests under
-    deploy/k8s/ create the Deployments this scales."""
+    (controllers owning component Deployments/Services, ref
+    deploy/cloud/operator/internal/controller/). Two modes:
+
+    - **managed** (``image`` set): render the full Deployment (+Service
+      when the spec has a port) from the ServiceSpec
+      (operator/manifests.py) and ``kubectl apply`` it — one idempotent
+      verb for create, command/env/image rolling updates, AND scaling,
+      exactly how the reference controller drives the apiserver. A
+      service removed from the graph is ``kubectl delete``d (delete()).
+    - **scale-only** (no ``image``): only patch replicas of Deployments
+      someone else created (manifests under deploy/k8s/).
+    """
 
     def __init__(self, namespace: str = "default",
-                 name_format: str = "dynamo-{service}"):
+                 name_format: str = "dynamo-{service}",
+                 image: str = "", hub: str = "", graph: str = "dynamo",
+                 python: str = "python"):
         self.namespace = namespace
         self.name_format = name_format
+        self.image = image
+        self.hub = hub
+        self.graph = graph
+        self.python = python
 
     def running(self, service: str) -> int:
         out = subprocess.run(
@@ -106,12 +124,72 @@ class KubectlBackend:
             return 0
 
     async def scale(self, spec: ServiceSpec, replicas: int) -> None:
+        if self.image:
+            import json
+
+            from dynamo_tpu.operator.manifests import render_bundle
+
+            bundle = render_bundle(
+                spec, replicas, graph=self.graph, namespace=self.namespace,
+                image=self.image, hub=self.hub,
+                name_format=self.name_format, python=self.python,
+            )
+            subprocess.run(
+                ["kubectl", "-n", self.namespace, "apply", "-f", "-"],
+                input=json.dumps(bundle), text=True, check=False,
+            )
+            if not spec.port:
+                # apply doesn't prune: a Service left over from when the
+                # spec HAD a port must go explicitly
+                subprocess.run(
+                    ["kubectl", "-n", self.namespace, "delete", "service",
+                     self.name_format.format(service=spec.name),
+                     "--ignore-not-found"],
+                    check=False,
+                )
+            return
         subprocess.run(
             ["kubectl", "-n", self.namespace, "scale", "deployment",
              self.name_format.format(service=spec.name),
              f"--replicas={replicas}"],
             check=False,
         )
+
+    async def delete(self, spec: ServiceSpec) -> None:
+        """Remove a service's objects (it left the graph resource).
+        The Service is deleted unconditionally (--ignore-not-found):
+        the current spec's port says nothing about whether an EARLIER
+        revision created one."""
+        name = self.name_format.format(service=spec.name)
+        for kind in ("deployment", "service"):
+            subprocess.run(
+                ["kubectl", "-n", self.namespace, "delete", kind, name,
+                 "--ignore-not-found"],
+                check=False,
+            )
+
+    async def prune(self, current_services: set[str]) -> None:
+        """Delete graph-labeled objects whose service left the resource
+        while the operator was down — the in-memory last-seen diff in
+        the reconciler can't see those; the GRAPH_LABEL stamped on every
+        managed object makes them findable. Managed mode only."""
+        if not self.image:
+            return
+        from dynamo_tpu.operator.manifests import GRAPH_LABEL, SERVICE_LABEL
+
+        out = subprocess.run(
+            ["kubectl", "-n", self.namespace, "get", "deployments",
+             "-l", f"{GRAPH_LABEL}={self.graph}",
+             "-o", f"jsonpath={{range .items[*]}}"
+             f"{{.metadata.labels.{SERVICE_LABEL}}}{{\"\\n\"}}{{end}}"],
+            capture_output=True, text=True,
+        )
+        for svc_name in out.stdout.split():
+            if svc_name and svc_name not in current_services:
+                log.info("operator: pruning orphaned service %r", svc_name)
+                await self.delete(ServiceSpec(
+                    name=svc_name, replicas=0, command=[]
+                ))
 
     async def close(self) -> None:  # deployments outlive the operator
         return None

@@ -18,7 +18,12 @@ from __future__ import annotations
 import asyncio
 import logging
 
-from dynamo_tpu.operator.graph import DGD_KEY, DynamoGraphDeployment
+from dynamo_tpu.operator.graph import (
+    DGD_KEY,
+    DGD_STATUS_KEY,
+    DynamoGraphDeployment,
+    ServiceSpec,
+)
 from dynamo_tpu.planner.connector import read_desired_replicas
 
 log = logging.getLogger("dynamo.operator")
@@ -43,6 +48,14 @@ class Reconciler:
         self._watch_task: asyncio.Task | None = None
         self._wake = asyncio.Event()
         self.reconciles = 0
+        # services seen last pass: a service dropped from the resource
+        # must be torn down (backend.delete when it manages the objects,
+        # else scale-to-zero). In-memory diffing misses edits made while
+        # the operator was down — backend.prune (label-selected sweep)
+        # covers those; together they are the reference controller's
+        # owner-reference GC equivalent
+        self._last_services: dict[str, ServiceSpec] = {}
+        self._last_revision: int | None = None
 
     async def start(self) -> "Reconciler":
         loop = asyncio.get_running_loop()
@@ -64,7 +77,31 @@ class Reconciler:
     async def reconcile_once(self) -> DynamoGraphDeployment | None:
         dgd = await DynamoGraphDeployment.get(self.hub, self.name)
         if dgd is None:
+            # resource deleted: tear down everything it owned and drop
+            # the status key (else dynamo_check reports a ghost graph)
+            if self._last_services:
+                log.info("reconcile %s: resource deleted; tearing down",
+                         self.name)
+                for old in self._last_services.values():
+                    if hasattr(self.backend, "delete"):
+                        await self.backend.delete(old)
+                    else:
+                        await self.backend.scale(old, 0)
+                self._last_services = {}
+                self._last_revision = None
+                try:
+                    await self.hub.delete(
+                        DGD_STATUS_KEY.format(name=self.name)
+                    )
+                except Exception:  # noqa: BLE001
+                    log.warning("dgd status delete failed", exc_info=True)
             return None
+        # a revision bump means the SPEC may have changed (command, env,
+        # port), not just counts — managed backends must re-apply even
+        # at matching replica counts for the rolling update to happen.
+        # Also true on the first pass after (re)start: converge from
+        # whatever state the cluster was left in.
+        spec_changed = dgd.revision != self._last_revision
         desired_override = None
         if self.apply_planner_desired:
             try:
@@ -74,6 +111,7 @@ class Reconciler:
             except Exception:  # noqa: BLE001
                 log.warning("planner desired-replica read failed",
                             exc_info=True)
+        status: dict[str, dict[str, int]] = {}
         for svc in dgd.services:
             replicas = svc.replicas
             if desired_override is not None and svc.role in (
@@ -81,12 +119,46 @@ class Reconciler:
             ):
                 replicas = getattr(desired_override, svc.role)
             have = self.backend.running(svc.name)
-            if have != replicas:
-                log.info(
-                    "reconcile %s/%s: %d -> %d replicas",
-                    self.name, svc.name, have, replicas,
-                )
+            if have != replicas or spec_changed:
+                if have != replicas:
+                    log.info(
+                        "reconcile %s/%s: %d -> %d replicas",
+                        self.name, svc.name, have, replicas,
+                    )
                 await self.backend.scale(svc, replicas)
+            status[svc.name] = {"desired": replicas, "ready": have}
+        # tear down services that left the resource
+        current = {svc.name for svc in dgd.services}
+        for name, old in self._last_services.items():
+            if name in current:
+                continue
+            log.info("reconcile %s/%s: removed from graph", self.name, name)
+            if hasattr(self.backend, "delete"):
+                await self.backend.delete(old)
+            else:
+                await self.backend.scale(old, 0)
+        # durable sweep: objects labeled for this graph but absent from
+        # the resource (edits made while the operator was down)
+        if spec_changed and hasattr(self.backend, "prune"):
+            await self.backend.prune(current)
+        self._last_services = {svc.name: svc for svc in dgd.services}
+        self._last_revision = dgd.revision
+        # status subresource equivalent: observed counts for dynamo_check
+        # and dashboards ("ready" lags one pass after a scale by design —
+        # it is the OBSERVED state this pass converged from)
+        try:
+            await self.hub.put(
+                DGD_STATUS_KEY.format(name=self.name),
+                {
+                    "revision": dgd.revision,
+                    "services": status,
+                    "ready": all(
+                        s["ready"] == s["desired"] for s in status.values()
+                    ),
+                },
+            )
+        except Exception:  # noqa: BLE001 - status is best-effort
+            log.warning("dgd status write failed", exc_info=True)
         self.reconciles += 1
         return dgd
 

@@ -15,6 +15,9 @@ from dataclasses import asdict, dataclass, field
 from typing import Any
 
 DGD_KEY = "v1/dgd/{name}"
+# status write-back (the CRD status subresource equivalent): the
+# reconciler publishes per-service desired/ready counts here each pass
+DGD_STATUS_KEY = "v1/dgd-status/{name}"
 
 
 @dataclass
@@ -27,6 +30,10 @@ class ServiceSpec:
     # planner wiring: "prefill"/"decode" services accept replica
     # overrides from the planner's desired-replicas key
     role: str = ""  # "", "prefill", "decode"
+    # k8s rendering (operator/manifests.py): a port gets a containerPort
+    # + ClusterIP Service; env vars are injected into the container
+    port: int = 0
+    env: dict[str, str] = field(default_factory=dict)
 
 
 @dataclass

@@ -1,0 +1,131 @@
+"""Kubernetes manifest rendering for managed graph deployments.
+
+The reference operator's controllers OWN the component Deployments and
+Services — they render them from the DynamoGraphDeployment resource and
+let the apiserver perform rolling updates when the pod template changes
+(ref deploy/cloud/operator/internal/controller/
+dynamocomponentdeployment_controller.go: generateDeployment/
+generateService). This module is that rendering step as pure functions:
+ServiceSpec -> Deployment (+ Service) dicts, consumed by KubectlBackend
+via ``kubectl apply -f -``. The objects are emitted as JSON — valid
+YAML, so no extra dependency — and ``apply`` makes create, update, and
+scale the same idempotent verb.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+from dynamo_tpu.operator.graph import ServiceSpec
+
+GRAPH_LABEL = "dynamo-graph"
+SERVICE_LABEL = "dynamo-service"
+
+
+def deployment_name(svc_name: str, name_format: str = "dynamo-{service}") -> str:
+    return name_format.format(service=svc_name)
+
+
+def deployment_manifest(
+    svc: ServiceSpec,
+    replicas: int,
+    *,
+    graph: str,
+    namespace: str,
+    image: str,
+    hub: str,
+    name_format: str = "dynamo-{service}",
+    python: str = "python",
+) -> dict[str, Any]:
+    """Render the Deployment that runs ``replicas`` copies of a service.
+
+    The container command mirrors ProcessBackend's spawn line
+    (``python *spec.command``); DYNAMO_HUB carries the coordination
+    address the way the reference injects etcd/NATS endpoints into its
+    component pods.
+    """
+    name = deployment_name(svc.name, name_format)
+    labels = {
+        "app": name,
+        GRAPH_LABEL: graph,
+        SERVICE_LABEL: svc.name,
+    }
+    if svc.role:
+        labels["dynamo-role"] = svc.role
+    env = [{"name": "DYNAMO_HUB", "value": hub}]
+    env += [{"name": k, "value": v} for k, v in sorted(svc.env.items())]
+    container: dict[str, Any] = {
+        "name": "worker",
+        "image": image,
+        "command": [python, *svc.command],
+        "env": env,
+    }
+    if svc.port:
+        container["ports"] = [{"containerPort": svc.port}]
+    return {
+        "apiVersion": "apps/v1",
+        "kind": "Deployment",
+        "metadata": {"name": name, "namespace": namespace, "labels": labels},
+        "spec": {
+            "replicas": replicas,
+            "selector": {"matchLabels": {"app": name}},
+            "template": {
+                "metadata": {"labels": dict(labels)},
+                "spec": {"containers": [container]},
+            },
+        },
+    }
+
+
+def service_manifest(
+    svc: ServiceSpec,
+    *,
+    graph: str,
+    namespace: str,
+    name_format: str = "dynamo-{service}",
+) -> dict[str, Any]:
+    """ClusterIP Service in front of a port-bearing component (the
+    frontend, typically). Only rendered when ``svc.port`` is set."""
+    name = deployment_name(svc.name, name_format)
+    return {
+        "apiVersion": "v1",
+        "kind": "Service",
+        "metadata": {
+            "name": name,
+            "namespace": namespace,
+            "labels": {GRAPH_LABEL: graph, SERVICE_LABEL: svc.name},
+        },
+        "spec": {
+            "selector": {"app": name},
+            "ports": [{"port": svc.port, "targetPort": svc.port}],
+        },
+    }
+
+
+def render_bundle(
+    svc: ServiceSpec,
+    replicas: int,
+    *,
+    graph: str,
+    namespace: str,
+    image: str,
+    hub: str,
+    name_format: str = "dynamo-{service}",
+    python: str = "python",
+) -> dict[str, Any]:
+    """Everything one service needs, as a single ``v1 List`` document
+    (what ``kubectl apply -f -`` consumes in one pass)."""
+    items: list[dict[str, Any]] = [
+        deployment_manifest(
+            svc, replicas, graph=graph, namespace=namespace, image=image,
+            hub=hub, name_format=name_format, python=python,
+        )
+    ]
+    if svc.port:
+        items.append(
+            service_manifest(
+                svc, graph=graph, namespace=namespace,
+                name_format=name_format,
+            )
+        )
+    return {"apiVersion": "v1", "kind": "List", "items": items}

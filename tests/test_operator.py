@@ -215,3 +215,252 @@ def test_kubectl_backend_issues_scale_commands(tmp_path, monkeypatch):
         for c in calls
     ), calls
     assert any("-n prod" in c for c in calls)
+
+
+def test_manifest_render():
+    """ServiceSpec -> Deployment/Service rendering (the managed-mode
+    objects kubectl applies): command mirrors ProcessBackend's spawn
+    line, DYNAMO_HUB + per-service env are injected, a port yields a
+    containerPort and a ClusterIP Service, labels tie objects to the
+    graph."""
+    from dynamo_tpu.operator.manifests import render_bundle
+
+    svc = ServiceSpec(
+        name="frontend", replicas=1, command=["-m", "dynamo_tpu.frontend"],
+        port=8000, env={"DYN_LOG": "info"},
+    )
+    bundle = render_bundle(
+        svc, 3, graph="g1", namespace="prod", image="dynamo:v1",
+        hub="hub:9000",
+    )
+    assert bundle["kind"] == "List" and len(bundle["items"]) == 2
+    dep, ksvc = bundle["items"]
+    assert dep["kind"] == "Deployment"
+    assert dep["metadata"]["name"] == "dynamo-frontend"
+    assert dep["metadata"]["namespace"] == "prod"
+    assert dep["metadata"]["labels"]["dynamo-graph"] == "g1"
+    assert dep["spec"]["replicas"] == 3
+    c = dep["spec"]["template"]["spec"]["containers"][0]
+    assert c["image"] == "dynamo:v1"
+    assert c["command"] == ["python", "-m", "dynamo_tpu.frontend"]
+    assert {"name": "DYNAMO_HUB", "value": "hub:9000"} in c["env"]
+    assert {"name": "DYN_LOG", "value": "info"} in c["env"]
+    assert c["ports"] == [{"containerPort": 8000}]
+    assert ksvc["kind"] == "Service"
+    assert ksvc["spec"]["selector"] == {"app": "dynamo-frontend"}
+    assert ksvc["spec"]["ports"] == [{"port": 8000, "targetPort": 8000}]
+
+    # portless service: Deployment only, no ports key
+    worker = ServiceSpec(name="decode", replicas=1, command=["-m", "w"])
+    bundle = render_bundle(
+        worker, 2, graph="g1", namespace="prod", image="dynamo:v1",
+        hub="hub:9000",
+    )
+    assert len(bundle["items"]) == 1
+    assert "ports" not in bundle["items"][0]["spec"]["template"]["spec"][
+        "containers"][0]
+
+
+def test_kubectl_backend_managed_apply_and_delete(tmp_path, monkeypatch):
+    """Managed mode (image set): scale() renders the bundle and pipes it
+    to ``kubectl apply -f -`` (create/update/scale in one idempotent
+    verb); delete() removes the Deployment and, for port-bearing
+    services, the Service."""
+    import json
+
+    from dynamo_tpu.operator.backends import KubectlBackend
+
+    stub = tmp_path / "kubectl"
+    logf = tmp_path / "calls.log"
+    stdinf = tmp_path / "stdin.json"
+    stub.write_text(
+        "#!/bin/sh\n"
+        f'printf \'%s \' "$@" >> "{logf}"; printf \'\\n\' >> "{logf}"\n'
+        'case "$*" in\n'
+        f'  *apply*) cat > "{stdinf}" ;;\n'
+        "esac\n"
+    )
+    stub.chmod(0o755)
+    monkeypatch.setenv("PATH", f"{tmp_path}:{os.environ.get('PATH', '')}")
+
+    be = KubectlBackend(namespace="prod", image="dynamo:v1",
+                        hub="hub:9000", graph="g1")
+    svc = ServiceSpec(name="frontend", replicas=1,
+                      command=["-m", "dynamo_tpu.frontend"], port=8000)
+    asyncio.run(be.scale(svc, 4))
+    calls = logf.read_text().splitlines()
+    assert any("apply -f -" in c and "-n prod" in c for c in calls), calls
+    bundle = json.loads(stdinf.read_text())
+    assert bundle["items"][0]["spec"]["replicas"] == 4
+    assert [i["kind"] for i in bundle["items"]] == ["Deployment", "Service"]
+
+    asyncio.run(be.delete(svc))
+    calls = logf.read_text().splitlines()
+    assert any("delete deployment dynamo-frontend" in c for c in calls)
+    assert any("delete service dynamo-frontend" in c for c in calls)
+
+
+def test_reconciler_drops_removed_service_and_publishes_status():
+    """A service removed from the graph resource is torn down
+    (backend.delete), and every pass publishes the status subresource
+    equivalent (v1/dgd-status/{name}: per-service desired/ready)."""
+    from dynamo_tpu.operator.graph import DGD_STATUS_KEY
+    from dynamo_tpu.runtime.hub import InMemoryHub
+
+    class FakeBackend:
+        def __init__(self):
+            self.scaled: list[tuple[str, int]] = []
+            self.deleted: list[str] = []
+            self.live: dict[str, int] = {}
+
+        def running(self, service):
+            return self.live.get(service, 0)
+
+        async def scale(self, spec, replicas):
+            self.scaled.append((spec.name, replicas))
+            self.live[spec.name] = replicas
+
+        async def delete(self, spec):
+            self.deleted.append(spec.name)
+            self.live.pop(spec.name, None)
+
+        async def close(self):
+            pass
+
+    async def main():
+        hub = InMemoryHub()
+        be = FakeBackend()
+        dgd = DynamoGraphDeployment(
+            name="g2",
+            services=[
+                ServiceSpec(name="prefill", replicas=2, command=["-m", "p"]),
+                ServiceSpec(name="decode", replicas=1, command=["-m", "d"]),
+            ],
+        )
+        await dgd.apply(hub)
+        rec = Reconciler(hub, "g2", be, apply_planner_desired=False)
+        await rec.reconcile_once()
+        assert ("prefill", 2) in be.scaled and ("decode", 1) in be.scaled
+
+        status = await hub.get(DGD_STATUS_KEY.format(name="g2"))
+        assert status["services"]["prefill"] == {"desired": 2, "ready": 0}
+        assert status["ready"] is False  # observed lags the scale-up
+
+        # converged pass: ready reflects live counts
+        await rec.reconcile_once()
+        status = await hub.get(DGD_STATUS_KEY.format(name="g2"))
+        assert status["services"]["prefill"] == {"desired": 2, "ready": 2}
+        assert status["ready"] is True
+
+        # drop the prefill service from the resource -> torn down
+        dgd.services = [s for s in dgd.services if s.name == "decode"]
+        await dgd.apply(hub)
+        await rec.reconcile_once()
+        assert be.deleted == ["prefill"]
+        status = await hub.get(DGD_STATUS_KEY.format(name="g2"))
+        assert "prefill" not in status["services"]
+
+    asyncio.run(main())
+
+
+def test_reconciler_rolls_out_spec_changes_and_resource_deletion():
+    """A revision bump re-applies every service even at matching replica
+    counts (command/env edits must roll out, not just replica drift);
+    deleting the resource tears everything down and removes the status
+    key."""
+    from dynamo_tpu.operator.graph import DGD_KEY, DGD_STATUS_KEY
+    from dynamo_tpu.runtime.hub import InMemoryHub
+
+    class FakeBackend:
+        def __init__(self):
+            self.scales: list[tuple[str, int]] = []
+            self.deleted: list[str] = []
+            self.live: dict[str, int] = {}
+
+        def running(self, service):
+            return self.live.get(service, 0)
+
+        async def scale(self, spec, replicas):
+            self.scales.append((spec.name, replicas))
+            self.live[spec.name] = replicas
+
+        async def delete(self, spec):
+            self.deleted.append(spec.name)
+            self.live.pop(spec.name, None)
+
+        async def close(self):
+            pass
+
+    async def main():
+        hub = InMemoryHub()
+        be = FakeBackend()
+        dgd = DynamoGraphDeployment(
+            name="g3",
+            services=[ServiceSpec(name="decode", replicas=2,
+                                  command=["-m", "d"])],
+        )
+        await dgd.apply(hub)
+        rec = Reconciler(hub, "g3", be, apply_planner_desired=False)
+        await rec.reconcile_once()
+        await rec.reconcile_once()  # converged, same revision
+        n_converged = len(be.scales)
+
+        # env edit, same replica count -> revision bump -> re-apply
+        dgd.services[0].env = {"NEW": "1"}
+        await dgd.apply(hub)
+        await rec.reconcile_once()
+        assert len(be.scales) == n_converged + 1, be.scales
+        await rec.reconcile_once()  # no new revision -> no re-apply
+        assert len(be.scales) == n_converged + 1
+
+        # resource deletion -> teardown + status key removal
+        await hub.delete(DGD_KEY.format(name="g3"))
+        await rec.reconcile_once()
+        assert be.deleted == ["decode"]
+        assert await hub.get(DGD_STATUS_KEY.format(name="g3")) is None
+
+    asyncio.run(main())
+
+
+def test_kubectl_backend_prunes_orphans_and_stray_service(
+    tmp_path, monkeypatch
+):
+    """prune() deletes graph-labeled Deployments whose service left the
+    resource while the operator was down; a managed apply for a portless
+    spec removes the Service an earlier port-bearing revision created."""
+    from dynamo_tpu.operator.backends import KubectlBackend
+
+    stub = tmp_path / "kubectl"
+    logf = tmp_path / "calls.log"
+    stub.write_text(
+        "#!/bin/sh\n"
+        f'printf \'%s \' "$@" >> "{logf}"; printf \'\\n\' >> "{logf}"\n'
+        'case "$*" in\n'
+        # label-listed deployments: one live service, one orphan
+        "  *get*deployments*-l*) printf 'decode\\nold-prefill\\n' ;;\n"
+        "  *apply*) cat > /dev/null ;;\n"
+        "esac\n"
+    )
+    stub.chmod(0o755)
+    monkeypatch.setenv("PATH", f"{tmp_path}:{os.environ.get('PATH', '')}")
+
+    be = KubectlBackend(namespace="prod", image="dynamo:v1",
+                        hub="hub:9000", graph="g1")
+    asyncio.run(be.prune({"decode"}))
+    calls = logf.read_text().splitlines()
+    assert any("delete deployment dynamo-old-prefill" in c for c in calls)
+    assert any("delete service dynamo-old-prefill" in c for c in calls)
+    assert not any("delete deployment dynamo-decode" in c for c in calls)
+
+    # portless apply also clears a possible stale Service
+    logf.write_text("")
+    svc = ServiceSpec(name="decode", replicas=1, command=["-m", "d"])
+    asyncio.run(be.scale(svc, 2))
+    calls = logf.read_text().splitlines()
+    assert any("apply -f -" in c for c in calls)
+    assert any(
+        "delete service dynamo-decode --ignore-not-found" in c
+        for c in calls
+    )
+
+    asyncio.run(be.close())
