@@ -66,6 +66,7 @@ async def launch_encode_worker(
 ):
     """Serve the encode endpoint on ``drt``; returns the served handle."""
     enc = encoder or MockVisionEncoder(hidden_size, tokens_per_image)
+    hidden_size = getattr(enc, "hidden_size", hidden_size)
 
     async def handler(request: dict, context):
         urls = list(request.get("images") or [])
@@ -104,11 +105,15 @@ async def _amain(args) -> None:
     if args.hub:
         rcfg.hub_address = args.hub
     drt = DistributedRuntime(await connect_hub(rcfg.hub_address), rcfg)
+    encoder = None
+    if args.encoder == "vit":
+        encoder = _build_vit(args)
     await launch_encode_worker(
         drt,
         namespace=args.namespace,
         hidden_size=args.hidden_size,
         tokens_per_image=args.tokens_per_image,
+        encoder=encoder,
     )
     print("ENCODER_READY", flush=True)
     try:
@@ -117,12 +122,40 @@ async def _amain(args) -> None:
         await drt.close()
 
 
+def _build_vit(args):
+    """Real ViT tower (multimodal/vit.py). A checkpoint is a torch
+    state_dict of a CLIPVisionModel; without one the tower is
+    random-init (shape/e2e testing). When the LLM hidden differs from
+    the vision hidden, a LLaVA-style projector bridges them."""
+    from dataclasses import replace
+
+    from dynamo_tpu.multimodal.vit import VitEncoder, VitSpec
+
+    spec = (VitSpec.tiny() if args.vit_size == "tiny" else VitSpec())
+    if args.hidden_size != spec.hidden_size:
+        spec = replace(
+            spec, projector_hidden=spec.hidden_size,
+            llm_hidden=args.hidden_size,
+        )
+    if args.vit_checkpoint:
+        import torch
+
+        sd = torch.load(args.vit_checkpoint, map_location="cpu",
+                        weights_only=True)
+        return VitEncoder.from_torch(spec, sd)
+    return VitEncoder(spec)
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser("dynamo-tpu-encode-worker")
     p.add_argument("--hub", required=True)
     p.add_argument("--namespace", default="dynamo")
     p.add_argument("--hidden-size", type=int, required=True)
     p.add_argument("--tokens-per-image", type=int, default=4)
+    p.add_argument("--encoder", default="mock", choices=("mock", "vit"))
+    p.add_argument("--vit-size", default="clip-l", choices=("clip-l", "tiny"))
+    p.add_argument("--vit-checkpoint", default="",
+                   help="torch state_dict (.pt) of a CLIPVisionModel")
     args = p.parse_args(argv)
     logging.basicConfig(level=logging.INFO)
     try:

@@ -218,3 +218,197 @@ def test_file_urls_require_opt_in(monkeypatch):
     monkeypatch.delenv("DYNAMO_MM_ALLOW_FILE_URLS", raising=False)
     with pytest.raises(ValueError, match="disabled"):
         load_image_bytes("file:///etc/passwd")
+
+
+def test_vit_matches_hf_clip_vision_golden():
+    """The in-tree JAX ViT (multimodal/vit.py) must reproduce
+    transformers.CLIPVisionModel numerics exactly: same pixels through a
+    random-init torch tower and through params_from_torch-mapped JAX
+    params -> same post-LN hidden states (class token dropped)."""
+    import numpy as np
+    import pytest
+
+    torch = pytest.importorskip("torch")
+    transformers = pytest.importorskip("transformers")
+    pytest.importorskip("PIL")
+    CLIPVisionConfig = transformers.CLIPVisionConfig
+    CLIPVisionModel = transformers.CLIPVisionModel
+
+    from dynamo_tpu.multimodal.vit import (
+        VitSpec,
+        params_from_torch,
+        vit_forward,
+    )
+
+    torch.manual_seed(7)
+    cfg = CLIPVisionConfig(
+        hidden_size=32, intermediate_size=64, num_hidden_layers=2,
+        num_attention_heads=4, image_size=28, patch_size=14,
+    )
+    hf = CLIPVisionModel(cfg).eval()
+    spec = VitSpec.from_hf_config(cfg.to_dict())
+    params = params_from_torch(spec, hf.state_dict())
+
+    pixels = np.random.default_rng(11).standard_normal(
+        (2, 3, 28, 28)
+    ).astype(np.float32)
+    with torch.no_grad():
+        want = hf(torch.from_numpy(pixels)).last_hidden_state
+        # our forward applies post_layernorm to every token (the rows
+        # the LLM consumes); HF applies it only in pooler_output, so
+        # norm the HF hidden the same way before comparing
+        want = hf.vision_model.post_layernorm(want)[:, 1:, :].numpy()
+    got = np.asarray(vit_forward(spec, params, pixels))
+    assert got.shape == (2, spec.tokens_per_image, 32)
+    np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-4)
+
+
+def test_vit_encoder_end_to_end_png():
+    """VitEncoder.encode: real PNG bytes -> deterministic rows, distinct
+    images -> distinct rows, projector maps to the LLM hidden size."""
+    import io
+
+    import numpy as np
+    import pytest
+
+    Image = pytest.importorskip("PIL.Image")
+
+    from dynamo_tpu.multimodal.vit import VitEncoder, VitSpec
+
+    def png(color):
+        img = Image.new("RGB", (40, 40), color)
+        buf = io.BytesIO()
+        img.save(buf, format="PNG")
+        return buf.getvalue()
+
+    from dataclasses import replace
+
+    spec = replace(VitSpec.tiny(), projector_hidden=32, llm_hidden=48)
+    enc = VitEncoder(spec, seed=3)
+    assert enc.hidden_size == 48
+    assert enc.tokens_per_image == 4
+
+    a1 = enc.encode([png((255, 0, 0))])
+    a2 = enc.encode([png((255, 0, 0))])
+    b = enc.encode([png((0, 0, 255))])
+    assert a1.shape == (4, 48)
+    np.testing.assert_array_equal(a1, a2)  # deterministic
+    assert np.abs(a1 - b).max() > 1e-4  # content-sensitive
+
+    two = enc.encode([png((255, 0, 0)), png((0, 0, 255))])
+    assert two.shape == (8, 48)
+    np.testing.assert_allclose(two[:4], a1, rtol=1e-5, atol=1e-5)
+
+    import pytest
+
+    with pytest.raises(ValueError, match="undecodable"):
+        enc.encode([b"not an image"])
+
+
+async def test_epd_with_real_vit_tower():
+    """The real ViT tower plugs into the full EPD pipeline behind the
+    same encode interface: chat with PNG image_urls -> ViT rows
+    (projected to the LLM hidden) injected into prefill; different
+    pictures change the generation."""
+    import io
+    from dataclasses import replace
+
+    import pytest
+
+    Image = pytest.importorskip("PIL.Image")
+
+    from dynamo_tpu.engine.worker import launch_engine_worker
+    from dynamo_tpu.frontend.watcher import ModelManager, ModelWatcher
+    from dynamo_tpu.multimodal.vit import VitEncoder, VitSpec
+    from dynamo_tpu.multimodal.worker import launch_encode_worker
+    from dynamo_tpu.runtime.distributed import DistributedRuntime
+    from dynamo_tpu.runtime.hub import InMemoryHub
+
+    def png(color):
+        img = Image.new("RGB", (32, 32), color)
+        buf = io.BytesIO()
+        img.save(buf, format="PNG")
+        return buf.getvalue()
+
+    vspec = replace(
+        VitSpec.tiny(), projector_hidden=32, llm_hidden=SPEC.hidden_size
+    )
+    enc = VitEncoder(vspec, seed=5)
+    assert enc.tokens_per_image == TPI  # (28/14)^2 placeholder rows
+
+    drt = DistributedRuntime(InMemoryHub())
+    await launch_encode_worker(
+        drt, hidden_size=SPEC.hidden_size, tokens_per_image=TPI,
+        encoder=enc,
+    )
+    _engine, _served = await launch_engine_worker(
+        drt, spec=SPEC, model_name="tiny-mm",
+        engine_config=_engine_cfg(),
+        mm_tokens_per_image=TPI, image_token_id=IMG_TOKEN,
+    )
+    manager = ModelManager()
+    watcher = await ModelWatcher(drt, manager).start()
+    await watcher.wait_for_model("tiny-mm", timeout=5)
+    pipe = manager.get("tiny-mm")
+
+    async def run(img: bytes):
+        pre = pipe.preprocessor.preprocess(chat_with_image(img))
+        toks = []
+        async for d in pipe.generate(pre, Context()):
+            assert not d.get("error"), d
+            toks.extend(d.get("token_ids") or [])
+        return toks
+
+    red1 = await run(png((255, 0, 0)))
+    blue = await run(png((0, 0, 255)))
+    red2 = await run(png((255, 0, 0)))
+    assert len(red1) == 6
+    assert red1 == red2  # deterministic tower
+    assert red1 != blue  # image content reaches the LLM
+    await watcher.close()
+    await drt.close()
+
+
+def test_vit_checkpoint_geometry_and_projector_mapping():
+    """params_from_torch fails FAST on a geometry mismatch (wrong
+    image/patch size for the checkpoint) instead of erroring per
+    request, and a checkpoint's multi_modal_projector is mapped even
+    when the spec didn't configure one (LLaVA with vision hidden ==
+    LLM hidden) — VitEncoder's output width follows the projector."""
+    from dataclasses import replace
+
+    import numpy as np
+    import pytest
+
+    torch = pytest.importorskip("torch")
+    transformers = pytest.importorskip("transformers")
+
+    from dynamo_tpu.multimodal.vit import (
+        VitEncoder,
+        VitSpec,
+        params_from_torch,
+    )
+
+    torch.manual_seed(9)
+    cfg = transformers.CLIPVisionConfig(
+        hidden_size=32, intermediate_size=64, num_hidden_layers=2,
+        num_attention_heads=4, image_size=28, patch_size=14,
+    )
+    hf = transformers.CLIPVisionModel(cfg)
+    spec = VitSpec.from_hf_config(cfg.to_dict())
+
+    with pytest.raises(ValueError, match="geometry mismatch"):
+        params_from_torch(replace(spec, image_size=56), hf.state_dict())
+
+    sd = dict(hf.state_dict())
+    sd["multi_modal_projector.linear_1.weight"] = torch.randn(40, 32)
+    sd["multi_modal_projector.linear_1.bias"] = torch.randn(40)
+    sd["multi_modal_projector.linear_2.weight"] = torch.randn(32, 40)
+    sd["multi_modal_projector.linear_2.bias"] = torch.randn(32)
+    enc = VitEncoder.from_torch(spec, sd)  # spec has NO projector dims
+    assert "projector" in enc.params
+    assert enc.hidden_size == 32  # from the projector's output shape
+    np.testing.assert_allclose(
+        np.asarray(enc.params["projector"]["w1"]),
+        sd["multi_modal_projector.linear_1.weight"].numpy().T,
+    )
