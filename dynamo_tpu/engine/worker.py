@@ -53,6 +53,7 @@ async def launch_engine_worker(
     mode: str = "aggregated",
     mm_tokens_per_image: int = 0,
     image_token_id: int = 0,
+    mm_video_frames: int = 8,
     prefill_component: str = PREFILL_COMPONENT,
     prefill_router_mode: str = "kv",
     max_local_prefill_length: int = 128,
@@ -159,6 +160,7 @@ async def launch_engine_worker(
             reasoning_parser=reasoning_parser,
             mm_tokens_per_image=mm_tokens_per_image,
             image_token_id=image_token_id,
+            mm_video_frames=(mm_video_frames if mm_tokens_per_image else 0),
             runtime_config={"engine": "jax", "tp": cfg.tp, "mode": mode},
             metadata={"engine": "jax", "role": mode},
         )
@@ -395,6 +397,7 @@ async def _amain(args: argparse.Namespace) -> None:
         mode=args.mode,
         mm_tokens_per_image=args.mm_tokens_per_image,
         image_token_id=args.image_token_id,
+        mm_video_frames=args.mm_video_frames,
         prefill_component=args.prefill_component,
         prefill_router_mode=args.prefill_router_mode,
         max_local_prefill_length=args.max_local_prefill_length,
@@ -450,6 +453,9 @@ def main() -> None:
                    help="placeholder tokens per image (0 = text-only); "
                         "requires an encode worker on the namespace")
     p.add_argument("--image-token-id", type=int, default=0)
+    p.add_argument("--mm-video-frames", type=int, default=8,
+                   help="frames sampled per video attachment (matches the "
+                        "encode worker's --video-frames)")
     p.add_argument("--mode", default="aggregated",
                    choices=["aggregated", "prefill", "decode"])
     p.add_argument("--prefill-component", default=PREFILL_COMPONENT)

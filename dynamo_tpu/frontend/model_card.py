@@ -39,6 +39,9 @@ class ModelDeploymentCard:
     # the engine overwrites them with encoder embedding rows at prefill
     mm_tokens_per_image: int = 0
     image_token_id: int = 0
+    # frames sampled per video attachment (0 = video input rejected);
+    # each frame occupies mm_tokens_per_image placeholder rows
+    mm_video_frames: int = 0
     runtime_config: dict[str, Any] = field(default_factory=dict)
 
     def key_for(self, instance_id: int) -> str:
@@ -78,6 +81,7 @@ async def register_llm(
     reasoning_parser: str | None = None,
     mm_tokens_per_image: int = 0,
     image_token_id: int = 0,
+    mm_video_frames: int = 0,
     runtime_config: dict[str, Any] | None = None,
     metadata: dict[str, Any] | None = None,
 ):
@@ -101,6 +105,7 @@ async def register_llm(
         reasoning_parser=reasoning_parser,
         mm_tokens_per_image=mm_tokens_per_image,
         image_token_id=image_token_id,
+        mm_video_frames=mm_video_frames,
         runtime_config=runtime_config or {},
     )
     served = await endpoint.serve(

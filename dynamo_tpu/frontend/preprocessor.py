@@ -37,6 +37,7 @@ class OpenAIPreprocessor:
         reasoning_parser: str | None = None,
         mm_tokens_per_image: int = 0,
         image_token_id: int = 0,
+        mm_video_frames: int = 0,
     ):
         self.tokenizer = tokenizer
         self.model_name = model_name
@@ -47,6 +48,9 @@ class OpenAIPreprocessor:
         # multimodal: 0 = text-only model (image content parts rejected)
         self.mm_tokens_per_image = mm_tokens_per_image
         self.image_token_id = image_token_id
+        # frames sampled per video_url part (0 = video rejected); each
+        # frame occupies mm_tokens_per_image placeholder rows
+        self.mm_video_frames = mm_video_frames
         # fail fast on unknown parser names: a typo must break worker
         # startup, not every subsequent chat request
         from dynamo_tpu.parsers import make_reasoning_parser, make_tool_config
@@ -77,19 +81,22 @@ class OpenAIPreprocessor:
 
     def _flatten_content(
         self, request: dict[str, Any]
-    ) -> tuple[dict[str, Any], list[str]]:
-        """OpenAI content-part lists -> string contents + image refs.
+    ) -> tuple[dict[str, Any], list["str | dict[str, Any]"]]:
+        """OpenAI content-part lists -> string contents + media refs.
 
-        Text parts concatenate; each image_url part becomes an inline
-        marker (spliced into placeholder tokens after rendering) and its
-        URL collects in order. Ref: the template-level multimodal prompt
+        Text parts concatenate; each image_url/video_url part becomes an
+        inline marker (spliced into placeholder tokens after rendering)
+        and its ref collects in order — plain URL strings for images,
+        ``{"url":…, "kind":"video"}`` dicts for videos (the encode
+        worker expands those into sampled frames). Ref: the template-level multimodal prompt
         handling of lib/llm/src/preprocessor/prompt/template/oai.rs."""
         if "messages" not in request:
             return request, []
         has_images = any(
             isinstance(m.get("content"), list)
             and any(
-                isinstance(p, dict) and p.get("type") == "image_url"
+                isinstance(p, dict)
+                and p.get("type") in ("image_url", "video_url")
                 for p in m["content"]
             )
             for m in request["messages"]
@@ -103,7 +110,7 @@ class OpenAIPreprocessor:
                 text.replace(self.IMAGE_MARKER, "") if has_images else text
             )
 
-        images: list[str] = []
+        images: list[str | dict[str, Any]] = []
         msgs = []
         changed = False
         for m in request["messages"]:
@@ -114,12 +121,15 @@ class OpenAIPreprocessor:
                     ptype = part.get("type") if isinstance(part, dict) else None
                     if ptype == "text":
                         parts.append(clean(str(part.get("text") or "")))
-                    elif ptype == "image_url":
-                        iu = part.get("image_url")
+                    elif ptype in ("image_url", "video_url"):
+                        iu = part.get(ptype)
                         url = iu.get("url") if isinstance(iu, dict) else iu
                         if not url:
-                            raise ValueError("image_url part without url")
-                        images.append(url)
+                            raise ValueError(f"{ptype} part without url")
+                        images.append(
+                            url if ptype == "image_url"
+                            else {"url": url, "kind": "video"}
+                        )
                         parts.append(self.IMAGE_MARKER)
                     else:
                         raise ValueError(
@@ -157,17 +167,25 @@ class OpenAIPreprocessor:
             prompt = "".join(prompt)
         return prompt
 
+    def _attachment_tokens(self, att) -> int:
+        """Placeholder rows one attachment occupies: an image is
+        mm_tokens_per_image; a video is that per sampled frame."""
+        if isinstance(att, dict) and att.get("kind") == "video":
+            return self.mm_tokens_per_image * self.mm_video_frames
+        return self.mm_tokens_per_image
+
     def _tokenize_with_images(
-        self, prompt: str, n_images: int
+        self, prompt: str, attachments: list
     ) -> tuple[list[int], list[int]]:
-        """Tokenize around image markers, splicing ``mm_tokens_per_image``
-        placeholder ids per image. Returns (token_ids, placeholder
-        positions — absolute prompt positions the engine overwrites with
-        the encoder's embedding rows)."""
+        """Tokenize around media markers, splicing each attachment's
+        placeholder ids (_attachment_tokens — images and videos differ).
+        Returns (token_ids, placeholder positions — absolute prompt
+        positions the engine overwrites with the encoder's embedding
+        rows)."""
         segs = prompt.split(self.IMAGE_MARKER)
-        if len(segs) - 1 != n_images:
+        if len(segs) - 1 != len(attachments):
             raise ValueError(
-                "image markers and image parts diverged (chat template "
+                "media markers and media parts diverged (chat template "
                 "dropped message content?)"
             )
         token_ids: list[int] = []
@@ -175,14 +193,11 @@ class OpenAIPreprocessor:
         for i, seg in enumerate(segs):
             if seg:
                 token_ids.extend(self.tokenizer.encode(seg))
-            if i < n_images:
+            if i < len(attachments):
+                n = self._attachment_tokens(attachments[i])
                 start = len(token_ids)
-                positions.extend(
-                    range(start, start + self.mm_tokens_per_image)
-                )
-                token_ids.extend(
-                    [self.image_token_id] * self.mm_tokens_per_image
-                )
+                positions.extend(range(start, start + n))
+                token_ids.extend([self.image_token_id] * n)
         return token_ids, positions
 
     def preprocess(self, request: dict[str, Any]) -> dict[str, Any]:
@@ -192,10 +207,17 @@ class OpenAIPreprocessor:
             raise ValueError(
                 f"model {self.model_name!r} does not accept image input"
             )
+        if any(
+            isinstance(a, dict) and a.get("kind") == "video"
+            for a in images
+        ) and not self.mm_video_frames:
+            raise ValueError(
+                f"model {self.model_name!r} does not accept video input"
+            )
         prompt = self.render_prompt(request)
         if images:
             token_ids, mm_positions = self._tokenize_with_images(
-                prompt, len(images)
+                prompt, images
             )
         else:
             token_ids = self.tokenizer.encode(prompt)

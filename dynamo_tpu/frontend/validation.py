@@ -16,7 +16,7 @@ from typing import Any
 __all__ = ["RequestValidationError", "validate_request"]
 
 _ROLES = {"system", "developer", "user", "assistant", "tool"}
-_CONTENT_PART_TYPES = {"text", "image_url"}
+_CONTENT_PART_TYPES = {"text", "image_url", "video_url"}
 
 
 class RequestValidationError(ValueError):
@@ -105,13 +105,13 @@ def _check_messages(body: dict) -> None:
                     )
                 if ptype == "text" and not isinstance(part.get("text"), str):
                     _fail(f"'{pw}.text' must be a string", f"{pw}.text")
-                if ptype == "image_url":
-                    iu = part.get("image_url")
+                if ptype in ("image_url", "video_url"):
+                    iu = part.get(ptype)
                     url = iu.get("url") if isinstance(iu, dict) else iu
                     if not isinstance(url, str) or not url:
                         _fail(
-                            f"'{pw}.image_url.url' must be a non-empty "
-                            "string", f"{pw}.image_url",
+                            f"'{pw}.{ptype}.url' must be a non-empty "
+                            "string", f"{pw}.{ptype}",
                         )
             continue
         _fail(

@@ -148,6 +148,7 @@ async def build_pipeline(
         reasoning_parser=card.reasoning_parser,
         mm_tokens_per_image=card.mm_tokens_per_image,
         image_token_id=card.image_token_id,
+        mm_video_frames=card.mm_video_frames,
     )
     return ModelPipeline(
         card=card,

@@ -20,7 +20,46 @@ import os
 
 import numpy as np
 
-__all__ = ["MockVisionEncoder", "load_image_bytes"]
+__all__ = ["MockVisionEncoder", "load_image_bytes", "sample_video_frames"]
+
+
+def sample_video_frames(data: bytes, n_frames: int) -> list[bytes]:
+    """Uniformly sample ``n_frames`` frames from an animated image
+    (GIF/WebP — the formats Pillow decodes; container video needing
+    ffmpeg is rejected with a clear error) and return each as PNG
+    bytes, so any ``encode``-interface tower treats frames exactly like
+    still images. A still image yields its single frame repeated: the
+    placeholder count in the prompt is fixed at preprocess time, so the
+    sampler ALWAYS returns exactly ``n_frames`` entries."""
+    import io
+
+    from PIL import Image
+
+    try:
+        img = Image.open(io.BytesIO(data))
+        total = getattr(img, "n_frames", 1)
+        # endpoint-covering uniform sampling (first AND last frame);
+        # seek only the sampled indices — decoding every frame of a
+        # long high-res clip just to keep n would blow worker memory
+        if n_frames == 1 or total == 1:
+            idx = [0] * n_frames
+        else:
+            idx = [
+                round(i * (total - 1) / (n_frames - 1))
+                for i in range(n_frames)
+            ]
+        out = []
+        for i in idx:
+            img.seek(i)
+            buf = io.BytesIO()
+            img.convert("RGB").save(buf, format="PNG")
+            out.append(buf.getvalue())
+    except Exception as e:  # noqa: BLE001
+        raise ValueError(
+            f"undecodable video bytes (animated GIF/WebP supported; "
+            f"container formats need an ffmpeg build): {e}"
+        ) from e
+    return out
 
 
 def load_image_bytes(url: str) -> bytes:

@@ -47,18 +47,27 @@ class MultimodalEncode:
                        "error": (resp or {}).get("error", "empty encode reply")}
                 return
             # config-skew check at the hop, not deep in the engine: the
-            # encoder's row count per image must match the model card's
-            # placeholder span
+            # encoder's row count per attachment must match the model
+            # card's placeholder span (videos count frames x rows/image)
             tpi = resp.get("tokens_per_image")
+            # NOT `or 1`: an explicit video_frames=0 is itself the skew
+            # this check exists to surface
+            vf = int(resp.get("video_frames", 1))
             n_pos = len(mm.get("positions") or ())
-            if tpi and n_pos and len(mm["images"]) * int(tpi) != n_pos:
+            n_units = sum(
+                vf if isinstance(a, dict) and a.get("kind") == "video"
+                else 1
+                for a in mm["images"]
+            )
+            if tpi and n_pos and n_units * int(tpi) != n_pos:
                 yield {
                     "token_ids": [], "finish_reason": "error",
                     "error": (
-                        f"encoder produces {tpi} rows/image but the model "
-                        f"card spliced {n_pos // len(mm['images'])} "
-                        "placeholder tokens/image — align "
-                        "--tokens-per-image with mm_tokens_per_image"
+                        f"encoder produces {tpi} rows/image x {n_units} "
+                        f"frame(s) but the model card spliced {n_pos} "
+                        "placeholder tokens — align --tokens-per-image/"
+                        "--video-frames with the card's "
+                        "mm_tokens_per_image/mm_video_frames"
                     ),
                 }
                 return

@@ -63,21 +63,51 @@ async def launch_encode_worker(
     hidden_size: int,
     tokens_per_image: int = 4,
     encoder=None,
+    video_frames: int = 8,
 ):
-    """Serve the encode endpoint on ``drt``; returns the served handle."""
+    """Serve the encode endpoint on ``drt``; returns the served handle.
+
+    Attachments are image URLs (str) or ``{"url":…, "kind":"video"}``
+    dicts; a video is uniformly sampled into ``video_frames`` stills
+    (encoder.sample_video_frames) and each frame rides the same encode
+    path as an image, so every ``encode``-interface tower gets video
+    support for free."""
+    from dynamo_tpu.multimodal.encoder import sample_video_frames
+
+    if video_frames < 1:
+        raise ValueError(
+            "video_frames must be >= 1 (a zero-frame video would "
+            "silently contribute no rows and desync placeholder counts)"
+        )
     enc = encoder or MockVisionEncoder(hidden_size, tokens_per_image)
     hidden_size = getattr(enc, "hidden_size", hidden_size)
 
     async def handler(request: dict, context):
-        urls = list(request.get("images") or [])
+        atts = list(request.get("images") or [])
         try:
-            images = [load_image_bytes(u) for u in urls]
-            rows = enc.encode(images)
+            images: list[bytes] = []
+            for a in atts:
+                if isinstance(a, dict) and a.get("kind") == "video":
+                    data = load_image_bytes(a["url"])
+                    images.extend(sample_video_frames(data, video_frames))
+                else:
+                    url = a["url"] if isinstance(a, dict) else a
+                    images.append(load_image_bytes(url))
+            # short clips repeat frames (byte-identical PNGs by
+            # construction): encode each UNIQUE frame once, tile rows
+            uniq: dict[bytes, int] = {}
+            order = [uniq.setdefault(b, len(uniq)) for b in images]
+            uniq_rows = enc.encode(list(uniq))
+            tpi = enc.tokens_per_image
+            rows = np.concatenate(
+                [uniq_rows[i * tpi:(i + 1) * tpi] for i in order]
+            ) if order else uniq_rows
         except Exception as e:  # noqa: BLE001
             yield {"error": f"image encode failed: {e}"}
             return
         out = embeds_to_wire(rows)
         out["tokens_per_image"] = enc.tokens_per_image
+        out["video_frames"] = video_frames
         yield out
 
     ep = (
@@ -91,6 +121,7 @@ async def launch_encode_worker(
             "role": "encoder",
             "tokens_per_image": enc.tokens_per_image,
             "hidden_size": hidden_size,
+            "video_frames": video_frames,
         },
     )
     return served
@@ -114,6 +145,7 @@ async def _amain(args) -> None:
         hidden_size=args.hidden_size,
         tokens_per_image=args.tokens_per_image,
         encoder=encoder,
+        video_frames=args.video_frames,
     )
     print("ENCODER_READY", flush=True)
     try:
@@ -152,6 +184,8 @@ def main(argv=None) -> int:
     p.add_argument("--namespace", default="dynamo")
     p.add_argument("--hidden-size", type=int, required=True)
     p.add_argument("--tokens-per-image", type=int, default=4)
+    p.add_argument("--video-frames", type=int, default=8,
+                   help="frames sampled per video attachment")
     p.add_argument("--encoder", default="mock", choices=("mock", "vit"))
     p.add_argument("--vit-size", default="clip-l", choices=("clip-l", "tiny"))
     p.add_argument("--vit-checkpoint", default="",

@@ -412,3 +412,155 @@ def test_vit_checkpoint_geometry_and_projector_mapping():
         np.asarray(enc.params["projector"]["w1"]),
         sd["multi_modal_projector.linear_1.weight"].numpy().T,
     )
+
+
+def _gif(colors, size=(24, 24)):
+    """Animated GIF bytes with one solid frame per color."""
+    import io
+
+    import pytest
+
+    Image = pytest.importorskip("PIL.Image")
+    frames = [Image.new("RGB", size, c) for c in colors]
+    buf = io.BytesIO()
+    frames[0].save(buf, format="GIF", save_all=True,
+                   append_images=frames[1:], duration=50)
+    return buf.getvalue()
+
+
+def test_sample_video_frames():
+    """Uniform frame sampling from an animated GIF: exactly n frames,
+    deterministic, endpoints covered; a still image repeats its single
+    frame; garbage raises ValueError."""
+    import io
+
+    import pytest
+
+    Image = pytest.importorskip("PIL.Image")
+
+    from dynamo_tpu.multimodal.encoder import sample_video_frames
+
+    gif = _gif([(255, 0, 0), (0, 255, 0), (0, 0, 255), (255, 255, 0)])
+    frames = sample_video_frames(gif, 2)
+    assert len(frames) == 2
+    assert frames == sample_video_frames(gif, 2)  # deterministic
+    # endpoints covered: first frame red, last frame yellow
+    first = Image.open(io.BytesIO(frames[0])).convert("RGB")
+    assert first.getpixel((0, 0))[0] > 200
+    last = Image.open(io.BytesIO(frames[-1])).convert("RGB")
+    assert last.getpixel((0, 0))[0] > 200  # R of yellow
+    assert last.getpixel((0, 0))[1] > 200  # G of yellow
+    assert last.getpixel((0, 0))[2] < 120  # not white/blue
+
+    still = _gif([(0, 0, 255)])
+    frames = sample_video_frames(still, 3)
+    assert len(frames) == 3
+    assert frames[0] == frames[1] == frames[2]
+
+    with pytest.raises(ValueError, match="undecodable video"):
+        sample_video_frames(b"not a video", 2)
+
+
+def test_preprocessor_splices_video_placeholders():
+    """A video_url part occupies frames x tokens_per_image placeholder
+    rows; models without mm_video_frames reject video cleanly."""
+    tok = load_tokenizer("mock")
+    pre = OpenAIPreprocessor(
+        tok, model_name="mm", context_length=4096,
+        mm_tokens_per_image=TPI, image_token_id=IMG_TOKEN,
+        mm_video_frames=3,
+    )
+    req = {
+        "model": "mm",
+        "messages": [{
+            "role": "user",
+            "content": [
+                {"type": "text", "text": "describe "},
+                {"type": "video_url",
+                 "video_url": {"url": data_uri(b"vid")}},
+                {"type": "text", "text": " and "},
+                {"type": "image_url",
+                 "image_url": {"url": data_uri(b"img")}},
+            ],
+        }],
+        "max_tokens": 4,
+    }
+    out = pre.preprocess(req)
+    mm = out["multimodal"]
+    assert len(mm["images"]) == 2
+    assert mm["images"][0]["kind"] == "video"
+    assert isinstance(mm["images"][1], str)
+    # 3 frames x TPI for the video + TPI for the image
+    assert len(mm["positions"]) == 3 * TPI + TPI
+    assert out["token_ids"].count(IMG_TOKEN) >= 4 * TPI
+
+    novid = OpenAIPreprocessor(
+        tok, model_name="mm", context_length=4096,
+        mm_tokens_per_image=TPI, image_token_id=IMG_TOKEN,
+    )
+    import pytest
+
+    with pytest.raises(ValueError, match="video"):
+        novid.preprocess(req)
+
+
+async def test_epd_video_end_to_end():
+    """A chat with a video_url (animated GIF) flows through the full
+    pipeline: frames sampled at the encode worker, frames x TPI rows
+    injected; different clips change the generation."""
+    from dynamo_tpu.engine.worker import launch_engine_worker
+    from dynamo_tpu.frontend.watcher import ModelManager, ModelWatcher
+    from dynamo_tpu.multimodal.worker import launch_encode_worker
+    from dynamo_tpu.runtime.distributed import DistributedRuntime
+    from dynamo_tpu.runtime.hub import InMemoryHub
+
+    N_FRAMES = 2
+    drt = DistributedRuntime(InMemoryHub())
+    await launch_encode_worker(
+        drt, hidden_size=SPEC.hidden_size, tokens_per_image=TPI,
+        encoder=MockVisionEncoder(SPEC.hidden_size, TPI, scale=4.0),
+        video_frames=N_FRAMES,
+    )
+    _engine, _served = await launch_engine_worker(
+        drt, spec=SPEC, model_name="tiny-mm",
+        engine_config=_engine_cfg(),
+        mm_tokens_per_image=TPI, image_token_id=IMG_TOKEN,
+        mm_video_frames=N_FRAMES,
+    )
+    manager = ModelManager()
+    watcher = await ModelWatcher(drt, manager).start()
+    await watcher.wait_for_model("tiny-mm", timeout=5)
+    pipe = manager.get("tiny-mm")
+    assert pipe.card.mm_video_frames == N_FRAMES
+
+    def chat_with_video(vid: bytes):
+        return {
+            "model": "tiny-mm",
+            "messages": [{
+                "role": "user",
+                "content": [
+                    {"type": "text", "text": "what happens here"},
+                    {"type": "video_url",
+                     "video_url": {"url": data_uri(vid)}},
+                ],
+            }],
+            "max_tokens": 6, "temperature": 0.0, "ignore_eos": True,
+        }
+
+    async def run(vid: bytes):
+        pre = pipe.preprocessor.preprocess(chat_with_video(vid))
+        assert len(pre["multimodal"]["positions"]) == N_FRAMES * TPI
+        toks = []
+        async for d in pipe.generate(pre, Context()):
+            assert not d.get("error"), d
+            toks.extend(d.get("token_ids") or [])
+        return toks
+
+    a1 = await run(_gif([(255, 0, 0), (0, 255, 0)]))
+    b1 = await run(_gif([(0, 0, 255), (255, 255, 0)]))
+    a2 = await run(_gif([(255, 0, 0), (0, 255, 0)]))
+    assert len(a1) == 6
+    assert a1 == a2
+    assert a1 != b1
+    await watcher.close()
+    await drt.close()
