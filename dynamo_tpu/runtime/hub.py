@@ -78,12 +78,15 @@ class Hub:
         raise NotImplementedError
 
     def watch_prefix(
-        self, prefix: str, *, initial: bool = True
+        self, prefix: str, *, initial: bool = True, sync_marker: bool = False
     ) -> AsyncIterator[WatchEvent]:
         """Stream of WatchEvents for keys under ``prefix``.
 
         With ``initial=True`` the current contents are replayed as synthetic
-        "put" events first (ref etcd.rs kv_get_and_watch_prefix).
+        "put" events first (ref etcd.rs kv_get_and_watch_prefix). With
+        ``sync_marker=True`` a ``kind="sync"`` event delimits the end of
+        that replay — reconnecting clients use it to diff their known key
+        set against the fresh snapshot (hub_client.py re-sync).
         """
         raise NotImplementedError
 
@@ -225,7 +228,7 @@ class InMemoryHub(Hub):
         return {k: v for k, v in self._kv.items() if k.startswith(prefix)}
 
     async def watch_prefix(
-        self, prefix: str, *, initial: bool = True
+        self, prefix: str, *, initial: bool = True, sync_marker: bool = False
     ) -> AsyncIterator[WatchEvent]:
         q: asyncio.Queue = asyncio.Queue()
         snapshot = (
@@ -237,6 +240,8 @@ class InMemoryHub(Hub):
         try:
             for ev in snapshot:
                 yield ev
+            if sync_marker:
+                yield WatchEvent("sync", "")
             while True:
                 yield await q.get()
         finally:

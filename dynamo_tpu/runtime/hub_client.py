@@ -1,23 +1,54 @@
 """TCP client for the hub service - same interface as InMemoryHub.
 
 One connection per client, request/response multiplexed by message id;
-watch/subscribe streams fan out to per-stream queues. Reconnection is the
-caller's concern (workers treat hub loss as fatal after retries, mirroring
-the reference's etcd-loss => shutdown behavior, lib/runtime/src/lib.rs).
+watch/subscribe streams fan out to per-stream queues.
+
+Reconnection is built in (``reconnect=True``): when the hub connection
+drops — a hub crash/restart, not a clean close — calls retry after
+re-dialing with backoff for up to ``reconnect_window_s``, and streams
+re-establish transparently:
+
+- ``watch_prefix`` re-opens with a fresh initial snapshot delimited by a
+  server-side sync marker, diffs it against the keys it has already
+  yielded, and emits synthetic ``delete`` events for keys that vanished
+  while disconnected before replaying the snapshot — consumer state
+  converges exactly (ref: etcd watch re-establishment semantics).
+- ``subscribe`` with ``replay=True`` re-subscribes with replay and drops
+  events whose per-subject seq it already delivered (the durable hub
+  preserves seq counters across restarts, hub_store.py); with
+  ``replay=False`` it re-subscribes live-only — events published while
+  disconnected are lost, NATS-core semantics.
+
+Retried mutations are at-least-once: a ``create`` whose ack was lost in
+the crash may raise KeyExists on retry (same exposure etcd clients have
+without txn ids). Workers still treat a hub that stays unreachable past
+the reconnect window as fatal, mirroring the reference's etcd-loss =>
+shutdown behavior (lib/runtime/src/lib.rs).
 """
 
 from __future__ import annotations
 
 import asyncio
 import itertools
+import time
 from typing import Any, AsyncIterator
 
 from dynamo_tpu.runtime import framing
 from dynamo_tpu.runtime.hub import Hub, KeyExists, WatchEvent
 
 
+class _ConnLost(Exception):
+    """Internal: the stream's connection died mid-iteration."""
+
+
 class RemoteHub(Hub):
-    def __init__(self, address: str):
+    def __init__(
+        self,
+        address: str,
+        *,
+        reconnect: bool = True,
+        reconnect_window_s: float = 10.0,
+    ):
         host, _, port = address.rpartition(":")
         self._host, self._port = host or "127.0.0.1", int(port)
         self._reader: asyncio.StreamReader | None = None
@@ -27,11 +58,25 @@ class RemoteHub(Hub):
         self._streams: dict[int, asyncio.Queue] = {}
         self._rx_task: asyncio.Task | None = None
         self._write_lock = asyncio.Lock()
+        self._conn_lock = asyncio.Lock()
+        self._reconnect = reconnect
+        self._reconnect_window_s = reconnect_window_s
         self._closed = False
 
     @classmethod
-    async def connect(cls, address: str, timeout: float = 5.0) -> "RemoteHub":
-        hub = cls(address)
+    async def connect(
+        cls,
+        address: str,
+        timeout: float = 5.0,
+        *,
+        reconnect: bool = True,
+        reconnect_window_s: float = 10.0,
+    ) -> "RemoteHub":
+        hub = cls(
+            address,
+            reconnect=reconnect,
+            reconnect_window_s=reconnect_window_s,
+        )
         await hub._connect(timeout)
         return hub
 
@@ -41,38 +86,90 @@ class RemoteHub(Hub):
         )
         self._rx_task = asyncio.get_running_loop().create_task(self._rx_loop())
 
+    def _connected(self) -> bool:
+        return (
+            self._writer is not None
+            and not self._writer.is_closing()
+            and self._rx_task is not None
+            and not self._rx_task.done()
+        )
+
+    async def _ensure_connected(self) -> None:
+        """Re-dial with backoff for up to the reconnect window. Raises
+        ConnectionError when closed, reconnect is disabled, or the window
+        is exhausted."""
+        if self._closed:
+            raise ConnectionError("hub client closed")
+        if self._connected():
+            return
+        if not self._reconnect:
+            raise ConnectionError("hub not connected")
+        async with self._conn_lock:
+            if self._closed:
+                raise ConnectionError("hub client closed")
+            if self._connected():
+                return  # a neighbor reconnected while we waited
+            if self._writer is not None:
+                self._writer.close()
+            deadline = time.monotonic() + self._reconnect_window_s
+            delay = 0.05
+            while True:
+                try:
+                    await self._connect(timeout=2.0)
+                    return
+                except (OSError, asyncio.TimeoutError):
+                    if self._closed or time.monotonic() + delay >= deadline:
+                        raise ConnectionError(
+                            f"hub unreachable for {self._reconnect_window_s}s"
+                        )
+                    await asyncio.sleep(delay)
+                    delay = min(delay * 2, 1.0)
+
     async def _rx_loop(self) -> None:
         assert self._reader is not None
-        while True:
-            msg = await framing.read_frame(self._reader)
-            if msg is None:
-                break
-            mid = msg.get("id")
-            if "stream" in msg:
-                q = self._streams.get(mid)
-                if q is not None:
-                    q.put_nowait(msg["stream"])
-            else:
-                fut = self._pending.pop(mid, None)
-                if fut is not None and not fut.done():
-                    fut.set_result(msg)
-        # connection lost: fail everything
-        err = ConnectionError("hub connection lost")
-        for fut in self._pending.values():
-            if not fut.done():
-                fut.set_exception(err)
-        self._pending.clear()
-        for q in self._streams.values():
-            q.put_nowait(None)  # sentinel: stream closed
+        reader = self._reader
+        try:
+            while True:
+                msg = await framing.read_frame(reader)
+                if msg is None:
+                    break
+                mid = msg.get("id")
+                if "stream" in msg:
+                    q = self._streams.get(mid)
+                    if q is not None:
+                        q.put_nowait(msg["stream"])
+                else:
+                    fut = self._pending.pop(mid, None)
+                    if fut is not None and not fut.done():
+                        fut.set_result(msg)
+        except Exception:  # noqa: BLE001 — any rx failure = connection lost
+            pass
+        finally:
+            # connection lost: fail in-flight calls (their callers retry
+            # via _call's reconnect loop) and wake stream consumers (they
+            # re-open). MUST run even on unexpected read errors (OSError
+            # variants, oversized/corrupt frames) or callers await their
+            # futures forever.
+            err = ConnectionError("hub connection lost")
+            for fut in self._pending.values():
+                if not fut.done():
+                    fut.set_exception(err)
+            self._pending.clear()
+            for q in self._streams.values():
+                q.put_nowait(None)  # sentinel: stream closed
 
-    async def _call(self, op: str, **kwargs: Any) -> Any:
-        if self._writer is None:
-            raise ConnectionError("hub not connected")
+    async def _send_request(self, op: str, kwargs: dict[str, Any]) -> Any:
         mid = next(self._ids)
         fut: asyncio.Future = asyncio.get_running_loop().create_future()
         self._pending[mid] = fut
-        async with self._write_lock:
-            await framing.write_frame(self._writer, {"id": mid, "op": op, **kwargs})
+        try:
+            async with self._write_lock:
+                await framing.write_frame(
+                    self._writer, {"id": mid, "op": op, **kwargs}
+                )
+        except (OSError, ConnectionError):
+            self._pending.pop(mid, None)
+            raise ConnectionError("hub connection lost on send")
         msg = await fut
         if not msg.get("ok"):
             if msg.get("error") == "key_exists":
@@ -80,25 +177,48 @@ class RemoteHub(Hub):
             raise RuntimeError(f"hub error for {op}: {msg.get('error')}")
         return msg.get("result")
 
-    async def _open_stream(self, op: str, **kwargs: Any) -> tuple[int, asyncio.Queue]:
-        if self._writer is None:
-            raise ConnectionError("hub not connected")
+    async def _call(self, op: str, **kwargs: Any) -> Any:
+        deadline: float | None = None
+        while True:
+            try:
+                await self._ensure_connected()
+                return await self._send_request(op, kwargs)
+            except ConnectionError:
+                if not self._reconnect or self._closed:
+                    raise
+                deadline = deadline or (
+                    time.monotonic() + self._reconnect_window_s
+                )
+                if time.monotonic() >= deadline:
+                    raise
+                await asyncio.sleep(0.05)
+
+    async def _open_stream(
+        self, op: str, **kwargs: Any
+    ) -> tuple[int, asyncio.Queue]:
+        await self._ensure_connected()
         mid = next(self._ids)
         q: asyncio.Queue = asyncio.Queue()
         self._streams[mid] = q
-        async with self._write_lock:
-            await framing.write_frame(self._writer, {"id": mid, "op": op, **kwargs})
+        try:
+            async with self._write_lock:
+                await framing.write_frame(
+                    self._writer, {"id": mid, "op": op, **kwargs}
+                )
+        except (OSError, ConnectionError):
+            self._streams.pop(mid, None)
+            raise ConnectionError("hub connection lost on stream open")
         return mid, q
 
     async def _close_stream(self, mid: int) -> None:
         self._streams.pop(mid, None)
-        if self._writer is not None and not self._closed:
+        if self._connected() and not self._closed:
             try:
                 async with self._write_lock:
                     await framing.write_frame(
                         self._writer, {"id": next(self._ids), "op": "cancel", "target": mid}
                     )
-            except (ConnectionError, RuntimeError):
+            except (ConnectionError, OSError, RuntimeError):
                 pass
 
     # -- kv ---------------------------------------------------------------
@@ -118,18 +238,92 @@ class RemoteHub(Hub):
     async def get_prefix(self, prefix: str) -> dict[str, Any]:
         return await self._call("get_prefix", prefix=prefix)
 
+    def _stream_retry_gate(self, deadline: float | None) -> float:
+        """Shared stream-reconnect policy: raise when reconnect is off,
+        the client is closed, or the failure deadline passed; otherwise
+        return the deadline (setting it on first failure). Streams must
+        NOT retry unboundedly — a permanently dead hub has to surface as
+        ConnectionError so consumers hit their etcd-loss => shutdown
+        path."""
+        if not self._reconnect or self._closed:
+            raise ConnectionError("hub connection lost")
+        deadline = deadline or time.monotonic() + self._reconnect_window_s
+        if time.monotonic() >= deadline:
+            raise ConnectionError(
+                f"hub unreachable for {self._reconnect_window_s}s"
+            )
+        return deadline
+
     async def watch_prefix(
-        self, prefix: str, *, initial: bool = True
+        self, prefix: str, *, initial: bool = True, sync_marker: bool = False
     ) -> AsyncIterator[WatchEvent]:
-        mid, q = await self._open_stream("watch", prefix=prefix, initial=initial)
-        try:
-            while True:
-                item = await q.get()
-                if item is None:
-                    raise ConnectionError("hub connection lost during watch")
-                yield WatchEvent(item["kind"], item["key"], item.get("value"))
-        finally:
-            await self._close_stream(mid)
+        known: set[str] = set()
+        first = True
+        fail_deadline: float | None = None
+        while True:
+            # first open: plain watch, events stream through untouched (no
+            # marker — also keeps legacy servers working). Re-opens after a
+            # connection loss request the sync marker so the fresh snapshot
+            # can be diffed against ``known`` for missed deletes.
+            resync = not first
+            try:
+                mid, q = await self._open_stream(
+                    "watch", prefix=prefix,
+                    initial=initial if first else True, sync=resync,
+                )
+            except ConnectionError:
+                fail_deadline = self._stream_retry_gate(fail_deadline)
+                await asyncio.sleep(0.05)
+                continue
+            fail_deadline = None
+            try:
+                if resync:
+                    # collect the snapshot up to the server's sync marker,
+                    # then reconcile: keys we know that are GONE from the
+                    # fresh snapshot were deleted while we were away
+                    snap: list[WatchEvent] = []
+                    while True:
+                        item = await q.get()
+                        if item is None:
+                            raise _ConnLost
+                        if item["kind"] == "sync":
+                            break
+                        snap.append(
+                            WatchEvent(
+                                item["kind"], item["key"], item.get("value")
+                            )
+                        )
+                    snap_keys = {ev.key for ev in snap if ev.kind == "put"}
+                    for key in sorted(known - snap_keys):
+                        known.discard(key)
+                        yield WatchEvent("delete", key)
+                    # snapshot puts re-yield even already-known keys:
+                    # puts are idempotent upserts for every consumer, and
+                    # the value may have changed while disconnected
+                    for ev in snap:
+                        if ev.kind == "put":
+                            known.add(ev.key)
+                        yield ev
+                first = False
+                while True:
+                    item = await q.get()
+                    if item is None:
+                        raise _ConnLost
+                    if item["kind"] == "sync":
+                        continue
+                    ev = WatchEvent(item["kind"], item["key"], item.get("value"))
+                    if ev.kind == "put":
+                        known.add(ev.key)
+                    elif ev.kind == "delete":
+                        known.discard(ev.key)
+                    yield ev
+            except _ConnLost:
+                self._streams.pop(mid, None)
+                fail_deadline = self._stream_retry_gate(fail_deadline)
+                first = False
+                continue
+            finally:
+                await self._close_stream(mid)
 
     # -- leases ------------------------------------------------------------
 
@@ -170,18 +364,54 @@ class RemoteHub(Hub):
     async def subscribe(
         self, subject: str, *, replay: bool = False, with_seq: bool = False
     ) -> AsyncIterator[tuple]:
-        mid, q = await self._open_stream("subscribe", subject=subject, replay=replay)
-        try:
-            while True:
-                item = await q.get()
-                if item is None:
-                    raise ConnectionError("hub connection lost during subscribe")
-                if with_seq:
-                    yield item["subject"], item["payload"], item.get("seq", 0)
-                else:
-                    yield item["subject"], item["payload"]
-        finally:
-            await self._close_stream(mid)
+        last_seq: dict[str, int] = {}
+        boot: str | None = None
+        first = True
+        fail_deadline: float | None = None
+        while True:
+            # re-subscribe with replay only if the caller wanted replay:
+            # a live-only subscription stays live-only across reconnects
+            # (missed events are lost — NATS-core semantics); a replay
+            # subscription dedups by per-subject seq, which the durable
+            # hub preserves across restarts. Seq baselines are only valid
+            # within one hub boot: a NON-durable hub restart resets seq
+            # counters, so a changed boot_id clears the dedup map instead
+            # of silently discarding fresh low-seq events.
+            try:
+                if replay:
+                    new_boot = await self.get_boot_id()
+                    if not first and new_boot != boot:
+                        last_seq.clear()
+                    boot = new_boot
+                mid, q = await self._open_stream(
+                    "subscribe", subject=subject, replay=replay
+                )
+            except ConnectionError:
+                fail_deadline = self._stream_retry_gate(fail_deadline)
+                await asyncio.sleep(0.05)
+                continue
+            fail_deadline = None
+            try:
+                while True:
+                    item = await q.get()
+                    if item is None:
+                        raise _ConnLost
+                    subj, seq = item["subject"], item.get("seq", 0)
+                    if replay and not first and seq and seq <= last_seq.get(subj, 0):
+                        continue  # already delivered before the reconnect
+                    if seq:
+                        last_seq[subj] = max(last_seq.get(subj, 0), seq)
+                    if with_seq:
+                        yield subj, item["payload"], seq
+                    else:
+                        yield subj, item["payload"]
+            except _ConnLost:
+                self._streams.pop(mid, None)
+                fail_deadline = self._stream_retry_gate(fail_deadline)
+                first = False
+                continue
+            finally:
+                await self._close_stream(mid)
 
     # -- object store ------------------------------------------------------
 

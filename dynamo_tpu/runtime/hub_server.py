@@ -2,9 +2,12 @@
 
 Run as ``python -m dynamo_tpu.runtime.hub_server [--port N]`` - this is the
 deployment's single coordination process, playing the role etcd + NATS play
-for the reference (SURVEY.md section 2.4). State is in-memory (like NATS
-core); router snapshots / model cards that must survive restarts go through
-the object store API which can be pointed at disk via --data-dir.
+for the reference (SURVEY.md section 2.4). Without ``--data-dir`` state is
+in-memory (like NATS core); with it the hub is DURABLE (hub_store.py): every
+mutation is WAL-logged + periodically snapshotted, and a restarted hub
+recovers its full state — model cards, instance keys, leases, retained event
+streams with their seq counters, object buckets — the way etcd and JetStream
+recover from disk (ref lib/runtime/src/transports/etcd.rs, nats.rs:132-243).
 
 Protocol: framing.py frames. Request: ``{"id": n, "op": str, ...}`` ->
 response ``{"id": n, "ok": bool, "result"/"error": ...}``. Streaming ops
@@ -17,7 +20,6 @@ from __future__ import annotations
 import argparse
 import asyncio
 import logging
-from pathlib import Path
 from typing import Any
 
 from dynamo_tpu.runtime import framing
@@ -28,19 +30,25 @@ log = logging.getLogger("dynamo.hub")
 
 class HubServer:
     def __init__(self, host: str = "127.0.0.1", port: int = 0, data_dir: str | None = None):
-        self.hub = InMemoryHub()
+        if data_dir:
+            from dynamo_tpu.runtime.hub_store import DurableHub
+
+            self.hub: InMemoryHub = DurableHub(data_dir)
+        else:
+            self.hub = InMemoryHub()
         self.host = host
         self.port = port
         self._server: asyncio.AbstractServer | None = None
         self._conns: set[asyncio.StreamWriter] = set()
-        self._data_dir = Path(data_dir) if data_dir else None
-        if self._data_dir:
-            self._data_dir.mkdir(parents=True, exist_ok=True)
 
     async def start(self) -> tuple[str, int]:
         self._server = await asyncio.start_server(self._handle, self.host, self.port)
         sock = self._server.sockets[0]
         self.host, self.port = sock.getsockname()[:2]
+        # recovered leases must be reaped when their owners stay gone;
+        # the reaper normally starts on the first grant_lease, which may
+        # never come on a restarted hub serving only old leases
+        self.hub._ensure_reaper()
         log.info("hub listening on %s:%d", self.host, self.port)
         return self.host, self.port
 
@@ -135,16 +143,19 @@ class HubServer:
                     up_to_seq=msg.get("up_to_seq"),
                 )
             elif op == "put_object":
-                await self._put_object(msg["bucket"], msg["name"], msg["data"])
+                await hub.put_object(msg["bucket"], msg["name"], msg["data"])
                 result = True
             elif op == "get_object":
-                result = await self._get_object(msg["bucket"], msg["name"])
+                result = await hub.get_object(msg["bucket"], msg["name"])
             elif op == "delete_object":
                 await hub.delete_object(msg["bucket"], msg["name"])
                 result = True
             elif op == "watch":
                 streams[mid] = asyncio.ensure_future(
-                    self._stream_watch(mid, msg["prefix"], msg.get("initial", True), send)
+                    self._stream_watch(
+                        mid, msg["prefix"], msg.get("initial", True),
+                        msg.get("sync", False), send,
+                    )
                 )
                 return  # stream frames only; no immediate ack
             elif op == "boot_id":
@@ -173,9 +184,13 @@ class HubServer:
         except Exception as e:  # noqa: BLE001 - serve errors to the client
             await send({"id": mid, "ok": False, "error": repr(e)})
 
-    async def _stream_watch(self, mid: int, prefix: str, initial: bool, send) -> None:
+    async def _stream_watch(
+        self, mid: int, prefix: str, initial: bool, sync: bool, send
+    ) -> None:
         try:
-            async for ev in self.hub.watch_prefix(prefix, initial=initial):
+            async for ev in self.hub.watch_prefix(
+                prefix, initial=initial, sync_marker=sync
+            ):
                 await send(
                     {"id": mid, "stream": {"kind": ev.kind, "key": ev.key, "value": ev.value}}
                 )
@@ -195,28 +210,6 @@ class HubServer:
             pass
         except (ConnectionResetError, BrokenPipeError):
             pass
-
-    # -- object store with optional disk persistence -----------------------
-
-    def _obj_path(self, bucket: str, name: str) -> Path:
-        safe = name.replace("/", "_")
-        return self._data_dir / bucket / safe  # type: ignore[operator]
-
-    async def _put_object(self, bucket: str, name: str, data: bytes) -> None:
-        await self.hub.put_object(bucket, name, data)
-        if self._data_dir:
-            p = self._obj_path(bucket, name)
-            p.parent.mkdir(parents=True, exist_ok=True)
-            p.write_bytes(data)
-
-    async def _get_object(self, bucket: str, name: str) -> bytes | None:
-        data = await self.hub.get_object(bucket, name)
-        if data is None and self._data_dir:
-            p = self._obj_path(bucket, name)
-            if p.exists():
-                data = p.read_bytes()
-                await self.hub.put_object(bucket, name, data)
-        return data
 
 
 async def _amain(args: argparse.Namespace) -> None:
