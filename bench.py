@@ -147,6 +147,10 @@ def serving_measurement(spec, page_size: int, on_tpu: bool) -> dict:
             os.environ.get("DYNAMO_BENCH_BURST", "24")
         ),
         pipeline_decode=True,
+        pipeline_depth=int(os.environ.get("DYNAMO_BENCH_DEPTH", "2")),
+        max_prefill_tokens_per_step=int(
+            os.environ.get("DYNAMO_BENCH_PREFILL_BUDGET", "2048")
+        ),
     )
 
     async def run() -> dict:

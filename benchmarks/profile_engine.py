@@ -1,0 +1,174 @@
+#!/usr/bin/env python
+"""Step-thread phase profile of the serving hot loop.
+
+Runs one closed-loop serving rung (same workload as bench.py's ladder:
+ISL=128, OSL=48) with DYNAMO_ENGINE_PROFILE=1 and prints where the step
+thread's wall time goes: device sync, host bookkeeping, admissions,
+batch building. This is the measurement tool behind the round-5
+serving-efficiency work (VERDICT r4 weak #1: ~40ms/cycle of host-side
+materialize/process work under admission churn).
+
+Usage:
+  python benchmarks/profile_engine.py [--concurrency N] [--secs S] [--cpu]
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import os
+import sys
+import time
+
+os.environ.setdefault("DYNAMO_ENGINE_PROFILE", "1")
+
+import numpy as np
+
+import jax
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--concurrency", type=int, default=64)
+    ap.add_argument("--secs", type=float, default=20.0)
+    ap.add_argument("--warm-secs", type=float, default=6.0)
+    ap.add_argument("--burst", type=int, default=24)
+    ap.add_argument("--cpu", action="store_true")
+    args = ap.parse_args()
+    if args.cpu:
+        jax.config.update("jax_platforms", "cpu")
+
+    from dynamo_tpu.engine.config import EngineConfig, ModelSpec
+    from dynamo_tpu.engine.core import InferenceEngine
+    from dynamo_tpu.runtime.context import Context
+
+    on_tpu = jax.default_backend() == "tpu"
+    if on_tpu:
+        spec = ModelSpec(
+            name="llama-1b-bench", vocab_size=32768, hidden_size=2048,
+            intermediate_size=8192, num_layers=16, num_heads=16,
+            num_kv_heads=8, head_dim=128, tie_embeddings=False,
+        )
+        page, slots = 32, 64
+    else:
+        spec = ModelSpec.dryrun()
+        page, slots = 16, 8
+        args.concurrency = min(args.concurrency, 4)
+        args.secs = min(args.secs, 4.0)
+        args.warm_secs = min(args.warm_secs, 2.0)
+
+    ISL, OSL = 128, 48
+    pps = (ISL + OSL + page - 1) // page + 2
+    cfg = EngineConfig(
+        page_size=page,
+        num_pages=slots * pps + 64,
+        max_pages_per_seq=pps,
+        max_decode_slots=slots,
+        prefill_buckets=(128, 256),
+        decode_steps_per_dispatch=args.burst,
+        pipeline_decode=True,
+    )
+
+    async def run() -> None:
+        engine = InferenceEngine(spec, cfg)
+        await engine.start()
+
+        if os.environ.get("DYNAMO_PROFILE_STACKS") == "1":
+            import threading
+            import traceback
+
+            def dump_stacks():
+                while True:
+                    time.sleep(5)
+                    for tid, frame in sys._current_frames().items():
+                        name = next(
+                            (t.name for t in threading.enumerate()
+                             if t.ident == tid), "?",
+                        )
+                        if name == "engine-step":
+                            lines = traceback.format_stack(frame)
+                            app = [
+                                ln for ln in lines
+                                if "dynamo_tpu" in ln or "sampling" in ln
+                            ]
+                            print(f"=== {name} ===", file=sys.stderr)
+                            print("".join(app[-4:]) or "".join(lines[-2:]),
+                                  file=sys.stderr)
+
+            threading.Thread(target=dump_stacks, daemon=True).start()
+        rng = np.random.default_rng(0)
+
+        # compile every serving shape BEFORE the measured window (mirrors
+        # bench.py): the full admission wave (packed prefill + burst
+        # programs), the single-prompt prefill + width-1 fused sample
+        # (straggler), and the ramp-up capped-burst program (trickle)
+        async def warm_one(i: int):
+            toks = rng.integers(3, spec.vocab_size, ISL).tolist()
+            async for _ in engine.generate(
+                {"token_ids": toks,
+                 "stop_conditions": {"max_tokens": 4, "ignore_eos": True},
+                 "sampling": {"temperature": 0.0}},
+                Context(f"warm-{i}"),
+            ):
+                pass
+
+        await asyncio.gather(*(warm_one(i) for i in range(args.concurrency)))
+        await warm_one(9999)  # straggler: single-prompt programs
+        for r in range(3):
+            await asyncio.gather(
+                *(warm_one(5000 + r * 10 + j) for j in range(4))
+            )
+
+        stop = asyncio.Event()
+        n_done = [0]
+
+        async def stream(sid: int):
+            while not stop.is_set():
+                toks = rng.integers(3, spec.vocab_size, ISL).tolist()
+                async for _item in engine.generate(
+                    {"token_ids": toks,
+                     "stop_conditions": {"max_tokens": OSL,
+                                         "ignore_eos": True},
+                     "sampling": {"temperature": 0.0}},
+                    Context(f"prof-{sid}"),
+                ):
+                    pass
+                n_done[0] += 1
+
+        tasks = [
+            asyncio.create_task(stream(i)) for i in range(args.concurrency)
+        ]
+        await asyncio.sleep(args.warm_secs)
+        engine._prof.clear()  # drop compile/warmup noise
+        t0 = time.perf_counter()
+        steps0 = engine.steps
+        await asyncio.sleep(args.secs)
+        elapsed = time.perf_counter() - t0
+        steps1 = engine.steps
+        snap = engine.profile_snapshot()
+        stop.set()
+        await asyncio.gather(*tasks)
+        await engine.close()
+
+        accounted = sum(
+            v["secs"] for k, v in snap.items()
+            if k in ("materialize", "flush", "admit_loop", "packed_prefill",
+                     "complete_admissions", "build_batch", "dispatch",
+                     "process", "idle")
+        )
+        out = {
+            "concurrency": args.concurrency,
+            "window_s": round(elapsed, 2),
+            "model_steps": steps1 - steps0,
+            "requests_done": n_done[0],
+            "accounted_s": round(accounted, 2),
+            "phases": snap,
+        }
+        print(json.dumps(out, indent=2))
+
+    asyncio.run(run())
+
+
+if __name__ == "__main__":
+    sys.exit(main())

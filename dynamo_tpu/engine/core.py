@@ -18,7 +18,9 @@ router / planner stack as the mocker.
 from __future__ import annotations
 
 import asyncio
+import contextlib
 import logging
+import os
 import queue
 import threading
 import time
@@ -183,6 +185,33 @@ class InferenceEngine:
         self._moe_dropped_dev = None  # device-side running drop count
         self.moe_dropped_slots = 0  # last fetched total (metrics surface)
         self._metrics_publishes = 0
+        # step-thread phase profiler (DYNAMO_ENGINE_PROFILE=1): wall
+        # seconds + call counts per phase, read via profile_snapshot()
+        self._profiling = os.environ.get("DYNAMO_ENGINE_PROFILE") == "1"
+        self._prof: dict[str, list[float]] = {}
+
+    @contextlib.contextmanager
+    def _phase(self, name: str):
+        if not self._profiling:
+            yield
+            return
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            dt = time.perf_counter() - t0
+            rec = self._prof.setdefault(name, [0.0, 0])
+            rec[0] += dt
+            rec[1] += 1
+
+    def profile_snapshot(self) -> dict[str, dict[str, float]]:
+        """Per-phase accumulated step-thread wall time (profiling mode)."""
+        return {
+            k: {"secs": round(v[0], 4), "calls": int(v[1])}
+            for k, v in sorted(
+                self._prof.items(), key=lambda kv: -kv[1][0]
+            )
+        }
 
     # -- events ------------------------------------------------------------
 
@@ -293,6 +322,13 @@ class InferenceEngine:
         self, request: dict[str, Any], context: Context
     ) -> AsyncIterator[dict[str, Any]]:
         """AsyncEngine surface: stream token deltas for one request."""
+        if self._closed:
+            # closed-engine race (worker deregistration): error loudly so
+            # the frontend's migration op re-drives on a live worker —
+            # enqueueing would hang the client (soak-found)
+            yield {"token_ids": [], "finish_reason": "error",
+                   "error": "engine closed"}
+            return
         await self.start()
         token_ids = list(request.get("token_ids") or [])
         if not token_ids:
@@ -354,6 +390,15 @@ class InferenceEngine:
                 yield {"token_ids": [], "finish_reason": "error",
                        "error": f"kv transfer pull failed: {e}"}
                 return
+        if self._closed:
+            # re-check right before the enqueue with NO awaits in between
+            # (close() flips the flag on this same event loop): a request
+            # that parked in an await above (e.g. the disagg KV pull)
+            # while the engine closed must error, not enqueue into a
+            # queue no step thread will ever read
+            yield {"token_ids": [], "finish_reason": "error",
+                   "error": "engine closed"}
+            return
         out_q: asyncio.Queue = asyncio.Queue()
         self._waiting.put_nowait(_Waiting(request, context, out_q))
         self._wake.set()
@@ -381,9 +426,11 @@ class InferenceEngine:
                         and not any(self._slots)
                         and self._partial is None
                     ):
-                        self._wake.wait()
+                        with self._phase("idle"):
+                            self._wake.wait()
                     else:
-                        self._wake.wait(self.config.step_idle_sleep_s)
+                        with self._phase("idle"):
+                            self._wake.wait(self.config.step_idle_sleep_s)
             except Exception:  # noqa: BLE001
                 # fail every in-flight request, then KEEP SERVING: one bad
                 # step must not brick the worker
@@ -422,6 +469,33 @@ class InferenceEngine:
             self._materialize_waves(force=True)
         except Exception:  # noqa: BLE001
             log.exception("final flush on close failed")
+        # ... then FAIL whatever is still live. A request that raced the
+        # close into _waiting (or a slot mid-decode) would otherwise hang
+        # its client forever — soak-found (tests/test_soak.py); the
+        # frontend's migration op re-drives errored streams on another
+        # worker, so erroring here is the recoverable path.
+        try:
+            if self._partial is not None:
+                p, self._partial = self._partial, None
+                self.allocator.release(p.sp.pages)
+                self._post(
+                    p.waiting.out_q,
+                    {"token_ids": [], "finish_reason": "error",
+                     "error": "engine closed"},
+                )
+            for i, slot in enumerate(self._slots):
+                if slot is not None:
+                    self._finish(i, slot, "error", error="engine closed")
+            while not self._waiting.empty():
+                w = self._waiting.get_nowait()
+                self._drop_staged_kv(w.request)
+                self._post(
+                    w.out_q,
+                    {"token_ids": [], "finish_reason": "error",
+                     "error": "engine closed"},
+                )
+        except Exception:  # noqa: BLE001
+            log.exception("final drain on close failed")
 
     def request_clear_cache(self) -> None:
         """Admin: drop every inactive prefix-cache page (ref the HTTP
@@ -439,7 +513,8 @@ class InferenceEngine:
             # a bounded age so first tokens never stall forever. Blocking
             # the step thread on a download still queued behind device
             # work would serialize the whole pipeline.
-            did |= self._materialize_waves()
+            with self._phase("materialize"):
+                did |= self._materialize_waves()
         if self._pipeline:
             # cancels and admin cache ops need exact slot state: land the
             # in-flight burst first. Plain ADMISSIONS do not: the device
@@ -459,7 +534,8 @@ class InferenceEngine:
                 or stopped
                 or self._clear_cache_requested
             ):
-                self._flush_pipeline()
+                with self._phase("flush"):
+                    self._flush_pipeline()
                 did = True
         if self._clear_cache_requested:
             self._clear_cache_requested = False
@@ -488,6 +564,7 @@ class InferenceEngine:
             pending: list[tuple] = []
             preps: list[dict] = []
             reserved: set[int] = set()
+            admit_t0 = time.perf_counter() if self._profiling else 0.0
             while self._partial is None:
                 free_idx = next(
                     (
@@ -523,10 +600,16 @@ class InferenceEngine:
                     budget -= cost
                     admitted = True
                 did = True
+            if self._profiling and admitted:
+                rec = self._prof.setdefault("admit_loop", [0.0, 0])
+                rec[0] += time.perf_counter() - admit_t0
+                rec[1] += 1
             # packed prefill: all same-bucket preps in ONE dispatch each
-            pending.extend(self._run_packed_prefills(preps))
+            with self._phase("packed_prefill"):
+                pending.extend(self._run_packed_prefills(preps))
             if pending:
-                self._complete_admissions(pending)
+                with self._phase("complete_admissions"):
+                    self._complete_admissions(pending)
             if did:
                 self._publish_metrics()
 
@@ -820,6 +903,20 @@ class InferenceEngine:
             pass
         self.offload.submit([s for s, _p, _i in batch], kb, vb)
 
+    def _sampling_params(self, req: dict) -> tuple[float, int, float, int]:
+        """(temperature, top_k, top_p, seed) for a request, allocating the
+        per-request seed. Used by the fused prefill-time first-token sample
+        (the seed must be FIXED before the sample dispatch) and then handed
+        to _make_slot so slot and sample agree."""
+        sampling = req.get("sampling") or {}
+        self._seed_counter += 1
+        return (
+            float(self._opt(sampling, "temperature", 0.0)),
+            int(self._opt(sampling, "top_k", 0)),
+            float(self._opt(sampling, "top_p", 1.0)),
+            int(self._opt(sampling, "seed", self._seed_counter)) & 0xFFFFFFFF,
+        )
+
     def _make_slot(
         self,
         waiting: _Waiting,
@@ -830,11 +927,17 @@ class InferenceEngine:
         remaining: int,
         generated: int = 0,
         last_token: int,
+        sample_seed: int | None = None,
     ) -> _Slot:
         req = waiting.request
         sampling = req.get("sampling") or {}
         stop = req.get("stop_conditions") or {}
-        self._seed_counter += 1
+        if sample_seed is None:
+            self._seed_counter += 1
+            sample_seed = (
+                int(self._opt(sampling, "seed", self._seed_counter))
+                & 0xFFFFFFFF
+            )
         return _Slot(
             request_id=waiting.context.id,
             context=waiting.context,
@@ -852,8 +955,7 @@ class InferenceEngine:
             min_tokens=int(self._opt(stop, "min_tokens", 0)),
             generated=generated,
             last_token=last_token,
-            sample_seed=int(self._opt(sampling, "seed", self._seed_counter))
-            & 0xFFFFFFFF,
+            sample_seed=sample_seed,
             logprobs=self._clamp_logprobs(
                 (req.get("output_options") or {}).get("logprobs")
             ),
@@ -979,7 +1081,10 @@ class InferenceEngine:
             )
             self._seal_prompt_blocks(sp, seq)  # salted hashes: cache-safe
             self._drain_offload()
-            return (slot_idx, waiting, seq, sp, token_ids, max_tokens, logits)
+            return (
+                slot_idx, waiting, seq, sp, token_ids, max_tokens,
+                (logits, None), None,
+            )
         use_ring = (
             self.mesh is not None
             and self.fam.supports_ring_prefill
@@ -1019,7 +1124,10 @@ class InferenceEngine:
             self._note_moe_dropped(dropped)
             self._seal_prompt_blocks(sp, seq)
             self._drain_offload()
-            return (slot_idx, waiting, seq, sp, token_ids, max_tokens, logits)
+            return (
+                slot_idx, waiting, seq, sp, token_ids, max_tokens,
+                (logits, None), None,
+            )
 
         chunk_max = self._prefill_chunk_max()
         if start_pos + chunk_max >= len(token_ids):
@@ -1111,14 +1219,68 @@ class InferenceEngine:
                          "error": f"prefill failed: {e}"},
                     )
                 continue
+            pres = self._fused_first_tokens(
+                logits, [p["waiting"] for p in group]
+            )
             for i, p in enumerate(group):
                 self._seal_prompt_blocks(p["sp"], p["seq"])
                 records.append((
                     p["slot_idx"], p["waiting"], p["seq"], p["sp"],
-                    p["token_ids"], p["max_tokens"], logits[i],
+                    p["token_ids"], p["max_tokens"], (logits, i),
+                    pres[i] if pres else None,
                 ))
         self._drain_offload()
         return records
+
+    def _fused_first_tokens(
+        self, logits: jax.Array, waitings: list[_Waiting]
+    ) -> list[tuple] | None:
+        """Sample the dispatch's first tokens straight off its [nb, V]
+        logits — no per-row slicing, no cross-dispatch stack, and the
+        host copy starts immediately. Returns per-row
+        ``(samples, row, seed)`` handles for the async admission path,
+        or None when these records need host-side logits anyway
+        (sync admissions: logprobs, disagg handoff, SPMD lockstep)."""
+        if (
+            not self.config.async_admissions
+            or self.spmd is not None
+            or any(self._needs_sync_admission(w.request) for w in waitings)
+        ):
+            return None
+        nb = logits.shape[0]
+        temps = np.zeros((nb,), np.float32)
+        topk = np.zeros((nb,), np.int32)
+        topp = np.ones((nb,), np.float32)
+        seeds = np.zeros((nb,), np.uint32)
+        params = [self._sampling_params(w.request) for w in waitings]
+        for i, (t, k, p, s) in enumerate(params):
+            temps[i], topk[i], topp[i], seeds[i] = t, k, p, s
+        samples = sample_tokens(
+            logits, jnp.asarray(temps), jnp.asarray(topk),
+            jnp.asarray(topp), jnp.asarray(seeds),
+            jnp.zeros((nb,), jnp.int32),  # first token: RNG step 0
+        )
+        # NO host copy here: on the tunneled runtime every d2h costs
+        # ~80 ms and transfers serialize, so per-dispatch copies would
+        # dominate the cycle. The round's samples coalesce into one wave
+        # with a single async copy (_complete_admissions_async), and the
+        # burst download's fed column is the no-extra-transfer backstop.
+        return [
+            (samples, i, params[i][3]) for i in range(len(waitings))
+        ]
+
+    def _needs_sync_admission(self, req: dict) -> bool:
+        """True when this request's admission must read logits/tokens on
+        the host immediately (logprob entries, disagg prefill handoff)."""
+        if (
+            (req.get("output_options") or {}).get("logprobs") is not None
+            and self.fam.supports_logprobs
+        ):
+            return True
+        kvt = (req.get("disagg") or {}).get("kv_transfer") or {}
+        return bool(
+            kvt.get("do_remote_decode") and self.transfer_source is not None
+        )
 
     def _single_prefill_record(self, p: dict) -> tuple | None:
         pmark = self._spmd_mark()
@@ -1127,9 +1289,11 @@ class InferenceEngine:
                 p["sp"], p["token_ids"], p["start_pos"], len(p["token_ids"])
             )
             self._seal_prompt_blocks(p["sp"], p["seq"])
+            pres = self._fused_first_tokens(logits[None, :], [p["waiting"]])
             return (
                 p["slot_idx"], p["waiting"], p["seq"], p["sp"],
-                p["token_ids"], p["max_tokens"], logits,
+                p["token_ids"], p["max_tokens"], (logits, None),
+                pres[0] if pres else None,
             )
         except Exception as e:  # noqa: BLE001
             log.exception("prefill failed for %s", p["waiting"].context.id)
@@ -1168,16 +1332,7 @@ class InferenceEngine:
             self.config.async_admissions
             and self.spmd is None
             and not any(
-                (r[1].request.get("output_options") or {}).get("logprobs")
-                is not None
-                and self.fam.supports_logprobs
-                for r in pending
-            )
-            and not any(
-                ((r[1].request.get("disagg") or {}).get("kv_transfer") or {})
-                .get("do_remote_decode")
-                and self.transfer_source is not None
-                for r in pending
+                self._needs_sync_admission(r[1].request) for r in pending
             )
         )
         if use_async:
@@ -1185,15 +1340,24 @@ class InferenceEngine:
             return
         recs: list[tuple] = []
         try:
-            for slot_idx, waiting, seq, sp, token_ids, max_tokens, logits in pending:
+            for (
+                slot_idx, waiting, seq, sp, token_ids, max_tokens,
+                logits_ref, pre,
+            ) in pending:
+                # a mixed round can carry presampled records onto the sync
+                # path (their fused sample goes unused); reuse their
+                # already-allocated seed so the slot's RNG stream matches
+                # what the same round would produce un-mixed
                 slot = self._make_slot(
                     waiting, seq, sp,
                     seq_len=len(token_ids), remaining=max_tokens,
                     last_token=token_ids[-1],
+                    sample_seed=pre[2] if pre is not None else None,
                 )
-                recs.append((slot_idx, waiting, slot, logits, token_ids, sp))
+                recs.append((slot_idx, waiting, slot, logits_ref, token_ids, sp))
             stacked, sample_args = self._admission_sample_inputs(
-                [r[2] for r in recs], [r[3] for r in recs],
+                [r[2] for r in recs],
+                [self._logits_row(r[3]) for r in recs],
                 on_device=self.spmd is None,
             )
             sampled_dev = sample_tokens(stacked, *sample_args)
@@ -1210,7 +1374,7 @@ class InferenceEngine:
                 toks = np.asarray(sampled_dev)
         except Exception as e:  # noqa: BLE001
             log.exception("batched admission completion failed")
-            for _si, waiting, _seq, sp, _t, _m, _l in pending:
+            for _si, waiting, _seq, sp, _t, _m, _lr, _pre in pending:
                 self.allocator.release(sp.pages)
                 sp.pages = []
                 self._post(
@@ -1220,7 +1384,7 @@ class InferenceEngine:
                 )
             return
 
-        for i, (slot_idx, waiting, slot, logits, token_ids, sp) in enumerate(recs):
+        for i, (slot_idx, waiting, slot, _logits_ref, token_ids, sp) in enumerate(recs):
             # per-record isolation: one bad emit (disagg export, handoff)
             # must not strand the step's other admissions
             try:
@@ -1297,16 +1461,36 @@ class InferenceEngine:
             jnp.asarray(seeds), jnp.asarray(gens),
         )
 
+    @staticmethod
+    def _logits_row(logits_ref: tuple) -> jax.Array:
+        """Resolve a record's ``(array, row)`` logits handle to a [V] row.
+        Packed dispatches share one [nb, V] array (row = index); single
+        dispatches carry the [V] row directly (row = None)."""
+        arr, row = logits_ref
+        return arr if row is None else arr[row]
+
     def _complete_admissions_async(self, pending: list[tuple]) -> None:
-        """Async admission completion: sample first tokens on device,
-        start their d2h copy, install the slots with ``first_pending``
-        set, and return WITHOUT waiting. The next decode burst feeds the
-        new slots' tokens straight from the device sample
-        (_dispatch_burst admit feed); the host values materialize at the
-        next step (_materialize_waves)."""
+        """Async admission completion: first tokens sampled on device,
+        d2h copies in flight, slots installed with ``first_pending`` set —
+        the step thread never waits. The next decode burst feeds the new
+        slots' tokens straight from the device samples (_dispatch_burst
+        admit feed); host values materialize later (_materialize_waves /
+        _process_burst ordering).
+
+        Most records arrive PRESAMPLED: the packed/single prefill stage
+        fused the first-token sample onto its own dispatch
+        (_fused_first_tokens), so no per-row logits slicing or cross-
+        dispatch stacking happens here — one admission wave per source
+        dispatch. Records without a presample (multimodal, ring, chunked
+        completions) batch into one extra stacked sample."""
         recs: list[tuple] = []
+        waves: dict[int, dict] = {}
+        unsampled: list[tuple] = []
         try:
-            for slot_idx, waiting, seq, sp, token_ids, max_tokens, logits in pending:
+            for (
+                slot_idx, waiting, seq, sp, token_ids, max_tokens,
+                logits_ref, pre,
+            ) in pending:
                 # counters PRE-advanced past the first token (its value is
                 # still in flight): bursts built before materialization
                 # see the same generated/remaining the sync path would
@@ -1314,20 +1498,63 @@ class InferenceEngine:
                     waiting, seq, sp,
                     seq_len=len(token_ids), remaining=max_tokens - 1,
                     generated=1, last_token=token_ids[-1],
+                    sample_seed=pre[2] if pre is not None else None,
                 )
                 slot.first_pending = True
-                recs.append((slot_idx, waiting, slot, token_ids, sp, logits))
-            stacked, sample_args = self._admission_sample_inputs(
-                [r[2] for r in recs], [r[5] for r in recs], on_device=True
-            )
-            sampled_dev = sample_tokens(stacked, *sample_args)
-            try:
-                sampled_dev.copy_to_host_async()
-            except AttributeError:
-                pass
+                recs.append((slot_idx, slot))
+                if pre is not None:
+                    arr, row, _seed = pre
+                    wave = waves.setdefault(
+                        id(arr), {"dev": arr, "recs": [], "fed": set(), "age": 0}
+                    )
+                    wave["recs"].append((slot_idx, slot, row))
+                else:
+                    unsampled.append((slot_idx, slot, logits_ref))
+            if unsampled:
+                stacked, sample_args = self._admission_sample_inputs(
+                    [s for _, s, _ in unsampled],
+                    [self._logits_row(lr) for _, _, lr in unsampled],
+                    on_device=True,
+                )
+                sampled_dev = sample_tokens(stacked, *sample_args)
+                waves[id(sampled_dev)] = {
+                    "dev": sampled_dev,
+                    "recs": [
+                        (si, s, i) for i, (si, s, _lr) in enumerate(unsampled)
+                    ],
+                    "fed": set(),
+                    "age": 0,
+                }
+            if len(waves) > 1:
+                # coalesce the round's per-dispatch samples into ONE wave:
+                # the tunneled runtime charges ~80 ms per d2h transfer and
+                # serializes them, so the round must cost at most one. The
+                # concat compiles per distinct part-count — a handful of
+                # tiny programs, amortized immediately.
+                parts = list(waves.values())
+                coalesced = jnp.concatenate([w["dev"] for w in parts])
+                recs2: list[tuple] = []
+                off = 0
+                for w in parts:
+                    recs2.extend(
+                        (si, s, off + row) for si, s, row in w["recs"]
+                    )
+                    off += w["dev"].shape[0]
+                waves = {0: {
+                    "dev": coalesced, "recs": recs2, "fed": set(), "age": 0,
+                }}
+            for w in waves.values():
+                # start the host copy NOW: by the next cycle the wave can
+                # land from host memory (is_ready) — a full cycle earlier
+                # than the burst-processing backstop, which is what keeps
+                # closed-loop clients resubmitting and the batch full
+                try:
+                    w["dev"].copy_to_host_async()
+                except AttributeError:
+                    pass
         except Exception as e:  # noqa: BLE001
             log.exception("async admission completion failed")
-            for _si, waiting, _seq, sp, _t, _m, _l in pending:
+            for _si, waiting, _seq, sp, _t, _m, _lr, _pre in pending:
                 self.allocator.release(sp.pages)
                 sp.pages = []
                 self._post(
@@ -1336,27 +1563,47 @@ class InferenceEngine:
                      "error": f"prefill failed: {e}"},
                 )
             return
-        for slot_idx, _w, slot, _t, _sp, _l in recs:
+        for slot_idx, slot in recs:
             self._slots[slot_idx] = slot
-        self._admit_waves.append(
-            {"dev": sampled_dev, "recs": recs, "fed": set(), "age": 0}
-        )
+        self._admit_waves.extend(waves.values())
 
     def _materialize_waves(self, force: bool = False) -> bool:
-        """Land every admission wave whose device sample is ready (or
-        aged out / forced). Waves cover disjoint LIVE slots, so landing
-        one never depends on another — slot-identity guards skip records
-        whose slot was reused since."""
+        """Land admission waves whose device sample is ready. Waves cover
+        disjoint LIVE slots, so landing one never depends on another —
+        slot-identity guards skip records whose slot was reused since.
+
+        A wave whose pending slots are COVERED by an in-flight decode
+        burst is left alone even when aged: _process_burst force-lands it
+        right before that burst's (already device-complete) tokens sync,
+        where the asarray is nearly free. Forcing here instead would
+        block the step thread on device work still queued behind a full
+        burst — measured at ~60 ms/cycle of stall under admission churn
+        (the round-5 profile, benchmarks/profile_engine.py). The age
+        fallback only catches waves NO burst will ever process (e.g. a
+        one-token budget exhausted by the first token)."""
         did = False
         keep: list[dict] = []
+        covered: set[int] = set()
+        if not force:
+            for pb in self._pipeline:
+                covered.update(
+                    si for si in pb["batch"]["participants"]
+                    if pb["batch"]["active"][si]
+                )
         for ap in self._admit_waves:
             ap["age"] += 1
             ready = getattr(ap["dev"], "is_ready", lambda: True)()
-            # age >= 2: two full cycles have passed since the sample was
-            # enqueued — its copy has crossed the wire by now, so the
-            # asarray costs ~nothing even when is_ready under-reports
-            # (observed on the tunneled runtime)
-            if force or ready or ap["age"] >= 2:
+            live = [
+                (si, s, row) for si, s, row in ap["recs"]
+                if self._slots[si] is s and s.first_pending
+            ]
+            if not live:
+                # every record finished/cancelled since admission: nothing
+                # to land — drop the wave without touching the device
+                did = True
+                continue
+            in_burst = all(si in covered for si, _s, _row in live)
+            if force or ready or (ap["age"] >= 2 and not in_burst):
                 self._materialize_one(ap)
                 did = True
             else:
@@ -1364,47 +1611,88 @@ class InferenceEngine:
         self._admit_waves = keep
         return did
 
-    def _materialize_one(self, ap: dict) -> None:
-        """Land one async admission wave: read the (long-since-arrived)
-        first tokens, append them to each slot's sequence, apply stop
-        semantics, and stream the first items."""
-        try:
-            toks = np.asarray(ap["dev"])
-        except Exception as e:  # noqa: BLE001
-            log.exception("admission materialization failed")
-            for slot_idx, _w, slot, _t, _sp, _l in ap["recs"]:
-                if self._slots[slot_idx] is slot:
-                    self._finish(
-                        slot_idx, slot, "error",
-                        error=f"admission failed: {e}",
-                    )
-            return
-        for i, (slot_idx, _waiting, slot, _token_ids, _sp, _l) in enumerate(
-            ap["recs"]
-        ):
-            if self._slots[slot_idx] is not slot:
+    def _materialize_one(
+        self,
+        ap: dict,
+        *,
+        fed_col: np.ndarray | None = None,
+        fed: set | None = None,
+        part: np.ndarray | None = None,
+        participants: dict | None = None,
+    ) -> dict | None:
+        """Land an async admission wave's first tokens.
+
+        Direct mode (``fed_col`` is None): read the wave's own device
+        sample — one d2h transfer. Burst mode (_process_burst): slots
+        that were FED into the burst being processed take their token
+        from the burst download's fed column — no extra transfer; any
+        record not covered (a page-stalled slot that joined a later
+        burst) stays in a residual wave, returned for re-queueing.
+
+        The ``participants`` request-id check is load-bearing: a burst
+        dispatched before this slot's admission can have its INDEX
+        active under the PREVIOUS request — its fed column carries the
+        dead request's chained token, not this wave's sample. Only the
+        burst whose participant at the index IS this request may land
+        the first token."""
+        if fed_col is None:
+            try:
+                toks = np.asarray(ap["dev"])
+            except Exception as e:  # noqa: BLE001
+                log.exception("admission materialization failed")
+                for slot_idx, slot, _row in ap["recs"]:
+                    if self._slots[slot_idx] is slot:
+                        self._finish(
+                            slot_idx, slot, "error",
+                            error=f"admission failed: {e}",
+                        )
+                return None
+            for slot_idx, slot, row in ap["recs"]:
+                if self._slots[slot_idx] is not slot:
+                    continue  # finished/cancelled since admission
+                self._land_first_token(slot_idx, slot, int(toks[row]))
+            return None
+        rest: list[tuple] = []
+        for slot_idx, slot, row in ap["recs"]:
+            if self._slots[slot_idx] is not slot or not slot.first_pending:
                 continue  # finished/cancelled since admission
-            tok = int(toks[i])
-            slot.seq.append(tok)
-            slot.last_token = tok
-            slot.first_pending = False
-            # stop semantics of _accept_token, with counters pre-advanced
-            finish = None
             if (
-                not slot.ignore_eos
-                and slot.generated >= slot.min_tokens
-                and tok in slot.eos_ids
+                slot_idx in fed
+                and part[slot_idx]
+                and participants is not None
+                and participants.get(slot_idx) == slot.request_id
             ):
-                finish = "stop"
-            elif tok in slot.stop_token_ids and slot.generated >= slot.min_tokens:
-                finish = "stop"
-            elif slot.remaining <= 0:
-                finish = "length"
-            if finish is not None:
-                self._finish(slot_idx, slot, finish, emit=False)
-            self._post(
-                slot.out_q, {"token_ids": [tok], "finish_reason": finish}
-            )
+                self._land_first_token(
+                    slot_idx, slot, int(fed_col[slot_idx])
+                )
+            else:
+                rest.append((slot_idx, slot, row))
+        if rest:
+            return {**ap, "recs": rest}
+        return None
+
+    def _land_first_token(self, slot_idx: int, slot: _Slot, tok: int) -> None:
+        """Record + stream an async admission's first token (stop
+        semantics of _accept_token, with counters pre-advanced)."""
+        slot.seq.append(tok)
+        slot.last_token = tok
+        slot.first_pending = False
+        finish = None
+        if (
+            not slot.ignore_eos
+            and slot.generated >= slot.min_tokens
+            and tok in slot.eos_ids
+        ):
+            finish = "stop"
+        elif tok in slot.stop_token_ids and slot.generated >= slot.min_tokens:
+            finish = "stop"
+        elif slot.remaining <= 0:
+            finish = "length"
+        if finish is not None:
+            self._finish(slot_idx, slot, finish, emit=False)
+        self._post(
+            slot.out_q, {"token_ids": [tok], "finish_reason": finish}
+        )
 
     def _run_prefill_chunk(
         self, sp: SeqPages, token_ids: list[int], start: int, end: int,
@@ -1497,7 +1785,7 @@ class InferenceEngine:
             self._drain_offload()
             self._complete_admissions([
                 (p.slot_idx, p.waiting, p.seq, p.sp, p.token_ids,
-                 p.max_tokens, logits)
+                 p.max_tokens, (logits, None), None)
             ])
 
     def _export_and_finish(
@@ -1639,23 +1927,30 @@ class InferenceEngine:
         garbage, as with mid-burst EOS); cancels and admin ops flush the
         pipeline first (_step)."""
         if self.config.pipeline_decode:
-            batch = self._build_batch(self._pipeline)
+            with self._phase("build_batch"):
+                batch = self._build_batch(self._pipeline)
             if batch is None:
                 if self._pipeline:
-                    self._process_burst(self._pipeline.pop(0))
+                    with self._phase("process"):
+                        self._process_burst(self._pipeline.pop(0))
                 return
-            results = self._dispatch_burst(
-                batch, chain=self._pipeline or None
-            )
+            with self._phase("dispatch"):
+                results = self._dispatch_burst(
+                    batch, chain=self._pipeline or None
+                )
             self._pipeline.append({"batch": batch, "results": results})
             if len(self._pipeline) > max(1, self.config.pipeline_depth):
-                self._process_burst(self._pipeline.pop(0))
+                with self._phase("process"):
+                    self._process_burst(self._pipeline.pop(0))
             return
-        batch = self._build_batch(None)
+        with self._phase("build_batch"):
+            batch = self._build_batch(None)
         if batch is None:
             return
-        results = self._dispatch_burst(batch, chain=None)
-        self._process_burst({"batch": batch, "results": results})
+        with self._phase("dispatch"):
+            results = self._dispatch_burst(batch, chain=None)
+        with self._phase("process"):
+            self._process_burst({"batch": batch, "results": results})
 
     def _flush_pipeline(self) -> None:
         """Process every in-flight burst (pipelined mode) so slot state is
@@ -1851,9 +2146,7 @@ class InferenceEngine:
             B = len(self._slots)
             mask = np.zeros((B,), bool)
             idx = np.zeros((B,), np.int32)
-            for row, (slot_idx, _w, slot, _t, _sp, _l) in enumerate(
-                ap["recs"]
-            ):
+            for slot_idx, slot, row in ap["recs"]:
                 if (
                     self._slots[slot_idx] is slot
                     and slot.first_pending
@@ -1891,40 +2184,56 @@ class InferenceEngine:
             sampled, self.k_pages, self.v_pages = result
             lp = top_i = top_v = None
         self.steps += batch["n_burst"]
-        # start the tokens' d2h NOW: by processing time (a cycle later)
-        # the copy has landed and the host asarray is free — the fresh
-        # ~80ms download RTT rides under the next burst's execution
+        # the FED tokens ride along as column 0: freshly admitted slots'
+        # first tokens (still device-only — _fused_first_tokens makes no
+        # host copy) materialize from THIS download when the burst
+        # processes, keeping the whole cycle at ONE device->host
+        # transfer (each costs ~80 ms on the tunneled runtime and they
+        # serialize — per-wave copies measured 2x worse cycle times)
+        combined = jnp.concatenate([tokens_in[:, None], sampled], axis=1)
+        # start the d2h NOW: by processing time (a cycle later) the copy
+        # has landed and the host asarray is free — the fresh download
+        # RTT rides under the next burst's execution
         try:
-            sampled.copy_to_host_async()
+            combined.copy_to_host_async()
         except AttributeError:
             pass
-        return (sampled, lp, top_i, top_v)
+        return (combined, lp, top_i, top_v)
 
     def _process_burst(self, pending: dict) -> None:
         """Sync a dispatched burst's tokens to host; apply stop semantics,
         seal pages, stream items. Participant request-ids guard against a
         slot that finished (and was discarded) between dispatch and
         processing."""
-        if self._admit_waves:
-            # a burst containing slots whose first token hasn't landed
-            # cannot be processed yet — sequence order requires the first
-            # token before burst tokens. Force down exactly those waves.
-            part = pending["batch"]["active"]
-            keep = []
-            for ap in self._admit_waves:
-                if any(
-                    self._slots[si] is s and s.first_pending and part[si]
-                    for si, _w, s, _t, _sp, _l in ap["recs"]
-                ):
-                    self._materialize_one(ap)
-                else:
-                    keep.append(ap)
-            self._admit_waves = keep
         batch = pending["batch"]
         sampled_dev, lp_dev, ti_dev, tv_dev = pending["results"]
         n_burst = batch["n_burst"]
         active = batch["active"]
-        sampled = np.asarray(sampled_dev)  # [B, n_burst]
+        with self._phase("process.d2h_sync"):
+            combined = np.asarray(sampled_dev)  # [B, 1 + n_burst]
+        # column 0 is the burst's FED tokens (_dispatch_burst): the first
+        # tokens of slots admitted into this burst land from this same
+        # download — sequence order (first token before burst tokens)
+        # holds because the wave lands before phase 1 below, and the
+        # cycle needs no second device->host transfer
+        fed_col, sampled = combined[:, 0], combined[:, 1:]
+        if self._admit_waves:
+            part = batch["active"]
+            keep = []
+            for ap in self._admit_waves:
+                if any(
+                    self._slots[si] is s and s.first_pending and part[si]
+                    for si, s, _row in ap["recs"]
+                ):
+                    rest = self._materialize_one(
+                        ap, fed_col=fed_col, fed=ap["fed"], part=part,
+                        participants=batch["participants"],
+                    )
+                    if rest is not None:
+                        keep.append(rest)
+                else:
+                    keep.append(ap)
+            self._admit_waves = keep
         if lp_dev is not None:
             lp = np.asarray(lp_dev)
             top_i = np.asarray(ti_dev)
