@@ -30,10 +30,19 @@ async def _amain(args: argparse.Namespace) -> None:
     rec = await Reconciler(
         hub, args.name, backend, interval_s=args.interval
     ).start()
+    crd_sync = None
+    if args.from_crd:
+        from dynamo_tpu.operator.crd_sync import CrdSync
+
+        crd_sync = await CrdSync(
+            hub, args.name, namespace=args.k8s_namespace
+        ).start()
     print("OPERATOR_READY", flush=True)
     try:
         await asyncio.Event().wait()
     finally:
+        if crd_sync is not None:
+            await crd_sync.close()
         await rec.close()
         await hub.close()
 
@@ -51,6 +60,10 @@ def main(argv=None) -> int:
                    "renders+applies full Deployment/Service objects; "
                    "empty = scale-only (Deployments created externally)")
     p.add_argument("--interval", type=float, default=1.0)
+    p.add_argument("--from-crd", action="store_true",
+                   help="watch the DynamoGraphDeployment CRD on the "
+                   "apiserver (deploy/k8s/crd.yaml) and mirror it into "
+                   "the hub resource + push status back")
     args = p.parse_args(argv)
     setup_logging()
     try:

@@ -59,6 +59,15 @@ class Reconciler:
 
     async def start(self) -> "Reconciler":
         loop = asyncio.get_running_loop()
+        if hasattr(self.backend, "start_watch"):
+            # observed-state watch (informer role): cluster-side changes
+            # wake the loop immediately, and running() becomes a cache
+            # read instead of a kubectl subprocess per service per pass
+            try:
+                await self.backend.start_watch(self._wake.set)
+            except Exception:  # noqa: BLE001 — fall back to polling
+                log.warning("observed-state watch unavailable; polling",
+                            exc_info=True)
         self._task = loop.create_task(self._run())
         self._watch_task = loop.create_task(self._watch_desired())
         return self
