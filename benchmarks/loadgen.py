@@ -25,6 +25,16 @@ import time
 from dataclasses import dataclass, field
 
 
+def pct_ms(xs, p: float, ndigits: int = 3):
+    """Shared percentile-in-milliseconds helper (nearest-rank on a
+    sorted-or-unsorted sample). ONE definition across the benchmark
+    harness so every artifact's percentiles use the same index formula."""
+    if not xs:
+        return None
+    xs = sorted(xs)
+    return round(xs[min(len(xs) - 1, int(p * len(xs)))] * 1e3, ndigits)
+
+
 @dataclass
 class RequestResult:
     ok: bool
@@ -47,11 +57,7 @@ class LoadResult:
         itls = sorted(x for r in ok for x in r.itl_s)
         durs = sorted(r.duration_s for r in ok)
         tokens = sum(r.output_tokens for r in ok)
-
-        def pct(xs, p):
-            if not xs:
-                return None
-            return round(xs[min(len(xs) - 1, int(p * len(xs)))] * 1e3, 3)
+        pct = pct_ms
 
         return {
             "concurrency": self.concurrency,

@@ -13,6 +13,18 @@ the TTFT distributions + prefix-hit blocks are compared.
 
 Run: ``python -m benchmarks.router_bench [--workers 4 --groups 8 ...]``
 Prints one JSON line.
+
+TRACE MODE (``--trace FILE`` or ``--synthesize``): replays a
+mooncake-style JSONL trace — records ``{"timestamp": ms,
+"input_length": N, "output_length": M, "hash_ids": [...]}`` where
+hash_ids name shared-prefix blocks (ref
+benchmarks/router/real_data_benchmark.py + prefix_data_generator/
+synthesizer.py:100-108) — OPEN-LOOP at the trace's own timestamps
+against the same mock fleet, KV-routed vs random, reporting TTFT and
+measured prefix-hit rate. ``--sweep`` replays at several rate
+multipliers and marks the Pareto-efficient (throughput, p99 TTFT)
+points, the role of the reference's benchmark sweep/Pareto machinery
+(benchmarks/utils/benchmark.py).
 """
 
 from __future__ import annotations
@@ -24,6 +36,7 @@ import time
 
 import numpy as np
 
+from benchmarks.loadgen import pct_ms
 from dynamo_tpu.kv_router.protocols import RouterConfig
 from dynamo_tpu.kv_router.router import KvPushRouter, KvRouter
 from dynamo_tpu.mocker.__main__ import launch_mock_worker
@@ -59,6 +72,143 @@ def build_workload(args, seed: int = 0) -> list[list[list[int]]]:
     return waves
 
 
+def synthesize_trace(
+    path: str, *, requests: int = 256, block_size: int = 16,
+    groups: int = 12, depth: int = 6, rate_per_s: float = 48.0,
+    osl: int = 8, seed: int = 0,
+) -> None:
+    """Write a mooncake-style JSONL trace: Poisson arrivals over a
+    radix-structured context tree (each group is a chain of shared
+    blocks; each request reuses a random-depth prefix of its group's
+    chain plus a unique tail block — the same shape the reference
+    synthesizer derives from the real mooncake trace)."""
+    rng = np.random.default_rng(seed)
+    t = 0.0
+    with open(path, "w") as f:
+        for i in range(requests):
+            g = int(rng.integers(0, groups))
+            keep = int(rng.integers(1, depth + 1))
+            hash_ids = [g * 1000 + d for d in range(keep)] + [10_000_000 + i]
+            input_length = len(hash_ids) * block_size
+            t += float(rng.exponential(1.0 / rate_per_s))
+            f.write(json.dumps({
+                "timestamp": int(t * 1000),
+                "input_length": input_length,
+                "output_length": osl,
+                "hash_ids": hash_ids,
+            }) + "\n")
+
+
+def load_trace(path: str, block_size: int) -> list[dict]:
+    """Parse a mooncake-style JSONL trace into replayable requests.
+    Tokens are derived deterministically from each hash id (one block of
+    ``block_size`` tokens per id), so equal hash_ids share prefixes
+    exactly as the trace's radix structure dictates."""
+    block_cache: dict[int, list[int]] = {}
+
+    def block(h: int) -> list[int]:
+        if h not in block_cache:
+            block_cache[h] = (
+                np.random.default_rng(h & 0x7FFFFFFF)
+                .integers(10, 30000, block_size)
+                .tolist()
+            )
+        return block_cache[h]
+
+    out = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            rec = json.loads(line)
+            toks: list[int] = []
+            for h in rec["hash_ids"]:
+                toks.extend(block(h))
+            n = int(rec["input_length"])
+            if len(toks) < n:  # tail beyond the hashed blocks: unique
+                toks.extend(
+                    np.random.default_rng(len(out))
+                    .integers(10, 30000, n - len(toks))
+                    .tolist()
+                )
+            out.append({
+                "t_ms": int(rec["timestamp"]),
+                "token_ids": toks[:n],
+                "osl": int(rec.get("output_length", 8)),
+                "blocks": len(rec["hash_ids"]),
+            })
+    out.sort(key=lambda r: r["t_ms"])
+    return out
+
+
+async def run_trace_mode(router_engine, trace, args, rate_scale: float = 1.0) -> dict:
+    """Open-loop replay at the trace's timestamps (scaled)."""
+    results: list[dict] = []
+
+    async def one(rec: dict, idx: int):
+        req = {
+            "token_ids": rec["token_ids"],
+            "stop_conditions": {"max_tokens": rec["osl"], "ignore_eos": True},
+            "sampling": {"temperature": 0.0},
+        }
+        t0 = time.perf_counter()
+        ttft = cached = None
+        async for item in router_engine.generate(req, Context(f"tr-{idx}")):
+            if ttft is None and item.get("token_ids"):
+                ttft = time.perf_counter() - t0
+                cached = item.get("cached_blocks")
+        results.append({
+            "ttft": ttft,
+            "cached": cached or 0,
+            "blocks": rec["blocks"],
+        })
+
+    start = time.perf_counter()
+    tasks = []
+    for idx, rec in enumerate(trace):
+        target = rec["t_ms"] / 1000.0 / rate_scale
+        now = time.perf_counter() - start
+        if target > now:
+            await asyncio.sleep(target - now)
+        tasks.append(asyncio.create_task(one(rec, idx)))
+    await asyncio.gather(*tasks)
+    elapsed = time.perf_counter() - start
+
+    ttfts = [r["ttft"] for r in results if r["ttft"] is not None]
+    pct = pct_ms
+    total_blocks = sum(r["blocks"] for r in results)
+    return {
+        "requests": len(results),
+        "req_per_s": round(len(results) / elapsed, 2),
+        "ttft_ms_p50": pct(ttfts, 0.5),
+        "ttft_ms_p90": pct(ttfts, 0.9),
+        "ttft_ms_p99": pct(ttfts, 0.99),
+        "ttft_ms_mean": round(float(np.mean(ttfts)) * 1e3, 2),
+        # measured at the serving worker: blocks actually reused / blocks
+        # offered (the routing-quality number the reference's real-data
+        # benchmark reports as cache hit rate)
+        "prefix_hit_rate": round(
+            sum(r["cached"] for r in results) / max(total_blocks, 1), 4
+        ),
+    }
+
+
+def pareto_front(points: list[dict]) -> None:
+    """Mark points not dominated in (max req_per_s, min ttft_ms_p99)."""
+    for p in points:
+        p["pareto"] = not any(
+            q is not p
+            and q["req_per_s"] >= p["req_per_s"]
+            and q["ttft_ms_p99"] <= p["ttft_ms_p99"]
+            and (
+                q["req_per_s"] > p["req_per_s"]
+                or q["ttft_ms_p99"] < p["ttft_ms_p99"]
+            )
+            for q in points
+        )
+
+
 async def run_mode(drt, router_engine, waves, args) -> dict:
     ttfts: list[float] = []  # steady-state only (waves >= 1)
 
@@ -81,16 +231,48 @@ async def run_mode(drt, router_engine, waves, args) -> dict:
             one(f"rb-{r}-{g}", p, r >= measure_from) for g, p in enumerate(wave)
         ))
 
-    def pct(xs, p):
-        xs = sorted(xs)
-        return round(xs[min(len(xs) - 1, int(p * len(xs)))] * 1e3, 2)
-
+    pct = pct_ms
     return {
         "ttft_ms_p50": pct(ttfts, 0.5),
         "ttft_ms_p90": pct(ttfts, 0.9),
         "ttft_ms_p99": pct(ttfts, 0.99),
         "ttft_ms_mean": round(float(np.mean(ttfts)) * 1e3, 2),
     }
+
+
+async def _fleet(args, mode: str):
+    """Fresh mock-worker fleet + router for one measurement run."""
+    drt = DistributedRuntime(InMemoryHub())
+    for _w in range(args.workers):
+        await launch_mock_worker(
+            drt, NS, COMP, EP,
+            MockEngineConfig(
+                block_size=args.block_size,
+                speedup_ratio=args.speedup,
+                total_kv_blocks=args.worker_blocks,
+            ),
+        )
+    ep = drt.namespace(NS).component(COMP).endpoint(EP)
+    push = await PushRouter.from_endpoint(
+        ep,
+        RouterMode.DIRECT if mode == "kv" else RouterMode.RANDOM,
+    )
+    kv_router = None
+    router_engine = push
+    if mode == "kv":
+        kv_router = await KvRouter(
+            drt.hub, f"{NS}/{COMP}",
+            RouterConfig(block_size=args.block_size),
+        ).start()
+        router_engine = KvPushRouter(push, kv_router)
+    return drt, router_engine, push, kv_router
+
+
+async def _teardown(drt, push, kv_router) -> None:
+    if kv_router is not None:
+        await kv_router.close()
+    await push.client.close()
+    await drt.close()
 
 
 async def bench(args) -> dict:
@@ -102,37 +284,10 @@ async def bench(args) -> dict:
         "prefix_ratio": args.prefix_ratio,
             }
     for mode in ("kv", "random"):
-        drt = DistributedRuntime(InMemoryHub())
-        engines = []
-        for _w in range(args.workers):
-            eng, _served = await launch_mock_worker(
-                drt, NS, COMP, EP,
-                MockEngineConfig(
-                    block_size=args.block_size,
-                    speedup_ratio=args.speedup,
-                    total_kv_blocks=args.worker_blocks,
-                ),
-            )
-            engines.append(eng)
-        ep = drt.namespace(NS).component(COMP).endpoint(EP)
-        push = await PushRouter.from_endpoint(
-            ep,
-            RouterMode.DIRECT if mode == "kv" else RouterMode.RANDOM,
-        )
-        kv_router = None
-        router_engine = push
-        if mode == "kv":
-            kv_router = await KvRouter(
-                drt.hub, f"{NS}/{COMP}",
-                RouterConfig(block_size=args.block_size),
-            ).start()
-            router_engine = KvPushRouter(push, kv_router)
+        drt, router_engine, push, kv_router = await _fleet(args, mode)
         waves = build_workload(args)
         out[mode] = await run_mode(drt, router_engine, waves, args)
-        if kv_router is not None:
-            await kv_router.close()
-        await push.client.close()
-        await drt.close()
+        await _teardown(drt, push, kv_router)
     out["ttft_speedup_p50"] = round(
         out["random"]["ttft_ms_p50"] / max(out["kv"]["ttft_ms_p50"], 1e-9),
         2,
@@ -141,6 +296,44 @@ async def bench(args) -> dict:
         out["random"]["ttft_ms_mean"]
         / max(out["kv"]["ttft_ms_mean"], 1e-9),
         2,
+    )
+    return out
+
+
+async def bench_trace(args) -> dict:
+    """Trace-replay comparison: KV-aware vs random routing over the SAME
+    mooncake-style trace, optionally swept over rate multipliers with a
+    Pareto front (ref real_data_benchmark.py + utils/benchmark.py)."""
+    if args.synthesize:
+        synthesize_trace(
+            args.trace, requests=args.trace_requests,
+            block_size=args.block_size, osl=args.osl,
+        )
+    trace = load_trace(args.trace, args.block_size)
+    scales = (
+        [float(s) for s in args.sweep.split(",")] if args.sweep else [1.0]
+    )
+    out: dict = {
+        "trace": args.trace, "records": len(trace),
+        "block_size": args.block_size, "workers": args.workers,
+    }
+    for mode in ("kv", "random"):
+        runs = []
+        for sc in scales:
+            drt, router_engine, push, kv_router = await _fleet(args, mode)
+            res = await run_trace_mode(router_engine, trace, args, sc)
+            res["rate_scale"] = sc
+            runs.append(res)
+            await _teardown(drt, push, kv_router)
+        pareto_front(runs)
+        out[mode] = runs if args.sweep else runs[0]
+    kv0 = out["kv"][0] if args.sweep else out["kv"]
+    rnd0 = out["random"][0] if args.sweep else out["random"]
+    out["ttft_speedup_p50"] = round(
+        rnd0["ttft_ms_p50"] / max(kv0["ttft_ms_p50"], 1e-9), 2
+    )
+    out["hit_rate_gain"] = round(
+        kv0["prefix_hit_rate"] - rnd0["prefix_hit_rate"], 4
     )
     return out
 
@@ -156,8 +349,20 @@ def main(argv=None) -> int:
     p.add_argument("--block-size", type=int, default=16)
     p.add_argument("--worker-blocks", type=int, default=4096)
     p.add_argument("--speedup", type=float, default=10.0)
+    p.add_argument("--trace", default=None,
+                   help="mooncake-style JSONL trace to replay open-loop")
+    p.add_argument("--synthesize", action="store_true",
+                   help="write a synthetic mooncake-style trace to --trace "
+                        "first (in-tree stand-in for the real mooncake data)")
+    p.add_argument("--trace-requests", type=int, default=256)
+    p.add_argument("--sweep", default=None,
+                   help="comma-separated rate multipliers, e.g. 0.5,1,2,4: "
+                        "replay at each and mark the Pareto front")
     args = p.parse_args(argv)
-    print(json.dumps(asyncio.run(bench(args))))
+    if args.trace:
+        print(json.dumps(asyncio.run(bench_trace(args))))
+    else:
+        print(json.dumps(asyncio.run(bench(args))))
     return 0
 
 

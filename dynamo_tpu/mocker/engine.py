@@ -198,12 +198,18 @@ class MockEngine:
                         # EOS): stop regardless of ignore_eos
                         is_eos = True
                     done = generated >= max_tokens or is_eos
-                    yield {
+                    item = {
                         "token_ids": [tok],
                         "finish_reason": (
                             "stop" if is_eos else "length" if done else None
                         ),
                     }
+                    if generated == 1:
+                        # routing-quality observability: how much of the
+                        # prompt the serving worker actually reused (ref
+                        # mocker KvStats / router bench hit-rate surfaces)
+                        item["cached_blocks"] = reused
+                    yield item
                     if done:
                         return
             finally:

@@ -169,3 +169,61 @@ async def test_loadgen_open_loop_arrivals(tmp_path):
         await frontend.stop()
         await watcher.close()
         await drt.close()
+
+
+async def test_router_trace_replay_and_pareto(tmp_path):
+    """Trace-replay router benchmark (VERDICT r4 missing #4): a
+    mooncake-style JSONL trace replays open-loop through KV-aware and
+    random routing; hit rates are measured at the workers and the sweep
+    marks a Pareto front."""
+    import argparse
+
+    from benchmarks.router_bench import (
+        bench_trace,
+        load_trace,
+        pareto_front,
+        synthesize_trace,
+    )
+
+    trace_path = tmp_path / "mooncake.jsonl"
+    synthesize_trace(str(trace_path), requests=40, block_size=8, osl=2)
+    trace = load_trace(str(trace_path), block_size=8)
+    assert len(trace) == 40
+    # shared-prefix structure survives tokenization: two records from the
+    # same group share their leading blocks
+    by_first = {}
+    for r in trace:
+        key = tuple(r["token_ids"][:8])
+        by_first.setdefault(key, 0)
+        by_first[key] += 1
+    assert max(by_first.values()) >= 2, "no shared prefixes in trace"
+    # timestamps are monotone (replay schedule)
+    ts = [r["t_ms"] for r in trace]
+    assert ts == sorted(ts)
+
+    args = argparse.Namespace(
+        workers=2, block_size=8, worker_blocks=2048, speedup=200.0,
+        trace=str(trace_path), synthesize=False, trace_requests=40,
+        sweep="1,4", osl=2,
+    )
+    out = await bench_trace(args)
+    for mode in ("kv", "random"):
+        assert len(out[mode]) == 2
+        for run in out[mode]:
+            assert run["requests"] == 40
+            assert run["ttft_ms_p99"] is not None
+    # KV routing must reuse at least as much prefix as random spray
+    assert (
+        out["kv"][0]["prefix_hit_rate"]
+        >= out["random"][0]["prefix_hit_rate"]
+    )
+    assert any(r["pareto"] for r in out["kv"])
+
+    # pareto_front marks dominance correctly on a crafted set
+    pts = [
+        {"req_per_s": 10, "ttft_ms_p99": 5.0},
+        {"req_per_s": 20, "ttft_ms_p99": 4.0},   # dominates the first
+        {"req_per_s": 30, "ttft_ms_p99": 9.0},
+    ]
+    pareto_front(pts)
+    assert [p["pareto"] for p in pts] == [False, True, True]
