@@ -75,6 +75,13 @@ class PageAllocator:
     def used_pages(self) -> int:
         return (self.num_pages - 1) - len(self._free)
 
+    def used_page_ids(self) -> list[int]:
+        """Every non-free page id (active + cached-inactive), sorted.
+        The SPMD rejoin snapshot transfers exactly these pages — free
+        pages hold no state a replayed descriptor could ever read."""
+        free = set(self._free)
+        return [p for p in range(1, self.num_pages) if p not in free]
+
     @property
     def active_pages(self) -> int:
         return self.used_pages - len(self._inactive)

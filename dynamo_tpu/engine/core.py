@@ -170,6 +170,10 @@ class InferenceEngine:
         self._loop: asyncio.AbstractEventLoop | None = None
         self._loop_thread: int | None = None
         self._wake = threading.Event()
+        if spmd is not None:
+            # a rejoining follower parks until the step loop serves its
+            # state sync; wake an idle loop the moment one arrives
+            spmd.on_sync_request = self._wake.set
         self._closed = False
         self.steps = 0
         self._partial: _PartialPrefill | None = None
@@ -507,6 +511,17 @@ class InferenceEngine:
 
     def _step(self) -> bool:
         did = False
+        if self.spmd is not None and self.spmd.sync_pending:
+            # follower rejoin: quiesce at this step boundary (land every
+            # in-flight burst and admission wave so the KV cache exactly
+            # reflects the descriptors published so far), then hand the
+            # rejoining follower a snapshot of every used page. Lockstep
+            # resumes from the next descriptor (parallel/spmd.py).
+            with self._phase("spmd_sync"):
+                self._flush_pipeline()
+                self._materialize_waves(force=True)
+                self.spmd.serve_sync(self._spmd_sync_state())
+            did = True
         if self._admit_waves:
             # land admission waves LAZILY: each once its device value is
             # ready (the d2h then costs just the residual RTT), or after
@@ -623,6 +638,23 @@ class InferenceEngine:
             self._flush_pipeline()
             did = True
         return did
+
+    def _spmd_sync_state(self) -> dict[str, np.ndarray]:
+        """Quiesced KV snapshot for a rejoining follower: the content of
+        every used page (its shard-identical twin on the follower died
+        with it). Params are not shipped — engine shells init them
+        deterministically from the same seed/checkpoint."""
+        ids = np.asarray(self.allocator.used_page_ids(), np.int32)
+        if ids.size == 0:
+            return {"page_ids": ids}
+        kb, vb = self.fam.extract_pages(
+            self.k_pages, self.v_pages, jnp.asarray(ids)
+        )
+        return {
+            "page_ids": ids,
+            "k": np.asarray(kb),
+            "v": np.asarray(vb),
+        }
 
     def _peek_waiting_tokens(self) -> list | None:
         """Prompt tokens of the next waiting request without dequeuing (the

@@ -312,6 +312,22 @@ async def _amain(args: argparse.Namespace) -> None:
         ep=args.ep,
     )
     spmd_leader = None
+    if args.mirror == "follower":
+        # MIRROR follower: its own local mesh/devices, replaying the
+        # leader's descriptor stream. Unlike the spanning-mesh follower
+        # below, this one survives restarts: on stream loss it rejoins
+        # with a state sync (parallel/spmd.py rejoin protocol).
+        from dynamo_tpu.parallel.spmd import SpmdFollower
+
+        rcfg = RuntimeConfig.from_env()
+        if args.hub:
+            rcfg.hub_address = args.hub
+        hub = await connect_hub(rcfg.hub_address)
+        engine = _build_engine_shell(args, ecfg, hub=hub)
+        group = f"{args.namespace}/{args.component}/{args.endpoint}"
+        print("MIRROR_FOLLOWER_READY", flush=True)
+        await SpmdFollower(hub, group, engine, rejoin=True).run()
+        return
     multihost = initialize_multihost(
         args.coordinator_address, args.num_processes, args.process_id
     )
@@ -348,7 +364,7 @@ async def _amain(args: argparse.Namespace) -> None:
     if args.hub:
         rcfg.hub_address = args.hub
     drt = DistributedRuntime(await connect_hub(rcfg.hub_address), rcfg)
-    if multihost:
+    if multihost or args.mirror == "leader":
         import asyncio as _aio
 
         from dynamo_tpu.parallel.spmd import SpmdLeader
@@ -357,6 +373,9 @@ async def _amain(args: argparse.Namespace) -> None:
         spmd_leader = await SpmdLeader(
             drt.hub, _aio.get_running_loop(), group,
             host=drt.config.host,
+            # mirror topology: follower loss is recoverable (rejoin),
+            # spanning mesh: strict fail-loud (auto-detected)
+            strict=None if multihost else False,
         ).start()
     health = None
     status_server = None
@@ -478,6 +497,11 @@ def main() -> None:
                    help="canary probe interval (s)")
     p.add_argument("--health-timeout", type=float, default=5.0,
                    help="canary probe timeout (s)")
+    p.add_argument("--mirror", default=None, choices=["leader", "follower"],
+                   help="descriptor-mirror topology WITHOUT a spanning "
+                        "jax.distributed mesh: each process runs its own "
+                        "local mesh and followers replay + state-sync "
+                        "rejoin after restarts")
     p.add_argument("--coordinator-address", default=None,
                    help="multi-host jax.distributed coordinator "
                         "(or DYN_COORDINATOR); all hosts of one worker "

@@ -51,6 +51,11 @@ log = logging.getLogger("dynamo.spmd")
 ADDR_KEY_FMT = "spmd/{group}/addr"
 RING_FRAMES = 1024  # catch-up window cap (descriptors)
 RING_BYTES = 64 * 1024 * 1024  # catch-up window cap (payload bytes)
+SYNC_CHUNK_BYTES = 64 * 1024 * 1024  # rejoin snapshot chunk (< MAX_FRAME)
+
+# queue sentinel: the leader dropped this follower (stopped draining);
+# closing its stream makes the loss VISIBLE so it re-syncs
+_DROPPED = object()
 
 
 def _enc(arr: np.ndarray) -> dict[str, Any]:
@@ -79,7 +84,7 @@ class SpmdLeader:
     """
 
     def __init__(self, hub, loop: asyncio.AbstractEventLoop, group: str,
-                 host: str = "127.0.0.1"):
+                 host: str = "127.0.0.1", strict: bool | None = None):
         self.hub = hub
         self.loop = loop
         self.group = group
@@ -87,6 +92,28 @@ class SpmdLeader:
         self.publish_failures = 0
         self.publish_count = 0  # monotonic; lets callers scope failures
         self._broken = False
+        # STRICT mode: any follower loss latches the plane broken. This
+        # is the only honest policy when the mesh SPANS processes
+        # (jax.distributed is not elastic — a dead process hangs the next
+        # collective; ranks restart together, exactly like the
+        # reference's NCCL/MPI worlds). In MIRROR topologies (each
+        # process runs its own local mesh and replays descriptors), a
+        # lost follower is recoverable: the leader keeps serving and the
+        # restarted follower re-joins with a state sync (hello
+        # {"sync": true} -> quiesced KV snapshot -> live stream).
+        if strict is None:
+            try:
+                import jax
+
+                strict = jax.process_count() > 1
+            except Exception:  # noqa: BLE001
+                strict = False
+        self.strict = strict
+        # rejoin state-sync requests parked until the engine reaches a
+        # step boundary (serve_sync); count readable cross-thread
+        self._sync_waiting: list[asyncio.Future] = []
+        self._sync_pending = 0
+        self.on_sync_request = None  # engine wake hook (set by engine)
         # catch-up ring: bounded by frames AND payload bytes (decode
         # descriptors are tens of KB at production batch shapes; an
         # unbounded byte footprint would pin hundreds of MB per worker)
@@ -129,11 +156,32 @@ class SpmdLeader:
         if hello is None:
             writer.close()
             return
+        if hello.get("sync"):
+            # REJOIN: instead of a descriptor backlog, this follower gets
+            # a quiesced state snapshot. Park until the engine reaches a
+            # step boundary and calls serve_sync (on_sync_request wakes
+            # an idle step loop), then stream the snapshot + live frames.
+            # (A requester that dies while parked costs the engine one
+            # wasted quiesce — bounded per connection attempt.)
+            fut: asyncio.Future = self.loop.create_future()
+            self._sync_waiting.append(fut)
+            self._sync_pending += 1
+            if self.on_sync_request is not None:
+                self.on_sync_request()
+            log.info("spmd follower %s requested rejoin sync", peer)
+            try:
+                sync_frames, q = await fut
+            except asyncio.CancelledError:
+                writer.close()
+                raise
+            await self._stream_to(peer, writer, q, sync_frames)
+            return
         from_seq = int(hello.get("from_seq", 0))
         oldest = self._ring[0][0] if self._ring else self._loop_seq + 1
         if from_seq + 1 < oldest:
             # history beyond the catch-up window: joining would silently
-            # desync — refuse loudly
+            # desync — refuse loudly (the follower falls back to a sync
+            # rejoin)
             await write_frame(writer, {
                 "op": "__reject__",
                 "scalars": {"reason": f"catch-up window exceeded "
@@ -141,9 +189,10 @@ class SpmdLeader:
                 "arrays": {},
             })
             writer.close()
-            self.mark_broken(
-                f"follower {peer} beyond catch-up window"
-            )
+            if self.strict:
+                self.mark_broken(
+                    f"follower {peer} beyond catch-up window"
+                )
             return
         # bounded to the SAME window as the catch-up ring: a join within
         # the advertised window must never be broken by publishes landing
@@ -157,20 +206,100 @@ class SpmdLeader:
         self._conns.append(q)
         log.info("spmd follower %s joined (%d backlog frames)",
                  peer, len(backlog))
+        await self._stream_to(peer, writer, q, backlog)
+
+    async def _stream_to(self, peer, writer, q: asyncio.Queue,
+                         first_frames) -> None:
+        """Shared send loop for both join paths: initial frames (backlog
+        or sync snapshot), then live queue frames until the connection
+        ends or the leader dropped this follower (_DROPPED sentinel —
+        closing the stream makes the drop visible so it re-syncs)."""
         try:
-            for f in backlog:
+            for f in first_frames:
                 await write_frame(writer, f)
             while True:
                 frame = await q.get()
+                if frame is _DROPPED:
+                    break
                 await write_frame(writer, frame)
         except asyncio.CancelledError:
             raise  # orderly teardown, not a broken plane
         except (ConnectionError, OSError) as e:
-            self.mark_broken(f"follower {peer} connection lost: {e}")
+            self._follower_lost(peer, e)
         finally:
             if q in self._conns:
                 self._conns.remove(q)
             writer.close()
+
+    def _follower_lost(self, peer, err) -> None:
+        """Connection-loss policy: spanning mesh -> latch broken (the
+        next collective would hang anyway); mirror topology -> keep
+        serving, the follower re-syncs when it comes back."""
+        if self.strict:
+            self.mark_broken(f"follower {peer} connection lost: {err}")
+        else:
+            log.warning(
+                "spmd follower %s lost (%s); serving continues, "
+                "awaiting rejoin", peer, err,
+            )
+
+    @property
+    def sync_pending(self) -> int:
+        """Rejoin syncs waiting for the engine's next step boundary."""
+        return self._sync_pending
+
+    def serve_sync(self, state: dict[str, np.ndarray]) -> None:
+        """Resolve every parked rejoin with a quiesced state snapshot.
+        Called from the engine's step THREAD at a step boundary (pipeline
+        flushed, admission waves landed) so the snapshot is exact; the
+        queue registration happens on the loop BEFORE any later
+        publish's _enqueue callback, so the follower sees snapshot ->
+        every subsequent descriptor with no gap.
+
+        The snapshot is CHUNKED along the page axis: a production cache
+        runs to GBs, far past the wire codec's MAX_FRAME — each chunk
+        stays under SYNC_CHUNK_BYTES and the follower installs chunks as
+        they arrive (the final chunk carries ``last``)."""
+        frames: list[dict] = []
+        ids = state.get("page_ids")
+        seq = self.publish_count
+        if ids is None or ids.size == 0 or "k" not in state:
+            frames.append({
+                "op": "__sync__",
+                "scalars": {"seq": seq, "last": True},
+                "arrays": {"page_ids": _enc(np.zeros((0,), np.int32))},
+            })
+        else:
+            k, v = state["k"], state["v"]
+            per_page = max(1, (k.nbytes + v.nbytes) // max(1, ids.size))
+            step = max(1, int(SYNC_CHUNK_BYTES // per_page))
+            for i0 in range(0, int(ids.size), step):
+                i1 = min(int(ids.size), i0 + step)
+                frames.append({
+                    "op": "__sync__",
+                    "scalars": {"seq": seq, "last": i1 == ids.size},
+                    "arrays": {
+                        "page_ids": _enc(ids[i0:i1]),
+                        # page axis is dim 1 (extract_pages layout)
+                        "k": _enc(k[:, i0:i1]),
+                        "v": _enc(v[:, i0:i1]),
+                    },
+                })
+        self._sync_pending = 0
+
+        def _resolve() -> None:
+            waiting, self._sync_waiting = self._sync_waiting, []
+            for fut in waiting:
+                if fut.done():
+                    continue
+                q: asyncio.Queue = asyncio.Queue(maxsize=RING_FRAMES)
+                self._conns.append(q)
+                fut.set_result((frames, q))
+
+        try:
+            self.loop.call_soon_threadsafe(_resolve)
+        except RuntimeError:
+            pass  # loop closed during shutdown
 
     def publish(self, op: str, scalars: dict[str, Any] | None = None,
                 arrays: dict[str, np.ndarray] | None = None) -> None:
@@ -203,10 +332,27 @@ class SpmdLeader:
                     q.put_nowait(msg)
                 except asyncio.QueueFull:
                     self._conns.remove(q)
-                    self.mark_broken(
-                        "follower stopped draining descriptors "
-                        f"({q.qsize()} backlogged)"
-                    )
+                    # make the drop VISIBLE to the follower: flush the
+                    # backlog and leave only the sentinel, so its stream
+                    # closes at a clean frame boundary (applying frames
+                    # past a gap would diverge its replay; a silently-
+                    # frozen stream would never trigger the rejoin)
+                    try:
+                        while True:
+                            q.get_nowait()
+                    except asyncio.QueueEmpty:
+                        pass
+                    q.put_nowait(_DROPPED)
+                    if self.strict:
+                        self.mark_broken(
+                            "follower stopped draining descriptors "
+                            f"({q.qsize()} backlogged)"
+                        )
+                    else:
+                        log.warning(
+                            "spmd follower stopped draining; dropped "
+                            "(it will rejoin with a state sync)"
+                        )
 
         try:
             self.loop.call_soon_threadsafe(_enqueue)
@@ -237,7 +383,7 @@ class SpmdFollower:
     different program and desynchronize the collectives.
     """
 
-    def __init__(self, hub, group: str, engine):
+    def __init__(self, hub, group: str, engine, rejoin: bool | None = None):
         self.hub = hub
         self.group = group
         self.engine = engine
@@ -247,6 +393,19 @@ class SpmdFollower:
         # chain would misalign every mask
         depth = int(getattr(engine.config, "pipeline_depth", 2) or 2)
         self._pending: deque = deque(maxlen=max(8, depth + 2))
+        # rejoin: on stream loss, reconnect with a state-sync join
+        # instead of dying. Only valid in MIRROR topologies (local mesh
+        # per process); a spanning jax.distributed mesh is not elastic.
+        if rejoin is None:
+            try:
+                import jax
+
+                rejoin = jax.process_count() == 1
+            except Exception:  # noqa: BLE001
+                rejoin = True
+        self.rejoin = rejoin
+        self.rejoins = 0  # completed state-sync rejoins (test hook)
+        self._sync_pages = 0  # pages installed across the current sync
 
     async def _leader_addr(self, timeout: float = 60.0) -> str:
         key = ADDR_KEY_FMT.format(group=self.group)
@@ -260,15 +419,30 @@ class SpmdFollower:
             await asyncio.sleep(0.2)
 
     async def run(self) -> None:
-        import jax.numpy as jnp
-
-        eng = self.engine
-        fam = eng.fam  # family adapter: replay works for GQA AND MLA
-        spec, mesh = eng.spec, eng.mesh
+        """Replay forever; in rejoin mode a lost stream (leader dropped
+        us, network blip, or we restarted) reconnects with a state-sync
+        join and resumes lockstep from the snapshot."""
         import os
-        import time as _time
 
-        trace = os.environ.get("DYNAMO_SPMD_TRACE") == "1"
+        # a RESTARTED follower process can skip the backlog attempt and
+        # go straight to the snapshot (a fresh process's from_seq=0 only
+        # works while the leader's ring still reaches back to seq 1)
+        sync_join = os.environ.get("DYNAMO_SPMD_SYNC_JOIN") == "1"
+        while True:
+            try:
+                await self._run_once(sync_join)
+                return  # leader sent "stop": orderly end
+            except ConnectionError as e:
+                if not self.rejoin:
+                    raise
+                log.warning(
+                    "spmd stream lost (%s); rejoining with state sync", e
+                )
+                self._pending.clear()
+                sync_join = True
+                await asyncio.sleep(0.2)
+
+    async def _run_once(self, sync_join: bool) -> None:
         # the hub key may briefly hold a PREVIOUS leader's address
         # (leader restarting): retry connect, re-reading the key
         deadline = asyncio.get_running_loop().time() + 60.0
@@ -286,8 +460,26 @@ class SpmdFollower:
                         f"spmd leader at {addr} unreachable: {e}"
                     ) from e
                 await asyncio.sleep(0.3)
-        await write_frame(writer, {"from_seq": 0})
-        log.info("spmd follower replaying from %s", addr)
+        await write_frame(writer, {"from_seq": 0, "sync": sync_join})
+        log.info(
+            "spmd follower replaying from %s%s", addr,
+            " (sync join)" if sync_join else "",
+        )
+        try:
+            await self._replay(reader, writer)
+        finally:
+            writer.close()  # a replay abort must not leak the socket
+
+    async def _replay(self, reader, writer) -> None:
+        import os
+        import time as _time
+
+        import jax.numpy as jnp
+
+        eng = self.engine
+        fam = eng.fam  # family adapter: replay works for GQA AND MLA
+        spec, mesh = eng.spec, eng.mesh
+        trace = os.environ.get("DYNAMO_SPMD_TRACE") == "1"
         t_prev = _time.perf_counter()
         while True:
             msg = await read_frame(reader)
@@ -309,9 +501,37 @@ class SpmdFollower:
                 writer.close()
                 return
             if op == "__reject__":
+                if self.rejoin:
+                    # beyond the catch-up window: fall back to a fresh
+                    # state-sync join instead of dying
+                    raise ConnectionError(
+                        f"join rejected ({sc.get('reason')})"
+                    )
                 raise RuntimeError(
                     f"spmd leader rejected join: {sc.get('reason')}"
                 )
+            if op == "__sync__":
+                # rejoin snapshot (possibly one of several chunks):
+                # install the leader's quiesced KV pages. Params are
+                # deterministic — same init/checkpoint — and the leader
+                # flushed its pipeline, so the chain mirror starts empty.
+                ids = ar["page_ids"].astype(np.int32)
+                if ids.size:
+                    eng.k_pages, eng.v_pages = fam.insert_pages(
+                        eng.k_pages, eng.v_pages, jnp_i32(ids),
+                        jnp.asarray(ar["k"]), jnp.asarray(ar["v"]),
+                    )
+                self._sync_pages += int(ids.size)
+                if sc.get("last", True):
+                    self._pending.clear()
+                    self.rejoins += 1
+                    log.info(
+                        "spmd rejoin complete: %d pages synced at seq %s",
+                        self._sync_pages, sc.get("seq"),
+                    )
+                    self._sync_pages = 0
+                t_prev = _time.perf_counter()
+                continue
             # every branch matches one leader dispatch site in
             # engine/core.py; keep in lockstep with it. All model calls
             # go through the family adapter so the compiled programs are
@@ -363,9 +583,22 @@ class SpmdFollower:
                         [int(h) for h in sc["hashes"]], kb, vb
                     )
             elif op == "kv_onboard":
+                hashes = [int(h) for h in sc["hashes"]]
+                if (
+                    self.rejoins
+                    and eng.kvbm is not None
+                    and any(h not in eng.kvbm for h in hashes)
+                ):
+                    # this process's tier copy died with the pre-restart
+                    # incarnation; zero-filling would silently diverge
+                    # the mirror. The leader just onboarded these blocks
+                    # to DEVICE pages, so a fresh state sync recovers
+                    # them exactly.
+                    raise ConnectionError(
+                        "kvbm tier miss after rejoin; re-syncing"
+                    )
                 eng.onboard_from_tiers(
-                    [int(h) for h in sc["hashes"]],
-                    ar["page_ids"].astype(np.int32),
+                    hashes, ar["page_ids"].astype(np.int32),
                 )
             elif op == "decode":
                 tokens_in = jnp_i32(ar["tokens"])

@@ -400,3 +400,145 @@ def test_two_process_dispatch_plane_not_per_step_bound():
     # steps ride one frame (would indicate per-step serialization
     # sneaking back in); generous margin for CPU scheduler noise
     assert itl_b4 < itl_b1 * 2.0, (itl_b1, itl_b4)
+
+
+def test_mirror_follower_kill_and_rejoin():
+    """SPMD follower rejoin (VERDICT r4 weak #6): mirror topology (one
+    local mesh per process), SIGKILL the follower mid-serving. The
+    leader must keep serving through the gap (no restart), and the
+    restarted follower must rejoin through the state-sync protocol and
+    resume descriptor replay."""
+    import signal
+
+    procs: list[subprocess.Popen] = []
+    try:
+        _hub_p, hub_addr = _spawn(
+            ["-m", "dynamo_tpu.runtime.hub_server", "--port", "0"],
+            "DYNAMO_HUB=", procs,
+            env={**os.environ, "PYTHONPATH": REPO},
+        )
+        worker_args = [
+            "-m", "dynamo_tpu.engine.worker", "--hub", hub_addr,
+            "--model", "tiny-test",
+            "--page-size", "4", "--num-pages", "64",
+            "--max-pages-per-seq", "16", "--max-decode-slots", "2",
+            "--decode-steps-per-dispatch", "2",
+        ]
+        leader_p, _ = _spawn(
+            [*worker_args, "--mirror", "leader"], "ENGINE_READY", procs,
+        )
+        leader_lines: list[str] = []
+        threading.Thread(
+            target=lambda: leader_lines.extend(leader_p.stdout), daemon=True
+        ).start()
+
+        def spawn_follower(sync: bool):
+            env = _env({"DYNAMO_SPMD_TRACE": "1"})
+            if sync:
+                env["DYNAMO_SPMD_SYNC_JOIN"] = "1"
+            p = subprocess.Popen(
+                [sys.executable, *worker_args, "--mirror", "follower"],
+                stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+                text=True, cwd=REPO, env=env,
+            )
+            procs.append(p)
+            lines: list[str] = []
+            threading.Thread(
+                target=lambda: lines.extend(p.stdout), daemon=True
+            ).start()
+            deadline = time.time() + 60
+            while time.time() < deadline:
+                if any("MIRROR_FOLLOWER_READY" in ln for ln in lines):
+                    return p, lines
+                if p.poll() is not None:
+                    raise RuntimeError(
+                        f"follower exited rc={p.poll()}\n" + "".join(lines)
+                    )
+                time.sleep(0.1)
+            raise RuntimeError("follower never became ready")
+
+        follower, f_lines = spawn_follower(sync=False)
+
+        _frontend_p, http_addr = _spawn(
+            ["-m", "dynamo_tpu.frontend", "--hub", hub_addr,
+             "--host", "127.0.0.1", "--port", "0"],
+            "DYNAMO_HTTP=", procs,
+            env={**os.environ, "PYTHONPATH": REPO},
+        )
+        base = f"http://{http_addr}"
+
+        def complete(prompt: str) -> dict:
+            req = urllib.request.Request(
+                f"{base}/v1/completions",
+                data=json.dumps({
+                    "model": "tiny-test", "prompt": prompt,
+                    "max_tokens": 4, "temperature": 0.0,
+                    "ignore_eos": True,
+                }).encode(),
+                headers={"Content-Type": "application/json"},
+            )
+            with urllib.request.urlopen(req, timeout=90) as r:
+                assert r.status == 200
+                return json.load(r)
+
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            try:
+                with urllib.request.urlopen(
+                    f"{base}/v1/models", timeout=5
+                ) as r:
+                    if json.load(r)["data"]:
+                        break
+            except OSError:
+                pass
+            time.sleep(0.2)
+
+        body = complete("m one")
+        assert body["usage"]["completion_tokens"] == 4
+        # the follower replayed the decode descriptors
+        deadline = time.time() + 20
+        while time.time() < deadline and not any(
+            "op=decode" in ln for ln in f_lines
+        ):
+            time.sleep(0.1)
+        assert any("op=decode" in ln for ln in f_lines), "".join(f_lines)
+
+        # kill -9 the follower mid-operation
+        follower.send_signal(signal.SIGKILL)
+        follower.wait()
+
+        # leader keeps serving THROUGH the gap (tolerant mirror plane)
+        body = complete("m two gap")
+        assert body["usage"]["completion_tokens"] == 4
+
+        # restart the follower: state-sync rejoin, then live replay
+        follower2, f2_lines = spawn_follower(sync=True)
+        deadline = time.time() + 30
+        while time.time() < deadline and not any(
+            "rejoin complete" in ln for ln in f2_lines
+        ):
+            time.sleep(0.1)
+        assert any("rejoin complete" in ln for ln in f2_lines), (
+            "".join(f2_lines)[-2000:]
+        )
+
+        # serving continues and the NEW follower replays the new bursts
+        body = complete("m three")
+        assert body["usage"]["completion_tokens"] == 4, (
+            body, "".join(leader_lines)[-3000:]
+        )
+        deadline = time.time() + 20
+        while time.time() < deadline and not any(
+            "op=decode" in ln for ln in f2_lines
+        ):
+            time.sleep(0.1)
+        assert any("op=decode" in ln for ln in f2_lines)
+        assert follower2.poll() is None  # alive and replaying
+    finally:
+        for p in procs:
+            p.terminate()
+        for p in procs:
+            try:
+                p.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                p.kill()
