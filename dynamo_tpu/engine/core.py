@@ -573,8 +573,17 @@ class InferenceEngine:
             # the budget exists to bound how long prefills stall RUNNING
             # decode streams; on a cold batch (nothing decoding) it only
             # serializes admissions across steps and inflates TTFT —
-            # admit everything the slots can hold in one step instead
+            # admit up to HALF the slots in one step instead. The half
+            # cap is a convoy breaker: admitting a whole cold wave at
+            # once locks closed-loop clients into lockstep (every
+            # request starts, decodes, and finishes together, so tokens
+            # clump at wave boundaries and throughput halves — measured
+            # as the 1.8k-tok/s attractor in the r5 ladder); two
+            # staggered cohorts interleave their prefills and decode
+            # bursts instead.
             decoding = any(s is not None for s in self._slots)
+            cold_cap = max(1, (len(self._slots) + 1) // 2)
+            n_admitted = 0
             admitted = False
             pending: list[tuple] = []
             preps: list[dict] = []
@@ -597,6 +606,8 @@ class InferenceEngine:
                 cost = min(cost, self._prefill_chunk_max())
                 if admitted and cost > budget and decoding:
                     break  # first admission always proceeds
+                if not decoding and n_admitted >= cold_cap:
+                    break  # stagger the cold wave (convoy breaker)
                 waiting = self._waiting.get_nowait()
                 if waiting.context.is_stopped:
                     self._drop_staged_kv(waiting.request)
@@ -614,6 +625,7 @@ class InferenceEngine:
                         reserved.add(free_idx)
                     budget -= cost
                     admitted = True
+                    n_admitted += 1
                 did = True
             if self._profiling and admitted:
                 rec = self._prof.setdefault("admit_loop", [0.0, 0])
@@ -639,22 +651,41 @@ class InferenceEngine:
             did = True
         return did
 
-    def _spmd_sync_state(self) -> dict[str, np.ndarray]:
-        """Quiesced KV snapshot for a rejoining follower: the content of
-        every used page (its shard-identical twin on the follower died
-        with it). Params are not shipped — engine shells init them
-        deterministically from the same seed/checkpoint."""
+    def _spmd_sync_state(self) -> list[tuple]:
+        """Quiesced KV snapshot for a rejoining follower, as a list of
+        ``(page_ids, k, v)`` numpy chunks. Chunked at EXTRACTION, not
+        just on the wire: materializing a multi-GB cache to host in one
+        asarray would double host RAM and stall the step thread for the
+        whole transfer — each chunk bounds the host copy to the wire
+        codec's chunk budget. Params are not shipped — engine shells
+        init them deterministically from the same seed/checkpoint."""
+        from dynamo_tpu.parallel.spmd import SYNC_CHUNK_BYTES
+
         ids = np.asarray(self.allocator.used_page_ids(), np.int32)
         if ids.size == 0:
-            return {"page_ids": ids}
-        kb, vb = self.fam.extract_pages(
-            self.k_pages, self.v_pages, jnp.asarray(ids)
+            return []
+        cache_bytes = sum(
+            x.size * x.dtype.itemsize
+            for x in jax.tree.leaves((self.k_pages, self.v_pages))
         )
-        return {
-            "page_ids": ids,
-            "k": np.asarray(kb),
-            "v": np.asarray(vb),
-        }
+        per_page = max(1, cache_bytes // max(1, self.config.num_pages + 1))
+        step = max(1, int(SYNC_CHUNK_BYTES // per_page))
+        chunks: list[tuple] = []
+        for i0 in range(0, int(ids.size), step):
+            sub = ids[i0: i0 + step]
+            # pad to a power-of-two width by repeating the last id: one
+            # compiled extract shape per size tier, not per used-page
+            # count (each fresh jit shape costs seconds on TPU; the
+            # duplicate rows re-insert identical content harmlessly)
+            bucket = 1 << max(0, int(sub.size) - 1).bit_length()
+            padded = np.concatenate(
+                [sub, np.full((bucket - sub.size,), sub[-1], np.int32)]
+            )
+            kb, vb = self.fam.extract_pages(
+                self.k_pages, self.v_pages, jnp.asarray(padded)
+            )
+            chunks.append((padded, np.asarray(kb), np.asarray(vb)))
+        return chunks
 
     def _peek_waiting_tokens(self) -> list | None:
         """Prompt tokens of the next waiting request without dequeuing (the

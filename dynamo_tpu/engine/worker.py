@@ -307,6 +307,7 @@ async def _amain(args: argparse.Namespace) -> None:
         max_decode_slots=args.max_decode_slots,
         decode_steps_per_dispatch=args.decode_steps_per_dispatch,
         pipeline_decode=args.decode_steps_per_dispatch > 1,
+        max_prefill_chunk_tokens=args.max_prefill_chunk_tokens,
         tp=args.tp,
         sp=args.sp,
         ep=args.ep,
@@ -456,6 +457,10 @@ def main() -> None:
     p.add_argument("--decode-steps-per-dispatch", type=int, default=1,
                    help=">1 fuses N decode steps per dispatch and enables "
                         "the pipelined (depth-2) burst schedule")
+    p.add_argument("--max-prefill-chunk-tokens", type=int, default=512,
+                   help="chunked-prefill dispatch cap; multimodal prompts "
+                        "must fit ONE dispatch (a 576-row CLIP-L image "
+                        "span needs >= 1024)")
     p.add_argument("--tp", type=int, default=1)
     p.add_argument("--sp", type=int, default=1,
                    help="sequence-parallel ring-attention prefill width")

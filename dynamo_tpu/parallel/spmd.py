@@ -248,7 +248,7 @@ class SpmdLeader:
         """Rejoin syncs waiting for the engine's next step boundary."""
         return self._sync_pending
 
-    def serve_sync(self, state: dict[str, np.ndarray]) -> None:
+    def serve_sync(self, chunks: list[tuple]) -> None:
         """Resolve every parked rejoin with a quiesced state snapshot.
         Called from the engine's step THREAD at a step boundary (pipeline
         flushed, admission waves landed) so the snapshot is exact; the
@@ -256,33 +256,28 @@ class SpmdLeader:
         publish's _enqueue callback, so the follower sees snapshot ->
         every subsequent descriptor with no gap.
 
-        The snapshot is CHUNKED along the page axis: a production cache
-        runs to GBs, far past the wire codec's MAX_FRAME — each chunk
-        stays under SYNC_CHUNK_BYTES and the follower installs chunks as
-        they arrive (the final chunk carries ``last``)."""
-        frames: list[dict] = []
-        ids = state.get("page_ids")
+        ``chunks`` is a list of (page_ids, k, v) numpy chunks, already
+        sized under SYNC_CHUNK_BYTES at extraction (a production cache
+        runs to GBs, far past the wire codec's MAX_FRAME and far past
+        what the leader host should materialize at once); the follower
+        installs chunks as they arrive (the final carries ``last``)."""
         seq = self.publish_count
-        if ids is None or ids.size == 0 or "k" not in state:
+        frames: list[dict] = []
+        if not chunks:
             frames.append({
                 "op": "__sync__",
                 "scalars": {"seq": seq, "last": True},
                 "arrays": {"page_ids": _enc(np.zeros((0,), np.int32))},
             })
         else:
-            k, v = state["k"], state["v"]
-            per_page = max(1, (k.nbytes + v.nbytes) // max(1, ids.size))
-            step = max(1, int(SYNC_CHUNK_BYTES // per_page))
-            for i0 in range(0, int(ids.size), step):
-                i1 = min(int(ids.size), i0 + step)
+            for i, (ids, k, v) in enumerate(chunks):
                 frames.append({
                     "op": "__sync__",
-                    "scalars": {"seq": seq, "last": i1 == ids.size},
+                    "scalars": {"seq": seq, "last": i == len(chunks) - 1},
                     "arrays": {
-                        "page_ids": _enc(ids[i0:i1]),
-                        # page axis is dim 1 (extract_pages layout)
-                        "k": _enc(k[:, i0:i1]),
-                        "v": _enc(v[:, i0:i1]),
+                        "page_ids": _enc(ids),
+                        "k": _enc(k),
+                        "v": _enc(v),
                     },
                 })
         self._sync_pending = 0
@@ -292,7 +287,14 @@ class SpmdLeader:
             for fut in waiting:
                 if fut.done():
                     continue
-                q: asyncio.Queue = asyncio.Queue(maxsize=RING_FRAMES)
+                # UNBOUNDED live queue for a syncing follower: the
+                # snapshot takes seconds to cross the wire at production
+                # cache sizes, during which the leader keeps publishing —
+                # a bounded queue would overflow mid-snapshot and drop
+                # the rejoiner into an endless quiesce/re-sync cycle.
+                # Memory is bounded by publish-rate x transfer-time and
+                # transient; once the snapshot lands the queue drains.
+                q: asyncio.Queue = asyncio.Queue()
                 self._conns.append(q)
                 fut.set_result((frames, q))
 
@@ -332,6 +334,7 @@ class SpmdLeader:
                     q.put_nowait(msg)
                 except asyncio.QueueFull:
                     self._conns.remove(q)
+                    backlog = q.qsize()
                     # make the drop VISIBLE to the follower: flush the
                     # backlog and leave only the sentinel, so its stream
                     # closes at a clean frame boundary (applying frames
@@ -346,7 +349,7 @@ class SpmdLeader:
                     if self.strict:
                         self.mark_broken(
                             "follower stopped draining descriptors "
-                            f"({q.qsize()} backlogged)"
+                            f"({backlog} backlogged)"
                         )
                     else:
                         log.warning(
@@ -406,6 +409,9 @@ class SpmdFollower:
         self.rejoin = rejoin
         self.rejoins = 0  # completed state-sync rejoins (test hook)
         self._sync_pages = 0  # pages installed across the current sync
+        # pre-restart tier hashes that already bought one re-sync: a
+        # second miss zero-fills loudly instead of looping quiesces
+        self._tier_missed: set[int] = set()
 
     async def _leader_addr(self, timeout: float = 60.0) -> str:
         key = ADDR_KEY_FMT.format(group=self.group)
@@ -584,18 +590,32 @@ class SpmdFollower:
                     )
             elif op == "kv_onboard":
                 hashes = [int(h) for h in sc["hashes"]]
-                if (
-                    self.rejoins
-                    and eng.kvbm is not None
-                    and any(h not in eng.kvbm for h in hashes)
-                ):
+                missing = (
+                    [h for h in hashes if h not in eng.kvbm]
+                    if self.rejoins and eng.kvbm is not None else []
+                )
+                fresh_miss = [
+                    h for h in missing if h not in self._tier_missed
+                ]
+                if fresh_miss:
                     # this process's tier copy died with the pre-restart
-                    # incarnation; zero-filling would silently diverge
-                    # the mirror. The leader just onboarded these blocks
-                    # to DEVICE pages, so a fresh state sync recovers
-                    # them exactly.
+                    # incarnation; a fresh state sync recovers the
+                    # leader's post-onboard DEVICE pages exactly. ONE
+                    # re-sync per hash: tier content itself is
+                    # unrecoverable (it died with the old process), so a
+                    # second miss of the same hash falls through to the
+                    # loud zero-fill instead of looping quiesces forever.
+                    self._tier_missed.update(fresh_miss)
                     raise ConnectionError(
-                        "kvbm tier miss after rejoin; re-syncing"
+                        f"kvbm tier miss after rejoin "
+                        f"({len(fresh_miss)} blocks); re-syncing"
+                    )
+                if missing:
+                    log.error(
+                        "kvbm onboard of %d pre-restart blocks after "
+                        "re-sync: tier data unrecoverable, shard "
+                        "zero-fills (mirror fidelity degraded until the "
+                        "blocks cycle out)", len(missing),
                     )
                 eng.onboard_from_tiers(
                     hashes, ar["page_ids"].astype(np.int32),
