@@ -1,0 +1,9 @@
+"""Inference-gateway integration: the endpoint-picker (EPP) role of the
+Kubernetes Gateway API inference extension (ref
+deploy/inference-gateway/ — the reference patches the upstream EPP with
+a ``dyn-kv`` plugin that calls the dynamo router; here the picker IS the
+router, exposed over the HTTP contract gateways consume)."""
+
+from dynamo_tpu.gateway.epp import EndpointPicker
+
+__all__ = ["EndpointPicker"]

@@ -1,0 +1,4 @@
+from dynamo_tpu.gateway.epp import main
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,223 @@
+"""Endpoint picker (EPP): cluster-native KV-aware routing decisions for
+an inference gateway.
+
+The reference integrates with the Kubernetes Gateway API inference
+extension by patching the upstream EPP with a ``dyn-kv`` plugin whose
+decision comes from the dynamo router (ref
+deploy/inference-gateway/README.md + epp-patches/ — the plugin's selling
+point over the stock EPP is MODEL-AWARE tokenization: the router runs
+the deployed model's tokenizer inline instead of a generic
+approximation). Here the picker IS the router, served over HTTP:
+
+  POST /pick   {"model": ..., "prompt": ...}        (or "token_ids")
+        -> 200 {"worker_id": ..., "endpoint": "host:port",
+                "overlap_blocks": N}
+           + x-gateway-destination-endpoint: host:port   (GIE header
+           convention — ext-proc based gateways copy it onto the
+           upstream route)
+  GET  /healthz -> 200
+
+The prompt tokenizes with the TARGET MODEL's tokenizer (discovered from
+its model card), the KV router scores workers by radix overlap + load,
+and the instance registry resolves the winner's serving address. Run as
+``python -m dynamo_tpu.gateway --hub ... --component backend``;
+deploy/inference-gateway/ has the manifests wiring it behind an
+HTTPRoute/InferencePool.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import logging
+from typing import Any
+
+from aiohttp import web
+
+from dynamo_tpu.kv_router.protocols import RouterConfig
+from dynamo_tpu.kv_router.router import KvRouter
+from dynamo_tpu.runtime.component import INSTANCE_ROOT, Instance
+
+log = logging.getLogger("dynamo.gateway.epp")
+
+
+class EndpointPicker:
+    def __init__(
+        self,
+        drt,
+        *,
+        namespace: str = "dynamo",
+        target_component: str = "backend",
+        target_endpoint: str = "generate",
+        config: RouterConfig | None = None,
+        host: str = "0.0.0.0",
+        port: int = 9002,
+    ):
+        self.drt = drt
+        self.namespace = namespace
+        self.target_component = target_component
+        self.target_endpoint = target_endpoint
+        self.config = config
+        self.host = host
+        self.port = port
+        self.kv: KvRouter | None = None
+        self._tokenizers: dict[str, Any] = {}
+        self._runner: web.AppRunner | None = None
+        self.picks = 0
+
+    async def start(self) -> "EndpointPicker":
+        self.kv = await KvRouter(
+            self.drt.hub,
+            f"{self.namespace}/{self.target_component}",
+            self.config,
+        ).start()
+        app = web.Application()
+        app.router.add_post("/pick", self._pick)
+        app.router.add_get("/healthz", self._healthz)
+        self._runner = web.AppRunner(app)
+        await self._runner.setup()
+        site = web.TCPSite(self._runner, self.host, self.port)
+        await site.start()
+        for s in self._runner.sites:
+            self.port = s._server.sockets[0].getsockname()[1]
+        log.info("EPP listening on %s:%d (target %s/%s)",
+                 self.host, self.port, self.namespace,
+                 self.target_component)
+        return self
+
+    # -- helpers -----------------------------------------------------------
+
+    async def _tokenizer_for(self, model: str | None):
+        """The deployed model's OWN tokenizer, from its model card — the
+        dyn-kv plugin's advantage over generic-tokenizer EPPs."""
+        from dynamo_tpu.frontend.model_card import MDC_ROOT
+        from dynamo_tpu.frontend.tokenizer import load_tokenizer
+
+        cards = await self.drt.hub.get_prefix(MDC_ROOT + "/")
+        card = None
+        for _key, value in sorted(cards.items()):
+            if model is None or value.get("name") == model:
+                card = value
+                break
+        tok_name = (card or {}).get("tokenizer", "mock")
+        if tok_name not in self._tokenizers:
+            self._tokenizers[tok_name] = load_tokenizer(tok_name)
+        return self._tokenizers[tok_name]
+
+    async def _endpoint_of(self, worker_id: int) -> str | None:
+        prefix = (
+            f"{INSTANCE_ROOT}/{self.namespace}/{self.target_component}/"
+            f"{self.target_endpoint}/"
+        )
+        entries = await self.drt.hub.get_prefix(prefix)
+        for _key, raw in entries.items():
+            inst = Instance.from_dict(raw)
+            if inst.instance_id == worker_id:
+                return f"{inst.host}:{inst.port}"
+        return None
+
+    # -- routes ------------------------------------------------------------
+
+    async def _healthz(self, _req: web.Request) -> web.Response:
+        return web.json_response({"status": "ok", "picks": self.picks})
+
+    async def _pick(self, req: web.Request) -> web.Response:
+        try:
+            body = await req.json()
+        except Exception:  # noqa: BLE001
+            return web.json_response(
+                {"error": "body must be JSON"}, status=400
+            )
+        token_ids = body.get("token_ids")
+        if token_ids is None:
+            prompt = body.get("prompt")
+            if not isinstance(prompt, str):
+                return web.json_response(
+                    {"error": "one of token_ids or prompt is required"},
+                    status=400,
+                )
+            tok = await self._tokenizer_for(body.get("model"))
+            token_ids = tok.encode(prompt)
+        rid = body.get("request_id", "epp")
+        try:
+            # decision-only probe: find + free, like the router service's
+            # best_worker endpoint (kv_router/service.py)
+            worker_id, overlap = self.kv.find_best_match(
+                rid, list(token_ids)
+            )
+            self.kv.free(rid)
+        except Exception as e:  # noqa: BLE001 — no workers yet
+            return web.json_response(
+                {"error": f"no routable worker: {e}"}, status=503
+            )
+        endpoint = await self._endpoint_of(worker_id)
+        if endpoint is None:
+            return web.json_response(
+                {"error": f"worker {worker_id:x} has no registered "
+                          "instance"},
+                status=503,
+            )
+        self.picks += 1
+        return web.json_response(
+            {
+                "worker_id": worker_id,
+                "endpoint": endpoint,
+                "overlap_blocks": overlap,
+            },
+            headers={"x-gateway-destination-endpoint": endpoint},
+        )
+
+    async def close(self) -> None:
+        if self.kv is not None:
+            await self.kv.save_snapshot()
+            await self.kv.close()
+        if self._runner is not None:
+            await self._runner.cleanup()
+
+
+async def _amain(args: argparse.Namespace) -> None:
+    from dynamo_tpu.runtime.config import RuntimeConfig
+    from dynamo_tpu.runtime.distributed import DistributedRuntime
+    from dynamo_tpu.runtime.hub_client import connect_hub
+
+    rcfg = RuntimeConfig.from_env()
+    if args.hub:
+        rcfg.hub_address = args.hub
+    drt = DistributedRuntime(await connect_hub(rcfg.hub_address), rcfg)
+    epp = await EndpointPicker(
+        drt,
+        namespace=args.namespace,
+        target_component=args.component,
+        target_endpoint=args.endpoint,
+        config=RouterConfig(block_size=args.block_size),
+        host=args.host,
+        port=args.port,
+    ).start()
+    print(f"DYNAMO_EPP={epp.host}:{epp.port}", flush=True)
+    try:
+        await asyncio.Event().wait()
+    finally:
+        await epp.close()
+        await drt.close()
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser("dynamo-tpu endpoint picker (EPP)")
+    p.add_argument("--hub", default=None)
+    p.add_argument("--namespace", default="dynamo")
+    p.add_argument("--component", default="backend")
+    p.add_argument("--endpoint", default="generate")
+    p.add_argument("--block-size", type=int, default=16)
+    p.add_argument("--host", default="0.0.0.0")
+    p.add_argument("--port", type=int, default=9002)
+    args = p.parse_args(argv)
+    logging.basicConfig(level=logging.INFO)
+    try:
+        asyncio.run(_amain(args))
+    except KeyboardInterrupt:
+        pass
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
