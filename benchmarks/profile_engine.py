@@ -28,6 +28,37 @@ import numpy as np
 import jax
 
 
+def readmission_attribution(snap: dict) -> dict:
+    """Break the finish->next-first-token gap into named per-request
+    phases from the engine's ``readmit.*`` profile counters:
+
+    - ``admit_wait``: generate() enqueue -> the step thread dequeued the
+      request (queue time; in a closed loop this starts ~a loop-tick
+      after the previous request's finish item posted).
+    - ``prefill_dispatch``: dequeue -> prompt forward + fused first-token
+      sample dispatched (device work enqueued, host copy in flight).
+    - ``first_token``: dispatch complete -> the first token's host value
+      landed and streamed (admission-wave materialization: residual
+      sample/d2h latency not hidden behind decode bursts).
+
+    Per-phase mean milliseconds x event count; their sum is the engine-
+    attributable slice of the re-admission gap (the client-side
+    finish->resubmit hop is outside the engine and shows up only in
+    admit_wait's lower bound)."""
+    out: dict[str, dict] = {}
+    total_ms = 0.0
+    for key in ("admit_wait", "prefill_dispatch", "first_token"):
+        rec = snap.get(f"readmit.{key}")
+        if not rec or not rec.get("calls"):
+            out[key] = {"events": 0, "mean_ms": None}
+            continue
+        mean_ms = rec["secs"] / rec["calls"] * 1e3
+        out[key] = {"events": rec["calls"], "mean_ms": round(mean_ms, 2)}
+        total_ms += mean_ms
+    out["engine_gap_ms"] = round(total_ms, 2)
+    return out
+
+
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--concurrency", type=int, default=64)
@@ -155,7 +186,7 @@ def main() -> None:
             v["secs"] for k, v in snap.items()
             if k in ("materialize", "flush", "admit_loop", "packed_prefill",
                      "complete_admissions", "build_batch", "dispatch",
-                     "process", "idle")
+                     "process", "idle", "eager_readmit", "readmit_wait")
         )
         out = {
             "concurrency": args.concurrency,
@@ -164,6 +195,8 @@ def main() -> None:
             "requests_done": n_done[0],
             "accounted_s": round(accounted, 2),
             "phases": snap,
+            "readmission": readmission_attribution(snap),
+            "eager_readmits": engine.eager_readmits,
         }
         print(json.dumps(out, indent=2))
 

@@ -297,6 +297,19 @@ class EngineConfig:
     seed: int = 0
     # scheduler
     step_idle_sleep_s: float = 0.002
+    # eager re-admission: when processing a decode burst frees slots, run
+    # the admission pass again IN THE SAME step cycle (the replacement's
+    # prefill dispatches behind the in-flight burst; its first token
+    # feeds the next burst's device chain) instead of leaving the slot
+    # idle until the next step's admission phase — one skipped pass
+    # costs a full burst of slot idleness (~200 ms at serving burst
+    # lengths; the dominant term in the r5 733 ms re-admission TTFT)
+    eager_readmit: bool = True
+    # bounded wait for a closed-loop client's resubmission to cross the
+    # event loop right after its finish item posted (finish -> client
+    # resubmit -> generate enqueue is ~a ms of loop latency); hidden
+    # behind the in-flight burst's device execution. 0 = don't wait.
+    readmit_wait_s: float = 0.002
 
     def __post_init__(self) -> None:
         if self.max_decode_slots is None:

@@ -68,6 +68,10 @@ class _Slot:
     # feeds the next decode burst there); the host value materializes one
     # step later without ever blocking the step thread on the d2h RTT
     first_pending: bool = False
+    # re-admission gap attribution (profiling mode): when this request
+    # left the waiting queue / when its prefill+sample dispatch completed
+    admit_t: float = 0.0
+    prefill_done_t: float = 0.0
 
 
 @dataclass
@@ -75,6 +79,8 @@ class _Waiting:
     request: dict[str, Any]
     context: Context
     out_q: asyncio.Queue
+    enq_t: float = 0.0  # perf_counter at enqueue (admit-wait attribution)
+    admit_t: float = 0.0  # perf_counter when the step thread dequeued it
 
 
 @dataclass
@@ -176,6 +182,9 @@ class InferenceEngine:
             spmd.on_sync_request = self._wake.set
         self._closed = False
         self.steps = 0
+        # eager re-admission passes that filled a slot in the SAME step
+        # cycle that freed it (observability for the serving-latency work)
+        self.eager_readmits = 0
         self._partial: _PartialPrefill | None = None
         self._clear_cache_requested = False
         # dispatched-but-unprocessed decode bursts, oldest first (max
@@ -193,6 +202,18 @@ class InferenceEngine:
         # seconds + call counts per phase, read via profile_snapshot()
         self._profiling = os.environ.get("DYNAMO_ENGINE_PROFILE") == "1"
         self._prof: dict[str, list[float]] = {}
+
+    def _prof_add(self, name: str, dt: float) -> None:
+        """Accumulate one timed event into the phase profiler (no-op
+        unless DYNAMO_ENGINE_PROFILE=1). Used for the re-admission gap
+        attribution: ``readmit.admit_wait`` / ``readmit.prefill_dispatch``
+        / ``readmit.first_token`` break the finish->next-first-token path
+        into named phases (benchmarks/profile_engine.py)."""
+        if not self._profiling:
+            return
+        rec = self._prof.setdefault(name, [0.0, 0])
+        rec[0] += dt
+        rec[1] += 1
 
     @contextlib.contextmanager
     def _phase(self, name: str):
@@ -404,7 +425,9 @@ class InferenceEngine:
                    "error": "engine closed"}
             return
         out_q: asyncio.Queue = asyncio.Queue()
-        self._waiting.put_nowait(_Waiting(request, context, out_q))
+        self._waiting.put_nowait(
+            _Waiting(request, context, out_q, enq_t=time.perf_counter())
+        )
         self._wake.set()
         while True:
             item = await out_q.get()
@@ -569,76 +592,7 @@ class InferenceEngine:
             did = True
             self._publish_metrics()
         else:
-            budget = self.config.max_prefill_tokens_per_step
-            # the budget exists to bound how long prefills stall RUNNING
-            # decode streams; on a cold batch (nothing decoding) it only
-            # serializes admissions across steps and inflates TTFT —
-            # admit up to HALF the slots in one step instead. The half
-            # cap is a convoy breaker: admitting a whole cold wave at
-            # once locks closed-loop clients into lockstep (every
-            # request starts, decodes, and finishes together, so tokens
-            # clump at wave boundaries and throughput halves — measured
-            # as the 1.8k-tok/s attractor in the r5 ladder); two
-            # staggered cohorts interleave their prefills and decode
-            # bursts instead.
-            decoding = any(s is not None for s in self._slots)
-            cold_cap = max(1, (len(self._slots) + 1) // 2)
-            n_admitted = 0
-            admitted = False
-            pending: list[tuple] = []
-            preps: list[dict] = []
-            reserved: set[int] = set()
-            admit_t0 = time.perf_counter() if self._profiling else 0.0
-            while self._partial is None:
-                free_idx = next(
-                    (
-                        i
-                        for i, s in enumerate(self._slots)
-                        if s is None and i not in reserved
-                    ),
-                    None,
-                )
-                if free_idx is None or self._waiting.empty():
-                    break
-                cost = len(
-                    self._peek_waiting_tokens() or ()
-                ) or 1
-                cost = min(cost, self._prefill_chunk_max())
-                if admitted and cost > budget and decoding:
-                    break  # first admission always proceeds
-                if not decoding and n_admitted >= cold_cap:
-                    break  # stagger the cold wave (convoy breaker)
-                waiting = self._waiting.get_nowait()
-                if waiting.context.is_stopped:
-                    self._drop_staged_kv(waiting.request)
-                    self._post(
-                        waiting.out_q,
-                        {"token_ids": [], "finish_reason": "cancelled"},
-                    )
-                else:
-                    out = self._prefill_safe(free_idx, waiting)
-                    if isinstance(out, dict):
-                        preps.append(out)
-                        reserved.add(free_idx)
-                    elif out is not None:
-                        pending.append(out)
-                        reserved.add(free_idx)
-                    budget -= cost
-                    admitted = True
-                    n_admitted += 1
-                did = True
-            if self._profiling and admitted:
-                rec = self._prof.setdefault("admit_loop", [0.0, 0])
-                rec[0] += time.perf_counter() - admit_t0
-                rec[1] += 1
-            # packed prefill: all same-bucket preps in ONE dispatch each
-            with self._phase("packed_prefill"):
-                pending.extend(self._run_packed_prefills(preps))
-            if pending:
-                with self._phase("complete_admissions"):
-                    self._complete_admissions(pending)
-            if did:
-                self._publish_metrics()
+            did |= self._admit_phase()
 
         # 2) one decode step over active slots
         if any(s is not None for s in self._slots):
@@ -650,6 +604,144 @@ class InferenceEngine:
             self._flush_pipeline()
             did = True
         return did
+
+    def _admit_phase(self) -> bool:
+        """Admit waiting requests into free slots, up to a per-step token
+        budget (ref: vLLM max_num_batched_tokens scheduling — many short
+        prompts enter in ONE step instead of serializing one admission
+        behind every decode step). Shared by the normal step phase and the
+        eager re-admission pass (_eager_readmit). Returns True when any
+        waiting entry was handled.
+
+        The budget exists to bound how long prefills stall RUNNING decode
+        streams — but it must not serialize WARM re-admissions: at >= half
+        occupancy the queue is closed-loop churn replacing just-finished
+        slots, each admission un-idles a slot immediately, and the total
+        prefill work is bounded by the free-slot count anyway, so the
+        budget check is skipped there (the r4 0.49 serving ceiling was
+        exactly a 16-prompt budget against a 32-prompt arrival rate).
+
+        On a COLD batch (nothing decoding) the budget only serializes
+        admissions across steps and inflates TTFT — admit up to HALF the
+        slots in one step instead. The half cap is a convoy breaker:
+        admitting a whole cold wave at once locks closed-loop clients
+        into lockstep (every request starts, decodes, and finishes
+        together, so tokens clump at wave boundaries and throughput
+        halves — measured as the 1.8k-tok/s attractor in the r5 ladder);
+        two staggered cohorts interleave their prefills and decode
+        bursts instead."""
+        budget = self.config.max_prefill_tokens_per_step
+        n_active = sum(s is not None for s in self._slots)
+        decoding = n_active > 0
+        warm = n_active * 2 >= len(self._slots)
+        cold_cap = max(1, (len(self._slots) + 1) // 2)
+        n_admitted = 0
+        admitted = False
+        did = False
+        pending: list[tuple] = []
+        preps: list[dict] = []
+        reserved: set[int] = set()
+        admit_t0 = time.perf_counter() if self._profiling else 0.0
+        while self._partial is None:
+            free_idx = next(
+                (
+                    i
+                    for i, s in enumerate(self._slots)
+                    if s is None and i not in reserved
+                ),
+                None,
+            )
+            if free_idx is None or self._waiting.empty():
+                break
+            cost = len(
+                self._peek_waiting_tokens() or ()
+            ) or 1
+            cost = min(cost, self._prefill_chunk_max())
+            if admitted and cost > budget and decoding and not warm:
+                break  # first admission always proceeds
+            if not decoding and n_admitted >= cold_cap:
+                break  # stagger the cold wave (convoy breaker)
+            waiting = self._waiting.get_nowait()
+            if self._profiling:
+                waiting.admit_t = time.perf_counter()
+                if waiting.enq_t:
+                    self._prof_add(
+                        "readmit.admit_wait", waiting.admit_t - waiting.enq_t
+                    )
+            if waiting.context.is_stopped:
+                self._drop_staged_kv(waiting.request)
+                self._post(
+                    waiting.out_q,
+                    {"token_ids": [], "finish_reason": "cancelled"},
+                )
+            else:
+                out = self._prefill_safe(free_idx, waiting)
+                if isinstance(out, dict):
+                    preps.append(out)
+                    reserved.add(free_idx)
+                elif out is not None:
+                    pending.append(out)
+                    reserved.add(free_idx)
+                budget -= cost
+                admitted = True
+                n_admitted += 1
+            did = True
+        if self._profiling and admitted:
+            rec = self._prof.setdefault("admit_loop", [0.0, 0])
+            rec[0] += time.perf_counter() - admit_t0
+            rec[1] += 1
+        # packed prefill: all same-bucket preps in ONE dispatch each
+        with self._phase("packed_prefill"):
+            pending.extend(self._run_packed_prefills(preps))
+        if pending:
+            with self._phase("complete_admissions"):
+                self._complete_admissions(pending)
+        if did:
+            self._publish_metrics()
+        return did
+
+    def _eager_readmit(self, freed: int) -> None:
+        """Fill slots freed by the burst that just processed WITHIN the
+        same step cycle, instead of leaving them idle until the next
+        _step's admission phase — at serving burst lengths one skipped
+        admission pass costs a full burst of slot idleness (~200 ms at
+        burst 24, the arithmetic behind the r5 TTFT p50 of 733 ms for a
+        128-token prefill).
+
+        When the waiting queue is momentarily empty right after a finish,
+        the closed-loop client's NEXT request is usually already crossing
+        the event loop (finish item -> client resubmit -> generate
+        enqueue); a bounded wait on the wake event catches it while the
+        in-flight burst still has a full burst of device execution ahead,
+        so the wait is hidden. Control signals (close, cancel, admin ops)
+        are level-checked flags re-read every step, so clearing the wake
+        event here delays them by at most readmit_wait_s."""
+        cfg = self.config
+        if (
+            not cfg.eager_readmit
+            or freed <= 0
+            or self._partial is not None
+            or self._closed
+        ):
+            return
+        if (
+            self._waiting.empty()
+            and cfg.readmit_wait_s > 0
+            and self._pipeline
+        ):
+            # only wait while a dispatched burst is still executing on
+            # device (the wait hides behind it); with no burst in flight
+            # — non-pipelined mode, or the drain branch just emptied the
+            # pipeline — a timeout here would be dead step-thread time
+            # added to every open-loop finish
+            with self._phase("readmit_wait"):
+                self._wake.clear()
+                self._wake.wait(cfg.readmit_wait_s)
+        if self._waiting.empty():
+            return
+        with self._phase("eager_readmit"):
+            if self._admit_phase():
+                self.eager_readmits += 1
 
     def _spmd_sync_state(self) -> list[tuple]:
         """Quiesced KV snapshot for a rejoining follower, as a list of
@@ -1022,6 +1114,7 @@ class InferenceEngine:
             logprobs=self._clamp_logprobs(
                 (req.get("output_options") or {}).get("logprobs")
             ),
+            admit_t=waiting.admit_t,
         )
 
     def _clamp_logprobs(self, n) -> int | None:
@@ -1447,6 +1540,14 @@ class InferenceEngine:
                 )
             return
 
+        if self._profiling:
+            now = time.perf_counter()
+            for _si, _w, slot, _lr, _t, _sp in recs:
+                if slot.admit_t:
+                    self._prof_add(
+                        "readmit.prefill_dispatch", now - slot.admit_t
+                    )
+                slot.prefill_done_t = now
         for i, (slot_idx, waiting, slot, _logits_ref, token_ids, sp) in enumerate(recs):
             # per-record isolation: one bad emit (disagg export, handoff)
             # must not strand the step's other admissions
@@ -1626,6 +1727,14 @@ class InferenceEngine:
                      "error": f"prefill failed: {e}"},
                 )
             return
+        if self._profiling:
+            now = time.perf_counter()
+            for _si, slot in recs:
+                slot.prefill_done_t = now
+                if slot.admit_t:
+                    self._prof_add(
+                        "readmit.prefill_dispatch", now - slot.admit_t
+                    )
         for slot_idx, slot in recs:
             self._slots[slot_idx] = slot
         self._admit_waves.extend(waves.values())
@@ -1737,6 +1846,12 @@ class InferenceEngine:
     def _land_first_token(self, slot_idx: int, slot: _Slot, tok: int) -> None:
         """Record + stream an async admission's first token (stop
         semantics of _accept_token, with counters pre-advanced)."""
+        if self._profiling and slot.prefill_done_t:
+            self._prof_add(
+                "readmit.first_token",
+                time.perf_counter() - slot.prefill_done_t,
+            )
+            slot.prefill_done_t = 0.0
         slot.seq.append(tok)
         slot.last_token = tok
         slot.first_pending = False
@@ -1994,8 +2109,12 @@ class InferenceEngine:
                 batch = self._build_batch(self._pipeline)
             if batch is None:
                 if self._pipeline:
+                    before = sum(s is not None for s in self._slots)
                     with self._phase("process"):
                         self._process_burst(self._pipeline.pop(0))
+                    self._eager_readmit(
+                        before - sum(s is not None for s in self._slots)
+                    )
                 return
             with self._phase("dispatch"):
                 results = self._dispatch_burst(
@@ -2003,17 +2122,29 @@ class InferenceEngine:
                 )
             self._pipeline.append({"batch": batch, "results": results})
             if len(self._pipeline) > max(1, self.config.pipeline_depth):
+                before = sum(s is not None for s in self._slots)
                 with self._phase("process"):
                     self._process_burst(self._pipeline.pop(0))
+                # slots the burst just freed re-fill NOW — their packed
+                # prefill dispatches behind the in-flight burst and their
+                # first tokens feed the NEXT burst's device chain, so a
+                # replacement stream loses zero decode cycles
+                self._eager_readmit(
+                    before - sum(s is not None for s in self._slots)
+                )
             return
         with self._phase("build_batch"):
             batch = self._build_batch(None)
         if batch is None:
             return
+        before = sum(s is not None for s in self._slots)
         with self._phase("dispatch"):
             results = self._dispatch_burst(batch, chain=None)
         with self._phase("process"):
             self._process_burst({"batch": batch, "results": results})
+        self._eager_readmit(
+            before - sum(s is not None for s in self._slots)
+        )
 
     def _flush_pipeline(self) -> None:
         """Process every in-flight burst (pipelined mode) so slot state is
@@ -2399,6 +2530,14 @@ class InferenceEngine:
         logprob_entry: dict | None = None,
     ) -> None:
         """Record + stream one sampled token; place slot or finish."""
+        if self._profiling and slot.prefill_done_t:
+            # sync-admission first token: sample + d2h ran inline just
+            # before this emit, so the residual here is host bookkeeping
+            self._prof_add(
+                "readmit.first_token",
+                time.perf_counter() - slot.prefill_done_t,
+            )
+            slot.prefill_done_t = 0.0
         finish = self._accept_token(slot, tok)
         if finish is not None:
             # release resources BEFORE posting the finish item, so a client

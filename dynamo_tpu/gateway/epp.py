@@ -89,7 +89,12 @@ class EndpointPicker:
 
     async def _tokenizer_for(self, model: str | None):
         """The deployed model's OWN tokenizer, from its model card — the
-        dyn-kv plugin's advantage over generic-tokenizer EPPs."""
+        dyn-kv plugin's advantage over generic-tokenizer EPPs. A NAMED
+        model with no matching card returns None (the route 404s): a
+        typo'd name must not silently tokenize with the mock fallback
+        and return confidently wrong block hashes/overlap estimates.
+        Only an OMITTED model may fall back to the first card (or the
+        mock tokenizer when no cards exist yet)."""
         from dynamo_tpu.frontend.model_card import MDC_ROOT
         from dynamo_tpu.frontend.tokenizer import load_tokenizer
 
@@ -99,6 +104,8 @@ class EndpointPicker:
             if model is None or value.get("name") == model:
                 card = value
                 break
+        if model is not None and card is None:
+            return None  # unknown model: the caller 404s
         tok_name = (card or {}).get("tokenizer", "mock")
         if tok_name not in self._tokenizers:
             self._tokenizers[tok_name] = load_tokenizer(tok_name)
@@ -137,6 +144,12 @@ class EndpointPicker:
                     status=400,
                 )
             tok = await self._tokenizer_for(body.get("model"))
+            if tok is None:
+                return web.json_response(
+                    {"error": f"no model card named "
+                              f"{body.get('model')!r}"},
+                    status=404,
+                )
             token_ids = tok.encode(prompt)
         rid = body.get("request_id", "epp")
         try:

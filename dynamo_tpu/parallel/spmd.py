@@ -39,6 +39,7 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import time
 from collections import deque
 from typing import Any
 
@@ -52,6 +53,10 @@ ADDR_KEY_FMT = "spmd/{group}/addr"
 RING_FRAMES = 1024  # catch-up window cap (descriptors)
 RING_BYTES = 64 * 1024 * 1024  # catch-up window cap (payload bytes)
 SYNC_CHUNK_BYTES = 64 * 1024 * 1024  # rejoin snapshot chunk (< MAX_FRAME)
+# how long a rejoiner may overflow its (bounded) sync queue without
+# latching the strict-mode plane broken: dropping it forces a clean
+# re-sync, which is recoverable — unlike a live follower losing frames
+SYNC_DRAIN_GRACE_S = 300.0
 
 # queue sentinel: the leader dropped this follower (stopped draining);
 # closing its stream makes the loss VISIBLE so it re-syncs
@@ -110,8 +115,10 @@ class SpmdLeader:
                 strict = False
         self.strict = strict
         # rejoin state-sync requests parked until the engine reaches a
-        # step boundary (serve_sync); count readable cross-thread
-        self._sync_waiting: list[asyncio.Future] = []
+        # step boundary (serve_sync); count readable cross-thread. Each
+        # entry carries its connection's writer so _resolve can skip
+        # requesters that died while parked (crash-looping followers)
+        self._sync_waiting: list[tuple[asyncio.Future, Any]] = []
         self._sync_pending = 0
         self.on_sync_request = None  # engine wake hook (set by engine)
         # catch-up ring: bounded by frames AND payload bytes (decode
@@ -164,7 +171,7 @@ class SpmdLeader:
             # (A requester that dies while parked costs the engine one
             # wasted quiesce — bounded per connection attempt.)
             fut: asyncio.Future = self.loop.create_future()
-            self._sync_waiting.append(fut)
+            self._sync_waiting.append((fut, writer))
             self._sync_pending += 1
             if self.on_sync_request is not None:
                 self.on_sync_request()
@@ -284,17 +291,31 @@ class SpmdLeader:
 
         def _resolve() -> None:
             waiting, self._sync_waiting = self._sync_waiting, []
-            for fut in waiting:
+            for fut, writer in waiting:
                 if fut.done():
                     continue
-                # UNBOUNDED live queue for a syncing follower: the
-                # snapshot takes seconds to cross the wire at production
-                # cache sizes, during which the leader keeps publishing —
-                # a bounded queue would overflow mid-snapshot and drop
-                # the rejoiner into an endless quiesce/re-sync cycle.
-                # Memory is bounded by publish-rate x transfer-time and
-                # transient; once the snapshot lands the queue drains.
-                q: asyncio.Queue = asyncio.Queue()
+                if writer.is_closing():
+                    # the requester died while parked (crash-looping
+                    # follower): cancelling sends its handler to the
+                    # close path instead of registering an orphan queue
+                    # that would absorb every descriptor until the next
+                    # failed write discovered the corpse
+                    fut.cancel()
+                    continue
+                # live queue bounded at 4x the catch-up window: a
+                # GB-scale snapshot takes tens of seconds to cross the
+                # wire while the leader keeps publishing, so the sync
+                # queue gets generous headroom — but NOT unbounded, so a
+                # follower that died (or stalled) mid-snapshot hits the
+                # normal overflow path (drop backlog + _DROPPED) instead
+                # of pinning leader memory forever. The grace deadline
+                # exempts that overflow from the strict-mode broken
+                # latch: a rejoiner drowning in its own snapshot is a
+                # recoverable re-sync, not a lost-lockstep event.
+                q = asyncio.Queue(maxsize=4 * RING_FRAMES)
+                q.sync_grace_until = (
+                    time.monotonic() + SYNC_DRAIN_GRACE_S
+                )
                 self._conns.append(q)
                 fut.set_result((frames, q))
 
@@ -346,7 +367,11 @@ class SpmdLeader:
                     except asyncio.QueueEmpty:
                         pass
                     q.put_nowait(_DROPPED)
-                    if self.strict:
+                    in_sync_grace = (
+                        getattr(q, "sync_grace_until", 0.0)
+                        > time.monotonic()
+                    )
+                    if self.strict and not in_sync_grace:
                         self.mark_broken(
                             "follower stopped draining descriptors "
                             f"({backlog} backlogged)"

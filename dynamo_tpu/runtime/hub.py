@@ -21,7 +21,7 @@ from __future__ import annotations
 import asyncio
 import fnmatch
 import time
-from collections import deque
+from collections import OrderedDict, deque
 from dataclasses import dataclass, field
 from typing import Any, AsyncIterator
 
@@ -101,7 +101,14 @@ class Hub:
         raise NotImplementedError
 
     # -- pub/sub -----------------------------------------------------------
-    async def publish(self, subject: str, payload: Any) -> None:
+    async def publish(
+        self, subject: str, payload: Any, pub_id: str | None = None
+    ) -> bool:
+        """Publish one event. ``pub_id`` is an optional client-unique
+        idempotency id: a retried publish (at-least-once transports
+        re-send after a lost ack) carrying an already-seen id is dropped
+        instead of minting a duplicate event under a fresh seq. Returns
+        True when the event was applied, False when deduplicated."""
         raise NotImplementedError
 
     async def purge_subject(
@@ -146,6 +153,11 @@ class InMemoryHub(Hub):
     """Single-process hub; also the core logic reused by the TCP hub server."""
 
     RETAIN_PER_SUBJECT = 65536
+    # publish-dedup window: ids older than this many publishes age out.
+    # Retries happen within a reconnect window (seconds), so a bounded
+    # recent-id set is enough — this is NATS-style msg-id dedup, not an
+    # unbounded ledger.
+    PUB_ID_WINDOW = 8192
 
     def __init__(self) -> None:
         import uuid
@@ -153,6 +165,7 @@ class InMemoryHub(Hub):
         self.boot_id = uuid.uuid4().hex
         self._retained: dict[str, deque] = {}  # subject -> (seq, payload)
         self._subject_seq: dict[str, int] = {}  # publish counter per subject
+        self._seen_pub_ids: "OrderedDict[str, None]" = OrderedDict()
         self._kv: dict[str, Any] = {}
         self._key_lease: dict[str, int] = {}
         self._leases: dict[int, _Lease] = {}
@@ -272,7 +285,23 @@ class InMemoryHub(Hub):
 
     # -- pub/sub -----------------------------------------------------------
 
-    async def publish(self, subject: str, payload: Any) -> None:
+    def _pub_id_fresh(self, pub_id: str | None) -> bool:
+        """Record ``pub_id`` in the bounded dedup window; False when the
+        id was already seen (a retried publish — drop it)."""
+        if pub_id is None:
+            return True
+        if pub_id in self._seen_pub_ids:
+            return False
+        self._seen_pub_ids[pub_id] = None
+        while len(self._seen_pub_ids) > self.PUB_ID_WINDOW:
+            self._seen_pub_ids.popitem(last=False)
+        return True
+
+    async def publish(
+        self, subject: str, payload: Any, pub_id: str | None = None
+    ) -> bool:
+        if not self._pub_id_fresh(pub_id):
+            return False  # retried duplicate: already applied
         if subject not in self._retained:
             self._retained[subject] = deque(maxlen=self.RETAIN_PER_SUBJECT)
         seq = self._subject_seq.get(subject, 0) + 1
@@ -281,6 +310,7 @@ class InMemoryHub(Hub):
         for pattern, q in self._subs:
             if fnmatch.fnmatchcase(subject, pattern):
                 q.put_nowait((subject, payload, seq))
+        return True
 
     async def purge_subject(
         self, subject: str, keep_last: int = 0,

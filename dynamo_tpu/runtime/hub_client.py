@@ -49,14 +49,30 @@ class RemoteHub(Hub):
         reconnect: bool = True,
         reconnect_window_s: float = 10.0,
     ):
+        import uuid
+
         host, _, port = address.rpartition(":")
         self._host, self._port = host or "127.0.0.1", int(port)
         self._reader: asyncio.StreamReader | None = None
         self._writer: asyncio.StreamWriter | None = None
         self._ids = itertools.count(1)
-        self._pending: dict[int, asyncio.Future] = {}
-        self._streams: dict[int, asyncio.Queue] = {}
+        # pending/stream entries are tagged with the connection EPOCH they
+        # were sent on: a stale rx task (old connection, still blocked on
+        # its reader while a reconnect already dialed a new one) must only
+        # fail entries from its own generation — nuking newer-epoch
+        # futures would spuriously retry calls on the healthy connection
+        # (duplicating non-idempotent ops) and force needless stream
+        # re-syncs (ADVICE r5 medium).
+        self._epoch = 0
+        self._pending: dict[int, tuple[int, asyncio.Future]] = {}
+        self._streams: dict[int, tuple[int, asyncio.Queue]] = {}
         self._rx_task: asyncio.Task | None = None
+        # client-unique publish ids let the hub drop the duplicate when
+        # _call's at-least-once retry re-sends a publish whose ack was
+        # lost in a crash (ADVICE r5 low: a dup under a fresh seq defeats
+        # the subscribe-side seq dedup and double-counts router blocks)
+        self._pub_ids = itertools.count(1)
+        self._client_id = uuid.uuid4().hex[:12]
         self._write_lock = asyncio.Lock()
         self._conn_lock = asyncio.Lock()
         self._reconnect = reconnect
@@ -84,7 +100,10 @@ class RemoteHub(Hub):
         self._reader, self._writer = await asyncio.wait_for(
             asyncio.open_connection(self._host, self._port), timeout
         )
-        self._rx_task = asyncio.get_running_loop().create_task(self._rx_loop())
+        self._epoch += 1
+        self._rx_task = asyncio.get_running_loop().create_task(
+            self._rx_loop(self._reader, self._epoch)
+        )
 
     def _connected(self) -> bool:
         return (
@@ -125,9 +144,7 @@ class RemoteHub(Hub):
                     await asyncio.sleep(delay)
                     delay = min(delay * 2, 1.0)
 
-    async def _rx_loop(self) -> None:
-        assert self._reader is not None
-        reader = self._reader
+    async def _rx_loop(self, reader: asyncio.StreamReader, epoch: int) -> None:
         try:
             while True:
                 msg = await framing.read_frame(reader)
@@ -135,11 +152,11 @@ class RemoteHub(Hub):
                     break
                 mid = msg.get("id")
                 if "stream" in msg:
-                    q = self._streams.get(mid)
-                    if q is not None:
-                        q.put_nowait(msg["stream"])
+                    entry = self._streams.get(mid)
+                    if entry is not None:
+                        entry[1].put_nowait(msg["stream"])
                 else:
-                    fut = self._pending.pop(mid, None)
+                    _ep, fut = self._pending.pop(mid, (0, None))
                     if fut is not None and not fut.done():
                         fut.set_result(msg)
         except Exception:  # noqa: BLE001 — any rx failure = connection lost
@@ -149,23 +166,38 @@ class RemoteHub(Hub):
             # via _call's reconnect loop) and wake stream consumers (they
             # re-open). MUST run even on unexpected read errors (OSError
             # variants, oversized/corrupt frames) or callers await their
-            # futures forever.
+            # futures forever. EPOCH-SCOPED: a reconnect can replace this
+            # task while it is still blocked on the dead reader (a send-
+            # side broken pipe surfaces before the read side EOFs), so
+            # only entries from THIS connection generation — which no rx
+            # loop will ever answer — may be failed; newer-epoch entries
+            # belong to the live connection and its own rx loop.
             err = ConnectionError("hub connection lost")
-            for fut in self._pending.values():
-                if not fut.done():
-                    fut.set_exception(err)
-            self._pending.clear()
-            for q in self._streams.values():
-                q.put_nowait(None)  # sentinel: stream closed
+            for mid, (ep, fut) in list(self._pending.items()):
+                if ep <= epoch:
+                    del self._pending[mid]
+                    if not fut.done():
+                        fut.set_exception(err)
+            for _mid, (ep, q) in list(self._streams.items()):
+                if ep <= epoch:
+                    q.put_nowait(None)  # sentinel: stream closed
 
     async def _send_request(self, op: str, kwargs: dict[str, Any]) -> Any:
         mid = next(self._ids)
         fut: asyncio.Future = asyncio.get_running_loop().create_future()
-        self._pending[mid] = fut
         try:
             async with self._write_lock:
+                # snapshot writer+epoch together INSIDE the lock: a
+                # reconnect can land while we awaited the lock, and the
+                # entry must be tagged with the epoch of the connection
+                # the frame actually goes out on — a stale tag would let
+                # the dying rx loop fail a request in flight on the
+                # healthy connection (spurious retry of a non-idempotent
+                # op, the exact bug the epochs exist to prevent)
+                writer, epoch = self._writer, self._epoch
+                self._pending[mid] = (epoch, fut)
                 await framing.write_frame(
-                    self._writer, {"id": mid, "op": op, **kwargs}
+                    writer, {"id": mid, "op": op, **kwargs}
                 )
         except (OSError, ConnectionError):
             self._pending.pop(mid, None)
@@ -199,11 +231,13 @@ class RemoteHub(Hub):
         await self._ensure_connected()
         mid = next(self._ids)
         q: asyncio.Queue = asyncio.Queue()
-        self._streams[mid] = q
         try:
             async with self._write_lock:
+                # same epoch-at-send discipline as _send_request
+                writer, epoch = self._writer, self._epoch
+                self._streams[mid] = (epoch, q)
                 await framing.write_frame(
-                    self._writer, {"id": mid, "op": op, **kwargs}
+                    writer, {"id": mid, "op": op, **kwargs}
                 )
         except (OSError, ConnectionError):
             self._streams.pop(mid, None)
@@ -349,8 +383,20 @@ class RemoteHub(Hub):
 
     # -- pub/sub -----------------------------------------------------------
 
-    async def publish(self, subject: str, payload: Any) -> None:
-        await self._call("publish", subject=subject, payload=payload)
+    async def publish(
+        self, subject: str, payload: Any, pub_id: str | None = None
+    ) -> bool:
+        # idempotency id: _call's reconnect loop may re-send after a lost
+        # ack; the hub dedups on pub_id so the retry cannot mint a second
+        # event under a fresh seq (hub.py publish; legacy servers ignore
+        # the extra field and keep plain at-least-once semantics)
+        res = await self._call(
+            "publish", subject=subject, payload=payload,
+            pub_id=pub_id or f"{self._client_id}:{next(self._pub_ids)}",
+        )
+        # legacy servers ack with a bare True; new ones relay the hub's
+        # applied/deduplicated bool so the contract matches local hubs
+        return True if res is None else bool(res)
 
     async def purge_subject(
         self, subject: str, keep_last: int = 0,

@@ -135,8 +135,13 @@ class HubServer:
                 await hub.revoke_lease(msg["lease"])
                 result = True
             elif op == "publish":
-                await hub.publish(msg["subject"], msg["payload"])
-                result = True
+                # pub_id: client idempotency id — a retried publish whose
+                # ack was lost dedups instead of minting a duplicate seq;
+                # the applied/deduplicated bool is relayed to the client
+                result = await hub.publish(
+                    msg["subject"], msg["payload"],
+                    pub_id=msg.get("pub_id"),
+                )
             elif op == "purge_subject":
                 result = await hub.purge_subject(
                     msg["subject"], msg.get("keep_last", 0),

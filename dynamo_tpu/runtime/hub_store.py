@@ -241,6 +241,9 @@ class DurableHub(InMemoryHub):
             ],
             "next_lease": self._next_lease,
             "subject_seq": dict(self._subject_seq),
+            # publish-dedup window: persists so a client retry landing
+            # after restart+compaction still dedups
+            "pub_ids": list(self._seen_pub_ids),
             "retained": {
                 subj: list(dq) for subj, dq in self._retained.items()
             },
@@ -266,6 +269,12 @@ class DurableHub(InMemoryHub):
                 self._leases[lid].keys.add(key)
         self._next_lease = state["next_lease"]
         self._subject_seq = dict(state["subject_seq"])
+        from collections import OrderedDict
+
+        # .get: pre-dedup snapshots have no pub_ids entry
+        self._seen_pub_ids = OrderedDict(
+            (pid, None) for pid in state.get("pub_ids", ())
+        )
         self._retained = {
             subj: deque(
                 (tuple(item) for item in items),
@@ -304,6 +313,8 @@ class DurableHub(InMemoryHub):
                 self._drop_lease(lease)
         elif op == "pub":
             subj = rec["s"]
+            if not self._pub_id_fresh(rec.get("pid")):
+                return  # replayed duplicate (same pid logged twice)
             if subj not in self._retained:
                 from collections import deque
 
@@ -362,9 +373,18 @@ class DurableHub(InMemoryHub):
         # lease EXPIRY (reap_expired) is deliberately not logged: restored
         # leases re-expire on their own one TTL after recovery
 
-    async def publish(self, subject: str, payload: Any) -> None:
-        await super().publish(subject, payload)
-        self._log({"op": "pub", "s": subject, "p": payload})
+    async def publish(
+        self, subject: str, payload: Any, pub_id: str | None = None
+    ) -> bool:
+        applied = await super().publish(subject, payload, pub_id)
+        if applied:
+            # pid rides in the WAL so a retry that lands AFTER a hub
+            # restart (which replayed the original record) still dedups
+            rec = {"op": "pub", "s": subject, "p": payload}
+            if pub_id is not None:
+                rec["pid"] = pub_id
+            self._log(rec)
+        return applied
 
     async def purge_subject(
         self, subject: str, keep_last: int = 0,

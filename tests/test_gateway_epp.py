@@ -72,7 +72,19 @@ async def test_epp_picks_kv_warm_worker_with_gie_header():
             )
 
             # prompt path: model-aware tokenization via the model card's
-            # tokenizer (mock tokenizer here), still yields a decision
+            # tokenizer (mock tokenizer here) — the card must exist; a
+            # named model without one 404s below
+            from dynamo_tpu.frontend.model_card import (
+                ModelDeploymentCard,
+            )
+
+            card = ModelDeploymentCard(
+                name="mock-model", namespace="dyn",
+                component="backend", endpoint="generate",
+            )
+            await drt.hub.put(
+                card.key_for(target.instance_id), card.to_dict()
+            )
             async with sess.post(
                 f"{base}/pick",
                 json={"model": "mock-model", "prompt": "hello epp"},
@@ -84,6 +96,20 @@ async def test_epp_picks_kv_warm_worker_with_gie_header():
             # validation + no-worker behavior
             async with sess.post(f"{base}/pick", json={}) as r:
                 assert r.status == 400
+
+            # unknown model name: 404, NOT a silent mock-tokenizer
+            # fallback that returns confidently wrong overlap estimates
+            async with sess.post(
+                f"{base}/pick",
+                json={"model": "no-such-model", "prompt": "hi"},
+            ) as r:
+                assert r.status == 404
+                assert "no-such-model" in (await r.json())["error"]
+            # omitted model still defaults to the first card
+            async with sess.post(
+                f"{base}/pick", json={"prompt": "hi"}
+            ) as r:
+                assert r.status == 200
     finally:
         await epp.close()
         await drt.close()

@@ -330,3 +330,102 @@ async def test_nondurable_hub_still_works(tmp_path):
     finally:
         proc.terminate()
         proc.wait()
+
+
+# -- reconnect-race + publish-idempotency regressions -----------------------
+
+
+async def test_stale_rx_loop_only_fails_own_epoch():
+    """ADVICE r5 medium: a reconnect can replace _rx_task while the OLD
+    rx task is still blocked on its dead reader (the write side of a
+    broken connection fails first). When the old task finally unblocks,
+    its cleanup must fail only ITS generation's pending entries/streams —
+    not futures created on the healthy new connection (which would
+    spuriously retry calls, duplicating non-idempotent ops)."""
+    from dynamo_tpu.runtime.hub_server import HubServer
+
+    server = HubServer(port=0)
+    await server.start()
+    hub = await RemoteHub.connect(f"127.0.0.1:{server.port}")
+    try:
+        old_rx, old_writer = hub._rx_task, hub._writer
+        old_epoch = hub._epoch
+        # the write side broke: _ensure_connected dials a NEW connection
+        # and replaces reader/writer/rx task while old_rx is still
+        # parked in read_frame on the old reader
+        await hub._connect()
+        assert hub._epoch == old_epoch + 1
+        assert hub._rx_task is not old_rx and not old_rx.done()
+
+        loop = asyncio.get_running_loop()
+        old_fut, new_fut = loop.create_future(), loop.create_future()
+        old_q: asyncio.Queue = asyncio.Queue()
+        new_q: asyncio.Queue = asyncio.Queue()
+        hub._pending[9001] = (old_epoch, old_fut)
+        hub._pending[9002] = (hub._epoch, new_fut)
+        hub._streams[9003] = (old_epoch, old_q)
+        hub._streams[9004] = (hub._epoch, new_q)
+
+        # now the old connection actually dies and old_rx unblocks
+        old_writer.close()
+        await asyncio.wait_for(old_rx, 5)
+
+        # own-generation entries failed...
+        assert isinstance(old_fut.exception(), ConnectionError)
+        assert old_q.get_nowait() is None  # closed-stream sentinel
+        # ...new-generation entries untouched
+        assert not new_fut.done()
+        assert new_q.empty()
+
+        hub._pending.pop(9002, None)
+        hub._streams.pop(9003, None)
+        hub._streams.pop(9004, None)
+        new_fut.cancel()
+        # and the new connection still serves calls end-to-end
+        await hub.put("alive", 1)
+        assert await hub.get("alive") == 1
+    finally:
+        await hub.close()
+        await server.stop()
+
+
+async def test_publish_pub_id_dedups_across_retry_and_restart(tmp_path):
+    """ADVICE r5 low: a publish retried after a lost ack must not mint a
+    duplicate event under a fresh seq. The pub_id dedup window also
+    survives a hub restart (WAL carries the id), so a retry landing on
+    the recovered hub still dedups."""
+    hub = DurableHub(tmp_path)
+    assert await hub.publish("ev", {"n": 1}, pub_id="cli:1") is True
+    # the at-least-once retry: same id, must be dropped
+    assert await hub.publish("ev", {"n": 1}, pub_id="cli:1") is False
+    assert await hub.publish("ev", {"n": 2}, pub_id="cli:2") is True
+    # ids are deduped, not subjects: no-id publishes keep old semantics
+    assert await hub.publish("ev", {"n": 3}) is True
+    assert hub._subject_seq["ev"] == 3
+    await hub.close()
+
+    hub2 = DurableHub(tmp_path)
+    assert hub2._subject_seq["ev"] == 3  # replay applied each event once
+    assert await hub2.publish("ev", {"n": 1}, pub_id="cli:1") is False
+    assert hub2._subject_seq["ev"] == 3
+    await hub2.close()
+
+
+async def test_remote_publish_retry_dedups_on_server():
+    """The RemoteHub wire path: a re-sent publish frame with the same
+    pub_id (what _call's reconnect retry produces) applies once."""
+    from dynamo_tpu.runtime.hub_server import HubServer
+
+    server = HubServer(port=0)
+    await server.start()
+    hub = await RemoteHub.connect(f"127.0.0.1:{server.port}")
+    try:
+        assert await hub.publish("s", {"a": 1}) is True  # id auto-attached
+        # simulate the retransmit after a lost ack: same id twice — the
+        # dedup verdict propagates over the wire
+        assert await hub.publish("s", {"a": 2}, pub_id="me:1") is True
+        assert await hub.publish("s", {"a": 2}, pub_id="me:1") is False
+        assert server.hub._subject_seq["s"] == 2
+    finally:
+        await hub.close()
+        await server.stop()

@@ -542,3 +542,53 @@ def test_mirror_follower_kill_and_rejoin():
                 p.wait(timeout=10)
             except subprocess.TimeoutExpired:
                 p.kill()
+
+
+def test_rejoin_sync_skips_dead_requesters_and_bounds_queue():
+    """ADVICE r5 low (spmd.py:296): a follower that dies while parked for
+    a rejoin sync must not get an (unbounded) orphan queue registered in
+    _conns — serve_sync skips closing writers, and live rejoiners get a
+    queue bounded to the catch-up window so a drained-to-death follower
+    hits the normal drop + _DROPPED path instead of pinning leader
+    memory."""
+    import asyncio
+
+    from dynamo_tpu.parallel.spmd import RING_FRAMES, SpmdLeader
+    from dynamo_tpu.runtime.hub import InMemoryHub
+
+    async def run():
+        loop = asyncio.get_running_loop()
+        leader = SpmdLeader(
+            InMemoryHub(), loop, "test-group", strict=False
+        )
+        await leader.start()
+
+        class _Writer:
+            def __init__(self, closing):
+                self._closing = closing
+
+            def is_closing(self):
+                return self._closing
+
+        dead_fut = loop.create_future()
+        live_fut = loop.create_future()
+        leader._sync_waiting = [
+            (dead_fut, _Writer(True)), (live_fut, _Writer(False)),
+        ]
+        leader._sync_pending = 2
+        n_conns0 = len(leader._conns)
+        leader.serve_sync([])
+        await asyncio.sleep(0.05)  # let the loop callback run
+
+        assert dead_fut.cancelled()  # handler takes the close path
+        frames, q = live_fut.result()
+        assert frames and frames[0]["op"] == "__sync__"
+        # bounded (generously) so overflow drops loudly instead of
+        # pinning leader memory; grace deadline set for the strict latch
+        assert q.maxsize == 4 * RING_FRAMES
+        assert q.sync_grace_until > 0
+        assert len(leader._conns) == n_conns0 + 1  # only the live one
+        assert leader.sync_pending == 0
+        await leader.close()
+
+    asyncio.run(run())
