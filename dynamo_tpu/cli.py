@@ -9,8 +9,10 @@ echo, dyn://}``):
   dynamo-tpu run --in text --out echo                        interactive REPL
   dynamo-tpu run --in batch:reqs.jsonl --out engine          offline batch:
       one JSON result line per input line (ref Input::Batch, input.rs:32)
-  dynamo-tpu hub|frontend|worker|mocker|router|planner ...   launch the
-      corresponding service process (same as python -m dynamo_tpu.<mod>)
+  dynamo-tpu hub|hub-replica|frontend|worker|mocker|router|planner ...
+      launch the corresponding service process (same as python -m
+      dynamo_tpu.<mod>); hub-replica runs one member of a replicated
+      hub cluster (runtime/hub_replica.py)
   dynamo-tpu bench|profile ...                               load generator /
       SLA profiler (benchmarks/)
 """
@@ -23,6 +25,7 @@ import sys
 
 SUBCOMMAND_MODULES = {
     "hub": "dynamo_tpu.runtime.hub_server",
+    "hub-replica": "dynamo_tpu.runtime.hub_replica",
     "frontend": "dynamo_tpu.frontend.__main__",
     "worker": "dynamo_tpu.engine.worker",
     "mocker": "dynamo_tpu.mocker.__main__",

@@ -322,8 +322,8 @@ async def _amain(args: argparse.Namespace) -> None:
 
         rcfg = RuntimeConfig.from_env()
         if args.hub:
-            rcfg.hub_address = args.hub
-        hub = await connect_hub(rcfg.hub_address)
+            rcfg.override_hub(args.hub)
+        hub = await connect_hub(rcfg.hub_target())
         engine = _build_engine_shell(args, ecfg, hub=hub)
         group = f"{args.namespace}/{args.component}/{args.endpoint}"
         print("MIRROR_FOLLOWER_READY", flush=True)
@@ -355,16 +355,16 @@ async def _amain(args: argparse.Namespace) -> None:
 
             rcfg = RuntimeConfig.from_env()
             if args.hub:
-                rcfg.hub_address = args.hub
-            hub = await connect_hub(rcfg.hub_address)
+                rcfg.override_hub(args.hub)
+            hub = await connect_hub(rcfg.hub_target())
             engine = _build_engine_shell(args, ecfg, hub=hub)
             print("MULTIHOST_FOLLOWER_READY", flush=True)
             await SpmdFollower(hub, group, engine).run()
             return
     rcfg = RuntimeConfig.from_env()
     if args.hub:
-        rcfg.hub_address = args.hub
-    drt = DistributedRuntime(await connect_hub(rcfg.hub_address), rcfg)
+        rcfg.override_hub(args.hub)
+    drt = DistributedRuntime(await connect_hub(rcfg.hub_target()), rcfg)
     if multihost or args.mirror == "leader":
         import asyncio as _aio
 

@@ -20,10 +20,10 @@ from dynamo_tpu.runtime.logging_util import setup_logging
 async def _amain(args: argparse.Namespace) -> None:
     cfg = RuntimeConfig.from_env()
     if args.hub:
-        cfg.hub_address = args.hub
+        cfg.override_hub(args.hub)
     if args.port is not None:
         cfg.http_port = args.port
-    drt = DistributedRuntime(await connect_hub(cfg.hub_address), cfg)
+    drt = DistributedRuntime(await connect_hub(cfg.hub_target()), cfg)
     manager = ModelManager()
     watcher = await ModelWatcher(drt, manager).start()
     frontend = HttpFrontend(manager, host=args.host, port=cfg.http_port, drt=drt)

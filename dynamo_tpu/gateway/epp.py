@@ -195,8 +195,8 @@ async def _amain(args: argparse.Namespace) -> None:
 
     rcfg = RuntimeConfig.from_env()
     if args.hub:
-        rcfg.hub_address = args.hub
-    drt = DistributedRuntime(await connect_hub(rcfg.hub_address), rcfg)
+        rcfg.override_hub(args.hub)
+    drt = DistributedRuntime(await connect_hub(rcfg.hub_target()), rcfg)
     epp = await EndpointPicker(
         drt,
         namespace=args.namespace,

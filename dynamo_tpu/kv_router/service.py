@@ -106,8 +106,8 @@ class RouterService:
 async def _amain(args: argparse.Namespace) -> None:
     rcfg = RuntimeConfig.from_env()
     if args.hub:
-        rcfg.hub_address = args.hub
-    drt = DistributedRuntime(await connect_hub(rcfg.hub_address), rcfg)
+        rcfg.override_hub(args.hub)
+    drt = DistributedRuntime(await connect_hub(rcfg.hub_target()), rcfg)
     svc = RouterService(
         drt,
         namespace=args.namespace,

@@ -73,8 +73,8 @@ async def launch_mock_worker(
 async def _amain(args: argparse.Namespace) -> None:
     cfg = RuntimeConfig.from_env()
     if args.hub:
-        cfg.hub_address = args.hub
-    drt = DistributedRuntime(await connect_hub(cfg.hub_address), cfg)
+        cfg.override_hub(args.hub)
+    drt = DistributedRuntime(await connect_hub(cfg.hub_target()), cfg)
     for i in range(args.num_workers):
         mcfg = MockEngineConfig(
             block_size=args.block_size,

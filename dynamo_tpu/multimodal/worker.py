@@ -134,8 +134,8 @@ async def _amain(args) -> None:
 
     rcfg = RuntimeConfig.from_env()
     if args.hub:
-        rcfg.hub_address = args.hub
-    drt = DistributedRuntime(await connect_hub(rcfg.hub_address), rcfg)
+        rcfg.override_hub(args.hub)
+    drt = DistributedRuntime(await connect_hub(rcfg.hub_target()), rcfg)
     encoder = None
     if args.encoder == "vit":
         encoder = _build_vit(args)

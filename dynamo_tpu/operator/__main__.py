@@ -13,16 +13,27 @@ import logging
 
 from dynamo_tpu.operator.backends import make_backend
 from dynamo_tpu.operator.controller import Reconciler
+from dynamo_tpu.runtime.config import RuntimeConfig
 from dynamo_tpu.runtime.hub_client import connect_hub
 from dynamo_tpu.runtime.logging_util import setup_logging
 
 
 async def _amain(args: argparse.Namespace) -> None:
-    hub = await connect_hub(args.hub)
+    rcfg = RuntimeConfig.from_env()
+    if args.hub:
+        rcfg.override_hub(args.hub)
+    if not rcfg.hub_target():
+        # an operator against a process-local in-memory hub reconciles
+        # nothing anyone can see — fail loudly, not "successfully"
+        raise SystemExit(
+            "operator: --hub (or DYN_HUB_ADDRESSES / DYN_HUB_ADDRESS) "
+            "is required"
+        )
+    hub = await connect_hub(rcfg.hub_target())
     backend = (
         make_backend(
             "kubectl", namespace=args.k8s_namespace, image=args.k8s_image,
-            hub=args.hub, graph=args.name,
+            hub=rcfg.hub_target(), graph=args.name,
         )
         if args.backend == "kubectl"
         else make_backend("process")
@@ -49,7 +60,9 @@ async def _amain(args: argparse.Namespace) -> None:
 
 def main(argv=None) -> int:
     p = argparse.ArgumentParser("dynamo-tpu operator")
-    p.add_argument("--hub", required=True)
+    p.add_argument("--hub", default="",
+                   help="hub address or comma-separated replica list "
+                   "(default: DYN_HUB_ADDRESSES / DYN_HUB_ADDRESS env)")
     p.add_argument("--name", default="default",
                    help="DynamoGraphDeployment name to reconcile")
     p.add_argument("--backend", default="process",

@@ -124,8 +124,8 @@ async def _amain(args) -> None:
 
     rcfg = RuntimeConfig.from_env()
     if args.hub:
-        rcfg.hub_address = args.hub
-    hub = await connect_hub(rcfg.hub_address)
+        rcfg.override_hub(args.hub)
+    hub = await connect_hub(rcfg.hub_target())
     planner = build_planner(args, hub=hub)
     print("PLANNER_READY", flush=True)
     await planner.run()

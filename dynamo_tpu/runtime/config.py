@@ -35,6 +35,9 @@ class RuntimeConfig:
     # identity / cluster
     namespace: str = "dynamo"
     hub_address: str = ""  # "host:port" of the hub service; empty = in-memory
+    # replicated hub: comma-separated replica addresses (DYN_HUB_ADDRESSES);
+    # takes precedence over hub_address — clients fail over across the list
+    hub_addresses: str = ""
     static: bool = False  # static mode: no discovery, fixed peers (ref lib.rs:205)
 
     # data plane
@@ -60,6 +63,19 @@ class RuntimeConfig:
     block_size: int = 64  # KV cache block granularity (tokens/block)
 
     extra: dict[str, Any] = field(default_factory=dict)
+
+    def hub_target(self) -> str:
+        """The address string to hand connect_hub: the replica list when
+        configured, else the single hub address (possibly empty =
+        in-memory)."""
+        return self.hub_addresses or self.hub_address
+
+    def override_hub(self, address: str) -> "RuntimeConfig":
+        """CLI ``--hub`` beats env: route hub_target() at ``address``
+        (single ``host:port`` or a comma-separated replica list). One
+        helper so every entry point applies the same precedence."""
+        self.hub_address = self.hub_addresses = address
+        return self
 
     @classmethod
     def from_env(cls, env: dict[str, str] | None = None) -> "RuntimeConfig":

@@ -66,11 +66,12 @@ class DistributedRuntime:
 
     @classmethod
     async def from_settings(cls, config: RuntimeConfig | None = None) -> "DistributedRuntime":
-        """Connect per config: remote hub if ``hub_address`` set, else local."""
+        """Connect per config: remote hub if ``hub_target()`` (replica
+        list or single address) is set, else local."""
         config = config or RuntimeConfig.from_env()
         hub: Hub
-        if config.hub_address:
-            hub = await RemoteHub.connect(config.hub_address, config.connect_timeout_s)
+        if config.hub_target():
+            hub = await RemoteHub.connect(config.hub_target(), config.connect_timeout_s)
         else:
             hub = InMemoryHub()
         return cls(hub, config)

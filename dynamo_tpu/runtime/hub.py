@@ -285,6 +285,15 @@ class InMemoryHub(Hub):
 
     # -- pub/sub -----------------------------------------------------------
 
+    def _subject_seq_base(self) -> int:
+        """Seq baseline for a subject with no recorded counter. The
+        replicated hub overrides this (hub_replica.py): after a
+        failover, subjects created in the dead leader's unshipped tail
+        are unknown to the promoted leader, and minting their seqs from
+        0 would make subscriber seq-dedup silently drop the first
+        post-failover events."""
+        return 0
+
     def _pub_id_fresh(self, pub_id: str | None) -> bool:
         """Record ``pub_id`` in the bounded dedup window; False when the
         id was already seen (a retried publish — drop it)."""
@@ -304,7 +313,7 @@ class InMemoryHub(Hub):
             return False  # retried duplicate: already applied
         if subject not in self._retained:
             self._retained[subject] = deque(maxlen=self.RETAIN_PER_SUBJECT)
-        seq = self._subject_seq.get(subject, 0) + 1
+        seq = self._subject_seq.get(subject, self._subject_seq_base()) + 1
         self._subject_seq[subject] = seq
         self._retained[subject].append((seq, payload))
         for pattern, q in self._subs:

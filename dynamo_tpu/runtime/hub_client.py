@@ -24,6 +24,15 @@ the crash may raise KeyExists on retry (same exposure etcd clients have
 without txn ids). Workers still treat a hub that stays unreachable past
 the reconnect window as fatal, mirroring the reference's etcd-loss =>
 shutdown behavior (lib/runtime/src/lib.rs).
+
+Replicated-hub failover (hub_replica.py): construct with a comma-
+separated address list (or set ``DYN_HUB_ADDRESSES``) and the client
+dials round-robin across replicas, follows ``not_leader`` redirects so
+writes always land on the leader while reads are served by whichever
+replica answered, and — because failover rides the same reconnect path —
+re-syncs watches (snapshot diff) and re-subscribes with seq dedup
+against the promoted follower exactly as it does across a restart (the
+cluster shares one boot_id, so seq baselines stay valid).
 """
 
 from __future__ import annotations
@@ -41,7 +50,23 @@ class _ConnLost(Exception):
     """Internal: the stream's connection died mid-iteration."""
 
 
+class NotLeader(Exception):
+    """A write landed on a replicated-hub follower. ``leader`` is the
+    current leader's address when known, None mid-election. _call follows
+    the redirect transparently; this only escapes to callers when the
+    cluster stays leaderless past the reconnect window."""
+
+    def __init__(self, leader: str | None):
+        super().__init__(leader or "<no leader>")
+        self.leader = leader
+
+
 class RemoteHub(Hub):
+    """Hub client. ``address`` may be ONE ``host:port`` or a comma-
+    separated list (a replicated hub, hub_replica.py): dials round-robin
+    across the list, follows ``not_leader`` redirects for writes, and
+    fails over streams to whichever replica answers."""
+
     def __init__(
         self,
         address: str,
@@ -51,8 +76,10 @@ class RemoteHub(Hub):
     ):
         import uuid
 
-        host, _, port = address.rpartition(":")
-        self._host, self._port = host or "127.0.0.1", int(port)
+        self._addrs = [a.strip() for a in address.split(",") if a.strip()]
+        if not self._addrs:
+            raise ValueError("empty hub address")
+        self._addr_idx = 0
         self._reader: asyncio.StreamReader | None = None
         self._writer: asyncio.StreamWriter | None = None
         self._ids = itertools.count(1)
@@ -96,13 +123,33 @@ class RemoteHub(Hub):
         await hub._connect(timeout)
         return hub
 
+    @staticmethod
+    def _split(addr: str) -> tuple[str, int]:
+        host, _, port = addr.rpartition(":")
+        return host or "127.0.0.1", int(port)
+
     async def _connect(self, timeout: float = 5.0) -> None:
-        self._reader, self._writer = await asyncio.wait_for(
-            asyncio.open_connection(self._host, self._port), timeout
-        )
-        self._epoch += 1
-        self._rx_task = asyncio.get_running_loop().create_task(
-            self._rx_loop(self._reader, self._epoch)
+        """Dial the preferred address, falling back round-robin through
+        the rest; raises the last dial error when every replica fails."""
+        last_err: Exception | None = None
+        for i in range(len(self._addrs)):
+            idx = (self._addr_idx + i) % len(self._addrs)
+            host, port = self._split(self._addrs[idx])
+            try:
+                self._reader, self._writer = await asyncio.wait_for(
+                    asyncio.open_connection(host, port), timeout
+                )
+            except (OSError, asyncio.TimeoutError) as e:
+                last_err = e
+                continue
+            self._addr_idx = idx
+            self._epoch += 1
+            self._rx_task = asyncio.get_running_loop().create_task(
+                self._rx_loop(self._reader, self._epoch)
+            )
+            return
+        raise last_err if last_err is not None else OSError(
+            "no hub addresses"
         )
 
     def _connected(self) -> bool:
@@ -206,8 +253,25 @@ class RemoteHub(Hub):
         if not msg.get("ok"):
             if msg.get("error") == "key_exists":
                 raise KeyExists(msg.get("key"))
+            if msg.get("error") == "not_leader":
+                raise NotLeader(msg.get("leader"))
             raise RuntimeError(f"hub error for {op}: {msg.get('error')}")
         return msg.get("result")
+
+    async def _redirect(self, leader: str | None) -> None:
+        """Point the next dial at the leader (when hinted; otherwise the
+        next replica in the ring — an election may still be running) and
+        drop the current connection so _ensure_connected re-dials."""
+        if leader:
+            if leader not in self._addrs:
+                self._addrs.append(leader)
+            self._addr_idx = self._addrs.index(leader)
+        else:
+            self._addr_idx = (self._addr_idx + 1) % len(self._addrs)
+        async with self._conn_lock:
+            if self._writer is not None:
+                self._writer.close()
+        await asyncio.sleep(0.05)
 
     async def _call(self, op: str, **kwargs: Any) -> Any:
         deadline: float | None = None
@@ -215,6 +279,23 @@ class RemoteHub(Hub):
             try:
                 await self._ensure_connected()
                 return await self._send_request(op, kwargs)
+            except NotLeader as e:
+                # a follower bounced a write: chase the leader until the
+                # cluster converges or the window closes
+                if not self._reconnect or self._closed:
+                    raise ConnectionError(
+                        f"hub follower refused {op!r}: leader is "
+                        f"{e.leader or 'unknown'}"
+                    )
+                deadline = deadline or (
+                    time.monotonic() + self._reconnect_window_s
+                )
+                if time.monotonic() >= deadline:
+                    raise ConnectionError(
+                        f"hub leaderless for {self._reconnect_window_s}s "
+                        f"(op {op!r})"
+                    )
+                await self._redirect(e.leader)
             except ConnectionError:
                 if not self._reconnect or self._closed:
                     raise
@@ -483,9 +564,17 @@ class RemoteHub(Hub):
 
 
 async def connect_hub(address: str | None) -> Hub:
-    """Connect to a remote hub, or fall back to a process-local one."""
+    """Connect to a remote hub, or fall back to a process-local one.
+
+    ``address`` may be a comma-separated multi-address list (a replicated
+    hub deployment, hub_replica.py) — every connect site gets round-robin
+    failover across the whole list, not just the first entry. Env
+    layering (``DYN_HUB_ADDRESSES`` / ``DYN_HUB_ADDRESS``) lives in
+    RuntimeConfig.hub_target(), the single source of truth — callers pass
+    its result; an empty address always means in-memory."""
     from dynamo_tpu.runtime.hub import InMemoryHub
 
+    address = (address or "").strip()
     if address:
         return await RemoteHub.connect(address)
     return InMemoryHub()

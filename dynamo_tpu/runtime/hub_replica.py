@@ -1,0 +1,687 @@
+"""Replicated hub: WAL-shipping followers + deterministic failover.
+
+The hub is the control plane's last single point of failure: EPP picks,
+KV-router publishes, worker leases, and planner watches all die with one
+process, even though hub_store.py already makes that process durable.
+The reference design leans on etcd's replicated keyspace here; this
+module gives the self-hosted hub the minimal Raft-shaped slice of that
+(Ongaro & Ousterhout: a leader streaming committed log records to
+followers that replay them into identical state machines) without the
+quorum machinery:
+
+- ONE leader serves writes and streams its committed WAL records (plus a
+  snapshot bootstrap at the current state) to followers over the
+  existing framed transport (``repl.sync`` → snapshot/append/hb frames);
+- followers replay records into their own ``DurableHub`` — persisting
+  locally, firing watch/subscribe notifications for their own clients —
+  and answer reads while bouncing writes with a ``not_leader`` error
+  naming the leader (hub_client.py follows the redirect);
+- when a follower sees nothing from the leader for ``lease_s`` (the
+  leader lease), the MOST-CAUGHT-UP live replica (highest replication
+  epoch, then highest WAL position, ties broken by lowest address)
+  promotes itself and bumps the replication epoch; everyone else
+  re-syncs to it. Ranking by data before address matters: a crashed
+  leader restarting with a wiped data dir must defer to followers that
+  still hold the replicated state instead of re-electing itself empty
+  and streaming that emptiness over everyone else's copy.
+
+Identity is cluster-wide: a follower's bootstrap snapshot carries the
+leader's ``boot_id``, ``wal_seq``, and per-subject seq counters, so
+client seq baselines stay valid across a failover. Promotion advances
+every subject seq by ``PROMOTION_SEQ_GAP`` so events minted by the new
+leader always outrank anything the dead leader's subscribers saw, even
+if the follower was a few records behind.
+
+Consistency contract (documented, not hidden): replication is
+asynchronous — an acked write that never reached a follower is lost if
+the leader dies before shipping it. Publishers cover that window with
+at-least-once retries + ``pub_id`` dedup (a retry that lands on the new
+leader either re-applies the lost event or is dropped as a duplicate —
+never double-counted), which is exactly the contract single-hub
+reconnects already had. Follower reads may be a replication beat stale.
+Under a full partition the best-ranked live replica on each side could
+lead its side (no quorum): run replicas in one failure domain per zone
+and size ``lease_s`` above worst-case GC/IO pauses.
+
+Run: ``python -m dynamo_tpu.runtime.hub_replica --port P --peers
+h1:p1,h2:p2,h3:p3 --data-dir DIR`` on each replica; point clients at the
+full list (``DYN_HUB_ADDRESSES``).
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import fnmatch
+import logging
+import time
+import uuid
+from collections import OrderedDict, deque
+from pathlib import Path
+from typing import Any
+
+from dynamo_tpu.runtime import framing
+from dynamo_tpu.runtime.hub import WatchEvent, _Lease
+from dynamo_tpu.runtime.hub_server import HubServer
+from dynamo_tpu.runtime.hub_store import DurableHub
+
+log = logging.getLogger("dynamo.hub")
+
+__all__ = ["ReplicatedHub", "ReplicatedHubServer", "HubReplica", "addr_key"]
+
+
+def addr_key(addr: str) -> tuple[str, int]:
+    """Numeric-port sort key: '10.0.0.1:9000' < '10.0.0.1:10000' must
+    hold numerically (lexical comparison would invert it)."""
+    host, _, port = addr.rpartition(":")
+    try:
+        return (host, int(port))
+    except ValueError:
+        return (addr, 0)
+
+
+class ReplicatedHub(DurableHub):
+    """DurableHub with a replication role: a follower replays the
+    leader's records (never reaping leases or accepting direct writes);
+    promotion turns it into a leader in place."""
+
+    # added to every per-subject seq on promotion: new-leader events must
+    # outrank anything the dead leader minted past our replication cursor
+    PROMOTION_SEQ_GAP = 1 << 20
+
+    def __init__(
+        self, data_dir: str | Path, *, compact_every: int = 8192,
+        fsync: bool | None = None, role: str = "follower",
+    ) -> None:
+        super().__init__(data_dir, compact_every=compact_every, fsync=fsync)
+        self.role = role
+
+    # -- role gating --------------------------------------------------------
+
+    def _ensure_reaper(self) -> None:
+        # keepalives are not replicated: only the leader may decide a
+        # lease is dead (followers learn expiry from its revoke records)
+        if self.role == "leader":
+            super()._ensure_reaper()
+
+    def reap_expired(self, now: float | None = None) -> list[int]:
+        if self.role != "leader":
+            return []
+        return super().reap_expired(now)
+
+    def _subject_seq_base(self) -> int:
+        # a subject first seen in term E must mint seqs above every seq
+        # any earlier term could have minted for it (same <2^20-events-
+        # per-subject-per-term assumption the promotion gap makes):
+        # subscribers that followed the dead leader keep valid baselines
+        # even for subjects the promoted leader never learned
+        return self.repl_epoch * self.PROMOTION_SEQ_GAP
+
+    def _lease_snapshot_live(self, lease: Any, now: float) -> bool:
+        # a follower's lease deadlines go stale by design (keepalives
+        # are not replicated; expiry arrives as the leader's revoke
+        # record), so its snapshots must keep every lease — dropping one
+        # here would kill a live owner's keepalive after this follower
+        # restarts and later promotes
+        if self.role != "leader":
+            return True
+        return super()._lease_snapshot_live(lease, now)
+
+    # -- promotion ----------------------------------------------------------
+
+    def promote(self, epoch: int | None = None) -> int:
+        """Become the leader: bump the epoch, reset lease deadlines to a
+        full-TTL grace (recovery semantics — live owners keepalive, dead
+        owners re-expire), gap the subject seqs, start reaping."""
+        if self.role == "leader":
+            return self.repl_epoch
+        self.role = "leader"
+        self.repl_epoch = (
+            self.repl_epoch + 1 if epoch is None
+            else max(int(epoch), self.repl_epoch + 1)
+        )
+        self.wal_seq = max(self.wal_seq, self.repl_cursor)
+        now = time.monotonic()
+        for lease in self._leases.values():
+            lease.deadline = now + lease.ttl
+        gap = self.PROMOTION_SEQ_GAP
+        for subj in list(self._subject_seq):
+            self._subject_seq[subj] += gap
+        self._log({"op": "promote", "epoch": self.repl_epoch, "gap": gap})
+        self._ensure_reaper()
+        return self.repl_epoch
+
+    def demote(self) -> None:
+        """Step down (a competing leader outranks us); the replica's role
+        loop re-syncs to the winner."""
+        self.role = "follower"
+
+    # -- follower replay ----------------------------------------------------
+
+    def reset_from_snapshot(
+        self, state: dict[str, Any], seq: int, epoch: int
+    ) -> None:
+        """Adopt a full leader snapshot: replace ALL local state (incl.
+        boot_id — identity is cluster-wide), persist it as our own
+        snapshot, and surface the change to locally connected watchers as
+        synthetic events (puts are idempotent upserts for every consumer;
+        keys gone from the new state get deletes)."""
+        old_keys = set(self._kv)
+        self._kv = {}
+        self._key_lease = {}
+        self._leases = {}
+        self._retained = {}
+        self._subject_seq = {}
+        self._seen_pub_ids = OrderedDict()
+        self._objects = {}
+        # the catch-up backlog indexes the OLD seq space; a stale window
+        # here could satisfy a peer's repl.sync with wrong records
+        self._recent.clear()
+        self._restore(state)
+        self.repl_cursor = int(seq)
+        self.repl_epoch = int(epoch)
+        self.store.snapshot(self._state())
+        for key in sorted(old_keys - set(self._kv)):
+            self._notify(WatchEvent("delete", key))
+        for key, value in sorted(self._kv.items()):
+            self._notify(WatchEvent("put", key, value))
+
+    async def apply_replicated(self, rec: dict[str, Any], seq: int) -> None:
+        """Replay ONE leader WAL record: mutate state exactly as the
+        leader did, fire local watch/subscribe notifications, and log the
+        record (tagged with the leader seq, ``rsq``) to our own WAL so
+        the replication cursor survives a follower restart."""
+        seq = int(seq)
+        if seq <= self.repl_cursor:
+            return  # duplicate delivery (resync overlap)
+        op = rec["op"]
+        if op == "put":
+            key, lid = rec["k"], rec.get("l")
+            if lid is not None and lid in self._leases:
+                self._leases[lid].keys.add(key)
+                self._key_lease[key] = lid
+            self._kv[key] = rec["v"]
+            self._notify(WatchEvent("put", key, rec["v"]))
+        elif op == "del":
+            key = rec["k"]
+            if self._kv.pop(key, None) is not None:
+                lid = self._key_lease.pop(key, None)
+                if lid is not None and lid in self._leases:
+                    self._leases[lid].keys.discard(key)
+                self._notify(WatchEvent("delete", key))
+        elif op == "lease":
+            lid, ttl = rec["id"], rec["ttl"]
+            self._leases[lid] = _Lease(lid, ttl, time.monotonic() + ttl)
+            self._next_lease = max(self._next_lease, lid + 1)
+        elif op == "revoke":
+            lease = self._leases.get(rec["id"])
+            if lease is not None:
+                self._drop_lease(lease)  # notifies the key deletes
+        elif op == "pub":
+            subj = rec["s"]
+            if self._pub_id_fresh(rec.get("pid")):
+                if subj not in self._retained:
+                    self._retained[subj] = deque(
+                        maxlen=self.RETAIN_PER_SUBJECT
+                    )
+                sseq = self._subject_seq.get(
+                    subj, self._subject_seq_base()
+                ) + 1
+                self._subject_seq[subj] = sseq
+                self._retained[subj].append((sseq, rec["p"]))
+                for pattern, q in self._subs:
+                    if fnmatch.fnmatchcase(subj, pattern):
+                        q.put_nowait((subj, rec["p"], sseq))
+        else:
+            # purge / obj / objdel / promote: the recovery-replay body is
+            # already notification-free and correct here
+            self._apply(rec)
+        self.repl_cursor = seq
+        self._log(dict(rec, rsq=seq))
+
+
+class ReplicatedHubServer(HubServer):
+    """HubServer + replication RPCs; bounces writes while follower."""
+
+    def __init__(
+        self, replica: "HubReplica", host: str = "127.0.0.1", port: int = 0
+    ):
+        super().__init__(host, port, hub=replica.hub)
+        self.replica = replica
+
+    def _route(self, op: str) -> dict[str, Any] | None:
+        if self.hub.role != "leader" and op in self.WRITE_OPS:
+            return {"error": "not_leader", "leader": self.replica.leader_addr}
+        return None
+
+    async def _dispatch_repl(
+        self, op: str, mid: int, msg: dict[str, Any], send, streams
+    ) -> bool:
+        hub: ReplicatedHub = self.hub
+        if op == "repl.status":
+            await send({"id": mid, "ok": True, "result": {
+                "role": hub.role, "leader": self.replica.leader_addr,
+                "epoch": hub.repl_epoch, "wal_seq": hub.wal_seq,
+                "cursor": hub.repl_cursor, "boot_id": hub.boot_id,
+                "addr": self.replica.advertise,
+                "nonce": self.replica.nonce,
+            }})
+            return True
+        if op == "repl.sync":
+            if hub.role != "leader":
+                await send({"id": mid, "ok": False, "error": "not_leader",
+                            "leader": self.replica.leader_addr})
+                return True
+            streams[mid] = asyncio.ensure_future(self._stream_repl(
+                mid, int(msg.get("cursor", 0)), int(msg.get("epoch", -1)),
+                msg.get("boot"), send,
+            ))
+            return True
+        if op == "repl.append":
+            # push-apply one record (admin/tooling path; the normal tail
+            # rides the repl.sync stream)
+            if hub.role == "leader":
+                await send({"id": mid, "ok": False, "error": "is_leader"})
+            elif int(msg.get("epoch", -1)) != hub.repl_epoch:
+                await send({"id": mid, "ok": False,
+                            "error": "epoch_mismatch",
+                            "epoch": hub.repl_epoch})
+            elif int(msg["seq"]) > hub.repl_cursor + 1:
+                await send({"id": mid, "ok": False, "error": "gap",
+                            "cursor": hub.repl_cursor})
+            else:
+                await hub.apply_replicated(msg["rec"], int(msg["seq"]))
+                await send({"id": mid, "ok": True,
+                            "result": hub.repl_cursor})
+            return True
+        if op == "repl.promote":
+            epoch = hub.promote(msg.get("epoch"))
+            self.replica.on_promoted()
+            await send({"id": mid, "ok": True, "result": epoch})
+            return True
+        return False
+
+    async def _stream_repl(
+        self, mid: int, cursor: int, epoch: int, boot: str | None, send
+    ) -> None:
+        hub: ReplicatedHub = self.hub
+        # bounded: a follower that stops draining (stalled TCP, wedged
+        # process) marks the queue overflowed instead of growing leader
+        # memory one record per mutation; the stream then ends and the
+        # follower re-syncs from its durable cursor
+        q: asyncio.Queue = asyncio.Queue(maxsize=hub.REPL_BACKLOG)
+        q.repl_overflowed = False
+        hub._repl_listeners.append(q)
+        try:
+            # listener registration, backlog slice, and snapshot capture
+            # form one synchronous block — nothing can be logged between
+            # them, so queue + what we send below cover the stream
+            # exactly once with no gap and no duplicate
+            recent = list(hub._recent)
+            oldest = recent[0][0] if recent else hub.wal_seq + 1
+            caught_up = (
+                boot == hub.boot_id
+                and epoch == hub.repl_epoch
+                and cursor <= hub.wal_seq
+                and cursor >= oldest - 1
+            )
+            if caught_up:
+                for s, r in recent:
+                    if s > cursor:
+                        await send({"id": mid, "stream": {
+                            "kind": "append", "rec": r, "seq": s}})
+            else:
+                await send({"id": mid, "stream": {
+                    "kind": "snapshot", "state": hub._state(),
+                    "seq": hub.wal_seq, "epoch": hub.repl_epoch}})
+            while not q.repl_overflowed:
+                try:
+                    s, r = await asyncio.wait_for(
+                        q.get(), self.replica.hb_interval_s
+                    )
+                except asyncio.TimeoutError:
+                    if hub.role != "leader":
+                        break  # demoted: end stream, follower rediscovers
+                    await send({"id": mid, "stream": {
+                        "kind": "hb", "seq": hub.wal_seq,
+                        "epoch": hub.repl_epoch}})
+                    continue
+                await send({"id": mid, "stream": {
+                    "kind": "append", "rec": r, "seq": s}})
+        except asyncio.CancelledError:
+            pass
+        except (ConnectionResetError, BrokenPipeError, OSError):
+            pass
+        finally:
+            hub._repl_listeners.remove(q)
+
+
+class HubReplica:
+    """One replica: a ReplicatedHub + its server + the role loop
+    (discover -> follow -> elect -> lead)."""
+
+    def __init__(
+        self, host: str, port: int, peers: list[str] | str,
+        data_dir: str | Path, *, advertise: str | None = None,
+        lease_s: float = 3.0, hb_interval_s: float | None = None,
+        fsync: bool | None = None, compact_every: int = 8192,
+    ):
+        if isinstance(peers, str):
+            peers = peers.split(",")
+        self.peers = [p.strip() for p in peers if p.strip()]
+        self.host, self.port = host, port
+        self.advertise = advertise or f"{host}:{port}"
+        self.lease_s = lease_s
+        self.hb_interval_s = hb_interval_s or max(lease_s / 6.0, 0.05)
+        self.hub = ReplicatedHub(
+            data_dir, compact_every=compact_every, fsync=fsync
+        )
+        self.server = ReplicatedHubServer(self, host, port)
+        # per-PROCESS identity for probe self-recognition: boot_id is
+        # cluster-wide (followers adopt the leader's) and the advertise
+        # string can be spelled differently from the peers list
+        # (localhost vs 127.0.0.1), so neither can tell "that status is
+        # me" reliably — a replica probing itself as a phantom peer
+        # would defer elections to it forever
+        self.nonce = uuid.uuid4().hex
+        self.leader_addr: str | None = None
+        self.stats = {
+            "snapshots": 0, "appends": 0, "promotions": 0, "elections": 0,
+        }
+        self._task: asyncio.Task | None = None
+        self._stopping = False
+        self._live_peer_stats: list[dict[str, Any]] = []
+
+    # -- lifecycle ----------------------------------------------------------
+
+    async def start(self) -> tuple[str, int]:
+        host, port = await self.server.start()
+        self.host, self.port = host, port
+        if self.advertise.endswith(":0"):
+            self.advertise = f"{host}:{port}"
+        self._task = asyncio.get_running_loop().create_task(
+            self._role_loop()
+        )
+        return host, port
+
+    async def stop(self) -> None:
+        self._stopping = True
+        if self._task is not None:
+            # cancel-with-retry: on 3.10 asyncio.wait_for can swallow a
+            # lone cancellation when its inner future completes in the
+            # same tick (probes to dead peers complete constantly during
+            # teardown, so the race is live here). The stopping flag
+            # bounds every loop await to ~lease_s regardless.
+            while not self._task.done():
+                self._task.cancel()
+                try:
+                    await asyncio.wait_for(
+                        asyncio.shield(self._task), 1.0
+                    )
+                except asyncio.TimeoutError:
+                    continue
+                except asyncio.CancelledError:
+                    pass
+            self._task = None
+        await self.server.stop()
+
+    def on_promoted(self) -> None:
+        """External promotion (repl.promote RPC) landed on our hub."""
+        if self.hub.role == "leader":
+            self.leader_addr = self.advertise
+            self.stats["promotions"] += 1
+
+    # -- role loop ----------------------------------------------------------
+
+    async def _role_loop(self) -> None:
+        try:
+            while not self._stopping:
+                if self.hub.role == "leader":
+                    self.leader_addr = self.advertise
+                    await self._lead()
+                    continue
+                leader = await self._discover()
+                if leader is None:
+                    await self._elect()
+                else:
+                    await self._follow(leader)
+        except asyncio.CancelledError:
+            pass
+
+    async def _probe(
+        self, addr: str, timeout: float = 0.75
+    ) -> dict[str, Any] | None:
+        """repl.status of one peer; None when unreachable (or pre-
+        replication: an old hub answers unknown-op, mapped to None)."""
+        try:
+            host, _, port = addr.rpartition(":")
+            reader, writer = await asyncio.wait_for(
+                asyncio.open_connection(host or "127.0.0.1", int(port)),
+                timeout,
+            )
+        except (OSError, asyncio.TimeoutError, ValueError):
+            return None
+        try:
+            await framing.write_frame(
+                writer, {"id": 1, "op": "repl.status"}
+            )
+            msg = await asyncio.wait_for(framing.read_frame(reader), timeout)
+            if msg and msg.get("ok"):
+                # rank by the address WE dialed (advertise mismatches
+                # must not fork the ordering)
+                return dict(msg["result"], addr=addr)
+        except (OSError, asyncio.TimeoutError, ValueError):
+            pass
+        finally:
+            writer.close()
+        return None
+
+    @staticmethod
+    def _rank(status: dict[str, Any]) -> tuple:
+        """Election sort key (ascending = better): highest epoch, then
+        highest WAL position, then lowest address. Data outranks
+        address so a wiped-and-restarted replica can never win against
+        followers still holding the replicated state."""
+        pos = max(int(status.get("wal_seq", 0)), int(status.get("cursor", 0)))
+        return (-int(status.get("epoch", 0)), -pos, addr_key(status["addr"]))
+
+    def _self_status(self) -> dict[str, Any]:
+        return {
+            "addr": self.advertise, "epoch": self.hub.repl_epoch,
+            "wal_seq": self.hub.wal_seq, "cursor": self.hub.repl_cursor,
+        }
+
+    async def _discover(self) -> str | None:
+        """Find the current leader among peers; None = nobody claims it
+        (records the live peer statuses for the election)."""
+        others = [p for p in self.peers if p != self.advertise]
+        statuses = [
+            s for s in await asyncio.gather(
+                *(self._probe(p) for p in others)
+            )
+            # nonce, not addr: a peers-list spelling of our own address
+            # (localhost vs 127.0.0.1) must not register us as a
+            # phantom peer we then defer elections to
+            if s and s.get("nonce") != self.nonce
+        ]
+        leaders = [s for s in statuses if s.get("role") == "leader"]
+        self._live_peer_stats = statuses
+        if not leaders:
+            return None
+        best = min(leaders, key=self._rank)
+        return best["addr"]
+
+    async def _elect(self) -> None:
+        """Leader-lease expired and nobody claims leadership: the
+        best-ranked live replica (_rank: epoch, WAL position, address)
+        promotes itself; everyone else defers and re-probes (the
+        deterministic promotion rule — no votes, no quorum)."""
+        self.stats["elections"] += 1
+        live = sorted(
+            self._live_peer_stats + [self._self_status()], key=self._rank
+        )
+        if live[0]["addr"] == self.advertise:
+            epoch = self.hub.promote()
+            self.leader_addr = self.advertise
+            self.stats["promotions"] += 1
+            log.warning(
+                "hub replica %s promoted to leader (epoch %d)",
+                self.advertise, epoch,
+            )
+        else:
+            self.leader_addr = None
+            await asyncio.sleep(self.hb_interval_s * 2)
+
+    async def _lead(self) -> None:
+        """Leader steady state: repl.sync streams are served by the
+        server; here we only heal accidental split-brain (a competing
+        leader that outranks us per _rank — higher epoch, more data,
+        lower address — wins; step down and re-sync to it)."""
+        while self.hub.role == "leader" and not self._stopping:
+            others = [p for p in self.peers if p != self.advertise]
+            statuses = await asyncio.gather(
+                *(self._probe(p) for p in others)
+            )
+            me = self._rank(self._self_status())
+            for st in statuses:
+                if st and st.get("nonce") == self.nonce:
+                    continue  # our own status dialed via an alias
+                if st and st.get("role") == "leader":
+                    them = self._rank(st)
+                    if them < me:
+                        log.warning(
+                            "hub replica %s stepping down: %s leads at "
+                            "epoch %d", self.advertise, st["addr"],
+                            st.get("epoch", 0),
+                        )
+                        self.hub.demote()
+                        self.leader_addr = st["addr"]
+                        return
+            await asyncio.sleep(self.lease_s)
+
+    async def _follow(self, leader: str) -> None:
+        """Tail the leader's WAL until it dies (lease expiry), demotes,
+        or we get promoted. Returning hands control back to the role
+        loop (re-discover / elect)."""
+        hub = self.hub
+        self.leader_addr = leader
+        try:
+            host, _, port = leader.rpartition(":")
+            reader, writer = await asyncio.wait_for(
+                asyncio.open_connection(host or "127.0.0.1", int(port)),
+                2.0,
+            )
+        except (OSError, asyncio.TimeoutError, ValueError):
+            self.leader_addr = None
+            await asyncio.sleep(self.hb_interval_s)
+            return
+        # a demoted split-brain loser holds records past its replication
+        # cursor (it led and logged its own writes); an append tail would
+        # silently merge that divergence into the winner's history, so
+        # request a full snapshot bootstrap instead
+        diverged = hub.wal_seq > hub.repl_cursor
+        try:
+            await framing.write_frame(writer, {
+                "id": 1, "op": "repl.sync",
+                "cursor": 0 if diverged else hub.repl_cursor,
+                "epoch": -1 if diverged else hub.repl_epoch,
+                "boot": hub.boot_id, "follower": self.advertise,
+            })
+            while hub.role != "leader" and not self._stopping:
+                try:
+                    msg = await asyncio.wait_for(
+                        framing.read_frame(reader), self.lease_s
+                    )
+                except asyncio.TimeoutError:
+                    log.warning(
+                        "hub replica %s: leader %s silent for %.1fs "
+                        "(lease expired)", self.advertise, leader,
+                        self.lease_s,
+                    )
+                    return
+                if hub.role == "leader":
+                    # promoted while the read was pending: the frame is
+                    # from the OLD leader's stream — applying it now
+                    # would merge its post-promotion writes into ours
+                    return
+                if msg is None:
+                    return  # connection closed
+                if not msg.get("ok", True):
+                    if msg.get("error") == "not_leader":
+                        self.leader_addr = msg.get("leader")
+                    return
+                item = msg.get("stream")
+                if not item:
+                    continue
+                kind = item.get("kind")
+                if kind == "snapshot":
+                    hub.reset_from_snapshot(
+                        item["state"], item["seq"], item["epoch"]
+                    )
+                    self.stats["snapshots"] += 1
+                    # adopting a snapshot means locally connected
+                    # subscribers missed whatever the snapshot delta
+                    # contained; kick them so they re-converge through
+                    # the client reconnect path (watch diff re-sync,
+                    # replay-subscribe with per-subject seq dedup)
+                    self.server.kick_clients()
+                elif kind == "append":
+                    seq = int(item["seq"])
+                    if seq > hub.repl_cursor + 1:
+                        log.warning(
+                            "hub replica %s: replication gap (cursor %d,"
+                            " got %d); resyncing", self.advertise,
+                            hub.repl_cursor, seq,
+                        )
+                        return
+                    await hub.apply_replicated(item["rec"], seq)
+                    self.stats["appends"] += 1
+                # hb: the read itself refreshed the leader lease
+        except (ConnectionError, OSError):
+            return
+        finally:
+            writer.close()
+
+
+async def _amain(args: argparse.Namespace) -> None:
+    replica = HubReplica(
+        args.host, args.port, args.peers, args.data_dir,
+        advertise=args.advertise, lease_s=args.lease_s,
+        fsync=True if args.fsync else None,
+    )
+    host, port = await replica.start()
+    print(f"DYNAMO_HUB={host}:{port}", flush=True)
+    try:
+        await replica.server.serve_forever()
+    finally:
+        await replica.stop()
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser(
+        description="dynamo-tpu replicated hub (one replica process)"
+    )
+    parser.add_argument("--host", default="127.0.0.1")
+    parser.add_argument("--port", type=int, default=6650)
+    parser.add_argument("--peers", required=True,
+                        help="comma-separated replica addresses "
+                             "(including this one's advertise address)")
+    parser.add_argument("--data-dir", required=True)
+    parser.add_argument("--advertise", default=None,
+                        help="address peers/clients reach us at "
+                             "(default host:port)")
+    parser.add_argument("--lease-s", type=float, default=3.0,
+                        help="leader lease: silence past this promotes "
+                             "a follower")
+    parser.add_argument("--fsync", action="store_true",
+                        help="fsync every WAL append")
+    args = parser.parse_args()
+    logging.basicConfig(level=logging.INFO)
+    try:
+        asyncio.run(_amain(args))
+    except KeyboardInterrupt:
+        pass
+
+
+if __name__ == "__main__":
+    main()

@@ -29,11 +29,25 @@ log = logging.getLogger("dynamo.hub")
 
 
 class HubServer:
-    def __init__(self, host: str = "127.0.0.1", port: int = 0, data_dir: str | None = None):
-        if data_dir:
+    # ops that mutate hub state — a replicated follower bounces these with
+    # a ``not_leader`` error naming the current leader (hub_replica.py)
+    WRITE_OPS = frozenset({
+        "put", "create", "delete", "grant_lease", "keepalive",
+        "revoke_lease", "publish", "purge_subject", "put_object",
+        "delete_object",
+    })
+
+    def __init__(
+        self, host: str = "127.0.0.1", port: int = 0,
+        data_dir: str | None = None, *,
+        hub: InMemoryHub | None = None, fsync: bool | None = None,
+    ):
+        if hub is not None:
+            self.hub: InMemoryHub = hub
+        elif data_dir:
             from dynamo_tpu.runtime.hub_store import DurableHub
 
-            self.hub: InMemoryHub = DurableHub(data_dir)
+            self.hub = DurableHub(data_dir, fsync=fsync)
         else:
             self.hub = InMemoryHub()
         self.host = host
@@ -70,6 +84,16 @@ class HubServer:
             except asyncio.TimeoutError:  # pragma: no cover
                 pass
         await self.hub.close()
+
+    def kick_clients(self) -> None:
+        """Close every client connection (clients auto-reconnect). Used
+        by a replication follower after adopting a snapshot bootstrap:
+        mid-stream subscribers would otherwise silently miss the events
+        inside the snapshot gap, while the reconnect path re-syncs
+        watches by diff and re-opens replay subscriptions with
+        per-subject seq dedup."""
+        for w in list(self._conns):
+            w.close()
 
     # -- per-connection ----------------------------------------------------
 
@@ -114,6 +138,12 @@ class HubServer:
         mid = msg.get("id")
         hub = self.hub
         try:
+            bounce = self._route(op)
+            if bounce is not None:
+                await send({"id": mid, "ok": False, **bounce})
+                return
+            if await self._dispatch_repl(op, mid, msg, send, streams):
+                return
             if op == "put":
                 await hub.put(msg["key"], msg["value"], msg.get("lease"))
                 result: Any = True
@@ -189,6 +219,19 @@ class HubServer:
         except Exception as e:  # noqa: BLE001 - serve errors to the client
             await send({"id": mid, "ok": False, "error": repr(e)})
 
+    def _route(self, op: str) -> dict[str, Any] | None:
+        """Hook: return an error payload to bounce ``op`` instead of
+        serving it (replicated followers bounce WRITE_OPS with
+        ``not_leader``). None = serve normally."""
+        return None
+
+    async def _dispatch_repl(
+        self, op: str, mid: int, msg: dict[str, Any], send, streams
+    ) -> bool:
+        """Hook: handle replication ops (``repl.*``); True = handled.
+        The base server has none — hub_replica.py overrides."""
+        return False
+
     async def _stream_watch(
         self, mid: int, prefix: str, initial: bool, sync: bool, send
     ) -> None:
@@ -218,7 +261,10 @@ class HubServer:
 
 
 async def _amain(args: argparse.Namespace) -> None:
-    server = HubServer(args.host, args.port, args.data_dir)
+    server = HubServer(
+        args.host, args.port, args.data_dir,
+        fsync=True if args.fsync else None,
+    )
     await server.start()
     print(f"DYNAMO_HUB={server.host}:{server.port}", flush=True)
     await server.serve_forever()
@@ -229,6 +275,10 @@ def main() -> None:
     parser.add_argument("--host", default="127.0.0.1")
     parser.add_argument("--port", type=int, default=6650)
     parser.add_argument("--data-dir", default=None)
+    parser.add_argument("--fsync", action="store_true",
+                        help="fsync every WAL append (survive power loss, "
+                             "not just process death); default follows "
+                             "DYNAMO_HUB_FSYNC=1")
     args = parser.parse_args()
     logging.basicConfig(level=logging.INFO)
     try:

@@ -12,7 +12,8 @@ from disk. This module gives the self-hosted hub the same property:
   flushed before the mutation is acknowledged — a SIGKILL'd hub process
   loses nothing that was acked (OS page cache survives process death;
   set DYNAMO_HUB_FSYNC=1 to also survive kernel/power loss);
-- a periodic snapshot (every ``compact_every`` records) bounds replay
+- a threshold-triggered snapshot (every ``compact_every`` records,
+  written by a background task off the mutation path) bounds replay
   time and WAL growth;
 - recovery rebuilds the FULL hub state — KV + lease bindings, leases,
   retained subjects with their per-subject seq counters, object
@@ -38,10 +39,13 @@ the generation check, never by double-apply). A torn final record
 
 from __future__ import annotations
 
+import asyncio
+import itertools
 import logging
 import os
 import struct
 import time
+from collections import deque
 from pathlib import Path
 from typing import Any
 
@@ -56,14 +60,29 @@ _MAX_REC = 512 * 1024 * 1024
 
 
 class HubStore:
-    """Disk half of the durable hub: WAL append + snapshot rotation."""
+    """Disk half of the durable hub: WAL append + snapshot rotation.
 
-    def __init__(self, data_dir: str | Path):
+    ``fsync`` forces an fsync per WAL append (survives kernel/power loss,
+    not just process death); default follows ``DYNAMO_HUB_FSYNC=1``.
+    """
+
+    def __init__(self, data_dir: str | Path, *, fsync: bool | None = None):
         self.dir = Path(data_dir)
         self.dir.mkdir(parents=True, exist_ok=True)
         self.gen = 0
         self._wal = None
-        self._fsync = os.environ.get("DYNAMO_HUB_FSYNC") == "1"
+        self._tmp_ids = itertools.count(1)
+        # stale temp snapshots (crash mid-write, or a discarded stale
+        # background capture) are dead weight — clear them
+        for p in self.dir.glob("hub.snap.tmp*"):
+            try:
+                p.unlink()
+            except OSError:
+                pass
+        self._fsync = (
+            os.environ.get("DYNAMO_HUB_FSYNC") == "1" if fsync is None
+            else fsync
+        )
         self.records_since_snapshot = 0
 
     @property
@@ -142,18 +161,51 @@ class HubStore:
     # -- snapshot ----------------------------------------------------------
 
     def snapshot(self, state: dict[str, Any]) -> None:
-        """Atomically replace the snapshot and rotate the WAL."""
+        """Atomically replace the snapshot and rotate the WAL (inline)."""
+        tmp, new_gen = self.write_snapshot_tmp(state)
+        self.commit_snapshot(tmp, new_gen, [])
+
+    def write_snapshot_tmp(
+        self, state: dict[str, Any]
+    ) -> tuple[Path, int]:
+        """Serialize + fsync the snapshot to a temp file. Does NOT touch
+        the live snapshot or the WAL, so it is safe to run in a worker
+        thread while the event loop keeps appending to the current WAL
+        (DurableHub background compaction). The temp name is UNIQUE per
+        call: an inline hard-bound snapshot may race an in-flight
+        background write, and a shared name would let the background
+        thread keep writing through its fd into an inode the inline
+        path already renamed onto hub.snap — corrupting the live
+        snapshot."""
         new_gen = self.gen + 1
         state = dict(state, gen=new_gen)
-        tmp = self.snap_path.with_suffix(".tmp")
+        # NOT with_suffix: that would REPLACE ".snap" ("hub.tmp7") and
+        # the crash-cleanup glob for "hub.snap.tmp*" would never match
+        tmp = Path(f"{self.snap_path}.tmp{next(self._tmp_ids)}")
         with open(tmp, "wb") as f:
             f.write(msgpack.packb(state, use_bin_type=True))
             f.flush()
             os.fsync(f.fileno())
-        os.replace(tmp, self.snap_path)
-        old_gen, self.gen = self.gen, new_gen
+        return tmp, new_gen
+
+    def commit_snapshot(
+        self, tmp: Path, new_gen: int,
+        pending: list[dict[str, Any]],
+    ) -> None:
+        """Publish a prepared snapshot: start the new-generation WAL,
+        re-append ``pending`` records (mutations logged AFTER the state
+        was captured — they are in the old-gen WAL, which the new
+        snapshot's generation check will ignore), then atomically replace
+        the snapshot. Crash-safe in both orders: before the replace the
+        old snapshot + old WAL are authoritative; after it the new
+        snapshot + new WAL already hold the pending tail."""
+        old_gen = self.gen
+        self.gen = new_gen
         self.open_wal(append=False)
         self.records_since_snapshot = 0
+        for rec in pending:
+            self.append(rec)
+        os.replace(tmp, self.snap_path)
         for p in self.dir.glob("hub.wal.*"):
             try:
                 if int(p.name.rsplit(".", 1)[1]) < new_gen:
@@ -161,8 +213,9 @@ class HubStore:
             except (ValueError, OSError):
                 pass
         log.info(
-            "hub snapshot gen %d written (%d bytes), wal rotated from gen %d",
-            new_gen, self.snap_path.stat().st_size, old_gen,
+            "hub snapshot gen %d written (%d bytes, %d pending re-appended),"
+            " wal rotated from gen %d",
+            new_gen, self.snap_path.stat().st_size, len(pending), old_gen,
         )
 
     def close(self) -> None:
@@ -176,22 +229,52 @@ class DurableHub(InMemoryHub):
     full state (incl. boot_id and per-subject seqs) recovered on
     construction. The etcd-disk + JetStream-file-store durability role.
 
-    Snapshot writes happen inline on the mutating call once
-    ``compact_every`` records accumulate — a few ms at typical state
-    sizes, amortized over thousands of mutations.
+    Snapshot compaction is a threshold-triggered BACKGROUND task: once
+    ``compact_every`` records accumulate, the state is captured
+    synchronously but serialized + fsynced in a worker thread, and
+    mutations keep flowing to the old-generation WAL meanwhile (they are
+    re-appended to the new generation at commit). The mutating call never
+    pays the snapshot latency — replication bootstrap (hub_replica.py)
+    can request snapshots without blocking the serving path. A hard
+    bound (4x the threshold) falls back to an inline snapshot so a loop
+    that never yields still cannot grow the WAL unboundedly.
+
+    Replication taps: every logged record gets a global ``wal_seq``; the
+    last ``REPL_BACKLOG`` records are kept in memory so a follower can
+    catch up mid-WAL, and listener queues registered in
+    ``_repl_listeners`` receive every ``(seq, record)`` as it commits.
     """
 
+    # in-memory (seq, record) window a reconnecting follower can resume
+    # from without a snapshot bootstrap
+    REPL_BACKLOG = 8192
+
     def __init__(
-        self, data_dir: str | Path, *, compact_every: int = 8192
+        self, data_dir: str | Path, *, compact_every: int = 8192,
+        fsync: bool | None = None,
     ) -> None:
         super().__init__()
         self.compact_every = compact_every
-        self.store = HubStore(data_dir)
+        self.store = HubStore(data_dir, fsync=fsync)
+        # replication stream position: total records ever logged by the
+        # leader lineage this hub's state descends from
+        self.wal_seq = 0
+        # leadership term; bumped by hub_replica promotion
+        self.repl_epoch = 0
+        # follower-side: last leader wal_seq applied (0 = never synced)
+        self.repl_cursor = 0
+        self._recent: deque = deque(maxlen=self.REPL_BACKLOG)
+        self._repl_listeners: list[asyncio.Queue] = []
+        self._compacting = False
+        # when set, _log also mirrors records here (compaction capture)
+        self._capture_log: list[dict[str, Any]] | None = None
         state, records = self.store.load()
         if state is not None:
             self._restore(state)
         for rec in records:
             self._apply(rec)
+            self.wal_seq += 1
+            self._recent.append((self.wal_seq, rec))
         self.store.records_since_snapshot = len(records)
         self._import_legacy_objects()
         if state is None and not records:
@@ -218,7 +301,7 @@ class DurableHub(InMemoryHub):
                 if f.is_file() and key not in self._objects:
                     data = f.read_bytes()
                     self._objects[key] = data
-                    self.store.append(
+                    self._log(
                         {"op": "obj", "b": key[0], "n": key[1], "d": data}
                     )
                     imported += 1
@@ -231,13 +314,20 @@ class DurableHub(InMemoryHub):
         now = time.monotonic()
         return {
             "boot_id": self.boot_id,
+            # replication identity: stream position + leadership term. A
+            # follower bootstrapping from this snapshot adopts all three
+            # (boot_id included), making identity CLUSTER-wide so client
+            # seq baselines stay valid across a failover.
+            "wal_seq": self.wal_seq,
+            "repl_epoch": self.repl_epoch,
+            "repl_cursor": self.repl_cursor,
             "kv": dict(self._kv),
             "key_lease": dict(self._key_lease),
             "leases": [
                 # remaining ttl not persisted: restore resets to full ttl
                 {"id": l.lease_id, "ttl": l.ttl}
                 for l in self._leases.values()
-                if l.deadline > now
+                if self._lease_snapshot_live(l, now)
             ],
             "next_lease": self._next_lease,
             "subject_seq": dict(self._subject_seq),
@@ -252,10 +342,22 @@ class DurableHub(InMemoryHub):
             ],
         }
 
+    def _lease_snapshot_live(self, lease: Any, now: float) -> bool:
+        """Should this lease survive into a snapshot? The local deadline
+        is authoritative on a single (or leader) hub; replication
+        followers override — their deadlines are stale by design, since
+        keepalives are never replicated and expiry arrives as the
+        leader's revoke record."""
+        return lease.deadline > now
+
     def _restore(self, state: dict[str, Any]) -> None:
         from collections import deque
 
         self.boot_id = state["boot_id"]
+        # .get: pre-replication snapshots carry none of these
+        self.wal_seq = int(state.get("wal_seq", 0))
+        self.repl_epoch = int(state.get("repl_epoch", 0))
+        self.repl_cursor = int(state.get("repl_cursor", 0))
         self._kv = dict(state["kv"])
         self._key_lease = dict(state["key_lease"])
         now = time.monotonic()
@@ -291,6 +393,12 @@ class DurableHub(InMemoryHub):
         minus logging/notification (no watchers or subscribers exist at
         recovery time) and minus anything needing a running loop."""
         op = rec["op"]
+        # follower-logged records carry the leader wal_seq they replicate
+        # ("rsq", hub_replica.py) so the replication cursor survives a
+        # follower restart even for records not yet inside a snapshot
+        rsq = rec.get("rsq")
+        if rsq is not None:
+            self.repl_cursor = max(self.repl_cursor, int(rsq))
         if op == "put":
             key, lid = rec["k"], rec.get("l")
             if lid is not None and lid in self._leases:
@@ -319,7 +427,7 @@ class DurableHub(InMemoryHub):
                 from collections import deque
 
                 self._retained[subj] = deque(maxlen=self.RETAIN_PER_SUBJECT)
-            seq = self._subject_seq.get(subj, 0) + 1
+            seq = self._subject_seq.get(subj, self._subject_seq_base()) + 1
             self._subject_seq[subj] = seq
             self._retained[subj].append((seq, rec["p"]))
         elif op == "purge":
@@ -340,6 +448,15 @@ class DurableHub(InMemoryHub):
             self._objects[(rec["b"], rec["n"])] = rec["d"]
         elif op == "objdel":
             self._objects.pop((rec["b"], rec["n"]), None)
+        elif op == "promote":
+            # leadership transition (hub_replica.py): adopt the term and
+            # re-apply the promotion seq gap so per-subject seqs stay
+            # ahead of anything the dead leader might have minted
+            self.repl_epoch = int(rec["epoch"])
+            gap = int(rec.get("gap", 0))
+            if gap:
+                for subj in list(self._subject_seq):
+                    self._subject_seq[subj] += gap
         else:  # forward-compat: ignore unknown records
             log.warning("hub WAL: unknown record op %r ignored", op)
 
@@ -347,8 +464,85 @@ class DurableHub(InMemoryHub):
 
     def _log(self, rec: dict[str, Any]) -> None:
         self.store.append(rec)
-        if self.store.records_since_snapshot >= self.compact_every:
+        self.wal_seq += 1
+        self._recent.append((self.wal_seq, rec))
+        if self._capture_log is not None:
+            self._capture_log.append(rec)
+        for q in self._repl_listeners:
+            try:
+                q.put_nowait((self.wal_seq, rec))
+            except asyncio.QueueFull:
+                # a stalled follower stream must not grow leader memory
+                # without bound: mark it overflowed — the stream ends and
+                # the follower re-syncs from its cursor (or a snapshot)
+                q.repl_overflowed = True
+        self._maybe_compact()
+
+    # -- snapshot compaction ------------------------------------------------
+
+    def _maybe_compact(self) -> None:
+        since = self.store.records_since_snapshot
+        if since < self.compact_every or self._closed:
+            return
+        if since >= self.compact_every * 4:
+            # hard bound: a caller that never yields to the loop (or no
+            # loop at all) must still get its WAL rotated eventually
             self.store.snapshot(self._state())
+            return
+        if self._compacting:
+            return
+        try:
+            loop = asyncio.get_running_loop()
+        except RuntimeError:
+            self.store.snapshot(self._state())
+            return
+        self._compacting = True
+        loop.create_task(self._compact_bg())
+
+    async def _compact_bg(self) -> None:
+        """Background compaction: capture state synchronously, serialize +
+        fsync it in a worker thread while mutations keep landing in the
+        old-generation WAL, then commit (rotate + re-append the records
+        captured during the write). The mutation path never blocks on
+        snapshot I/O."""
+        try:
+            while (
+                not self._closed
+                and self.store.records_since_snapshot >= self.compact_every
+            ):
+                state = self._state()
+                pending: list[dict[str, Any]] = []
+                self._capture_log = pending
+                try:
+                    tmp, new_gen = await asyncio.to_thread(
+                        self.store.write_snapshot_tmp, state
+                    )
+                    if self._closed or new_gen != self.store.gen + 1:
+                        # closed, or the inline hard-bound snapshot
+                        # rotated the gen while we serialized: our
+                        # capture is stale (its pending records are
+                        # already inside the newer snapshot) — discard
+                        tmp.unlink(missing_ok=True)
+                        if self._closed:
+                            return
+                        continue
+                    self.store.commit_snapshot(tmp, new_gen, pending)
+                finally:
+                    self._capture_log = None
+        finally:
+            self._compacting = False
+
+    def reap_expired(self, now: float | None = None) -> list[int]:
+        # expiry IS logged (as a revoke): replication followers never run
+        # the reaper — keepalives are not replicated, so only the leader
+        # may decide a lease is dead — and they learn expiry from this
+        # record. Recovery semantics are unchanged: a lease that expired
+        # pre-crash is revoked by replay instead of re-expiring one TTL
+        # after restart.
+        expired = super().reap_expired(now)
+        for lid in expired:
+            self._log({"op": "revoke", "id": lid})
+        return expired
 
     async def put(self, key: str, value: Any, lease_id: int | None = None) -> None:
         await super().put(key, value, lease_id)
@@ -370,8 +564,8 @@ class DurableHub(InMemoryHub):
         await super().revoke_lease(lease_id)
         if existed:
             self._log({"op": "revoke", "id": lease_id})
-        # lease EXPIRY (reap_expired) is deliberately not logged: restored
-        # leases re-expire on their own one TTL after recovery
+        # lease EXPIRY is also logged as a revoke (see reap_expired): the
+        # replication stream must carry it, since followers never reap
 
     async def publish(
         self, subject: str, payload: Any, pub_id: str | None = None

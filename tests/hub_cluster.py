@@ -1,0 +1,77 @@
+"""Shared helpers for tests that drive REAL hub-replica processes
+(tests/test_hub_replication.py chaos tier, tests/test_soak.py hub-kill
+soak): spawn `python -m dynamo_tpu.runtime.hub_replica` subprocesses and
+poll their ``repl.status`` over the framed transport. One copy of the
+subprocess-spawn and status-probe protocol, so a CLI-flag or
+status-schema change has a single place to land."""
+
+from __future__ import annotations
+
+import asyncio
+import os
+import socket
+import subprocess
+import sys
+import time
+
+from dynamo_tpu.runtime import framing
+
+
+def free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def spawn_replica(
+    addr: str, peers: str, data_dir: str, lease_s: float = 1.0
+) -> subprocess.Popen:
+    """Start one replica process and block until it prints DYNAMO_HUB=
+    (listening); callers SIGKILL it freely."""
+    host, port = addr.rsplit(":", 1)
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "dynamo_tpu.runtime.hub_replica",
+         "--host", host, "--port", port, "--peers", peers,
+         "--data-dir", data_dir, "--lease-s", str(lease_s)],
+        stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    line = proc.stdout.readline().decode()
+    assert "DYNAMO_HUB=" in line, line
+    return proc
+
+
+async def repl_status(addr: str) -> dict | None:
+    """One ``repl.status`` probe; None when unreachable/unresponsive."""
+    host, port = addr.rsplit(":", 1)
+    try:
+        reader, writer = await asyncio.wait_for(
+            asyncio.open_connection(host, int(port)), 1.0
+        )
+    except (OSError, asyncio.TimeoutError):
+        return None
+    try:
+        await framing.write_frame(writer, {"id": 1, "op": "repl.status"})
+        msg = await asyncio.wait_for(framing.read_frame(reader), 1.0)
+        return msg.get("result") if msg and msg.get("ok") else None
+    except (OSError, asyncio.TimeoutError):
+        return None
+    finally:
+        writer.close()
+
+
+async def find_leader(addrs: list[str], timeout: float = 15.0) -> str:
+    """Poll until exactly ONE replica claims leadership; its address."""
+    statuses: list = []
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        statuses = [await repl_status(a) for a in addrs]
+        leaders = [
+            s["addr"] for s in statuses if s and s.get("role") == "leader"
+        ]
+        if len(leaders) == 1:
+            return leaders[0]
+        await asyncio.sleep(0.1)
+    raise AssertionError(f"no unique leader among {addrs}: {statuses}")

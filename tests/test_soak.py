@@ -16,6 +16,8 @@ assertions (test_soak_detects_injected_page_leak).
 import asyncio
 import os
 import random
+import signal
+import time
 
 import aiohttp
 import pytest
@@ -26,6 +28,7 @@ from dynamo_tpu.frontend.http import HttpFrontend
 from dynamo_tpu.frontend.watcher import ModelManager, ModelWatcher
 from dynamo_tpu.runtime.distributed import DistributedRuntime
 from dynamo_tpu.runtime.hub import InMemoryHub
+from hub_cluster import free_port, repl_status, spawn_replica
 
 pytestmark = [pytest.mark.soak, pytest.mark.integration]
 
@@ -208,6 +211,138 @@ async def test_soak_sustained_open_loop():
         for e in engines:
             await e.close()
         await _teardown(handles)
+
+
+@pytest.mark.slow
+@pytest.mark.e2e
+async def test_soak_leader_hub_sigkill_recovery(tmp_path):
+    """Soak with violence, hub half (ROADMAP #7): the serving stack runs
+    against a 3-replica hub cluster; mid-soak the LEADER hub process is
+    SIGKILL'd. The request success rate must recover — a follower is
+    promoted within the lease window, the worker's lease keepalives and
+    the frontend's model watch fail over via the multi-address client,
+    and the tail of the soak serves cleanly."""
+    from dynamo_tpu.runtime.hub_client import RemoteHub
+
+    ports = sorted(free_port() for _ in range(3))
+    addrs = [f"127.0.0.1:{p}" for p in ports]
+    peers = ",".join(addrs)
+    procs = {
+        a: spawn_replica(a, peers, str(tmp_path / f"rep{i}"))
+        for i, a in enumerate(addrs)
+    }
+
+    async def leader_of(addr):
+        st = await repl_status(addr)
+        return st["addr"] if st and st.get("role") == "leader" else None
+
+    hub = None
+    handles = None
+    try:
+        # wait for the cluster to elect
+        leader = None
+        deadline = time.monotonic() + 15
+        while leader is None and time.monotonic() < deadline:
+            for a in addrs:
+                leader = leader or await leader_of(a)
+            await asyncio.sleep(0.1)
+        assert leader is not None
+
+        hub = await RemoteHub.connect(peers, reconnect_window_s=30.0)
+        drt = DistributedRuntime(hub)
+        engine, served = await launch_engine_worker(
+            drt, model="tiny-test", spec=TINY, engine_config=_engine_cfg(),
+            model_name="tiny-test", router_mode="kv",
+        )
+        manager = ModelManager()
+        watcher = await ModelWatcher(drt, manager).start()
+        await watcher.wait_for_model("tiny-test", timeout=15)
+        frontend = HttpFrontend(manager, host="127.0.0.1", port=0)
+        await frontend.start()
+        handles = (drt, served, watcher, frontend)
+        base = f"http://127.0.0.1:{frontend.port}"
+
+        duration_s = min(SOAK_SECS, 12.0)
+        stop = asyncio.Event()
+        outcomes: list[tuple[float, bool]] = []  # (t, ok)
+        rng = random.Random(0)
+
+        async def requester(sess, sid):
+            while not stop.is_set():
+                body = {
+                    "model": "tiny-test",
+                    "prompt": "soak " * rng.randrange(1, 6) + str(sid),
+                    "max_tokens": rng.randrange(1, 8),
+                    "temperature": 0.0, "ignore_eos": True,
+                }
+                try:
+                    async with sess.post(
+                        f"{base}/v1/completions", json=body,
+                        timeout=aiohttp.ClientTimeout(total=20),
+                    ) as r:
+                        await r.read()
+                        outcomes.append(
+                            (time.monotonic(), r.status == 200)
+                        )
+                except (aiohttp.ClientError, asyncio.TimeoutError):
+                    outcomes.append((time.monotonic(), False))
+                await asyncio.sleep(rng.uniform(0, 0.03))
+
+        async with aiohttp.ClientSession() as sess:
+            # warm compile before the measured window
+            async with sess.post(
+                f"{base}/v1/completions",
+                json={"model": "tiny-test", "prompt": "warm",
+                      "max_tokens": 8, "ignore_eos": True},
+            ) as r:
+                await r.read()
+            tasks = [
+                asyncio.create_task(requester(sess, i)) for i in range(4)
+            ]
+            await asyncio.sleep(duration_s * 0.3)
+            # the violence: SIGKILL the leader hub, no warning
+            procs[leader].send_signal(signal.SIGKILL)
+            procs[leader].wait()
+            t_kill = time.monotonic()
+            await asyncio.sleep(duration_s * 0.7)
+            stop.set()
+            done, pending = await asyncio.wait(tasks, timeout=30)
+            assert not pending, f"stuck clients: {pending}"
+            for t in done:
+                t.result()
+
+        # a follower took over...
+        survivors = [a for a in addrs if a != leader]
+        new_leader = None
+        for a in survivors:
+            new_leader = new_leader or await leader_of(a)
+        assert new_leader is not None, "no promoted follower"
+        # ...the hub client reconverged (the worker's instance key is
+        # still served, so discovery keeps working)...
+        inst = await hub.get_prefix("v1/instances/")
+        assert inst, "instance registration lost across hub failover"
+        # ...and the serving loop RECOVERED: the tail of the soak (well
+        # past the lease window) serves with zero failures
+        tail = [ok for t, ok in outcomes if t > t_kill + 4.0]
+        assert len(tail) > 10, f"too few tail requests: {len(tail)}"
+        assert all(tail), (
+            f"{tail.count(False)}/{len(tail)} tail requests failed "
+            "after leader SIGKILL"
+        )
+        assert sum(ok for _, ok in outcomes) > 30
+    finally:
+        if handles is not None:
+            drt_, served_, watcher_, frontend_ = handles
+            await frontend_.stop()
+            await watcher_.close()
+            await engine.close()
+            await drt_.close()
+        elif hub is not None:
+            await hub.close()
+        for p in procs.values():
+            if p.poll() is None:
+                p.send_signal(signal.SIGKILL)
+                p.wait()
 
 
 async def test_soak_detects_injected_page_leak(monkeypatch):
