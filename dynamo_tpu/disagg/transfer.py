@@ -525,6 +525,14 @@ def pull_kv_blocks(
     mesh, needed to land TP-sharded exports shard-by-shard), then TCP
     host staging.
     """
+    from dynamo_tpu.runtime.faults import FAULTS
+
+    if FAULTS.enabled:
+        # disagg.pull error = transfer plane failure mid-KV-handoff (e.g.
+        # the prefill worker died between export and pull); the engine
+        # falls back to a full local prefill, so disagg stays strictly an
+        # optimization (tests/test_disagg.py exercises the continuity)
+        FAULTS.fire_sync("disagg.pull")
     tid = params["transfer_id"]
     src = _LOCAL_SOURCES.get(params.get("source_uid", ""))
     if src is not None:

@@ -293,6 +293,11 @@ class EngineConfig:
     sp: int = 1  # sequence/context parallel (ring-attention prefill)
     ep: int = 1  # expert parallel (MoE)
     pp: int = 1  # pipeline parallel (layer stages; parallel/pipeline.py)
+    # admission queue bound: a request arriving with this many already
+    # waiting is refused with ServiceUnavailable (-> migration re-drives
+    # on another worker, or HTTP 503 + Retry-After when none can take it)
+    # instead of queueing unboundedly behind a saturated engine. 0 = off.
+    max_waiting: int = 0
     # sampling
     seed: int = 0
     # scheduler

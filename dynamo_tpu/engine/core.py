@@ -37,7 +37,12 @@ from dynamo_tpu.engine.sampling import sample_tokens, token_logprobs
 from dynamo_tpu.kv_router.protocols import ForwardPassMetrics
 from dynamo_tpu.models import llama
 from dynamo_tpu.models.family import get_family
-from dynamo_tpu.runtime.context import Context
+from dynamo_tpu.runtime.context import (
+    Context,
+    DeadlineExceeded,
+    ServiceUnavailable,
+)
+from dynamo_tpu.runtime.faults import FAULTS
 from dynamo_tpu.tokens import TokenBlockSequence
 
 log = logging.getLogger("dynamo.engine")
@@ -181,6 +186,11 @@ class InferenceEngine:
             # state sync; wake an idle loop the moment one arrives
             spmd.on_sync_request = self._wake.set
         self._closed = False
+        # SIGTERM drain: stop admitting (generate refuses with
+        # ServiceUnavailable) while in-flight slots run to completion
+        self._draining = False
+        # disagg KV pulls that failed and fell back to a local prefill
+        self.disagg_fallbacks = 0
         self.steps = 0
         # eager re-admission passes that filled a slot in the SAME step
         # cycle that freed it (observability for the serving-latency work)
@@ -332,6 +342,26 @@ class InferenceEngine:
             and not self._closed
         )
 
+    def begin_drain(self) -> None:
+        """Graceful-drain entry (worker SIGTERM path): refuse NEW requests
+        with ServiceUnavailable — retryable, so the frontend's migration
+        operator re-drives them on a live worker — while admitted work
+        runs to completion. The step loop keeps running until close()."""
+        self._draining = True
+        self._wake.set()
+
+    @property
+    def draining(self) -> bool:
+        return self._draining
+
+    def inflight(self) -> int:
+        """Admitted-but-unfinished work (drain-completion signal)."""
+        return (
+            sum(s is not None for s in self._slots)
+            + self._waiting.qsize()
+            + (1 if self._partial is not None else 0)
+        )
+
     async def close(self) -> None:
         self._closed = True
         self._wake.set()
@@ -354,6 +384,33 @@ class InferenceEngine:
             yield {"token_ids": [], "finish_reason": "error",
                    "error": "engine closed"}
             return
+        if self._draining:
+            # SIGTERM drain: typed refusal rides the transport as a
+            # retryable 503-mappable error (another worker may accept)
+            raise ServiceUnavailable(
+                "worker draining", retry_after_s=1.0
+            )
+        if (
+            self.config.max_waiting
+            and self._waiting.qsize() >= self.config.max_waiting
+        ):
+            raise ServiceUnavailable(
+                f"engine saturated ({self._waiting.qsize()} waiting)",
+                retry_after_s=0.5,
+            )
+        if context.deadline_expired:
+            raise DeadlineExceeded(
+                f"request {context.id} deadline passed before admission"
+            )
+        if FAULTS.enabled:
+            try:
+                await FAULTS.fire("engine.admit")
+            except ConnectionError as e:
+                # a 'drop' at admission = this worker vanished before
+                # taking the request; keep the drop contract (retryable,
+                # migration re-drives on another instance) rather than
+                # surfacing a non-retryable 500
+                raise ServiceUnavailable(f"injected admit drop: {e}") from e
         await self.start()
         token_ids = list(request.get("token_ids") or [])
         if not token_ids:
@@ -412,9 +469,43 @@ class InferenceEngine:
                     lambda: pull_kv_blocks(kvp, mesh=self.mesh)
                 )
             except Exception as e:  # noqa: BLE001
-                yield {"token_ids": [], "finish_reason": "error",
-                       "error": f"kv transfer pull failed: {e}"}
-                return
+                # transfer-plane failure (prefill worker died between
+                # export and pull, link cut, injected disagg.pull fault):
+                # fall back to a FULL LOCAL prefill instead of erroring
+                # the stream — disagg stays strictly an optimization. The
+                # handler already emitted the remote first token, so
+                # continuity = prompt + first_token, budget shrunk by one
+                # (mirrors _resume_from_remote's remaining=max_tokens-1).
+                log.warning(
+                    "kv transfer pull failed (%s); falling back to local "
+                    "prefill for %s", e, context.id,
+                )
+                self.disagg_fallbacks += 1
+                try:
+                    # best-effort: unpin the exported pages on a still-
+                    # alive prefill worker instead of waiting out the
+                    # export TTL (the dead-worker case just fails again)
+                    await asyncio.to_thread(release_kv_blocks, kvp)
+                except Exception:  # noqa: BLE001
+                    pass
+                first = disagg["kv_transfer"].get("first_token")
+                request = dict(request)
+                request["disagg"] = None
+                disagg = {}  # nothing staged/exported remains to release
+                if first is not None:
+                    token_ids = token_ids + [int(first)]
+                    request["token_ids"] = token_ids
+                    stop = dict(request.get("stop_conditions") or {})
+                    if stop.get("max_tokens") is not None:
+                        stop["max_tokens"] = max(
+                            int(stop["max_tokens"]) - 1, 1
+                        )
+                    request["stop_conditions"] = stop
+                if len(token_ids) >= self.config.max_context:
+                    yield {"token_ids": [], "finish_reason": "error",
+                           "error": f"prompt exceeds max context "
+                                    f"{self.config.max_context}"}
+                    return
         if self._closed:
             # re-check right before the enqueue with NO awaits in between
             # (close() flips the flag on this same event loop): a request
@@ -424,13 +515,58 @@ class InferenceEngine:
             yield {"token_ids": [], "finish_reason": "error",
                    "error": "engine closed"}
             return
+        if (
+            self.config.max_waiting
+            and self._waiting.qsize() >= self.config.max_waiting
+        ):
+            # re-check at the enqueue: the awaits above (start, disagg KV
+            # pull) let a burst of concurrent admissions pass the early
+            # check together and blow past the bound
+            if disagg.get("mode") == "decode" and disagg.get("kv_transfer"):
+                # the bounce must not strand the pulled payload or leave
+                # the prefill worker's exported pages pinned to TTL
+                self._drop_staged_kv(request)
+                from dynamo_tpu.disagg.transfer import release_kv_blocks
+
+                kvp = {
+                    k: v for k, v in disagg["kv_transfer"].items()
+                    if k != "first_token"
+                }
+                try:
+                    await asyncio.to_thread(release_kv_blocks, kvp)
+                except Exception:  # noqa: BLE001
+                    pass
+            raise ServiceUnavailable(
+                f"engine saturated ({self._waiting.qsize()} waiting)",
+                retry_after_s=0.5,
+            )
         out_q: asyncio.Queue = asyncio.Queue()
         self._waiting.put_nowait(
             _Waiting(request, context, out_q, enq_t=time.perf_counter())
         )
         self._wake.set()
+        deadline_hit = False
         while True:
-            item = await out_q.get()
+            # after the deadline every wait is bounded (2s per item): a
+            # stuck step must not turn a deadline into a hang (the Orca
+            # stuck-request-stalls-the-batch failure mode)
+            remaining = 2.0 if deadline_hit else context.remaining_s()
+            if remaining is None:
+                item = await out_q.get()
+            else:
+                try:
+                    item = await asyncio.wait_for(out_q.get(), remaining)
+                except asyncio.TimeoutError:
+                    if deadline_hit:
+                        yield {"token_ids": [], "finish_reason": "cancelled",
+                               "error": "deadline exceeded"}
+                        return
+                    # end-to-end deadline passed mid-generation: stop the
+                    # slot (the step loop finishes it as 'cancelled')
+                    deadline_hit = True
+                    context.stop_generating()
+                    self._wake.set()
+                    continue
             if item is None:
                 return
             yield item
@@ -445,6 +581,11 @@ class InferenceEngine:
         while not self._closed:
             try:
                 step_mark = self._spmd_mark()
+                if FAULTS.enabled:
+                    # engine.step error lands INSIDE this try: the fail-
+                    # every-in-flight-then-keep-serving recovery below is
+                    # exactly what the fault exercises; delay = stalled step
+                    FAULTS.fire_sync("engine.step")
                 did_work = self._step()
                 if not did_work:
                     self._wake.clear()

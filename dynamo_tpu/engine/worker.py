@@ -173,6 +173,27 @@ async def launch_engine_worker(
         if request.get("op") == "clear_kv_blocks":
             engine.request_clear_cache()
             yield {"ok": True}
+        elif request.get("op") == "faults":
+            # flip the process-wide fault registry live (runtime/faults.py):
+            # {"op": "faults", "spec": "...", "seed": N} reconfigures;
+            # {"op": "faults"} reports active rules + trip counters
+            from dynamo_tpu.runtime.faults import FAULTS
+
+            if "spec" in request:
+                try:
+                    FAULTS.configure(
+                        request.get("spec") or "", request.get("seed")
+                    )
+                except ValueError as e:
+                    yield {"ok": False, "error": str(e)}
+                    return
+            yield {"ok": True, **FAULTS.snapshot()}
+        elif request.get("op") == "drain":
+            # operator-triggered drain: same withdraw-and-stop-admitting
+            # sequence as SIGTERM, but the process stays up — exiting is
+            # the operator's call
+            await _withdraw_and_begin_drain(drt, engine, served)
+            yield {"ok": True, "inflight": engine.inflight()}
         elif request.get("op") == "cache_status":
             yield {
                 "ok": True,
@@ -399,7 +420,7 @@ async def _amain(args: argparse.Namespace) -> None:
         ).start()
         print(f"SYSTEM_STATUS_PORT={status_server.port}", flush=True)
 
-    await launch_engine_worker(
+    engine, served = await launch_engine_worker(
         drt,
         health=health,
         namespace=args.namespace,
@@ -426,6 +447,7 @@ async def _amain(args: argparse.Namespace) -> None:
         spmd=spmd_leader,
     )
     print("ENGINE_READY", flush=True)
+    _install_drain_handler(drt, engine, served)
     try:
         await drt.runtime.wait_for_shutdown()
     finally:
@@ -434,6 +456,85 @@ async def _amain(args: argparse.Namespace) -> None:
             # later follower run cannot connect to this dead leader
             spmd_leader.stop()
             await spmd_leader.close()
+
+
+def _install_drain_handler(drt, engine, served) -> None:
+    """SIGTERM => graceful drain (k8s preStop / pod deletion path)."""
+    import signal as _signal
+
+    state: dict = {"task": None}
+
+    def on_sigterm() -> None:
+        if state["task"] is not None:
+            return  # second SIGTERM while draining: let the first finish
+        # keep a strong reference: the loop only holds tasks weakly, and a
+        # GC'd drain task means kubelet SIGKILLs us at the grace period
+        state["task"] = asyncio.get_running_loop().create_task(
+            graceful_drain(drt, engine, served)
+        )
+
+    try:
+        asyncio.get_running_loop().add_signal_handler(
+            _signal.SIGTERM, on_sigterm
+        )
+    except (NotImplementedError, RuntimeError):  # pragma: no cover
+        pass  # non-unix event loop
+
+
+async def _withdraw_and_begin_drain(drt, engine, served) -> None:
+    """Steps 1-2 of the drain contract, shared by the SIGTERM path and the
+    admin ``drain`` RPC: WITHDRAW the instance key from the hub (lease kept
+    alive, so routers stop picking this worker within one watch event),
+    then STOP ADMITTING (new generates refuse with ServiceUnavailable)."""
+    try:
+        await drt.hub.delete(served.instance.path)
+    except (ConnectionError, RuntimeError) as e:
+        log.warning("drain: instance withdrawal failed (%s)", e)
+    engine.begin_drain()
+
+
+async def graceful_drain(
+    drt, engine, served, timeout_s: float | None = None
+) -> None:
+    """Hardened worker drain (ROADMAP #7 / k8s preStop contract):
+
+    1. WITHDRAW this worker's instance key from the hub (lease kept
+       alive) so routers stop picking it within one watch event — the
+       same mechanism health.py uses for unhealthy endpoints;
+    2. STOP ADMITTING: new generates refuse with ServiceUnavailable
+       (retryable -> migration re-drives on a live worker, or the
+       frontend answers 503 + Retry-After);
+    3. FINISH IN-FLIGHT work under the drain deadline;
+    4. EXIT: runtime shutdown force-cancels whatever outlived the
+       deadline (transport.stop logs the abandoned count).
+    """
+    timeout_s = (
+        drt.config.drain_timeout_s if timeout_s is None else timeout_s
+    )
+    log.warning(
+        "SIGTERM: graceful drain (%d in flight, timeout %.0fs)",
+        engine.inflight(), timeout_s,
+    )
+    await _withdraw_and_begin_drain(drt, engine, served)
+    loop = asyncio.get_running_loop()
+    deadline = loop.time() + timeout_s
+    server = drt._server
+    while loop.time() < deadline:
+        if engine.inflight() == 0 and (
+            server is None or server.num_inflight == 0
+        ):
+            break
+        await asyncio.sleep(0.1)
+    leftover = engine.inflight()
+    if leftover:
+        log.warning("drain deadline: %d request(s) still in flight", leftover)
+    # past the deadline, the transport stop force-cancels immediately —
+    # and COUNTS/logs the abandoned streams (aborted_inflight)
+    await drt.shutdown(
+        drain=True, drain_timeout=5.0 if leftover == 0 else 0.0
+    )
+    await engine.close()
+    print(f"ENGINE_DRAINED leftover={leftover}", flush=True)
 
 
 def main() -> None:

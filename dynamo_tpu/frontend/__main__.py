@@ -26,7 +26,10 @@ async def _amain(args: argparse.Namespace) -> None:
     drt = DistributedRuntime(await connect_hub(cfg.hub_target()), cfg)
     manager = ModelManager()
     watcher = await ModelWatcher(drt, manager).start()
-    frontend = HttpFrontend(manager, host=args.host, port=cfg.http_port, drt=drt)
+    frontend = HttpFrontend(
+        manager, host=args.host, port=cfg.http_port, drt=drt,
+        request_timeout_s=cfg.request_timeout_s,
+    )
     host, port = await frontend.start()
     print(f"DYNAMO_HTTP={host}:{port}", flush=True)
     grpc_frontend = None

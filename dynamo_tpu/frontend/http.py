@@ -18,6 +18,7 @@ from __future__ import annotations
 import asyncio
 import json
 import logging
+import math
 import time
 from typing import Any
 
@@ -31,10 +32,19 @@ from dynamo_tpu.frontend.validation import (
 from dynamo_tpu.frontend.watcher import ModelManager, ModelPipeline
 from dynamo_tpu.runtime import tracing
 from dynamo_tpu.runtime.compute import ComputePool
-from dynamo_tpu.runtime.context import Context, StreamError
+from dynamo_tpu.runtime.context import (
+    Context,
+    DeadlineExceeded,
+    ServiceUnavailable,
+    StreamError,
+)
 from dynamo_tpu.runtime.metrics import MetricsRegistry
+from dynamo_tpu.runtime.push import NoInstancesError
 
 log = logging.getLogger("dynamo.http")
+
+# per-request deadline override (ms); clamped to the server-side default
+TIMEOUT_HEADER = "x-dyn-timeout-ms"
 
 
 class HttpFrontend:
@@ -47,10 +57,12 @@ class HttpFrontend:
         metrics: MetricsRegistry | None = None,
         drt=None,  # DistributedRuntime: enables admin routes
         audit=None,  # AuditBus (default: env-configured, see runtime/audit)
+        request_timeout_s: float = 600.0,  # end-to-end deadline default
     ):
         self.manager = manager
         self.host = host
         self.port = port
+        self.request_timeout_s = request_timeout_s
         self.metrics = metrics or MetricsRegistry()
         self._drt = drt
         self._compute = ComputePool()
@@ -139,13 +151,35 @@ class HttpFrontend:
     def _traced_context(self, request: web.Request) -> Context:
         """Per-request Context joined to the client's W3C trace (or a new
         one); the traceparent rides Context.headers to workers
-        (runtime/tracing.py)."""
+        (runtime/tracing.py). Every request gets an END-TO-END DEADLINE
+        (default ``request_timeout_s``; ``x-dyn-timeout-ms`` tightens it),
+        propagated frontend -> migration -> worker so no failure chain can
+        cost a client more than its budget."""
         headers: dict[str, str] = {}
         incoming = request.headers.get(tracing.TRACEPARENT)
         if incoming:
             headers[tracing.TRACEPARENT] = incoming
         tracing.ensure_trace(headers)
-        return Context(request_id=new_request_id(), headers=headers)
+        timeout_s = self.request_timeout_s
+        raw = request.headers.get(TIMEOUT_HEADER)
+        if raw:
+            try:
+                hdr = float(raw)
+                if math.isfinite(hdr):  # 'nan'/'inf' must not drop the cap
+                    hdr_s = max(hdr / 1000.0, 0.001)
+                    # the header can only tighten the server default; with
+                    # the default disabled (<= 0) it is the sole source
+                    timeout_s = (
+                        min(hdr_s, timeout_s) if timeout_s > 0 else hdr_s
+                    )
+            except ValueError:
+                pass
+        deadline = (
+            time.monotonic() + timeout_s if timeout_s > 0 else None
+        )
+        return Context(
+            request_id=new_request_id(), headers=headers, deadline=deadline
+        )
 
     # -- routes ------------------------------------------------------------
 
@@ -323,6 +357,24 @@ class HttpFrontend:
                     ),
                 )
                 return web.json_response(agg)
+        except (ServiceUnavailable, NoInstancesError) as e:
+            # every worker draining/saturated (or none left) and the retry
+            # budget exhausted: tell the client WHEN to come back instead
+            # of a generic 500 (ref Orca-style bounded admission: shedding
+            # with a hint beats queueing until the deadline)
+            ctx.stop_generating()
+            retry_after = getattr(e, "retry_after_s", 1.0)
+            self._m_requests.labels(model, route, "503").inc()
+            self._audit(route, model, ctx, body, 503, t_start, error=str(e))
+            return _error(
+                503, f"service unavailable: {e}", code="service_unavailable",
+                headers={"Retry-After": str(max(int(retry_after), 1))},
+            )
+        except DeadlineExceeded as e:
+            ctx.stop_generating()
+            self._m_requests.labels(model, route, "504").inc()
+            self._audit(route, model, ctx, body, 504, t_start, error=str(e))
+            return _error(504, f"deadline exceeded: {e}", code="deadline_exceeded")
         except Exception as e:  # noqa: BLE001
             log.exception("request %s failed", ctx.id)
             ctx.stop_generating()
@@ -672,10 +724,11 @@ class HttpFrontend:
 
 def _error(
     status: int, message: str, code: str | None = None,
-    param: str | None = None,
+    param: str | None = None, headers: dict[str, str] | None = None,
 ) -> web.Response:
     return web.json_response(
         {"error": {"message": message, "type": "invalid_request_error",
                    "param": param, "code": code}},
         status=status,
+        headers=headers,
     )

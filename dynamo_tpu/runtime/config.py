@@ -50,6 +50,10 @@ class RuntimeConfig:
     keepalive_interval_s: float = 3.0
     health_check_interval_s: float = 30.0
     health_check_timeout_s: float = 10.0
+    # graceful drain (worker SIGTERM / k8s preStop): max seconds to let
+    # in-flight requests finish before force-cancelling and exiting; keep
+    # terminationGracePeriodSeconds comfortably above this
+    drain_timeout_s: float = 30.0
 
     # http frontend
     http_port: int = 8000

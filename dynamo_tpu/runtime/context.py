@@ -10,6 +10,7 @@ letting any stage observe or trigger cancellation.
 from __future__ import annotations
 
 import asyncio
+import time
 import uuid
 from typing import Any
 
@@ -22,12 +23,55 @@ class StreamError(RuntimeError):
     """
 
 
+class ServiceUnavailable(StreamError):
+    """A worker refused the request because it is draining or saturated.
+
+    Retryable (another instance may accept — a StreamError, so the
+    migration operator re-drives it with backoff); when retries exhaust,
+    the HTTP frontend maps it to 503 with ``Retry-After``.
+    """
+
+    def __init__(self, message: str, retry_after_s: float = 1.0):
+        super().__init__(message)
+        self.retry_after_s = retry_after_s
+
+
+class DeadlineExceeded(RuntimeError):
+    """The request's end-to-end deadline passed. NOT a StreamError: spending
+    more time retrying a request whose client has given up is the failure
+    mode deadlines exist to prevent. HTTP maps it to 504."""
+
+
+# Remaining request budget in milliseconds, attached to the wire headers at
+# send time (relative, so no cross-host clock sync needed) and rebuilt into
+# an absolute monotonic deadline on the receiving side.
+DEADLINE_HEADER = "x-dyn-deadline-ms"
+
+
+def deadline_from_headers(headers: dict[str, str] | None) -> float | None:
+    """Absolute monotonic deadline from a relative wire header, or None."""
+    raw = (headers or {}).get(DEADLINE_HEADER)
+    if not raw:
+        return None
+    try:
+        return time.monotonic() + max(float(raw), 0.0) / 1000.0
+    except ValueError:
+        return None
+
+
 class Context:
     """Cancellation + identity context for one in-flight request."""
 
-    def __init__(self, request_id: str | None = None, headers: dict[str, str] | None = None):
+    def __init__(
+        self,
+        request_id: str | None = None,
+        headers: dict[str, str] | None = None,
+        deadline: float | None = None,
+    ):
         self.id: str = request_id or uuid.uuid4().hex
         self.headers: dict[str, str] = headers or {}
+        # absolute time.monotonic() deadline; None = unbounded (legacy)
+        self.deadline: float | None = deadline
         self._stopped = asyncio.Event()
         self._killed = asyncio.Event()
         self._children: list[Context] = []
@@ -59,9 +103,33 @@ class Context:
     async def killed_or_stopped(self) -> None:
         await self._stopped.wait()
 
+    # -- deadlines ---------------------------------------------------------
+
+    def remaining_s(self) -> float | None:
+        """Seconds until the deadline (clamped at 0), or None if unbounded."""
+        if self.deadline is None:
+            return None
+        return max(self.deadline - time.monotonic(), 0.0)
+
+    @property
+    def deadline_expired(self) -> bool:
+        return self.deadline is not None and time.monotonic() >= self.deadline
+
+    def wire_headers(self) -> dict[str, str]:
+        """Headers to send with this request: baggage plus the remaining
+        deadline budget in ms (the receiver rebuilds an absolute deadline
+        via deadline_from_headers)."""
+        remaining = self.remaining_s()
+        if remaining is None:
+            return self.headers
+        return {
+            **self.headers,
+            DEADLINE_HEADER: str(int(remaining * 1000)),
+        }
+
     def child(self, request_id: str | None = None) -> "Context":
         """Derived context: cancelling the parent cancels the child."""
-        c = Context(request_id or self.id, dict(self.headers))
+        c = Context(request_id or self.id, dict(self.headers), deadline=self.deadline)
         if self.is_stopped:
             c.stop_generating()
         if self.is_killed:

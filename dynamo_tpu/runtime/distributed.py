@@ -183,14 +183,16 @@ class DistributedRuntime:
 
     # -- shutdown ----------------------------------------------------------
 
-    async def shutdown(self, drain: bool = True) -> None:
+    async def shutdown(
+        self, drain: bool = True, drain_timeout: float = 30.0
+    ) -> None:
         if self._closed:
             return
         self._closed = True
         for served in list(self._served):
             await self.deregister_endpoint(served, drain=drain)
         if self._server is not None:
-            await self._server.stop(drain=drain)
+            await self._server.stop(drain=drain, timeout=drain_timeout)
         if self._keepalive_task is not None:
             self._keepalive_task.cancel()
         if self._lease_id is not None:

@@ -1,0 +1,283 @@
+"""Deterministic fault-injection layer: named fault points, seeded schedules.
+
+The recovery machinery in this framework (frontend/migration.py re-drives,
+runtime/health.py withdrawal, transport drain, hub client failover) only gets
+exercised when something actually fails. This module makes failure a
+first-class, *reproducible* input: code under test declares named fault
+points (``fire("transport.send")``), and a process-wide registry decides —
+from a seeded per-site RNG — whether that call drops, delays, or errors.
+Ref: the reference's fault-tolerance test tier provokes failures with real
+SIGKILLs (tests/fault_tolerance/); this layer covers the partial-failure
+space kill -9 can't reach (slow fsync, lossy links, flaky admission).
+
+Spec grammar (``DYN_FAULTS`` env var, or the worker admin ``faults`` RPC)::
+
+    site:action[=param][@prob][xN][,site:action...]
+
+    transport.send:drop@0.02          2% of sends die like a cut connection
+    hub.fsync:delay=50ms              every WAL fsync takes +50ms
+    engine.step:error@0.001           1-in-1000 steps raises (recovery path)
+    disagg.pull:error@1x1             the first KV pull fails, then clean
+
+Actions:
+    drop   raise ``FaultDrop`` (a ConnectionResetError): the site behaves
+           exactly as if the peer vanished — existing except-clauses and
+           migration/retry paths handle it with zero special-casing.
+    delay  sleep ``param`` (``50ms``/``0.2s``/bare seconds) at the site.
+    error  raise ``FaultInjected`` (a RuntimeError): an internal failure.
+
+Determinism: every site draws its own decision stream from
+``random.Random(f"{seed}:{site}")`` — the schedule at one site is a pure
+function of (spec, seed, call index at that site), independent of thread
+interleavings or what other sites are doing. The same spec + seed replays
+the same fault schedule; tests assert this (tests/test_faults.py).
+
+Registered fault points (this PR):
+    transport.connect / transport.send / transport.recv   (transport.py)
+    hub.dial / hub.call                                   (hub_client.py)
+    hub.wal_append / hub.fsync                            (hub_store.py)
+    engine.step / engine.admit                            (engine/core.py)
+    disagg.pull                                           (disagg/transfer.py)
+
+Trip counters are exported on every ``/metrics`` surface as
+``dynamo_fault_trips_total{site,action}`` (runtime/metrics.py global
+exposition providers), so a chaos run can assert its faults actually fired.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import os
+import random
+import re
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any
+
+log = logging.getLogger("dynamo.faults")
+
+
+class FaultInjected(RuntimeError):
+    """An injected ``error`` action fired at a fault point."""
+
+
+class FaultDrop(ConnectionResetError):
+    """An injected ``drop`` action fired: behave like the peer vanished."""
+
+
+_DURATION = re.compile(r"^(\d+(?:\.\d+)?)(ms|s|m)?$")
+
+
+def _parse_duration(text: str) -> float:
+    m = _DURATION.match(text.strip())
+    if m is None:
+        raise ValueError(f"bad duration {text!r} (want e.g. 50ms, 0.2s)")
+    val = float(m.group(1))
+    unit = m.group(2) or "s"
+    return val * {"ms": 1e-3, "s": 1.0, "m": 60.0}[unit]
+
+
+@dataclass
+class FaultRule:
+    site: str
+    action: str  # drop | delay | error
+    prob: float = 1.0
+    delay_s: float = 0.0
+    limit: int = 0  # max trips; 0 = unbounded
+    trips: int = 0
+
+    def spec(self) -> str:
+        out = f"{self.site}:{self.action}"
+        if self.action == "delay":
+            out += f"={self.delay_s * 1000:g}ms"
+        if self.prob != 1.0:
+            out += f"@{self.prob:g}"
+        if self.limit:
+            out += f"x{self.limit}"
+        return out
+
+
+def parse_spec(spec: str) -> list[FaultRule]:
+    """Parse a ``DYN_FAULTS`` spec string into rules (see module doc)."""
+    rules: list[FaultRule] = []
+    for entry in (spec or "").split(","):
+        entry = entry.strip()
+        if not entry:
+            continue
+        site, _, rest = entry.partition(":")
+        if not rest:
+            raise ValueError(f"fault entry {entry!r}: want site:action")
+        limit = 0
+        m = re.search(r"x(\d+)$", rest)
+        if m:
+            limit = int(m.group(1))
+            rest = rest[: m.start()]
+        prob = 1.0
+        if "@" in rest:
+            rest, _, p = rest.rpartition("@")
+            prob = float(p)
+        action, _, param = rest.partition("=")
+        action = action.strip()
+        if action not in ("drop", "delay", "error"):
+            raise ValueError(f"fault entry {entry!r}: unknown action {action!r}")
+        delay_s = _parse_duration(param) if param else 0.0
+        if action == "delay" and not delay_s:
+            raise ValueError(f"fault entry {entry!r}: delay needs =duration")
+        rules.append(FaultRule(
+            site=site.strip(), action=action, prob=prob,
+            delay_s=delay_s, limit=limit,
+        ))
+    return rules
+
+
+class FaultRegistry:
+    """Process-wide fault-point registry.
+
+    ``enabled`` is the hot-path gate: with no rules configured every
+    ``fire``/``fire_sync`` call is one attribute read and a return —
+    production overhead is negligible.
+    """
+
+    def __init__(self, spec: str = "", seed: int = 0):
+        self._lock = threading.Lock()
+        self.enabled = False
+        self.seed = seed
+        self._rules: dict[str, list[FaultRule]] = {}
+        self._rngs: dict[str, random.Random] = {}
+        self.trip_counts: dict[tuple[str, str], int] = {}
+        if spec:
+            self.configure(spec, seed)
+
+    # -- configuration -----------------------------------------------------
+
+    def configure(self, spec: str, seed: int | None = None) -> None:
+        """Replace the active rule set (live reconfig: the admin ``faults``
+        RPC lands here). Resets per-site RNGs so the new schedule is
+        deterministic from the configure point."""
+        rules = parse_spec(spec)
+        with self._lock:
+            if seed is not None:
+                self.seed = seed
+            self._rules = {}
+            for r in rules:
+                self._rules.setdefault(r.site, []).append(r)
+            self._rngs = {}
+            self.enabled = bool(self._rules)
+        if rules:
+            log.warning(
+                "fault injection ACTIVE (seed=%d): %s",
+                self.seed, ",".join(r.spec() for r in rules),
+            )
+        else:
+            log.info("fault injection cleared")
+
+    def clear(self) -> None:
+        self.configure("")
+
+    # -- decision ----------------------------------------------------------
+
+    def _site_rng(self, site: str) -> random.Random:
+        rng = self._rngs.get(site)
+        if rng is None:
+            rng = self._rngs[site] = random.Random(f"{self.seed}:{site}")
+        return rng
+
+    def decide(self, site: str) -> FaultRule | None:
+        """One decision draw at ``site``; returns the rule to apply (and
+        counts the trip) or None. Deterministic per (spec, seed, site,
+        call index)."""
+        if not self.enabled:
+            return None
+        with self._lock:
+            rules = self._rules.get(site)
+            if not rules:
+                return None
+            # one draw per configured rule, in spec order, so multi-rule
+            # sites (delay + rare drop) keep independent schedules
+            for rule in rules:
+                if rule.limit and rule.trips >= rule.limit:
+                    continue
+                if self._site_rng(site).random() < rule.prob:
+                    rule.trips += 1
+                    key = (site, rule.action)
+                    self.trip_counts[key] = self.trip_counts.get(key, 0) + 1
+                    return rule
+            return None
+
+    def _raise(self, rule: FaultRule) -> None:
+        log.warning("fault injected: %s (trip %d)", rule.spec(), rule.trips)
+        if rule.action == "drop":
+            raise FaultDrop(f"injected drop at {rule.site}")
+        raise FaultInjected(f"injected error at {rule.site}")
+
+    def fire_sync(self, site: str) -> None:
+        """Blocking fault point (step thread, WAL append, transfer pull)."""
+        rule = self.decide(site)
+        if rule is None:
+            return
+        if rule.action == "delay":
+            time.sleep(rule.delay_s)
+            return
+        self._raise(rule)
+
+    async def fire(self, site: str) -> None:
+        """Async fault point (event-loop call sites)."""
+        rule = self.decide(site)
+        if rule is None:
+            return
+        if rule.action == "delay":
+            await asyncio.sleep(rule.delay_s)
+            return
+        self._raise(rule)
+
+    # -- observability -----------------------------------------------------
+
+    def snapshot(self) -> dict[str, Any]:
+        with self._lock:
+            return {
+                "enabled": self.enabled,
+                "seed": self.seed,
+                "rules": [
+                    r.spec() for rs in self._rules.values() for r in rs
+                ],
+                "trips": {
+                    f"{site}:{action}": n
+                    for (site, action), n in sorted(self.trip_counts.items())
+                },
+            }
+
+    def exposition(self) -> str:
+        """Prometheus text lines for every /metrics surface (registered as
+        a global provider with runtime/metrics.py)."""
+        if not self.trip_counts:
+            return ""
+        lines = [
+            "# HELP dynamo_fault_trips_total Injected fault trips by site/action.",
+            "# TYPE dynamo_fault_trips_total counter",
+        ]
+        with self._lock:
+            for (site, action), n in sorted(self.trip_counts.items()):
+                lines.append(
+                    f'dynamo_fault_trips_total{{site="{site}",'
+                    f'action="{action}"}} {n}'
+                )
+        return "\n".join(lines) + "\n"
+
+
+# The process-wide registry: env-configured at import, reconfigurable live
+# via the worker admin ``faults`` RPC.
+FAULTS = FaultRegistry(
+    os.environ.get("DYN_FAULTS", ""),
+    seed=int(os.environ.get("DYN_FAULTS_SEED", "0") or 0),
+)
+
+
+def _register_metrics() -> None:
+    from dynamo_tpu.runtime import metrics
+
+    metrics.register_global_provider("faults", FAULTS.exposition)
+
+
+_register_metrics()

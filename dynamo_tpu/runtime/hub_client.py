@@ -43,6 +43,7 @@ import time
 from typing import Any, AsyncIterator
 
 from dynamo_tpu.runtime import framing
+from dynamo_tpu.runtime.faults import FAULTS
 from dynamo_tpu.runtime.hub import Hub, KeyExists, WatchEvent
 
 
@@ -136,6 +137,8 @@ class RemoteHub(Hub):
             idx = (self._addr_idx + i) % len(self._addrs)
             host, port = self._split(self._addrs[idx])
             try:
+                if FAULTS.enabled:
+                    await FAULTS.fire("hub.dial")  # drop/error -> dial fails
                 self._reader, self._writer = await asyncio.wait_for(
                     asyncio.open_connection(host, port), timeout
                 )
@@ -230,6 +233,10 @@ class RemoteHub(Hub):
                     q.put_nowait(None)  # sentinel: stream closed
 
     async def _send_request(self, op: str, kwargs: dict[str, Any]) -> Any:
+        if FAULTS.enabled:
+            # drop -> ConnectionError -> _call's reconnect/retry loop;
+            # delay simulates a slow hub RPC; error surfaces to the caller
+            await FAULTS.fire("hub.call")
         mid = next(self._ids)
         fut: asyncio.Future = asyncio.get_running_loop().create_future()
         try:

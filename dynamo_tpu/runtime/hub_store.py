@@ -51,6 +51,7 @@ from typing import Any
 
 import msgpack
 
+from dynamo_tpu.runtime.faults import FAULTS
 from dynamo_tpu.runtime.hub import InMemoryHub, _Lease
 
 log = logging.getLogger("dynamo.hub")
@@ -149,11 +150,18 @@ class HubStore:
         self._wal = open(self.wal_path(self.gen), mode)
 
     def append(self, rec: dict[str, Any]) -> None:
+        if FAULTS.enabled:
+            # hub.wal_append error = failed disk write (acked mutations
+            # must not be lost — the caller surfaces the failure);
+            # hub.fsync delay = slow disk at the durability point
+            FAULTS.fire_sync("hub.wal_append")
         if self._wal is None:
             self.open_wal()
         body = msgpack.packb(rec, use_bin_type=True)
         self._wal.write(_LEN.pack(len(body)) + body)
         self._wal.flush()
+        if FAULTS.enabled:
+            FAULTS.fire_sync("hub.fsync")
         if self._fsync:
             os.fsync(self._wal.fileno())
         self.records_since_snapshot += 1

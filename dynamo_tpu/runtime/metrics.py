@@ -8,7 +8,7 @@ frontend's /metrics route and the system status server.
 
 from __future__ import annotations
 
-from typing import Iterable
+from typing import Callable, Iterable
 
 from prometheus_client import (
     CollectorRegistry,
@@ -19,6 +19,17 @@ from prometheus_client import (
 )
 
 PREFIX = "dynamo_"
+
+# Process-global exposition providers: named callables returning Prometheus
+# text appended to EVERY MetricsRegistry exposition. Process-wide subsystems
+# that don't hang off one registry (fault-injection trip counters,
+# migration recovery counters) register here once and show up on every
+# /metrics surface — frontend, system status server, EPP.
+_GLOBAL_PROVIDERS: dict[str, Callable[[], str]] = {}
+
+
+def register_global_provider(name: str, fn: Callable[[], str]) -> None:
+    _GLOBAL_PROVIDERS[name] = fn
 
 # Buckets tuned for LLM serving latencies (seconds).
 LATENCY_BUCKETS = (
@@ -68,4 +79,12 @@ class MetricsRegistry:
         return self._metrics[key]  # type: ignore[return-value]
 
     def exposition(self) -> bytes:
-        return generate_latest(self.registry)
+        out = generate_latest(self.registry)
+        for fn in _GLOBAL_PROVIDERS.values():
+            try:
+                extra = fn()
+            except Exception:  # noqa: BLE001 - never break /metrics
+                continue
+            if extra:
+                out += extra.encode()
+        return out

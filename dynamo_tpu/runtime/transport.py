@@ -26,7 +26,14 @@ import uuid
 from typing import Any, AsyncIterator, Awaitable, Callable
 
 from dynamo_tpu.runtime import framing
-from dynamo_tpu.runtime.context import Context, StreamError
+from dynamo_tpu.runtime.context import (
+    Context,
+    DeadlineExceeded,
+    ServiceUnavailable,
+    StreamError,
+    deadline_from_headers,
+)
+from dynamo_tpu.runtime.faults import FAULTS
 
 log = logging.getLogger("dynamo.transport")
 
@@ -60,6 +67,8 @@ class EndpointServer:
         self._inflight: set[asyncio.Task] = set()
         self._conns: set[asyncio.StreamWriter] = set()
         self.draining = False
+        self.drain_retry_after_s = 1.0  # hint sent with draining refusals
+        self.aborted_inflight = 0  # streams force-cancelled at drain timeout
 
     def register(self, path: str, handler: Handler) -> None:
         self._handlers[path] = handler
@@ -73,14 +82,29 @@ class EndpointServer:
         return self.host, self.port
 
     async def stop(self, drain: bool = True, timeout: float = 30.0) -> None:
-        """Stop accepting; optionally wait for in-flight requests to finish."""
+        """Stop accepting; optionally wait for in-flight requests to finish.
+
+        Streams that outlive the drain timeout are FORCE-cancelled (and
+        counted in ``aborted_inflight``): a wedged handler must not turn a
+        graceful drain into an unbounded hang — its client sees a stream
+        death and re-drives via migration."""
         self.draining = True
         if self._server is not None:
             self._server.close()
         if drain and self._inflight:
-            await asyncio.wait(self._inflight, timeout=timeout)
-        for t in self._inflight:
+            _done, pending = await asyncio.wait(self._inflight, timeout=timeout)
+            if pending:
+                self.aborted_inflight += len(pending)
+                log.warning(
+                    "drain timeout (%.1fs): force-cancelling %d in-flight "
+                    "stream(s)", timeout, len(pending),
+                )
+        leftover = list(self._inflight)
+        for t in leftover:
             t.cancel()
+        if leftover:
+            # give cancellation a moment to actually unwind the handlers
+            await asyncio.wait(leftover, timeout=5)
         # Actively close peer connections: from 3.12 Server.wait_closed()
         # blocks until every client connection is gone.
         for w in list(self._conns):
@@ -115,8 +139,10 @@ class EndpointServer:
                 if kind == "req":
                     # Register the context BEFORE scheduling the handler task:
                     # a cancel frame in the same read buffer must find it.
+                    headers = msg.get("headers") or {}
                     ctx = Context(
-                        request_id=msg["req"], headers=msg.get("headers") or {}
+                        request_id=msg["req"], headers=headers,
+                        deadline=deadline_from_headers(headers),
                     )
                     # join the caller's W3C trace (runtime/tracing.py)
                     from dynamo_tpu.runtime.tracing import bind_trace
@@ -148,10 +174,18 @@ class EndpointServer:
         path = msg.get("path", "")
         handler = self._handlers.get(path)
         if handler is None or self.draining:
-            reason = "draining" if self.draining else f"no handler for {path!r}"
             contexts.pop(req_id, None)
+            # draining carries a machine-readable code + Retry-After hint:
+            # the client raises ServiceUnavailable, migration re-drives on
+            # a live worker, and the frontend maps exhaustion to HTTP 503
+            err: dict[str, Any] = {"kind": "err", "req": req_id}
+            if self.draining:
+                err.update(error="draining", code="unavailable",
+                           retry_after=self.drain_retry_after_s)
+            else:
+                err.update(error=f"no handler for {path!r}")
             try:
-                await send({"kind": "err", "req": req_id, "error": reason})
+                await send(err)
             except (ConnectionError, RuntimeError):
                 pass
             return
@@ -167,6 +201,22 @@ class EndpointServer:
         except asyncio.CancelledError:
             ctx.kill()
             raise
+        except ServiceUnavailable as e:
+            # typed refusal (draining/saturated handler): ship the code so
+            # the client side re-raises ServiceUnavailable, not a generic
+            # RuntimeError — that's what makes it retryable + 503-mappable
+            try:
+                await send({"kind": "err", "req": req_id, "error": str(e),
+                            "code": "unavailable",
+                            "retry_after": e.retry_after_s})
+            except (ConnectionError, RuntimeError):
+                pass
+        except DeadlineExceeded as e:
+            try:
+                await send({"kind": "err", "req": req_id, "error": str(e),
+                            "code": "deadline"})
+            except (ConnectionError, RuntimeError):
+                pass
         except Exception as e:  # noqa: BLE001 - report handler errors to the peer
             log.exception("handler error on %s", path)
             try:
@@ -190,6 +240,8 @@ class InstanceChannel:
         self._closed = False
 
     async def connect(self, timeout: float = 5.0) -> None:
+        if FAULTS.enabled:
+            await FAULTS.fire("transport.connect")  # drop/error -> dial fails
         self._reader, self._writer = await asyncio.wait_for(
             asyncio.open_connection(self.host, self.port), timeout
         )
@@ -205,6 +257,17 @@ class InstanceChannel:
             msg = await framing.read_frame(self._reader)
             if msg is None:
                 break
+            if FAULTS.enabled:
+                try:
+                    await FAULTS.fire("transport.recv")
+                except (ConnectionError, RuntimeError):
+                    # injected drop OR error: die exactly like a cut
+                    # connection — close the socket so both sides see a
+                    # real death; falling out of the loop marks the
+                    # channel closed and delivers the death sentinels
+                    if self._writer is not None:
+                        self._writer.close()
+                    break
             q = self._queues.get(msg.get("req"))
             if q is not None:
                 q.put_nowait(msg)
@@ -219,10 +282,16 @@ class InstanceChannel:
         mid-stream connection death (the migration trigger)."""
         if not self.connected:
             raise StreamError(f"not connected to {self.host}:{self.port}")
+        if context.deadline_expired:
+            raise DeadlineExceeded(
+                f"deadline passed before dispatch of {context.id}"
+            )
         req_id = context.id or uuid.uuid4().hex
         q: asyncio.Queue = asyncio.Queue()
         self._queues[req_id] = q
         try:
+            if FAULTS.enabled:
+                await FAULTS.fire("transport.send")  # drop -> StreamError
             async with self._lock:
                 await framing.write_frame(
                     self._writer,
@@ -231,7 +300,8 @@ class InstanceChannel:
                         "req": req_id,
                         "path": path,
                         "payload": payload,
-                        "headers": context.headers,
+                        # remaining deadline budget rides the headers
+                        "headers": context.wire_headers(),
                     },
                 )
         except (ConnectionError, RuntimeError) as e:
@@ -254,6 +324,16 @@ class InstanceChannel:
                     return
                 elif kind == "err":
                     finished = True
+                    code = msg.get("code")
+                    if code == "unavailable":
+                        raise ServiceUnavailable(
+                            msg.get("error", "worker unavailable"),
+                            retry_after_s=float(msg.get("retry_after") or 1.0),
+                        )
+                    if code == "deadline":
+                        raise DeadlineExceeded(
+                            msg.get("error", "deadline exceeded")
+                        )
                     raise RuntimeError(msg.get("error", "remote error"))
         finally:
             cancel_task.cancel()

@@ -1,0 +1,24 @@
+#!/usr/bin/env bash
+# Nightly chaos tier: kill-churn soaks + deterministic fault injection.
+# See README.md in this directory for knobs and pass criteria.
+set -euo pipefail
+cd "$(dirname "$0")/../.."
+
+export JAX_PLATFORMS=cpu
+export DYN_SOAK_SECS="${DYN_SOAK_SECS:-300}"
+# low-rate background faults during the soaks; same spec+seed => same
+# schedule (runtime/faults.py), so a red run is replayable
+export DYN_FAULTS="${DYN_FAULTS:-transport.send:drop@0.005,hub.call:delay=5ms@0.05}"
+export DYN_FAULTS_SEED="${DYN_FAULTS_SEED:-0}"
+export DYN_TEST_TIMEOUT="${DYN_TEST_TIMEOUT:-$((${DYN_SOAK_SECS%.*} + 300))}"
+
+echo "chaos soak: DYN_SOAK_SECS=$DYN_SOAK_SECS" \
+     "DYN_FAULTS=$DYN_FAULTS seed=$DYN_FAULTS_SEED"
+
+exec python -m pytest -q -p no:cacheprovider \
+  tests/test_faults.py \
+  tests/test_fault_tolerance.py \
+  "tests/test_soak.py::test_soak_worker_sigkill_churn" \
+  "tests/test_soak.py::test_soak_leader_hub_sigkill_recovery" \
+  "tests/test_hub_replication.py::test_kill9_leader_delete_data_dir_chaos" \
+  "$@"

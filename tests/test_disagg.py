@@ -234,6 +234,70 @@ async def test_disagg_matches_aggregated_greedy():
     assert dec.allocator.active_pages == 0
 
 
+async def test_prefill_death_mid_kv_transfer_completes_with_continuity():
+    """Migration × disagg (robustness PR): the prefill worker dies
+    mid-KV-handoff — the remote first token was emitted but the KV pull
+    fails. The decode worker must complete the request itself (local
+    prefill of prompt + first token) producing EXACTLY the aggregated
+    greedy token stream, and a later request must survive the prefill
+    worker being gone entirely."""
+    from dynamo_tpu.runtime.faults import FAULTS
+
+    prompt = list(range(40, 40 + 23))
+
+    # aggregated ground truth
+    drt_a = DistributedRuntime(InMemoryHub())
+    agg, _ = await launch_engine_worker(
+        drt_a, spec=SPEC, engine_config=engine_config(), model_name="agg",
+    )
+    want, _ = await collect(agg.generate(request(prompt), Context()))
+    await agg.close()
+    await drt_a.close()
+
+    drt = DistributedRuntime(InMemoryHub())
+    pre, _ = await launch_engine_worker(
+        drt, spec=SPEC, engine_config=engine_config(), model_name="tiny-test",
+        mode="prefill",
+    )
+    dec, _ = await launch_engine_worker(
+        drt, spec=SPEC, engine_config=engine_config(), model_name="tiny-test",
+        mode="decode", always_remote_prefill=True,
+    )
+    handler = dec.frontdoor
+    await handler.wait_for_prefill_pool()
+    try:
+        # the violence: the KV pull fails exactly once, as if the prefill
+        # worker died between exporting the pages and serving the pull
+        FAULTS.configure("disagg.pull:error@1x1")
+        got, _ = await collect(handler.generate(request(prompt), Context()))
+        assert got == want, "token continuity broken across the failed pull"
+        assert dec.disagg_fallbacks == 1
+        assert FAULTS.trip_counts[("disagg.pull", "error")] == 1
+        FAULTS.clear()
+
+        # now the prefill worker dies OUTRIGHT; the next request (fresh
+        # prompt so the decode prefix cache can't shortcut the remote
+        # path) must still complete locally
+        await pre.close()
+        prompt2 = list(range(70, 70 + 23))
+        drt_b = DistributedRuntime(InMemoryHub())
+        agg2, _ = await launch_engine_worker(
+            drt_b, spec=SPEC, engine_config=engine_config(),
+            model_name="agg2",
+        )
+        want2, _ = await collect(agg2.generate(request(prompt2), Context()))
+        await agg2.close()
+        await drt_b.close()
+        got2, _ = await collect(handler.generate(request(prompt2), Context()))
+        assert got2 == want2
+    finally:
+        FAULTS.clear()
+        await pre.close()
+        await dec.close()
+        await drt.close()
+    assert dec.allocator.active_pages == 0
+
+
 async def test_disagg_fallback_without_prefill_pool():
     """No live prefill workers -> decode worker serves locally."""
     drt = DistributedRuntime(InMemoryHub())

@@ -88,6 +88,113 @@ def _instances(hub_addr):
     return asyncio.run(go())
 
 
+@pytest.mark.slow
+def test_worker_sigterm_drains_gracefully():
+    """Hardened SIGTERM drain (k8s preStop contract): a SIGTERM'd worker
+    withdraws from the hub, stops admitting, FINISHES its in-flight
+    stream (no migration, no client-visible hiccup), and exits 0 with
+    the drain marker. New traffic lands on the survivor."""
+    procs: list[subprocess.Popen] = []
+    try:
+        _hub_p, hub_addr = _spawn(
+            ["-m", "dynamo_tpu.runtime.hub_server", "--port", "0"],
+            "DYNAMO_HUB=", procs,
+        )
+        worker_a, _ = _spawn(_worker_args(hub_addr), "ENGINE_READY", procs)
+        _frontend_p, http_addr = _spawn(
+            ["-m", "dynamo_tpu.frontend", "--hub", hub_addr,
+             "--host", "127.0.0.1", "--port", "0"],
+            "DYNAMO_HTTP=", procs,
+        )
+        base = f"http://{http_addr}"
+
+        deadline = time.time() + 30
+        models = []
+        while time.time() < deadline and not models:
+            with urllib.request.urlopen(f"{base}/v1/models", timeout=5) as r:
+                models = json.load(r)["data"]
+            if not models:
+                time.sleep(0.2)
+        assert [m["id"] for m in models] == ["tiny-test"]
+
+        # stream starts while A is the only worker: it must be serving it
+        n_tokens = 60
+        req = urllib.request.Request(
+            f"{base}/v1/completions",
+            data=json.dumps({
+                "model": "tiny-test", "prompt": "drain gracefully",
+                "max_tokens": n_tokens, "temperature": 0.0,
+                "ignore_eos": True, "stream": True,
+                "stream_options": {"include_usage": True},
+            }).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        resp = urllib.request.urlopen(req, timeout=120)
+        assert resp.status == 200
+        # a few tokens flow, so the stream is live on A...
+        seen = 0
+        while seen < 5:
+            line = resp.readline().decode().strip()
+            if line.startswith("data:") and '"text"' in line:
+                seen += 1
+
+        # ...worker B joins, then A gets SIGTERM mid-stream
+        _worker_b, _ = _spawn(_worker_args(hub_addr), "ENGINE_READY", procs)
+        deadline = time.time() + 20
+        while time.time() < deadline and len(_instances(hub_addr)) < 2:
+            time.sleep(0.2)
+        worker_a.terminate()  # SIGTERM
+
+        # the in-flight stream COMPLETES on A under the drain (usage
+        # carries the full budget; nothing was migrated or truncated)
+        chunks = []
+        while True:
+            line = resp.readline().decode()
+            if not line:
+                break
+            line = line.strip()
+            if not line.startswith("data:"):
+                continue
+            payload = line[5:].strip()
+            if payload == "[DONE]":
+                break
+            chunks.append(json.loads(payload))
+        usages = [c["usage"] for c in chunks if c.get("usage")]
+        assert usages and usages[-1]["completion_tokens"] == n_tokens, usages
+
+        # A exits 0 and reports a clean drain
+        assert worker_a.wait(timeout=60) == 0
+        out = worker_a.stdout.read()
+        assert "ENGINE_DRAINED leftover=0" in out, out[-2000:]
+
+        # A's withdrawal was immediate (hub delete, not lease expiry):
+        # its instance key is gone; the survivor serves new traffic
+        deadline = time.time() + 15
+        while time.time() < deadline and len(_instances(hub_addr)) != 1:
+            time.sleep(0.3)
+        assert len(_instances(hub_addr)) == 1
+        req2 = urllib.request.Request(
+            f"{base}/v1/completions",
+            data=json.dumps({
+                "model": "tiny-test", "prompt": "after the drain",
+                "max_tokens": 4, "temperature": 0.0, "ignore_eos": True,
+            }).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req2, timeout=60) as r:
+            body = json.load(r)
+        assert body["usage"]["completion_tokens"] == 4
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.terminate()
+        for p in procs:
+            try:
+                p.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                p.kill()
+
+
 def test_worker_sigkill_mid_stream_migrates():
     procs: list[subprocess.Popen] = []
     try:

@@ -392,6 +392,85 @@ async def test_subscribe_seq_dedup_across_failover(tmp_path):
         await _stop_all(reps)
 
 
+async def test_stale_epoch_repl_append_fenced_after_promotion(tmp_path):
+    """Fencing regression (robustness PR): after a promotion bumps the
+    replication epoch, a deposed leader's stale-epoch ``repl.append``
+    push must be REJECTED by followers of the new leader — a late append
+    from the old regime applied after promotion would silently diverge
+    the follower from the new leader's history."""
+    from dynamo_tpu.runtime import framing
+
+    reps, addrs = await _start_cluster(tmp_path, n=3)
+    try:
+        leader = await _wait_single_leader(reps)
+        followers = [r for r in reps if r is not leader]
+        await leader.hub.put("k", 1)
+        await _wait_caught_up(leader, followers)
+        stale_epoch = leader.hub.repl_epoch
+
+        # forced promotion: one follower takes over with a bumped epoch
+        promoted, bystander = followers
+        promoted.hub.promote()
+        promoted.on_promoted()
+        settled = await _wait_single_leader(reps)
+        assert settled is promoted
+        # the bystander has adopted the new regime's epoch
+        deadline = time.monotonic() + 10
+        while (
+            bystander.hub.repl_epoch != promoted.hub.repl_epoch
+            and time.monotonic() < deadline
+        ):
+            await asyncio.sleep(0.05)
+        assert bystander.hub.repl_epoch == promoted.hub.repl_epoch
+        assert bystander.hub.repl_epoch > stale_epoch
+
+        # the deposed leader's late push-apply under the OLD epoch: fenced
+        host, port = bystander.advertise.rsplit(":", 1)
+        reader, writer = await asyncio.open_connection(host, int(port))
+        try:
+            await framing.write_frame(writer, {
+                "id": 1, "op": "repl.append", "epoch": stale_epoch,
+                "seq": bystander.hub.repl_cursor + 1,
+                "rec": {"op": "put", "k": "div/late", "v": 666, "l": None},
+            })
+            msg = await asyncio.wait_for(framing.read_frame(reader), 5)
+            assert msg["ok"] is False
+            assert msg["error"] == "epoch_mismatch"
+            assert msg["epoch"] == bystander.hub.repl_epoch
+
+            # and the record was NOT applied
+            assert "div/late" not in bystander.hub._kv
+
+            # a current-epoch append from the live regime still applies
+            await framing.write_frame(writer, {
+                "id": 2, "op": "repl.append",
+                "epoch": bystander.hub.repl_epoch,
+                "seq": bystander.hub.repl_cursor + 1,
+                "rec": {"op": "put", "k": "ok/fresh", "v": 1, "l": None},
+            })
+            msg = await asyncio.wait_for(framing.read_frame(reader), 5)
+            assert msg["ok"] is True
+            assert bystander.hub._kv.get("ok/fresh") == 1
+        finally:
+            writer.close()
+
+        # the promoted leader itself refuses push-appends outright
+        host, port = promoted.advertise.rsplit(":", 1)
+        reader, writer = await asyncio.open_connection(host, int(port))
+        try:
+            await framing.write_frame(writer, {
+                "id": 1, "op": "repl.append", "epoch": stale_epoch,
+                "seq": 999,
+                "rec": {"op": "put", "k": "div/l", "v": 1, "l": None},
+            })
+            msg = await asyncio.wait_for(framing.read_frame(reader), 5)
+            assert msg["ok"] is False and msg["error"] == "is_leader"
+        finally:
+            writer.close()
+    finally:
+        await _stop_all(reps)
+
+
 async def test_split_brain_loser_discards_divergent_writes(tmp_path):
     """When a split-brain heals, the losing leader must adopt the
     winner's history via a full snapshot bootstrap — NOT an append tail
