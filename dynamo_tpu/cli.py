@@ -114,7 +114,11 @@ async def _arun(args: argparse.Namespace) -> None:
 
         path = args.inp[len("batch:"):]
         pipe = manager.get(model_name)
-        reqs = [json.loads(ln) for ln in open(path) if ln.strip()]
+        # read AND parse off the loop: a big/NFS batch file must not stall
+        # the serving pipeline sharing this loop (dynalint DL001)
+        reqs = await asyncio.to_thread(
+            lambda: [json.loads(ln) for ln in open(path) if ln.strip()]
+        )
         sem = asyncio.Semaphore(args.batch_concurrency)
 
         async def one(i: int, req: dict) -> dict:
@@ -135,12 +139,20 @@ async def _arun(args: argparse.Namespace) -> None:
         results = await asyncio.gather(
             *(one(i, r) for i, r in enumerate(reqs))
         )
-        out = open(args.output, "w") if args.output else sys.stdout
-        for r in results:
-            out.write(json.dumps(r) + "\n")
         if args.output:
-            out.close()
+
+            def _write() -> None:
+                # per-record writes: no O(total-output) payload string on
+                # top of the results list
+                with open(args.output, "w") as f:
+                    for r in results:
+                        f.write(json.dumps(r) + "\n")
+
+            await asyncio.to_thread(_write)
             print(f"BATCH_DONE n={len(results)} -> {args.output}", flush=True)
+        else:
+            for r in results:
+                sys.stdout.write(json.dumps(r) + "\n")
         return
 
     if args.inp == "text":

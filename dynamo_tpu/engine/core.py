@@ -486,6 +486,8 @@ class InferenceEngine:
                     # alive prefill worker instead of waiting out the
                     # export TTL (the dead-worker case just fails again)
                     await asyncio.to_thread(release_kv_blocks, kvp)
+                # dynalint: disable=DL003 -- best-effort release toward a
+                # likely-dead worker; TTL reclaim is the backstop
                 except Exception:  # noqa: BLE001
                     pass
                 first = disagg["kv_transfer"].get("first_token")
@@ -534,6 +536,8 @@ class InferenceEngine:
                 }
                 try:
                     await asyncio.to_thread(release_kv_blocks, kvp)
+                # dynalint: disable=DL003 -- best-effort unpin before the
+                # saturation bounce; TTL reclaim is the backstop
                 except Exception:  # noqa: BLE001
                     pass
             raise ServiceUnavailable(
@@ -629,6 +633,8 @@ class InferenceEngine:
                         {"token_ids": [], "finish_reason": "error",
                          "error": "engine step failure"},
                     )
+                # dynalint: disable=DL001 -- step-thread-only backoff after
+                # a failed step; _thread_loop never runs on the event loop
                 time.sleep(0.05)
         # orderly exit: land any in-flight burst and admission wave so
         # streaming clients get their final items instead of hanging

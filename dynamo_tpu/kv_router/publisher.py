@@ -21,6 +21,7 @@ from dynamo_tpu.kv_router.protocols import (
     KvCacheEvent,
     RouterEvent,
 )
+from dynamo_tpu.runtime.context import spawn
 from dynamo_tpu.runtime.hub import Hub
 
 log = logging.getLogger("dynamo.kv.publisher")
@@ -82,7 +83,7 @@ class KvEventPublisher:
             self._dirty.set()
             if len(self._ops) >= self.max_batch:
                 # batch full: flush immediately rather than waiting the interval
-                asyncio.ensure_future(self.flush())
+                spawn(self.flush(), name="kv-publisher-flush")
 
         if threading.get_ident() == self._loop_thread:
             signal()
@@ -97,7 +98,7 @@ class KvEventPublisher:
             return
 
         def send() -> None:
-            asyncio.ensure_future(self._publish(ev))
+            spawn(self._publish(ev), name="kv-publisher-cleared")
 
         if threading.get_ident() == self._loop_thread:
             send()

@@ -323,6 +323,11 @@ class RemoteBlockPool:
             try:
                 blk = self._decode(data)
             except Exception:  # noqa: BLE001
+                # corrupt tier payload: skip the block (onboard treats it
+                # as a miss) but say so — silent corruption re-prefills
+                # forever with no signal (dynalint DL003)
+                log.warning("g4 block %x decode failed; treating as miss",
+                            sh, exc_info=True)
                 continue
             if blk is not None:
                 out[sh] = blk

@@ -190,6 +190,8 @@ class KubectlBackend:
                     # after loop close raises and leaves a zombie
                     try:
                         await self._watch_proc.wait()
+                    # dynalint: disable=DL003 -- best-effort zombie reap on
+                    # a process we just killed; shutdown must not fail here
                     except Exception:  # noqa: BLE001
                         pass
                 raise
@@ -217,6 +219,13 @@ class KubectlBackend:
         except ValueError:
             return 0
 
+    @staticmethod
+    async def _kubectl(argv: list[str], **kw) -> subprocess.CompletedProcess:
+        """kubectl off the event loop: apiserver round-trips run 100ms+
+        (or hang on a dead cluster), and the reconciler shares its loop
+        with watch streams and the hub client — dynalint DL001."""
+        return await asyncio.to_thread(subprocess.run, argv, **kw)
+
     async def scale(self, spec: ServiceSpec, replicas: int) -> None:
         if self.image:
             import json
@@ -228,21 +237,21 @@ class KubectlBackend:
                 image=self.image, hub=self.hub,
                 name_format=self.name_format, python=self.python,
             )
-            subprocess.run(
+            await self._kubectl(
                 ["kubectl", "-n", self.namespace, "apply", "-f", "-"],
                 input=json.dumps(bundle), text=True, check=False,
             )
             if not spec.port:
                 # apply doesn't prune: a Service left over from when the
                 # spec HAD a port must go explicitly
-                subprocess.run(
+                await self._kubectl(
                     ["kubectl", "-n", self.namespace, "delete", "service",
                      self.name_format.format(service=spec.name),
                      "--ignore-not-found"],
                     check=False,
                 )
             return
-        subprocess.run(
+        await self._kubectl(
             ["kubectl", "-n", self.namespace, "scale", "deployment",
              self.name_format.format(service=spec.name),
              f"--replicas={replicas}"],
@@ -256,7 +265,7 @@ class KubectlBackend:
         revision created one."""
         name = self.name_format.format(service=spec.name)
         for kind in ("deployment", "service"):
-            subprocess.run(
+            await self._kubectl(
                 ["kubectl", "-n", self.namespace, "delete", kind, name,
                  "--ignore-not-found"],
                 check=False,
@@ -271,7 +280,7 @@ class KubectlBackend:
             return
         from dynamo_tpu.operator.manifests import GRAPH_LABEL, SERVICE_LABEL
 
-        out = subprocess.run(
+        out = await self._kubectl(
             ["kubectl", "-n", self.namespace, "get", "deployments",
              "-l", f"{GRAPH_LABEL}={self.graph}",
              "-o", f"jsonpath={{range .items[*]}}"

@@ -113,6 +113,8 @@ class CrdSync:
                     self._proc.kill()
                     try:
                         await self._proc.wait()  # reap on the loop
+                    # dynalint: disable=DL003 -- best-effort zombie reap on
+                    # a process we just killed; cancellation must proceed
                     except Exception:  # noqa: BLE001
                         pass
                 raise

@@ -112,6 +112,11 @@ class SpmdLeader:
 
                 strict = jax.process_count() > 1
             except Exception:  # noqa: BLE001
+                # jax absent/uninitialized: single-process default. Log it
+                # — a mis-probed multi-host run silently losing strictness
+                # is exactly the lockstep bug class (dynalint DL003)
+                log.debug("jax process_count probe failed; strict=False",
+                          exc_info=True)
                 strict = False
         self.strict = strict
         # rejoin state-sync requests parked until the engine reaches a
@@ -398,6 +403,8 @@ class SpmdLeader:
             # drop the advertised address: a follower from a later run
             # must not connect to this dead leader
             await self.hub.delete(ADDR_KEY_FMT.format(group=self.group))
+        # dynalint: disable=DL003 -- best-effort address withdrawal during
+        # close; the hub being already gone is the expected failure here
         except Exception:  # noqa: BLE001 - hub may already be gone
             pass
 
@@ -430,6 +437,10 @@ class SpmdFollower:
 
                 rejoin = jax.process_count() == 1
             except Exception:  # noqa: BLE001
+                # jax absent/uninitialized: mirror-topology default; log
+                # the probe failure (see SpmdLeader.strict — dynalint DL003)
+                log.debug("jax process_count probe failed; rejoin=True",
+                          exc_info=True)
                 rejoin = True
         self.rejoin = rejoin
         self.rejoins = 0  # completed state-sync rejoins (test hook)

@@ -110,11 +110,15 @@ def build_planner(args, hub=None) -> SlaPlanner:
 async def _amain(args) -> None:
     if args.dryrun_trace:
         planner = build_planner(args)
-        trace = [
-            json.loads(line)
-            for line in open(args.dryrun_trace)
-            if line.strip()
-        ]
+        # trace read AND parsed off the loop (dynalint DL001): dryrun
+        # traces can be hundreds of MB of JSONL
+        trace = await asyncio.to_thread(
+            lambda: [
+                json.loads(line)
+                for line in open(args.dryrun_trace)
+                if line.strip()
+            ]
+        )
         decisions = await planner.dryrun(trace)
         for i, (p, d) in enumerate(decisions):
             print(json.dumps({"interval": i, "prefill": p, "decode": d}))

@@ -78,6 +78,9 @@ class JsonlSink:
         import threading
 
         self._f = open(path, "a")
+        # records dropped because the queue was full (observable: silent
+        # audit loss under backpressure is itself an audit failure)
+        self.dropped = 0
         self._q: "_queue.Queue" = _queue.Queue(maxsize=max_queue)
         self._stop = object()
         self._thread = threading.Thread(
@@ -100,14 +103,20 @@ class JsonlSink:
     def emit(self, rec: AuditRecord) -> None:
         try:
             self._q.put_nowait(rec)
+        # dynalint: disable=DL003 -- drop-don't-block is the module
+        # contract; the drop is counted, not silent
         except Exception:  # noqa: BLE001
-            pass  # full queue: drop, never block serving
+            self.dropped += 1  # full queue: drop, never block serving
 
     def flush(self, timeout: float = 5.0) -> None:
+        """Blocking drain for tests and process shutdown ONLY — the
+        serving path never calls it (emit() is enqueue-and-return)."""
         import time as _time
 
         deadline = _time.monotonic() + timeout
         while not self._q.empty() and _time.monotonic() < deadline:
+            # dynalint: disable=DL001 -- test/shutdown helper, never on
+            # the event loop; emit() is the serving-path surface
             _time.sleep(0.01)
 
     def close(self) -> None:
@@ -163,4 +172,6 @@ class AuditBus:
             try:
                 sink.close()
             except Exception:  # noqa: BLE001
-                pass
+                # shutdown fan-out: one sink's close failure must not stop
+                # the others from closing
+                log.warning("audit sink close failed", exc_info=True)

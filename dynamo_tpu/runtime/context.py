@@ -10,9 +10,42 @@ letting any stage observe or trigger cancellation.
 from __future__ import annotations
 
 import asyncio
+import logging
 import time
 import uuid
-from typing import Any
+from typing import Any, Coroutine
+
+_task_log = logging.getLogger("dynamo.tasks")
+
+# Strong references for fire-and-forget tasks: the event loop itself only
+# holds tasks *weakly*, so a task whose result is dropped can be garbage-
+# collected mid-flight — silently cancelling the work (the PR-3 drain-task
+# bug; dynalint DL002 now rejects bare create_task/ensure_future).
+_BACKGROUND_TASKS: set[asyncio.Task] = set()
+
+
+def spawn(coro: Coroutine, *, name: str | None = None) -> asyncio.Task:
+    """create_task with the two things every fire-and-forget site needs:
+    a strong reference until the task finishes, and a done-callback that
+    logs unexpected exceptions instead of letting them vanish with the
+    task object. Returns the task so callers can still cancel/await it."""
+    task = asyncio.get_running_loop().create_task(coro, name=name)
+    _BACKGROUND_TASKS.add(task)
+
+    def _done(t: asyncio.Task) -> None:
+        _BACKGROUND_TASKS.discard(t)
+        if t.cancelled():
+            return
+        exc = t.exception()
+        if exc is not None:
+            _task_log.error(
+                "background task %s crashed: %s: %s",
+                t.get_name(), type(exc).__name__, exc,
+                exc_info=exc,
+            )
+
+    task.add_done_callback(_done)
+    return task
 
 
 class StreamError(RuntimeError):

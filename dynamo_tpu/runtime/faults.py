@@ -59,6 +59,25 @@ from typing import Any
 log = logging.getLogger("dynamo.faults")
 
 
+# Machine-readable site catalog (mirrored by tools/dynalint/catalog.py,
+# cross-checked by tests/test_static_analysis.py): every fire()/fire_sync()
+# call site in the tree must use one of these strings, and configure()
+# warns when a DYN_FAULTS spec names a site no code declares — both
+# directions of the drift that silently kills chaos-schedule replay.
+KNOWN_SITES: frozenset[str] = frozenset({
+    "transport.connect",
+    "transport.send",
+    "transport.recv",
+    "hub.dial",
+    "hub.call",
+    "hub.wal_append",
+    "hub.fsync",
+    "engine.step",
+    "engine.admit",
+    "disagg.pull",
+})
+
+
 class FaultInjected(RuntimeError):
     """An injected ``error`` action fired at a fault point."""
 
@@ -166,6 +185,15 @@ class FaultRegistry:
             self._rngs = {}
             self.enabled = bool(self._rules)
         if rules:
+            unknown = {r.site for r in rules} - KNOWN_SITES
+            if unknown:
+                # warn, don't raise: an old schedule replayed against a
+                # newer build should degrade loudly, not crash the worker
+                log.warning(
+                    "fault spec names unknown site(s) %s — these will "
+                    "NEVER trip (known: %s)",
+                    ",".join(sorted(unknown)), ",".join(sorted(KNOWN_SITES)),
+                )
             log.warning(
                 "fault injection ACTIVE (seed=%d): %s",
                 self.seed, ",".join(r.spec() for r in rules),
@@ -213,11 +241,15 @@ class FaultRegistry:
         raise FaultInjected(f"injected error at {rule.site}")
 
     def fire_sync(self, site: str) -> None:
-        """Blocking fault point (step thread, WAL append, transfer pull)."""
+        """Blocking fault point (step thread, WAL append, transfer pull).
+        Event-loop call sites must use the async ``fire`` instead."""
         rule = self.decide(site)
         if rule is None:
             return
         if rule.action == "delay":
+            # dynalint: disable=DL001 -- blocking delay IS the contract
+            # here: fire_sync is documented thread-side only (step thread,
+            # WAL fsync, transfer pull); loop sites use async fire()
             time.sleep(rule.delay_s)
             return
         self._raise(rule)

@@ -108,8 +108,14 @@ class HealthCheckManager:
         for t in self._tasks:
             try:
                 await t
-            except (asyncio.CancelledError, Exception):  # noqa: BLE001
-                pass
+            except asyncio.CancelledError:
+                pass  # we cancelled it: the expected outcome
+            except Exception:  # noqa: BLE001
+                # a probe loop that died of something OTHER than our
+                # cancel was broken before close() — surface it
+                # (dynalint DL003)
+                log.warning("health probe task died unclean",
+                            exc_info=True)
 
     # -- probing -----------------------------------------------------------
 

@@ -39,12 +39,15 @@ from __future__ import annotations
 
 import asyncio
 import itertools
+import logging
 import time
 from typing import Any, AsyncIterator
 
 from dynamo_tpu.runtime import framing
 from dynamo_tpu.runtime.faults import FAULTS
 from dynamo_tpu.runtime.hub import Hub, KeyExists, WatchEvent
+
+log = logging.getLogger("dynamo.hub.client")
 
 
 class _ConnLost(Exception):
@@ -209,8 +212,13 @@ class RemoteHub(Hub):
                     _ep, fut = self._pending.pop(mid, (0, None))
                     if fut is not None and not fut.done():
                         fut.set_result(msg)
-        except Exception:  # noqa: BLE001 — any rx failure = connection lost
-            pass
+        except Exception as e:  # noqa: BLE001 — any rx failure = conn lost
+            # the finally block below converts this into the reconnect
+            # path; keep the *cause* visible for post-mortems (an
+            # oversized-frame bug looks identical to a cut cable without
+            # this line — dynalint DL003)
+            log.debug("hub rx loop (epoch %d) died: %s: %s",
+                      epoch, type(e).__name__, e)
         finally:
             # connection lost: fail in-flight calls (their callers retry
             # via _call's reconnect loop) and wake stream consumers (they

@@ -23,6 +23,7 @@ import logging
 from typing import Any
 
 from dynamo_tpu.runtime import framing
+from dynamo_tpu.runtime.context import spawn
 from dynamo_tpu.runtime.hub import InMemoryHub, KeyExists
 
 log = logging.getLogger("dynamo.hub")
@@ -114,8 +115,11 @@ class HubServer:
                 msg = await framing.read_frame(reader)
                 if msg is None:
                     break
-                asyncio.ensure_future(
-                    self._dispatch(msg, send, streams, conn_leases)
+                # spawn: strong ref + crash logging — a GC'd dispatch task
+                # would silently drop the RPC (client hangs to timeout)
+                spawn(
+                    self._dispatch(msg, send, streams, conn_leases),
+                    name="hub-dispatch",
                 )
         except (ConnectionResetError, BrokenPipeError):
             pass

@@ -51,6 +51,7 @@ from typing import Any
 
 import msgpack
 
+from dynamo_tpu.runtime.context import spawn
 from dynamo_tpu.runtime.faults import FAULTS
 from dynamo_tpu.runtime.hub import InMemoryHub, _Lease
 
@@ -500,12 +501,14 @@ class DurableHub(InMemoryHub):
         if self._compacting:
             return
         try:
-            loop = asyncio.get_running_loop()
+            asyncio.get_running_loop()  # probe: background mode needs a loop
         except RuntimeError:
             self.store.snapshot(self._state())
             return
         self._compacting = True
-        loop.create_task(self._compact_bg())
+        # spawn: the loop's weak task ref is not enough — a GC'd compaction
+        # task would leave _compacting latched True and the WAL unbounded
+        spawn(self._compact_bg(), name="hub-compact")
 
     async def _compact_bg(self) -> None:
         """Background compaction: capture state synchronously, serialize +

@@ -83,6 +83,8 @@ class MetricsRegistry:
         for fn in _GLOBAL_PROVIDERS.values():
             try:
                 extra = fn()
+            # dynalint: disable=DL003 -- /metrics must never 500 because
+            # one provider is broken; the other providers still render
             except Exception:  # noqa: BLE001 - never break /metrics
                 continue
             if extra:

@@ -20,6 +20,7 @@ stashes it in Context.headers; the transport carries headers to workers
 
 from __future__ import annotations
 
+import asyncio
 import contextlib
 import contextvars
 import json
@@ -230,12 +231,22 @@ class OtlpExporter:
                             len(spans))
 
     def flush(self, timeout: float = 5.0) -> None:
-        """Best-effort synchronous drain (tests, shutdown)."""
+        """Best-effort synchronous drain — tests and shutdown ONLY.
+        Span emission itself never calls this (enqueue + daemon thread);
+        loop-side reconfiguration should prefer ``aflush``."""
         deadline = time.monotonic() + timeout
         while not self._q.empty() and time.monotonic() < deadline:
+            # dynalint: disable=DL001 -- shutdown/test drain, off-loop by
+            # contract; aflush() is the event-loop-safe variant
             time.sleep(0.02)
         # one extra beat for the in-flight POST
+        # dynalint: disable=DL001 -- same shutdown-only contract as above
         time.sleep(0.05)
+
+    async def aflush(self, timeout: float = 5.0) -> None:
+        """Event-loop-safe drain: same semantics as flush() without
+        parking the loop (dynalint DL001)."""
+        await asyncio.to_thread(self.flush, timeout)
 
     def close(self) -> None:
         self.flush()

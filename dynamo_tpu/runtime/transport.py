@@ -32,6 +32,7 @@ from dynamo_tpu.runtime.context import (
     ServiceUnavailable,
     StreamError,
     deadline_from_headers,
+    spawn,
 )
 from dynamo_tpu.runtime.faults import FAULTS
 
@@ -341,8 +342,9 @@ class InstanceChannel:
             if not finished:
                 # Consumer abandoned the stream (break / exception upstream):
                 # tell the worker to stop generating. Fire-and-forget - we may
-                # be inside GeneratorExit where awaiting is restricted.
-                asyncio.ensure_future(self._send_cancel(req_id))
+                # be inside GeneratorExit where awaiting is restricted; spawn
+                # keeps the strong reference so GC can't cancel the send.
+                spawn(self._send_cancel(req_id), name="transport-cancel")
 
     async def _watch_cancel(self, req_id: str, context: Context) -> None:
         await context.stopped()

@@ -1,0 +1,237 @@
+"""Tier-1 gate for tools/dynalint: the package must scan clean against the
+committed baseline, the baseline itself must honor its own policy, and every
+rule must prove it can both catch (true-positive fixture) and be silenced
+(suppressed-negative fixture).
+
+Fast by construction: dynalint is pure stdlib AST — no JAX, no model init —
+so the whole-package scan fits well inside the <5s budget on CPU.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import re
+import subprocess
+import sys
+import time
+import types
+from pathlib import Path
+
+import pytest
+
+REPO_ROOT = Path(__file__).resolve().parents[1]
+sys.path.insert(0, str(REPO_ROOT))  # tools/ is repo-level, not a package dep
+
+from tools.dynalint import baseline as baseline_mod  # noqa: E402
+from tools.dynalint import catalog  # noqa: E402
+from tools.dynalint.core import run_paths, scan_file  # noqa: E402
+from tools.dynalint.rules import RULES  # noqa: E402
+
+FIXTURES = REPO_ROOT / "tools" / "dynalint" / "fixtures"
+BASELINE = REPO_ROOT / "tools" / "dynalint" / "baseline.json"
+
+
+# ---------------------------------------------------------------- the gate
+
+
+def test_dynalint_clean_against_baseline_under_5s():
+    """THE gate: scanning all of dynamo_tpu/ yields no findings beyond the
+    committed baseline, in under 5 seconds."""
+    t0 = time.monotonic()
+    findings, _suppressed, _warnings = run_paths(
+        [REPO_ROOT / "dynamo_tpu"], REPO_ROOT
+    )
+    elapsed = time.monotonic() - t0
+    base = baseline_mod.load(BASELINE)
+    new, _old, _stale = baseline_mod.split(findings, base)
+    assert not new, "new dynalint findings:\n" + "\n".join(
+        f.render() for f in new
+    )
+    assert elapsed < 5.0, f"dynalint scan took {elapsed:.2f}s (budget 5s)"
+
+
+def test_baseline_never_grandfathers_dl001_dl002():
+    """DL001/DL002 are fixed outright, never baselined (ISSUE acceptance
+    criterion + baseline.py policy)."""
+    data = json.loads(BASELINE.read_text())
+    bad = [e for e in data["findings"]
+           if e["rule"] in baseline_mod.NEVER_BASELINE]
+    assert not bad, f"baseline contains banned rules: {bad}"
+
+
+def test_stale_baseline_entries_are_reported():
+    """A baseline fingerprint nothing produces any more must surface (the
+    baseline shrinks monotonically, it never accretes dead weight)."""
+    findings, _s, _w = run_paths([REPO_ROOT / "dynamo_tpu"], REPO_ROOT)
+    fake = {"deadbeef0000": {
+        "fingerprint": "deadbeef0000", "rule": "DL003",
+        "path": "dynamo_tpu/nonexistent.py", "context": "gone",
+    }}
+    _new, _old, stale = baseline_mod.split(findings, fake)
+    assert [e["fingerprint"] for e in stale] == ["deadbeef0000"]
+
+
+def test_unused_suppression_is_reported(tmp_path):
+    """A disable whose finding is gone must surface — otherwise it sits
+    there masking the NEXT finding on that line forever."""
+    (tmp_path / "mod.py").write_text(
+        "import asyncio\n\n\n"
+        "async def fine():\n"
+        "    # dynalint: disable=DL001 -- stale: the sleep was removed\n"
+        "    await asyncio.sleep(0)\n"
+    )
+    (tmp_path / "mod2.py").write_text(
+        "# dynalint: disable-file=DL005 -- stale: class went away\n"
+        "X = 1\n"
+    )
+    findings, suppressed, warnings = run_paths([tmp_path], tmp_path)
+    assert not findings and not suppressed
+    assert any("unused suppression for DL001" in w for w in warnings)
+    assert any(
+        "unused suppression for DL005" in w and "mod2.py:1" in w
+        for w in warnings
+    ), "stale file-wide disable not reported"
+
+
+def test_package_has_no_unused_suppressions():
+    """Every in-repo disable still silences a live finding."""
+    _f, _s, warnings = run_paths([REPO_ROOT / "dynamo_tpu"], REPO_ROOT)
+    unused = [w for w in warnings if "unused suppression" in w]
+    assert not unused, "\n".join(unused)
+
+
+def test_in_repo_suppressions_carry_reasons():
+    """Every ``# dynalint: disable=`` in the package must have a written
+    ``-- reason`` (the satellite contract: suppress WITH a reason)."""
+    offenders = []
+    for f in (REPO_ROOT / "dynamo_tpu").rglob("*.py"):
+        for i, line in enumerate(f.read_text().splitlines(), 1):
+            if "dynalint: disable" in line and "--" not in line:
+                offenders.append(f"{f.relative_to(REPO_ROOT)}:{i}")
+    assert not offenders, f"suppressions without reasons: {offenders}"
+
+
+# ------------------------------------------------------------ the fixtures
+
+
+def _expected_findings(path: Path) -> dict[int, set[str]]:
+    expected: dict[int, set[str]] = {}
+    for i, line in enumerate(path.read_text().splitlines(), 1):
+        m = re.search(r"# EXPECT: (DL\d+)", line)
+        if m:
+            expected.setdefault(i, set()).add(m.group(1))
+    return expected
+
+
+@pytest.mark.parametrize(
+    "fixture", sorted(FIXTURES.glob("dl0*.py")), ids=lambda p: p.stem
+)
+def test_fixture_golden(fixture: Path):
+    """Each fixture produces EXACTLY its ``# EXPECT: DLnnn`` findings —
+    no false negatives on the marked lines, no false positives anywhere
+    else — and exercises at least one suppressed negative."""
+    expected = _expected_findings(fixture)
+    assert expected, f"{fixture.name} has no EXPECT markers"
+    active, suppressed, _ctx = scan_file(fixture, REPO_ROOT)
+    got: dict[int, set[str]] = {}
+    for f in active:
+        got.setdefault(f.line, set()).add(f.rule)
+    assert got == expected, (
+        f"{fixture.name}: expected {expected}, got {got}"
+    )
+    rule_id = fixture.stem[:5].upper().replace("DL0", "DL0")
+    assert any(f.rule == rule_id for f in active), (
+        f"{fixture.name} has no {rule_id} true positive"
+    )
+    assert any(f.rule == rule_id for f in suppressed), (
+        f"{fixture.name} has no {rule_id} suppressed negative"
+    )
+
+
+def test_every_rule_has_a_fixture():
+    stems = {p.stem[:5].upper() for p in FIXTURES.glob("dl0*.py")}
+    assert stems == set(RULES), f"fixtures {stems} != rules {set(RULES)}"
+
+
+# ------------------------------------------------- catalog <-> runtime sync
+
+
+def test_fault_site_catalog_matches_runtime():
+    """tools/dynalint/catalog.py and runtime/faults.py KNOWN_SITES are the
+    same registry spelled twice (dynalint never imports the package under
+    scan); they must never drift."""
+    from dynamo_tpu.runtime.faults import KNOWN_SITES
+
+    assert set(catalog.FAULT_SITES) == set(KNOWN_SITES)
+
+
+def test_unknown_fault_site_in_spec_warns(caplog):
+    from dynamo_tpu.runtime.faults import FaultRegistry
+
+    reg = FaultRegistry()
+    with caplog.at_level("WARNING", logger="dynamo.faults"):
+        reg.configure("engine.setp:error@0.1")
+    assert any("unknown site" in r.message for r in caplog.records)
+    reg.clear()
+
+
+def test_stale_catalog_entry_warns(tmp_path):
+    """A catalogued site/metric no code uses is cross-file drift: the
+    runner reports it (the code-level complement lives in DL006)."""
+    (tmp_path / "mod.py").write_text(
+        'FAULTS = None\n\ndef f():\n    FAULTS.fire("transport.send")\n'
+    )
+    fake_catalog = types.SimpleNamespace(
+        FAULT_SITES={"transport.send": "", "ghost.site": ""},
+        METRIC_NAMES={"ghost_metric_total": ""},
+    )
+    findings, _s, warnings = run_paths(
+        [tmp_path], tmp_path, catalog=fake_catalog
+    )
+    assert not findings
+    assert any("ghost.site" in w for w in warnings)
+    assert any("ghost_metric_total" in w for w in warnings)
+
+
+# -------------------------------------------------------- entry point + spawn
+
+
+def test_cli_entry_point_exits_zero():
+    """``python -m tools.dynalint`` is the single CI entry point; it must
+    pass against the committed baseline (externals skipped gracefully
+    when not installed)."""
+    proc = subprocess.run(
+        [sys.executable, "-m", "tools.dynalint"],
+        cwd=REPO_ROOT, capture_output=True, text=True, timeout=120,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+def test_spawn_keeps_strong_ref_and_logs_crashes(caplog):
+    """The DL002 remedy: spawn() holds the task strongly and surfaces
+    unexpected exceptions through the 'dynamo.tasks' logger."""
+    from dynamo_tpu.runtime import context as ctx_mod
+
+    async def scenario():
+        async def boom():
+            raise RuntimeError("kaput")
+
+        async def fine():
+            return 42
+
+        t1 = ctx_mod.spawn(boom(), name="boom-task")
+        t2 = ctx_mod.spawn(fine(), name="fine-task")
+        assert t1 in ctx_mod._BACKGROUND_TASKS
+        assert t2 in ctx_mod._BACKGROUND_TASKS
+        await asyncio.gather(t1, t2, return_exceptions=True)
+        await asyncio.sleep(0)  # let done-callbacks run
+        assert t1 not in ctx_mod._BACKGROUND_TASKS
+        assert t2 not in ctx_mod._BACKGROUND_TASKS
+
+    with caplog.at_level("ERROR", logger="dynamo.tasks"):
+        asyncio.run(scenario())
+    crashes = [r for r in caplog.records if "boom-task" in r.message
+               or "kaput" in str(r.args)]
+    assert crashes, "crashed background task was not logged"
+    assert not any("fine-task" in str(r.args) for r in caplog.records)

@@ -1,0 +1,1 @@
+"""Developer tooling for the dynamo-tpu repo (not shipped with the package)."""

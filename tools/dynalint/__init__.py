@@ -1,0 +1,31 @@
+"""dynalint — project-specific static analysis for dynamo-tpu.
+
+The upstream reference framework leans on Rust's type system plus CodeQL /
+cargo-deny in CI; this Python reproduction has neither, and an entire class
+of its historical bugs (GC'd fire-and-forget drain task, exported-KV-page
+leaks on error paths, event-loop-blocking sleeps — all hand-fixed in PR 3)
+are *mechanically detectable*. dynalint turns those reviewer-enforced
+invariants into a machine-checked tier-1 gate.
+
+Rules (see tools/dynalint/README.md for the full catalog):
+
+    DL001  blocking-call-in-async      event-loop stalls (TTFT tail spikes)
+    DL002  orphaned-task               GC'd fire-and-forget asyncio tasks
+    DL003  swallowed-exception         broad except that hides failures
+    DL004  resource-pairing            KV page alloc without release on all paths
+    DL005  cross-thread-mutation       step-thread vs event-loop attr races
+    DL006  fault-site/metric-registry  chaos-schedule + metrics name drift
+
+Suppression: ``# dynalint: disable=DL001 -- reason`` on the offending line
+(or on a comment-only line directly above it). File-wide:
+``# dynalint: disable-file=DL005 -- reason``.
+
+Run: ``python -m tools.dynalint [paths...]`` (defaults to ``dynamo_tpu``,
+compared against the committed baseline ``tools/dynalint/baseline.json``;
+new findings always fail).
+"""
+
+from tools.dynalint.core import Finding, run_paths, scan_file  # noqa: F401
+from tools.dynalint.rules import RULES  # noqa: F401
+
+__version__ = "0.1.0"

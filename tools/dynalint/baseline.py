@@ -1,0 +1,64 @@
+"""Baseline handling: grandfathered findings that don't fail the gate.
+
+The baseline is a committed JSON file keyed by line-number-independent
+fingerprints (rule | path | enclosing qualname | rule-specific detail), so
+unrelated edits to a file don't churn it. New findings always fail; stale
+entries (fingerprints no current finding produces) are reported so the
+baseline shrinks monotonically — ``--update-baseline`` rewrites it.
+
+Policy (enforced by tests/test_static_analysis.py): DL001 and DL002 may
+NOT be baselined — those classes are fixed outright, never grandfathered.
+"""
+
+from __future__ import annotations
+
+import json
+from pathlib import Path
+
+from tools.dynalint.core import Finding
+
+NEVER_BASELINE = ("DL001", "DL002")
+
+
+def load(path: Path) -> dict[str, dict]:
+    if not path.exists():
+        return {}
+    data = json.loads(path.read_text())
+    return {e["fingerprint"]: e for e in data.get("findings", [])}
+
+
+def save(path: Path, findings: list[Finding]) -> None:
+    entries = [
+        {
+            "fingerprint": f.fingerprint,
+            "rule": f.rule,
+            "path": f.path,
+            "context": f.context,
+            "detail": f.detail,
+            "message": f.message,
+        }
+        for f in findings
+        if f.rule not in NEVER_BASELINE
+    ]
+    entries.sort(key=lambda e: (e["path"], e["rule"], e["fingerprint"]))
+    path.write_text(json.dumps(
+        {"version": 1, "tool": "dynalint", "findings": entries}, indent=2
+    ) + "\n")
+
+
+def split(
+    findings: list[Finding], baseline: dict[str, dict]
+) -> tuple[list[Finding], list[Finding], list[dict]]:
+    """(new, grandfathered, stale-baseline-entries)."""
+    seen: set[str] = set()
+    new: list[Finding] = []
+    old: list[Finding] = []
+    for f in findings:
+        fp = f.fingerprint
+        seen.add(fp)
+        if fp in baseline and f.rule not in NEVER_BASELINE:
+            old.append(f)
+        else:
+            new.append(f)
+    stale = [e for fp, e in baseline.items() if fp not in seen]
+    return new, old, stale

@@ -1,0 +1,39 @@
+"""The reviewable registries DL006 checks against.
+
+Adding a fault site or metric is a two-line diff *here* plus the code —
+which is the point: the catalog shows up in review, chaos schedules and
+dashboards reference these exact strings, and dynalint fails on drift in
+either direction (unknown name used, or catalogued name unused).
+
+``FAULT_SITES`` mirrors ``dynamo_tpu.runtime.faults.KNOWN_SITES`` — the
+runtime complement that warns when a ``DYN_FAULTS`` spec names a site no
+code declares. tests/test_static_analysis.py asserts the two sets match.
+"""
+
+from __future__ import annotations
+
+# site -> where it fires / what failure it simulates
+FAULT_SITES: dict[str, str] = {
+    "transport.connect": "runtime/transport.py dial — peer unreachable",
+    "transport.send": "runtime/transport.py request send — cut connection",
+    "transport.recv": "runtime/transport.py rx loop — channel dies mid-stream",
+    "hub.dial": "runtime/hub_client.py connect — hub unreachable",
+    "hub.call": "runtime/hub_client.py RPC — lossy hub link",
+    "hub.wal_append": "runtime/hub_store.py WAL append — disk write fails",
+    "hub.fsync": "runtime/hub_store.py fsync — slow/failing durable disk",
+    "engine.step": "engine/core.py step thread — device step fails/stalls",
+    "engine.admit": "engine/core.py admission — worker vanishes pre-admit",
+    "disagg.pull": "disagg/transfer.py KV pull — transfer plane failure",
+}
+
+# metric name (without the dynamo_ prefix MetricsRegistry adds) -> meaning
+METRIC_NAMES: dict[str, str] = {
+    "http_requests_total": "HTTP requests by model/route/status",
+    "time_to_first_token_seconds": "TTFT histogram by model",
+    "inter_token_latency_seconds": "ITL histogram by model",
+    "request_duration_seconds": "end-to-end request duration by model",
+    "output_tokens_total": "generated tokens by model",
+    "input_tokens_total": "prompt tokens by model",
+    "requests_completed_total": "requests that reached the backend",
+    "inflight_requests": "in-flight request gauge by model",
+}

@@ -1,0 +1,159 @@
+"""dynalint CLI: the single static-analysis entry point for this repo.
+
+``python -m tools.dynalint`` runs, in order:
+
+  1. the dynalint rule suite (DL001–DL006) against the committed baseline;
+  2. ``ruff check`` with the pyproject config, when ruff is installed;
+  3. ``mypy`` (strict on dynamo_tpu/runtime/), when mypy is installed.
+
+Missing external tools are *skipped with a notice*, never a failure — the
+hermetic CI container bakes only the Python toolchain, and the dynalint
+rules themselves are pure stdlib. Exit code 0 = the combined pass is green.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import shutil
+import subprocess
+import sys
+import time
+from pathlib import Path
+
+from tools.dynalint import baseline as baseline_mod
+from tools.dynalint.core import run_paths
+from tools.dynalint.rules import RULES
+
+REPO_ROOT = Path(__file__).resolve().parents[2]
+DEFAULT_BASELINE = Path(__file__).resolve().parent / "baseline.json"
+
+
+def _run_external(name: str, argv: list[str]) -> int | None:
+    """Run an optional external checker; None = not installed (skipped).
+    Notices go to stderr: stdout belongs to findings (and, under --json,
+    to the one JSON document)."""
+    if shutil.which(name) is None and shutil.which(argv[0]) is None:
+        print(f"dynalint: {name} not installed — skipped "
+              f"(pip install .[dev] to enable)", file=sys.stderr)
+        return None
+    proc = subprocess.run(argv, cwd=REPO_ROOT)
+    return proc.returncode
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m tools.dynalint",
+        description="Project-specific static analysis for dynamo-tpu.",
+    )
+    ap.add_argument("paths", nargs="*", default=["dynamo_tpu"],
+                    help="files/dirs to scan (default: dynamo_tpu)")
+    ap.add_argument("--baseline", default=str(DEFAULT_BASELINE),
+                    help="baseline JSON (default: committed baseline)")
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="report every finding, ignore the baseline")
+    ap.add_argument("--update-baseline", action="store_true",
+                    help="rewrite the baseline from current findings "
+                         "(DL001/DL002 are never baselined)")
+    ap.add_argument("--rules", default="",
+                    help="comma-separated rule subset (e.g. DL001,DL004)")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="machine-readable findings on stdout")
+    ap.add_argument("--list-rules", action="store_true")
+    ap.add_argument("--no-external", action="store_true",
+                    help="skip ruff/mypy even when installed")
+    ap.add_argument("--show-suppressed", action="store_true",
+                    help="also list suppressed findings")
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        for rid, rule in sorted(RULES.items()):
+            doc = (rule.__doc__ or "").strip().splitlines()[0]
+            print(f"{rid}  {rule.name:<26} {doc}")
+        return 0
+
+    rules = None
+    if args.rules:
+        rules = {r.strip().upper() for r in args.rules.split(",") if r.strip()}
+        unknown = rules - set(RULES)
+        if unknown:
+            print(f"dynalint: unknown rule(s): {', '.join(sorted(unknown))}",
+                  file=sys.stderr)
+            return 2
+
+    t0 = time.monotonic()
+    paths = [
+        p if p.is_absolute() else REPO_ROOT / p
+        for p in (Path(p) for p in args.paths)
+    ]
+    missing = [p for p in paths if not p.exists()]
+    if missing:
+        print(f"dynalint: no such path: {missing[0]}", file=sys.stderr)
+        return 2
+    findings, suppressed, warnings = run_paths(paths, REPO_ROOT, rules=rules)
+
+    base = {} if args.no_baseline else baseline_mod.load(Path(args.baseline))
+    new, grandfathered, stale = baseline_mod.split(findings, base)
+
+    if args.update_baseline:
+        baseline_mod.save(Path(args.baseline), findings)
+        print(f"dynalint: baseline rewritten with "
+              f"{len([f for f in findings if f.rule not in baseline_mod.NEVER_BASELINE])} "
+              f"finding(s) -> {args.baseline}", file=sys.stderr)
+        new = [f for f in findings
+               if f.rule in baseline_mod.NEVER_BASELINE]
+
+    if args.as_json:
+        print(json.dumps({
+            "new": [vars(f) | {"fingerprint": f.fingerprint} for f in new],
+            "grandfathered": [f.fingerprint for f in grandfathered],
+            "stale_baseline": [e["fingerprint"] for e in stale],
+            "suppressed": len(suppressed),
+            "warnings": warnings,
+        }, indent=2))
+    else:
+        for f in new:
+            print(f.render())
+        if args.show_suppressed:
+            for f in suppressed:
+                print(f"[suppressed] {f.render()}")
+        for w in warnings:
+            print(f"dynalint: warning: {w}", file=sys.stderr)
+        for e in stale:
+            print(
+                f"dynalint: warning: stale baseline entry "
+                f"{e['fingerprint']} ({e['rule']} {e['path']} "
+                f"{e.get('context', '')}) — fixed? run --update-baseline",
+                file=sys.stderr,
+            )
+        dt = time.monotonic() - t0
+        print(
+            f"dynalint: {len(new)} new, {len(grandfathered)} baselined, "
+            f"{len(suppressed)} suppressed finding(s) in {dt:.2f}s",
+            file=sys.stderr,
+        )
+
+    rc = 1 if new else 0
+
+    # --json promises exactly one parseable document on stdout; external
+    # tools write their own stdout, so they only chain in text mode
+    if (
+        rc == 0 and not args.no_external and not args.update_baseline
+        and not args.as_json
+    ):
+        ruff_rc = _run_external(
+            "ruff", ["ruff", "check", *[str(p) for p in args.paths]]
+        )
+        if ruff_rc not in (None, 0):
+            rc = 1
+        mypy_rc = _run_external(
+            "mypy", ["mypy", "--config-file", "pyproject.toml",
+                     "dynamo_tpu/runtime"]
+        )
+        if mypy_rc not in (None, 0):
+            rc = 1
+    return rc
+
+
+if __name__ == "__main__":  # pragma: no cover
+    sys.exit(main())

@@ -1,0 +1,330 @@
+"""dynalint core: findings, suppressions, file walking, the scan driver.
+
+Pure stdlib + pure AST: dynalint never imports the code under analysis, so
+it runs in <5s on CPU with no JAX initialisation and cannot be broken by an
+import-time crash in the package it is checking.
+"""
+
+from __future__ import annotations
+
+import ast
+import hashlib
+import re
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Iterable
+
+SUPPRESS_RE = re.compile(
+    r"#\s*dynalint:\s*(disable|disable-file)\s*=\s*"
+    r"(?P<rules>[A-Z0-9,\s]+?)\s*(?:--\s*(?P<reason>.*))?$"
+)
+ALL = "ALL"
+
+
+@dataclass
+class Finding:
+    """One rule violation at one source location."""
+
+    rule: str
+    path: str  # repo-relative posix path
+    line: int
+    col: int
+    message: str
+    hint: str = ""
+    context: str = ""  # enclosing def/class qualname ("Engine.generate")
+    detail: str = ""  # stable token for the fingerprint (not line-based)
+
+    @property
+    def fingerprint(self) -> str:
+        """Line-number-independent identity: survives unrelated edits to
+        the same file, so the committed baseline doesn't churn."""
+        raw = f"{self.rule}|{self.path}|{self.context}|{self.detail}"
+        return hashlib.sha1(raw.encode()).hexdigest()[:12]
+
+    def render(self) -> str:
+        out = f"{self.path}:{self.line}:{self.col}: {self.rule} {self.message}"
+        if self.hint:
+            out += f"  [fix: {self.hint}]"
+        return out
+
+
+@dataclass
+class _Suppression:
+    """One ``disable=`` directive and the source lines it covers."""
+
+    rules: frozenset[str]
+    lines: frozenset[int]
+    declared_line: int
+    used: set[str] = field(default_factory=set)  # rules that matched
+
+
+@dataclass
+class Suppressions:
+    """Per-file suppression map parsed from ``# dynalint: disable=...``."""
+
+    entries: list[_Suppression] = field(default_factory=list)
+    file_wide: dict[str, int] = field(default_factory=dict)  # rule -> line
+    _file_wide_used: set[str] = field(default_factory=set)
+
+    def covers(self, finding: Finding) -> bool:
+        if finding.rule in self.file_wide:
+            self._file_wide_used.add(finding.rule)
+            return True
+        if ALL in self.file_wide:
+            self._file_wide_used.add(ALL)
+            return True
+        hit = False
+        for e in self.entries:
+            if finding.line in e.lines and (
+                finding.rule in e.rules or ALL in e.rules
+            ):
+                e.used.add(finding.rule)
+                hit = True
+        return hit
+
+    def unused(self) -> list[tuple[int, str]]:
+        """(line, rule) pairs that silenced nothing — a stale disable
+        (per-line OR file-wide) would otherwise mask the NEXT real
+        finding forever."""
+        out = [
+            (e.declared_line, r)
+            for e in self.entries
+            for r in sorted(e.rules)
+            if r != ALL and r not in e.used
+        ]
+        out.extend(
+            (line, rule)
+            for rule, line in sorted(self.file_wide.items())
+            if rule != ALL and rule not in self._file_wide_used
+        )
+        return sorted(out)
+
+
+def parse_suppressions(source: str) -> Suppressions:
+    sup = Suppressions()
+    lines = source.splitlines()
+    for i, raw in enumerate(lines, start=1):
+        m = SUPPRESS_RE.search(raw)
+        if m is None:
+            continue
+        rules = frozenset(
+            r.strip() for r in m.group("rules").split(",") if r.strip()
+        )
+        if m.group(1) == "disable-file":
+            for r in rules:
+                sup.file_wide.setdefault(r, i)
+            continue
+        covered = {i}
+        if raw.strip().startswith("#"):
+            # comment-only line: the suppression names the next *code*
+            # line (reason text may continue over further comment lines)
+            j = i + 1
+            while j <= len(lines) and (
+                not lines[j - 1].strip()
+                or lines[j - 1].strip().startswith("#")
+            ):
+                j += 1
+            covered.add(j)
+        sup.entries.append(_Suppression(
+            rules=rules, lines=frozenset(covered), declared_line=i,
+        ))
+    return sup
+
+
+def annotate_parents(tree: ast.AST) -> list[ast.AST]:
+    """Attach ``_dl_parent`` to every node (rules walk ancestry for
+    try/finally placement, with-blocks, and enclosing scopes) and return
+    the flat node list — computed once per file so the six rules don't
+    each re-walk the tree (the <5s tier-1 budget is real)."""
+    nodes: list[ast.AST] = [tree]
+    i = 0
+    while i < len(nodes):
+        node = nodes[i]
+        i += 1
+        for child in ast.iter_child_nodes(node):
+            child._dl_parent = node  # type: ignore[attr-defined]
+            nodes.append(child)
+    return nodes
+
+
+def parents(node: ast.AST) -> Iterable[ast.AST]:
+    cur = getattr(node, "_dl_parent", None)
+    while cur is not None:
+        yield cur
+        cur = getattr(cur, "_dl_parent", None)
+
+
+def enclosing_function(node: ast.AST):
+    """Nearest enclosing function scope (lambda counts: code inside a
+    lambda passed to ``asyncio.to_thread`` is NOT on the event loop)."""
+    for p in parents(node):
+        if isinstance(p, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+            return p
+    return None
+
+
+def qualname(node: ast.AST) -> str:
+    """Dotted path of enclosing class/function defs ("Engine.generate")."""
+    names: list[str] = []
+    cur: ast.AST | None = node
+    while cur is not None:
+        if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+            names.append(cur.name)
+        cur = getattr(cur, "_dl_parent", None)
+    return ".".join(reversed(names)) or "<module>"
+
+
+def dotted(node: ast.AST) -> str | None:
+    """Resolve an attribute/name chain to a dotted string, or None when a
+    segment is dynamic. ``a.b().c`` resolves through calls as ``a.b.c`` so
+    ``asyncio.get_running_loop().create_task`` is matchable."""
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        base = dotted(node.value)
+        return f"{base}.{node.attr}" if base else None
+    if isinstance(node, ast.Call):
+        return dotted(node.func)
+    return None
+
+
+class ScanContext:
+    """Everything one rule invocation gets to look at for one file."""
+
+    def __init__(
+        self,
+        tree: ast.Module,
+        source: str,
+        path: str,
+        catalog=None,
+        nodes: list[ast.AST] | None = None,
+    ):
+        self.tree = tree
+        self.source = source
+        self.path = path
+        self.lines = source.splitlines()
+        # flat pre-order node list; every rule iterates this instead of
+        # re-walking the tree
+        self.nodes = annotate_parents(tree) if nodes is None else nodes
+        # modules that participate in the async/threaded runtime: a sync
+        # time.sleep in one of these is loop-reachable until proven
+        # otherwise (DL001 tier 2)
+        self.imports_async_runtime = any(
+            isinstance(n, (ast.Import, ast.ImportFrom))
+            and any(
+                (a.name if isinstance(n, ast.Import) else n.module or "")
+                .split(".")[0] in ("asyncio", "threading")
+                for a in n.names
+            )
+            for n in self.nodes
+        )
+        if catalog is None:
+            from tools.dynalint import catalog as catalog_mod
+
+            catalog = catalog_mod
+        self.catalog = catalog
+        # cross-file accumulators (runner-owned; rules append)
+        self.used_fault_sites: set[str] = set()
+        self.used_metric_names: set[str] = set()
+        # per-file notices the runner surfaces (unused suppressions)
+        self.warnings: list[str] = []
+
+
+def scan_file(
+    path: Path,
+    root: Path,
+    rules=None,
+    catalog=None,
+) -> tuple[list[Finding], list[Finding], ScanContext | None]:
+    """Scan one file. Returns (active findings, suppressed findings, ctx);
+    ctx is None when the file failed to parse (which is itself a finding)."""
+    from tools.dynalint.rules import RULES
+
+    rel = path.resolve().relative_to(root.resolve()).as_posix()
+    source = path.read_text(encoding="utf-8", errors="replace")
+    try:
+        tree = ast.parse(source, filename=str(path))
+    except SyntaxError as e:
+        f = Finding(
+            rule="DL000",
+            path=rel,
+            line=e.lineno or 1,
+            col=e.offset or 0,
+            message=f"syntax error: {e.msg}",
+            detail="syntax-error",
+        )
+        return [f], [], None
+    ctx = ScanContext(tree, source, rel, catalog=catalog)
+    sup = parse_suppressions(source)
+    active: list[Finding] = []
+    suppressed: list[Finding] = []
+    for rule_id, rule in RULES.items():
+        if rules is not None and rule_id not in rules:
+            continue
+        for finding in rule.check(ctx):
+            (suppressed if sup.covers(finding) else active).append(finding)
+    if rules is None:
+        # only meaningful under the full rule set: a DL004 disable looks
+        # "unused" when DL004 wasn't run
+        for line, rule_id in sup.unused():
+            ctx.warnings.append(
+                f"{rel}:{line}: unused suppression for {rule_id} — the "
+                "finding is gone; remove the disable before it masks a "
+                "new one"
+            )
+    return active, suppressed, ctx
+
+
+def iter_python_files(paths: list[Path]) -> Iterable[Path]:
+    for p in paths:
+        if p.is_file() and p.suffix == ".py":
+            yield p
+        elif p.is_dir():
+            for f in sorted(p.rglob("*.py")):
+                if "__pycache__" in f.parts:
+                    continue
+                yield f
+
+
+def run_paths(
+    paths: list[Path],
+    root: Path,
+    rules=None,
+    catalog=None,
+) -> tuple[list[Finding], list[Finding], list[str]]:
+    """Scan all files under ``paths``. Returns (findings, suppressed,
+    cross-file warnings). Warnings cover catalog drift in the *stale*
+    direction — a catalogued fault site or metric name that no code uses —
+    which can't be attributed to any single file."""
+    findings: list[Finding] = []
+    suppressed: list[Finding] = []
+    used_sites: set[str] = set()
+    used_metrics: set[str] = set()
+    warnings: list[str] = []
+    for path in iter_python_files(paths):
+        active, supp, ctx = scan_file(path, root, rules=rules, catalog=catalog)
+        findings.extend(active)
+        suppressed.extend(supp)
+        if ctx is not None:
+            used_sites |= ctx.used_fault_sites
+            used_metrics |= ctx.used_metric_names
+            warnings.extend(ctx.warnings)
+    if catalog is None:
+        from tools.dynalint import catalog as catalog_mod
+
+        catalog = catalog_mod
+    # stale-catalog detection only makes sense over a whole tree: a
+    # single-file scan trivially "doesn't use" almost every entry
+    if any(p.is_dir() for p in paths) and (rules is None or "DL006" in rules):
+        for site in sorted(set(catalog.FAULT_SITES) - used_sites):
+            warnings.append(
+                f"catalog: fault site {site!r} is documented but no "
+                f"faults.fire()/fire_sync() call uses it (stale catalog entry?)"
+            )
+        for name in sorted(set(catalog.METRIC_NAMES) - used_metrics):
+            warnings.append(
+                f"catalog: metric {name!r} is documented but never "
+                f"registered (stale catalog entry?)"
+            )
+    findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    return findings, suppressed, warnings

@@ -1,0 +1,8 @@
+"""Golden fixtures for the dynalint rule suite.
+
+Each ``dl00N_*.py`` file is scanned by tests/test_static_analysis.py.
+Lines carrying a ``# EXPECT: DLnnn`` comment must produce exactly that
+finding (true positive); lines carrying a suppression comment must NOT
+(suppressed negative); everything else must stay quiet (clean negative).
+The fixtures are never imported — syntax-valid is all they need to be.
+"""

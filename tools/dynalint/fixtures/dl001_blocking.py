@@ -1,0 +1,48 @@
+"""DL001 fixture: blocking calls in async / loop-reachable code."""
+
+import asyncio
+import socket
+import subprocess
+import threading
+import time
+import urllib.request
+
+_lock = threading.Lock()
+
+
+async def stalls_the_loop():
+    time.sleep(0.5)  # EXPECT: DL001
+    subprocess.run(["ls"])  # EXPECT: DL001
+    urllib.request.urlopen("http://example.com")  # EXPECT: DL001
+    socket.create_connection(("localhost", 1))  # EXPECT: DL001
+    f = open("/etc/hostname")  # EXPECT: DL001
+    _lock.acquire()  # EXPECT: DL001
+    return f
+
+
+async def alias_dodge():
+    import time as _time
+
+    _time.sleep(0.5)  # EXPECT: DL001
+
+
+def sync_but_loop_reachable():
+    # module imports asyncio: sync time.sleep is tier-2 flagged
+    time.sleep(0.1)  # EXPECT: DL001
+
+
+def proven_thread_only():
+    # dynalint: disable=DL001 -- fixture: daemon-thread poll loop only
+    time.sleep(0.1)
+
+
+async def clean():
+    await asyncio.sleep(0.5)  # asyncio.sleep is fine
+    _lock.acquire(timeout=1.0)  # timed acquire is fine
+    await asyncio.to_thread(time.sleep, 0.1)  # referenced, not called
+    await asyncio.to_thread(lambda: time.sleep(0.1))  # lambda = off-loop
+
+    def helper():  # nested sync def: not the coroutine's body
+        subprocess.run(["ls"])  # only tier-2 time.sleep applies to sync
+
+    return helper

@@ -1,0 +1,41 @@
+"""DL002 fixture: fire-and-forget tasks without a strong reference."""
+
+import asyncio
+
+_tasks: set = set()
+
+
+async def work():
+    pass
+
+
+async def orphans():
+    asyncio.create_task(work())  # EXPECT: DL002
+    asyncio.ensure_future(work())  # EXPECT: DL002
+    loop = asyncio.get_running_loop()
+    loop.create_task(work())  # EXPECT: DL002
+    asyncio.get_running_loop().create_task(work())  # EXPECT: DL002
+    _ = asyncio.create_task(work())  # EXPECT: DL002
+
+
+async def suppressed_negative():
+    # dynalint: disable=DL002 -- fixture: process-lifetime task, loop
+    # outlives it by construction
+    asyncio.create_task(work())
+
+
+class Holder:
+    def __init__(self):
+        self._task = None
+
+    async def clean(self):
+        # assigned to an attribute: strong reference held
+        self._task = asyncio.create_task(work())
+        # kept in a collection: strong reference held
+        _tasks.add(asyncio.create_task(work()))
+        # local kept and used
+        t = asyncio.create_task(work())
+        t.add_done_callback(_tasks.discard)
+        # done-callback chained directly (the rule's documented out)
+        asyncio.create_task(work()).add_done_callback(print)
+        return t

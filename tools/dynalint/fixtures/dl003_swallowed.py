@@ -1,0 +1,66 @@
+"""DL003 fixture: broad exception handlers that swallow failures."""
+
+import logging
+
+log = logging.getLogger("fixture")
+
+
+def swallows():
+    try:
+        risky()
+    except Exception:  # EXPECT: DL003
+        pass
+
+
+def swallows_bare():
+    try:
+        risky()
+    except:  # noqa: E722  # EXPECT: DL003
+        return None
+
+
+def swallows_in_tuple():
+    try:
+        risky()
+    except (ValueError, Exception):  # EXPECT: DL003
+        return 0
+
+
+def contract_drop():
+    try:
+        risky()
+    # dynalint: disable=DL003 -- fixture: drop-don't-block contract
+    except Exception:
+        pass
+
+
+def logs_it():
+    try:
+        risky()
+    except Exception:
+        log.warning("risky failed", exc_info=True)
+
+
+def reraises():
+    try:
+        risky()
+    except Exception:
+        raise
+
+
+def uses_the_value():
+    try:
+        risky()
+    except Exception as e:
+        return {"error": str(e)}
+
+
+def narrow_is_fine():
+    try:
+        risky()
+    except ValueError:
+        pass  # narrow catches are a deliberate decision, not a dragnet
+
+
+def risky():
+    raise ValueError("boom")

@@ -1,0 +1,54 @@
+"""DL004 fixture: KV page acquire/release pairing."""
+
+
+class SeqHolder:
+    def __init__(self):
+        self.pages = None
+
+
+def leaks_outright(allocator):
+    pages = allocator.alloc_page()  # EXPECT: DL004
+    return 7  # pages neither released nor transferred
+
+
+def released_on_happy_path_only(allocator, model):
+    pages = allocator.take_prefix([1, 2, 3])  # EXPECT: DL004
+    model.forward(pages=None)  # can raise -> pages leak
+    allocator.release(pages)
+
+
+def suppressed_negative(allocator):
+    # dynalint: disable=DL004 -- fixture: allocator is a test double that
+    # reclaims everything in its own teardown
+    pages = allocator.alloc_page()
+    return 7
+
+
+def release_in_finally(allocator, model):
+    pages = allocator.alloc_page()
+    try:
+        model.forward(pages)
+    finally:
+        allocator.release(pages)
+
+
+def release_in_except(allocator, model):
+    pages = allocator.take_prefix([1])
+    try:
+        model.forward(pages)
+    except Exception:
+        allocator.release(pages)
+        raise
+    return pages  # also escapes via return on success
+
+
+def ownership_transferred(allocator):
+    pages = allocator.alloc_page()
+    holder = SeqHolder()
+    holder.pages = pages  # stored into an attribute: transferred
+    return holder
+
+
+def immediate_release(allocator):
+    pages = allocator.alloc_page()
+    allocator.release(pages)  # nothing raise-capable in between

@@ -1,0 +1,64 @@
+"""DL005 fixture: step-thread vs event-loop attribute rebinding."""
+
+import threading
+
+
+class RacyEngine:
+    def __init__(self):
+        self.counter = 0
+        self.status = "idle"
+        self._thread = threading.Thread(target=self._thread_loop)
+
+    def _thread_loop(self):
+        while True:
+            self._step()
+
+    def _step(self):
+        self.counter += 1  # thread-side write (via _thread_loop closure)
+        self.status = "stepping"
+
+    async def generate(self):
+        self.counter = 0  # EXPECT: DL005
+        self.status = "generating"  # EXPECT: DL005
+
+
+class MediatedEngine:
+    def __init__(self):
+        self.counter = 0
+        self._lock = threading.Lock()
+        self._thread = threading.Thread(target=self._thread_loop)
+
+    def _thread_loop(self):
+        with self._lock:
+            self.counter += 1
+
+    async def generate(self):
+        with self._lock:
+            self.counter = 0  # lock-mediated: clean
+
+
+class SuppressedEngine:
+    def __init__(self):
+        self.flag = False
+        self._thread = threading.Thread(target=self._thread_loop)
+
+    def _thread_loop(self):
+        self.flag = True
+
+    async def generate(self):
+        # dynalint: disable=DL005 -- fixture: bool flip, GIL-atomic and
+        # tolerated by the reader
+        self.flag = False
+
+
+class NoThreads:
+    """No Thread(target=...) anywhere: the rule stays out entirely."""
+
+    def __init__(self):
+        self.x = 0
+
+    def poke(self):
+        self.x = 1
+
+    async def agen(self):
+        self.x = 2

@@ -1,0 +1,39 @@
+"""DL006 fixture: fault-site and metric-name catalog conformance.
+
+Scanned with the REAL catalog (tools/dynalint/catalog.py), so the clean
+cases must use real catalogued names.
+"""
+
+FAULTS = None
+metrics_registry = None
+
+
+def known_sites_are_clean():
+    FAULTS.fire_sync("engine.step")
+    return FAULTS.fire("transport.send")
+
+
+def unknown_site():
+    FAULTS.fire_sync("engine.setp")  # EXPECT: DL006  (typo'd site)
+
+
+def dynamic_site(name):
+    FAULTS.fire_sync("trans" + name)  # EXPECT: DL006
+
+
+def suppressed_negative():
+    # dynalint: disable=DL006 -- fixture: experimental site, catalogued
+    # in the next PR
+    FAULTS.fire_sync("engine.experimental")
+
+
+def known_metric_is_clean():
+    return metrics_registry.counter(
+        "http_requests_total", "HTTP requests", ["model"]
+    )
+
+
+def unknown_metric():
+    return metrics_registry.counter(  # EXPECT: DL006
+        "http_request_total", "typo'd: orphans every dashboard", []
+    )

@@ -1,0 +1,692 @@
+"""The dynalint rule set (DL001–DL006).
+
+Each rule encodes an invariant this repo has already paid for in bugs
+(see tools/dynalint/README.md for the incident each rule back-references).
+Rules are pure-AST ``check(ctx) -> list[Finding]`` callables over one file;
+DL006 additionally feeds the runner's cross-file stale-catalog check.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable
+
+from tools.dynalint.core import (
+    Finding,
+    ScanContext,
+    dotted,
+    enclosing_function,
+    parents,
+    qualname,
+)
+
+# --------------------------------------------------------------------------
+# DL001 blocking-call-in-async
+# --------------------------------------------------------------------------
+
+# Calls that park the calling OS thread. Inside ``async def`` they park the
+# event loop itself: every in-flight stream on this process stalls behind
+# them (the TTFT-tail failure mode PR 3 hand-fixed in the engine).
+BLOCKING_CALLS = {
+    "time.sleep": "await asyncio.sleep(...)",
+    "subprocess.run": "await asyncio.create_subprocess_exec(...)",
+    "subprocess.call": "await asyncio.create_subprocess_exec(...)",
+    "subprocess.check_call": "await asyncio.create_subprocess_exec(...)",
+    "subprocess.check_output": "await asyncio.create_subprocess_exec(...)",
+    "os.system": "await asyncio.create_subprocess_shell(...)",
+    "urllib.request.urlopen": "await asyncio.to_thread(urllib.request.urlopen, ...)",
+    "socket.create_connection": "await asyncio.open_connection(...)",
+    "requests.get": "aiohttp / asyncio.to_thread",
+    "requests.post": "aiohttp / asyncio.to_thread",
+    "requests.put": "aiohttp / asyncio.to_thread",
+    "requests.delete": "aiohttp / asyncio.to_thread",
+    "requests.head": "aiohttp / asyncio.to_thread",
+    "requests.request": "aiohttp / asyncio.to_thread",
+}
+
+
+class BlockingCallInAsync:
+    """DL001: blocking call reachable from the event loop.
+
+    Two tiers:
+      * inside ``async def`` — always a finding (the loop stalls);
+      * ``time.sleep`` in a *sync* def of a module that imports asyncio or
+        threading — flagged because sync helpers in async/threaded runtime
+        modules get called from coroutines sooner or later; prove the
+        helper thread-only and suppress with a reason, or convert.
+    """
+
+    id = "DL001"
+    name = "blocking-call-in-async"
+
+    @staticmethod
+    def _normalize(name: str | None) -> str | None:
+        """Canonicalize alias dodges: ``import time as _time`` must not
+        evade the matcher (runtime/audit.py used exactly that spelling)."""
+        if name is None:
+            return None
+        parts = [p.lstrip("_") for p in name.split(".")]
+        return ".".join(parts)
+
+    def check(self, ctx: ScanContext) -> Iterable[Finding]:
+        for node in ctx.nodes:
+            if not isinstance(node, ast.Call):
+                continue
+            fn = enclosing_function(node)
+            in_async = isinstance(fn, ast.AsyncFunctionDef)
+            name = self._normalize(dotted(node.func))
+            if in_async:
+                if name in BLOCKING_CALLS:
+                    yield Finding(
+                        rule=self.id, path=ctx.path,
+                        line=node.lineno, col=node.col_offset,
+                        message=f"blocking call {name}() inside async def "
+                                f"{fn.name!r} stalls the event loop",
+                        hint=BLOCKING_CALLS[name],
+                        context=qualname(node), detail=name,
+                    )
+                elif name == "open":
+                    yield Finding(
+                        rule=self.id, path=ctx.path,
+                        line=node.lineno, col=node.col_offset,
+                        message=f"sync file I/O open() inside async def "
+                                f"{fn.name!r} can stall the event loop",
+                        hint="await asyncio.to_thread(...) for slow/NFS paths, "
+                             "or suppress with a reason for tiny local reads",
+                        context=qualname(node), detail="open",
+                    )
+                elif self._untimed_lock_acquire(node):
+                    yield Finding(
+                        rule=self.id, path=ctx.path,
+                        line=node.lineno, col=node.col_offset,
+                        message="untimed threading Lock.acquire() inside "
+                                f"async def {fn.name!r} can deadlock the loop",
+                        hint="acquire(timeout=...) in a thread, or an "
+                             "asyncio.Lock",
+                        context=qualname(node),
+                        detail=f"acquire:{dotted(node.func)}",
+                    )
+            elif (
+                name == "time.sleep"
+                and ctx.imports_async_runtime
+                and isinstance(fn, ast.FunctionDef)
+            ):
+                yield Finding(
+                    rule=self.id, path=ctx.path,
+                    line=node.lineno, col=node.col_offset,
+                    message=f"time.sleep() in sync def {fn.name!r} of an "
+                            "asyncio module: loop-reachable unless proven "
+                            "thread-only",
+                    hint="convert to async + asyncio.sleep, or suppress "
+                         "with a thread-only reason",
+                    context=qualname(node), detail="time.sleep:sync",
+                )
+
+    @staticmethod
+    def _untimed_lock_acquire(node: ast.Call) -> bool:
+        func = node.func
+        if not (isinstance(func, ast.Attribute) and func.attr == "acquire"):
+            return False
+        recv = dotted(func.value) or ""
+        if "lock" not in recv.lower():
+            return False
+        for kw in node.keywords:
+            if kw.arg in ("timeout", "blocking"):
+                return False
+        return not node.args  # acquire(False) / acquire(timeout) are timed
+
+
+# --------------------------------------------------------------------------
+# DL002 orphaned-task
+# --------------------------------------------------------------------------
+
+_SPAWN_ATTRS = {"create_task", "ensure_future"}
+
+
+def _is_task_spawn(call: ast.Call) -> bool:
+    func = call.func
+    if isinstance(func, ast.Attribute) and func.attr in _SPAWN_ATTRS:
+        return True
+    return isinstance(func, ast.Name) and func.id in _SPAWN_ATTRS
+
+
+class OrphanedTask:
+    """DL002: ``create_task``/``ensure_future`` result dropped.
+
+    The event loop holds only a *weak* reference to tasks: a spawn whose
+    result is discarded can be garbage-collected mid-flight, silently
+    cancelling the work — the exact PR-3 drain-task pitfall. Keep a strong
+    reference (``runtime.context.spawn`` does, plus crash logging) or chain
+    ``.add_done_callback`` directly.
+    """
+
+    id = "DL002"
+    name = "orphaned-task"
+
+    def check(self, ctx: ScanContext) -> Iterable[Finding]:
+        for node in ctx.nodes:
+            call: ast.Call | None = None
+            if (
+                isinstance(node, ast.Expr)
+                and isinstance(node.value, ast.Call)
+                and _is_task_spawn(node.value)
+            ):
+                call = node.value
+            elif (
+                isinstance(node, ast.Assign)
+                and isinstance(node.value, ast.Call)
+                and _is_task_spawn(node.value)
+                and all(
+                    isinstance(t, ast.Name) and t.id == "_"
+                    for t in node.targets
+                )
+            ):
+                call = node.value
+            if call is None:
+                continue
+            coro = ast.unparse(call.args[0]) if call.args else "?"
+            yield Finding(
+                rule=self.id, path=ctx.path,
+                line=node.lineno, col=node.col_offset,
+                message=f"task for {coro!r} has no strong reference: the "
+                        "loop only holds it weakly, so GC can cancel it "
+                        "mid-flight",
+                hint="use dynamo_tpu.runtime.context.spawn(...) (strong ref "
+                     "+ exception logging), or keep the Task yourself",
+                context=qualname(node), detail=coro[:80],
+            )
+
+
+# --------------------------------------------------------------------------
+# DL003 swallowed-exception
+# --------------------------------------------------------------------------
+
+_LOG_METHODS = {"debug", "info", "warning", "error", "exception", "critical"}
+_HOT_PREFIXES = ("dynamo_tpu/runtime/", "dynamo_tpu/engine/",
+                 "dynamo_tpu/frontend/")
+
+
+def _is_broad(handler: ast.ExceptHandler) -> bool:
+    t = handler.type
+    if t is None:
+        return True
+    names = [t] if not isinstance(t, ast.Tuple) else list(t.elts)
+    return any(
+        isinstance(n, ast.Name) and n.id in ("Exception", "BaseException")
+        for n in names
+    )
+
+
+def _handler_reports(handler: ast.ExceptHandler) -> bool:
+    """Does this handler raise, log, or otherwise surface what it caught?"""
+    exc_name = handler.name
+    for node in ast.walk(handler):
+        if isinstance(node, ast.Raise):
+            return True
+        if isinstance(node, ast.Call):
+            d = dotted(node.func) or ""
+            last = d.rsplit(".", 1)[-1]
+            recv = d.rsplit(".", 1)[0] if "." in d else ""
+            if last in _LOG_METHODS and (
+                "log" in recv.lower() or recv == "logging"
+            ):
+                return True
+            if d in ("traceback.print_exc", "traceback.format_exc", "print"):
+                return True
+        if (
+            exc_name
+            and isinstance(node, ast.Name)
+            and node.id == exc_name
+            and isinstance(node.ctx, ast.Load)
+        ):
+            return True  # the exception value is used (mapped/propagated)
+    return False
+
+
+class SwallowedException:
+    """DL003: broad ``except Exception``/bare except that hides the error.
+
+    A handler that neither re-raises, logs, nor uses the caught value turns
+    real failures (KV leak, lost migration, dead stream) into silence. Hot
+    paths (runtime/, engine/, frontend/) must triage every site; elsewhere
+    the committed baseline may grandfather old ones.
+    """
+
+    id = "DL003"
+    name = "swallowed-exception"
+
+    def check(self, ctx: ScanContext) -> Iterable[Finding]:
+        for node in ctx.nodes:
+            if not isinstance(node, ast.ExceptHandler):
+                continue
+            if not _is_broad(node) or _handler_reports(node):
+                continue
+            hot = ctx.path.startswith(_HOT_PREFIXES)
+            where = "hot path: " if hot else ""
+            caught = "bare except" if node.type is None else (
+                f"except {ast.unparse(node.type)}"
+            )
+            yield Finding(
+                rule=self.id, path=ctx.path,
+                line=node.lineno, col=node.col_offset,
+                message=f"{where}{caught} neither re-raises, logs, nor uses "
+                        "the exception — failures vanish silently",
+                hint="re-raise, log with context, map to a typed transport "
+                     "error, or suppress with the contract reason",
+                context=qualname(node),
+                detail=f"{caught}:{qualname(node)}",
+            )
+
+
+# --------------------------------------------------------------------------
+# DL004 resource-pairing
+# --------------------------------------------------------------------------
+
+ACQUIRE_ATTRS = {"alloc_page", "take_prefix", "pull_kv_blocks",
+                 "acquire_pages", "export_kv_blocks"}
+RELEASE_ATTRS = {"release", "free", "release_kv_blocks", "free_blocks",
+                 "release_pages"}
+
+
+def _in_cleanup(node: ast.AST) -> bool:
+    """Is ``node`` inside an except handler or a try/finally finalbody?"""
+    child = node
+    for p in parents(node):
+        if isinstance(p, ast.ExceptHandler):
+            return True
+        if isinstance(p, ast.Try) and any(
+            child is n or any(child is d for d in ast.walk(n))
+            for n in p.finalbody
+        ):
+            return True
+        child = p
+    return False
+
+
+def _name_loads(tree: ast.AST, name: str) -> list[ast.Name]:
+    return [
+        n for n in ast.walk(tree)
+        if isinstance(n, ast.Name) and n.id == name
+        and isinstance(n.ctx, ast.Load)
+    ]
+
+
+class ResourcePairing:
+    """DL004: KV page-pool acquire without a release on every path.
+
+    The PR-3 exported-page leaks were exactly this shape: pages acquired,
+    an error path returned early, and the pool bled until the export TTL.
+    Function-local and deliberately lightweight: an acquired value that
+    *escapes* (returned, yielded, stored into an attribute/container,
+    passed to another function) transfers ownership and is not tracked
+    further; one that stays local must be released, and released on the
+    exception path (finally/except), not just the happy line.
+    """
+
+    id = "DL004"
+    name = "resource-pairing"
+
+    def check(self, ctx: ScanContext) -> Iterable[Finding]:
+        # acquire sites are rare: find them in one pass over the flat node
+        # list, then do the (per-site) function-local trace
+        for node in ctx.nodes:
+            if not isinstance(node, ast.Assign):
+                continue
+            call = node.value
+            if not isinstance(call, ast.Call):
+                continue
+            d = dotted(call.func) or ""
+            attr = d.rsplit(".", 1)[-1]
+            if attr not in ACQUIRE_ATTRS:
+                continue
+            if len(node.targets) != 1 or not isinstance(
+                node.targets[0], ast.Name
+            ):
+                continue  # non-name bindings: treated as escaped
+            fn = enclosing_function(node)
+            if fn is None or isinstance(fn, ast.Lambda):
+                continue
+            var = node.targets[0].id
+            escapes, released, release_safe = self._trace(fn, node, var)
+            if escapes:
+                continue
+            if not released:
+                yield Finding(
+                    rule=self.id, path=ctx.path,
+                    line=node.lineno, col=node.col_offset,
+                    message=f"{attr}() result {var!r} is never released, "
+                            "freed, or transferred — the pool leaks",
+                    hint=f"release {var!r} (finally:) or hand ownership off",
+                    context=qualname(node), detail=f"{attr}:{var}:leak",
+                )
+            elif not release_safe:
+                yield Finding(
+                    rule=self.id, path=ctx.path,
+                    line=node.lineno, col=node.col_offset,
+                    message=f"{attr}() result {var!r} is only released on "
+                            "the happy path — an exception in between "
+                            "leaks it",
+                    hint="move the release into finally: (or release in "
+                         "the except handler before re-raising)",
+                    context=qualname(node),
+                    detail=f"{attr}:{var}:unsafe-release",
+                )
+
+    @staticmethod
+    def _trace(fn, acquire_stmt, var) -> tuple[bool, bool, bool]:
+        """(escapes, released_anywhere, released_on_exception_path)."""
+        escapes = released = release_safe = False
+        for node in ast.walk(fn):
+            if isinstance(node, (ast.Return, ast.Yield, ast.YieldFrom)):
+                val = node.value
+                if val is not None and _name_loads(val, var):
+                    escapes = True
+            elif isinstance(node, ast.Assign):
+                if node is acquire_stmt:
+                    continue
+                if _name_loads(node.value, var) and any(
+                    not isinstance(t, ast.Name) for t in node.targets
+                ):
+                    escapes = True  # stored into attribute/subscript/tuple
+            elif isinstance(node, ast.Call):
+                d = dotted(node.func) or ""
+                attr = d.rsplit(".", 1)[-1]
+                arg_uses = any(
+                    _name_loads(a, var)
+                    for a in list(node.args)
+                    + [kw.value for kw in node.keywords]
+                )
+                if not arg_uses:
+                    # method call ON the var (var.append/…) is fine; a call
+                    # on some receiver path containing var isn't ownership
+                    continue
+                if attr in RELEASE_ATTRS:
+                    released = True
+                    if _in_cleanup(node):
+                        release_safe = True
+                else:
+                    escapes = True  # passed to arbitrary callee: ownership
+                    # ambiguity resolved toward "transferred" (precision
+                    # over recall — this rule must stay quiet when unsure)
+        if released and not release_safe:
+            # a release with nothing raise-capable before it is safe enough:
+            # approximate by "release is the lexically next statement"
+            nxt = ResourcePairing._next_stmt(fn, acquire_stmt)
+            if nxt is not None and any(
+                isinstance(n, ast.Call)
+                and (dotted(n.func) or "").rsplit(".", 1)[-1] in RELEASE_ATTRS
+                and any(_name_loads(a, var) for a in n.args)
+                for n in ast.walk(nxt)
+            ):
+                release_safe = True
+        return escapes, released, release_safe
+
+    @staticmethod
+    def _next_stmt(fn, stmt):
+        for node in ast.walk(fn):
+            body = getattr(node, "body", None)
+            if isinstance(body, list) and stmt in body:
+                i = body.index(stmt)
+                if i + 1 < len(body):
+                    return body[i + 1]
+        return None
+
+
+# --------------------------------------------------------------------------
+# DL005 cross-thread-mutation
+# --------------------------------------------------------------------------
+
+
+class CrossThreadMutation:
+    """DL005: the same ``self.attr`` rebound from both the step thread and
+    coroutine bodies without lock/queue mediation.
+
+    The engine owns the device from a dedicated step thread
+    (``threading.Thread(target=self._thread_loop)``); coroutines run on the
+    event loop. An attribute *rebound* (``self.x = ...`` / ``self.x += 1``)
+    from both worlds is a data race under kill-9 churn — exactly where
+    VERDICT r5 says "step-thread/page-pool races actually live".
+    ``__init__`` writes are construction (happens-before the thread start)
+    and writes under ``with self.<...lock...>:`` count as mediated.
+    Mutating calls on thread-safe objects (``.set()``, ``.put_nowait()``)
+    are intentionally out of scope — rebinding is the hazard this catches.
+    """
+
+    id = "DL005"
+    name = "cross-thread-mutation"
+
+    def check(self, ctx: ScanContext) -> Iterable[Finding]:
+        if "Thread" not in ctx.source:
+            return  # no worker threads here: nothing to race with
+        for node in ctx.nodes:
+            if isinstance(node, ast.ClassDef):
+                yield from self._check_class(ctx, node)
+
+    def _check_class(self, ctx, cls) -> Iterable[Finding]:
+        methods: dict[str, ast.AST] = {}
+        for stmt in cls.body:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                methods[stmt.name] = stmt
+
+        thread_entries = self._thread_targets(cls, methods)
+        if not thread_entries:
+            return
+
+        calls = {
+            name: self._self_calls(node) for name, node in methods.items()
+        }
+        thread_world = self._closure(thread_entries, calls, methods)
+        async_roots = {
+            n for n, m in methods.items()
+            if isinstance(m, ast.AsyncFunctionDef)
+        }
+        async_world = self._closure(async_roots, calls, methods)
+
+        def writes(world: set[str]) -> dict[str, list[tuple[str, ast.AST]]]:
+            out: dict[str, list[tuple[str, ast.AST]]] = {}
+            for name in world:
+                if name == "__init__":
+                    continue
+                for attr, node in self._attr_writes(methods[name]):
+                    out.setdefault(attr, []).append((name, node))
+            return out
+
+        tw, aw = writes(thread_world), writes(async_world)
+        for attr in sorted(set(tw) & set(aw)):
+            a_method, a_node = aw[attr][0]
+            t_method = tw[attr][0][0]
+            yield Finding(
+                rule=self.id, path=ctx.path,
+                line=a_node.lineno, col=a_node.col_offset,
+                message=f"self.{attr} rebound from both the step thread "
+                        f"({t_method}) and a coroutine ({a_method}) with "
+                        "no lock/queue mediation",
+                hint="route one side through a queue/call_soon_threadsafe, "
+                     "guard both with a lock, or make one side read-only",
+                context=f"{cls.name}", detail=attr,
+            )
+
+    @staticmethod
+    def _thread_targets(cls, methods) -> set[str]:
+        """Methods used as ``threading.Thread(target=self.X)`` anywhere in
+        the class (the step/writer threads)."""
+        out: set[str] = set()
+        for node in ast.walk(cls):
+            if not isinstance(node, ast.Call):
+                continue
+            d = dotted(node.func) or ""
+            if d.rsplit(".", 1)[-1] != "Thread":
+                continue
+            for kw in node.keywords:
+                if kw.arg == "target" and isinstance(kw.value, ast.Attribute):
+                    if (
+                        isinstance(kw.value.value, ast.Name)
+                        and kw.value.value.id == "self"
+                        and kw.value.attr in methods
+                    ):
+                        out.add(kw.value.attr)
+        return out
+
+    @staticmethod
+    def _self_calls(method) -> set[str]:
+        return {
+            n.func.attr
+            for n in ast.walk(method)
+            if isinstance(n, ast.Call)
+            and isinstance(n.func, ast.Attribute)
+            and isinstance(n.func.value, ast.Name)
+            and n.func.value.id == "self"
+        }
+
+    @staticmethod
+    def _closure(roots: set[str], calls, methods) -> set[str]:
+        seen = set()
+        frontier = [r for r in roots if r in methods]
+        while frontier:
+            cur = frontier.pop()
+            if cur in seen:
+                continue
+            seen.add(cur)
+            for callee in calls.get(cur, ()):
+                if callee in methods and callee not in seen:
+                    # only sync helpers propagate; an async callee from a
+                    # thread method would be a bug of its own
+                    if not isinstance(methods[callee], ast.AsyncFunctionDef):
+                        frontier.append(callee)
+        return seen
+
+    @staticmethod
+    def _attr_writes(method) -> Iterable[tuple[str, ast.AST]]:
+        for node in ast.walk(method):
+            targets: list[ast.AST] = []
+            if isinstance(node, ast.Assign):
+                targets = node.targets
+            elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+                targets = [node.target]
+            for t in targets:
+                if (
+                    isinstance(t, ast.Attribute)
+                    and isinstance(t.value, ast.Name)
+                    and t.value.id == "self"
+                    and not CrossThreadMutation._under_lock(node)
+                ):
+                    yield t.attr, node
+
+    @staticmethod
+    def _under_lock(node: ast.AST) -> bool:
+        for p in parents(node):
+            if isinstance(p, (ast.With, ast.AsyncWith)):
+                for item in p.items:
+                    src = ""
+                    try:
+                        src = ast.unparse(item.context_expr)
+                    except Exception:  # pragma: no cover - defensive
+                        pass
+                    if "lock" in src.lower():
+                        return True
+        return False
+
+
+# --------------------------------------------------------------------------
+# DL006 fault-site / metric registry
+# --------------------------------------------------------------------------
+
+_FIRE_ATTRS = {"fire", "fire_sync", "check"}
+_METRIC_ATTRS = {"counter", "gauge", "histogram"}
+
+
+class FaultSiteRegistry:
+    """DL006: fault-injection sites and metric names must come from the
+    committed catalog (tools/dynalint/catalog.py).
+
+    A ``FAULTS.fire("typo.site")`` never trips — the chaos schedule that
+    names the real site silently tests nothing, and a replayed
+    ``DYN_FAULTS`` spec stops matching the code it was recorded against.
+    Same for metric names: a renamed counter orphans every dashboard and
+    alert pointing at the old name. The catalog is the reviewable,
+    diffable registry; the runner also warns about *stale* entries no code
+    uses any more.
+    """
+
+    id = "DL006"
+    name = "fault-site-registry"
+
+    def check(self, ctx: ScanContext) -> Iterable[Finding]:
+        fault_sites = set(ctx.catalog.FAULT_SITES)
+        metric_names = set(ctx.catalog.METRIC_NAMES)
+        for node in ctx.nodes:
+            if not isinstance(node, ast.Call):
+                continue
+            func = node.func
+            if not isinstance(func, ast.Attribute):
+                continue
+            recv = dotted(func.value) or ""
+            if func.attr in _FIRE_ATTRS and "faults" in recv.lower():
+                yield from self._check_site(ctx, node, fault_sites)
+            elif func.attr in _METRIC_ATTRS and node.args:
+                yield from self._check_metric(ctx, node, metric_names)
+
+    def _check_site(self, ctx, node, known) -> Iterable[Finding]:
+        if not node.args:
+            return
+        arg = node.args[0]
+        if not (isinstance(arg, ast.Constant) and isinstance(arg.value, str)):
+            yield Finding(
+                rule=self.id, path=ctx.path,
+                line=node.lineno, col=node.col_offset,
+                message="fault site must be a string literal (dynamic site "
+                        "names can't be catalogued or replayed)",
+                hint="inline the site string",
+                context=qualname(node), detail="dynamic-site",
+            )
+            return
+        site = arg.value
+        ctx.used_fault_sites.add(site)
+        if site not in known:
+            yield Finding(
+                rule=self.id, path=ctx.path,
+                line=node.lineno, col=node.col_offset,
+                message=f"fault site {site!r} is not in the documented "
+                        "catalog — chaos schedules naming it silently drift",
+                hint="add it to tools/dynalint/catalog.py FAULT_SITES (and "
+                     "runtime/faults.py KNOWN_SITES) or fix the typo",
+                context=qualname(node), detail=f"site:{site}",
+            )
+
+    def _check_metric(self, ctx, node, known) -> Iterable[Finding]:
+        arg = node.args[0]
+        if not (isinstance(arg, ast.Constant) and isinstance(arg.value, str)):
+            yield Finding(
+                rule=self.id, path=ctx.path,
+                line=node.lineno, col=node.col_offset,
+                message="metric name must be a string literal so dashboards "
+                        "and the catalog can reference it",
+                hint="inline the metric name",
+                context=qualname(node), detail="dynamic-metric",
+            )
+            return
+        name = arg.value
+        ctx.used_metric_names.add(name)
+        if name not in known:
+            yield Finding(
+                rule=self.id, path=ctx.path,
+                line=node.lineno, col=node.col_offset,
+                message=f"metric {name!r} is not registered in the catalog "
+                        "— renames orphan dashboards/alerts silently",
+                hint="add it to tools/dynalint/catalog.py METRIC_NAMES or "
+                     "fix the typo",
+                context=qualname(node), detail=f"metric:{name}",
+            )
+
+
+RULES = {
+    r.id: r
+    for r in (
+        BlockingCallInAsync(),
+        OrphanedTask(),
+        SwallowedException(),
+        ResourcePairing(),
+        CrossThreadMutation(),
+        FaultSiteRegistry(),
+    )
+}
