@@ -37,7 +37,8 @@ async def _amain(args: argparse.Namespace) -> None:
         from dynamo_tpu.grpc import KserveGrpcFrontend
 
         grpc_frontend = await KserveGrpcFrontend(
-            manager, host=args.host, port=args.grpc_port
+            manager, host=args.host, port=args.grpc_port,
+            request_timeout_s=cfg.request_timeout_s,
         ).start()
         print(f"DYNAMO_GRPC={args.host}:{grpc_frontend.port}", flush=True)
     try:

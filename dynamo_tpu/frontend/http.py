@@ -18,7 +18,6 @@ from __future__ import annotations
 import asyncio
 import json
 import logging
-import math
 import time
 from typing import Any
 
@@ -37,6 +36,7 @@ from dynamo_tpu.runtime.context import (
     DeadlineExceeded,
     ServiceUnavailable,
     StreamError,
+    tighten_timeout_s,
 )
 from dynamo_tpu.runtime.metrics import MetricsRegistry
 from dynamo_tpu.runtime.push import NoInstancesError
@@ -163,17 +163,9 @@ class HttpFrontend:
         timeout_s = self.request_timeout_s
         raw = request.headers.get(TIMEOUT_HEADER)
         if raw:
-            try:
-                hdr = float(raw)
-                if math.isfinite(hdr):  # 'nan'/'inf' must not drop the cap
-                    hdr_s = max(hdr / 1000.0, 0.001)
-                    # the header can only tighten the server default; with
-                    # the default disabled (<= 0) it is the sole source
-                    timeout_s = (
-                        min(hdr_s, timeout_s) if timeout_s > 0 else hdr_s
-                    )
-            except ValueError:
-                pass
+            # one shared clamp rule for every serving surface
+            # (runtime/context.py; the gRPC frontend uses the same)
+            timeout_s = tighten_timeout_s(timeout_s, raw)
         deadline = (
             time.monotonic() + timeout_s if timeout_s > 0 else None
         )
@@ -631,12 +623,14 @@ class HttpFrontend:
                     try:
                         async for item in client.call_instance(
                             inst.instance_id, {"op": "clear_kv_blocks"},
-                            Context(),
+                            # bounded admin budget: one wedged worker must
+                            # not hang the whole fan-out (DL008)
+                            Context(deadline=time.monotonic() + 10.0),
                         ):
                             if isinstance(item, dict) and item.get("ok"):
                                 acks += 1
                             break
-                    except StreamError:
+                    except (StreamError, DeadlineExceeded):
                         pass
                 results[f"{ns}/{comp}"] = {"workers_cleared": acks}
             finally:
@@ -663,18 +657,29 @@ class HttpFrontend:
         inputs = body.get("input")
         if isinstance(inputs, str):
             inputs = [inputs]
-        ctx = Context(request_id=new_request_id())
+        # same trace + end-to-end deadline contract as the generation
+        # routes (dynalint DL008: a deadline-less root here left every
+        # embedding fan-out unbounded)
+        ctx = self._traced_context(request)
         data = []
         for i, text in enumerate(inputs):
             token_ids = pipe.preprocessor.tokenizer.encode(text)
             out = None
-            async for item in pipe.generate(
-                {"token_ids": token_ids, "stop_conditions": {"max_tokens": 1},
-                 "embedding_request": True},
-                ctx.child(f"{ctx.id}-{i}"),
-            ):
-                if isinstance(item, dict) and "embedding" in item:
-                    out = item["embedding"]
+            try:
+                async for item in pipe.generate(
+                    {"token_ids": token_ids,
+                     "stop_conditions": {"max_tokens": 1},
+                     "embedding_request": True},
+                    ctx.child(f"{ctx.id}-{i}"),
+                ):
+                    if isinstance(item, dict) and "embedding" in item:
+                        out = item["embedding"]
+            except DeadlineExceeded as e:
+                # the context now carries a deadline: expiry mid-batch is
+                # the 504 contract, same as the generation routes
+                return _error(
+                    504, f"deadline exceeded: {e}", code="deadline_exceeded"
+                )
             if out is None:
                 return _error(502, "worker returned no embedding")
             data.append({"object": "embedding", "index": i, "embedding": out})

@@ -10,19 +10,32 @@ Text-generation tensor convention (kserve.rs:449-556): request input
 ``text_input`` (BYTES) with optional ``streaming`` (BOOL) input and
 sampling parameters in ``parameters`` (max_tokens, temperature, top_p,
 seed, ignore_eos, min_tokens); responses carry ``text_output`` (BYTES).
+
+End-to-end deadlines (dynalint DL008): every inference RPC mints its root
+Context WITH a deadline — the server-wide ``request_timeout_s`` default
+(same DYN_REQUEST_TIMEOUT_S contract as the HTTP frontend), tightened
+per-request by a ``timeout_ms`` entry in ``parameters`` or by the caller's
+own gRPC deadline when that is sooner. DeadlineExceeded maps to
+``DEADLINE_EXCEEDED`` (the 504 of this surface).
 """
 
 from __future__ import annotations
 
 import json
 import logging
+import time
 from typing import Any, AsyncIterator
 
 import grpc
 
 from dynamo_tpu.frontend.protocols import new_request_id
 from dynamo_tpu.grpc import kserve_pb2 as pb
-from dynamo_tpu.runtime.context import Context
+from dynamo_tpu.runtime.context import (
+    Context,
+    DeadlineExceeded,
+    ServiceUnavailable,
+    tighten_timeout_s,
+)
 
 log = logging.getLogger("dynamo.grpc")
 
@@ -98,10 +111,14 @@ def _openai_response(
 class KserveGrpcFrontend:
     """grpc.aio server exposing the ModelManager's pipelines."""
 
-    def __init__(self, manager, *, host: str = "127.0.0.1", port: int = 0):
+    def __init__(
+        self, manager, *, host: str = "127.0.0.1", port: int = 0,
+        request_timeout_s: float = 600.0,  # end-to-end deadline default
+    ):
         self.manager = manager
         self.host = host
         self.port = port
+        self.request_timeout_s = request_timeout_s
         self._server: grpc.aio.Server | None = None
 
     # -- lifecycle ---------------------------------------------------------
@@ -270,6 +287,31 @@ class KserveGrpcFrontend:
         body = {"model": req.model_name, "prompt": text}
         return pipe, self._apply_params(body, params), streaming, "text"
 
+    def _root_context(self, req, grpc_ctx, rid: str) -> Context:
+        """Root Context for one inference RPC, WITH the end-to-end budget:
+        the server default, tightened (never loosened) by a ``timeout_ms``
+        request parameter or the caller's own gRPC deadline."""
+        timeout_s = self.request_timeout_s
+        raw = req.parameters.get("timeout_ms")
+        if raw is not None:
+            # one shared clamp rule for every serving surface
+            # (runtime/context.py; the HTTP frontend uses the same)
+            timeout_s = tighten_timeout_s(timeout_s, _param_value(raw))
+        remaining = None
+        time_remaining = getattr(grpc_ctx, "time_remaining", None)
+        if callable(time_remaining):
+            remaining = time_remaining()
+        if remaining is not None:
+            # an already-expired caller deadline must FAIL FAST, not
+            # disable the budget: clamp to a tiny positive remainder so
+            # admission raises DeadlineExceeded -> DEADLINE_EXCEEDED
+            remaining = max(remaining, 0.001)
+            timeout_s = (
+                min(remaining, timeout_s) if timeout_s > 0 else remaining
+            )
+        deadline = time.monotonic() + timeout_s if timeout_s > 0 else None
+        return Context(request_id=rid, deadline=deadline)
+
     @staticmethod
     def _apply_params(body: dict[str, Any], params: dict) -> dict[str, Any]:
         for key in ("max_tokens", "min_tokens", "top_k", "seed"):
@@ -333,7 +375,7 @@ class KserveGrpcFrontend:
                 "streaming=true requires the ModelStreamInfer RPC",
             )
         rid = req.id or new_request_id()
-        ctx = Context(request_id=rid)
+        ctx = self._root_context(req, grpc_ctx, rid)
         if mode == "openai":
             try:
                 pre = pipe.preprocessor.preprocess(body)
@@ -353,6 +395,14 @@ class KserveGrpcFrontend:
                     agg = await pipe.preprocessor.aggregate_completions(
                         deltas, request_id=rid, prompt_tokens=prompt_tokens,
                     )
+            except DeadlineExceeded as e:
+                await grpc_ctx.abort(
+                    grpc.StatusCode.DEADLINE_EXCEEDED, str(e)
+                )
+            except ServiceUnavailable as e:
+                # draining/saturated worker, retries exhausted: the
+                # retryable status (HTTP 503 equivalent), not UNKNOWN
+                await grpc_ctx.abort(grpc.StatusCode.UNAVAILABLE, str(e))
             finally:
                 ctx.stop_generating()
             return _openai_response(req.model_name, rid, agg, final=True)
@@ -368,6 +418,10 @@ class KserveGrpcFrontend:
                         grpc.StatusCode.INTERNAL,
                         d.get("error") or "generation error",
                     )
+        except DeadlineExceeded as e:
+            await grpc_ctx.abort(grpc.StatusCode.DEADLINE_EXCEEDED, str(e))
+        except ServiceUnavailable as e:
+            await grpc_ctx.abort(grpc.StatusCode.UNAVAILABLE, str(e))
         finally:
             ctx.stop_generating()
         return _text_output_response(
@@ -385,7 +439,7 @@ class KserveGrpcFrontend:
             yield pb.ModelStreamInferResponse(error_message=str(e))
             return
         rid = req.id or new_request_id()
-        ctx = Context(request_id=rid)
+        ctx = self._root_context(req, grpc_ctx, rid)
         streaming = streaming is not False  # stream RPC defaults to True
         if mode == "openai":
             # OpenAI-over-gRPC streaming: one chunk object per response,
@@ -437,6 +491,10 @@ class KserveGrpcFrontend:
                             req.model_name, rid, prev, final=True
                         )
                     )
+            except (DeadlineExceeded, ServiceUnavailable) as e:
+                # mid-stream 504/503: the stream protocol reports via
+                # error_message, mirroring the HTTP SSE error event
+                yield pb.ModelStreamInferResponse(error_message=str(e))
             finally:
                 ctx.stop_generating()
             return
@@ -476,6 +534,8 @@ class KserveGrpcFrontend:
                             token_ids=ids if mode == "tokens" else None,
                         )
                     )
+        except (DeadlineExceeded, ServiceUnavailable) as e:
+            yield pb.ModelStreamInferResponse(error_message=str(e))
         finally:
             # client disconnect mid-stream cancels the backend request
             ctx.stop_generating()

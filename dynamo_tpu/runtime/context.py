@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import math
 import time
 import uuid
 from typing import Any, Coroutine
@@ -79,6 +80,26 @@ class DeadlineExceeded(RuntimeError):
 # send time (relative, so no cross-host clock sync needed) and rebuilt into
 # an absolute monotonic deadline on the receiving side.
 DEADLINE_HEADER = "x-dyn-deadline-ms"
+
+
+def tighten_timeout_s(default_s: float, raw_ms: Any) -> float:
+    """Tighten (never loosen) an end-to-end budget with a client-supplied
+    relative timeout in milliseconds — the one clamp rule shared by every
+    serving surface (HTTP ``x-dyn-timeout-ms``, gRPC ``timeout_ms``), so
+    the DYN_REQUEST_TIMEOUT_S contract can't drift between them.
+
+    Invalid or non-finite input leaves the default; with the default
+    disabled (``<= 0``) the client value is the sole source; the floor is
+    1ms so a zero/negative request fails fast instead of disabling the
+    deadline."""
+    try:
+        ms = float(raw_ms)
+    except (TypeError, ValueError):
+        return default_s
+    if not math.isfinite(ms):  # 'nan'/'inf' must not drop the cap
+        return default_s
+    s = max(ms / 1000.0, 0.001)
+    return min(s, default_s) if default_s > 0 else s
 
 
 def deadline_from_headers(headers: dict[str, str] | None) -> float | None:

@@ -176,6 +176,10 @@ class RemoteHub(Hub):
             return
         if not self._reconnect:
             raise ConnectionError("hub not connected")
+        # dynalint: disable=DL009 -- deliberate: _conn_lock's whole job is
+        # to serialize re-dials — contenders MUST wait for the one
+        # reconnect in flight (a parallel dial would mint a duplicate rx
+        # loop), and the span is bounded by reconnect_window_s
         async with self._conn_lock:
             if self._closed:
                 raise ConnectionError("hub client closed")
@@ -248,6 +252,10 @@ class RemoteHub(Hub):
         mid = next(self._ids)
         fut: asyncio.Future = asyncio.get_running_loop().create_future()
         try:
+            # dynalint: disable=DL009 -- deliberate: per-connection frame
+            # writes MUST serialize (interleaved write_frame calls corrupt
+            # the framing); the await is bounded by socket backpressure,
+            # and a dead peer surfaces as ConnectionError to every waiter
             async with self._write_lock:
                 # snapshot writer+epoch together INSIDE the lock: a
                 # reconnect can land while we awaited the lock, and the
@@ -328,6 +336,9 @@ class RemoteHub(Hub):
         mid = next(self._ids)
         q: asyncio.Queue = asyncio.Queue()
         try:
+            # dynalint: disable=DL009 -- deliberate: same frame-write
+            # serialization contract as _send_request (interleaved frames
+            # corrupt the protocol; bounded by socket backpressure)
             async with self._write_lock:
                 # same epoch-at-send discipline as _send_request
                 writer, epoch = self._writer, self._epoch
@@ -344,6 +355,9 @@ class RemoteHub(Hub):
         self._streams.pop(mid, None)
         if self._connected() and not self._closed:
             try:
+                # dynalint: disable=DL009 -- deliberate: frame-write
+                # serialization (see _send_request); cancel frames ride
+                # the same connection as the calls they cancel
                 async with self._write_lock:
                     await framing.write_frame(
                         self._writer, {"id": next(self._ids), "op": "cancel", "target": mid}

@@ -272,6 +272,14 @@ class ReplicatedHubServer(HubServer):
                 await send({"id": mid, "ok": False, "error": "not_leader",
                             "leader": self.replica.leader_addr})
                 return True
+            # the follower self-identifies so the leader's logs can name
+            # who is tailing (was a stray unread field until dynalint
+            # DL007 flagged it)
+            log.info(
+                "hub replica %s: follower %s syncing from cursor %s",
+                self.replica.advertise, msg.get("follower", "<unknown>"),
+                msg.get("cursor", 0),
+            )
             streams[mid] = asyncio.ensure_future(self._stream_repl(
                 mid, int(msg.get("cursor", 0)), int(msg.get("epoch", -1)),
                 msg.get("boot"), send,

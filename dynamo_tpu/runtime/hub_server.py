@@ -107,6 +107,10 @@ class HubServer:
         self._conns.add(writer)
 
         async def send(msg: dict[str, Any]) -> None:
+            # dynalint: disable=DL009 -- deliberate: response/stream frames
+            # to ONE client must serialize (interleaving corrupts framing);
+            # scope is per-connection, so one slow client only stalls its
+            # own dispatch tasks, never other connections
             async with write_lock:
                 await framing.write_frame(writer, msg)
 

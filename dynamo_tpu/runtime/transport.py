@@ -128,6 +128,9 @@ class EndpointServer:
         self._conns.add(writer)
 
         async def send(msg: dict[str, Any]) -> None:
+            # dynalint: disable=DL009 -- deliberate: frames to one client
+            # connection must serialize (interleaving corrupts framing);
+            # per-connection scope, bounded by that peer's backpressure
             async with write_lock:
                 await framing.write_frame(writer, msg)
 
@@ -293,6 +296,9 @@ class InstanceChannel:
         try:
             if FAULTS.enabled:
                 await FAULTS.fire("transport.send")  # drop -> StreamError
+            # dynalint: disable=DL009 -- deliberate: request frames on one
+            # worker channel must serialize (interleaving corrupts
+            # framing); bounded by that worker's socket backpressure
             async with self._lock:
                 await framing.write_frame(
                     self._writer,
@@ -353,6 +359,8 @@ class InstanceChannel:
     async def _send_cancel(self, req_id: str) -> None:
         if self.connected:
             try:
+                # dynalint: disable=DL009 -- deliberate: cancel frames ride
+                # the same serialized channel as the requests they cancel
                 async with self._lock:
                     await framing.write_frame(
                         self._writer, {"kind": "cancel", "req": req_id}

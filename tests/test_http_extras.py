@@ -71,6 +71,31 @@ async def test_embeddings_route_over_live_engine():
         await drt.close()
 
 
+async def test_embeddings_route_maps_deadline_to_504():
+    """The embeddings root context carries the end-to-end deadline
+    (dynalint DL008); expiry surfaces as the 504 contract, not a 500."""
+    drt, engine, watcher, frontend = await _engine_stack("embeddings")
+    base = f"http://127.0.0.1:{frontend.port}"
+    try:
+        async with aiohttp.ClientSession() as sess:
+            # several inputs: the 1ms budget is certainly spent by a
+            # later item's admission even if the first squeaks through
+            async with sess.post(
+                f"{base}/v1/embeddings",
+                json={"model": "tiny-test",
+                      "input": [f"text {i}" for i in range(8)]},
+                headers={"x-dyn-timeout-ms": "1"},
+            ) as r:
+                assert r.status == 504, await r.text()
+                body = await r.json()
+            assert body["error"]["code"] == "deadline_exceeded"
+    finally:
+        await frontend.stop()
+        await watcher.close()
+        await engine.close()
+        await drt.close()
+
+
 async def test_responses_route():
     drt, engine, watcher, frontend = await _engine_stack()
     base = f"http://127.0.0.1:{frontend.port}"

@@ -25,22 +25,34 @@ sys.path.insert(0, str(REPO_ROOT))  # tools/ is repo-level, not a package dep
 
 from tools.dynalint import baseline as baseline_mod  # noqa: E402
 from tools.dynalint import catalog  # noqa: E402
-from tools.dynalint.core import run_paths, scan_file  # noqa: E402
+from tools.dynalint import wire  # noqa: E402
+from tools.dynalint.core import build_index, run_paths, scan_file  # noqa: E402
 from tools.dynalint.rules import RULES  # noqa: E402
 
 FIXTURES = REPO_ROOT / "tools" / "dynalint" / "fixtures"
 BASELINE = REPO_ROOT / "tools" / "dynalint" / "baseline.json"
+WIRE_SCHEMA = REPO_ROOT / "tools" / "dynalint" / "wire_schema.json"
+PROTOCOL_MD = REPO_ROOT / "docs" / "PROTOCOL.md"
+# the CLI's default scan scope (package + tooling + the cluster helper
+# that speaks the repl.* wire protocol from tests)
+SCAN_SCOPE = [
+    REPO_ROOT / "dynamo_tpu",
+    REPO_ROOT / "tools",
+    REPO_ROOT / "tests" / "hub_cluster.py",
+]
 
 
 # ---------------------------------------------------------------- the gate
 
 
 def test_dynalint_clean_against_baseline_under_5s():
-    """THE gate: scanning all of dynamo_tpu/ yields no findings beyond the
+    """THE gate: scanning the full default scope — including the
+    interprocedural wire-schema/deadline/lock passes and the committed
+    protocol-catalog drift check — yields no findings beyond the
     committed baseline, in under 5 seconds."""
     t0 = time.monotonic()
     findings, _suppressed, _warnings = run_paths(
-        [REPO_ROOT / "dynamo_tpu"], REPO_ROOT
+        SCAN_SCOPE, REPO_ROOT, wire_schema_path=WIRE_SCHEMA
     )
     elapsed = time.monotonic() - t0
     base = baseline_mod.load(BASELINE)
@@ -52,12 +64,24 @@ def test_dynalint_clean_against_baseline_under_5s():
 
 
 def test_baseline_never_grandfathers_dl001_dl002():
-    """DL001/DL002 are fixed outright, never baselined (ISSUE acceptance
-    criterion + baseline.py policy)."""
+    """DL001/DL002/DL007 are fixed outright, never baselined (ISSUE
+    acceptance criterion + baseline.py policy; DL007 because a
+    grandfathered wire-schema drift is a shipped protocol break)."""
+    assert "DL007" in baseline_mod.NEVER_BASELINE
     data = json.loads(BASELINE.read_text())
     bad = [e for e in data["findings"]
            if e["rule"] in baseline_mod.NEVER_BASELINE]
     assert not bad, f"baseline contains banned rules: {bad}"
+
+
+def test_committed_baseline_is_empty():
+    """The satellite contract: every in-tree finding is FIXED or
+    reason-suppressed — the baseline grandfathers nothing."""
+    data = json.loads(BASELINE.read_text())
+    assert data["findings"] == [], (
+        "baseline must stay empty; fix or reason-suppress instead: "
+        f"{data['findings']}"
+    )
 
 
 def test_stale_baseline_entries_are_reported():
@@ -95,17 +119,26 @@ def test_unused_suppression_is_reported(tmp_path):
 
 
 def test_package_has_no_unused_suppressions():
-    """Every in-repo disable still silences a live finding."""
-    _f, _s, warnings = run_paths([REPO_ROOT / "dynamo_tpu"], REPO_ROOT)
+    """Every in-repo disable still silences a live finding (full default
+    scope, since tools/ and the cluster helper are now scanned too)."""
+    _f, _s, warnings = run_paths(SCAN_SCOPE, REPO_ROOT)
     unused = [w for w in warnings if "unused suppression" in w]
     assert not unused, "\n".join(unused)
 
 
 def test_in_repo_suppressions_carry_reasons():
-    """Every ``# dynalint: disable=`` in the package must have a written
-    ``-- reason`` (the satellite contract: suppress WITH a reason)."""
+    """Every ``# dynalint: disable=`` in the scanned scope must have a
+    written ``-- reason`` (the satellite contract: suppress WITH a
+    reason)."""
     offenders = []
-    for f in (REPO_ROOT / "dynamo_tpu").rglob("*.py"):
+    files = [
+        *(REPO_ROOT / "dynamo_tpu").rglob("*.py"),
+        *(REPO_ROOT / "tools").rglob("*.py"),
+        REPO_ROOT / "tests" / "hub_cluster.py",
+    ]
+    for f in files:
+        if "__pycache__" in f.parts:
+            continue
         for i, line in enumerate(f.read_text().splitlines(), 1):
             if "dynalint: disable" in line and "--" not in line:
                 offenders.append(f"{f.relative_to(REPO_ROOT)}:{i}")
@@ -192,6 +225,321 @@ def test_stale_catalog_entry_warns(tmp_path):
     assert not findings
     assert any("ghost.site" in w for w in warnings)
     assert any("ghost_metric_total" in w for w in warnings)
+
+
+# ----------------------------------------------- wire schema (DL007) contract
+
+
+def _extracted_schema() -> dict:
+    index = build_index(SCAN_SCOPE, REPO_ROOT)
+    return wire.extract(index).to_canonical()
+
+
+def test_wire_schema_matches_code_both_directions():
+    """The committed protocol catalog IS the extracted one — drift in
+    either direction (op added/removed/changed in code or hand-edited in
+    the JSON) fails (same two-way contract as DL006)."""
+    extracted = _extracted_schema()
+    committed = json.loads(WIRE_SCHEMA.read_text())
+    assert committed == extracted, (
+        "wire_schema.json drifted from the code; review the protocol "
+        "change, then: python -m tools.dynalint --update-wire-schema "
+        "--emit-protocol\n"
+        + "\n".join(
+            m for _k, m in wire._diff_schema(committed, extracted)
+        )
+    )
+
+
+def test_protocol_md_matches_schema():
+    """docs/PROTOCOL.md is the rendered catalog; a stale doc fails."""
+    committed = json.loads(WIRE_SCHEMA.read_text())
+    assert PROTOCOL_MD.exists(), "docs/PROTOCOL.md missing: run " \
+        "python -m tools.dynalint --emit-protocol"
+    assert PROTOCOL_MD.read_text() == wire.render_protocol_md(committed), (
+        "docs/PROTOCOL.md drifted: python -m tools.dynalint --emit-protocol"
+    )
+
+
+def test_wire_schema_covers_expected_channels():
+    """The catalog documents all three conventions the repo actually
+    speaks (sanity: extraction anchors are alive)."""
+    committed = json.loads(WIRE_SCHEMA.read_text())
+    assert set(committed["channels"]) == {
+        "hub", "worker.admin", "disagg.transfer"
+    }
+    hub_ops = committed["channels"]["hub"]
+    for op in ("put", "watch", "subscribe", "repl.status", "repl.sync"):
+        assert op in hub_ops, f"hub op {op!r} missing from catalog"
+    assert "clear_kv_blocks" in committed["channels"]["worker.admin"]
+    err = committed["transport_err_codes"]
+    assert set(err["emitted"]) == set(err["handled"]) == {
+        "deadline", "unavailable"
+    }
+
+
+def test_missing_dispatcher_anchor_is_a_finding(tmp_path):
+    """A refactor that moves/renames a dispatch function must fail loudly
+    instead of silently extracting an empty server side."""
+    target = tmp_path / "dynamo_tpu" / "runtime"
+    target.mkdir(parents=True)
+    # the anchored file exists but the qualname is gone
+    (target / "hub_server.py").write_text(
+        "class HubServer:\n    def _route(self, op):\n        return None\n"
+    )
+    findings, _s, _w = run_paths([tmp_path / "dynamo_tpu"], tmp_path)
+    assert any(
+        f.rule == "DL007" and "anchor" in f.detail for f in findings
+    ), [f.render() for f in findings]
+
+
+# ----------------------------------------------------------- mutation tests
+
+
+def _scan_mutated(tmp_path, fixture: str, old: str, new: str):
+    src = (FIXTURES / fixture).read_text()
+    assert old in src, f"mutation target {old!r} not in {fixture}"
+    # keep the dynalint/fixtures path marker so the copy gets the same
+    # self-contained-channel treatment as the original
+    fdir = tmp_path / "dynalint" / "fixtures"
+    fdir.mkdir(parents=True, exist_ok=True)
+    mutated = fdir / fixture
+    mutated.write_text(src.replace(old, new))
+    active, suppressed, _ = scan_file(mutated, tmp_path)
+    return active, suppressed
+
+
+def test_mutation_extra_client_field_is_caught(tmp_path):
+    """Synthetic drift: a field added to a clean sender trips DL007."""
+    active, _ = _scan_mutated(
+        tmp_path, "dl007_wire_schema.py",
+        'hub._call("lookup", key="a")\n\n\ndef typoed_op',
+        'hub._call("lookup", key="a", epoch=1)\n\n\ndef typoed_op',
+    )
+    assert any(
+        f.rule == "DL007" and "epoch" in f.detail for f in active
+    ), [f.render() for f in active]
+
+
+def test_mutation_renamed_server_op_is_caught(tmp_path):
+    """Synthetic drift: renaming the server branch orphans every sender
+    of the old op name."""
+    active, _ = _scan_mutated(
+        tmp_path, "dl007_wire_schema.py",
+        'if op == "lookup":', 'if op == "lookup_v2":',
+    )
+    assert any(
+        f.rule == "DL007" and f.detail == "op:hub:lookup" for f in active
+    ), [f.render() for f in active]
+
+
+def test_mutation_dropped_deadline_forward_is_caught(tmp_path):
+    """Synthetic drift: deleting the context argument from a clean
+    forwarding call trips DL008."""
+    active, _ = _scan_mutated(
+        tmp_path, "dl008_deadline.py",
+        "self.engine.generate(request, context):\n"
+        "            yield item\n\n    async def forwards_child",
+        "self.engine.generate(request):\n"
+        "            yield item\n\n    async def forwards_child",
+    )
+    assert any(
+        f.rule == "DL008" and f.detail == "drop:Operator.forwards_is_clean:generate"
+        for f in active
+    ), [f.render() for f in active]
+
+
+def test_mutation_dropped_wire_headers_is_caught(tmp_path):
+    """Synthetic drift: a req frame that stops calling wire_headers()
+    trips DL008's wire-send check."""
+    active, _ = _scan_mutated(
+        tmp_path, "dl008_deadline.py",
+        '"headers": context.wire_headers(),', '"headers": {},',
+    )
+    assert sum(
+        1 for f in active
+        if f.rule == "DL008" and f.detail.startswith("req-headers")
+    ) == 2, [f.render() for f in active]
+
+
+# --------------------------------------------- interprocedural rule details
+
+
+def test_dl008_serving_surface_root_context(tmp_path):
+    """A deadline-less root Context() on a serving surface is flagged;
+    one with deadline= is not (path-scoped: the same code outside the
+    serving surfaces stays silent)."""
+    code = (
+        "import time\n"
+        "Context = None\n"
+        "def handler(request):\n"
+        "    bad = Context(request_id='x')\n"
+        "    good = Context(request_id='x', deadline=time.monotonic())\n"
+        "    return bad, good\n"
+    )
+    surface = tmp_path / "dynamo_tpu" / "grpc"
+    surface.mkdir(parents=True)
+    (surface / "svc.py").write_text(code)
+    elsewhere = tmp_path / "dynamo_tpu" / "runtime"
+    elsewhere.mkdir(parents=True)
+    (elsewhere / "svc.py").write_text(code)
+    findings, _s, _w = run_paths([tmp_path / "dynamo_tpu"], tmp_path)
+    flagged = [f for f in findings if f.rule == "DL008"]
+    assert len(flagged) == 1, [f.render() for f in findings]
+    assert flagged[0].path == "dynamo_tpu/grpc/svc.py"
+    assert flagged[0].line == 4
+
+
+def test_dl009_wire_taint_is_transitive_and_precise(tmp_path):
+    """The call-graph pass: a helper that dials taints its callers, but
+    a name shared with an un-tainted definition does NOT smear (the
+    unanimity rule — queue.put must not look like RemoteHub.put)."""
+    (tmp_path / "mod.py").write_text(
+        "import asyncio\n"
+        "class A:\n"
+        "    async def dial(self):\n"
+        "        await asyncio.open_connection('h', 1)\n"
+        "    async def via(self):\n"
+        "        await self.dial()\n"
+        "    async def locked(self):\n"
+        "        async with self.lock:\n"
+        "            await self.via()\n"
+        "class B:\n"
+        "    async def put(self): ...\n"
+        "class C:\n"
+        "    async def put(self):\n"
+        "        await asyncio.open_connection('h', 1)\n"
+        "    async def locked(self, q):\n"
+        "        async with self.lock:\n"
+        "            await q.put(1)\n"  # ambiguous name: stays quiet
+    )
+    findings, _s, _w = run_paths([tmp_path], tmp_path)
+    dl9 = [f for f in findings if f.rule == "DL009"]
+    assert len(dl9) == 1 and dl9[0].context == "A.locked", (
+        [f.render() for f in dl9]
+    )
+
+
+def test_dl008_unanimity_rule_no_name_smear(tmp_path):
+    """A same-named callee that takes no context must block the
+    bare-name match (same unanimity rule as the wire taint): an
+    unrelated cache.put inside a request-path function stays silent."""
+    (tmp_path / "mod.py").write_text(
+        "class Store:\n"
+        "    async def put(self, key, value, context): ...\n"
+        "class Cache:\n"
+        "    async def put(self, key, value): ...\n"
+        "class Op:\n"
+        "    async def run(self, request, context, cache):\n"
+        "        await cache.put('k', request)\n"  # ambiguous: silent
+    )
+    findings, _s, _w = run_paths([tmp_path], tmp_path)
+    assert not [f for f in findings if f.rule == "DL008"], (
+        [f.render() for f in findings]
+    )
+
+
+def test_dl001_awaited_asyncio_acquire_not_flagged(tmp_path):
+    """``await lock.acquire()`` is an asyncio lock (yields to the loop):
+    DL009's business, never DL001's thread-block finding."""
+    (tmp_path / "mod.py").write_text(
+        "async def f(lock):\n"
+        "    await lock.acquire()\n"
+        "    lock.release()\n"
+    )
+    findings, _s, _w = run_paths([tmp_path], tmp_path)
+    assert not [f for f in findings if f.rule == "DL001"], (
+        [f.render() for f in findings]
+    )
+
+
+def test_dl007_unsent_server_op_warns_not_fails(tmp_path):
+    """Handled-but-never-sent is the warn direction (dead surface), and
+    TOOLING_OPS annotations silence it with a written reason."""
+    fdir = tmp_path / "dynalint" / "fixtures"
+    fdir.mkdir(parents=True)
+    (fdir / "mod.py").write_text(
+        (FIXTURES / "dl007_wire_schema.py").read_text()
+    )
+    # explicit file path (the dir-walk skips fixture dirs) + a dir so the
+    # runner treats this as a project scan and emits cross-file warnings
+    findings, _s, warnings = run_paths(
+        [tmp_path, fdir / "mod.py"], tmp_path
+    )
+    assert any(
+        "op 'evict'" in w and "nothing in scope sends" in w
+        for w in warnings
+    ), warnings
+    assert not any(
+        f.rule == "DL007" and "evict" in f.detail for f in findings
+    )
+
+
+def test_tooling_ops_all_have_reasons():
+    for op, reason in wire.TOOLING_OPS.items():
+        assert reason and len(reason) > 10, f"TOOLING_OPS[{op!r}] needs a reason"
+
+
+# ------------------------------------------------------------ CLI modes
+
+
+def test_cli_github_format():
+    from tools.dynalint.cli import render_github
+    from tools.dynalint.core import Finding
+
+    f = Finding(rule="DL007", path="a/b.py", line=3, col=4,
+                message="op 'x' is sent but unhandled", hint="fix it")
+    line = render_github(f)
+    assert line.startswith("::error file=a/b.py,line=3,col=5,")
+    assert "title=dynalint DL007" in line
+    assert "fix it" in line
+
+
+def test_cli_changed_only_withholds_untouched_files(monkeypatch, capsys):
+    """--changed-only: full-scope scan, report filtered to git-dirty
+    files — per-file findings in untouched files are withheld, but
+    project-level DL007 findings always report (they're attributed to
+    the OTHER side of the drift, which may not be the edited file)."""
+    from tools.dynalint import cli as cli_mod
+
+    monkeypatch.setattr(cli_mod, "changed_files", lambda root: set())
+    # per-file rule findings (DL001 fixture) in an "untouched" file: withheld
+    rc = cli_mod.main([
+        "tools/dynalint/fixtures/dl001_blocking.py",
+        "--no-baseline", "--changed-only", "--no-external",
+    ])
+    out = capsys.readouterr()
+    assert rc == 0, out.out + out.err
+    assert "withheld" in out.err
+    # cross-file DL007 findings bypass the dirty-path filter entirely
+    rc = cli_mod.main([
+        "tools/dynalint/fixtures/dl007_wire_schema.py",
+        "--no-baseline", "--changed-only", "--no-external",
+    ])
+    out = capsys.readouterr()
+    assert rc == 1, "cross-file DL007 findings must not be withheld"
+    assert "DL007" in out.out
+    monkeypatch.setattr(
+        cli_mod, "changed_files",
+        lambda root: {"tools/dynalint/fixtures/dl001_blocking.py"},
+    )
+    rc = cli_mod.main([
+        "tools/dynalint/fixtures/dl001_blocking.py",
+        "--no-baseline", "--changed-only", "--no-external",
+    ])
+    assert rc == 1  # the fixture's findings are in a "changed" file now
+
+
+def test_cli_emit_protocol_roundtrip(tmp_path):
+    """--emit-protocol writes the rendered catalog; output equals the
+    in-process renderer over the committed schema."""
+    from tools.dynalint import cli as cli_mod
+
+    out = tmp_path / "PROTO.md"
+    rc = cli_mod.main(["--emit-protocol", str(out), "--no-external"])
+    assert rc == 0
+    committed = json.loads(WIRE_SCHEMA.read_text())
+    assert out.read_text() == wire.render_protocol_md(committed)
 
 
 # -------------------------------------------------------- entry point + spawn

@@ -15,14 +15,19 @@ Rules (see tools/dynalint/README.md for the full catalog):
     DL004  resource-pairing            KV page alloc without release on all paths
     DL005  cross-thread-mutation       step-thread vs event-loop attr races
     DL006  fault-site/metric-registry  chaos-schedule + metrics name drift
+    DL007  wire-schema-drift           cross-process op/field protocol drift
+    DL008  deadline-taint              request deadline dropped mid-path
+    DL009  lock-across-await           async lock held across wire latency
 
-Suppression: ``# dynalint: disable=DL001 -- reason`` on the offending line
-(or on a comment-only line directly above it). File-wide:
-``# dynalint: disable-file=DL005 -- reason``.
+Suppression: ``dynalint: disable=<RULE> -- <reason>`` in a comment on the
+offending line (or on a comment-only line directly above it); file-wide
+via ``dynalint: disable-file=<RULE> -- <reason>``. (Spelled with the
+placeholders here so this docstring doesn't register as a real
+suppression — dynalint scans its own source.)
 
-Run: ``python -m tools.dynalint [paths...]`` (defaults to ``dynamo_tpu``,
-compared against the committed baseline ``tools/dynalint/baseline.json``;
-new findings always fail).
+Run: ``python -m tools.dynalint [paths...]`` (defaults to ``dynamo_tpu``
++ ``tools`` + ``tests/hub_cluster.py``, compared against the committed
+baseline ``tools/dynalint/baseline.json``; new findings always fail).
 """
 
 from tools.dynalint.core import Finding, run_paths, scan_file  # noqa: F401

@@ -6,8 +6,10 @@ unrelated edits to a file don't churn it. New findings always fail; stale
 entries (fingerprints no current finding produces) are reported so the
 baseline shrinks monotonically — ``--update-baseline`` rewrites it.
 
-Policy (enforced by tests/test_static_analysis.py): DL001 and DL002 may
-NOT be baselined — those classes are fixed outright, never grandfathered.
+Policy (enforced by tests/test_static_analysis.py): DL001, DL002, and
+DL007 may NOT be baselined — blocking-in-async and orphaned tasks are
+fixed outright, and a wire-schema drift that's "grandfathered" is a
+protocol break shipped to production, so DL007 fails immediately too.
 """
 
 from __future__ import annotations
@@ -17,7 +19,7 @@ from pathlib import Path
 
 from tools.dynalint.core import Finding
 
-NEVER_BASELINE = ("DL001", "DL002")
+NEVER_BASELINE = ("DL001", "DL002", "DL007")
 
 
 def load(path: Path) -> dict[str, dict]:

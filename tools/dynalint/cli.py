@@ -2,13 +2,21 @@
 
 ``python -m tools.dynalint`` runs, in order:
 
-  1. the dynalint rule suite (DL001–DL006) against the committed baseline;
+  1. the dynalint rule suite (DL001–DL009, incl. the interprocedural
+     wire-schema/deadline/lock passes) against the committed baseline
+     and the committed wire-protocol catalog (wire_schema.json);
   2. ``ruff check`` with the pyproject config, when ruff is installed;
   3. ``mypy`` (strict on dynamo_tpu/runtime/), when mypy is installed.
 
 Missing external tools are *skipped with a notice*, never a failure — the
 hermetic CI container bakes only the Python toolchain, and the dynalint
 rules themselves are pure stdlib. Exit code 0 = the combined pass is green.
+
+Output modes: default text, ``--format=github`` (GitHub Actions
+annotation lines), ``--json`` (one machine-readable document).
+``--changed-only`` scans the full default scope (the interprocedural
+passes need the whole project) but reports only findings in files your
+git working tree touches — the pre-commit sweet spot.
 """
 
 from __future__ import annotations
@@ -22,11 +30,66 @@ import time
 from pathlib import Path
 
 from tools.dynalint import baseline as baseline_mod
-from tools.dynalint.core import run_paths
-from tools.dynalint.rules import RULES
+from tools.dynalint import wire
+from tools.dynalint.core import build_index, run_paths
+from tools.dynalint.rules import PROJECT_RULES, RULES
 
 REPO_ROOT = Path(__file__).resolve().parents[2]
 DEFAULT_BASELINE = Path(__file__).resolve().parent / "baseline.json"
+# the default scan scope: the package, the tooling (dynalint checks
+# itself), and the shared cluster test helper (it spawns replica
+# subprocesses and speaks the repl.* wire protocol too)
+DEFAULT_PATHS = ["dynamo_tpu", "tools", "tests/hub_cluster.py"]
+DEFAULT_PROTOCOL_MD = "docs/PROTOCOL.md"
+
+
+def changed_files(root: Path) -> set[str] | None:
+    """Repo-relative paths the git working tree touches (staged,
+    unstaged, and untracked), or None when git is unavailable."""
+    try:
+        # -uall: a brand-new directory must list its files individually
+        # (plain porcelain collapses them to "?? dir/", which would
+        # silently withhold every finding inside it)
+        proc = subprocess.run(
+            ["git", "status", "--porcelain", "-uall"],
+            cwd=root, capture_output=True, text=True, timeout=30,
+        )
+    except (OSError, subprocess.TimeoutExpired):
+        return None
+    if proc.returncode != 0:
+        return None
+    out: set[str] = set()
+    for line in proc.stdout.splitlines():
+        if len(line) < 4:
+            continue
+        path = line[3:].strip()
+        if " -> " in path:  # rename: report the new side
+            path = path.split(" -> ", 1)[1]
+        if path.startswith('"') and path.endswith('"'):
+            # core.quotePath: non-ASCII names arrive C-style quoted with
+            # octal escapes; left undecoded they'd never match a
+            # Finding.path and the finding would be silently withheld
+            try:
+                path = (
+                    path[1:-1].encode("latin-1")
+                    .decode("unicode_escape")
+                    .encode("latin-1").decode("utf-8")
+                )
+            except (UnicodeDecodeError, UnicodeEncodeError):
+                path = path.strip('"')
+        out.add(path)
+    return out
+
+
+def render_github(f) -> str:
+    """GitHub Actions workflow-command annotation line."""
+    msg = f.message + (f"  [fix: {f.hint}]" if f.hint else "")
+    # workflow-command data must stay one line
+    msg = msg.replace("%", "%25").replace("\n", " ")
+    return (
+        f"::error file={f.path},line={f.line},col={f.col + 1},"
+        f"title=dynalint {f.rule}::{msg}"
+    )
 
 
 def _run_external(name: str, argv: list[str]) -> int | None:
@@ -46,19 +109,35 @@ def main(argv: list[str] | None = None) -> int:
         prog="python -m tools.dynalint",
         description="Project-specific static analysis for dynamo-tpu.",
     )
-    ap.add_argument("paths", nargs="*", default=["dynamo_tpu"],
-                    help="files/dirs to scan (default: dynamo_tpu)")
+    ap.add_argument("paths", nargs="*", default=DEFAULT_PATHS,
+                    help=f"files/dirs to scan (default: "
+                         f"{' '.join(DEFAULT_PATHS)})")
     ap.add_argument("--baseline", default=str(DEFAULT_BASELINE),
                     help="baseline JSON (default: committed baseline)")
     ap.add_argument("--no-baseline", action="store_true",
                     help="report every finding, ignore the baseline")
     ap.add_argument("--update-baseline", action="store_true",
                     help="rewrite the baseline from current findings "
-                         "(DL001/DL002 are never baselined)")
+                         "(DL001/DL002/DL007 are never baselined)")
     ap.add_argument("--rules", default="",
                     help="comma-separated rule subset (e.g. DL001,DL004)")
     ap.add_argument("--json", action="store_true", dest="as_json",
                     help="machine-readable findings on stdout")
+    ap.add_argument("--format", default="text",
+                    choices=["text", "github"],
+                    help="finding output format: text (default) or "
+                         "github (Actions ::error annotations)")
+    ap.add_argument("--changed-only", action="store_true",
+                    help="scan the full scope (interprocedural passes "
+                         "need it) but report only findings in files the "
+                         "git working tree touches")
+    ap.add_argument("--update-wire-schema", action="store_true",
+                    help="rewrite tools/dynalint/wire_schema.json from "
+                         "the extracted protocol")
+    ap.add_argument("--emit-protocol", nargs="?", const=DEFAULT_PROTOCOL_MD,
+                    default=None, metavar="PATH",
+                    help="render the wire schema to a human-readable "
+                         f"markdown catalog (default {DEFAULT_PROTOCOL_MD})")
     ap.add_argument("--list-rules", action="store_true")
     ap.add_argument("--no-external", action="store_true",
                     help="skip ruff/mypy even when installed")
@@ -82,6 +161,12 @@ def main(argv: list[str] | None = None) -> int:
             return 2
 
     t0 = time.monotonic()
+    # resolve before comparing: "dynamo_tpu/" or a reordered spelling of
+    # the default scope must not silently disable the (never-
+    # baselineable) wire-schema drift check
+    full_scope = {
+        (REPO_ROOT / p).resolve() for p in args.paths
+    } == {(REPO_ROOT / p).resolve() for p in DEFAULT_PATHS}
     paths = [
         p if p.is_absolute() else REPO_ROOT / p
         for p in (Path(p) for p in args.paths)
@@ -90,10 +175,61 @@ def main(argv: list[str] | None = None) -> int:
     if missing:
         print(f"dynalint: no such path: {missing[0]}", file=sys.stderr)
         return 2
-    findings, suppressed, warnings = run_paths(paths, REPO_ROOT, rules=rules)
+
+    if args.update_wire_schema or args.emit_protocol is not None:
+        # catalog maintenance mode: extract over the FULL default scope
+        # (a partial extraction would record a partial protocol) and
+        # write; the gate run stays separate
+        index = build_index(
+            [REPO_ROOT / p for p in DEFAULT_PATHS], REPO_ROOT
+        )
+        canonical = wire.extract(index).to_canonical()
+        if args.update_wire_schema:
+            wire.save_schema(index, wire.SCHEMA_PATH)
+            n_ops = sum(len(v) for v in canonical["channels"].values())
+            print(f"dynalint: wire schema rewritten ({n_ops} ops across "
+                  f"{len(canonical['channels'])} channels) -> "
+                  f"{wire.SCHEMA_PATH}", file=sys.stderr)
+        if args.emit_protocol is not None:
+            out = Path(args.emit_protocol)
+            if not out.is_absolute():
+                out = REPO_ROOT / out
+            out.parent.mkdir(parents=True, exist_ok=True)
+            out.write_text(wire.render_protocol_md(canonical))
+            print(f"dynalint: protocol catalog rendered -> {out}",
+                  file=sys.stderr)
+        return 0
+
+    findings, suppressed, warnings = run_paths(
+        paths, REPO_ROOT, rules=rules,
+        # the committed-catalog drift check needs the full picture: a
+        # partial scan would see "missing" ops that are merely out of
+        # scope
+        wire_schema_path=wire.SCHEMA_PATH if full_scope else None,
+    )
 
     base = {} if args.no_baseline else baseline_mod.load(Path(args.baseline))
     new, grandfathered, stale = baseline_mod.split(findings, base)
+
+    if args.changed_only:
+        changed = changed_files(REPO_ROOT)
+        if changed is None:
+            print("dynalint: --changed-only needs git; reporting all "
+                  "findings", file=sys.stderr)
+        else:
+            before = len(new)
+            # project-level rules (DL007) attribute findings to the
+            # OTHER side of the drift — the sender file or the committed
+            # catalog — which may not be the file that was edited;
+            # withholding those would let a protocol break commit
+            new = [
+                f for f in new
+                if f.path in changed or f.rule in PROJECT_RULES
+            ]
+            if before != len(new):
+                print(f"dynalint: --changed-only: {before - len(new)} "
+                      "finding(s) in untouched files withheld",
+                      file=sys.stderr)
 
     if args.update_baseline:
         baseline_mod.save(Path(args.baseline), findings)
@@ -113,7 +249,8 @@ def main(argv: list[str] | None = None) -> int:
         }, indent=2))
     else:
         for f in new:
-            print(f.render())
+            print(render_github(f) if args.format == "github"
+                  else f.render())
         if args.show_suppressed:
             for f in suppressed:
                 print(f"[suppressed] {f.render()}")

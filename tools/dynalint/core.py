@@ -20,6 +20,16 @@ SUPPRESS_RE = re.compile(
 )
 ALL = "ALL"
 
+# Calls that put bytes on (or take bytes off) a wire. The seed set for the
+# project-wide wire-taint closure (ProjectIndex): any project function that
+# transitively reaches one of these is "wire-tagged" — DL009 refuses to let
+# an async lock span await it, and DL007 anchors frame extraction on the
+# write_frame sites.
+WIRE_PRIMITIVES = frozenset({
+    "write_frame", "read_frame", "open_connection", "open_unix_connection",
+    "create_connection", "drain",
+})
+
 
 @dataclass
 class Finding:
@@ -60,7 +70,9 @@ class _Suppression:
 
 @dataclass
 class Suppressions:
-    """Per-file suppression map parsed from ``# dynalint: disable=...``."""
+    """Per-file suppression map parsed from the ``disable=`` directives
+    (SUPPRESS_RE above; spelled indirectly here so this docstring isn't
+    itself parsed as one)."""
 
     entries: list[_Suppression] = field(default_factory=list)
     file_wide: dict[str, int] = field(default_factory=dict)  # rule -> line
@@ -188,6 +200,189 @@ def dotted(node: ast.AST) -> str | None:
     return None
 
 
+class FunctionInfo:
+    """One function/method definition in the project symbol table."""
+
+    __slots__ = (
+        "path", "qualname", "node", "is_async", "params", "cls",
+        "calls", "has_request_context",
+    )
+
+    def __init__(self, path: str, qual: str, node, cls: str | None):
+        self.path = path
+        self.qualname = qual
+        self.node = node
+        self.is_async = isinstance(node, ast.AsyncFunctionDef)
+        args = node.args
+        self.params = tuple(
+            a.arg for a in (*args.posonlyargs, *args.args, *args.kwonlyargs)
+        )
+        self.cls = cls
+        # calls made DIRECTLY by this function (nested defs excluded: their
+        # bodies only run when the nested function itself is called)
+        self.calls: list[tuple[str, ast.Call]] = []
+        self.has_request_context = any(
+            _is_request_context_param(a)
+            for a in (*args.posonlyargs, *args.args, *args.kwonlyargs)
+        )
+
+    @property
+    def name(self) -> str:
+        return self.qualname.rsplit(".", 1)[-1]
+
+
+def _is_request_context_param(arg: ast.arg) -> bool:
+    """A parameter that carries the per-request Context (and therefore the
+    request deadline). Matched by the repo convention: named ``context``,
+    or annotated ``Context`` (``ctx: Context``) — a bare ``ctx`` without
+    annotation is NOT assumed (dynalint's own ScanContext convention)."""
+    if arg.arg == "context":
+        return True
+    ann = arg.annotation
+    if ann is None:
+        return False
+    name = dotted(ann) or (
+        ann.value if isinstance(ann, ast.Constant)
+        and isinstance(ann.value, str) else ""
+    )
+    return (name or "").rsplit(".", 1)[-1] == "Context"
+
+
+class ProjectIndex:
+    """Project-wide symbol table + call graph, built once per scan.
+
+    The interprocedural substrate under DL007/DL008/DL009: which functions
+    exist, what each one calls, which ones transitively reach a wire
+    primitive, and which ones accept a per-request Context. Pure AST —
+    method resolution is name-based with a precision bias (self-calls
+    resolve within the class; free calls resolve only when every project
+    definition of that name agrees)."""
+
+    def __init__(self) -> None:
+        self.functions: dict[tuple[str, str], FunctionInfo] = {}
+        self.by_name: dict[str, list[FunctionInfo]] = {}
+        self.contexts: list["ScanContext"] = []
+        self._wire_tainted: set[tuple[str, str]] = set()
+        self.context_callee_names: set[str] = set()
+
+    def add_file(self, ctx: "ScanContext") -> None:
+        self.contexts.append(ctx)
+        # one pass over the pre-built flat node list (NOT a walk per
+        # function — the <5s tier-1 budget is real): defs register, calls
+        # attach to their nearest enclosing def
+        by_node: dict[ast.AST, FunctionInfo] = {}
+        for node in ctx.nodes:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                qual = qualname(node)
+                cls = None
+                for p in parents(node):
+                    if isinstance(p, ast.ClassDef):
+                        cls = p.name
+                        break
+                    if isinstance(p, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                        break
+                info = FunctionInfo(ctx.path, qual, node, cls)
+                by_node[node] = info
+                self.functions[(ctx.path, qual)] = info
+            elif isinstance(node, ast.Call):
+                fn = enclosing_function(node)
+                while isinstance(fn, ast.Lambda):
+                    fn = enclosing_function(fn)
+                info = by_node.get(fn)
+                if info is not None:
+                    name = dotted(node.func)
+                    if name:
+                        info.calls.append((name, node))
+
+    def finalize(self) -> None:
+        self.by_name.clear()
+        for info in self.functions.values():
+            self.by_name.setdefault(info.name, []).append(info)
+        self.context_callee_names = {
+            info.name
+            for info in self.functions.values()
+            if info.has_request_context and not info.name.startswith("__")
+        }
+        self._compute_wire_taint()
+
+    # -- wire taint ---------------------------------------------------------
+
+    def _resolve(self, caller: FunctionInfo, name: str) -> list[FunctionInfo]:
+        """Best-effort callee resolution for ``name`` as called from
+        ``caller``. Exactly ``self.X`` resolves within the caller's class
+        (``self.other.X`` is some OTHER object's method — falling through
+        to the bare-name candidates); otherwise all project definitions
+        of the bare name are returned."""
+        last = name.rsplit(".", 1)[-1]
+        if name == f"self.{last}" and caller.cls:
+            hit = self.functions.get((caller.path, f"{caller.cls}.{last}"))
+            if hit is not None:
+                return [hit]
+        return self.by_name.get(last, [])
+
+    def context_accepting(
+        self, caller: FunctionInfo, name: str
+    ) -> bool:
+        """Does calling ``name`` from ``caller`` reach a context-accepting
+        callee? Same unanimity rule as the wire taint: a bare name only
+        counts when EVERY project definition of it takes a request
+        context — ``cache.put`` must not smear just because some other
+        ``put`` somewhere accepts one."""
+        cands = self._resolve(caller, name)
+        return bool(cands) and all(c.has_request_context for c in cands)
+
+    def _compute_wire_taint(self) -> None:
+        tainted = self._wire_tainted
+        tainted.clear()
+        for key, info in self.functions.items():
+            if any(
+                n.rsplit(".", 1)[-1] in WIRE_PRIMITIVES
+                for n, _ in info.calls
+            ):
+                tainted.add(key)
+        changed = True
+        while changed:
+            changed = False
+            for key, info in self.functions.items():
+                if key in tainted:
+                    continue
+                for name, _ in info.calls:
+                    cands = self._resolve(info, name)
+                    # unanimity rule (precision over recall): only taint
+                    # through a bare name when EVERY definition of it is
+                    # tainted — InMemoryHub.put must not smear RemoteHub
+                    # taint onto queue.put
+                    if cands and all(
+                        (c.path, c.qualname) in tainted for c in cands
+                    ):
+                        tainted.add(key)
+                        changed = True
+                        break
+
+    def is_wire_call(
+        self, caller: FunctionInfo | None, name: str
+    ) -> bool:
+        """Does calling ``name`` (dotted) from ``caller`` reach the wire?"""
+        if name.rsplit(".", 1)[-1] in WIRE_PRIMITIVES:
+            return True
+        if caller is None:
+            return False
+        cands = self._resolve(caller, name)
+        return bool(cands) and all(
+            (c.path, c.qualname) in self._wire_tainted for c in cands
+        )
+
+    def function_at(self, path: str, node: ast.AST) -> FunctionInfo | None:
+        fn = node if isinstance(
+            node, (ast.FunctionDef, ast.AsyncFunctionDef)
+        ) else enclosing_function(node)
+        while isinstance(fn, ast.Lambda):
+            fn = enclosing_function(fn)
+        if fn is None:
+            return None
+        return self.functions.get((path, qualname(fn)))
+
+
 class ScanContext:
     """Everything one rule invocation gets to look at for one file."""
 
@@ -228,18 +423,16 @@ class ScanContext:
         self.used_metric_names: set[str] = set()
         # per-file notices the runner surfaces (unused suppressions)
         self.warnings: list[str] = []
+        # the project-wide symbol table / call graph; set by the runner
+        # before any rule runs (single-file scans get a one-file index)
+        self.project: ProjectIndex | None = None
 
 
-def scan_file(
-    path: Path,
-    root: Path,
-    rules=None,
-    catalog=None,
-) -> tuple[list[Finding], list[Finding], ScanContext | None]:
-    """Scan one file. Returns (active findings, suppressed findings, ctx);
-    ctx is None when the file failed to parse (which is itself a finding)."""
-    from tools.dynalint.rules import RULES
-
+def _parse_file(
+    path: Path, root: Path, catalog=None
+) -> tuple[ScanContext | None, Suppressions | None, Finding | None]:
+    """Parse one file into a ScanContext (+its suppressions), or a DL000
+    syntax-error finding."""
     rel = path.resolve().relative_to(root.resolve()).as_posix()
     source = path.read_text(encoding="utf-8", errors="replace")
     try:
@@ -253,25 +446,77 @@ def scan_file(
             message=f"syntax error: {e.msg}",
             detail="syntax-error",
         )
-        return [f], [], None
+        return None, None, f
     ctx = ScanContext(tree, source, rel, catalog=catalog)
-    sup = parse_suppressions(source)
+    return ctx, parse_suppressions(source), None
+
+
+def _run_rules(
+    ctxs: list[tuple[ScanContext, Suppressions]],
+    project: ProjectIndex,
+    rules=None,
+) -> tuple[list[Finding], list[Finding]]:
+    """Run per-file rules over every ctx, then the project-level rules
+    over the whole index; route each finding through its own file's
+    suppressions."""
+    from tools.dynalint.rules import PROJECT_RULES, RULES
+
+    sups = {ctx.path: sup for ctx, sup in ctxs}
     active: list[Finding] = []
     suppressed: list[Finding] = []
-    for rule_id, rule in RULES.items():
+
+    def route(finding: Finding) -> None:
+        sup = sups.get(finding.path)
+        if sup is not None and sup.covers(finding):
+            suppressed.append(finding)
+        else:
+            active.append(finding)
+
+    for ctx, _sup in ctxs:
+        ctx.project = project
+        for rule_id, rule in RULES.items():
+            if rules is not None and rule_id not in rules:
+                continue
+            if rule_id in PROJECT_RULES:
+                continue  # runs once over the index, below
+            for finding in rule.check(ctx):
+                route(finding)
+    for rule_id in PROJECT_RULES:
         if rules is not None and rule_id not in rules:
             continue
-        for finding in rule.check(ctx):
-            (suppressed if sup.covers(finding) else active).append(finding)
+        rule = RULES[rule_id]
+        for finding in rule.check_project(project):
+            route(finding)
     if rules is None:
         # only meaningful under the full rule set: a DL004 disable looks
         # "unused" when DL004 wasn't run
-        for line, rule_id in sup.unused():
-            ctx.warnings.append(
-                f"{rel}:{line}: unused suppression for {rule_id} — the "
-                "finding is gone; remove the disable before it masks a "
-                "new one"
-            )
+        for ctx, sup in ctxs:
+            for line, rule_id in sup.unused():
+                ctx.warnings.append(
+                    f"{ctx.path}:{line}: unused suppression for {rule_id} "
+                    "— the finding is gone; remove the disable before it "
+                    "masks a new one"
+                )
+    return active, suppressed
+
+
+def scan_file(
+    path: Path,
+    root: Path,
+    rules=None,
+    catalog=None,
+) -> tuple[list[Finding], list[Finding], ScanContext | None]:
+    """Scan one file standalone (fixtures, ad-hoc checks). Project-level
+    rules run over a one-file index, so a self-contained fixture can pin
+    DL007 behavior. Returns (active, suppressed, ctx); ctx is None when
+    the file failed to parse (which is itself a finding)."""
+    ctx, sup, err = _parse_file(path, root, catalog=catalog)
+    if err is not None:
+        return [err], [], None
+    project = ProjectIndex()
+    project.add_file(ctx)
+    project.finalize()
+    active, suppressed = _run_rules([(ctx, sup)], project, rules=rules)
     return active, suppressed, ctx
 
 
@@ -283,7 +528,23 @@ def iter_python_files(paths: list[Path]) -> Iterable[Path]:
             for f in sorted(p.rglob("*.py")):
                 if "__pycache__" in f.parts:
                     continue
+                if "dynalint" in f.parts and "fixtures" in f.parts:
+                    # the golden fixtures are findings BY DESIGN; scanning
+                    # tools/ must not turn them into gate failures
+                    continue
                 yield f
+
+
+def build_index(paths: list[Path], root: Path, catalog=None) -> ProjectIndex:
+    """Parse ``paths`` into a finalized ProjectIndex without running any
+    rules (wire-schema extraction / --emit-protocol)."""
+    project = ProjectIndex()
+    for path in iter_python_files(paths):
+        ctx, _sup, err = _parse_file(path, root, catalog=catalog)
+        if err is None:
+            project.add_file(ctx)
+    project.finalize()
+    return project
 
 
 def run_paths(
@@ -291,40 +552,63 @@ def run_paths(
     root: Path,
     rules=None,
     catalog=None,
+    wire_schema_path: Path | None = None,
 ) -> tuple[list[Finding], list[Finding], list[str]]:
     """Scan all files under ``paths``. Returns (findings, suppressed,
     cross-file warnings). Warnings cover catalog drift in the *stale*
-    direction — a catalogued fault site or metric name that no code uses —
-    which can't be attributed to any single file."""
+    direction — a catalogued fault site, metric name, or wire op that no
+    code uses — which can't be attributed to any single file.
+
+    ``wire_schema_path``: when set (the CLI passes it for default-scope
+    scans), the extracted wire schema is additionally diffed against this
+    committed catalog in both directions (DL007)."""
+    ctxs: list[tuple[ScanContext, Suppressions]] = []
     findings: list[Finding] = []
-    suppressed: list[Finding] = []
-    used_sites: set[str] = set()
-    used_metrics: set[str] = set()
-    warnings: list[str] = []
+    project = ProjectIndex()
     for path in iter_python_files(paths):
-        active, supp, ctx = scan_file(path, root, rules=rules, catalog=catalog)
-        findings.extend(active)
-        suppressed.extend(supp)
-        if ctx is not None:
-            used_sites |= ctx.used_fault_sites
-            used_metrics |= ctx.used_metric_names
-            warnings.extend(ctx.warnings)
+        ctx, sup, err = _parse_file(path, root, catalog=catalog)
+        if err is not None:
+            findings.append(err)
+            continue
+        ctxs.append((ctx, sup))
+        project.add_file(ctx)
+    project.finalize()
+    active, suppressed = _run_rules(ctxs, project, rules=rules)
+    findings.extend(active)
+    warnings: list[str] = []
+    for ctx, _sup in ctxs:
+        warnings.extend(ctx.warnings)
     if catalog is None:
         from tools.dynalint import catalog as catalog_mod
 
         catalog = catalog_mod
     # stale-catalog detection only makes sense over a whole tree: a
     # single-file scan trivially "doesn't use" almost every entry
-    if any(p.is_dir() for p in paths) and (rules is None or "DL006" in rules):
-        for site in sorted(set(catalog.FAULT_SITES) - used_sites):
-            warnings.append(
-                f"catalog: fault site {site!r} is documented but no "
-                f"faults.fire()/fire_sync() call uses it (stale catalog entry?)"
-            )
-        for name in sorted(set(catalog.METRIC_NAMES) - used_metrics):
-            warnings.append(
-                f"catalog: metric {name!r} is documented but never "
-                f"registered (stale catalog entry?)"
-            )
+    if any(p.is_dir() for p in paths):
+        used_sites: set[str] = set()
+        used_metrics: set[str] = set()
+        for ctx, _sup in ctxs:
+            used_sites |= ctx.used_fault_sites
+            used_metrics |= ctx.used_metric_names
+        if rules is None or "DL006" in rules:
+            for site in sorted(set(catalog.FAULT_SITES) - used_sites):
+                warnings.append(
+                    f"catalog: fault site {site!r} is documented but no "
+                    f"faults.fire()/fire_sync() call uses it "
+                    f"(stale catalog entry?)"
+                )
+            for name in sorted(set(catalog.METRIC_NAMES) - used_metrics):
+                warnings.append(
+                    f"catalog: metric {name!r} is documented but never "
+                    f"registered (stale catalog entry?)"
+                )
+        if rules is None or "DL007" in rules:
+            from tools.dynalint import wire
+
+            warnings.extend(wire.unsent_op_warnings(project))
+            if wire_schema_path is not None:
+                findings.extend(
+                    wire.schema_drift_findings(project, wire_schema_path)
+                )
     findings.sort(key=lambda f: (f.path, f.line, f.rule))
     return findings, suppressed, warnings

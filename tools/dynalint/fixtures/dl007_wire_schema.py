@@ -1,0 +1,58 @@
+"""DL007 fixture: cross-process wire-schema drift.
+
+Self-contained protocol: a client half (``hub._call`` senders + one err
+emitter) and a server half (a dispatch chain + one err-code handler) in
+ONE file — fixture files join the "hub" channel, so sender/handler
+matching works on a single-file scan exactly like the real
+hub_client/hub_server pair does project-wide.
+"""
+
+hub = None
+
+
+def lookup_is_clean():
+    # op handled below, field read by the branch: silent
+    return hub._call("lookup", key="a")
+
+
+def typoed_op():
+    return hub._call("lokup", key="a")  # EXPECT: DL007
+
+
+def stray_field():
+    return hub._call("lookup", key="a", shard=0)  # EXPECT: DL007
+
+
+def suppressed_negative():
+    # dynalint: disable=DL007 -- fixture: next-PR op; the server branch
+    # lands together with the feature flag
+    return hub._call("experimental", key="a")
+
+
+def emit_known_err(req_id):
+    # code mapped by handle_codes below: silent
+    return {"kind": "err", "req": req_id, "code": "unavailable"}
+
+
+def emit_unmapped_err(req_id):
+    return {"kind": "err", "req": req_id, "code": "throttled"}  # EXPECT: DL007
+
+
+def handle_codes(frame):
+    code = frame.get("code")
+    if code == "unavailable":
+        return True
+    return False
+
+
+async def _dispatch(msg, send):
+    op = msg.get("op")
+    if op == "lookup":
+        await send({"id": msg.get("id"), "ok": True, "result": msg["key"]})
+        return
+    if op == "evict":
+        # handled-but-never-sent: surfaces as a runner WARNING on
+        # project scans, never a finding
+        await send({"id": msg.get("id"), "ok": True, "result": msg["key"]})
+        return
+    raise ValueError(f"unknown op {op!r}")

@@ -1,0 +1,59 @@
+"""DL008 fixture: deadline-taint — request contexts that stop flowing.
+
+``_Engine.generate`` takes a ``context`` parameter, which (via the
+project symbol table) makes every ``.generate(...)`` call site a
+deadline-accepting callee: callers holding a request context must
+forward it (or a ``.child()``), and ``{"kind": "req"}`` frames must ship
+``context.wire_headers()``.
+"""
+
+Context = None
+framing = None
+
+
+class _Engine:
+    async def generate(self, request, context):
+        yield request
+
+
+class Operator:
+    def __init__(self, engine):
+        self.engine = engine
+
+    async def forwards_is_clean(self, request, context):
+        async for item in self.engine.generate(request, context):
+            yield item
+
+    async def forwards_child_is_clean(self, request, context):
+        sub = context.child("sub")
+        async for item in self.engine.generate(request, sub):
+            yield item
+
+    async def drops_context(self, request, context):
+        async for item in self.engine.generate(request):  # EXPECT: DL008
+            yield item
+
+    async def detaches_deadline(self, request, context):
+        fresh = Context()  # EXPECT: DL008
+        async for item in self.engine.generate(request, fresh):
+            yield item
+
+    async def suppressed_negative(self, request, context):
+        # dynalint: disable=DL008 -- fixture: fire-and-forget audit probe,
+        # deliberately unbounded by the caller's deadline
+        async for item in self.engine.generate(request):
+            yield item
+
+
+async def send_req_is_clean(writer, context):
+    await framing.write_frame(writer, {
+        "kind": "req", "req": context.id, "payload": None,
+        "headers": context.wire_headers(),
+    })
+
+
+async def send_req_drops_header(writer, context):
+    await framing.write_frame(writer, {  # EXPECT: DL008
+        "kind": "req", "req": context.id, "payload": None,
+        "headers": context.headers,
+    })

@@ -1,0 +1,53 @@
+"""DL009 fixture: async lock spans that await wire/blocking latency.
+
+``_dial`` reaches ``asyncio.open_connection`` so the call-graph pass
+wire-taints it: awaiting it (or ``framing.write_frame`` directly) inside
+an ``async with ...lock:`` span — or between an untimed
+``await lock.acquire()`` and its ``release()`` — is a finding.
+"""
+
+import asyncio
+
+framing = None
+
+
+class Channel:
+    def __init__(self):
+        self._send_lock = asyncio.Lock()
+        self._state_lock = asyncio.Lock()
+        self._writer = None
+        self._peers = []
+
+    async def _dial(self):
+        # wire primitive: everything that (transitively) awaits this is
+        # wire-tagged
+        return await asyncio.open_connection("127.0.0.1", 1)
+
+    async def direct_wire_await(self, msg):
+        async with self._send_lock:  # EXPECT: DL009
+            await framing.write_frame(self._writer, msg)
+
+    async def wire_via_helper(self):
+        async with self._state_lock:  # EXPECT: DL009
+            self._writer = await self._dial()
+
+    async def pure_compute_is_clean(self, item):
+        async with self._state_lock:
+            self._peers.append(item)
+
+    async def snapshot_then_send_is_clean(self, msg):
+        async with self._state_lock:
+            peers = list(self._peers)
+        for _p in peers:
+            await framing.write_frame(self._writer, msg)
+
+    async def acquire_span(self, msg):
+        await self._send_lock.acquire()  # EXPECT: DL009
+        await framing.write_frame(self._writer, msg)
+        self._send_lock.release()
+
+    async def suppressed_negative(self, msg):
+        # dynalint: disable=DL009 -- fixture: per-connection frame writes
+        # must serialize; the span is bounded by socket backpressure
+        async with self._send_lock:
+            await framing.write_frame(self._writer, msg)

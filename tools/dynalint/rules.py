@@ -1,9 +1,13 @@
-"""The dynalint rule set (DL001–DL006).
+"""The dynalint rule set (DL001–DL009).
 
 Each rule encodes an invariant this repo has already paid for in bugs
 (see tools/dynalint/README.md for the incident each rule back-references).
-Rules are pure-AST ``check(ctx) -> list[Finding]`` callables over one file;
-DL006 additionally feeds the runner's cross-file stale-catalog check.
+DL001–DL006 are pure-AST ``check(ctx) -> list[Finding]`` callables over
+one file (DL006 additionally feeds the runner's cross-file stale-catalog
+check). DL007–DL009 ride the project-wide symbol table + call graph
+(core.ProjectIndex): DL007 is a project-level rule
+(``check_project(index)``), DL008/DL009 are per-file rules that consult
+the index for callee resolution and wire-taint.
 """
 
 from __future__ import annotations
@@ -13,6 +17,7 @@ from typing import Iterable
 
 from tools.dynalint.core import (
     Finding,
+    ProjectIndex,
     ScanContext,
     dotted,
     enclosing_function,
@@ -126,6 +131,11 @@ class BlockingCallInAsync:
     def _untimed_lock_acquire(node: ast.Call) -> bool:
         func = node.func
         if not (isinstance(func, ast.Attribute) and func.attr == "acquire"):
+            return False
+        if isinstance(getattr(node, "_dl_parent", None), ast.Await):
+            # ``await lock.acquire()`` is an asyncio lock: it yields, the
+            # loop keeps running — holding it across wire latency is
+            # DL009's business, not a thread-blocking call
             return False
         recv = dotted(func.value) or ""
         if "lock" not in recv.lower():
@@ -580,6 +590,9 @@ class CrossThreadMutation:
                     src = ""
                     try:
                         src = ast.unparse(item.context_expr)
+                    # dynalint: disable=DL003 -- defensive: an unparse
+                    # failure just means "not a lock expr"; there is
+                    # nothing to report and no value to use
                     except Exception:  # pragma: no cover - defensive
                         pass
                     if "lock" in src.lower():
@@ -679,6 +692,423 @@ class FaultSiteRegistry:
             )
 
 
+# --------------------------------------------------------------------------
+# DL007 wire-schema drift
+# --------------------------------------------------------------------------
+
+
+class WireSchemaDrift:
+    """DL007: cross-process wire-schema drift.
+
+    The hub protocol, the worker admin RPC, and the transfer-plane control
+    ops exist only by convention (string op names + dict fields). This
+    rule extracts every client-side emission and every server-side
+    dispatch branch project-wide (tools/dynalint/wire.py) and fails on an
+    op or field that is sent but unhandled, a transport err code no client
+    maps, a lost dispatcher anchor, or drift against the committed
+    ``wire_schema.json`` catalog — the machine-checked stand-in for the
+    reference's shared Rust protocol structs. Never baselineable.
+    """
+
+    id = "DL007"
+    name = "wire-schema-drift"
+
+    def check(self, ctx: ScanContext) -> Iterable[Finding]:
+        return ()  # project-level rule: see check_project
+
+    def check_project(self, project: ProjectIndex) -> Iterable[Finding]:
+        from tools.dynalint import wire
+
+        yield from wire.check_project(project)
+
+
+# --------------------------------------------------------------------------
+# DL008 deadline-taint
+# --------------------------------------------------------------------------
+
+# receiving a request context and then NOT passing it along breaks the
+# end-to-end deadline contract (PR 3): the callee runs unbounded while the
+# frontend's 504 fires without cancelling the work
+
+
+class DeadlineTaint:
+    """DL008: request-path function has a Context/deadline in scope but
+    drops it.
+
+    Three shapes, all of which silently detach a stage from the
+    end-to-end deadline (the class behind the PR 3 migration-retry
+    hardening):
+
+      * a call to a context-accepting callee (any project function with a
+        ``context`` / ``x: Context`` parameter, via the project index)
+        that forwards neither the in-scope context nor a ``.child()`` of
+        it;
+      * a fresh ``Context()`` constructed while a request context is in
+        scope (the new context has no deadline — derive with
+        ``context.child()`` or pass ``deadline=`` explicitly);
+      * a ``{"kind": "req"}`` wire frame whose headers don't come from
+        ``context.wire_headers()`` (the only thing that attaches
+        DEADLINE_HEADER);
+      * a ROOT ``Context()`` minted without ``deadline=`` in a serving
+        surface (frontend/gateway/grpc/multimodal) — these are where the
+        end-to-end budget is supposed to START (the HTTP frontend's
+        DYN_REQUEST_TIMEOUT_S contract); a deadline-less root here means
+        every downstream stage runs unbounded.
+    """
+
+    id = "DL008"
+    name = "deadline-taint"
+
+    # modules where requests ENTER the system: roots minted here must
+    # carry the end-to-end budget
+    SERVING_SURFACES = (
+        "dynamo_tpu/frontend/", "dynamo_tpu/gateway/",
+        "dynamo_tpu/grpc/", "dynamo_tpu/multimodal/",
+    )
+
+    def check(self, ctx: ScanContext) -> Iterable[Finding]:
+        project = ctx.project
+        if project is None:
+            return
+        yield from self._check_serving_roots(ctx, project)
+        callees = project.context_callee_names
+        for info in project.functions.values():
+            if info.path != ctx.path or not info.has_request_context:
+                continue
+            tainted = {
+                a.arg for a in (
+                    *info.node.args.posonlyargs, *info.node.args.args,
+                    *info.node.args.kwonlyargs,
+                )
+                if a.arg == "context" or a.arg in self._annotated_ctx(info)
+            }
+            tainted |= self._child_aliases(info.node, tainted)
+            # names bound from a fresh Context(...): passing one IS
+            # passing a context (the fresh-Context finding below already
+            # covers the deadline loss — don't double-report the call)
+            ctx_locals = {
+                n.targets[0].id
+                for n in ast.walk(info.node)
+                if isinstance(n, ast.Assign) and len(n.targets) == 1
+                and isinstance(n.targets[0], ast.Name)
+                and isinstance(n.value, ast.Call)
+                and (dotted(n.value.func) or "").rsplit(".", 1)[-1]
+                == "Context"
+            }
+            for name, call in info.calls:
+                last = name.rsplit(".", 1)[-1]
+                root = name.split(".", 1)[0]
+                if root in tainted:
+                    continue  # context.child() / context.remaining_s()
+                arg_names = self._loaded_names(call)
+                if last == "Context":
+                    if not any(kw.arg == "deadline" for kw in call.keywords):
+                        yield Finding(
+                            rule=self.id, path=ctx.path,
+                            line=call.lineno, col=call.col_offset,
+                            message="fresh Context() constructed while a "
+                                    "request context is in scope — the new "
+                                    "context carries NO deadline",
+                            hint="derive it: context.child(), or pass "
+                                 "deadline=context.deadline explicitly",
+                            context=info.qualname,
+                            detail=f"fresh-context:{info.qualname}",
+                        )
+                    continue
+                # the bare-name prefilter is cheap; context_accepting
+                # then applies the unanimity rule (every project def of
+                # the name takes a context) so an unrelated same-named
+                # callee can't smear findings onto innocent calls
+                if (
+                    last in callees and last != "child"
+                    and project.context_accepting(info, name)
+                ):
+                    if arg_names & (tainted | ctx_locals):
+                        continue
+                    if any(
+                        isinstance(a, ast.Call)
+                        and (dotted(a.func) or "").rsplit(".", 1)[-1]
+                        in ("Context", "ensure_context")
+                        for a in call.args
+                    ):
+                        continue  # inline Context(...): reported above
+                    yield Finding(
+                        rule=self.id, path=ctx.path,
+                        line=call.lineno, col=call.col_offset,
+                        message=f"{last}() accepts a request context but "
+                                "this call forwards none — the deadline "
+                                "(and cancellation) chain breaks here",
+                        hint="pass the in-scope context (or "
+                             "context.child() for a sub-request)",
+                        context=info.qualname,
+                        detail=f"drop:{info.qualname}:{last}",
+                    )
+            yield from self._check_req_frames(ctx, info, tainted)
+
+    def _check_serving_roots(
+        self, ctx: ScanContext, project: ProjectIndex
+    ) -> Iterable[Finding]:
+        if not ctx.path.startswith(self.SERVING_SURFACES):
+            return
+        for node in ctx.nodes:
+            if not isinstance(node, ast.Call):
+                continue
+            if (dotted(node.func) or "").rsplit(".", 1)[-1] != "Context":
+                continue
+            if any(kw.arg == "deadline" for kw in node.keywords):
+                continue
+            info = project.function_at(ctx.path, node)
+            if info is not None and info.has_request_context:
+                continue  # the fresh-Context check above owns this case
+            fn_name = info.qualname if info else "<module>"
+            yield Finding(
+                rule=self.id, path=ctx.path,
+                line=node.lineno, col=node.col_offset,
+                message="root Context() minted on a serving surface "
+                        "without a deadline — every downstream stage of "
+                        "this request runs unbounded (the "
+                        "DYN_REQUEST_TIMEOUT_S contract starts HERE)",
+                hint="Context(..., deadline=time.monotonic() + budget_s) "
+                     "— mirror HttpFrontend._traced_context",
+                context=fn_name, detail=f"root-context:{fn_name}",
+            )
+
+    @staticmethod
+    def _annotated_ctx(info) -> set[str]:
+        out = set()
+        for a in (
+            *info.node.args.posonlyargs, *info.node.args.args,
+            *info.node.args.kwonlyargs,
+        ):
+            ann = a.annotation
+            if ann is None:
+                continue
+            # same resolution as core._is_request_context_param: dotted
+            # OR string annotation ('c: "Context"') — diverging here
+            # would flag every correct forward in such a function
+            ann_name = dotted(ann) or (
+                ann.value if isinstance(ann, ast.Constant)
+                and isinstance(ann.value, str) else ""
+            )
+            if (ann_name or "").rsplit(".", 1)[-1] == "Context":
+                out.add(a.arg)
+        return out
+
+    @staticmethod
+    def _child_aliases(fn, tainted: set[str]) -> set[str]:
+        """Names bound from ``<tainted>.child(...)`` carry the deadline."""
+        out: set[str] = set()
+        for node in ast.walk(fn):
+            if not (isinstance(node, ast.Assign) and len(node.targets) == 1
+                    and isinstance(node.targets[0], ast.Name)):
+                continue
+            v = node.value
+            if (
+                isinstance(v, ast.Call)
+                and isinstance(v.func, ast.Attribute)
+                and v.func.attr == "child"
+                and (dotted(v.func.value) or "").split(".", 1)[0] in tainted
+            ):
+                out.add(node.targets[0].id)
+        return out
+
+    @staticmethod
+    def _loaded_names(call: ast.Call) -> set[str]:
+        out: set[str] = set()
+        for a in (*call.args, *[kw.value for kw in call.keywords]):
+            for n in ast.walk(a):
+                if isinstance(n, ast.Name) and isinstance(n.ctx, ast.Load):
+                    out.add(n.id)
+        return out
+
+    def _check_req_frames(self, ctx, info, tainted) -> Iterable[Finding]:
+        for node in ast.walk(info.node):
+            if not isinstance(node, ast.Dict):
+                continue
+            keys = {
+                k.value: v for k, v in zip(node.keys, node.values)
+                if isinstance(k, ast.Constant) and isinstance(k.value, str)
+            }
+            kind = keys.get("kind")
+            if not (
+                isinstance(kind, ast.Constant) and kind.value == "req"
+            ):
+                continue
+            headers = keys.get("headers")
+            ok = (
+                isinstance(headers, ast.Call)
+                and isinstance(headers.func, ast.Attribute)
+                and headers.func.attr == "wire_headers"
+            )
+            if not ok:
+                yield Finding(
+                    rule=self.id, path=ctx.path,
+                    line=node.lineno, col=node.col_offset,
+                    message="request frame sent without "
+                            "context.wire_headers() — DEADLINE_HEADER is "
+                            "dropped at this hop, downstream runs "
+                            "unbounded",
+                    hint='"headers": context.wire_headers()',
+                    context=info.qualname,
+                    detail=f"req-headers:{info.qualname}",
+                )
+
+
+# --------------------------------------------------------------------------
+# DL009 lock-across-await
+# --------------------------------------------------------------------------
+
+
+class LockAcrossAwait:
+    """DL009: an async lock span awaits a wire- or blocking-tagged call.
+
+    ``async with lock:`` (or an untimed ``await lock.acquire()`` span)
+    whose body awaits something that can stall on the network, a thread
+    pool, or a sleep holds every other coroutine contending that lock for
+    the full stall — the hub write path serializing behind one slow peer
+    is exactly how a single wedged follower turns into cluster-wide
+    backpressure. Wire-taint is computed transitively over the project
+    call graph (a helper that dials is as tagged as the dial itself).
+    Deliberate serialization points (per-connection frame writers) get a
+    reasoned suppression, which is the point: the contract is written
+    down where the lock is held.
+    """
+
+    id = "DL009"
+    name = "lock-across-await"
+
+    _EXTRA_TAGGED = frozenset({"to_thread", "run_in_executor"})
+
+    def check(self, ctx: ScanContext) -> Iterable[Finding]:
+        project = ctx.project
+        if project is None:
+            return
+        for node in ctx.nodes:
+            if isinstance(node, ast.AsyncWith):
+                lock_src = self._lock_src(node)
+                if lock_src is None:
+                    continue
+                hit = self._first_tagged_await(
+                    project, ctx, node.body
+                )
+                if hit is not None:
+                    call_name, line = hit
+                    yield Finding(
+                        rule=self.id, path=ctx.path,
+                        line=node.lineno, col=node.col_offset,
+                        message=f"async with {lock_src}: body awaits "
+                                f"{call_name}() (line {line}) — every "
+                                "contender stalls for the full wire/"
+                                "blocking latency",
+                        hint="move the slow await outside the lock, "
+                             "snapshot state under the lock and act "
+                             "after, or suppress with the serialization "
+                             "contract as the reason",
+                        context=qualname(node),
+                        detail=f"{lock_src}:{call_name}",
+                    )
+            elif isinstance(node, ast.Await):
+                yield from self._check_acquire_span(project, ctx, node)
+
+    @staticmethod
+    def _lock_src(node: ast.AsyncWith) -> str | None:
+        for item in node.items:
+            try:
+                src = ast.unparse(item.context_expr)
+            # dynalint: disable=DL003 -- defensive: an unparse failure
+            # just means "not a lock expr"; nothing to report
+            except Exception:  # pragma: no cover - defensive
+                continue
+            if "lock" in src.lower():
+                return src
+        return None
+
+    def _first_tagged_await(
+        self, project, ctx, body
+    ) -> tuple[str, int] | None:
+        for stmt in body:
+            for sub in ast.walk(stmt):
+                if not (
+                    isinstance(sub, ast.Await)
+                    and isinstance(sub.value, ast.Call)
+                ):
+                    continue
+                name = dotted(sub.value.func) or ""
+                if self._tagged(project, ctx, sub.value, name):
+                    return name, sub.lineno
+        return None
+
+    def _tagged(self, project, ctx, call: ast.Call, name: str) -> bool:
+        last = name.rsplit(".", 1)[-1]
+        if last in self._EXTRA_TAGGED:
+            return True
+        if name == "asyncio.sleep":
+            # sleeping under a lock is a held-lock delay, except the
+            # bare yield idiom sleep(0)
+            arg = call.args[0] if call.args else None
+            return not (
+                isinstance(arg, ast.Constant) and arg.value in (0, 0.0)
+            )
+        caller = project.function_at(ctx.path, call)
+        return project.is_wire_call(caller, name)
+
+    def _check_acquire_span(self, project, ctx, node: ast.Await):
+        call = node.value
+        if not (
+            isinstance(call, ast.Call)
+            and isinstance(call.func, ast.Attribute)
+            and call.func.attr == "acquire"
+        ):
+            return
+        recv = dotted(call.func.value) or ""
+        if "lock" not in recv.lower():
+            return
+        if call.args or any(
+            kw.arg in ("timeout", "blocking") for kw in call.keywords
+        ):
+            return
+        # span: statements after the acquire up to release() on the same
+        # receiver (or end of the enclosing body)
+        stmt: ast.AST = node
+        for p in parents(node):
+            body = getattr(p, "body", None)
+            if isinstance(body, list) and any(
+                stmt is s or any(stmt is w for w in ast.walk(s))
+                for s in body
+            ):
+                idx = next(
+                    i for i, s in enumerate(body)
+                    if stmt is s or any(stmt is w for w in ast.walk(s))
+                )
+                span = []
+                for s in body[idx + 1:]:
+                    if any(
+                        isinstance(w, ast.Call)
+                        and isinstance(w.func, ast.Attribute)
+                        and w.func.attr == "release"
+                        and dotted(w.func.value) == recv
+                        for w in ast.walk(s)
+                    ):
+                        break
+                    span.append(s)
+                hit = self._first_tagged_await(project, ctx, span)
+                if hit is not None:
+                    call_name, line = hit
+                    yield Finding(
+                        rule=self.id, path=ctx.path,
+                        line=node.lineno, col=node.col_offset,
+                        message=f"untimed {recv}.acquire() span awaits "
+                                f"{call_name}() (line {line}) before "
+                                "release — contenders stall for the full "
+                                "wire/blocking latency",
+                        hint="use 'async with' + move the slow await out, "
+                             "or suppress with the serialization contract",
+                        context=qualname(node),
+                        detail=f"acquire:{recv}:{call_name}",
+                    )
+                return
+
+
 RULES = {
     r.id: r
     for r in (
@@ -688,5 +1118,11 @@ RULES = {
         ResourcePairing(),
         CrossThreadMutation(),
         FaultSiteRegistry(),
+        WireSchemaDrift(),
+        DeadlineTaint(),
+        LockAcrossAwait(),
     )
 }
+
+# rules that run ONCE over the whole ProjectIndex instead of per file
+PROJECT_RULES = ("DL007",)

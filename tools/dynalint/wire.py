@@ -1,0 +1,765 @@
+"""Wire-schema extraction: the cross-process protocol as the AST sees it.
+
+The reference Dynamo shares protocol structs between client and server, so
+the Rust compiler *is* the wire-schema check. Our Python reproduction
+encodes three cross-process protocols purely by convention:
+
+  hub              ``{"id": n, "op": str, ...}`` frames between hub
+                   clients (hub_client.py ``_call``/``_open_stream``,
+                   hub_replica.py probe/sync frames, tests/hub_cluster.py)
+                   and the hub server dispatch chains
+                   (``HubServer._dispatch`` + ``_dispatch_repl``);
+  worker.admin     ``{"op": str, ...}`` payloads to the worker admin
+                   endpoint (engine/worker.py ``admin_handler``);
+  disagg.transfer  newline-JSON ``{"op": str, ...}`` control requests on
+                   the KV transfer plane (disagg/transfer.py).
+
+This module extracts BOTH directions from the ProjectIndex — every
+client-side op emission with its field names, every server-side dispatch
+branch with the fields it actually reads — plus the transport error codes
+(``{"kind": "err", "code": ...}`` emitted vs. the codes the client maps
+back to typed exceptions). DL007 (rules.py) compares them:
+
+  * op or field sent but unhandled  -> FAIL (the exact not_leader /
+    repl.status-nonce drift class the PR 2/3 review cycles hand-caught);
+  * op handled but never sent       -> warn (dead protocol surface),
+    silenced per-op via ``TOOLING_OPS`` with a written reason;
+  * extracted schema != committed ``wire_schema.json`` -> FAIL in both
+    directions (DL006-style two-way catalog drift; never baselineable).
+
+``wire_schema.json`` is the committed, reviewable protocol catalog;
+``--emit-protocol`` renders it to docs/PROTOCOL.md for humans.
+
+Deliberately out of scope: the SPMD replay stream (parallel/spmd.py) whose
+ops are *dynamic by design* (it mirrors engine entry-point names), and the
+hub WAL record format (hub_store.py ``_log``/``_apply``) which never
+crosses a process boundary except via repl.sync, where it is shipped as an
+opaque ``rec`` payload.
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+from pathlib import Path
+from typing import TYPE_CHECKING, Iterable
+
+from tools.dynalint.core import Finding, dotted, parents, qualname
+
+if TYPE_CHECKING:  # pragma: no cover
+    from tools.dynalint.core import ProjectIndex, ScanContext
+
+SCHEMA_PATH = Path(__file__).resolve().parent / "wire_schema.json"
+FIXTURE_MARKER = ("dynalint", "fixtures")
+
+# (repo path, dispatcher qualname) -> channel. These are ANCHORS: when the
+# file is in scope but the qualname is gone (refactor/rename), DL007 fails
+# loudly instead of silently extracting an empty server side.
+DISPATCHERS: dict[tuple[str, str], str] = {
+    ("dynamo_tpu/runtime/hub_server.py", "HubServer._dispatch"): "hub",
+    (
+        "dynamo_tpu/runtime/hub_replica.py",
+        "ReplicatedHubServer._dispatch_repl",
+    ): "hub",
+    (
+        "dynamo_tpu/engine/worker.py",
+        "launch_engine_worker.admin_handler",
+    ): "worker.admin",
+    ("dynamo_tpu/disagg/transfer.py", "KvTransferSource._handle"):
+        "disagg.transfer",
+}
+
+# Ops a server deliberately handles with no in-tree (scanned-scope) sender:
+# tests and operator tooling drive them. Keyed by "channel:op" — a reason
+# written for one surface must not excuse a same-named dead op on another.
+# The reason is REQUIRED — it lands in wire_schema.json and
+# docs/PROTOCOL.md so the surface stays documented instead of looking dead.
+TOOLING_OPS: dict[str, str] = {
+    "hub:ping": "liveness probe for operators/tests; no runtime caller",
+    "hub:repl.append": "push-apply tooling path; the normal record tail "
+                       "rides the repl.sync stream (exercised by "
+                       "tests/test_hub_replication.py fencing tests)",
+    "hub:repl.promote": "manual failover lever for operators; elections "
+                        "promote in-process without the RPC",
+    "worker.admin:faults": "chaos tooling: live DYN_FAULTS reconfiguration "
+                           "(tests/test_faults.py, "
+                           "recipes/chaos/nightly.sh)",
+    "worker.admin:drain": "operator-triggered drain; SIGTERM drives the "
+                          "same helper in-process (tests/test_faults.py)",
+    "worker.admin:cache_status": "operator/debug introspection of page "
+                                 "pools (tests/test_kvbm.py)",
+}
+
+# Frame envelope fields present on every op of a channel; not part of any
+# one op's schema.
+ENVELOPE_FIELDS = frozenset({"op", "id"})
+
+# Client-call attribute names whose first string-literal argument IS the
+# op (the hub client's generic senders).
+_OP_CALL_ATTRS = frozenset({"_call", "_open_stream"})
+# Calls that carry a ``{"op": ...}`` dict-literal payload to a worker
+# endpoint (the admin plane rides the generate transport).
+_ADMIN_CARRIERS = frozenset({"call_instance", "generate", "direct"})
+# Calls that put a ``{"op": ...}`` dict-literal on the transfer plane.
+_TRANSFER_CARRIERS = frozenset({"_tcp_request", "dumps"})
+
+
+class _Site:
+    __slots__ = ("path", "line", "col", "qualname")
+
+    def __init__(self, path: str, node: ast.AST):
+        self.path = path
+        self.line = getattr(node, "lineno", 1)
+        self.col = getattr(node, "col_offset", 0)
+        self.qualname = qualname(node)
+
+    @property
+    def ref(self) -> str:
+        return f"{self.path}:{self.qualname}"
+
+
+class OpInfo:
+    __slots__ = ("handlers", "handled_fields", "senders", "sent_fields")
+
+    def __init__(self) -> None:
+        self.handlers: list[_Site] = []
+        self.handled_fields: set[str] = set()
+        self.senders: list[_Site] = []
+        self.sent_fields: dict[str, list[_Site]] = {}
+
+
+class WireSchema:
+    def __init__(self) -> None:
+        # channel -> op -> OpInfo
+        self.channels: dict[str, dict[str, OpInfo]] = {}
+        self.err_emitted: dict[str, list[_Site]] = {}
+        self.err_handled: dict[str, list[_Site]] = {}
+        self.missing_anchors: list[tuple[str, str]] = []
+
+    def op(self, channel: str, op: str) -> OpInfo:
+        return self.channels.setdefault(channel, {}).setdefault(op, OpInfo())
+
+    def to_canonical(self) -> dict:
+        """Deterministic, line-number-free form: what gets committed as
+        wire_schema.json and what the drift check diffs against."""
+        channels: dict = {}
+        for channel in sorted(self.channels):
+            ops: dict = {}
+            for op_name in sorted(self.channels[channel]):
+                info = self.channels[channel][op_name]
+                fields: dict[str, str] = {}
+                for f in info.handled_fields | set(info.sent_fields):
+                    sent = f in info.sent_fields
+                    handled = f in info.handled_fields
+                    fields[f] = (
+                        "both" if sent and handled
+                        else "sent-only" if sent else "handled-only"
+                    )
+                entry = {
+                    "fields": {k: fields[k] for k in sorted(fields)},
+                    "handlers": sorted({s.ref for s in info.handlers}),
+                    "senders": sorted({s.ref for s in info.senders}),
+                }
+                note = TOOLING_OPS.get(f"{channel}:{op_name}")
+                if note is not None:
+                    entry["note"] = note
+                ops[op_name] = entry
+            channels[channel] = ops
+        return {
+            "version": 1,
+            "tool": "dynalint",
+            "channels": channels,
+            "transport_err_codes": {
+                "emitted": sorted(self.err_emitted),
+                "handled": sorted(self.err_handled),
+            },
+        }
+
+
+# --------------------------------------------------------------------------
+# extraction
+# --------------------------------------------------------------------------
+
+
+def _is_fixture(path: str) -> bool:
+    parts = tuple(path.split("/"))
+    return all(m in parts for m in FIXTURE_MARKER)
+
+
+def _str_const(node: ast.AST | None) -> str | None:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
+
+
+def _get_call_field(node: ast.AST) -> tuple[str | None, str | None]:
+    """``recv.get("f", ...)`` -> (recv dotted, "f"); else (None, None)."""
+    if (
+        isinstance(node, ast.Call)
+        and isinstance(node.func, ast.Attribute)
+        and node.func.attr == "get"
+        and node.args
+    ):
+        field = _str_const(node.args[0])
+        if field is not None:
+            return dotted(node.func.value), field
+    return None, None
+
+
+def _subscript_field(node: ast.AST) -> tuple[str | None, str | None]:
+    """``recv["f"]`` -> (recv dotted, "f"); else (None, None)."""
+    if isinstance(node, ast.Subscript):
+        field = _str_const(node.slice)
+        if field is not None:
+            return dotted(node.value), field
+    return None, None
+
+
+def _extract_dispatcher(
+    schema: WireSchema, ctx: "ScanContext", fn_node: ast.AST, channel: str
+) -> None:
+    """One server dispatch function: find the op variable(s)/receiver(s),
+    then every ``op == "lit"`` branch and the message fields each branch
+    (plus the shared pre-branch code) actually reads."""
+    op_vars: set[str] = set()
+    msg_vars: set[str] = set()
+    # dispatchers that receive a pre-split (op, msg) pair as parameters
+    # (hub_replica._dispatch_repl gets them from _dispatch's routing)
+    args = getattr(fn_node, "args", None)
+    if args is not None:
+        for a in (*args.posonlyargs, *args.args, *args.kwonlyargs):
+            if a.arg == "op":
+                op_vars.add("op")
+            elif a.arg in ("msg", "request", "req"):
+                msg_vars.add(a.arg)
+
+    def note_op_source(value: ast.AST, target: ast.AST) -> None:
+        for probe in (_get_call_field, _subscript_field):
+            recv, field = probe(value)
+            if recv is not None and field == "op":
+                msg_vars.add(recv)
+                if isinstance(target, ast.Name):
+                    op_vars.add(target.id)
+
+    for node in ast.walk(fn_node):
+        if isinstance(node, ast.Assign):
+            targets = node.targets[0] if len(node.targets) == 1 else None
+            if (
+                isinstance(targets, ast.Tuple)
+                and isinstance(node.value, ast.Tuple)
+                and len(targets.elts) == len(node.value.elts)
+            ):
+                for t, v in zip(targets.elts, node.value.elts):
+                    note_op_source(v, t)
+            elif targets is not None:
+                note_op_source(node.value, targets)
+        elif isinstance(node, ast.Compare):
+            for probe in (_get_call_field, _subscript_field):
+                recv, field = probe(node.left)
+                if recv is not None and field == "op":
+                    msg_vars.add(recv)
+    if not msg_vars:
+        return
+
+    def field_reads(tree: ast.AST) -> set[str]:
+        out: set[str] = set()
+        for node in ast.walk(tree):
+            for probe in (_get_call_field, _subscript_field):
+                recv, field = probe(node)
+                if recv in msg_vars and field is not None:
+                    out.add(field)
+            # membership probes count as reads: ``"spec" in request``
+            if (
+                isinstance(node, ast.Compare)
+                and len(node.ops) == 1
+                and isinstance(node.ops[0], (ast.In, ast.NotIn))
+                and dotted(node.comparators[0]) in msg_vars
+            ):
+                f = _str_const(node.left)
+                if f is not None:
+                    out.add(f)
+        return out
+
+    def branch_of(compare: ast.Compare) -> ast.If | None:
+        child: ast.AST = compare
+        for p in parents(compare):
+            if isinstance(p, ast.If) and (
+                p.test is child or any(child is n for n in ast.walk(p.test))
+            ):
+                return p
+            child = p
+        return None
+
+    # pass 1: locate every op branch
+    eq_branches: list[tuple[str, ast.If | None, ast.Compare]] = []
+    ne_ops: list[tuple[str, ast.Compare]] = []
+    for node in ast.walk(fn_node):
+        if not (isinstance(node, ast.Compare) and len(node.ops) == 1):
+            continue
+        # left side: an op variable, or a direct recv.get("op") call
+        recv, field = _get_call_field(node.left)
+        is_op_left = (
+            (isinstance(node.left, ast.Name) and node.left.id in op_vars)
+            or (recv in msg_vars and field == "op")
+        )
+        if not is_op_left:
+            continue
+        op_lit = _str_const(node.comparators[0])
+        if op_lit is None:
+            continue
+        if isinstance(node.ops[0], ast.Eq):
+            eq_branches.append((op_lit, branch_of(node), node))
+        elif isinstance(node.ops[0], ast.NotEq):
+            ne_ops.append((op_lit, node))
+
+    all_fields = field_reads(fn_node)
+    in_branch_fields: set[str] = set()
+    for _op, branch, _cmp in eq_branches:
+        if branch is not None:
+            for stmt in branch.body:
+                in_branch_fields |= field_reads(stmt)
+    shared_fields = (all_fields - in_branch_fields) - {"op"}
+
+    for op_lit, branch, cmp_node in eq_branches:
+        info = schema.op(channel, op_lit)
+        info.handlers.append(_Site(ctx.path, cmp_node))
+        fields = set(shared_fields)
+        if branch is not None:
+            for stmt in branch.body:
+                fields |= field_reads(stmt)
+        info.handled_fields |= fields - ENVELOPE_FIELDS
+    for op_lit, cmp_node in ne_ops:
+        # guard form (``if op != "pull": return``): the op's handling is
+        # the rest of the function — attribute every field read to it
+        info = schema.op(channel, op_lit)
+        info.handlers.append(_Site(ctx.path, cmp_node))
+        info.handled_fields |= all_fields - ENVELOPE_FIELDS
+
+
+def _record_send(
+    schema: WireSchema, ctx: "ScanContext", channel: str,
+    op: str, fields: Iterable[str], node: ast.AST,
+) -> None:
+    info = schema.op(channel, op)
+    site = _Site(ctx.path, node)
+    info.senders.append(site)
+    for f in fields:
+        if f not in ENVELOPE_FIELDS:
+            info.sent_fields.setdefault(f, []).append(site)
+
+
+def _dict_op_fields(d: ast.Dict) -> tuple[str | None, list[str]]:
+    op = None
+    fields: list[str] = []
+    for k, v in zip(d.keys, d.values):
+        key = _str_const(k)
+        if key is None:
+            continue
+        if key == "op":
+            op = _str_const(v)  # dynamic op -> None -> skipped by caller
+        else:
+            fields.append(key)
+    return op, fields
+
+
+def _extract_senders(schema: WireSchema, ctx: "ScanContext") -> None:
+    for node in ctx.nodes:
+        if not isinstance(node, ast.Call):
+            continue
+        func = node.func
+        name = dotted(func) or ""
+        last = name.rsplit(".", 1)[-1]
+        # hub generic senders: the first string literal IS the op
+        if (
+            isinstance(func, ast.Attribute)
+            and func.attr in _OP_CALL_ATTRS
+            and node.args
+        ):
+            op = _str_const(node.args[0])
+            if op is not None:
+                kw = [k.arg for k in node.keywords if k.arg]
+                _record_send(schema, ctx, "hub", op, kw, node)
+            continue
+        # framed hub messages: write_frame(writer, {"id": ..., "op": ...}).
+        # The "id" envelope key is the hub-protocol marker — the SPMD
+        # descriptor stream also write_frames ``{"op": ...}`` dicts but
+        # speaks its own (deliberately dynamic) replay protocol.
+        if last == "write_frame" and len(node.args) >= 2 and isinstance(
+            node.args[1], ast.Dict
+        ):
+            keys = {_str_const(k) for k in node.args[1].keys}
+            if "id" in keys:
+                op, fields = _dict_op_fields(node.args[1])
+                if op is not None:
+                    _record_send(schema, ctx, "hub", op, fields, node)
+            continue
+        # dict-literal {"op": ...} payloads riding a carrier call
+        if last in _ADMIN_CARRIERS or last in _TRANSFER_CARRIERS:
+            channel = (
+                "worker.admin" if last in _ADMIN_CARRIERS
+                else "disagg.transfer"
+            )
+            for arg in node.args:
+                if isinstance(arg, ast.Dict):
+                    op, fields = _dict_op_fields(arg)
+                    if op is not None:
+                        _record_send(schema, ctx, channel, op, fields, node)
+
+
+def _extract_err_codes(schema: WireSchema, ctx: "ScanContext") -> None:
+    # emitted: {"kind": "err", ..., "code": "lit"} dicts and
+    # err.update(code="lit", ...) builders
+    for node in ctx.nodes:
+        if isinstance(node, ast.Dict):
+            keys = {
+                _str_const(k): v for k, v in zip(node.keys, node.values)
+            }
+            if _str_const(keys.get("kind")) == "err" and "code" in keys:
+                code = _str_const(keys["code"])
+                if code is not None:
+                    schema.err_emitted.setdefault(code, []).append(
+                        _Site(ctx.path, node)
+                    )
+        elif (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr == "update"
+        ):
+            for kw in node.keywords:
+                if kw.arg == "code":
+                    code = _str_const(kw.value)
+                    if code is not None:
+                        schema.err_emitted.setdefault(code, []).append(
+                            _Site(ctx.path, node)
+                        )
+    # handled: compares of a var assigned from .get("code"), or direct
+    # recv.get("code") == "lit"
+    code_vars: set[str] = set()
+    for node in ctx.nodes:
+        if isinstance(node, ast.Assign) and len(node.targets) == 1:
+            recv, field = _get_call_field(node.value)
+            if recv is not None and field == "code" and isinstance(
+                node.targets[0], ast.Name
+            ):
+                code_vars.add(node.targets[0].id)
+    for node in ctx.nodes:
+        if not (isinstance(node, ast.Compare) and len(node.ops) == 1
+                and isinstance(node.ops[0], (ast.Eq, ast.NotEq))):
+            continue
+        recv, field = _get_call_field(node.left)
+        is_code = (
+            isinstance(node.left, ast.Name) and node.left.id in code_vars
+        ) or field == "code"
+        if not is_code:
+            continue
+        code = _str_const(node.comparators[0])
+        if code is not None:
+            schema.err_handled.setdefault(code, []).append(
+                _Site(ctx.path, node)
+            )
+
+
+def extract(project: "ProjectIndex") -> WireSchema:
+    """Build the wire schema for one ProjectIndex (memoized on it)."""
+    cached = getattr(project, "_wire_schema", None)
+    if cached is not None:
+        return cached
+    schema = WireSchema()
+    anchors_found: set[tuple[str, str]] = set()
+    for ctx in project.contexts:
+        for (path, qual), channel in DISPATCHERS.items():
+            if ctx.path != path:
+                continue
+            info = project.functions.get((path, qual))
+            if info is None:
+                continue
+            anchors_found.add((path, qual))
+            _extract_dispatcher(schema, ctx, info.node, channel)
+        if _is_fixture(ctx.path):
+            # fixtures are self-contained: any dispatcher-shaped function
+            # in them joins the "hub" channel so sender/handler matching
+            # works on a single-file scan
+            for info in project.functions.values():
+                if info.path == ctx.path:
+                    _extract_dispatcher(schema, ctx, info.node, "hub")
+        _extract_senders(schema, ctx)
+        _extract_err_codes(schema, ctx)
+    scanned_paths = {ctx.path for ctx in project.contexts}
+    schema.missing_anchors = [
+        (path, qual)
+        for (path, qual) in sorted(DISPATCHERS)
+        if path in scanned_paths and (path, qual) not in anchors_found
+    ]
+    project._wire_schema = schema  # type: ignore[attr-defined]
+    return schema
+
+
+# --------------------------------------------------------------------------
+# DL007 checks
+# --------------------------------------------------------------------------
+
+
+def check_project(project: "ProjectIndex") -> Iterable[Finding]:
+    """The failing direction of DL007: sent-but-unhandled ops/fields,
+    emitted-but-unmapped err codes, and missing dispatcher anchors."""
+    schema = extract(project)
+    for path, qual in schema.missing_anchors:
+        yield Finding(
+            rule="DL007", path=path, line=1, col=0,
+            message=f"wire dispatcher anchor {qual!r} not found — the "
+                    "schema extractor has lost the server side of this "
+                    "protocol",
+            hint="update tools/dynalint/wire.py DISPATCHERS for the "
+                 "refactor (and re-run --update-wire-schema)",
+            context=qual, detail=f"anchor:{path}:{qual}",
+        )
+    for channel, ops in sorted(schema.channels.items()):
+        has_handlers = any(info.handlers for info in ops.values())
+        if not has_handlers:
+            # the channel's server side is out of scan scope (partial
+            # scan): sent-op matching would be pure noise
+            continue
+        for op_name, info in sorted(ops.items()):
+            if info.senders and not info.handlers:
+                for site in info.senders:
+                    yield Finding(
+                        rule="DL007", path=site.path, line=site.line,
+                        col=site.col,
+                        message=f"op {op_name!r} is sent on the {channel} "
+                                "channel but no dispatch branch handles it "
+                                "— the peer answers 'unknown op'",
+                        hint="fix the op name, or add the server branch "
+                             "(then --update-wire-schema)",
+                        context=site.qualname,
+                        detail=f"op:{channel}:{op_name}",
+                    )
+                continue
+            if not info.handlers:
+                continue
+            for field, sites in sorted(info.sent_fields.items()):
+                if field in info.handled_fields:
+                    continue
+                for site in sites:
+                    yield Finding(
+                        rule="DL007", path=site.path, line=site.line,
+                        col=site.col,
+                        message=f"field {field!r} of op {op_name!r} "
+                                f"({channel}) is sent but the handler "
+                                "never reads it — stray payload or a "
+                                "renamed server-side field",
+                        hint="drop the field, or read it in the dispatch "
+                             "branch (then --update-wire-schema)",
+                        context=site.qualname,
+                        detail=f"field:{channel}:{op_name}:{field}",
+                    )
+    if schema.err_handled:
+        for code, sites in sorted(schema.err_emitted.items()):
+            if code in schema.err_handled:
+                continue
+            for site in sites:
+                yield Finding(
+                    rule="DL007", path=site.path, line=site.line,
+                    col=site.col,
+                    message=f"transport err code {code!r} is emitted but "
+                            "no client maps it — the peer degrades it to "
+                            "a generic RuntimeError",
+                    hint="map the code in the transport client (typed "
+                         "exception) or reuse an existing code",
+                    context=site.qualname, detail=f"errcode:{code}",
+                )
+
+
+def unsent_op_warnings(project: "ProjectIndex") -> list[str]:
+    """The warn direction: server surface nothing in scope exercises."""
+    schema = extract(project)
+    out: list[str] = []
+    for channel, ops in sorted(schema.channels.items()):
+        if not any(info.senders for info in ops.values()):
+            continue  # client side out of scan scope: skip the direction
+        for op_name, info in sorted(ops.items()):
+            if info.handlers and not info.senders and (
+                f"{channel}:{op_name}" not in TOOLING_OPS
+            ):
+                site = info.handlers[0]
+                out.append(
+                    f"wire: op {op_name!r} ({channel}) is handled at "
+                    f"{site.path}:{site.line} but nothing in scope sends "
+                    "it — dead surface? (annotate TOOLING_OPS in "
+                    "tools/dynalint/wire.py with a reason if deliberate)"
+                )
+    for code in sorted(set(schema.err_handled) - set(schema.err_emitted)):
+        if schema.err_emitted:
+            site = schema.err_handled[code][0]
+            out.append(
+                f"wire: transport err code {code!r} is handled at "
+                f"{site.path}:{site.line} but never emitted — stale "
+                "client mapping?"
+            )
+    return out
+
+
+def schema_drift_findings(
+    project: "ProjectIndex", schema_path: Path
+) -> list[Finding]:
+    """Committed-catalog drift, both directions, as DL007 findings."""
+    extracted = extract(project).to_canonical()
+    rel = "tools/dynalint/wire_schema.json"
+    if not schema_path.exists():
+        return [Finding(
+            rule="DL007", path=rel, line=1, col=0,
+            message="wire_schema.json is missing — the protocol catalog "
+                    "must be committed",
+            hint="python -m tools.dynalint --update-wire-schema",
+            context="<catalog>", detail="schema-missing",
+        )]
+    try:
+        committed = json.loads(schema_path.read_text())
+    except json.JSONDecodeError as e:
+        return [Finding(
+            rule="DL007", path=rel, line=1, col=0,
+            message=f"wire_schema.json is not valid JSON: {e}",
+            hint="python -m tools.dynalint --update-wire-schema",
+            context="<catalog>", detail="schema-corrupt",
+        )]
+    out: list[Finding] = []
+    for key, msg in _diff_schema(committed, extracted):
+        out.append(Finding(
+            rule="DL007", path=rel, line=1, col=0,
+            message=f"protocol catalog drift: {msg}",
+            hint="review the protocol change, then "
+                 "python -m tools.dynalint --update-wire-schema "
+                 "--emit-protocol",
+            context="<catalog>", detail=f"drift:{key}",
+        ))
+    return out
+
+
+def _diff_schema(committed: dict, extracted: dict) -> list[tuple[str, str]]:
+    """Both-direction diff keyed for stable fingerprints."""
+    out: list[tuple[str, str]] = []
+    c_ch = committed.get("channels", {})
+    e_ch = extracted.get("channels", {})
+    for ch in sorted(set(c_ch) | set(e_ch)):
+        c_ops = c_ch.get(ch, {})
+        e_ops = e_ch.get(ch, {})
+        for op in sorted(set(c_ops) - set(e_ops)):
+            out.append((f"{ch}:{op}:gone",
+                        f"op {op!r} ({ch}) is catalogued but no longer "
+                        "extracted from the code"))
+        for op in sorted(set(e_ops) - set(c_ops)):
+            out.append((f"{ch}:{op}:new",
+                        f"op {op!r} ({ch}) exists in code but not in the "
+                        "committed catalog"))
+        for op in sorted(set(c_ops) & set(e_ops)):
+            if c_ops[op] != e_ops[op]:
+                c_f, e_f = c_ops[op].get("fields", {}), e_ops[op].get(
+                    "fields", {})
+                if c_f != e_f:
+                    delta = sorted(
+                        set(c_f.items()) ^ set(e_f.items())
+                    )
+                    out.append((f"{ch}:{op}:fields",
+                                f"op {op!r} ({ch}) field set changed: "
+                                f"{delta}"))
+                else:
+                    out.append((f"{ch}:{op}:sites",
+                                f"op {op!r} ({ch}) sender/handler sites "
+                                "changed"))
+    c_err = committed.get("transport_err_codes", {})
+    e_err = extracted.get("transport_err_codes", {})
+    if c_err != e_err:
+        out.append(("errcodes",
+                    f"transport err codes changed: committed {c_err}, "
+                    f"extracted {e_err}"))
+    return out
+
+
+def save_schema(project: "ProjectIndex", schema_path: Path) -> dict:
+    canonical = extract(project).to_canonical()
+    schema_path.write_text(json.dumps(canonical, indent=2) + "\n")
+    return canonical
+
+
+# --------------------------------------------------------------------------
+# docs/PROTOCOL.md renderer
+# --------------------------------------------------------------------------
+
+_CHANNEL_BLURB = {
+    "hub": "Framed msgpack RPC between hub clients and the hub server "
+           "(`{\"id\": n, \"op\": str, ...}` -> "
+           "`{\"id\": n, \"ok\": bool, \"result\"/\"error\": ...}`; "
+           "streaming ops emit `{\"id\": n, \"stream\": item}` frames). "
+           "Includes the `repl.*` replication RPCs.",
+    "worker.admin": "Control-plane payloads to each worker's `admin` "
+                    "endpoint, riding the normal request transport "
+                    "(`{\"op\": str, ...}` -> one `{\"ok\": bool, ...}` "
+                    "item).",
+    "disagg.transfer": "Newline-delimited JSON control requests on the KV "
+                       "transfer plane's TCP socket "
+                       "(`{\"op\": str, \"transfer_id\": ...}`).",
+}
+
+
+def render_protocol_md(canonical: dict) -> str:
+    lines = [
+        "# dynamo-tpu cross-process protocol catalog",
+        "",
+        "<!-- GENERATED by `python -m tools.dynalint --emit-protocol` from",
+        "     tools/dynalint/wire_schema.json — do not hand-edit. A tier-1",
+        "     test (tests/test_static_analysis.py) fails when this file",
+        "     drifts from the schema the code actually implements. -->",
+        "",
+        "Extracted mechanically from the code by dynalint's wire-schema "
+        "pass (DL007):",
+        "every client-side op emission and every server-side dispatch "
+        "branch, compared",
+        "in both directions. `both` = the field is sent and read; "
+        "`handled-only` = the",
+        "server reads it but no in-scope caller sends it (optional/"
+        "tooling field).",
+        "",
+    ]
+    for channel in sorted(canonical.get("channels", {})):
+        ops = canonical["channels"][channel]
+        lines.append(f"## Channel `{channel}`")
+        lines.append("")
+        blurb = _CHANNEL_BLURB.get(channel)
+        if blurb:
+            lines.append(blurb)
+            lines.append("")
+        lines.append("| op | fields | handler | senders | note |")
+        lines.append("|----|--------|---------|---------|------|")
+        for op in sorted(ops):
+            e = ops[op]
+            fields = "<br>".join(
+                f"`{f}` ({status})" for f, status in e["fields"].items()
+            ) or "—"
+            handlers = "<br>".join(f"`{h}`" for h in e["handlers"]) or "—"
+            senders = "<br>".join(f"`{s}`" for s in e["senders"]) or (
+                "— (see note)" if e.get("note") else "—"
+            )
+            lines.append(
+                f"| `{op}` | {fields} | {handlers} | {senders} | "
+                f"{e.get('note', '')} |"
+            )
+        lines.append("")
+    err = canonical.get("transport_err_codes", {})
+    lines.append("## Transport error codes")
+    lines.append("")
+    lines.append(
+        "`{\"kind\": \"err\", \"code\": ...}` frames on the request/"
+        "response transport; the client maps each code to a typed "
+        "exception (runtime/transport.py)."
+    )
+    lines.append("")
+    lines.append("| code | emitted | handled |")
+    lines.append("|------|---------|---------|")
+    for code in sorted(set(err.get("emitted", [])) | set(
+        err.get("handled", [])
+    )):
+        lines.append(
+            f"| `{code}` | {'yes' if code in err.get('emitted', []) else 'no'}"
+            f" | {'yes' if code in err.get('handled', []) else 'no'} |"
+        )
+    lines.append("")
+    return "\n".join(lines)
