@@ -304,6 +304,10 @@ def serving_measurement(
             "PREFILL_BUDGET", family,
             int(ISL * SLOTS * tuning["budget_frac"]),
         ),
+        # dispatch.* attribution in the artifact (dispatch_overhead_frac,
+        # compile events): per-phase perf_counter pairs, negligible vs
+        # 6-10 ms steps
+        profile=True,
     )
 
     async def run() -> dict:
@@ -373,6 +377,30 @@ def serving_measurement(
                 "itl_ms_p99": pct(itls, 0.99),
             }
 
+        async def timed_ttft(tag: str) -> float | None:
+            """First-token latency of ONE isolated request (ms)."""
+            toks = rng.integers(3, spec.vocab_size, ISL).tolist()
+            t0 = time.perf_counter()
+            first = None
+            async for item in engine.generate(
+                {"token_ids": toks,
+                 "stop_conditions": {"max_tokens": 2, "ignore_eos": True},
+                 "sampling": {"temperature": 0.0}},
+                Context(tag),
+            ):
+                if first is None and item.get("token_ids"):
+                    first = round((time.perf_counter() - t0) * 1e3, 2)
+            return first
+
+        # cold TTFT: the very first request on this engine pays every
+        # compile the precompile pass would have absorbed — the
+        # cold-vs-warm delta IS the first-request tax (ROADMAP #4).
+        # With DYN_COMPILE_CACHE_DIR set and populated, 'cold' measures
+        # the CACHED restart instead (deserialize, not recompile) —
+        # which is exactly the restarted-worker number the cache claims
+        # to improve, so the artifact stays meaningful either way.
+        cold_ttft_ms = await timed_ttft("bench-cold")
+
         # global warmup: compile every serving shape ONCE before rung 1
         # (packed + single prefill, the decode burst programs, the batched
         # first-token sample) so the first rung's window measures steady
@@ -397,6 +425,17 @@ def serving_measurement(
                 *(warm_one(5000 + r * 10 + j) for j in range(4))
             )
 
+        # warm TTFT: same isolated request with every shape compiled —
+        # the cold/warm delta is what the compile cache + precompile
+        # pass buys a restarted worker
+        warm_ttft_ms = await timed_ttft("bench-warm-ttft")
+
+        # dispatch attribution windows over the ladder only: drop the
+        # warmup's compile noise from the dispatch.* counters
+        engine.reset_profile_window()
+        ladder_steps0 = engine.steps
+        ladder_t0 = time.perf_counter()
+
         # the variance protocol: the FULL ladder repeats, so per-rung
         # medians also absorb slow drift across the run (a single rung
         # repeated back-to-back would share one noise window)
@@ -404,7 +443,17 @@ def serving_measurement(
         for _rep in range(repeats):
             for i, n in enumerate(rungs):
                 rep_rungs[i].append(await one_rung(n))
+        ladder_s = time.perf_counter() - ladder_t0
+        snap = engine.profile_snapshot()
+        ladder_steps = engine.steps - ladder_steps0
         await engine.close()
+        from benchmarks.profile_engine import (
+            dispatch_attribution,
+            dispatch_overhead,
+        )
+
+        dispatch = dispatch_attribution(snap, ladder_steps)
+        overhead = dispatch_overhead(snap, ladder_s, ladder_steps)
         out_rungs = [aggregate_rung(reps) for reps in rep_rungs]
         best = max(out_rungs, key=lambda r: r["output_tok_per_s"])
         return {
@@ -419,6 +468,14 @@ def serving_measurement(
             "rungs": out_rungs,
             "output_tok_per_s": best["output_tok_per_s"],
             "best_concurrency": best["concurrency"],
+            # compile-and-dispatch evidence (ROADMAP #4): the cold/warm
+            # first-request delta and the step thread's dispatch+readmit
+            # overhead fraction across the ladder windows
+            "cold_ttft_ms": cold_ttft_ms,
+            "warm_ttft_ms": warm_ttft_ms,
+            "dispatch_overhead_frac":
+                overhead["dispatch_plus_readmit_frac_of_window"],
+            "dispatch": dispatch,
             "bars": {
                 "frac_of_raw_decode": SERVING_BARS["frac_of_raw_decode"].get(
                     family, SERVING_BARS["frac_of_raw_decode"]["gqa"]

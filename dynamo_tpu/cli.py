@@ -81,7 +81,9 @@ async def _arun(args: argparse.Namespace) -> None:
             model=args.model,
             model_path=args.model_path,
             model_name=model_name,
-            engine_config=EngineConfig(tp=args.tp),
+            # serving always pipelines the decode d2h (see worker._amain)
+            engine_config=EngineConfig(tp=args.tp, pipeline_decode=True),
+            precompile=args.precompile,
         )
         model_name = model_name or engine.spec.name
     else:
@@ -196,6 +198,11 @@ def _run_command(rest: list[str]) -> int:
     p.add_argument("--host", default="127.0.0.1")
     p.add_argument("--port", type=int, default=8000)
     p.add_argument("--tp", type=int, default=1)
+    p.add_argument("--precompile", action=argparse.BooleanOptionalAction,
+                   default=False,
+                   help="out=engine: compile every serving shape before "
+                        "serving (see worker --precompile); recipes turn "
+                        "this on")
     p.add_argument("--max-tokens", type=int, default=128)
     p.add_argument("--speedup-ratio", type=float, default=1.0)
     p.add_argument("--output", default=None,

@@ -1,0 +1,106 @@
+"""Persistent XLA compilation cache + compile-event accounting.
+
+Two halves of the compile war (ROADMAP #4):
+
+- ``enable_compile_cache(dir)`` points JAX's persistent compilation
+  cache at a directory (``DYN_COMPILE_CACHE_DIR`` / RuntimeConfig
+  ``compile_cache_dir``), so a restarted worker reloads its serving
+  programs from disk instead of paying cold-start TTFT re-deriving
+  them. Thresholds are zeroed: serving programs are worth caching
+  regardless of size or compile time.
+- ``compile_snapshot()`` reads a process-wide compile-event counter fed
+  by a ``jax.monitoring`` duration listener (``backend_compile``
+  events). The engine's profiler exposes the delta as the
+  ``dispatch.compile`` phase, ``InferenceEngine.precompile`` uses it to
+  report compiles-per-shape at startup, and the precompile-coverage
+  test asserts warmed traffic triggers ZERO new compiles.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+
+log = logging.getLogger("dynamo.engine.compile")
+
+_lock = threading.Lock()
+_listener_installed = False
+_cache_dir: str | None = None
+# [count, total_secs] — mutated only under the GIL by the jax listener
+_events: list = [0, 0.0]
+
+
+def _on_event_duration(name: str, secs: float, **_kw) -> None:
+    if "backend_compile" in name:
+        _events[0] += 1
+        _events[1] += secs
+
+
+def ensure_compile_listener() -> None:
+    """Install the compile-event listener once per process."""
+    global _listener_installed
+    with _lock:
+        if _listener_installed:
+            return
+        import jax
+
+        jax.monitoring.register_event_duration_secs_listener(
+            _on_event_duration
+        )
+        _listener_installed = True
+
+
+def compile_snapshot() -> tuple[int, float]:
+    """(compile events, total backend-compile seconds) so far. The
+    listener installs lazily on first read, so deltas from a snapshot
+    taken before any jit activity are complete."""
+    ensure_compile_listener()
+    return _events[0], _events[1]
+
+
+def enable_compile_cache(cache_dir: str) -> bool:
+    """Point JAX's persistent compilation cache at ``cache_dir``
+    (created if missing). Idempotent; returns whether the cache is
+    active. A second call with a DIFFERENT dir logs and keeps the
+    first — jax's cache config is process-global."""
+    global _cache_dir
+    if not cache_dir:
+        return _cache_dir is not None
+    with _lock:
+        if _cache_dir is not None:
+            if _cache_dir != cache_dir:
+                log.warning(
+                    "compile cache already at %s; ignoring %s",
+                    _cache_dir, cache_dir,
+                )
+            return True
+        import jax
+
+        os.makedirs(cache_dir, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        # serving programs are worth caching regardless of size/compile
+        # time — the defaults skip small/fast programs
+        for knob, val in (
+            ("jax_persistent_cache_min_compile_time_secs", 0.0),
+            ("jax_persistent_cache_min_entry_size_bytes", -1),
+        ):
+            try:
+                jax.config.update(knob, val)
+            except (AttributeError, ValueError):  # older jax: knob absent
+                log.debug("compile cache knob %s unavailable", knob)
+        _cache_dir = cache_dir
+        log.info("persistent compilation cache: %s", cache_dir)
+        return True
+
+
+def maybe_enable_compile_cache() -> bool:
+    """Env-gated ``enable_compile_cache`` (``DYN_COMPILE_CACHE_DIR``) —
+    the chokepoint InferenceEngine.__init__ calls so every engine
+    process (worker, follower shell, bench, tests) honors the env
+    without each wiring it separately."""
+    return enable_compile_cache(os.environ.get("DYN_COMPILE_CACHE_DIR", ""))
+
+
+def active_cache_dir() -> str | None:
+    return _cache_dir

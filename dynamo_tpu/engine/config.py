@@ -300,6 +300,11 @@ class EngineConfig:
     max_waiting: int = 0
     # sampling
     seed: int = 0
+    # step-thread phase profiler (same switch as DYNAMO_ENGINE_PROFILE=1):
+    # per-phase wall seconds + call counts via profile_snapshot(), incl.
+    # the dispatch.* attribution (bench.py turns this on for the serving
+    # ladder so the artifact can carry dispatch_overhead_frac)
+    profile: bool = False
     # scheduler
     step_idle_sleep_s: float = 0.002
     # eager re-admission: when processing a decode burst frees slots, run

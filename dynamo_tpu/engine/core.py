@@ -32,6 +32,10 @@ import jax.numpy as jnp
 import numpy as np
 
 from dynamo_tpu.engine.cache import OutOfPages, PageAllocator, SeqPages
+from dynamo_tpu.engine.compile_cache import (
+    compile_snapshot,
+    maybe_enable_compile_cache,
+)
 from dynamo_tpu.engine.config import EngineConfig, ModelSpec
 from dynamo_tpu.engine.sampling import sample_tokens, token_logprobs
 from dynamo_tpu.kv_router.protocols import ForwardPassMetrics
@@ -120,6 +124,11 @@ class InferenceEngine:
         self.spec = spec
         self.transfer_source = transfer_source
         self.kvbm = kvbm
+        # persistent XLA compilation cache (DYN_COMPILE_CACHE_DIR): wired
+        # here so EVERY engine process honors it (worker, follower shell,
+        # bench, tests) — a restarted worker reloads serving programs from
+        # disk instead of paying cold-start TTFT recompiling them
+        maybe_enable_compile_cache()
         # multi-host: SpmdLeader broadcasting every serving-path dispatch
         # so follower processes replay the same SPMD programs
         # (parallel/spmd.py). Pipelined decode replays too (descriptors
@@ -208,10 +217,20 @@ class InferenceEngine:
         self._moe_dropped_dev = None  # device-side running drop count
         self.moe_dropped_slots = 0  # last fetched total (metrics surface)
         self._metrics_publishes = 0
-        # step-thread phase profiler (DYNAMO_ENGINE_PROFILE=1): wall
-        # seconds + call counts per phase, read via profile_snapshot()
-        self._profiling = os.environ.get("DYNAMO_ENGINE_PROFILE") == "1"
+        # step-thread phase profiler (DYNAMO_ENGINE_PROFILE=1 or
+        # EngineConfig.profile): wall seconds + call counts per phase,
+        # read via profile_snapshot()
+        self._profiling = (
+            self.config.profile
+            or os.environ.get("DYNAMO_ENGINE_PROFILE") == "1"
+        )
         self._prof: dict[str, list[float]] = {}
+        # dispatch accounting (always on — one int add per device
+        # dispatch): jitted programs issued by the step thread, plus the
+        # process-wide compile-event baseline so profile_snapshot can
+        # attribute compiles that happened on THIS engine's watch
+        self.dispatches = 0
+        self._compile_base = compile_snapshot()
 
     def _prof_add(self, name: str, dt: float) -> None:
         """Accumulate one timed event into the phase profiler (no-op
@@ -240,13 +259,189 @@ class InferenceEngine:
             rec[1] += 1
 
     def profile_snapshot(self) -> dict[str, dict[str, float]]:
-        """Per-phase accumulated step-thread wall time (profiling mode)."""
-        return {
+        """Per-phase accumulated step-thread wall time (profiling mode),
+        plus the always-on dispatch accounting:
+
+        - ``dispatch.dispatches``: jitted device programs issued by the
+          step thread (calls; secs stays 0 — issue time is inside the
+          existing dispatch/prefill phases).
+        - ``dispatch.d2h_wait``: wall time the step thread spent BLOCKED
+          on device->host transfers (burst token sync, sync-admission
+          device_get, aged admission-wave materialization).
+        - ``dispatch.compile``: backend compile events (+ seconds) since
+          this engine was built, from the process-wide jax.monitoring
+          listener (engine/compile_cache.py) — nonzero during a steady
+          serving window means a shape escaped the warmup set.
+        """
+        snap = {
             k: {"secs": round(v[0], 4), "calls": int(v[1])}
             for k, v in sorted(
                 self._prof.items(), key=lambda kv: -kv[1][0]
             )
         }
+        snap.setdefault("dispatch.d2h_wait", {"secs": 0.0, "calls": 0})
+        snap.setdefault("readmit.d2h_wait", {"secs": 0.0, "calls": 0})
+        snap["dispatch.dispatches"] = {"secs": 0.0, "calls": self.dispatches}
+        c, s = compile_snapshot()
+        snap["dispatch.compile"] = {
+            "secs": round(s - self._compile_base[1], 4),
+            "calls": c - self._compile_base[0],
+        }
+        return snap
+
+    def reset_profile_window(self) -> None:
+        """Zero the profiling counters so the next profile_snapshot
+        covers only work from this point on (drop warmup/compile noise
+        before a measured window — bench.py, profile_engine.py)."""
+        self._prof.clear()
+        self.dispatches = 0
+        self._compile_base = compile_snapshot()
+
+    # -- precompile (startup warmup) ---------------------------------------
+
+    def precompile(self) -> dict[str, dict]:
+        """Compile every serving-shape program BEFORE traffic so no
+        request ever eats a compile (with the persistent cache enabled,
+        a restarted worker loads most of these from disk): per-bucket
+        single + packed prefill, the decode burst programs (full and
+        ramp-up-capped lengths), and the first-token sample widths. All
+        warmup dispatches write only the trash page (zero block tables,
+        inactive slots) against the LIVE pools, so device state is
+        exactly as if the engine had served and finished requests.
+
+        Must run before the step thread starts (the dispatches donate and
+        reassign the live KV pools); workers call it before serve. Skipped
+        under SPMD (followers would not replay the warmup descriptors).
+        Returns ``{shape: {"secs": s, "compiles": n[, "error": e]}}`` and
+        logs per-shape compile time (the worker startup contract)."""
+        if self._thread is not None and self._thread.is_alive():
+            raise RuntimeError(
+                "precompile() must run before the engine starts serving"
+            )
+        if self.spmd is not None:
+            log.info("precompile skipped: SPMD followers would not replay")
+            return {}
+        cfg = self.config
+        report: dict[str, dict] = {}
+
+        def timed(name: str, fn) -> None:
+            c0, s0 = compile_snapshot()
+            t0 = time.perf_counter()
+            try:
+                if FAULTS.enabled:
+                    # injectable slow/failing compile (site
+                    # engine.compile): a delay models a cold cache /
+                    # slow XLA; an error models a warmup miss — serving
+                    # must still come up and eat the compile at first
+                    # use instead
+                    FAULTS.fire_sync("engine.compile")
+                fn()
+            except Exception as e:  # noqa: BLE001
+                log.warning("precompile %s failed (%s); first request "
+                            "pays this compile instead", name, e)
+                report[name] = {
+                    "secs": round(time.perf_counter() - t0, 3),
+                    "compiles": compile_snapshot()[0] - c0,
+                    "error": str(e),
+                }
+                return
+            dt = time.perf_counter() - t0
+            c1, s1 = compile_snapshot()
+            report[name] = {"secs": round(dt, 3), "compiles": c1 - c0}
+            log.info(
+                "precompile %s: %.0f ms (%d compiles, %.0f ms in XLA)",
+                name, dt * 1e3, c1 - c0, (s1 - s0) * 1e3,
+            )
+
+        # prefill buckets up to the chunk cap (chunked prefill re-enters
+        # through the same bucketed shapes)
+        chunk_cap = cfg.bucket_for(
+            min(self._prefill_chunk_max(), cfg.prefill_buckets[-1])
+        )
+        buckets = [b for b in cfg.prefill_buckets if b <= chunk_cap]
+        bt1 = jnp.zeros((cfg.max_pages_per_seq,), jnp.int32)
+        for bucket in buckets:
+            def one_prefill(bucket=bucket):
+                logits, self.k_pages, self.v_pages, _ = self.fam.prefill(
+                    self.spec, self.params,
+                    jnp.zeros((bucket,), jnp.int32), bt1,
+                    jnp.asarray(0, jnp.int32),
+                    self.k_pages, self.v_pages,
+                    jnp.asarray(bucket, jnp.int32), mesh=self.mesh,
+                )
+                jax.block_until_ready(logits)
+
+            timed(f"prefill[{bucket}]", one_prefill)
+            if self.fam.supports_packed_prefill and cfg.prefill_pack_size > 1:
+                nb = cfg.prefill_pack_size
+
+                def packed(bucket=bucket, nb=nb):
+                    logits, self.k_pages, self.v_pages, _ = (
+                        self.fam.prefill_batch(
+                            self.spec, self.params,
+                            jnp.zeros((nb, bucket), jnp.int32),
+                            jnp.zeros((nb, cfg.max_pages_per_seq), jnp.int32),
+                            jnp.zeros((nb,), jnp.int32),
+                            self.k_pages, self.v_pages,
+                            jnp.zeros((nb,), jnp.int32), mesh=self.mesh,
+                        )
+                    )
+                    jax.block_until_ready(logits)
+
+                timed(f"prefill_packed[{nb}x{bucket}]", packed)
+
+        # decode burst programs: the full burst and the ramp-up-capped
+        # one (decode_steps_admit_pending) — the two lengths _build_batch
+        # actually dispatches in steady state
+        B = cfg.max_decode_slots
+        bursts = {max(1, cfg.decode_steps_per_dispatch)}
+        if cfg.decode_steps_admit_pending:
+            bursts.add(max(1, min(cfg.decode_steps_per_dispatch,
+                                  cfg.decode_steps_admit_pending)))
+        zB = jnp.zeros((B,), jnp.int32)
+        for n in sorted(bursts):
+            def burst(n=n):
+                out, self.k_pages, self.v_pages = self.fam.decode_steps(
+                    self.spec, self.params, zB,
+                    jnp.zeros((B, cfg.max_pages_per_seq), jnp.int32),
+                    jnp.ones((B,), jnp.int32),
+                    self.k_pages, self.v_pages,
+                    jnp.zeros((B,), bool),
+                    jnp.zeros((B,), jnp.float32), zB,
+                    jnp.ones((B,), jnp.float32),
+                    jnp.zeros((B,), jnp.uint32), zB,
+                    n_steps=n, n_logprobs=0, mesh=self.mesh,
+                )
+                jax.block_until_ready(out)
+
+            timed(f"decode[{B}x{n}]", burst)
+
+        # first-token sample widths: packed-dispatch fused samples
+        # (prefill_pack_size), the single-prompt program (1), and the
+        # stacked admission batch (max_decode_slots)
+        for w in sorted({1, cfg.prefill_pack_size, B}):
+            def sample(w=w):
+                out = sample_tokens(
+                    jnp.zeros((w, self.spec.vocab_size), jnp.float32),
+                    jnp.zeros((w,), jnp.float32),
+                    jnp.zeros((w,), jnp.int32),
+                    jnp.ones((w,), jnp.float32),
+                    jnp.zeros((w,), jnp.uint32),
+                    jnp.zeros((w,), jnp.int32),
+                )
+                jax.block_until_ready(out)
+
+            timed(f"sample[{w}]", sample)
+
+        total = sum(r["secs"] for r in report.values())
+        compiles = sum(r["compiles"] for r in report.values())
+        misses = sum(1 for r in report.values() if "error" in r)
+        log.info(
+            "precompile done: %d shapes, %d compiles, %.1f s total%s",
+            len(report), compiles, total,
+            f" ({misses} MISSED — compiled at first use)" if misses else "",
+        )
+        return report
 
     # -- events ------------------------------------------------------------
 
@@ -1424,6 +1619,7 @@ class InferenceEngine:
                     mesh=self.mesh,
                 )
             )
+            self.dispatches += 1
             self._note_moe_dropped(dropped)
             self._seal_prompt_blocks(sp, seq)
             self._drain_offload()
@@ -1481,13 +1677,19 @@ class InferenceEngine:
                     records.append(rec)
                 continue
             nb = cfg.prefill_pack_size
-            tokens = np.zeros((nb, bucket), np.int32)
+            tails = [p["token_ids"][p["start_pos"]:] for p in group]
+            if len(group) == nb and all(len(t) == bucket for t in tails):
+                # full pack of exact-bucket prompts: stack directly, no
+                # zero-fill + row-copy re-pad
+                tokens = np.asarray(tails, np.int32)
+            else:
+                tokens = np.zeros((nb, bucket), np.int32)
+                for i, t in enumerate(tails):
+                    tokens[i, : len(t)] = t
             bts = np.zeros((nb, cfg.max_pages_per_seq), np.int32)
             starts = np.zeros((nb,), np.int32)
             nts = np.zeros((nb,), np.int32)  # padded rows: 0 -> trash page
             for i, p in enumerate(group):
-                tail_toks = p["token_ids"][p["start_pos"]:]
-                tokens[i, : len(tail_toks)] = tail_toks
                 bts[i, : p["sp"].num_pages] = p["sp"].pages
                 starts[i] = p["start_pos"]
                 nts[i] = p["tail"]
@@ -1507,6 +1709,7 @@ class InferenceEngine:
                         mesh=self.mesh,
                     )
                 )
+                self.dispatches += 1
                 self._note_moe_dropped(dropped)
             except Exception as e:  # noqa: BLE001
                 log.exception("packed prefill failed (%d prompts)", len(group))
@@ -1563,6 +1766,7 @@ class InferenceEngine:
             jnp.asarray(topp), jnp.asarray(seeds),
             jnp.zeros((nb,), jnp.int32),  # first token: RNG step 0
         )
+        self.dispatches += 1
         # NO host copy here: on the tunneled runtime every d2h costs
         # ~80 ms and transfers serialize, so per-dispatch copies would
         # dominate the cycle. The round's samples coalesce into one wave
@@ -1664,17 +1868,25 @@ class InferenceEngine:
                 on_device=self.spmd is None,
             )
             sampled_dev = sample_tokens(stacked, *sample_args)
+            self.dispatches += 1
             # logprobs, when any admitted prompt wants them, batch over the
             # same stacked logits: one more fused sync, not one per record
             lp = top_i = top_v = None
             if any(r[2].logprobs is not None for r in recs):
                 n_lp = min(20, self.spec.vocab_size - 1)
                 picked, ti, tv = token_logprobs(stacked, sampled_dev, n_lp)
-                toks, lp, top_i, top_v = jax.device_get(
-                    (sampled_dev, picked, ti, tv)
-                )
+                self.dispatches += 1
+                # readmit.d2h_wait, NOT dispatch.d2h_wait: this span
+                # nests inside the complete_admissions phase the
+                # overhead fraction already sums (profile_engine
+                # READMIT_PHASES) — one name per accounting bucket
+                with self._phase("readmit.d2h_wait"):
+                    toks, lp, top_i, top_v = jax.device_get(
+                        (sampled_dev, picked, ti, tv)
+                    )
             else:
-                toks = np.asarray(sampled_dev)
+                with self._phase("readmit.d2h_wait"):
+                    toks = np.asarray(sampled_dev)
         except Exception as e:  # noqa: BLE001
             log.exception("batched admission completion failed")
             for _si, waiting, _seq, sp, _t, _m, _lr, _pre in pending:
@@ -1828,6 +2040,7 @@ class InferenceEngine:
                     on_device=True,
                 )
                 sampled_dev = sample_tokens(stacked, *sample_args)
+                self.dispatches += 1
                 waves[id(sampled_dev)] = {
                     "dev": sampled_dev,
                     "recs": [
@@ -1956,7 +2169,11 @@ class InferenceEngine:
         the first token."""
         if fed_col is None:
             try:
-                toks = np.asarray(ap["dev"])
+                # nests inside the materialize phase (a READMIT_PHASES
+                # member): readmit bucket, not dispatch (see
+                # _complete_admissions)
+                with self._phase("readmit.d2h_wait"):
+                    toks = np.asarray(ap["dev"])
             except Exception as e:  # noqa: BLE001
                 log.exception("admission materialization failed")
                 for slot_idx, slot, _row in ap["recs"]:
@@ -2030,8 +2247,14 @@ class InferenceEngine:
         cfg = self.config
         new_tokens = token_ids[start:end]
         bucket = cfg.bucket_for(len(new_tokens))
-        padded = np.zeros((bucket,), np.int32)
-        padded[: len(new_tokens)] = new_tokens
+        if len(new_tokens) == bucket:
+            # exact bucket fit (every mid-prompt chunk of a chunked
+            # prefill, and any prompt landing on a bucket boundary):
+            # skip the zero-fill + copy re-pad
+            padded = np.asarray(new_tokens, np.int32)
+        else:
+            padded = np.zeros((bucket,), np.int32)
+            padded[: len(new_tokens)] = new_tokens
         block_table = np.zeros((cfg.max_pages_per_seq,), np.int32)
         block_table[: sp.num_pages] = sp.pages
         mm_kwargs: dict[str, Any] = {}
@@ -2072,6 +2295,7 @@ class InferenceEngine:
             mesh=self.mesh,
             **mm_kwargs,
         )
+        self.dispatches += 1
         self._note_moe_dropped(dropped)
         return logits
 
@@ -2501,6 +2725,7 @@ class InferenceEngine:
                 tokens_in = jnp.where(
                     jnp.asarray(mask), ap["dev"][jnp.asarray(idx)], tokens_in
                 )
+        self.dispatches += 1
         result = self.fam.decode_steps(
             self.spec,
             self.params,
@@ -2550,7 +2775,7 @@ class InferenceEngine:
         sampled_dev, lp_dev, ti_dev, tv_dev = pending["results"]
         n_burst = batch["n_burst"]
         active = batch["active"]
-        with self._phase("process.d2h_sync"):
+        with self._phase("process.d2h_sync"), self._phase("dispatch.d2h_wait"):
             combined = np.asarray(sampled_dev)  # [B, 1 + n_burst]
         # column 0 is the burst's FED tokens (_dispatch_burst): the first
         # tokens of slots admitted into this burst land from this same
@@ -2576,9 +2801,10 @@ class InferenceEngine:
                     keep.append(ap)
             self._admit_waves = keep
         if lp_dev is not None:
-            lp = np.asarray(lp_dev)
-            top_i = np.asarray(ti_dev)
-            top_v = np.asarray(tv_dev)
+            with self._phase("dispatch.d2h_wait"):
+                lp = np.asarray(lp_dev)
+                top_i = np.asarray(ti_dev)
+                top_v = np.asarray(tv_dev)
         else:
             lp = top_i = top_v = None
 

@@ -61,6 +61,7 @@ async def launch_engine_worker(
     kvbm_config=None,
     health=None,  # HealthCheckManager: canary-probe this worker's endpoint
     spmd=None,  # SpmdLeader: multi-host dispatch broadcast (leader only)
+    precompile: bool = False,  # compile every serving shape before serve
 ) -> tuple[InferenceEngine, object]:
     """Build + register one engine worker in this process.
 
@@ -117,6 +118,17 @@ async def launch_engine_worker(
         spec, cfg, mesh=mesh, params=params,
         transfer_source=transfer_source, kvbm=kvbm, spmd=spmd,
     )
+
+    if precompile:
+        # shape warmup BEFORE registration: no request ever eats a
+        # compile, and per-shape compile time lands in the startup log
+        # (engine.precompile logs each shape; with DYN_COMPILE_CACHE_DIR
+        # set, a restarted worker mostly replays the disk cache here).
+        # Off the event loop: a cold compile pass can take minutes on
+        # TPU and must not starve the hub keepalives sharing this loop.
+        import asyncio as _aio
+
+        await _aio.to_thread(engine.precompile)
 
     if mode == "prefill":
         from dynamo_tpu.disagg.handlers import PrefillWorkerHandler
@@ -327,7 +339,13 @@ async def _amain(args: argparse.Namespace) -> None:
         max_pages_per_seq=args.max_pages_per_seq,
         max_decode_slots=args.max_decode_slots,
         decode_steps_per_dispatch=args.decode_steps_per_dispatch,
-        pipeline_decode=args.decode_steps_per_dispatch > 1,
+        # serving workers ALWAYS pipeline (even at burst 1 = pure
+        # double-buffering): burst N+1 dispatches with device-chained
+        # tokens while burst N's d2h is in flight, so the step thread
+        # never blocks on the device->host RTT (dispatch.d2h_wait ~ 0).
+        # Cost: stops detected up to pipeline_depth bursts late
+        # (overshoot discarded); cancels/admin ops still flush first.
+        pipeline_decode=True,
         max_prefill_chunk_tokens=args.max_prefill_chunk_tokens,
         tp=args.tp,
         sp=args.sp,
@@ -385,6 +403,12 @@ async def _amain(args: argparse.Namespace) -> None:
     rcfg = RuntimeConfig.from_env()
     if args.hub:
         rcfg.override_hub(args.hub)
+    if rcfg.compile_cache_dir:
+        # honor the YAML-layered config too (DYN_CONFIG), not just the
+        # DYN_COMPILE_CACHE_DIR env the engine reads itself
+        from dynamo_tpu.engine.compile_cache import enable_compile_cache
+
+        enable_compile_cache(rcfg.compile_cache_dir)
     drt = DistributedRuntime(await connect_hub(rcfg.hub_target()), rcfg)
     if multihost or args.mirror == "leader":
         import asyncio as _aio
@@ -445,6 +469,7 @@ async def _amain(args: argparse.Namespace) -> None:
         always_remote_prefill=args.always_remote_prefill,
         kvbm_config=_kvbm_config_from_args(args),
         spmd=spmd_leader,
+        precompile=args.precompile,
     )
     print("ENGINE_READY", flush=True)
     _install_drain_handler(drt, engine, served)
@@ -596,6 +621,14 @@ def main() -> None:
     p.add_argument("--kvbm-remote-blocks", type=int, default=0,
                    help="G4 remote-tier block cap in the hub object store "
                         "(0 = off); shared across workers")
+    p.add_argument("--precompile", action=argparse.BooleanOptionalAction,
+                   default=False,
+                   help="compile every serving shape (prefill buckets x "
+                        "pack widths, decode bursts, sample widths) before "
+                        "registering, logging per-shape compile time — no "
+                        "request ever eats a compile. Default ON in the "
+                        "serving recipes; pair with DYN_COMPILE_CACHE_DIR "
+                        "so restarts replay the disk cache")
     p.add_argument("--health-port", type=int, default=-1,
                    help="system status server port (0 = ephemeral, "
                         "-1 = health subsystem off)")

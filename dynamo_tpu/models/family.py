@@ -23,6 +23,7 @@ registry); here it is explicit and small.
 
 from __future__ import annotations
 
+from functools import partial
 from typing import Any
 
 import jax
@@ -184,7 +185,10 @@ def _extract_latent(cache, page_ids):
     return cache[:, page_ids]
 
 
-@jax.jit
+# donated: the latent cache updates in place (disagg resume / KVBM
+# onboard install whole pages into the live pool — a copy here doubles
+# the cache's HBM footprint for the duration of the insert)
+@partial(jax.jit, donate_argnums=(0,))
 def _insert_latent_impl(cache, page_ids, blocks):
     return cache.at[:, page_ids].set(blocks)
 

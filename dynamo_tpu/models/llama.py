@@ -25,11 +25,10 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from dynamo_tpu.engine.config import ModelSpec
 from dynamo_tpu.ops.attention import (
     causal_attention,
+    decode_update_attention,
     gather_pages,
     page_tiles,
-    paged_decode_attention_auto,
 )
-from dynamo_tpu.ops.pallas.kv_write import write_new_kv
 
 TRASH_PAGE = 0  # reserved page index for padded-position scatters
 
@@ -605,13 +604,13 @@ def decode_forward_impl(
         v = v.reshape(B, spec.num_kv_heads, spec.head_dim)
         q = rope_spec(spec, q, positions)
         k = rope_spec(spec, k, positions)
-        # new-token KV rows land via DMA kernel on TPU (XLA scatter is
-        # ~0.35ms/layer on v5e — see ops/pallas/kv_write.py), scatter off-TPU
-        k_pages, v_pages = write_new_kv(
-            k_pages, v_pages, k, v, safe_page, offset, layer=li, mesh=mesh
-        )
-        attn = paged_decode_attention_auto(
-            q, k_pages[li], v_pages[li], block_tables, seq_lens, mesh=mesh,
+        # KV append + paged attention in ONE kernel per layer on the
+        # Pallas path (ops/pallas/fused_decode.py — halves the decode
+        # program's kernel-launch count); scatter + gather attention
+        # elsewhere (ops/attention.decode_update_attention dispatch)
+        attn, k_pages, v_pages = decode_update_attention(
+            q, k_pages, v_pages, k, v, block_tables, seq_lens,
+            safe_page, offset, layer=li, mesh=mesh,
             window=spec.attn_window(li), sinks=lp.get("sinks"),
         )
         attn = attn.reshape(B, spec.num_heads * spec.head_dim)

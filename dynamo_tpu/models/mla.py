@@ -559,6 +559,10 @@ def decode_steps_impl(
 decode_steps = jax.jit(
     decode_steps_impl, static_argnums=(0,),
     static_argnames=("n_steps", "n_logprobs", "mesh"),
+    # donate the latent cache: without this every MLA decode burst
+    # COPIED the whole cache for its in-place page writes (the donation
+    # audit in tests/test_donation.py caught exactly this)
+    donate_argnums=(5,),
 )
 
 

@@ -21,6 +21,8 @@ import os
 import jax
 import jax.numpy as jnp
 
+from dynamo_tpu.ops.shard import shard_map as compat_shard_map
+
 NEG_INF = -1e30
 
 
@@ -32,6 +34,16 @@ def use_pallas() -> bool:
     if env in ("0", "false", "off", "no"):
         return False
     return jax.default_backend() == "tpu"
+
+
+def use_fused_decode() -> bool:
+    """Fused KV-append + attention kernel (ops/pallas/fused_decode.py) on
+    the decode path unless DYNAMO_FUSED_DECODE overrides (0/1). Only
+    consulted where the Pallas path is active (use_pallas)."""
+    env = (os.environ.get("DYNAMO_FUSED_DECODE") or "").strip().lower()
+    if env in ("0", "false", "off", "no"):
+        return False
+    return True
 
 
 def lane_aligned(head_dim: int) -> bool:
@@ -265,6 +277,113 @@ def _decode_attention_tpu(
     )
 
 
+def decode_update_attention(
+    q: jax.Array,  # [B, H, D] (model head dim)
+    k_pages: jax.Array,  # [L, num_pages, KH, page, pool_d]
+    v_pages: jax.Array,
+    k_new: jax.Array,  # [B, KH, D] new-token KV rows (post-rope)
+    v_new: jax.Array,
+    block_tables: jax.Array,  # [B, P]
+    seq_lens: jax.Array,  # [B] length INCLUDING the new token
+    dst_page: jax.Array,  # [B] pool page for the new row (0 = trash)
+    dst_off: jax.Array,  # [B]
+    *,
+    layer: int,
+    mesh=None,
+    window: int = 0,
+    sinks: jax.Array | None = None,
+) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """ONE fused kernel for the per-layer decode step: KV append + paged
+    attention (ops/pallas/fused_decode.py) — the dispatch-count half of
+    the compile-and-dispatch work. Falls back to the two-kernel path
+    (write_new_kv scatter/DMA + paged_decode_attention_auto) off the
+    Pallas path, when DYNAMO_FUSED_DECODE=0, or for lane-misaligned
+    pools on real TPUs.
+
+    Returns ``(attn [B, H, D], k_pages, v_pages)`` — pools updated in
+    place on the fused path (input/output aliasing + donation at the
+    model jit boundary)."""
+    D = q.shape[-1]
+    pool_d = k_pages.shape[-1]
+    on_tpu = jax.default_backend() == "tpu"
+    fused_ok = (
+        use_pallas()
+        and use_fused_decode()
+        and (not on_tpu or lane_aligned(pool_d))
+    )
+    if fused_ok:
+        from jax.sharding import PartitionSpec as P
+
+        from dynamo_tpu.ops.pallas.fused_decode import fused_decode_attention
+
+        if pool_d != D:
+            # lane-padded pool (pool_head_dim): zero-padded q/k dims add 0
+            # to every score, padded V columns slice off — scale pins to
+            # the TRUE model dim
+            q = pad_heads(q, pool_d)
+            k_new = pad_heads(k_new, pool_d)
+            v_new = pad_heads(v_new, pool_d)
+        scale = 1.0 / float(D) ** 0.5
+        base = functools.partial(
+            fused_decode_attention,
+            layer=layer, window=window, scale=scale,
+            interpret=not on_tpu,
+        )
+        if sinks is not None:
+            kernel = lambda q_, kp_, vp_, kn_, vn_, bt_, sl_, dp_, do_, s_: (  # noqa: E731
+                base(q_, kp_, vp_, kn_, vn_, bt_, sl_, dp_, do_, sinks=s_)
+            )
+        else:
+            kernel = lambda q_, kp_, vp_, kn_, vn_, bt_, sl_, dp_, do_: (  # noqa: E731
+                base(q_, kp_, vp_, kn_, vn_, bt_, sl_, dp_, do_)
+            )
+        if mesh is not None and mesh.shape.get("tp", 1) > 1:
+            in_specs = [
+                P(None, "tp", None),  # q: heads sharded
+                P(None, None, "tp", None, None),  # k_pages: kv heads
+                P(None, None, "tp", None, None),
+                P(None, "tp", None),  # k_new: kv heads sharded
+                P(None, "tp", None),
+                P(None, None),  # block tables replicated
+                P(None),  # seq lens
+                P(None),  # dst_page
+                P(None),  # dst_off
+            ]
+            if sinks is not None:
+                in_specs.append(P("tp"))
+            kernel = compat_shard_map(
+                kernel,
+                mesh=mesh,
+                in_specs=tuple(in_specs),
+                out_specs=(
+                    P(None, "tp", None),
+                    P(None, None, "tp", None, None),
+                    P(None, None, "tp", None, None),
+                ),
+                check_vma=False,
+            )
+        args = (
+            q, k_pages, v_pages, k_new, v_new, block_tables, seq_lens,
+            dst_page, dst_off,
+        )
+        if sinks is not None:
+            args = args + (sinks,)
+        attn, k_pages, v_pages = kernel(*args)
+        return attn[..., :D], k_pages, v_pages
+
+    from dynamo_tpu.ops.pallas.kv_write import write_new_kv
+
+    k_pages, v_pages = write_new_kv(
+        k_pages, v_pages, k_new, v_new, dst_page, dst_off,
+        layer=layer, mesh=mesh,
+    )
+    attn = paged_decode_attention_auto(
+        q, k_pages[layer], v_pages[layer], block_tables, seq_lens,
+        mesh=mesh, window=window, sinks=sinks,
+    )
+    return attn, k_pages, v_pages
+
+
 def paged_decode_attention_auto(
     q: jax.Array,
     k_pages: jax.Array,
@@ -340,7 +459,7 @@ def paged_decode_attention_auto(
             ]
             if sinks is not None:
                 in_specs.append(P("tp"))  # per-query-head sinks
-            kernel = jax.shard_map(
+            kernel = compat_shard_map(
                 kernel,
                 mesh=mesh,
                 in_specs=tuple(in_specs),

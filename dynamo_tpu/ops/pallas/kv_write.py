@@ -33,6 +33,8 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from dynamo_tpu.ops.shard import shard_map as compat_shard_map
+
 
 def _kv_write_kernel(
     # scalar prefetch (SMEM)
@@ -114,7 +116,9 @@ def _kv_write_kernel(
             out_copy(v_buf, v_out_ref, 1, i - 1, nxt).wait()
 
 
-@functools.partial(jax.jit, static_argnames=("layer", "interpret"))
+@functools.partial(
+    jax.jit, static_argnames=("layer", "interpret"), donate_argnums=(0, 1)
+)
 def kv_write_pallas(
     k_pages: jax.Array,  # [L, P, KH, page, D]
     v_pages: jax.Array,
@@ -214,7 +218,7 @@ def write_new_kv(
         if mesh is not None and mesh.shape.get("tp", 1) > 1:
             from jax.sharding import PartitionSpec as P
 
-            kernel = jax.shard_map(
+            kernel = compat_shard_map(
                 kernel,
                 mesh=mesh,
                 in_specs=(

@@ -1,0 +1,26 @@
+"""``shard_map`` compatibility across jax versions.
+
+``jax.shard_map`` (with ``check_vma``) is the modern spelling; older
+jaxlibs (e.g. the 0.4.x line this container bakes in) only ship
+``jax.experimental.shard_map.shard_map`` with the equivalent knob
+spelled ``check_rep``. One chokepoint so every kernel dispatch
+(attention, kv-write, fused decode, ring, pipeline) works on both.
+"""
+
+from __future__ import annotations
+
+import jax
+
+
+def shard_map(f, *, mesh, in_specs, out_specs, check_vma: bool = False):
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map(
+            f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+            check_vma=check_vma,
+        )
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    return _shard_map(
+        f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+        check_rep=check_vma,
+    )

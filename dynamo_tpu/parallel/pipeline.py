@@ -45,7 +45,10 @@ import numpy as np
 
 import jax
 import jax.numpy as jnp
+
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from dynamo_tpu.ops.shard import shard_map as compat_shard_map
 
 from dynamo_tpu.engine.config import ModelSpec
 from dynamo_tpu.models.llama import TRASH_PAGE, rms_norm, rope
@@ -310,7 +313,7 @@ def pp_decode_step(
         pp_params["embed"] if spec.tie_embeddings else pp_params["lm_head"]
     )
 
-    shard = jax.shard_map(
+    shard = compat_shard_map(
         partial(body),
         mesh=mesh,
         in_specs=(
@@ -418,7 +421,7 @@ def pp_prefill(
         "w_up": P("pp", None, "tp"),
         "w_down": P("pp", "tp", None),
     }
-    shard = jax.shard_map(
+    shard = compat_shard_map(
         body,
         mesh=mesh,
         in_specs=(

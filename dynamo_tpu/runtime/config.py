@@ -65,6 +65,11 @@ class RuntimeConfig:
 
     # engine-side compute
     block_size: int = 64  # KV cache block granularity (tokens/block)
+    # persistent XLA compilation cache dir (DYN_COMPILE_CACHE_DIR): a
+    # restarted worker reloads its serving programs from disk instead of
+    # paying cold-start TTFT recompiling them; empty = off. Honored by
+    # every engine process (engine/compile_cache.py).
+    compile_cache_dir: str = ""
 
     extra: dict[str, Any] = field(default_factory=dict)
 

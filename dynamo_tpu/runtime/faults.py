@@ -74,6 +74,7 @@ KNOWN_SITES: frozenset[str] = frozenset({
     "hub.fsync",
     "engine.step",
     "engine.admit",
+    "engine.compile",
     "disagg.pull",
 })
 

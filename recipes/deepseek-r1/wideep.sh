@@ -23,16 +23,24 @@ SLOTS="${SLOTS:-128}"
 KVBM_MB="${KVBM_MB:-65536}"
 MODEL_ARGS=(--model-path "${MODEL_PATH:-/ckpt/deepseek-r1}")
 
+PRECOMPILE="${PRECOMPILE:-1}"
 if [ "${SMOKE:-0}" = "1" ]; then
   export JAX_PLATFORMS=cpu
   export XLA_FLAGS="--xla_force_host_platform_device_count=4"
   EP=2 PREFILL_TP=2 PAGE=4 NUM_PAGES=64 SLOTS=2 KVBM_MB=8 BURST=4
   MODEL_ARGS=(--model tiny-deepseek)
+  PRECOMPILE=0  # CI smoke: skip the shape warmup
+else
+  # persistent XLA compile cache: worker restarts replay compiled
+  # serving programs from disk (empty DYN_COMPILE_CACHE_DIR disables)
+  export DYN_COMPILE_CACHE_DIR="${DYN_COMPILE_CACHE_DIR-$HOME/.cache/dynamo-tpu/xla-cache}"
 fi
 
 COMMON=("${MODEL_ARGS[@]}" --model-name "${MODEL:-deepseek-r1}"
         --page-size "$PAGE" --num-pages "$NUM_PAGES"
         --max-decode-slots "$SLOTS" --decode-steps-per-dispatch "$BURST")
+# serving default: compile every shape at startup (PRECOMPILE=0 skips)
+[ "$PRECOMPILE" = "1" ] && COMMON+=(--precompile)
 
 case "${ROLE:-all}" in
   decode)

@@ -19,12 +19,20 @@ NUM_PAGES="${NUM_PAGES:-4096}"
 SLOTS="${SLOTS:-64}"
 MODEL_ARGS=(--model-path "${MODEL_PATH:-/ckpt/gpt-oss-120b}")
 
+PRECOMPILE="${PRECOMPILE:-1}"
 if [ "${SMOKE:-0}" = "1" ]; then
   export JAX_PLATFORMS=cpu
   export XLA_FLAGS="--xla_force_host_platform_device_count=4"
   EP=2 TP=2 PAGE=4 NUM_PAGES=64 SLOTS=2 BURST=4
   MODEL_ARGS=(--model tiny-gpt-oss)
+  PRECOMPILE=0  # CI smoke: skip the shape warmup
+else
+  # persistent XLA compile cache: worker restarts replay compiled
+  # serving programs from disk (empty DYN_COMPILE_CACHE_DIR disables)
+  export DYN_COMPILE_CACHE_DIR="${DYN_COMPILE_CACHE_DIR-$HOME/.cache/dynamo-tpu/xla-cache}"
 fi
+# serving default: compile every shape at startup (PRECOMPILE=0 skips)
+[ "$PRECOMPILE" = "1" ] && MODEL_ARGS+=(--precompile)
 
 HUBLOG=$(mktemp)
 python -m dynamo_tpu.runtime.hub_server --port 0 > "$HUBLOG" &

@@ -25,17 +25,25 @@ NUM_PAGES="${NUM_PAGES:-4096}"
 SLOTS="${SLOTS:-64}"
 MODEL_ARGS=(--model-path "${MODEL_PATH:-/ckpt/llama-3-70b}")
 
+PRECOMPILE="${PRECOMPILE:-1}"
 if [ "${SMOKE:-0}" = "1" ]; then
   export JAX_PLATFORMS=cpu
   export XLA_FLAGS="--xla_force_host_platform_device_count=2"
   TP=2 PAGE=4 NUM_PAGES=64 SLOTS=2 BURST=4
   MODEL_ARGS=(--model tiny-test)
+  PRECOMPILE=0  # CI smoke: skip the shape warmup
+else
+  # persistent XLA compile cache: worker restarts replay compiled
+  # serving programs from disk (empty DYN_COMPILE_CACHE_DIR disables)
+  export DYN_COMPILE_CACHE_DIR="${DYN_COMPILE_CACHE_DIR-$HOME/.cache/dynamo-tpu/xla-cache}"
 fi
 
 COMMON=(--tp "$TP" --page-size "$PAGE" --num-pages "$NUM_PAGES"
         --max-decode-slots "$SLOTS" --decode-steps-per-dispatch "$BURST"
         "${MODEL_ARGS[@]}"
         --model-name "${MODEL:-llama-3-70b}")
+# serving default: compile every shape at startup (PRECOMPILE=0 skips)
+[ "$PRECOMPILE" = "1" ] && COMMON+=(--precompile)
 MH=()
 [ -n "${COORDINATOR:-}" ] && MH=(--coordinator-address "$COORDINATOR"
   --num-processes "${NUM_PROCESSES:-2}" --process-id "${PROCESS_ID:-0}")

@@ -4,7 +4,13 @@
 #   MODEL_PATH=/ckpt ./agg.sh     # real weights (else random-weight preset)
 set -euo pipefail
 cd "$(dirname "$0")/../.."
+# Persistent XLA compile cache + startup shape warmup (serving default):
+# restarts replay compiled programs from disk, and no request ever eats
+# a compile. DYN_COMPILE_CACHE_DIR= (empty) disables the cache,
+# PRECOMPILE=0 skips the warmup.
+export DYN_COMPILE_CACHE_DIR="${DYN_COMPILE_CACHE_DIR-$HOME/.cache/dynamo-tpu/xla-cache}"
 ARGS=(run --in http --out engine --port "${PORT:-8000}")
+[ "${PRECOMPILE:-1}" = "1" ] && ARGS+=(--precompile)
 if [ -n "${MODEL_PATH:-}" ]; then
   ARGS+=(--model-path "$MODEL_PATH")
 else

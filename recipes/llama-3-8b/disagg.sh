@@ -7,6 +7,10 @@ cd "$(dirname "$0")/../.."
 PORT="${PORT:-8000}"
 MODEL_ARGS=(--model "${MODEL:-llama-3-8b}")
 [ -n "${MODEL_PATH:-}" ] && MODEL_ARGS=(--model-path "$MODEL_PATH")
+# compile cache + shape warmup (serving default; see README):
+# DYN_COMPILE_CACHE_DIR= disables the cache, PRECOMPILE=0 the warmup
+export DYN_COMPILE_CACHE_DIR="${DYN_COMPILE_CACHE_DIR-$HOME/.cache/dynamo-tpu/xla-cache}"
+[ "${PRECOMPILE:-1}" = "1" ] && MODEL_ARGS+=(--precompile)
 
 python -m dynamo_tpu.runtime.hub_server --port 0 > /tmp/dyn-hub.out &
 HUB_PID=$!

@@ -1,0 +1,218 @@
+"""Compile-and-dispatch instrumentation (ROADMAP #4): precompile
+coverage, dispatch.* profile phases, compile-cache wiring, the
+engine.compile fault site, and the PROFILE_PHASES catalog sync."""
+
+import ast
+import asyncio
+import re
+import subprocess
+import sys
+
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from benchmarks.profile_engine import (
+    READMIT_PHASES,
+    dispatch_attribution,
+    dispatch_overhead,
+)
+from dynamo_tpu.engine.compile_cache import compile_snapshot
+from dynamo_tpu.engine.config import EngineConfig, ModelSpec
+from dynamo_tpu.engine.core import InferenceEngine
+from dynamo_tpu.runtime.context import Context
+from dynamo_tpu.runtime.faults import FAULTS
+
+pytestmark = pytest.mark.integration
+
+
+def _cfg(**kw) -> EngineConfig:
+    base = dict(
+        page_size=4, num_pages=128, max_pages_per_seq=16,
+        max_decode_slots=4, prefill_buckets=(16, 32),
+        prefill_pack_size=2, max_prefill_chunk_tokens=32,
+        # sync admissions: the zero-new-compiles assertion needs a
+        # deterministic shape set (async wave coalescing concatenates
+        # run-length-dependent widths)
+        async_admissions=False,
+        profile=True,
+    )
+    base.update(kw)
+    return EngineConfig(**base)
+
+
+async def _serve(engine, isls, tag) -> None:
+    async def one(i, isl):
+        toks = [3 + (i + j) % 50 for j in range(isl)]
+        async for _ in engine.generate(
+            {"token_ids": toks,
+             "stop_conditions": {"max_tokens": 4, "ignore_eos": True},
+             "sampling": {"temperature": 0.0}},
+            Context(f"{tag}-{i}"),
+        ):
+            pass
+
+    await asyncio.gather(*(one(i, isl) for i, isl in enumerate(isls)))
+
+
+async def test_precompile_then_mixed_isl_batch_zero_new_compiles():
+    """After the precompile pass + one warm traffic round, a mixed-ISL
+    batch (different lengths, same buckets) must trigger ZERO new
+    compiles — asserted via the jax.monitoring compile-event counter."""
+    engine = InferenceEngine(ModelSpec.tiny(), _cfg())
+    report = engine.precompile()
+    assert report, "precompile produced no shapes"
+    # warm traffic: compiles the eager glue (feeds, stacks) precompile's
+    # jitted-program warmup does not cover
+    await _serve(engine, [5, 12, 20], "warm")
+    c0, _s0 = compile_snapshot()
+    await _serve(engine, [7, 14, 25], "mixed")
+    c1, _s1 = compile_snapshot()
+    assert c1 - c0 == 0, (
+        f"{c1 - c0} compiles during warmed serving — a shape escaped "
+        "the precompile set"
+    )
+    await engine.close()
+
+
+async def test_precompile_report_covers_serving_shapes():
+    engine = InferenceEngine(ModelSpec.tiny(), _cfg())
+    report = engine.precompile()
+    names = set(report)
+    assert {"prefill[16]", "prefill[32]", "prefill_packed[2x16]",
+            "prefill_packed[2x32]", "decode[4x1]", "sample[1]",
+            "sample[2]", "sample[4]"} <= names
+    for rec in report.values():
+        assert rec["secs"] >= 0 and "compiles" in rec
+    # calling precompile after the engine started serving is a bug
+    await engine.start()
+    with pytest.raises(RuntimeError, match="before the engine starts"):
+        engine.precompile()
+    await engine.close()
+
+
+async def test_precompile_warmup_miss_fault_keeps_serving():
+    """Injected engine.compile failures (DYN_FAULTS site) = warmup
+    misses: precompile reports them and serving still works, eating the
+    compile at first use."""
+    FAULTS.configure("engine.compile:error@1.0x2", seed=7)
+    try:
+        engine = InferenceEngine(ModelSpec.tiny(), _cfg())
+        report = engine.precompile()
+        missed = [n for n, r in report.items() if "error" in r]
+        assert len(missed) == 2, report
+        await _serve(engine, [5, 20], "after-miss")
+        await engine.close()
+    finally:
+        FAULTS.configure("")
+    # delay action: slow-compile simulation parses and fires too
+    FAULTS.configure("engine.compile:delay=1ms@1.0x1", seed=7)
+    try:
+        engine = InferenceEngine(ModelSpec.tiny(), _cfg())
+        report = engine.precompile()
+        assert not any("error" in r for r in report.values())
+        await engine.close()
+    finally:
+        FAULTS.configure("")
+
+
+async def test_dispatch_phases_and_attribution():
+    """profile_snapshot carries the dispatch.* phases; the profile_engine
+    attribution helpers compute the overhead fraction from them."""
+    engine = InferenceEngine(ModelSpec.tiny(), _cfg())
+    await _serve(engine, [5, 12], "prof")
+    snap = engine.profile_snapshot()
+    await engine.close()
+    assert snap["dispatch.dispatches"]["calls"] > 0
+    assert "dispatch.d2h_wait" in snap
+    assert snap["dispatch.compile"]["calls"] >= 0
+
+    disp = dispatch_attribution(snap, model_steps=max(engine.steps, 1))
+    for key in ("dispatches", "dispatches_per_step", "d2h_wait_s",
+                "compile_events", "compile_s", "issue_s"):
+        assert key in disp
+    assert disp["dispatches"] == snap["dispatch.dispatches"]["calls"]
+
+    over = dispatch_overhead(snap, window_s=10.0, model_steps=engine.steps)
+    assert over["target_frac_max"] == 0.15
+    assert over["dispatch_plus_readmit_frac_of_window"] is not None
+    # the fraction is exactly (dispatch_s + readmit_s) / window
+    want = round((over["dispatch_s"] + over["readmit_s"]) / 10.0, 4)
+    assert over["dispatch_plus_readmit_frac_of_window"] == want
+
+
+def test_dispatch_overhead_fraction_math():
+    snap = {
+        "dispatch": {"secs": 1.0, "calls": 10},
+        "dispatch.d2h_wait": {"secs": 0.5, "calls": 5},
+        "dispatch.compile": {"secs": 0.25, "calls": 1},
+        "admit_loop": {"secs": 0.25, "calls": 4},
+        "readmit_wait": {"secs": 0.5, "calls": 2},
+        # NOT summed — its time already lives inside the admit phases
+        "eager_readmit": {"secs": 0.75, "calls": 2},
+    }
+    over = dispatch_overhead(snap, window_s=10.0, model_steps=100)
+    assert over["dispatch_s"] == 1.75
+    assert over["readmit_s"] == 0.75
+    assert over["dispatch_plus_readmit_frac_of_window"] == 0.25
+    assert set(READMIT_PHASES) >= {"admit_loop", "readmit_wait"}
+    assert "eager_readmit" not in READMIT_PHASES
+
+
+def test_compile_cache_env_wiring(tmp_path):
+    """DYN_COMPILE_CACHE_DIR reaches jax config through the engine
+    chokepoint, and RuntimeConfig layers the same knob. Subprocess:
+    jax's cache config is process-global."""
+    code = (
+        "import os, jax\n"
+        "from dynamo_tpu.engine.compile_cache import maybe_enable_compile_cache, active_cache_dir\n"
+        "from dynamo_tpu.runtime.config import RuntimeConfig\n"
+        f"os.environ['DYN_COMPILE_CACHE_DIR'] = {str(tmp_path)!r}\n"
+        "assert maybe_enable_compile_cache()\n"
+        f"assert active_cache_dir() == {str(tmp_path)!r}\n"
+        f"assert jax.config.jax_compilation_cache_dir == {str(tmp_path)!r}\n"
+        "rcfg = RuntimeConfig.from_env()\n"
+        f"assert rcfg.compile_cache_dir == {str(tmp_path)!r}\n"
+        "print('WIRED')\n"
+    )
+    out = subprocess.run(
+        [sys.executable, "-c", code], capture_output=True, text=True,
+        timeout=120, env={"JAX_PLATFORMS": "cpu", "PATH": "/usr/bin:/bin",
+                          "PYTHONPATH": "."},
+        cwd=__file__.rsplit("/tests/", 1)[0],
+    )
+    assert "WIRED" in out.stdout, out.stderr
+
+
+def test_profile_phase_catalog_sync():
+    """catalog.PROFILE_PHASES <-> engine/core.py phase names, BOTH
+    directions (the DL006 pattern): an uncatalogued phase silently
+    zeroes every consumer of profile snapshots; a catalogued phase no
+    code emits is drift."""
+    from tools.dynalint import catalog
+
+    core_path = InferenceEngine.__module__.replace(".", "/") + ".py"
+    src = open(core_path).read()
+    used: set[str] = set()
+    for node in ast.walk(ast.parse(src)):
+        if (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr in ("_phase", "_prof_add")
+            and node.args
+            and isinstance(node.args[0], ast.Constant)
+            and isinstance(node.args[0].value, str)
+        ):
+            used.add(node.args[0].value)
+    # profile_snapshot's synthesized keys + direct _prof accumulators
+    used.update(re.findall(
+        r'(?:snap|self\._prof)(?:\.setdefault\(|\[)"([a-z_.0-9]+)"', src
+    ))
+    catalogued = set(catalog.PROFILE_PHASES)
+    assert used - catalogued == set(), (
+        f"phases missing from catalog.PROFILE_PHASES: {used - catalogued}"
+    )
+    assert catalogued - used == set(), (
+        f"stale catalog phases no code emits: {catalogued - used}"
+    )

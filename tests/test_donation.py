@@ -1,0 +1,222 @@
+"""Donated-buffer audit: every hot-path jit must donate the KV pools it
+updates (``donate_argnums`` discipline — without it each decode step
+COPIES the multi-GB page arrays it rewrites; SNIPPETS.md [2]/[3]).
+
+Two layers of enforcement:
+
+- Behavioral: calling each hot jit with real arrays must invalidate
+  exactly the expected inputs (jax marks donated buffers deleted at the
+  API layer on every backend, so this holds on CPU tier-1 too).
+- Inventory: every ``jax.jit`` object in the hot modules must appear in
+  the audit table below — a NEW hot jit landing without a donation
+  decision fails the test until it is classified (donating or
+  explicitly read-only).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dynamo_tpu.engine import sampling
+from dynamo_tpu.engine.config import ModelSpec
+from dynamo_tpu.models import family, llama, mla
+from dynamo_tpu.ops.pallas import fused_decode, kv_write
+
+PJIT_TYPE = type(jax.jit(lambda x: x))
+
+SPEC = ModelSpec(
+    name="donate-audit", vocab_size=64, hidden_size=32,
+    intermediate_size=64, num_layers=2, num_heads=4, num_kv_heads=2,
+    head_dim=8, dtype="float32", tie_embeddings=True,
+)
+MLA_SPEC = ModelSpec.tiny_deepseek()
+B, PAGE, PPS = 2, 4, 3
+NUM_PAGES = 1 + B * PPS
+
+
+def _gqa_args():
+    params = llama.init_params(SPEC, jax.random.PRNGKey(0))
+    k, v = llama.init_cache(SPEC, NUM_PAGES, PAGE)
+    bt = np.zeros((B, PPS), np.int32)
+    for i in range(B):
+        bt[i] = np.arange(1 + i * PPS, 1 + (i + 1) * PPS)
+    return params, k, v, jnp.asarray(bt)
+
+
+def _mla_args():
+    params = mla.init_params(MLA_SPEC, jax.random.PRNGKey(0))
+    cache = mla.init_cache(MLA_SPEC, NUM_PAGES, PAGE)
+    bt = np.zeros((B, PPS), np.int32)
+    for i in range(B):
+        bt[i] = np.arange(1 + i * PPS, 1 + (i + 1) * PPS)
+    return params, cache, jnp.asarray(bt)
+
+
+def _deleted(arrs) -> list[bool]:
+    return [a.is_deleted() for a in arrs]
+
+
+def test_gqa_prefill_donates_pools():
+    params, k, v, bt = _gqa_args()
+    tokens = jnp.zeros((8,), jnp.int32)
+    logits, k2, v2, _ = llama.prefill_forward(
+        SPEC, params, tokens, bt[0], jnp.asarray(0, jnp.int32), k, v,
+        jnp.asarray(8, jnp.int32),
+    )
+    assert _deleted([k, v]) == [True, True]
+    assert not tokens.is_deleted()
+    assert not jax.tree.leaves(params)[0].is_deleted()
+
+
+def test_gqa_packed_prefill_donates_pools():
+    params, k, v, bt = _gqa_args()
+    tokens = jnp.zeros((B, 8), jnp.int32)
+    _logits, k2, v2, _ = llama.prefill_forward_batch(
+        SPEC, params, tokens, bt, jnp.zeros((B,), jnp.int32), k, v,
+        jnp.zeros((B,), jnp.int32),
+    )
+    assert _deleted([k, v]) == [True, True]
+
+
+def test_gqa_decode_steps_donates_pools():
+    params, k, v, bt = _gqa_args()
+    zB = jnp.zeros((B,), jnp.int32)
+    out, k2, v2 = llama.decode_steps(
+        SPEC, params, zB, bt, jnp.ones((B,), jnp.int32), k, v,
+        jnp.zeros((B,), bool), jnp.zeros((B,), jnp.float32), zB,
+        jnp.ones((B,), jnp.float32), jnp.zeros((B,), jnp.uint32), zB,
+        n_steps=2,
+    )
+    assert _deleted([k, v]) == [True, True]
+    assert not bt.is_deleted()
+
+
+def test_gqa_insert_donates_extract_does_not():
+    _params, k, v, _bt = _gqa_args()
+    ids = jnp.asarray([1, 2], jnp.int32)
+    kb, vb = llama.extract_kv_pages(k, v, ids)
+    assert _deleted([k, v]) == [False, False]  # extract is read-only
+    k2, v2 = llama.insert_kv_pages(k, v, ids, kb, vb)
+    assert _deleted([k, v]) == [True, True]
+
+
+def test_kv_write_kernel_donates_pools():
+    _params, k, v, _bt = _gqa_args()
+    kn = jnp.zeros((B, SPEC.num_kv_heads, SPEC.head_dim), jnp.float32)
+    k2, v2 = kv_write.kv_write_pallas(
+        k, v, kn, kn, jnp.zeros((B,), jnp.int32),
+        jnp.zeros((B,), jnp.int32), layer=0, interpret=True,
+    )
+    assert _deleted([k, v]) == [True, True]
+
+
+def test_fused_decode_kernel_donates_pools():
+    _params, k, v, bt = _gqa_args()
+    q = jnp.zeros((B, SPEC.num_heads, SPEC.head_dim), jnp.float32)
+    kn = jnp.zeros((B, SPEC.num_kv_heads, SPEC.head_dim), jnp.float32)
+    _o, k2, v2 = fused_decode.fused_decode_attention(
+        q, k, v, kn, kn, bt, jnp.ones((B,), jnp.int32),
+        jnp.zeros((B,), jnp.int32), jnp.zeros((B,), jnp.int32),
+        layer=0, interpret=True,
+    )
+    assert _deleted([k, v]) == [True, True]
+    assert not q.is_deleted()
+
+
+def test_mla_decode_and_prefill_donate_cache():
+    params, cache, bt = _mla_args()
+    tokens = jnp.zeros((8,), jnp.int32)
+    _logits, cache2 = mla.prefill_forward(
+        MLA_SPEC, params, tokens, bt[0], jnp.asarray(0, jnp.int32),
+        cache, jnp.asarray(8, jnp.int32),
+    )
+    assert cache.is_deleted()
+    zB = jnp.zeros((B,), jnp.int32)
+    out = mla.decode_steps(
+        MLA_SPEC, params, zB, bt, jnp.ones((B,), jnp.int32), cache2,
+        jnp.zeros((B,), bool), jnp.zeros((B,), jnp.float32), zB,
+        jnp.ones((B,), jnp.float32), jnp.zeros((B,), jnp.uint32), zB,
+        n_steps=1,
+    )
+    assert cache2.is_deleted()
+
+
+def test_mla_latent_insert_donates_extract_does_not():
+    _params, cache, _bt = _mla_args()
+    ids = jnp.asarray([1, 2], jnp.int32)
+    blocks = family._extract_latent(cache, ids)
+    assert not cache.is_deleted()  # extract is read-only
+    cache2 = family._insert_latent(cache, ids, np.asarray(blocks))
+    assert cache.is_deleted()
+
+
+def test_sampling_does_not_donate_logits():
+    """sample_tokens must NOT donate: _complete_admissions reuses the
+    stacked logits for the batched logprob pass after sampling."""
+    logits = jnp.zeros((B, SPEC.vocab_size), jnp.float32)
+    zB = jnp.zeros((B,), jnp.int32)
+    sampling.sample_tokens(
+        logits, jnp.zeros((B,), jnp.float32), zB,
+        jnp.ones((B,), jnp.float32), jnp.zeros((B,), jnp.uint32), zB,
+    )
+    assert not logits.is_deleted()
+
+
+# --------------------------------------------------------------- inventory
+
+# module -> {jit name: "donates" | "read-only"}. A jit object in one of
+# these modules that is NOT listed fails the inventory test: new hot
+# jits must make an explicit donation decision here (and get a
+# behavioral test above when they donate).
+AUDIT: dict = {
+    llama: {
+        "prefill_forward": "donates",
+        "prefill_forward_batch": "donates",
+        "prefill_forward_ring": "donates",
+        "decode_forward": "donates",
+        "decode_steps": "donates",
+        "extract_kv_pages": "read-only",
+        "insert_kv_pages": "donates",
+        "embed_forward": "read-only",
+    },
+    mla: {
+        "prefill_forward": "donates",
+        "prefill_forward_batch": "donates",
+        "decode_forward": "donates",
+        "decode_steps": "donates",
+        "embed_forward": "read-only",
+    },
+    family: {
+        "_extract_latent": "read-only",
+        "_insert_latent_impl": "donates",
+    },
+    sampling: {
+        "sample_tokens": "read-only",
+        "token_logprobs": "read-only",
+    },
+    kv_write: {
+        "kv_write_pallas": "donates",
+    },
+    fused_decode: {
+        "fused_decode_attention": "donates",
+    },
+}
+
+
+def test_every_hot_jit_is_audited():
+    unaudited = []
+    for mod, table in AUDIT.items():
+        found = {
+            name for name, obj in vars(mod).items()
+            if isinstance(obj, PJIT_TYPE)
+        }
+        missing = found - set(table)
+        if missing:
+            unaudited.append((mod.__name__, sorted(missing)))
+        stale = set(table) - found
+        assert not stale, f"audit table lists absent jits in {mod.__name__}: {stale}"
+    assert not unaudited, (
+        "hot-path jits without a donation decision (add to AUDIT + a "
+        f"behavioral test if they donate): {unaudited}"
+    )

@@ -23,7 +23,44 @@ FAULT_SITES: dict[str, str] = {
     "hub.fsync": "runtime/hub_store.py fsync — slow/failing durable disk",
     "engine.step": "engine/core.py step thread — device step fails/stalls",
     "engine.admit": "engine/core.py admission — worker vanishes pre-admit",
+    "engine.compile": "engine/core.py precompile — slow/failing shape "
+                      "warmup (serving must come up and eat the compile "
+                      "at first use)",
     "disagg.pull": "disagg/transfer.py KV pull — transfer plane failure",
+}
+
+# engine step-thread profiler phase names (engine/core.py _phase /
+# _prof_add / profile_snapshot) -> meaning. DL006-style registry for the
+# SAME reason as METRIC_NAMES: benchmarks/profile_engine.py's
+# attribution sections, bench.py's dispatch_overhead_frac, and the
+# dashboards built on profile snapshots reference these exact strings —
+# a renamed phase silently zeroes every consumer. Two-way sync with the
+# code is test-enforced (tests/test_dispatch_profile.py).
+PROFILE_PHASES: dict[str, str] = {
+    "idle": "step thread parked waiting for work",
+    "spmd_sync": "rejoining follower state-sync service",
+    "materialize": "async admission-wave first-token landings",
+    "flush": "pipeline flush before cancels/admin ops",
+    "admit_loop": "admission dequeue + page acquisition",
+    "packed_prefill": "packed prefill dispatch(es) for the step",
+    "complete_admissions": "first-token sample + emit for admissions",
+    "eager_readmit": "same-cycle re-admission pass after a burst freed slots",
+    "readmit_wait": "bounded wait for a closed-loop resubmission",
+    "build_batch": "host-side burst assembly",
+    "dispatch": "decode burst dispatch (host issue time)",
+    "process": "burst processing (stop semantics, seal, stream)",
+    "process.d2h_sync": "burst token download sync inside process",
+    "readmit.admit_wait": "generate() enqueue -> step-thread dequeue",
+    "readmit.prefill_dispatch": "dequeue -> prefill+sample dispatched",
+    "readmit.first_token": "dispatch complete -> first token streamed",
+    "dispatch.d2h_wait": "step thread blocked on device->host transfers "
+                         "(outside admission phases)",
+    "readmit.d2h_wait": "d2h blocks nested inside admission phases "
+                        "(sync-admission device_get, aged wave "
+                        "materialization) — already inside the readmit "
+                        "phase sums",
+    "dispatch.dispatches": "jitted device programs issued (count)",
+    "dispatch.compile": "backend compile events since engine build",
 }
 
 # metric name (without the dynamo_ prefix MetricsRegistry adds) -> meaning
