@@ -11,8 +11,9 @@ echo, dyn://}``):
       one JSON result line per input line (ref Input::Batch, input.rs:32)
   dynamo-tpu hub|hub-replica|frontend|worker|mocker|router|planner ...
       launch the corresponding service process (same as python -m
-      dynamo_tpu.<mod>); hub-replica runs one member of a replicated
-      hub cluster (runtime/hub_replica.py)
+      dynamo_tpu.<mod>); hub-replica runs one member of a quorum-backed
+      replicated hub cluster (runtime/hub_replica.py — the --peers list,
+      or DYN_HUB_PEERS, is the membership majorities are computed from)
   dynamo-tpu bench|profile ...                               load generator /
       SLA profiler (benchmarks/)
 """

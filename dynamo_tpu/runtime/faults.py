@@ -18,6 +18,10 @@ Spec grammar (``DYN_FAULTS`` env var, or the worker admin ``faults`` RPC)::
     hub.fsync:delay=50ms              every WAL fsync takes +50ms
     engine.step:error@0.001           1-in-1000 steps raises (recovery path)
     disagg.pull:error@1x1             the first KV pull fails, then clean
+    transport.partition:drop=A|B      bidirectional partition between the
+                                      address pair A and B
+    transport.partition:drop=A>B      one-way partition: traffic A -> B is
+                                      cut (B never hears A; A still hears B)
 
 Actions:
     drop   raise ``FaultDrop`` (a ConnectionResetError): the site behaves
@@ -25,6 +29,17 @@ Actions:
            migration/retry paths handle it with zero special-casing.
     delay  sleep ``param`` (``50ms``/``0.2s``/bare seconds) at the site.
     error  raise ``FaultInjected`` (a RuntimeError): an internal failure.
+
+Partitions are address-pair scoped: the ``transport.partition`` site takes
+a ``drop`` action whose param names the pair (``A|B`` symmetric, ``A>B``
+one-way src->dst; either side may be a ``*`` fnmatch pattern). Code that
+speaks peer-to-peer (the hub replication plane, hub_replica.py) consults
+``link_blocked(site, src, dst)`` / ``fire_link(site, src, dst)`` with its
+own advertise address and the peer's — a cut link refuses dials, kills
+established streams at the next frame, and drops follower acks, which is
+exactly the partial-failure surface a Raft-style election has to survive.
+Live-flippable like every other rule: ``configure()`` (the worker admin
+``faults`` RPC) swaps the partition set atomically.
 
 Determinism: every site draws its own decision stream from
 ``random.Random(f"{seed}:{site}")`` — the schedule at one site is a pure
@@ -47,6 +62,7 @@ exposition providers), so a chaos run can assert its faults actually fired.
 from __future__ import annotations
 
 import asyncio
+import fnmatch
 import logging
 import os
 import random
@@ -68,10 +84,12 @@ KNOWN_SITES: frozenset[str] = frozenset({
     "transport.connect",
     "transport.send",
     "transport.recv",
+    "transport.partition",
     "hub.dial",
     "hub.call",
     "hub.wal_append",
     "hub.fsync",
+    "hub.snap_fsync",
     "engine.step",
     "engine.admit",
     "engine.compile",
@@ -107,10 +125,34 @@ class FaultRule:
     delay_s: float = 0.0
     limit: int = 0  # max trips; 0 = unbounded
     trips: int = 0
+    # partition rules only (site transport.partition): the address pair.
+    # ``one_way`` cuts src->dst traffic only; symmetric cuts both ways.
+    src: str | None = None
+    dst: str | None = None
+    one_way: bool = False
+
+    def is_partition(self) -> bool:
+        return self.dst is not None
+
+    def link_matches(self, src: str, dst: str) -> bool:
+        if self.one_way:
+            return (
+                fnmatch.fnmatchcase(src, self.src)
+                and fnmatch.fnmatchcase(dst, self.dst)
+            )
+        return (
+            fnmatch.fnmatchcase(src, self.src)
+            and fnmatch.fnmatchcase(dst, self.dst)
+        ) or (
+            fnmatch.fnmatchcase(src, self.dst)
+            and fnmatch.fnmatchcase(dst, self.src)
+        )
 
     def spec(self) -> str:
         out = f"{self.site}:{self.action}"
-        if self.action == "delay":
+        if self.is_partition():
+            out += f"={self.src}{'>' if self.one_way else '|'}{self.dst}"
+        elif self.action == "delay":
             out += f"={self.delay_s * 1000:g}ms"
         if self.prob != 1.0:
             out += f"@{self.prob:g}"
@@ -142,11 +184,38 @@ def parse_spec(spec: str) -> list[FaultRule]:
         action = action.strip()
         if action not in ("drop", "delay", "error"):
             raise ValueError(f"fault entry {entry!r}: unknown action {action!r}")
+        site = site.strip()
+        if site == "transport.partition":
+            if action != "drop" or not param:
+                raise ValueError(
+                    f"fault entry {entry!r}: partition wants "
+                    "transport.partition:drop=A|B (or A>B one-way)"
+                )
+            if limit:
+                # a partition is link STATE probed by traffic, not a
+                # countable event: xN would silently heal after N probes
+                # (including idle polls), which is never what a chaos
+                # schedule means — flip the spec off to heal instead
+                raise ValueError(
+                    f"fault entry {entry!r}: xN limits are not valid on "
+                    "partitions (clear/replace the spec to heal)"
+                )
+            one_way = ">" in param
+            src, _, dst = param.partition(">" if one_way else "|")
+            if not src.strip() or not dst.strip():
+                raise ValueError(
+                    f"fault entry {entry!r}: partition needs both addresses"
+                )
+            rules.append(FaultRule(
+                site=site, action=action, prob=prob,
+                src=src.strip(), dst=dst.strip(), one_way=one_way,
+            ))
+            continue
         delay_s = _parse_duration(param) if param else 0.0
         if action == "delay" and not delay_s:
             raise ValueError(f"fault entry {entry!r}: delay needs =duration")
         rules.append(FaultRule(
-            site=site.strip(), action=action, prob=prob,
+            site=site, action=action, prob=prob,
             delay_s=delay_s, limit=limit,
         ))
     return rules
@@ -226,6 +295,8 @@ class FaultRegistry:
             # one draw per configured rule, in spec order, so multi-rule
             # sites (delay + rare drop) keep independent schedules
             for rule in rules:
+                if rule.is_partition():
+                    continue  # pair-scoped: only link_blocked matches these
                 if rule.limit and rule.trips >= rule.limit:
                     continue
                 if self._site_rng(site).random() < rule.prob:
@@ -234,6 +305,43 @@ class FaultRegistry:
                     self.trip_counts[key] = self.trip_counts.get(key, 0) + 1
                     return rule
             return None
+
+    def link_blocked(self, site: str, src: str, dst: str) -> bool:
+        """True when a partition rule at ``site`` cuts the directed link
+        ``src -> dst``. Symmetric rules match either direction; one-way
+        rules match src->dst only. Probabilistic partitions (flaky links)
+        draw from the same seeded per-site stream as every other rule, so
+        a chaos schedule replays. Trip semantics differ from event sites:
+        a partition is link STATE, so ``trips`` counts blocked link
+        CHECKS (dials refused, stream frames cut, idle polls while cut) —
+        nonzero trips still means the partition was live and consulted."""
+        if not self.enabled:
+            return False
+        with self._lock:
+            rules = self._rules.get(site)
+            if not rules:
+                return False
+            for rule in rules:
+                if not rule.is_partition():
+                    continue
+                if not rule.link_matches(src, dst):
+                    continue
+                if (
+                    rule.prob < 1.0
+                    and self._site_rng(site).random() >= rule.prob
+                ):
+                    continue
+                rule.trips += 1
+                key = (site, rule.action)
+                self.trip_counts[key] = self.trip_counts.get(key, 0) + 1
+                return True
+            return False
+
+    async def fire_link(self, site: str, src: str, dst: str) -> None:
+        """Async fault point for directed peer traffic: raises FaultDrop
+        (the peer-vanished contract) when the link is partitioned."""
+        if self.link_blocked(site, src, dst):
+            raise FaultDrop(f"injected partition at {site}: {src} -/-> {dst}")
 
     def _raise(self, rule: FaultRule) -> None:
         log.warning("fault injected: %s (trip %d)", rule.spec(), rule.trips)

@@ -25,11 +25,20 @@ from collections import OrderedDict, deque
 from dataclasses import dataclass, field
 from typing import Any, AsyncIterator
 
-__all__ = ["WatchEvent", "Hub", "InMemoryHub", "KeyExists"]
+__all__ = ["WatchEvent", "Hub", "InMemoryHub", "KeyExists", "NoQuorum"]
 
 
 class KeyExists(Exception):
     """Atomic create failed: key already present."""
+
+
+class NoQuorum(Exception):
+    """A replicated-hub write could not reach a majority of the configured
+    replica set before the commit timeout (leader cut off mid-partition,
+    or too few replicas up). The write is NOT durably committed — it may
+    be discarded when the partition heals. Surfaced to clients as a
+    retryable ``no_quorum`` error (hub_client.py treats it like a
+    mid-election ``not_leader`` bounce)."""
 
 
 @dataclass(frozen=True)

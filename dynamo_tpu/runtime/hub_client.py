@@ -40,6 +40,7 @@ from __future__ import annotations
 import asyncio
 import itertools
 import logging
+import random
 import time
 from typing import Any, AsyncIterator
 
@@ -70,6 +71,16 @@ class RemoteHub(Hub):
     separated list (a replicated hub, hub_replica.py): dials round-robin
     across the list, follows ``not_leader`` redirects for writes, and
     fails over streams to whichever replica answers."""
+
+    # redirect-chase bound: a mid-election cluster (every replica bouncing
+    # with a different — or no — leader hint, or two stale replicas naming
+    # each other) must not spin a client through an unbounded hot loop;
+    # after this many hops the call fails even inside the reconnect
+    # window. Sized so the backoff sum (~15 s expected at the 0.5 s cap)
+    # comfortably exceeds the default reconnect window AND a default
+    # 3 s-lease election — the window is the failover SLA, the hop cap
+    # only kills true redirect loops.
+    MAX_REDIRECT_HOPS = 32
 
     def __init__(
         self,
@@ -278,6 +289,16 @@ class RemoteHub(Hub):
                 raise KeyExists(msg.get("key"))
             if msg.get("error") == "not_leader":
                 raise NotLeader(msg.get("leader"))
+            if msg.get("error") == "no_quorum":
+                # the leader logged the write but could not commit it to a
+                # majority (mid-partition): retryable exactly like a
+                # mid-election bounce — chase until the cluster converges.
+                # AMBIGUOUS like any timeout: the record may still commit
+                # once stragglers ack, so a retried non-idempotent create
+                # can see KeyExists for its own write — the same
+                # at-least-once exposure the reconnect path documents
+                # (publish stays exactly-once via pub_id dedup).
+                raise NotLeader(None)
             raise RuntimeError(f"hub error for {op}: {msg.get('error')}")
         return msg.get("result")
 
@@ -294,31 +315,43 @@ class RemoteHub(Hub):
         async with self._conn_lock:
             if self._writer is not None:
                 self._writer.close()
-        await asyncio.sleep(0.05)
 
     async def _call(self, op: str, **kwargs: Any) -> Any:
         deadline: float | None = None
+        hops = 0
         while True:
             try:
                 await self._ensure_connected()
                 return await self._send_request(op, kwargs)
             except NotLeader as e:
-                # a follower bounced a write: chase the leader until the
-                # cluster converges or the window closes
+                # a follower bounced a write: chase the leader, but
+                # BOUNDED — max hops with jittered exponential backoff, so
+                # a mid-election cluster (or two stale replicas naming
+                # each other as leader) cannot spin us in a redirect loop
                 if not self._reconnect or self._closed:
                     raise ConnectionError(
                         f"hub follower refused {op!r}: leader is "
                         f"{e.leader or 'unknown'}"
                     )
+                hops += 1
                 deadline = deadline or (
                     time.monotonic() + self._reconnect_window_s
                 )
+                if hops > self.MAX_REDIRECT_HOPS:
+                    raise ConnectionError(
+                        f"hub redirect loop: {op!r} bounced "
+                        f"{hops} times without reaching a leader"
+                    )
                 if time.monotonic() >= deadline:
                     raise ConnectionError(
                         f"hub leaderless for {self._reconnect_window_s}s "
                         f"(op {op!r})"
                     )
                 await self._redirect(e.leader)
+                await asyncio.sleep(
+                    min(0.05 * (2 ** (hops - 1)), 0.5)
+                    * (0.5 + random.random())
+                )
             except ConnectionError:
                 if not self._reconnect or self._closed:
                     raise

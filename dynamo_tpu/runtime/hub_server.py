@@ -24,7 +24,8 @@ from typing import Any
 
 from dynamo_tpu.runtime import framing
 from dynamo_tpu.runtime.context import spawn
-from dynamo_tpu.runtime.hub import InMemoryHub, KeyExists
+from dynamo_tpu.runtime.hub import InMemoryHub, KeyExists, NoQuorum
+from dynamo_tpu.runtime.hub_store import HubFenced
 
 log = logging.getLogger("dynamo.hub")
 
@@ -152,6 +153,10 @@ class HubServer:
                 return
             if await self._dispatch_repl(op, mid, msg, send, streams):
                 return
+            # WAL position before the op: a replicated leader acks a write
+            # only after the records it logged past this point are on a
+            # majority (_commit_barrier); ops that logged nothing skip it
+            pre_seq = getattr(hub, "wal_seq", 0)
             if op == "put":
                 await hub.put(msg["key"], msg["value"], msg.get("lease"))
                 result: Any = True
@@ -219,9 +224,28 @@ class HubServer:
                 result = "pong"
             else:
                 raise ValueError(f"unknown op {op!r}")
+            if op in self.WRITE_OPS:
+                # capture the post-op position HERE (no await since the op
+                # body finished): waiting on anything later would couple
+                # this write's ack to neighbors' replication
+                post_seq = getattr(hub, "wal_seq", 0)
+                if post_seq > pre_seq:
+                    await self._commit_barrier(post_seq)
             await send({"id": mid, "ok": True, "result": result})
         except KeyExists as e:
             await send({"id": mid, "ok": False, "error": "key_exists", "key": str(e)})
+        except NoQuorum as e:
+            # the write is logged locally but NOT majority-replicated: the
+            # client must treat it as not-committed and retry elsewhere
+            log.warning("hub write %r failed commit quorum: %s", op, e)
+            await send({"id": mid, "ok": False, "error": "no_quorum"})
+        except HubFenced:
+            # fenced at commit time: this replica was deposed while the
+            # write was in flight — bounce like any follower would
+            await send({
+                "id": mid, "ok": False, "error": "not_leader",
+                "leader": self._leader_hint(),
+            })
         except asyncio.CancelledError:
             raise
         except Exception as e:  # noqa: BLE001 - serve errors to the client
@@ -232,6 +256,18 @@ class HubServer:
         serving it (replicated followers bounce WRITE_OPS with
         ``not_leader``). None = serve normally."""
         return None
+
+    def _leader_hint(self) -> str | None:
+        """Hook: current leader address for not_leader bounces (the
+        replicated server reports its replica's view)."""
+        return None
+
+    async def _commit_barrier(self, seq: int) -> None:
+        """Hook: called after a WRITE_OPS op logged records up to WAL
+        position ``seq``, before the ack is sent. The base server commits
+        locally (no-op); the replicated leader blocks until ``seq`` is on
+        a majority of the replica set (hub_replica.py), raising NoQuorum
+        when it cannot be."""
 
     async def _dispatch_repl(
         self, op: str, mid: int, msg: dict[str, Any], send, streams

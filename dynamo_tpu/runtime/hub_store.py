@@ -54,11 +54,32 @@ import msgpack
 from dynamo_tpu.runtime.context import spawn
 from dynamo_tpu.runtime.faults import FAULTS
 from dynamo_tpu.runtime.hub import InMemoryHub, _Lease
+from dynamo_tpu.runtime.metrics import MetricsRegistry, register_registry
 
 log = logging.getLogger("dynamo.hub")
 
 _LEN = struct.Struct(">I")
 _MAX_REC = 512 * 1024 * 1024
+
+# Process-wide hub-store metrics, appended to every /metrics surface.
+# Background snapshot-compaction failures were previously only visible in
+# logs; the counter makes "the WAL is growing because compaction keeps
+# failing" alertable before the disk fills.
+_METRICS = MetricsRegistry()
+COMPACTION_FAILURES = _METRICS.counter(
+    "hub_compaction_failures_total",
+    "Hub snapshot-compaction failures (serving continues on the "
+    "uncompacted WAL).",
+)
+register_registry("hub_store", _METRICS)
+
+
+class HubFenced(RuntimeError):
+    """A WAL commit was refused by the fencing check: the hub minting the
+    record is no longer the leader of the epoch it is writing under
+    (hub_replica.py sets the policy via ``_commit_allowed``). The
+    in-flight write of a deposed leader dies here instead of being
+    replayed into a history the cluster has moved past."""
 
 
 class HubStore:
@@ -74,13 +95,14 @@ class HubStore:
         self.gen = 0
         self._wal = None
         self._tmp_ids = itertools.count(1)
-        # stale temp snapshots (crash mid-write, or a discarded stale
-        # background capture) are dead weight — clear them
-        for p in self.dir.glob("hub.snap.tmp*"):
-            try:
-                p.unlink()
-            except OSError:
-                pass
+        # stale temp snapshots/term files (crash mid-write, or a discarded
+        # stale background capture) are dead weight — clear them
+        for pattern in ("hub.snap.tmp*", "hub.term.tmp*"):
+            for p in self.dir.glob(pattern):
+                try:
+                    p.unlink()
+                except OSError:
+                    pass
         self._fsync = (
             os.environ.get("DYNAMO_HUB_FSYNC") == "1" if fsync is None
             else fsync
@@ -91,8 +113,54 @@ class HubStore:
     def snap_path(self) -> Path:
         return self.dir / "hub.snap"
 
+    @property
+    def term_path(self) -> Path:
+        return self.dir / "hub.term"
+
     def wal_path(self, gen: int) -> Path:
         return self.dir / f"hub.wal.{gen}"
+
+    # -- election term (raft-style durable vote state) ----------------------
+
+    def load_term(self) -> tuple[int, str | None]:
+        """(term, voted_for) from the term file; (0, None) when absent or
+        torn. Kept OUT of the WAL deliberately: a vote grant must not look
+        like replicated-state divergence to the resync path."""
+        try:
+            data = msgpack.unpackb(self.term_path.read_bytes(), raw=False)
+            return int(data.get("term", 0)), data.get("voted_for")
+        except (OSError, ValueError, msgpack.exceptions.ExtraData):
+            return 0, None
+
+    def save_term(self, term: int, voted_for: str | None) -> None:
+        """Atomically persist (term, voted_for). Always fsynced regardless
+        of the WAL fsync knob: voting twice in one term after a crash
+        breaks election safety outright, while a lost WAL tail only costs
+        acked-but-unreplicated data the contract already concedes.
+        Deliberately synchronous on the caller's thread (it runs on the
+        event loop from vote handling): the grant must be durable BEFORE
+        the response frame leaves the process, and term changes happen
+        once per election — not per write — so the stall is rare and
+        bounded, unlike the per-append path that earned a background
+        thread."""
+        tmp = Path(f"{self.term_path}.tmp{next(self._tmp_ids)}")
+        with open(tmp, "wb") as f:
+            f.write(msgpack.packb(
+                {"term": int(term), "voted_for": voted_for},
+                use_bin_type=True,
+            ))
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, self.term_path)
+        # the rename itself must be durable before the grant leaves this
+        # process: without the directory fsync a power loss can resurrect
+        # the OLD term file and let the restarted replica vote a second
+        # time in the same term
+        dirfd = os.open(self.dir, os.O_RDONLY)
+        try:
+            os.fsync(dirfd)
+        finally:
+            os.close(dirfd)
 
     # -- load --------------------------------------------------------------
 
@@ -194,6 +262,16 @@ class HubStore:
         with open(tmp, "wb") as f:
             f.write(msgpack.packb(state, use_bin_type=True))
             f.flush()
+            if FAULTS.enabled:
+                # the snapshot's own durability point: a failing disk here
+                # is a compaction failure, not a serving failure — the
+                # caller counts it and keeps serving on the old WAL. A
+                # DISTINCT site from the per-append hub.fsync: this runs
+                # in a compaction worker thread, and sharing one seeded
+                # decision stream across threads would make the schedule
+                # interleaving-dependent (the determinism faults.py
+                # promises).
+                FAULTS.fire_sync("hub.snap_fsync")
             os.fsync(f.fileno())
         return tmp, new_gen
 
@@ -270,6 +348,11 @@ class DurableHub(InMemoryHub):
         self.wal_seq = 0
         # leadership term; bumped by hub_replica promotion
         self.repl_epoch = 0
+        # fencing epoch of the LAST record in the log: the raft election
+        # restriction compares (last record term, position), so a deposed
+        # leader's uncommitted tail — long, but stamped with a dead term —
+        # can never outrank a shorter log holding newer-term records
+        self.last_rec_epoch = 0
         # follower-side: last leader wal_seq applied (0 = never synced)
         self.repl_cursor = 0
         self._recent: deque = deque(maxlen=self.REPL_BACKLOG)
@@ -282,7 +365,11 @@ class DurableHub(InMemoryHub):
             self._restore(state)
         for rec in records:
             self._apply(rec)
-            self.wal_seq += 1
+            # records minted after the replication PR carry their global
+            # stream seq ("sq") — prefer it so recovery lands on exactly
+            # the position the record was logged at; the increment covers
+            # pre-stamp WALs
+            self.wal_seq = max(int(rec.get("sq", 0)), self.wal_seq + 1)
             self._recent.append((self.wal_seq, rec))
         self.store.records_since_snapshot = len(records)
         self._import_legacy_objects()
@@ -330,6 +417,7 @@ class DurableHub(InMemoryHub):
             "wal_seq": self.wal_seq,
             "repl_epoch": self.repl_epoch,
             "repl_cursor": self.repl_cursor,
+            "last_e": self.last_rec_epoch,
             "kv": dict(self._kv),
             "key_lease": dict(self._key_lease),
             "leases": [
@@ -367,6 +455,9 @@ class DurableHub(InMemoryHub):
         self.wal_seq = int(state.get("wal_seq", 0))
         self.repl_epoch = int(state.get("repl_epoch", 0))
         self.repl_cursor = int(state.get("repl_cursor", 0))
+        # pre-election snapshots: the minting leader's epoch is the best
+        # available bound for its last record's term
+        self.last_rec_epoch = int(state.get("last_e", self.repl_epoch))
         self._kv = dict(state["kv"])
         self._key_lease = dict(state["key_lease"])
         now = time.monotonic()
@@ -408,6 +499,9 @@ class DurableHub(InMemoryHub):
         rsq = rec.get("rsq")
         if rsq is not None:
             self.repl_cursor = max(self.repl_cursor, int(rsq))
+        e = rec.get("e")
+        if e is not None:
+            self.last_rec_epoch = max(self.last_rec_epoch, int(e))
         if op == "put":
             key, lid = rec["k"], rec.get("l")
             if lid is not None and lid in self._leases:
@@ -471,7 +565,18 @@ class DurableHub(InMemoryHub):
 
     # -- logged mutations --------------------------------------------------
 
-    def _log(self, rec: dict[str, Any]) -> None:
+    def _commit_allowed(self, rec: dict[str, Any]) -> None:
+        """Commit-time fencing hook: raise HubFenced to refuse logging
+        ``rec``. The plain durable hub commits everything; the replicated
+        hub (hub_replica.py) refuses records minted by a deposed leader."""
+
+    def _log(self, rec: dict[str, Any]) -> int:
+        self._commit_allowed(rec)
+        if "sq" not in rec:
+            # stamp the record's global stream position so a WAL is
+            # self-describing for recovery and the replication invariant
+            # checker (followers keep the leader's stamp: rsq == sq)
+            rec = dict(rec, sq=self.wal_seq + 1)
         self.store.append(rec)
         self.wal_seq += 1
         self._recent.append((self.wal_seq, rec))
@@ -486,6 +591,7 @@ class DurableHub(InMemoryHub):
                 # the follower re-syncs from its cursor (or a snapshot)
                 q.repl_overflowed = True
         self._maybe_compact()
+        return self.wal_seq
 
     # -- snapshot compaction ------------------------------------------------
 
@@ -496,26 +602,39 @@ class DurableHub(InMemoryHub):
         if since >= self.compact_every * 4:
             # hard bound: a caller that never yields to the loop (or no
             # loop at all) must still get its WAL rotated eventually
-            self.store.snapshot(self._state())
+            self._snapshot_inline()
             return
         if self._compacting:
             return
         try:
             asyncio.get_running_loop()  # probe: background mode needs a loop
         except RuntimeError:
-            self.store.snapshot(self._state())
+            self._snapshot_inline()
             return
         self._compacting = True
         # spawn: the loop's weak task ref is not enough — a GC'd compaction
         # task would leave _compacting latched True and the WAL unbounded
         spawn(self._compact_bg(), name="hub-compact")
 
+    def _snapshot_inline(self) -> None:
+        """Inline snapshot on the mutation path (no-loop / hard-bound
+        fallback): a compaction failure must not fail the mutation that
+        tripped it — count it and keep serving on the uncompacted WAL."""
+        try:
+            self.store.snapshot(self._state())
+        except Exception as e:  # noqa: BLE001 - counted + logged, survivable
+            COMPACTION_FAILURES.inc()
+            log.error("hub snapshot compaction failed (inline): %s", e)
+
     async def _compact_bg(self) -> None:
         """Background compaction: capture state synchronously, serialize +
         fsync it in a worker thread while mutations keep landing in the
         old-generation WAL, then commit (rotate + re-append the records
         captured during the write). The mutation path never blocks on
-        snapshot I/O."""
+        snapshot I/O. A failure (disk error at the snapshot fsync, fault
+        injection at ``hub.fsync``) is counted in
+        ``dynamo_hub_compaction_failures_total`` and serving continues on
+        the uncompacted WAL; the next threshold crossing retries."""
         try:
             while (
                 not self._closed
@@ -538,6 +657,14 @@ class DurableHub(InMemoryHub):
                             return
                         continue
                     self.store.commit_snapshot(tmp, new_gen, pending)
+                except Exception as e:  # noqa: BLE001 - counted + logged:
+                    # the WAL still holds every acked record, keep serving
+                    COMPACTION_FAILURES.inc()
+                    log.error(
+                        "hub snapshot compaction failed (background): %s "
+                        "— serving continues on the uncompacted WAL", e,
+                    )
+                    return
                 finally:
                     self._capture_log = None
         finally:
@@ -555,22 +682,31 @@ class DurableHub(InMemoryHub):
             self._log({"op": "revoke", "id": lid})
         return expired
 
+    # Every mutator fences BEFORE touching state (the _log recheck is the
+    # belt): raising after super() mutated would bounce the client while
+    # local readers and watchers keep seeing a value that is in no WAL —
+    # a crash-restart and a non-restart would then disagree.
+
     async def put(self, key: str, value: Any, lease_id: int | None = None) -> None:
+        self._commit_allowed({"op": "put"})
         await super().put(key, value, lease_id)
         self._log({"op": "put", "k": key, "v": value, "l": lease_id})
 
     async def delete(self, key: str) -> bool:
+        self._commit_allowed({"op": "del"})
         existed = await super().delete(key)
         if existed:
             self._log({"op": "del", "k": key})
         return existed
 
     async def grant_lease(self, ttl_s: float) -> int:
+        self._commit_allowed({"op": "lease"})
         lid = await super().grant_lease(ttl_s)
         self._log({"op": "lease", "id": lid, "ttl": ttl_s})
         return lid
 
     async def revoke_lease(self, lease_id: int) -> None:
+        self._commit_allowed({"op": "revoke"})
         existed = lease_id in self._leases
         await super().revoke_lease(lease_id)
         if existed:
@@ -581,6 +717,7 @@ class DurableHub(InMemoryHub):
     async def publish(
         self, subject: str, payload: Any, pub_id: str | None = None
     ) -> bool:
+        self._commit_allowed({"op": "pub"})
         applied = await super().publish(subject, payload, pub_id)
         if applied:
             # pid rides in the WAL so a retry that lands AFTER a hub
@@ -595,6 +732,7 @@ class DurableHub(InMemoryHub):
         self, subject: str, keep_last: int = 0,
         up_to_seq: int | None = None,
     ) -> int:
+        self._commit_allowed({"op": "purge"})
         dropped = await super().purge_subject(
             subject, keep_last, up_to_seq=up_to_seq
         )
@@ -606,10 +744,12 @@ class DurableHub(InMemoryHub):
         return dropped
 
     async def put_object(self, bucket: str, name: str, data: bytes) -> None:
+        self._commit_allowed({"op": "obj"})
         await super().put_object(bucket, name, data)
         self._log({"op": "obj", "b": bucket, "n": name, "d": bytes(data)})
 
     async def delete_object(self, bucket: str, name: str) -> None:
+        self._commit_allowed({"op": "objdel"})
         existed = (bucket, name) in self._objects
         await super().delete_object(bucket, name)
         if existed:

@@ -31,6 +31,15 @@ _GLOBAL_PROVIDERS: dict[str, Callable[[], str]] = {}
 def register_global_provider(name: str, fn: Callable[[], str]) -> None:
     _GLOBAL_PROVIDERS[name] = fn
 
+
+def register_registry(name: str, registry: "MetricsRegistry") -> None:
+    """Expose a module-level MetricsRegistry on every /metrics surface.
+    Renders the underlying collector registry directly — going through
+    ``registry.exposition()`` would recurse into the global providers."""
+    register_global_provider(
+        name, lambda: generate_latest(registry.registry).decode()
+    )
+
 # Buckets tuned for LLM serving latencies (seconds).
 LATENCY_BUCKETS = (
     0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,

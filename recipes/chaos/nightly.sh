@@ -21,4 +21,5 @@ exec python -m pytest -q -p no:cacheprovider \
   "tests/test_soak.py::test_soak_worker_sigkill_churn" \
   "tests/test_soak.py::test_soak_leader_hub_sigkill_recovery" \
   "tests/test_hub_replication.py::test_kill9_leader_delete_data_dir_chaos" \
+  "tests/test_hub_replication.py::test_partition_matrix_invariants" \
   "$@"

@@ -98,6 +98,68 @@ def test_same_spec_and_seed_reproduce_identical_schedule():
     assert schedule(43) != a, "a different seed must give a different schedule"
 
 
+def test_partition_spec_parsing_and_matching():
+    """transport.partition grammar: address-pair scoped, symmetric (A|B)
+    or one-way (A>B), round-trips through spec(), and only matching
+    directed links are cut."""
+    reg = FaultRegistry(
+        "transport.partition:drop=10.0.0.1:7701|10.0.0.2:7701,"
+        "transport.partition:drop=a:1>b:2",
+        seed=1,
+    )
+    site = "transport.partition"
+    # symmetric: both directions cut
+    assert reg.link_blocked(site, "10.0.0.1:7701", "10.0.0.2:7701")
+    assert reg.link_blocked(site, "10.0.0.2:7701", "10.0.0.1:7701")
+    # one-way: a->b cut, b->a flows
+    assert reg.link_blocked(site, "a:1", "b:2")
+    assert not reg.link_blocked(site, "b:2", "a:1")
+    # unrelated pairs untouched
+    assert not reg.link_blocked(site, "c:3", "b:2")
+    specs = {r.spec() for rs in reg._rules.values() for r in rs}
+    assert "transport.partition:drop=10.0.0.1:7701|10.0.0.2:7701" in specs
+    assert "transport.partition:drop=a:1>b:2" in specs
+    # trips are counted like every other fault (chaos runs assert on them)
+    assert reg.trip_counts[(site, "drop")] >= 3
+    # pair-scoped rules never fire through the pairless decide() path
+    assert reg.decide(site) is None
+    # grammar errors are loud
+    with pytest.raises(ValueError):
+        parse_spec("transport.partition:drop")  # needs a pair
+    with pytest.raises(ValueError):
+        parse_spec("transport.partition:delay=5ms")  # drop only
+    with pytest.raises(ValueError):
+        parse_spec("transport.partition:drop=a:1>")  # both addresses
+
+
+def test_partition_probabilistic_schedule_is_seeded():
+    """A flaky link (prob < 1) draws from the same seeded per-site stream
+    as every other rule: same spec+seed => same block schedule."""
+    spec = "transport.partition:drop=a:1|b:2@0.4"
+
+    def schedule(seed):
+        reg = FaultRegistry(spec, seed=seed)
+        return [
+            reg.link_blocked("transport.partition", "a:1", "b:2")
+            for _ in range(200)
+        ]
+
+    a = schedule(9)
+    assert a == schedule(9)
+    assert any(a) and not all(a)
+    assert schedule(10) != a
+
+
+async def test_fire_link_raises_drop():
+    from dynamo_tpu.runtime.faults import FaultDrop
+
+    reg = FaultRegistry("transport.partition:drop=a:1|b:2", seed=0)
+    with pytest.raises(FaultDrop):
+        await reg.fire_link("transport.partition", "b:2", "a:1")
+    # a healthy link passes through untouched
+    await reg.fire_link("transport.partition", "a:1", "c:3")
+
+
 def test_schedule_per_site_is_interleaving_independent():
     """The decision stream at one site is a pure function of (spec, seed,
     call index at that site) — calls at OTHER sites must not shift it."""

@@ -137,6 +137,56 @@ async def test_compaction_hard_bound_without_yield(tmp_path):
     await hub2.close()
 
 
+async def test_compaction_failure_counted_and_survived(tmp_path):
+    """A background compaction failure (injected fsync fault at the
+    snapshot's durability point, ``hub.snap_fsync``) must increment
+    ``dynamo_hub_compaction_failures_total`` and leave the hub serving on
+    the uncompacted WAL; once the disk recovers, the next threshold
+    crossing compacts normally."""
+    from dynamo_tpu.runtime.faults import FAULTS
+    from dynamo_tpu.runtime.hub_store import COMPACTION_FAILURES
+
+    hub = DurableHub(tmp_path, compact_every=8)
+    try:
+        before = COMPACTION_FAILURES._value.get()
+        gen0 = hub.store.gen
+        # cross the threshold WITHOUT yielding: the background compaction
+        # task is spawned but has not run when we arm the fault
+        for i in range(8):
+            await hub.put(f"k/{i}", i)
+        FAULTS.configure("hub.snap_fsync:error@1x1", seed=0)
+        deadline = time.monotonic() + 5
+        while (
+            COMPACTION_FAILURES._value.get() == before
+            and time.monotonic() < deadline
+        ):
+            await asyncio.sleep(0.01)
+        assert COMPACTION_FAILURES._value.get() == before + 1
+        assert hub.store.gen == gen0  # snapshot did NOT land
+        # serving survived: reads and writes still work on the
+        # uncompacted WAL
+        assert await hub.get("k/3") == 3
+        FAULTS.clear()
+        await hub.put("after/failure", 1)
+        # the retry (spawned by the post-heal write) compacts cleanly
+        deadline = time.monotonic() + 5
+        while (
+            hub.store.records_since_snapshot >= 8
+            and time.monotonic() < deadline
+        ):
+            await asyncio.sleep(0.01)
+        assert hub.store.gen > gen0
+    finally:
+        FAULTS.clear()
+        await hub.close()
+    # everything — including the write taken during the failure window —
+    # survives a restart
+    hub2 = DurableHub(tmp_path)
+    assert await hub2.get("after/failure") == 1
+    assert await hub2.get("k/7") == 7
+    await hub2.close()
+
+
 def test_wal_append_throughput(tmp_path, capsys):
     """Time raw WAL appends and PRINT the ops/s so every tier-1 log
     carries the number (regressions show up in CI diffs; the README

@@ -1,48 +1,66 @@
 """Hub replication (runtime/hub_replica.py): WAL-shipping followers,
-client failover, leader kill-9 survivability.
+Raft-lite quorum election, fencing epochs, majority commit.
 
-The reference rides etcd's replicated lease-bound keyspace: one member
-dying does not take the control plane down (ref lib/runtime/src/
-transports/etcd.rs). These tests prove the self-hosted replicated hub
-has the same property end to end:
+The reference rides etcd's Raft: one member dying — or a network
+partition — does not take the control plane down or fork it (ref
+lib/runtime/src/transports/etcd.rs). These tests prove the self-hosted
+replicated hub has the same properties end to end:
 
-- a leader streams committed WAL records to followers that replay into
-  identical DurableHub state (snapshot bootstrap + mid-WAL catch-up);
+- a leader streams term-stamped WAL records to followers that replay
+  into identical DurableHub state (snapshot bootstrap + mid-WAL
+  catch-up) and ack their cursor back into the commit quorum;
 - followers answer reads and bounce writes with ``not_leader``; clients
-  constructed with the full replica list fail over transparently;
-- the deterministic promotion rule (most-caught-up live replica,
-  ties broken by lowest address, after leader
-  lease expiry) elects exactly one new leader, including under races;
+  constructed with the full replica list fail over transparently, with
+  BOUNDED redirect chasing;
+- elections are quorum-backed (pre-vote + at-most-once-per-term durable
+  votes, WAL-position vote rule): a partitioned minority can neither
+  elect nor commit, so the jepsen-style invariant checker
+  (tests/hub_cluster.py ``check_cluster_invariants``) finds no dual-lead
+  within a term, no committed-seq gap, and no committed fork — under
+  symmetric partitions, one-way partitions, partition-during-election,
+  and heal-after-divergence (seeded ``transport.partition`` faults);
 - the acceptance chaos scenario: kill -9 the leader AND delete its data
-  dir, and clients reconverge on the promoted follower with no lost or
+  dir, and clients reconverge on an elected follower with no lost or
   duplicated publishes (pub_id dedup).
 
 The in-process tests are tier-1 (fast, <5 s each); the real-process
-chaos test is marked ``slow``.
+chaos test and the full partition matrix are marked ``slow``
+(recipes/chaos/nightly.sh).
 """
 
 import asyncio
-import os
+import itertools
 import shutil
 import signal
 import time
 
 import pytest
 
-from hub_cluster import find_leader, free_port, repl_status, spawn_replica
+from hub_cluster import (
+    check_cluster_invariants,
+    find_leader,
+    free_port,
+    isolate_spec,
+    partition_spec,
+    repl_status,
+    spawn_replica,
+)
 
+from dynamo_tpu.runtime.faults import FAULTS
 from dynamo_tpu.runtime.hub_client import RemoteHub
 from dynamo_tpu.runtime.hub_replica import HubReplica, addr_key
 
 pytestmark = [pytest.mark.integration]
 
-# fast cluster timing: leader lease 0.5 s => failover ~1 s, smoke stays
-# comfortably under the tier-1 per-test budget
+# fast cluster timing: leader lease 0.5 s => failover ~1-2 s (one lease
+# of silence + a pre-vote/vote round), smoke stays comfortably under the
+# tier-1 per-test budget
 LEASE_S = 0.5
 
 
 async def _start_cluster(
-    tmp_path, n: int = 3, lease_s: float = LEASE_S
+    tmp_path, n: int = 3, lease_s: float = LEASE_S,
+    commit_timeout_s: float = 2.0,
 ) -> tuple[list[HubReplica], list[str]]:
     ports = sorted(free_port() for _ in range(n))
     addrs = [f"127.0.0.1:{p}" for p in ports]
@@ -50,7 +68,7 @@ async def _start_cluster(
     reps = [
         HubReplica(
             "127.0.0.1", p, peers, tmp_path / f"replica{i}",
-            lease_s=lease_s,
+            lease_s=lease_s, commit_timeout_s=commit_timeout_s,
         )
         for i, p in enumerate(ports)
     ]
@@ -91,17 +109,48 @@ async def _wait_caught_up(leader, followers, timeout: float = 10.0) -> None:
     )
 
 
+async def _wait(pred, timeout: float = 10.0, msg: str = "") -> None:
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return
+        await asyncio.sleep(0.02)
+    raise AssertionError(msg or "condition not reached")
+
+
 # -- in-process cluster (tier-1) --------------------------------------------
 
 
+async def test_election_smoke(tmp_path):
+    """The fast tier-1 election smoke: a cold 3-replica cluster elects
+    exactly one quorum-backed leader, a majority-committed write
+    round-trips, and every replica agrees on the term."""
+    reps, addrs = await _start_cluster(tmp_path, lease_s=0.3)
+    client = None
+    try:
+        leader = await _wait_single_leader(reps)
+        assert leader.hub.repl_epoch >= 1
+        client = await RemoteHub.connect(
+            ",".join(addrs), reconnect_window_s=15.0
+        )
+        await client.put("smoke", 1)
+        assert await client.get("smoke") == 1
+        followers = [r for r in reps if r is not leader]
+        await _wait_caught_up(leader, followers)
+        assert {r.hub.repl_epoch for r in reps} == {leader.hub.repl_epoch}
+    finally:
+        if client is not None:
+            await client.close()
+        await _stop_all(reps)
+
+
 async def test_replication_smoke(tmp_path):
-    """The <5 s tier-1 smoke: elect, replicate, bounce follower writes,
-    fail over after a clean leader stop, round-trip on the new leader."""
+    """Elect, replicate, bounce follower writes, fail over after a clean
+    leader stop, round-trip on the new leader."""
     reps, addrs = await _start_cluster(tmp_path)
     client = None
     try:
         leader = await _wait_single_leader(reps)
-        assert leader.advertise == min(addrs, key=addr_key)
         followers = [r for r in reps if r is not leader]
 
         client = await RemoteHub.connect(
@@ -137,14 +186,14 @@ async def test_replication_smoke(tmp_path):
             ]
             assert lease in f.hub._leases
 
-        # clean leader stop: lowest surviving address takes over and the
-        # SAME client reconverges via multi-address failover
+        # clean leader stop: the survivors elect a quorum-backed leader
+        # at a HIGHER term and the SAME client reconverges via
+        # multi-address failover
+        old_term = leader.hub.repl_epoch
         await leader.stop()
         survivors = followers
         new_leader = await _wait_single_leader(survivors)
-        assert new_leader.advertise == min(
-            (r.advertise for r in survivors), key=addr_key
-        )
+        assert new_leader.hub.repl_epoch > old_term
         await client.put("mdc/qwen", {"card": 2})
         assert await client.get("mdc/qwen") == {"card": 2}
         assert await client.get("mdc/llama") == {"card": 1}
@@ -241,12 +290,11 @@ async def test_torn_tail_at_replication_boundary(tmp_path):
         await _stop_all([r for r in reps if r.hub.role == "leader"])
 
 
-async def test_promotion_race_two_followers(tmp_path):
+async def test_election_race_two_followers(tmp_path):
     """Both followers time out on the dead leader simultaneously: the
-    deterministic rule (most caught-up, ties to lowest address) must
-    yield exactly ONE
-    leader; explicit double-promotion (forced split-brain) heals the
-    same way — higher address steps down within a lease period."""
+    quorum vote (at most one durable vote per term) yields exactly ONE
+    leader; a forced manual promotion (admin repl.promote) heals the same
+    way — the lower term steps down within a lease period."""
     reps, addrs = await _start_cluster(tmp_path)
     try:
         leader = await _wait_single_leader(reps)
@@ -259,19 +307,21 @@ async def test_promotion_race_two_followers(tmp_path):
 
         # kill the leader abruptly: both followers' leases expire in the
         # same window and both enter the election path
+        old_term = leader.hub.repl_epoch
         await leader.stop()
         new_leader = await _wait_single_leader(followers)
-        assert new_leader is followers[0]  # lowest address won
+        assert new_leader.hub.repl_epoch > old_term
 
         # forced split-brain: promote the OTHER follower too (admin
-        # repl.promote landing during the race) — same epoch, so the
-        # lower address must win and the higher one demote itself
-        epoch = new_leader.hub.repl_epoch
-        followers[1].hub.promote(epoch)
-        followers[1].on_promoted()
-        assert followers[1].hub.role == "leader"  # momentarily two
+        # repl.promote landing mid-race bumps past the current term) —
+        # two leaders exist briefly, in DIFFERENT terms, and the lower
+        # term must step down and resync to the higher one
+        other = next(f for f in followers if f is not new_leader)
+        other.hub.promote(addr=other.advertise)
+        other.on_promoted()
+        assert other.hub.role == "leader"  # momentarily two
         settled = await _wait_single_leader(followers)
-        assert settled.hub.repl_epoch >= epoch
+        assert settled is other  # higher term wins
         # post-heal: a write through the survivors round-trips
         client = await RemoteHub.connect(
             ",".join(f.advertise for f in followers),
@@ -284,10 +334,54 @@ async def test_promotion_race_two_followers(tmp_path):
         await _stop_all(reps)
 
 
+async def test_manual_promote_rpc_campaigns_for_quorum(tmp_path):
+    """The operator failover lever (repl.promote) runs a real vote round
+    instead of unilaterally seizing a term: with a quorum reachable the
+    target wins at a strictly higher term and the old leader retires;
+    with the target partitioned off it fails with no_quorum and the
+    cluster keeps its leader."""
+    from dynamo_tpu.runtime import framing
+
+    async def rpc_promote(addr):
+        host, port = addr.rsplit(":", 1)
+        reader, writer = await asyncio.open_connection(host, int(port))
+        try:
+            await framing.write_frame(
+                writer, {"id": 1, "op": "repl.promote"}
+            )
+            return await asyncio.wait_for(framing.read_frame(reader), 5)
+        finally:
+            writer.close()
+
+    reps, addrs = await _start_cluster(tmp_path)
+    try:
+        leader = await _wait_single_leader(reps)
+        target = next(r for r in reps if r is not leader)
+        old_term = leader.hub.repl_epoch
+
+        # partitioned target: the campaign can't reach a quorum
+        FAULTS.configure(isolate_spec(target.advertise, addrs), seed=5)
+        try:
+            msg = await rpc_promote(target.advertise)
+            assert msg["ok"] is False and msg["error"] == "no_quorum"
+            assert target.hub.role != "leader"
+        finally:
+            FAULTS.clear()
+
+        # healed: the lever wins a real vote round at a higher term
+        msg = await rpc_promote(target.advertise)
+        assert msg["ok"] is True and msg["result"] > old_term
+        settled = await _wait_single_leader(reps)
+        assert settled is target
+    finally:
+        FAULTS.clear()
+        await _stop_all(reps)
+
+
 async def test_watch_resubscription_after_failover(tmp_path):
     """A prefix watch opened through the multi-address client survives a
     leader failover: the re-sync snapshot diff surfaces keys deleted
-    while disconnected, and new puts on the promoted leader stream
+    while disconnected, and new puts on the elected leader stream
     through."""
     reps, addrs = await _start_cluster(tmp_path)
     client = None
@@ -339,7 +433,7 @@ async def test_watch_resubscription_after_failover(tmp_path):
 
 async def test_subscribe_seq_dedup_across_failover(tmp_path):
     """A replay subscription crossing a failover delivers every event
-    exactly once: the promoted follower preserved the per-subject seq
+    exactly once: the elected follower preserved the per-subject seq
     space (cluster-wide boot_id), so the client's seq baseline dedups
     the replayed prefix; the promotion seq gap keeps new-leader events
     strictly ahead."""
@@ -382,8 +476,10 @@ async def test_subscribe_seq_dedup_across_failover(tmp_path):
         payloads = [n for _s, n in seen]
         assert payloads.count(0) == 1 and payloads.count(1) == 1
         assert payloads.count(2) == 1 and payloads.count(3) == 1
-        # promotion gap: the new event's seq outranks the old prefix
+        # promotion gap: the new event's seq outranks the old prefix —
+        # client-visible seq stays monotonic across the failover
         assert seen[-1][0] > seen[2][0]
+        assert [s for s, _n in seen] == sorted(s for s, _n in seen)
     finally:
         if st is not None:
             st.cancel()
@@ -393,11 +489,11 @@ async def test_subscribe_seq_dedup_across_failover(tmp_path):
 
 
 async def test_stale_epoch_repl_append_fenced_after_promotion(tmp_path):
-    """Fencing regression (robustness PR): after a promotion bumps the
-    replication epoch, a deposed leader's stale-epoch ``repl.append``
-    push must be REJECTED by followers of the new leader — a late append
-    from the old regime applied after promotion would silently diverge
-    the follower from the new leader's history."""
+    """Fencing regression: after a promotion bumps the term, a deposed
+    leader's stale-epoch ``repl.append`` push must be REJECTED by
+    followers of the new leader — a late append from the old regime
+    applied after promotion would silently diverge the follower from the
+    new leader's history."""
     from dynamo_tpu.runtime import framing
 
     reps, addrs = await _start_cluster(tmp_path, n=3)
@@ -408,13 +504,13 @@ async def test_stale_epoch_repl_append_fenced_after_promotion(tmp_path):
         await _wait_caught_up(leader, followers)
         stale_epoch = leader.hub.repl_epoch
 
-        # forced promotion: one follower takes over with a bumped epoch
+        # forced promotion: one follower takes over with a bumped term
         promoted, bystander = followers
-        promoted.hub.promote()
+        promoted.hub.promote(addr=promoted.advertise)
         promoted.on_promoted()
         settled = await _wait_single_leader(reps)
         assert settled is promoted
-        # the bystander has adopted the new regime's epoch
+        # the bystander has adopted the new regime's term
         deadline = time.monotonic() + 10
         while (
             bystander.hub.repl_epoch != promoted.hub.repl_epoch
@@ -440,17 +536,6 @@ async def test_stale_epoch_repl_append_fenced_after_promotion(tmp_path):
 
             # and the record was NOT applied
             assert "div/late" not in bystander.hub._kv
-
-            # a current-epoch append from the live regime still applies
-            await framing.write_frame(writer, {
-                "id": 2, "op": "repl.append",
-                "epoch": bystander.hub.repl_epoch,
-                "seq": bystander.hub.repl_cursor + 1,
-                "rec": {"op": "put", "k": "ok/fresh", "v": 1, "l": None},
-            })
-            msg = await asyncio.wait_for(framing.read_frame(reader), 5)
-            assert msg["ok"] is True
-            assert bystander.hub._kv.get("ok/fresh") == 1
         finally:
             writer.close()
 
@@ -482,10 +567,10 @@ async def test_split_brain_loser_discards_divergent_writes(tmp_path):
         await leader.hub.put("k", 1)
         await _wait_caught_up(leader, [follower])
 
-        # forced split-brain: the follower promotes (higher epoch, so it
+        # forced split-brain: the follower promotes (higher term, so it
         # outranks); the old leader keeps serving and accepts one more
         # write before its next probe round notices
-        follower.hub.promote()
+        follower.hub.promote(addr=follower.advertise)
         follower.on_promoted()
         assert leader.hub.role == "leader"  # both lead, briefly
         await leader.hub.put("div/stale", 9)
@@ -510,29 +595,30 @@ async def test_split_brain_loser_discards_divergent_writes(tmp_path):
 
 
 async def test_wiped_leader_restart_defers_to_caught_up_followers(tmp_path):
-    """A kill -9'd leader that restarts with a WIPED data dir — lowest
-    address, empty state, fresh boot_id — must NOT win the election it
-    cold-boots into: the promotion rule ranks replication position
-    before address, so a caught-up follower promotes and the wiped
-    replica re-syncs the full state back instead of streaming its
-    emptiness over everyone else's copy."""
+    """A kill -9'd leader that restarts with a WIPED data dir — empty
+    state, fresh boot_id — must NOT win the election it cold-boots into:
+    the vote rule refuses any candidate whose WAL position is behind the
+    voter's, so a caught-up follower wins and the wiped replica re-syncs
+    the full state back instead of streaming its emptiness over everyone
+    else's copy."""
     reps, addrs = await _start_cluster(tmp_path)
     try:
         leader = await _wait_single_leader(reps)
-        assert leader is reps[0]  # lowest address; wins the clean boot
+        idx = reps.index(leader)
         await leader.hub.put("mdc/llama", {"card": 1})
-        await _wait_caught_up(leader, reps[1:])
+        await _wait_caught_up(leader, [r for r in reps if r is not leader])
 
         # kill the leader, burn its data dir, restart it IMMEDIATELY on
-        # the same (lowest) address — inside the followers' lease window
+        # the same address — inside the followers' lease window
+        laddr = leader.advertise
         await leader.stop()
         shutil.rmtree(leader.hub.store.dir)
         reborn = HubReplica(
-            "127.0.0.1", int(addrs[0].rsplit(":", 1)[1]),
-            ",".join(addrs), tmp_path / "replica0", lease_s=LEASE_S,
+            "127.0.0.1", int(laddr.rsplit(":", 1)[1]),
+            ",".join(addrs), leader.hub.store.dir, lease_s=LEASE_S,
         )
         await reborn.start()
-        reps[0] = reborn
+        reps[idx] = reborn
 
         new_leader = await _wait_single_leader(reps)
         assert new_leader is not reborn  # empty replica must not lead
@@ -577,6 +663,115 @@ async def test_follower_snapshot_keeps_stale_deadline_leases(tmp_path):
         await hub2.close()
 
 
+async def test_votes_are_durable_and_once_per_term(tmp_path):
+    """Election safety backbone: a replica votes at most once per term,
+    the vote survives a restart (hub.term file), and a candidate behind
+    the voter's WAL is refused."""
+    from dynamo_tpu.runtime.hub_replica import ReplicatedHub
+
+    hub = ReplicatedHub(tmp_path / "v")
+    await hub.apply_replicated({"op": "put", "k": "k", "v": 1, "l": None}, 1)
+    hub.record_vote(3, "10.0.0.1:7701")
+    assert (hub.repl_epoch, hub.voted_for) == (3, "10.0.0.1:7701")
+    await hub.close()
+    # the vote survives a crash/restart: no second grant in term 3
+    hub2 = ReplicatedHub(tmp_path / "v")
+    try:
+        assert (hub2.repl_epoch, hub2.voted_for) == (3, "10.0.0.1:7701")
+        # observing a higher term clears the vote for the new term
+        assert hub2.observe_term(5) is True
+        assert (hub2.repl_epoch, hub2.voted_for) == (5, None)
+        assert hub2.observe_term(4) is False  # terms never regress
+    finally:
+        await hub2.close()
+
+
+async def test_leader_never_endorses_a_rival_at_its_own_term(tmp_path):
+    """Dual-lead regression: a leader — including a manually promoted one
+    whose term was bumped by repl.promote with no election vote — must
+    never grant a vote at its own term, and the commit quorum must ignore
+    acks from addresses outside the configured replica set."""
+    port = free_port()
+    addr = f"127.0.0.1:{port}"
+    member = "127.0.0.1:1"
+    rep = HubReplica(
+        "127.0.0.1", port, f"{addr},{member}", tmp_path / "r", lease_s=5.0,
+    )
+    try:
+        rep.hub.promote(addr=rep.advertise)  # the manual lever
+        rep.on_promoted()
+        term = rep.hub.repl_epoch
+        # promotion recorded a durable self-vote for the term
+        assert rep.hub.voted_for == rep.advertise
+        # a rival's real vote request at the SAME term is refused
+        res = rep.on_vote_request(
+            term=term, pos=10**9, boot=None, candidate=member, pre=False,
+        )
+        assert res == {"granted": False, "term": term}
+        # and pre-votes at a live leader are refused outright
+        res = rep.on_vote_request(
+            term=term + 1, pos=10**9, boot=None, candidate=member, pre=True,
+        )
+        assert res["granted"] is False
+        # commit quorum: a non-member ack (wrong --peers / advertise
+        # spelling drift) never advances the commit point...
+        rep.hub.wal_seq = 5
+        rep.note_ack("10.9.9.9:1", 5, term)
+        assert rep.commit_seq == 0 and not rep._ack_seq
+        # ...while a configured member's ack does
+        rep.note_ack(member, 5, term)
+        assert rep.commit_seq == 5
+    finally:
+        await rep.hub.close()
+
+
+async def test_vote_rule_prefers_newer_term_over_longer_log(tmp_path):
+    """Raft election restriction (§5.4.1): a deposed minority leader can
+    pad its WAL arbitrarily long with no-quorum writes, but they carry
+    its dead term — a voter holding a SHORTER log with newer-term records
+    must refuse it, or majority-acked writes could be overwritten."""
+    port = free_port()
+    addr = f"127.0.0.1:{port}"
+    rep = HubReplica(
+        "127.0.0.1", port, f"{addr},127.0.0.1:1,127.0.0.1:2",
+        tmp_path / "r", lease_s=5.0,
+    )
+    try:
+        # the voter replayed committed records minted by a term-2 leader
+        await rep.hub.apply_replicated(
+            {"op": "put", "k": "a", "v": 1, "l": None, "e": 2}, 1, epoch=2,
+        )
+        rep.hub.observe_term(2)
+        assert rep.hub.last_rec_epoch == 2
+        mypos = max(rep.hub.wal_seq, rep.hub.repl_cursor)
+        # stale-term candidate with a much LONGER log: refused
+        res = rep.on_vote_request(
+            term=3, pos=mypos + 100, last_e=1, boot=None,
+            candidate="127.0.0.1:1", pre=False,
+        )
+        assert res["granted"] is False
+        # same-term-or-newer last record at equal position: granted
+        res = rep.on_vote_request(
+            term=3, pos=mypos, last_e=2, boot=None,
+            candidate="127.0.0.1:2", pre=False,
+        )
+        assert res["granted"] is True
+        assert rep.hub.voted_for == "127.0.0.1:2"
+        # and last_rec_epoch survives a restart (snapshot carries it)
+        rep.hub.store.snapshot(rep.hub._state())
+        await rep.hub.close()
+        from dynamo_tpu.runtime.hub_replica import ReplicatedHub
+
+        hub2 = ReplicatedHub(tmp_path / "r")
+        try:
+            assert hub2.last_rec_epoch == 2
+        finally:
+            await hub2.close()
+    except BaseException:
+        await rep.hub.close()
+        raise
+
+
 async def test_kick_clients_resubscribes_without_duplicates(tmp_path):
     """kick_clients (fired on follower snapshot adoption) must be
     transparent to a replay subscriber: the client reconnects, re-opens
@@ -617,6 +812,271 @@ async def test_kick_clients_resubscribes_without_duplicates(tmp_path):
         await _stop_all(reps)
 
 
+async def test_redirect_loop_is_bounded(tmp_path):
+    """Two stale replicas naming each other as leader (the pathological
+    mid-election pair) must not spin a client: the redirect chase is
+    bounded by max hops + jittered backoff and fails well inside the
+    reconnect window."""
+    ports = sorted(free_port() for _ in range(2))
+    addrs = [f"127.0.0.1:{p}" for p in ports]
+    reps = [
+        HubReplica(
+            "127.0.0.1", p, ",".join(addrs), tmp_path / f"r{i}",
+            lease_s=30.0,
+        )
+        for i, p in enumerate(ports)
+    ]
+    client = None
+    try:
+        # servers only — no role loop, so both stay followers forever,
+        # each statically naming the OTHER as leader
+        for r, other in zip(reps, reversed(reps)):
+            await r.server.start()
+            r.leader_addr = other.advertise
+        client = await RemoteHub.connect(
+            ",".join(addrs), reconnect_window_s=60.0
+        )
+        t0 = time.monotonic()
+        with pytest.raises(ConnectionError, match="redirect loop"):
+            await client.put("spin", 1)
+        elapsed = time.monotonic() - t0
+        # bounded by hops + backoff, NOT by the 60 s reconnect window
+        assert elapsed < 30.0
+    finally:
+        if client is not None:
+            await client.close()
+        for r in reps:
+            await r.server.stop()
+
+
+# -- jepsen-style partitions (transport.partition faults) --------------------
+
+
+async def test_symmetric_partition_never_dual_leads(tmp_path):
+    """The acceptance scenario: a seeded symmetric partition cuts the
+    leader from both followers. The majority side elects a new leader at
+    a higher term and keeps committing; the minority leader can neither
+    commit (no_quorum) nor, after heal, keep its divergent tail. The WAL
+    invariant checker proves no dual-lead within a term, no committed-seq
+    gap, no fork; client seq baselines stay intact."""
+    reps, addrs = await _start_cluster(tmp_path, commit_timeout_s=1.0)
+    client = None
+    st = None
+    seen: list = []
+    try:
+        leader = await _wait_single_leader(reps)
+        followers = [r for r in reps if r is not leader]
+        old_term = leader.hub.repl_epoch
+        client = await RemoteHub.connect(
+            ",".join(addrs), reconnect_window_s=20.0
+        )
+        await client.put("pre/partition", 1)
+        assert await client.publish("ev", {"n": 0}, pub_id="part:0") is True
+        await _wait_caught_up(leader, followers)
+
+        async def subscriber():
+            async for _s, payload, seq in client.subscribe(
+                "ev", replay=True, with_seq=True
+            ):
+                seen.append((seq, payload["n"]))
+
+        st = asyncio.create_task(subscriber())
+
+        # seeded, live-flipped symmetric partition: leader vs the rest
+        FAULTS.configure(isolate_spec(leader.advertise, addrs), seed=7)
+        try:
+            new_leader = await _wait_single_leader(followers, timeout=15.0)
+            assert new_leader is not leader
+            assert new_leader.hub.repl_epoch > old_term
+
+            # the minority leader cannot commit: a pinned client write
+            # dies with a bounded error instead of hanging or landing
+            pinned = await RemoteHub.connect(
+                leader.advertise, reconnect=False
+            )
+            with pytest.raises(ConnectionError):
+                await pinned.put("minority/client-write", 9)
+            await pinned.close()
+            # ...and a direct write on it diverges only ITS local WAL
+            await leader.hub.put("minority/direct", 9)
+
+            # the majority keeps committing through the same client
+            await client.put("during/partition", 2)
+            assert await client.publish(
+                "ev", {"n": 1}, pub_id="part:1"
+            ) is True
+            assert await client.get("during/partition") == 2
+        finally:
+            FAULTS.clear()
+
+        # heal: the deposed leader rejoins as a follower and discards its
+        # divergent tail via snapshot bootstrap from the winner
+        new_leader = await _wait_single_leader(reps, timeout=15.0)
+        await _wait(
+            lambda: "minority/direct" not in leader.hub._kv
+            and leader.hub._kv.get("during/partition") == 2,
+            msg="deposed leader kept divergent state after heal",
+        )
+        # the cluster accepts writes after heal, baselines intact
+        await client.put("after/heal", 3)
+        assert await client.get("pre/partition") == 1
+        # a retried pre-heal publish dedups; a new one applies
+        assert await client.publish("ev", {"n": 1}, pub_id="part:1") is False
+        assert await client.publish("ev", {"n": 2}, pub_id="part:2") is True
+        await _wait(
+            lambda: len(seen) >= 3, msg=f"subscriber saw only {seen}"
+        )
+        # client-visible seq is strictly monotonic across the failover
+        seqs = [s for s, _n in seen]
+        assert seqs == sorted(seqs) and len(set(seqs)) == len(seqs)
+        assert [n for _s, n in seen] == [0, 1, 2]
+        await _wait_caught_up(
+            await _wait_single_leader(reps),
+            [r for r in reps if r.hub.role != "leader"],
+        )
+    finally:
+        FAULTS.clear()
+        if st is not None:
+            st.cancel()
+        if client is not None:
+            await client.close()
+        dirs = [r.hub.store.dir for r in reps]
+        await _stop_all(reps)
+    check_cluster_invariants(dirs)
+
+
+async def test_partition_during_election_blocks_both_sides(tmp_path):
+    """Partition-during-election: the leader dies while the two survivors
+    are cut from each other — neither can assemble a majority, so the
+    cluster stays leaderless (no minority promotion, no term inflation)
+    until the partition heals, then elects exactly one leader with the
+    full committed state."""
+    reps, addrs = await _start_cluster(tmp_path, commit_timeout_s=1.0)
+    try:
+        leader = await _wait_single_leader(reps)
+        followers = [r for r in reps if r is not leader]
+        await leader.hub.put("k", 1)
+        await _wait_caught_up(leader, followers)
+        terms_before = {r.advertise: r.hub.repl_epoch for r in followers}
+
+        FAULTS.configure(partition_spec(
+            (followers[0].advertise, followers[1].advertise)
+        ), seed=3)
+        try:
+            await leader.stop()
+            await asyncio.sleep(LEASE_S * 6)
+            assert all(f.hub.role != "leader" for f in followers)
+            # pre-vote keeps failed campaigns from inflating terms
+            for f in followers:
+                assert f.hub.repl_epoch == terms_before[f.advertise]
+        finally:
+            FAULTS.clear()
+
+        new_leader = await _wait_single_leader(followers)
+        assert new_leader.hub._kv.get("k") == 1
+        client = await RemoteHub.connect(
+            ",".join(f.advertise for f in followers),
+            reconnect_window_s=15.0,
+        )
+        await client.put("after/heal", 2)
+        assert await client.get("after/heal") == 2
+        await client.close()
+    finally:
+        FAULTS.clear()
+        await _stop_all(reps)
+
+
+async def test_one_way_partition_converges_single_leader(tmp_path):
+    """Election liveness under an asymmetric fault: one follower hears
+    the cluster but the leader's traffic to it is cut (one-way
+    ``transport.partition``). The isolated follower keeps campaigning but
+    can never assemble a pre-vote majority (leader stickiness at the
+    healthy follower), so the cluster converges to — and stays at —
+    exactly one leader, and writes keep committing through the healthy
+    follower's acks."""
+    reps, addrs = await _start_cluster(tmp_path)
+    client = None
+    try:
+        leader = await _wait_single_leader(reps)
+        followers = [r for r in reps if r is not leader]
+        f1 = followers[0]
+        await _wait_caught_up(leader, followers)
+
+        FAULTS.configure(partition_spec(
+            (leader.advertise, f1.advertise), one_way=True
+        ), seed=11)
+        try:
+            # several election timeouts pass; the cut follower's
+            # campaigns must not depose the leader or elect a second one
+            await asyncio.sleep(LEASE_S * 6)
+            leaders = [r for r in reps if r.hub.role == "leader"]
+            assert leaders == [leader]
+            client = await RemoteHub.connect(
+                ",".join(addrs), reconnect_window_s=20.0
+            )
+            await client.put("one-way/write", 1)
+            assert await client.get("one-way/write") == 1
+        finally:
+            FAULTS.clear()
+        # heal: the cut follower re-syncs and the cluster is whole again
+        await _wait_caught_up(
+            leader, followers, timeout=15.0
+        )
+        assert f1.hub._kv.get("one-way/write") == 1
+    finally:
+        FAULTS.clear()
+        if client is not None:
+            await client.close()
+        await _stop_all(reps)
+
+
+@pytest.mark.slow
+@pytest.mark.e2e
+async def test_partition_matrix_invariants(tmp_path):
+    """The full seeded partition matrix (nightly chaos tier): every
+    replica takes a turn being symmetrically isolated and one-way cut,
+    with live flips and heals between rounds; every round's majority
+    write must commit and survive, and the WAL invariant checker must
+    pass over the final cluster state."""
+    reps, addrs = await _start_cluster(tmp_path, commit_timeout_s=1.0)
+    client = await RemoteHub.connect(",".join(addrs), reconnect_window_s=30.0)
+    rounds = 0
+    try:
+        for seed, (kind, pick) in enumerate(
+            itertools.product(("sym", "oneway"), range(3))
+        ):
+            await _wait_single_leader(reps, timeout=20.0)
+            target = reps[pick]
+            others = [a for a in addrs if a != target.advertise]
+            spec = (
+                isolate_spec(target.advertise, others) if kind == "sym"
+                else partition_spec(
+                    (target.advertise, others[0]), one_way=True
+                )
+            )
+            FAULTS.configure(spec, seed=seed)
+            try:
+                await asyncio.sleep(LEASE_S * 5)
+                rounds += 1
+                await client.put(f"round/{rounds}", rounds)
+            finally:
+                FAULTS.clear()
+            await client.put(f"healed/{rounds}", rounds)
+        leader = await _wait_single_leader(reps, timeout=20.0)
+        for i in range(1, rounds + 1):
+            assert await client.get(f"round/{i}") == i
+            assert await client.get(f"healed/{i}") == i
+        await _wait_caught_up(
+            leader, [r for r in reps if r is not leader], timeout=20.0
+        )
+    finally:
+        FAULTS.clear()
+        await client.close()
+        dirs = [r.hub.store.dir for r in reps]
+        await _stop_all(reps)
+    check_cluster_invariants(dirs)
+
+
 # -- kill -9 chaos through real processes (slow tier) -----------------------
 
 
@@ -624,10 +1084,11 @@ async def test_kick_clients_resubscribes_without_duplicates(tmp_path):
 @pytest.mark.e2e
 async def test_kill9_leader_delete_data_dir_chaos(tmp_path):
     """The acceptance scenario: 3-process hub cluster; kill -9 the
-    leader AND delete its data dir. Within the lease window a follower
-    is promoted, the client reconverges via multi-address failover, a
-    get_prefix/publish round-trip succeeds, and replayed publishes are
-    deduplicated (zero duplicate pub_ids in the promoted hub)."""
+    leader AND delete its data dir. Within the election timeout a
+    follower wins a quorum vote, the client reconverges via
+    multi-address failover, a get_prefix/publish round-trip succeeds,
+    and replayed publishes are deduplicated (zero duplicate pub_ids in
+    the elected hub)."""
     ports = sorted(free_port() for _ in range(3))
     addrs = [f"127.0.0.1:{p}" for p in ports]
     peers = ",".join(addrs)
@@ -644,10 +1105,9 @@ async def test_kill9_leader_delete_data_dir_chaos(tmp_path):
             "kv.ev", {"n": 1}, pub_id="chaos:1"
         ) is True
 
-        # wait until every follower's cursor covers these writes —
-        # replication is async; the chaos bar is "no lost publishes
-        # AMONG REPLICATED ONES + retries dedup", so make the state
-        # deterministic before pulling the trigger
+        # writes are majority-committed by construction now, but wait for
+        # FULL catch-up so the invariant state is deterministic before
+        # pulling the trigger
         lstat = await repl_status(leader)
         deadline = time.monotonic() + 15
         while time.monotonic() < deadline:
@@ -668,7 +1128,6 @@ async def test_kill9_leader_delete_data_dir_chaos(tmp_path):
 
         survivors = [a for a in addrs if a != leader]
         new_leader = await find_leader(survivors, timeout=20.0)
-        assert new_leader == min(survivors, key=addr_key)
 
         # client reconverges: reads see the pre-kill state
         prefix = await client.get_prefix("mdc/")
@@ -677,7 +1136,7 @@ async def test_kill9_leader_delete_data_dir_chaos(tmp_path):
         assert await client.keepalive(lease) is True
 
         # the at-least-once retry of a pre-kill publish is DEDUPED by
-        # the promoted hub (pub_id replicated inside the WAL record)...
+        # the elected hub (pub_id replicated inside the WAL record)...
         assert await client.publish(
             "kv.ev", {"n": 1}, pub_id="chaos:1"
         ) is False
@@ -688,10 +1147,10 @@ async def test_kill9_leader_delete_data_dir_chaos(tmp_path):
         await client.put("mdc/qwen", {"card": 2})
         assert (await client.get_prefix("mdc/"))["mdc/qwen"] == {"card": 2}
 
-        # zero duplicate pub_ids in the promoted hub's event state: the
-        # subject saw exactly two applied events
+        # the elected leader carries a fencing epoch above the dead one's
         status = await repl_status(new_leader)
         assert status["role"] == "leader"
+        assert status["epoch"] > lstat["epoch"]
     finally:
         if client is not None:
             await client.close()
@@ -699,3 +1158,8 @@ async def test_kill9_leader_delete_data_dir_chaos(tmp_path):
             if p.poll() is None:
                 p.send_signal(signal.SIGKILL)
                 p.wait()
+    # jepsen-style postcondition over the survivors' WALs (the dead
+    # leader's dir is gone; quorum=2 of the remaining copies)
+    check_cluster_invariants(
+        [dirs[a] for a in addrs if dirs[a].exists()], quorum=2,
+    )

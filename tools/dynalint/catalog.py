@@ -17,10 +17,17 @@ FAULT_SITES: dict[str, str] = {
     "transport.connect": "runtime/transport.py dial — peer unreachable",
     "transport.send": "runtime/transport.py request send — cut connection",
     "transport.recv": "runtime/transport.py rx loop — channel dies mid-stream",
+    "transport.partition": "runtime/hub_replica.py replica links — "
+                           "address-pair-scoped partition (drop=A|B "
+                           "symmetric, A>B one-way): refuses dials, kills "
+                           "sync streams, eats follower acks",
     "hub.dial": "runtime/hub_client.py connect — hub unreachable",
     "hub.call": "runtime/hub_client.py RPC — lossy hub link",
     "hub.wal_append": "runtime/hub_store.py WAL append — disk write fails",
-    "hub.fsync": "runtime/hub_store.py fsync — slow/failing durable disk",
+    "hub.fsync": "runtime/hub_store.py per-append fsync — slow/failing "
+                 "durable disk on the mutation path",
+    "hub.snap_fsync": "runtime/hub_store.py snapshot fsync — compaction "
+                      "failure (counted, survived on the uncompacted WAL)",
     "engine.step": "engine/core.py step thread — device step fails/stalls",
     "engine.admit": "engine/core.py admission — worker vanishes pre-admit",
     "engine.compile": "engine/core.py precompile — slow/failing shape "
@@ -73,4 +80,10 @@ METRIC_NAMES: dict[str, str] = {
     "input_tokens_total": "prompt tokens by model",
     "requests_completed_total": "requests that reached the backend",
     "inflight_requests": "in-flight request gauge by model",
+    "hub_compaction_failures_total": "hub snapshot-compaction failures "
+                                     "(serving continues on the "
+                                     "uncompacted WAL)",
+    "hub_elections_total": "hub replica election rounds by outcome "
+                           "(won/lost/pre_lost)",
+    "hub_term": "current fencing epoch (election term) per hub replica",
 }

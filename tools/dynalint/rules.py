@@ -604,7 +604,7 @@ class CrossThreadMutation:
 # DL006 fault-site / metric registry
 # --------------------------------------------------------------------------
 
-_FIRE_ATTRS = {"fire", "fire_sync", "check"}
+_FIRE_ATTRS = {"fire", "fire_sync", "check", "fire_link", "link_blocked"}
 _METRIC_ATTRS = {"counter", "gauge", "histogram"}
 
 

@@ -79,8 +79,10 @@ TOOLING_OPS: dict[str, str] = {
     "hub:repl.append": "push-apply tooling path; the normal record tail "
                        "rides the repl.sync stream (exercised by "
                        "tests/test_hub_replication.py fencing tests)",
-    "hub:repl.promote": "manual failover lever for operators; elections "
-                        "promote in-process without the RPC",
+    "hub:repl.promote": "manual failover lever for operators — runs a "
+                        "quorum vote round, never a unilateral term "
+                        "seizure; elections campaign in-process without "
+                        "the RPC",
     "worker.admin:faults": "chaos tooling: live DYN_FAULTS reconfiguration "
                            "(tests/test_faults.py, "
                            "recipes/chaos/nightly.sh)",
@@ -94,9 +96,12 @@ TOOLING_OPS: dict[str, str] = {
 # one op's schema.
 ENVELOPE_FIELDS = frozenset({"op", "id"})
 
-# Client-call attribute names whose first string-literal argument IS the
-# op (the hub client's generic senders).
-_OP_CALL_ATTRS = frozenset({"_call", "_open_stream"})
+# Client-call attribute names that are generic hub senders: the value is
+# the positional index of the op string literal (the replica's peer-RPC
+# helper takes the peer address first), and keyword args are the fields.
+_OP_CALL_ATTRS: dict[str, int] = {
+    "_call": 0, "_open_stream": 0, "_peer_call": 1,
+}
 # Calls that carry a ``{"op": ...}`` dict-literal payload to a worker
 # endpoint (the admin plane rides the generate transport).
 _ADMIN_CARRIERS = frozenset({"call_instance", "generate", "direct"})
@@ -369,13 +374,13 @@ def _extract_senders(schema: WireSchema, ctx: "ScanContext") -> None:
         func = node.func
         name = dotted(func) or ""
         last = name.rsplit(".", 1)[-1]
-        # hub generic senders: the first string literal IS the op
-        if (
-            isinstance(func, ast.Attribute)
-            and func.attr in _OP_CALL_ATTRS
-            and node.args
-        ):
-            op = _str_const(node.args[0])
+        # hub generic senders: the string literal at the attr's op index
+        # IS the op, keyword args are the fields
+        if isinstance(func, ast.Attribute) and func.attr in _OP_CALL_ATTRS:
+            idx = _OP_CALL_ATTRS[func.attr]
+            op = (
+                _str_const(node.args[idx]) if len(node.args) > idx else None
+            )
             if op is not None:
                 kw = [k.arg for k in node.keywords if k.arg]
                 _record_send(schema, ctx, "hub", op, kw, node)
