@@ -490,6 +490,206 @@ def serving_measurement(
     return asyncio.run(run())
 
 
+def spec_decode_measurement(
+    spec, page_size: int, on_tpu: bool,
+    family: str = "gqa",
+    concurrencies: tuple[int, ...] | None = None,
+    osl: int | None = None,
+    reqs_per_stream: int | None = None,
+) -> dict:
+    """Speculative-decoding micro-benchmark (ROADMAP #6 evidence): the
+    SAME repetitive/agentic synthetic workload through two real engines,
+    ``spec_mode=ngram`` vs ``off``, at low closed-loop concurrency (the
+    regime speculation targets — per-stream latency, not saturated
+    throughput).
+
+    Per rung: ``per_stream_toks_s`` both modes + the ratio, the
+    ``acceptance_rate`` of drafted tokens, and
+    ``accepted_tokens_per_dispatch`` — tokens each verify dispatch
+    landed (accepted drafts + the emitted target token) against the
+    1.0/dispatch non-spec decode baseline. The last one is the CPU
+    step-count proxy for the speedup claim: wall-clock on a shared CI
+    host is noise, dispatch counts are exact. Engines run the
+    latency-oriented config (burst 1, pipelined d2h, reprobe 16) —
+    speculation composes with bursts for parked slots, but the claim
+    under test is the low-concurrency one.
+
+    Greedy outputs are bit-identical between the two engines by
+    construction (accept-longest-prefix against the target argmax); the
+    tier-1 golden suite (tests/test_spec_decode.py) pins that, so this
+    measurement only reports speed."""
+    import asyncio
+
+    from dynamo_tpu.engine.config import EngineConfig
+    from dynamo_tpu.engine.core import InferenceEngine
+    from dynamo_tpu.runtime.context import Context
+
+    ISL = 64
+    OSL = osl or 96
+    reqs = reqs_per_stream or (4 if on_tpu else 2)
+    rungs = list(concurrencies or ((1, 2, 3, 4) if on_tpu else (1, 2)))
+    SLOTS = max(rungs) * 2
+    pps = (ISL + OSL + page_size - 1) // page_size + 2
+
+    def build(mode: str) -> EngineConfig:
+        return EngineConfig(
+            page_size=page_size,
+            num_pages=SLOTS * pps + 64,
+            max_pages_per_seq=pps,
+            max_decode_slots=SLOTS,
+            prefill_buckets=(64, 128),
+            # latency mode: one decode step per dispatch — per-stream
+            # tok/s is dispatch-floor-bound, which is exactly the floor
+            # speculation amortizes
+            decode_steps_per_dispatch=1,
+            pipeline_decode=True,
+            spec_mode=mode,
+            spec_reprobe_tokens=16,
+        )
+
+    rng = np.random.default_rng(0)
+    base = rng.integers(3, spec.vocab_size, 12).tolist()
+    # incompressible control: a random-token prompt the drafter can't
+    # predict — the adaptive-k decay must make spec mode cost ~nothing
+    # here (the <5% overhead criterion, measured in exact dispatch
+    # counts: a handful of decay verifies then pure burst decoding)
+    random_prompt = rng.integers(3, spec.vocab_size, ISL).tolist()
+    # repetitive/agentic shape: one phrase repeated (tool-loop /
+    # quoted-context analogue); shared across streams like real agentic
+    # traffic shares its system prefix — the prefix cache absorbing the
+    # prefill repeats is part of the scenario, and both engines (spec
+    # on and off) get the identical benefit
+    the_prompt = (base * ((ISL // len(base)) + 1))[:ISL]
+
+    def prompt(sid: int) -> list[int]:
+        return the_prompt
+
+    async def run() -> dict:
+        out_rungs: list[dict] = []
+        per_mode: dict[str, list[dict]] = {}
+        for mode in ("ngram", "off"):
+            engine = InferenceEngine(spec, build(mode))
+            # full shape warmup incl. the verify grid: a rung window
+            # must never eat a compile (the same contract serving gets
+            # from --precompile)
+            engine.precompile()
+            await engine.start()
+
+            async def one(sid: int, n: int, tag: str, eng=engine):
+                async for _ in eng.generate(
+                    {"token_ids": prompt(sid),
+                     "stop_conditions": {"max_tokens": n,
+                                         "ignore_eos": True},
+                     "sampling": {"temperature": 0.0}},
+                    Context(f"spec-{tag}-{sid}"),
+                ):
+                    pass
+
+            # warm the eager host glue (feeds, stacks) precompile's
+            # jitted-program warmup does not cover
+            await asyncio.gather(
+                *(one(sid, 4, "warm") for sid in range(max(rungs)))
+            )
+            rows: list[dict] = []
+            for c in rungs:
+                d0 = engine.dispatches
+                v0, a0, r0 = (engine.spec_verifies, engine.spec_accepted,
+                              engine.spec_rejected)
+                t0 = time.perf_counter()
+
+                async def stream(sid: int, eng=engine):
+                    for _ in range(reqs):
+                        await one(sid, OSL, "run")
+
+                await asyncio.gather(*(stream(s) for s in range(c)))
+                dt = time.perf_counter() - t0
+                verifies = engine.spec_verifies - v0
+                accepted = engine.spec_accepted - a0
+                rejected = engine.spec_rejected - r0
+                judged = accepted + rejected
+                rows.append({
+                    "concurrency": c,
+                    "per_stream_toks_s": round(reqs * OSL / dt, 1),
+                    "dispatches": engine.dispatches - d0,
+                    "verifies": verifies,
+                    "acceptance_rate": (
+                        round(accepted / judged, 4) if judged else None
+                    ),
+                    "accepted_tokens_per_dispatch": (
+                        round((accepted + verifies) / verifies, 3)
+                        if verifies else None
+                    ),
+                })
+            # incompressible control at concurrency 1: same engine,
+            # random-token prompt — records the decayed-k overhead
+            d0 = engine.dispatches
+            t0 = time.perf_counter()
+            async for _ in engine.generate(
+                {"token_ids": random_prompt,
+                 "stop_conditions": {"max_tokens": OSL,
+                                     "ignore_eos": True},
+                 "sampling": {"temperature": 0.0}},
+                Context(f"spec-rand-{mode}"),
+            ):
+                pass
+            rows.append({
+                "concurrency": "incompressible-control",
+                "per_stream_toks_s": round(
+                    OSL / (time.perf_counter() - t0), 1
+                ),
+                "dispatches": engine.dispatches - d0,
+            })
+            await engine.close()
+            per_mode[mode] = rows
+        ctl_on = per_mode["ngram"].pop()
+        ctl_off = per_mode["off"].pop()
+        for on, off in zip(per_mode["ngram"], per_mode["off"]):
+            out_rungs.append({
+                **on,
+                "per_stream_toks_s_nospec": off["per_stream_toks_s"],
+                "dispatches_nospec": off["dispatches"],
+                "speedup": round(
+                    on["per_stream_toks_s"]
+                    / max(off["per_stream_toks_s"], 1e-9), 2,
+                ),
+            })
+        r1 = out_rungs[0]
+        return {
+            "mode": "prompt-lookup spec decode",
+            "family": family,
+            "workload": "repetitive-agentic synthetic",
+            "isl": ISL, "osl": OSL, "reqs_per_stream": reqs,
+            "k_max": build("ngram").spec_k_max,
+            "rungs": out_rungs,
+            # headline fields at concurrency 1 (the acceptance bar:
+            # accepted tokens per verify dispatch >= 1.5 on this
+            # workload, i.e. >= 1.5x the non-spec step-count proxy)
+            "per_stream_toks_s": r1["per_stream_toks_s"],
+            "acceptance_rate": r1["acceptance_rate"],
+            "accepted_tokens_per_dispatch":
+                r1["accepted_tokens_per_dispatch"],
+            # decayed-k cost on a prompt speculation can't help: extra
+            # dispatches as a fraction of the non-spec count (the <5%
+            # overhead criterion, dispatch-exact on CPU)
+            "incompressible_control": {
+                "dispatches": ctl_on["dispatches"],
+                "dispatches_nospec": ctl_off["dispatches"],
+                "dispatch_overhead_frac": round(
+                    ctl_on["dispatches"]
+                    / max(ctl_off["dispatches"], 1) - 1.0, 4,
+                ),
+                "per_stream_toks_s": ctl_on["per_stream_toks_s"],
+                "per_stream_toks_s_nospec": ctl_off["per_stream_toks_s"],
+            },
+            "bars": {
+                "accepted_tokens_per_dispatch_min": 1.5,
+                "incompressible_dispatch_overhead_max": 0.05,
+            },
+        }
+
+    return asyncio.run(run())
+
+
 def raw_decode(
     spec: ModelSpec, B: int, page_size: int, pages_per_seq: int,
     repeats: int = 1,
@@ -649,6 +849,13 @@ def main() -> None:
         frac, rung_c = frac_of_raw(out["serving"], value, B)
         out["serving"]["frac_of_raw_decode"] = frac
         out["serving"]["frac_rung_concurrency"] = rung_c
+    if os.environ.get("DYNAMO_BENCH_SPEC", "1") not in ("0", "false"):
+        # speculative decoding at low concurrency (ROADMAP #6): spec-on
+        # vs spec-off per-stream tok/s + acceptance on the repetitive
+        # synthetic workload, per family
+        out["spec_decode"] = spec_decode_measurement(
+            spec, page_size, on_tpu, family=family
+        )
     # the OTHER flagship families' on-chip numbers ride in the same
     # artifact (VERDICT r4 weak #2: BASELINE's deepseek-r1 and
     # gpt-oss-120b configs previously had no TPU evidence): raw decode
@@ -671,6 +878,12 @@ def main() -> None:
             ffrac, frung_c = frac_of_raw(serving, fraw["value"], fB)
             fraw["serving_frac_of_raw"] = ffrac
             fraw["frac_rung_concurrency"] = frung_c
+            if os.environ.get("DYNAMO_BENCH_SPEC", "1") not in (
+                "0", "false"
+            ):
+                fraw["spec_decode"] = spec_decode_measurement(
+                    fspec, fpage, on_tpu, family=fam_name
+                )
             out["families"][fam_name] = fraw
     print(json.dumps(out))
 

@@ -75,7 +75,9 @@ async def _arun(args: argparse.Namespace) -> None:
     elif args.out == "engine":
         from dynamo_tpu.engine.config import EngineConfig
         from dynamo_tpu.engine.worker import launch_engine_worker
+        from dynamo_tpu.runtime.config import RuntimeConfig
 
+        env_cfg = RuntimeConfig.from_env()
         engine, _ = await launch_engine_worker(
             drt,
             namespace=args.namespace,
@@ -83,7 +85,13 @@ async def _arun(args: argparse.Namespace) -> None:
             model_path=args.model_path,
             model_name=model_name,
             # serving always pipelines the decode d2h (see worker._amain)
-            engine_config=EngineConfig(tp=args.tp, pipeline_decode=True),
+            engine_config=EngineConfig(
+                tp=args.tp, pipeline_decode=True,
+                # --spec beats DYN_SPEC_MODE beats the "off" default
+                # (recipes export SPEC_MODE -> --spec)
+                spec_mode=args.spec or env_cfg.spec_mode or "off",
+                spec_k_max=env_cfg.spec_k_max or 8,
+            ),
             precompile=args.precompile,
         )
         model_name = model_name or engine.spec.name
@@ -204,6 +212,10 @@ def _run_command(rest: list[str]) -> int:
                    help="out=engine: compile every serving shape before "
                         "serving (see worker --precompile); recipes turn "
                         "this on")
+    p.add_argument("--spec", default=None, choices=["off", "ngram"],
+                   help="out=engine: speculative decoding mode "
+                        "(prompt-lookup drafter + batched verify; "
+                        "default from DYN_SPEC_MODE, else off)")
     p.add_argument("--max-tokens", type=int, default=128)
     p.add_argument("--speedup-ratio", type=float, default=1.0)
     p.add_argument("--output", default=None,

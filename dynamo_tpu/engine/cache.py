@@ -39,6 +39,23 @@ class SeqPages:
     def num_pages(self) -> int:
         return len(self.pages)
 
+    def truncate(self, keep: int) -> list[int]:
+        """Drop tail pages beyond the first ``keep``, returning the
+        dropped ids (caller releases them to the allocator). Refuses to
+        cross into hashed pages: a sealed block is live prefix-cache
+        state, and the only rollback caller (speculative-verify tail
+        release, engine/core.py _process_verify) must never have
+        allocated past one."""
+        keep = max(keep, 0)
+        for i in range(len(self.pages) - 1, keep - 1, -1):
+            if self.hashes[i] is not None:
+                keep = i + 1  # defensive: never drop a sealed page
+                break
+        dropped = self.pages[keep:]
+        del self.pages[keep:]
+        del self.hashes[keep:]
+        return dropped
+
 
 class PageAllocator:
     def __init__(

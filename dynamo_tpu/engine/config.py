@@ -298,6 +298,23 @@ class EngineConfig:
     # on another worker, or HTTP 503 + Retry-After when none can take it)
     # instead of queueing unboundedly behind a saturated engine. 0 = off.
     max_waiting: int = 0
+    # speculative decoding (ROADMAP #6; engine/spec.py): "ngram" turns on
+    # the prompt-lookup drafter + batched verify for greedy, logprob-free
+    # slots — each verify dispatch lands 1..spec_k_max+1 tokens instead
+    # of joining the one-token-per-step decode bursts. Bit-identical
+    # output at temperature 0 (accept-longest-prefix against the
+    # target's own argmax); per-slot acceptance EWMA decays k to 0 on
+    # incompressible streams, transparently returning the slot to the
+    # burst path. Forced off under SPMD (verify is not in the follower
+    # replay protocol).
+    spec_mode: str = "off"  # "off" | "ngram"
+    spec_k_max: int = 8  # max draft tokens per verify (verify width k+1)
+    spec_ngram_min: int = 1  # shortest suffix n-gram the drafter matches
+    spec_ngram_max: int = 4  # longest (tried first: stronger predictor)
+    spec_ewma_alpha: float = 0.5  # acceptance-EWMA step per verify
+    # emitted tokens between k=1 reprobes while a slot is parked at k=0
+    # (0 = never reprobe: once decayed, the request stays non-spec)
+    spec_reprobe_tokens: int = 64
     # sampling
     seed: int = 0
     # step-thread phase profiler (same switch as DYNAMO_ENGINE_PROFILE=1):

@@ -38,6 +38,7 @@ from dynamo_tpu.engine.compile_cache import (
 )
 from dynamo_tpu.engine.config import EngineConfig, ModelSpec
 from dynamo_tpu.engine.sampling import sample_tokens, token_logprobs
+from dynamo_tpu.engine.spec import SPEC_TOKENS, SlotSpec
 from dynamo_tpu.kv_router.protocols import ForwardPassMetrics
 from dynamo_tpu.models import llama
 from dynamo_tpu.models.family import get_family
@@ -81,6 +82,10 @@ class _Slot:
     # left the waiting queue / when its prefill+sample dispatch completed
     admit_t: float = 0.0
     prefill_done_t: float = 0.0
+    # speculative decoding (engine/spec.py): per-slot drafter + adaptive
+    # k; None = this slot never speculates (spec off, temperature > 0,
+    # logprobs requested)
+    spec: SlotSpec | None = None
 
 
 @dataclass
@@ -204,6 +209,17 @@ class InferenceEngine:
         # eager re-admission passes that filled a slot in the SAME step
         # cycle that freed it (observability for the serving-latency work)
         self.eager_readmits = 0
+        # speculative decoding (engine/spec.py): gated to single-host —
+        # the verify dispatch is not in the SPMD follower replay protocol
+        self._spec_on = (
+            self.config.spec_mode == "ngram"
+            and spmd is None
+            and getattr(self.fam, "supports_spec_decode", False)
+        )
+        self.spec_verifies = 0  # verify dispatches issued
+        self.spec_drafted = 0  # draft tokens proposed into verifies
+        self.spec_accepted = 0  # drafts the target's argmax confirmed
+        self.spec_rejected = 0  # drafts cut by accept-longest-prefix
         self._partial: _PartialPrefill | None = None
         self._clear_cache_requested = False
         # dispatched-but-unprocessed decode bursts, oldest first (max
@@ -415,6 +431,34 @@ class InferenceEngine:
                 jax.block_until_ready(out)
 
             timed(f"decode[{B}x{n}]", burst)
+
+        # speculative-verify grid (spec mode): one program per
+        # power-of-two row count at the static k+1 token width — the
+        # exact shape set _spec_phase dispatches, so spec serving does
+        # ZERO new compiles after warmup. num_tokens=0 rows write only
+        # the trash page, like every other warmup dispatch.
+        if self._spec_on:
+            W = cfg.spec_k_max + 1
+            widths = {1}
+            w = 1
+            while w < B:
+                w *= 2
+                widths.add(w)
+            for nrows in sorted(widths):
+                def verify(nrows=nrows, W=W):
+                    out, self.k_pages, self.v_pages, _ = self.fam.verify(
+                        self.spec, self.params,
+                        jnp.zeros((nrows, W), jnp.int32),
+                        jnp.zeros(
+                            (nrows, cfg.max_pages_per_seq), jnp.int32
+                        ),
+                        jnp.zeros((nrows,), jnp.int32),
+                        self.k_pages, self.v_pages,
+                        jnp.zeros((nrows,), jnp.int32), mesh=self.mesh,
+                    )
+                    jax.block_until_ready(out)
+
+                timed(f"verify[{nrows}x{W}]", verify)
 
         # first-token sample widths: packed-dispatch fused samples
         # (prefill_pack_size), the single-prompt program (1), and the
@@ -936,6 +980,12 @@ class InferenceEngine:
         else:
             did |= self._admit_phase()
 
+        # 1.5) speculative verify over spec-managed slots (engine/spec.py):
+        # each one lands 1..k+1 tokens in ONE packed short-prefill
+        # dispatch; non-spec slots still take the decode burst below
+        if self._spec_on:
+            did |= self._spec_phase()
+
         # 2) one decode step over active slots
         if any(s is not None for s in self._slots):
             self._decode_step()
@@ -1435,6 +1485,17 @@ class InferenceEngine:
                 int(self._opt(sampling, "seed", self._seed_counter))
                 & 0xFFFFFFFF
             )
+        temperature = float(self._opt(sampling, "temperature", 0.0))
+        logprobs = self._clamp_logprobs(
+            (req.get("output_options") or {}).get("logprobs")
+        )
+        # speculative decoding is GREEDY-only (accept-longest-prefix
+        # against the target argmax is exact at temperature 0; sampled
+        # streams would need full rejection sampling) and logprob-free
+        # (the verify returns token ids, not per-position logits)
+        slot_spec = None
+        if self._spec_on and temperature <= 0.0 and logprobs is None:
+            slot_spec = SlotSpec.for_config(self.config)
         return _Slot(
             request_id=waiting.context.id,
             context=waiting.context,
@@ -1443,7 +1504,7 @@ class InferenceEngine:
             pages=sp,
             seq_len=seq_len,
             remaining=remaining,
-            temperature=float(self._opt(sampling, "temperature", 0.0)),
+            temperature=temperature,
             top_k=int(self._opt(sampling, "top_k", 0)),
             top_p=float(self._opt(sampling, "top_p", 1.0)),
             ignore_eos=bool(stop.get("ignore_eos", False)),
@@ -1453,10 +1514,9 @@ class InferenceEngine:
             generated=generated,
             last_token=last_token,
             sample_seed=sample_seed,
-            logprobs=self._clamp_logprobs(
-                (req.get("output_options") or {}).get("logprobs")
-            ),
+            logprobs=logprobs,
             admit_t=waiting.admit_t,
+            spec=slot_spec,
         )
 
     def _clamp_logprobs(self, n) -> int | None:
@@ -2454,6 +2514,223 @@ class InferenceEngine:
         self._slots[slot_idx] = slot
         self._publish_metrics()
 
+    # -- speculative decoding (runs in thread) -----------------------------
+
+    def spec_snapshot(self) -> dict[str, Any]:
+        """Speculation counters for bench/profile attribution: verify
+        dispatches, draft outcomes, and the live acceptance rate."""
+        judged = self.spec_accepted + self.spec_rejected
+        return {
+            "verifies": self.spec_verifies,
+            "drafted": self.spec_drafted,
+            "accepted": self.spec_accepted,
+            "rejected": self.spec_rejected,
+            "acceptance_rate": (
+                round(self.spec_accepted / judged, 4) if judged else None
+            ),
+        }
+
+    def _spec_managed(self, slot: _Slot) -> bool:
+        """True while the slot takes the verify path INSTEAD of decode
+        bursts. first_pending slots stay burst-managed: their first
+        token is still on device, so the drafter has no host-side
+        suffix to match yet (and the burst feed lands it for free)."""
+        return (
+            slot.spec is not None
+            and slot.spec.active
+            and not slot.first_pending
+        )
+
+    def _spec_phase(self) -> bool:
+        """Draft + batched verify for every spec-managed slot not covered
+        by an in-flight decode burst.
+
+        Scheduling contract with the pipeline: a slot is EITHER
+        burst-managed or spec-managed in any given cycle. _build_batch
+        skips spec-managed slots, so their burst coverage drains within
+        pipeline_depth cycles of the flag flipping, after which every
+        cycle runs one packed verify (1..k+1 tokens per slot per
+        dispatch). A slot whose drafter finds nothing still verifies at
+        width 1 — it must emit a token this cycle — and the no-match
+        counts into the acceptance EWMA, so persistently incompressible
+        slots decay to k=0 and rejoin the bursts within a handful of
+        one-token verifies (the <5% overhead story for random prompts).
+        """
+        cfg = self.config
+        B = len(self._slots)
+        covered = [False] * B
+        for pb in self._pipeline:
+            pbb = pb["batch"]
+            for i in range(B):
+                if pbb["active"][i] and self._slot_matches(i, pbb):
+                    covered[i] = True
+        cands: list[tuple[int, _Slot, list[int]]] = []
+        with self._phase("spec.draft"):
+            for i, slot in enumerate(self._slots):
+                if slot is None or not self._spec_managed(slot):
+                    continue
+                if slot.context.is_stopped or covered[i]:
+                    # stopped slots cancel through _build_batch; covered
+                    # ones verify once their in-flight burst processes
+                    continue
+                if cfg.max_context - slot.seq_len < 2:
+                    # defensive (unreachable: _decode_budget clamps
+                    # remaining below the context cap): no room to write
+                    # even the fed token safely
+                    continue
+                k_cap = min(
+                    slot.remaining - 1,
+                    cfg.max_context - slot.seq_len - 2,
+                    cfg.spec_k_max,
+                )
+                slot.spec.sync_from_seq(slot.seq)
+                draft = (
+                    slot.spec.propose(k_cap) if k_cap > 0 else []
+                )
+                cands.append((i, slot, [int(t) for t in draft]))
+        if not cands:
+            return False
+
+        # page room for the fed token + drafts (same backpressure story
+        # as _build_batch: OutOfPages trims the draft to the pages held;
+        # a slot that can't even hold its fed token stalls this cycle)
+        ready: list[tuple[int, _Slot, list[int], int]] = []
+        for i, slot, draft in cands:
+            m = 1 + len(draft)
+            base_pages = slot.pages.num_pages
+            while (slot.seq_len + m - 1) // cfg.page_size >= (
+                slot.pages.num_pages
+            ):
+                try:
+                    slot.pages.pages.append(self.allocator.alloc_page())
+                    slot.pages.hashes.append(None)
+                except OutOfPages:
+                    m = min(
+                        m,
+                        slot.pages.num_pages * cfg.page_size - slot.seq_len,
+                    )
+                    break
+            if m < 1:
+                # not even the fed token fits: stall this cycle; a long
+                # stall hands the slot back to the burst path, whose
+                # backpressure accounting owns the give-up decision
+                slot.stalled_steps += 1
+                if slot.stalled_steps > 200:
+                    slot.spec.disable()
+                continue
+            slot.stalled_steps = 0
+            ready.append((i, slot, draft[: m - 1], base_pages))
+        if not ready:
+            return False
+
+        if FAULTS.enabled:
+            try:
+                # injected verify failure (site engine.spec_verify): the
+                # contract is transparent per-slot fallback — rejected
+                # BEFORE any KV write, so rollback is pure allocator
+                # bookkeeping and the request decodes on untouched state
+                FAULTS.fire_sync("engine.spec_verify")
+            except Exception as e:  # noqa: BLE001
+                with self._phase("spec.rollback"):
+                    for _i, slot, _draft, base_pages in ready:
+                        self.allocator.release(
+                            slot.pages.truncate(base_pages)
+                        )
+                        slot.spec.disable()
+                log.warning(
+                    "spec verify fault (%s): %d slot(s) fall back to "
+                    "non-spec decode", e, len(ready),
+                )
+                return True
+
+        # ONE packed dispatch: rows pad to a power of two (bounded
+        # compiled-shape set, warmed by precompile's verify grid), token
+        # width is the static spec_k_max+1; padded rows have
+        # num_tokens=0 so every write lands on the trash page
+        W = cfg.spec_k_max + 1
+        n = 1
+        while n < len(ready):
+            n *= 2
+        tokens = np.zeros((n, W), np.int32)
+        bts = np.zeros((n, cfg.max_pages_per_seq), np.int32)
+        starts = np.zeros((n,), np.int32)
+        nts = np.zeros((n,), np.int32)
+        for r, (_i, slot, draft, _bp) in enumerate(ready):
+            row = [slot.last_token, *draft]
+            tokens[r, : len(row)] = row
+            bts[r, : slot.pages.num_pages] = slot.pages.pages
+            starts[r] = slot.seq_len
+            nts[r] = len(row)
+        with self._phase("spec.verify"):
+            targets, self.k_pages, self.v_pages, dropped = self.fam.verify(
+                self.spec, self.params, jnp.asarray(tokens),
+                jnp.asarray(bts), jnp.asarray(starts),
+                self.k_pages, self.v_pages, jnp.asarray(nts),
+                mesh=self.mesh,
+            )
+            self.dispatches += 1
+            self._note_moe_dropped(dropped)
+            with self._phase("dispatch.d2h_wait"):
+                targets = np.asarray(targets)
+        self.spec_verifies += 1
+        for r, (i, slot, draft, _bp) in enumerate(ready):
+            if self._slots[i] is not slot:
+                continue  # defensive: slot replaced mid-phase
+            self._process_verify(i, slot, draft, targets[r])
+        self._publish_metrics()
+        return True
+
+    def _process_verify(
+        self, slot_idx: int, slot: _Slot, draft: list[int],
+        targets: np.ndarray,
+    ) -> None:
+        """Greedy accept-longest-prefix over one slot's verify row.
+
+        ``targets[j]`` is the target's argmax AFTER consuming
+        [last_token, draft[:j]] — so drafts are accepted while they
+        equal the target's own choice, and ``targets[n_acc]`` (the
+        correction on a mismatch, the bonus token when everything
+        matched) always emits. Every emitted token runs through
+        _accept_token, the single source of stop semantics: a
+        max_tokens/EOS/stop boundary mid-verify cuts the stream at the
+        exact boundary token, never into the rejected tail."""
+        n_acc = 0
+        while n_acc < len(draft) and int(targets[n_acc]) == draft[n_acc]:
+            n_acc += 1
+        drafted = len(draft)
+        self.spec_drafted += drafted
+        self.spec_accepted += n_acc
+        self.spec_rejected += drafted - n_acc
+        if drafted:
+            SPEC_TOKENS.labels(outcome="accepted").inc(n_acc)
+            SPEC_TOKENS.labels(outcome="rejected").inc(drafted - n_acc)
+        slot.spec.observe(drafted, n_acc)
+
+        # the emitted tokens run through the burst path's stop-semantics
+        # loop (single source: _accept_token via _decide_burst), so a
+        # max_tokens/EOS/stop boundary cuts at the exact token
+        toks, finish = self._decide_burst(slot, targets[: n_acc + 1])
+        # the fed token + the consumed accepted drafts are now cache
+        # state (mirrors _process_burst's seq_len advance: the LAST
+        # emitted token's KV write belongs to the next dispatch)
+        slot.seq_len += len(toks)
+        with self._phase("spec.rollback"):
+            # release pages past the accepted prefix: rejected-tail
+            # positions are beyond seq_len (masked, overwritten by the
+            # next real write), but their PAGES must not stay pinned
+            keep = (
+                slot.seq_len + self.config.page_size - 1
+            ) // self.config.page_size
+            released = slot.pages.truncate(max(keep, 1))
+            if released:
+                self.allocator.release(released)
+        self._maybe_seal(slot)
+        self._drain_offload()
+        item: dict[str, Any] = {"token_ids": toks, "finish_reason": finish}
+        if finish is not None:
+            self._finish(slot_idx, slot, finish, emit=False)
+        self._post(slot.out_q, item)
+
     # -- decode (runs in thread) -------------------------------------------
 
     def _decode_step(self) -> None:
@@ -2568,7 +2845,11 @@ class InferenceEngine:
             # pipeline, so they are cheap to interleave).
             n_burst = max(1, min(n_burst, cfg.decode_steps_admit_pending))
         for i, slot in enumerate(self._slots):
-            if slot is not None and not slot.context.is_stopped:
+            if (
+                slot is not None
+                and not slot.context.is_stopped
+                and not self._spec_managed(slot)
+            ):
                 n_burst = max(
                     1, min(n_burst, capacity - slot.seq_len - int(extra[i]))
                 )
@@ -2582,6 +2863,11 @@ class InferenceEngine:
                 # pipelined: _step flushed before cancels normally; a race
                 # here just skips the slot — the next (flushed) step
                 # finishes it
+                continue
+            if self._spec_managed(slot):
+                # spec-managed: this slot's tokens come from the verify
+                # path (_spec_phase); keeping it out of new bursts is
+                # what lets speculation and bursts share one engine cycle
                 continue
             if slot.remaining <= extra[i]:
                 # the in-flight burst already covers this slot's budget
@@ -2819,6 +3105,10 @@ class InferenceEngine:
             toks, finish = self._decide_burst(slot, sampled[i, :n_burst])
             burst[i] = (toks, finish)
             slot.seq_len += len(toks)  # the fed tokens are now in the cache
+            if slot.spec is not None:
+                # parked spec slot (k decayed to 0): count burst tokens
+                # toward the next k=1 reprobe (engine/spec.py)
+                slot.spec.on_tokens(len(toks))
             self._maybe_seal(slot)
         self._drain_offload()
 

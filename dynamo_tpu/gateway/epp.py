@@ -30,6 +30,7 @@ from __future__ import annotations
 import argparse
 import asyncio
 import logging
+import time
 from typing import Any
 
 from aiohttp import web
@@ -39,6 +40,73 @@ from dynamo_tpu.kv_router.router import KvRouter
 from dynamo_tpu.runtime.component import INSTANCE_ROOT, Instance
 
 log = logging.getLogger("dynamo.gateway.epp")
+
+
+class _PrefixCache:
+    """Hub-watch-invalidated snapshot of one key prefix, with a TTL
+    backstop (ROADMAP #7 EPP slice: steady-state picks must do ZERO hub
+    round-trips — at 100s of instances the per-pick ``get_prefix`` scan
+    was the pick-latency floor). A watch event clears the snapshot
+    immediately (new/removed cards and instances land within one hub
+    watch delivery); the TTL bounds staleness when the watch stream is
+    down and the hub only answers plain RPCs."""
+
+    def __init__(self, hub, prefix: str, ttl_s: float):
+        self.hub = hub
+        self.prefix = prefix
+        self.ttl_s = ttl_s
+        self._snap: dict[str, Any] | None = None
+        self._expiry = 0.0
+        # invalidation generation: a watch event arriving WHILE a scan
+        # is in flight must not be overwritten by that scan's (pre-event)
+        # result — the refill only installs its snapshot if no
+        # invalidate() happened since it started
+        self._gen = 0
+        # single-flight refill: N concurrent picks missing the cache
+        # share ONE hub scan instead of issuing a burst of N
+        self._refill: asyncio.Task | None = None
+        self.scans = 0  # hub round-trips actually paid (observability)
+
+    async def get(self) -> dict[str, Any]:
+        if self._snap is not None and time.monotonic() < self._expiry:
+            return self._snap
+        if self._refill is None or self._refill.done():
+            self._refill = asyncio.get_running_loop().create_task(
+                self._do_refill()
+            )
+        return await self._refill
+
+    async def _do_refill(self) -> dict[str, Any]:
+        gen = self._gen
+        snap = await self.hub.get_prefix(self.prefix)
+        self.scans += 1
+        if gen == self._gen:
+            self._snap = snap
+            self._expiry = time.monotonic() + self.ttl_s
+        # an invalidation raced the scan: serve this (possibly pre-event)
+        # snapshot to the waiters but do NOT cache it — the next get()
+        # re-scans and sees the post-event state
+        return snap
+
+    def invalidate(self) -> None:
+        self._gen += 1
+        self._snap = None
+
+    async def watch(self) -> None:
+        """Invalidation loop (spawned by start()): any event under the
+        prefix drops the snapshot. A dead hub ends the loop — the TTL
+        keeps answers bounded-stale until the process is restarted or
+        the hub returns on the RPC path."""
+        try:
+            async for _ev in self.hub.watch_prefix(
+                self.prefix, initial=False
+            ):
+                self.invalidate()
+        except (ConnectionError, RuntimeError) as e:
+            log.warning(
+                "EPP watch on %r ended (%s); falling back to the %.1fs "
+                "TTL", self.prefix, e, self.ttl_s,
+            )
 
 
 class EndpointPicker:
@@ -52,6 +120,7 @@ class EndpointPicker:
         config: RouterConfig | None = None,
         host: str = "0.0.0.0",
         port: int = 9002,
+        card_ttl_s: float = 2.0,
     ):
         self.drt = drt
         self.namespace = namespace
@@ -64,13 +133,33 @@ class EndpointPicker:
         self._tokenizers: dict[str, Any] = {}
         self._runner: web.AppRunner | None = None
         self.picks = 0
+        # pick-path caches: model cards (tokenizer resolution) and
+        # instance records (winner address) — both watch-invalidated
+        # with a TTL backstop, so a steady-state pick touches the hub
+        # zero times (tests/test_gateway_epp.py micro-benchmark)
+        from dynamo_tpu.frontend.model_card import MDC_ROOT
+
+        self._cards = _PrefixCache(drt.hub, MDC_ROOT + "/", card_ttl_s)
+        self._instances = _PrefixCache(
+            drt.hub,
+            f"{INSTANCE_ROOT}/{namespace}/{target_component}/"
+            f"{target_endpoint}/",
+            card_ttl_s,
+        )
+        self._watch_tasks: list[asyncio.Task] = []
 
     async def start(self) -> "EndpointPicker":
+        from dynamo_tpu.runtime.context import spawn
+
         self.kv = await KvRouter(
             self.drt.hub,
             f"{self.namespace}/{self.target_component}",
             self.config,
         ).start()
+        self._watch_tasks = [
+            spawn(self._cards.watch(), name="epp-cards-watch"),
+            spawn(self._instances.watch(), name="epp-instances-watch"),
+        ]
         app = web.Application()
         app.router.add_post("/pick", self._pick)
         app.router.add_get("/healthz", self._healthz)
@@ -94,11 +183,15 @@ class EndpointPicker:
         typo'd name must not silently tokenize with the mock fallback
         and return confidently wrong block hashes/overlap estimates.
         Only an OMITTED model may fall back to the first card (or the
-        mock tokenizer when no cards exist yet)."""
-        from dynamo_tpu.frontend.model_card import MDC_ROOT
+        mock tokenizer when no cards exist yet).
+
+        The card scan is served from the watch-invalidated cache: a new
+        or removed card lands within one watch delivery (TTL-bounded
+        when the watch is down), and a steady-state pick pays zero hub
+        round-trips here."""
         from dynamo_tpu.frontend.tokenizer import load_tokenizer
 
-        cards = await self.drt.hub.get_prefix(MDC_ROOT + "/")
+        cards = await self._cards.get()
         card = None
         for _key, value in sorted(cards.items()):
             if model is None or value.get("name") == model:
@@ -112,21 +205,29 @@ class EndpointPicker:
         return self._tokenizers[tok_name]
 
     async def _endpoint_of(self, worker_id: int) -> str | None:
-        prefix = (
-            f"{INSTANCE_ROOT}/{self.namespace}/{self.target_component}/"
-            f"{self.target_endpoint}/"
-        )
-        entries = await self.drt.hub.get_prefix(prefix)
-        for _key, raw in entries.items():
-            inst = Instance.from_dict(raw)
-            if inst.instance_id == worker_id:
-                return f"{inst.host}:{inst.port}"
+        # second attempt after a forced re-scan: the router may know a
+        # winner the cached snapshot predates (fresh worker between
+        # watch deliveries) — one refetch before answering 503
+        for attempt in range(2):
+            entries = await self._instances.get()
+            for _key, raw in entries.items():
+                inst = Instance.from_dict(raw)
+                if inst.instance_id == worker_id:
+                    return f"{inst.host}:{inst.port}"
+            if attempt == 0:
+                self._instances.invalidate()
         return None
 
     # -- routes ------------------------------------------------------------
 
     async def _healthz(self, _req: web.Request) -> web.Response:
-        return web.json_response({"status": "ok", "picks": self.picks})
+        return web.json_response({
+            "status": "ok",
+            "picks": self.picks,
+            # hub round-trips actually paid for cards/instances: with
+            # the pick-path caches warm this stays flat while picks grow
+            "hub_scans": self._cards.scans + self._instances.scans,
+        })
 
     async def _pick(self, req: web.Request) -> web.Response:
         try:
@@ -183,6 +284,8 @@ class EndpointPicker:
         )
 
     async def close(self) -> None:
+        for t in self._watch_tasks:
+            t.cancel()
         if self.kv is not None:
             await self.kv.save_snapshot()
             await self.kv.close()

@@ -43,6 +43,7 @@ class GqaFamily:
     supports_logprobs = True
     supports_embeddings = True
     supports_multimodal = True  # prefill embedding injection (EPD)
+    supports_spec_decode = True  # prompt-lookup verify (engine/spec.py)
 
     def __init__(self, spec: Any | None = None):
         from dynamo_tpu.models import llama
@@ -83,6 +84,12 @@ class GqaFamily:
             spec, params, tokens, bt, k, v, n, mesh=mesh
         )
 
+    def verify(self, spec, params, tokens, bts, starts, k, v, ns,
+               mesh=None):
+        return self.m.verify_forward(
+            spec, params, tokens, bts, starts, k, v, ns, mesh=mesh
+        )
+
     def decode_steps(self, spec, params, tokens, bts, lens, k, v, active,
                      temps, topk, topp, seeds, steps, *, n_steps, n_logprobs,
                      mesh=None):
@@ -119,6 +126,7 @@ class MlaFamily:
     supports_logprobs = True
     supports_embeddings = True
     supports_multimodal = False
+    supports_spec_decode = True  # prompt-lookup verify (engine/spec.py)
 
     def __init__(self):
         from dynamo_tpu.models import mla
@@ -152,6 +160,13 @@ class MlaFamily:
             spec, params, tokens, bts, starts, k, ns, mesh=mesh
         )
         return logits, cache, v, jnp.zeros((), jnp.int32)
+
+    def verify(self, spec, params, tokens, bts, starts, k, v, ns,
+               mesh=None):
+        targets, cache = self.m.verify_forward(
+            spec, params, tokens, bts, starts, k, ns, mesh=mesh
+        )
+        return targets, cache, v, jnp.zeros((), jnp.int32)
 
     def decode_steps(self, spec, params, tokens, bts, lens, k, v, active,
                      temps, topk, topp, seeds, steps, *, n_steps, n_logprobs,

@@ -564,6 +564,105 @@ prefill_forward_ring = jax.jit(
 )
 
 
+# ----------------------------------------------------------------- verify
+
+
+def verify_forward_impl(
+    spec: ModelSpec,
+    params: Params,
+    tokens: jax.Array,  # [N, W] int32: [fed_token, draft...] per row
+    block_tables: jax.Array,  # [N, max_pages_per_seq]
+    start_pos: jax.Array,  # [N]: cache length before the fed token
+    k_pages: jax.Array,  # donated
+    v_pages: jax.Array,
+    num_tokens: jax.Array,  # [N] valid tokens per row (0 = padded row)
+    mesh: Mesh | None = None,  # static
+) -> tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
+    """Speculative-verify forward: N slots' (fed token + k drafts) in
+    ONE short-prefill dispatch, with the target's greedy choice at EVERY
+    position (engine/core.py _spec_phase).
+
+    Differs from prefill in exactly two ways. (1) KV writes are
+    TOKEN-granular (write_new_kv — the decode-path scatter/DMA kernel):
+    a verify starts wherever decode left off, mid-page, so the
+    page-tile scatter's page-aligned-start invariant does not hold.
+    (2) Logits are computed for all W positions and argmax'd ON DEVICE —
+    the host needs only the [N, W] int32 target tokens to run
+    accept-longest-prefix, not a [N, W, V] logits download.
+
+    Rejected-draft KV rows are garbage beyond the accepted prefix: they
+    sit past the slot's post-verify seq_len, masked from attention, and
+    are overwritten by the next real write at that position (the
+    engine's page rollback handles the allocator side).
+
+    Returns (targets [N, W] int32, k_pages, v_pages, moe_dropped).
+    """
+    from dynamo_tpu.ops.pallas.kv_write import write_new_kv
+
+    N, W = tokens.shape
+    page_size = k_pages.shape[3]
+    idx = jnp.arange(W)
+    positions = start_pos[:, None] + idx[None, :]  # [N, W]
+    valid = idx[None, :] < num_tokens[:, None]
+    pg_idx_raw = jnp.take_along_axis(
+        block_tables, positions // page_size, axis=1
+    )
+    safe_pg = jnp.where(valid, pg_idx_raw, TRASH_PAGE).reshape(N * W)
+    offs = (positions % page_size).reshape(N * W)
+
+    x = params["embed"][tokens]  # [N, W, d]
+    kv_len = start_pos + num_tokens  # [N]
+    moe_dropped = jnp.zeros((), jnp.int32)
+
+    for li, lp in enumerate(params["layers"]):
+        h = rms_norm(x, lp["attn_norm"], spec.rms_eps)
+        q = h @ lp["wq"]
+        k = h @ lp["wk"]
+        v = h @ lp["wv"]
+        if spec.attn_bias:
+            q, k, v = q + lp["bq"], k + lp["bk"], v + lp["bv"]
+        q = q.reshape(N, W, spec.num_heads, spec.head_dim)
+        k = k.reshape(N, W, spec.num_kv_heads, spec.head_dim)
+        v = v.reshape(N, W, spec.num_kv_heads, spec.head_dim)
+        q = jax.vmap(lambda a, p: rope_spec(spec, a, p))(q, positions)
+        k = jax.vmap(lambda a, p: rope_spec(spec, a, p))(k, positions)
+        k_pages, v_pages = write_new_kv(
+            k_pages, v_pages,
+            k.reshape(N * W, spec.num_kv_heads, spec.head_dim),
+            v.reshape(N * W, spec.num_kv_heads, spec.head_dim),
+            safe_pg, offs, layer=li, mesh=mesh,
+        )
+
+        def one_attn(q_i, bt_i, pos_i, kvl_i, kp=k_pages, vp=v_pages,
+                     li=li, lp=lp):
+            k_ctx = gather_pages(kp[li], bt_i)[..., :spec.head_dim]
+            v_ctx = gather_pages(vp[li], bt_i)[..., :spec.head_dim]
+            return causal_attention(
+                q_i, k_ctx, v_ctx, pos_i, kvl_i,
+                window=spec.attn_window(li), sinks=lp.get("sinks"),
+            )
+
+        attn = jax.vmap(one_attn)(q, block_tables, positions, kv_len)
+        x = x + _o_proj(spec, lp, attn.reshape(N, W, -1))
+        h = rms_norm(x, lp["mlp_norm"], spec.rms_eps)
+        f, d = _ffn_counted(spec, lp, h.reshape(N * W, -1))
+        x = x + f.reshape(N, W, -1)
+        moe_dropped = moe_dropped + d
+
+    logits = _logits(spec, params, x)  # [N, W, V]
+    targets = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    return (
+        _replicate(targets, mesh), k_pages, v_pages,
+        _replicate(moe_dropped, mesh),
+    )
+
+
+verify_forward = jax.jit(
+    verify_forward_impl, static_argnums=(0,), static_argnames=("mesh",),
+    donate_argnums=(5, 6),
+)
+
+
 # ---------------------------------------------------------------- decode
 
 

@@ -450,6 +450,73 @@ prefill_forward_batch = jax.jit(
 )
 
 
+def verify_forward_impl(
+    spec: ModelSpec,
+    params: Params,
+    tokens: jax.Array,  # [N, W] int32: [fed_token, draft...] per row
+    block_tables: jax.Array,  # [N, max_pages_per_seq]
+    start_pos: jax.Array,  # [N]: cache length before the fed token
+    cache: jax.Array,  # donated
+    num_tokens: jax.Array,  # [N] valid tokens per row (0 = padded row)
+    mesh: Mesh | None = None,  # static
+) -> tuple[jax.Array, jax.Array]:
+    """Speculative-verify forward for MLA (mirrors llama.verify_forward):
+    token-granular latent writes — a verify starts mid-page, so the
+    page-tile invariant of prefill does not hold — and the target's
+    greedy argmax at all W positions, returned as [N, W] int32 so only
+    token ids cross to the host. Returns (targets, cache)."""
+    N, W = tokens.shape
+    page_size = cache.shape[2]
+    idx = jnp.arange(W)
+    positions = start_pos[:, None] + idx[None, :]  # [N, W]
+    valid = idx[None, :] < num_tokens[:, None]
+    pg_idx_raw = jnp.take_along_axis(
+        block_tables, positions // page_size, axis=1
+    )
+    safe_pg = jnp.where(valid, pg_idx_raw, TRASH_PAGE).reshape(N * W)
+    offs = (positions % page_size).reshape(N * W)
+
+    x = params["embed"][tokens]  # [N, W, d]
+    kv_len = start_pos + num_tokens  # [N]
+    max_ctx = block_tables.shape[1] * page_size
+    ctx_pos = jnp.arange(max_ctx)
+    for li, lp in enumerate(params["layers"]):
+        h = rms_norm(x, lp["attn_norm"], spec.rms_eps)
+        q_nope, q_rope = jax.vmap(
+            lambda hh, pos: _q_heads(spec, lp, hh, pos)
+        )(h, positions)  # [N, W, H, dn] / [N, W, H, dr]
+        new_rows = jax.vmap(
+            lambda hh, pos: _latent_row(spec, lp, hh, pos)
+        )(h, positions)  # [N, W, D]
+        cache = cache.at[li, safe_pg, offs].set(
+            new_rows.reshape(N * W, -1).astype(cache.dtype)
+        )
+
+        def one_attn(qn, qr, bt, pos, kvl, cache_l=cache[li], lp=lp):
+            rows = _gather_rows(cache_l, bt)  # [max_ctx, D]
+            mask = (ctx_pos[None, :] <= pos[:, None]) & (
+                ctx_pos[None, :] < kvl
+            )
+            return _absorbed_attention(spec, lp, qn, qr, rows, mask)
+
+        attn = jax.vmap(one_attn)(
+            q_nope, q_rope, block_tables, positions, kv_len
+        )  # [N, W, H, dv]
+        x = x + attn.reshape(N, W, -1).astype(x.dtype) @ lp["wo"]
+        hh = rms_norm(x, lp["mlp_norm"], spec.rms_eps)
+        x = x + _ffn(spec, li, lp, hh.reshape(N * W, -1)).reshape(N, W, -1)
+
+    logits = _logits_all(spec, params, x)  # [N, W, V]
+    targets = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    return _replicate(targets, mesh), cache
+
+
+verify_forward = jax.jit(
+    verify_forward_impl, static_argnums=(0,),
+    static_argnames=("mesh",), donate_argnums=(5,)
+)
+
+
 def decode_forward_impl(
     spec: ModelSpec,
     params: Params,

@@ -65,6 +65,11 @@ class RuntimeConfig:
 
     # engine-side compute
     block_size: int = 64  # KV cache block granularity (tokens/block)
+    # speculative decoding defaults for engine workers (DYN_SPEC_MODE /
+    # DYN_SPEC_K_MAX; engine/spec.py): explicit --spec CLI flags win,
+    # empty/0 falls through to the EngineConfig defaults ("off" / 8)
+    spec_mode: str = ""
+    spec_k_max: int = 0
     # persistent XLA compilation cache dir (DYN_COMPILE_CACHE_DIR): a
     # restarted worker reloads its serving programs from disk instead of
     # paying cold-start TTFT recompiling them; empty = off. Honored by

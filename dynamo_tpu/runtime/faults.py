@@ -51,7 +51,7 @@ Registered fault points (this PR):
     transport.connect / transport.send / transport.recv   (transport.py)
     hub.dial / hub.call                                   (hub_client.py)
     hub.wal_append / hub.fsync                            (hub_store.py)
-    engine.step / engine.admit                            (engine/core.py)
+    engine.step / engine.admit / engine.spec_verify       (engine/core.py)
     disagg.pull                                           (disagg/transfer.py)
 
 Trip counters are exported on every ``/metrics`` surface as
@@ -93,6 +93,7 @@ KNOWN_SITES: frozenset[str] = frozenset({
     "engine.step",
     "engine.admit",
     "engine.compile",
+    "engine.spec_verify",
     "disagg.pull",
 })
 

@@ -41,6 +41,8 @@ COMMON=("${MODEL_ARGS[@]}" --model-name "${MODEL:-deepseek-r1}"
         --max-decode-slots "$SLOTS" --decode-steps-per-dispatch "$BURST")
 # serving default: compile every shape at startup (PRECOMPILE=0 skips)
 [ "$PRECOMPILE" = "1" ] && COMMON+=(--precompile)
+# SPEC_MODE=ngram: prompt-lookup speculative decoding (decode pool)
+[ -n "${SPEC_MODE:-}" ] && COMMON+=(--spec "$SPEC_MODE")
 
 case "${ROLE:-all}" in
   decode)

@@ -33,6 +33,9 @@ else
 fi
 # serving default: compile every shape at startup (PRECOMPILE=0 skips)
 [ "$PRECOMPILE" = "1" ] && MODEL_ARGS+=(--precompile)
+# SPEC_MODE=ngram: prompt-lookup speculative decoding (agentic tool-call
+# loops are exactly where the n-gram drafter wins)
+[ -n "${SPEC_MODE:-}" ] && MODEL_ARGS+=(--spec "$SPEC_MODE")
 
 HUBLOG=$(mktemp)
 python -m dynamo_tpu.runtime.hub_server --port 0 > "$HUBLOG" &

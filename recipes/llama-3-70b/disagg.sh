@@ -44,6 +44,8 @@ COMMON=(--tp "$TP" --page-size "$PAGE" --num-pages "$NUM_PAGES"
         --model-name "${MODEL:-llama-3-70b}")
 # serving default: compile every shape at startup (PRECOMPILE=0 skips)
 [ "$PRECOMPILE" = "1" ] && COMMON+=(--precompile)
+# SPEC_MODE=ngram: prompt-lookup speculative decoding (decode pool)
+[ -n "${SPEC_MODE:-}" ] && COMMON+=(--spec "$SPEC_MODE")
 MH=()
 [ -n "${COORDINATOR:-}" ] && MH=(--coordinator-address "$COORDINATOR"
   --num-processes "${NUM_PROCESSES:-2}" --process-id "${PROCESS_ID:-0}")

@@ -11,6 +11,9 @@ cd "$(dirname "$0")/../.."
 export DYN_COMPILE_CACHE_DIR="${DYN_COMPILE_CACHE_DIR-$HOME/.cache/dynamo-tpu/xla-cache}"
 ARGS=(run --in http --out engine --port "${PORT:-8000}")
 [ "${PRECOMPILE:-1}" = "1" ] && ARGS+=(--precompile)
+# SPEC_MODE=ngram: prompt-lookup speculative decoding (>=1.5x per-stream
+# tok/s on repetitive/agentic prompts; greedy output unchanged)
+[ -n "${SPEC_MODE:-}" ] && ARGS+=(--spec "$SPEC_MODE")
 if [ -n "${MODEL_PATH:-}" ]; then
   ARGS+=(--model-path "$MODEL_PATH")
 else

@@ -11,6 +11,8 @@ MODEL_ARGS=(--model "${MODEL:-llama-3-8b}")
 # DYN_COMPILE_CACHE_DIR= disables the cache, PRECOMPILE=0 the warmup
 export DYN_COMPILE_CACHE_DIR="${DYN_COMPILE_CACHE_DIR-$HOME/.cache/dynamo-tpu/xla-cache}"
 [ "${PRECOMPILE:-1}" = "1" ] && MODEL_ARGS+=(--precompile)
+# SPEC_MODE=ngram: prompt-lookup speculative decoding on the decode pool
+[ -n "${SPEC_MODE:-}" ] && MODEL_ARGS+=(--spec "$SPEC_MODE")
 
 python -m dynamo_tpu.runtime.hub_server --port 0 > /tmp/dyn-hub.out &
 HUB_PID=$!

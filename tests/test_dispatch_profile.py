@@ -76,6 +76,47 @@ async def test_precompile_then_mixed_isl_batch_zero_new_compiles():
     await engine.close()
 
 
+async def test_spec_engine_precompile_then_zero_new_compiles():
+    """Satellite of the speculative-decoding PR: precompile() walks the
+    verify-shape grid (power-of-two row counts x the static k+1 width),
+    so a spec-enabled engine serves REPETITIVE traffic — drafts
+    accepted, verifies at multiple widths — with zero new compiles
+    after warmup."""
+    engine = InferenceEngine(
+        ModelSpec.tiny(), _cfg(spec_mode="ngram", spec_k_max=4),
+    )
+    report = engine.precompile()
+    # the verify grid rode along: rows 1,2,4 (max_decode_slots=4) at
+    # width k_max+1
+    assert {"verify[1x5]", "verify[2x5]", "verify[4x5]"} <= set(report)
+
+    def rep(i):  # repetitive prompt per stream: spec engages
+        return [3 + (i + j) % 4 for j in range(16)]
+
+    async def serve(tag):
+        async def one(i):
+            async for _ in engine.generate(
+                {"token_ids": rep(i),
+                 "stop_conditions": {"max_tokens": 12, "ignore_eos": True},
+                 "sampling": {"temperature": 0.0}},
+                Context(f"{tag}-{i}"),
+            ):
+                pass
+
+        await asyncio.gather(*(one(i) for i in range(3)))
+
+    await serve("warm")
+    assert engine.spec_verifies > 0, "spec never engaged in warm traffic"
+    c0, _s0 = compile_snapshot()
+    await serve("steady")
+    c1, _s1 = compile_snapshot()
+    assert c1 - c0 == 0, (
+        f"{c1 - c0} compiles during warmed spec serving — a verify "
+        "shape escaped the precompile grid"
+    )
+    await engine.close()
+
+
 async def test_precompile_report_covers_serving_shapes():
     engine = InferenceEngine(ModelSpec.tiny(), _cfg())
     report = engine.precompile()

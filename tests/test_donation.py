@@ -79,6 +79,27 @@ def test_gqa_packed_prefill_donates_pools():
     assert _deleted([k, v]) == [True, True]
 
 
+def test_gqa_verify_donates_pools():
+    params, k, v, bt = _gqa_args()
+    tokens = jnp.zeros((B, 3), jnp.int32)
+    targets, k2, v2, _ = llama.verify_forward(
+        SPEC, params, tokens, bt, jnp.zeros((B,), jnp.int32), k, v,
+        jnp.zeros((B,), jnp.int32),
+    )
+    assert _deleted([k, v]) == [True, True]
+    assert not tokens.is_deleted()
+
+
+def test_mla_verify_donates_cache():
+    params, cache, bt = _mla_args()
+    tokens = jnp.zeros((B, 3), jnp.int32)
+    _targets, cache2 = mla.verify_forward(
+        MLA_SPEC, params, tokens, bt, jnp.zeros((B,), jnp.int32),
+        cache, jnp.zeros((B,), jnp.int32),
+    )
+    assert cache.is_deleted()
+
+
 def test_gqa_decode_steps_donates_pools():
     params, k, v, bt = _gqa_args()
     zB = jnp.zeros((B,), jnp.int32)
@@ -174,6 +195,7 @@ AUDIT: dict = {
         "prefill_forward": "donates",
         "prefill_forward_batch": "donates",
         "prefill_forward_ring": "donates",
+        "verify_forward": "donates",
         "decode_forward": "donates",
         "decode_steps": "donates",
         "extract_kv_pages": "read-only",
@@ -183,6 +205,7 @@ AUDIT: dict = {
     mla: {
         "prefill_forward": "donates",
         "prefill_forward_batch": "donates",
+        "verify_forward": "donates",
         "decode_forward": "donates",
         "decode_steps": "donates",
         "embed_forward": "read-only",

@@ -131,3 +131,151 @@ async def test_epp_503_when_no_workers():
     finally:
         await epp.close()
         await drt.close()
+
+
+async def test_prefix_cache_ttl_backstop():
+    """_PrefixCache without its watch loop: the TTL bounds staleness
+    (the hub-watch-down fallback) and expiry forces exactly one
+    re-scan."""
+    import asyncio
+
+    from dynamo_tpu.gateway.epp import _PrefixCache
+
+    hub = InMemoryHub()
+    cache = _PrefixCache(hub, "x/", ttl_s=0.05)
+    assert await cache.get() == {}
+    await hub.put("x/a", {"v": 1})
+    assert await cache.get() == {}  # inside the TTL: served from cache
+    assert cache.scans == 1
+    await asyncio.sleep(0.06)
+    assert (await cache.get()).get("x/a") == {"v": 1}
+    assert cache.scans == 2
+
+
+async def test_epp_cached_pick_does_zero_hub_scans():
+    """Pick-path micro-benchmark (ROADMAP #7 EPP slice): after the
+    first pick warms the card + instance caches, steady-state picks do
+    ZERO hub round-trips — the scan counter stays flat while picks
+    grow."""
+    import time
+
+    from dynamo_tpu.frontend.model_card import ModelDeploymentCard
+
+    drt = DistributedRuntime(InMemoryHub())
+    cfg = MockEngineConfig(block_size=4, speedup_ratio=1000.0)
+    _eng, served = await launch_mock_worker(
+        drt, "dyn", "backend", "generate", cfg,
+    )
+    card = ModelDeploymentCard(
+        name="mock-model", namespace="dyn",
+        component="backend", endpoint="generate",
+    )
+    await drt.hub.put(card.key_for(served.instance.instance_id),
+                      card.to_dict())
+    epp = await EndpointPicker(
+        drt, namespace="dyn", target_component="backend",
+        config=RouterConfig(block_size=4), host="127.0.0.1", port=0,
+        card_ttl_s=30.0,  # long TTL: the watch is the invalidator
+    ).start()
+    base = f"http://127.0.0.1:{epp.port}"
+    try:
+        import asyncio
+
+        async with aiohttp.ClientSession() as sess:
+            # first pick warms the caches (poll: the KV router needs a
+            # beat to index the worker's registration watch events)
+            for _ in range(100):
+                async with sess.post(
+                    f"{base}/pick",
+                    json={"model": "mock-model",
+                          "prompt": "warm the caches"},
+                ) as r:
+                    if r.status == 200:
+                        break
+                await asyncio.sleep(0.05)
+            else:
+                raise AssertionError("router never learned the worker")
+            warm_scans = epp._cards.scans + epp._instances.scans
+            assert warm_scans >= 1  # the first pick paid the scans
+
+            t0 = time.perf_counter()
+            n_picks = 20
+            for i in range(n_picks):
+                async with sess.post(
+                    f"{base}/pick",
+                    json={"model": "mock-model", "prompt": f"pick {i}"},
+                ) as r:
+                    assert r.status == 200
+            elapsed = time.perf_counter() - t0
+            assert epp._cards.scans + epp._instances.scans == warm_scans, (
+                "steady-state picks paid hub round-trips"
+            )
+            # generous wall bound: 20 local cached picks in well under
+            # the old per-pick scan regime (sanity, not a perf gate)
+            assert elapsed < 10.0
+            async with sess.get(f"{base}/healthz") as r:
+                health = await r.json()
+                assert health["hub_scans"] == warm_scans
+                assert health["picks"] >= n_picks + 1
+    finally:
+        await epp.close()
+        await drt.close()
+
+
+async def test_epp_card_add_and_remove_invalidate_within_window():
+    """Regression: a NEW model card becomes pickable (and a removed one
+    stops resolving) within the invalidation window — the hub watch
+    fires immediately; the TTL is only the watch-down backstop."""
+    import asyncio
+
+    from dynamo_tpu.frontend.model_card import ModelDeploymentCard
+
+    drt = DistributedRuntime(InMemoryHub())
+    cfg = MockEngineConfig(block_size=4, speedup_ratio=1000.0)
+    _eng, served = await launch_mock_worker(
+        drt, "dyn", "backend", "generate", cfg,
+    )
+    epp = await EndpointPicker(
+        drt, namespace="dyn", target_component="backend",
+        config=RouterConfig(block_size=4), host="127.0.0.1", port=0,
+        card_ttl_s=30.0,
+    ).start()
+    base = f"http://127.0.0.1:{epp.port}"
+
+    async def pick_status(sess, model):
+        async with sess.post(
+            f"{base}/pick", json={"model": model, "prompt": "hi"}
+        ) as r:
+            return r.status
+
+    try:
+        async with aiohttp.ClientSession() as sess:
+            # cache a (card-less) snapshot first: unknown model 404s
+            assert await pick_status(sess, "late-model") == 404
+            # new card: the watch event must invalidate the cached scan
+            card = ModelDeploymentCard(
+                name="late-model", namespace="dyn",
+                component="backend", endpoint="generate",
+            )
+            key = card.key_for(served.instance.instance_id)
+            await drt.hub.put(key, card.to_dict())
+            for _ in range(40):
+                if await pick_status(sess, "late-model") == 200:
+                    break
+                await asyncio.sleep(0.05)
+            else:
+                raise AssertionError(
+                    "new card never became pickable (watch invalidation "
+                    "lost and TTL not honored)"
+                )
+            # removed card: stops resolving within the window too
+            await drt.hub.delete(key)
+            for _ in range(40):
+                if await pick_status(sess, "late-model") == 404:
+                    break
+                await asyncio.sleep(0.05)
+            else:
+                raise AssertionError("removed card kept resolving")
+    finally:
+        await epp.close()
+        await drt.close()

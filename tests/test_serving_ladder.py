@@ -119,6 +119,44 @@ def test_cpu_smoke_ladder_carries_variance_protocol(monkeypatch):
         assert key in disp
 
 
+def test_spec_decode_artifact_schema():
+    """The speculative-decoding bench section (bench.spec_decode_
+    measurement): per-rung acceptance_rate + per_stream_toks_s for BOTH
+    modes at low concurrency, the accepted-tokens-per-dispatch proxy,
+    and the recorded bar — the artifact fields the >=1.5x low-
+    concurrency claim is judged on."""
+    out = bench.spec_decode_measurement(
+        TINY, page_size=16, on_tpu=False, family="gqa",
+        concurrencies=(1, 2), osl=32, reqs_per_stream=1,
+    )
+    assert out["family"] == "gqa"
+    assert out["mode"] == "prompt-lookup spec decode"
+    assert out["k_max"] >= 1
+    assert out["bars"]["accepted_tokens_per_dispatch_min"] == 1.5
+    assert out["bars"]["incompressible_dispatch_overhead_max"] == 0.05
+    ctl = out["incompressible_control"]
+    for key in ("dispatches", "dispatches_nospec",
+                "dispatch_overhead_frac", "per_stream_toks_s",
+                "per_stream_toks_s_nospec"):
+        assert key in ctl, key
+    # the decay claim itself: spec on an incompressible prompt costs
+    # (almost) no extra dispatches — dispatch counts are CPU-exact
+    assert ctl["dispatch_overhead_frac"] <= 0.05, ctl
+    assert [r["concurrency"] for r in out["rungs"]] == [1, 2]
+    for rung in out["rungs"]:
+        for key in (
+            "per_stream_toks_s", "per_stream_toks_s_nospec", "speedup",
+            "acceptance_rate", "accepted_tokens_per_dispatch",
+            "verifies", "dispatches", "dispatches_nospec",
+        ):
+            assert key in rung, key
+        assert rung["per_stream_toks_s"] > 0
+        assert rung["per_stream_toks_s_nospec"] > 0
+    # headline convenience fields mirror rung 1 (concurrency 1)
+    assert out["per_stream_toks_s"] == out["rungs"][0]["per_stream_toks_s"]
+    assert out["acceptance_rate"] == out["rungs"][0]["acceptance_rate"]
+
+
 def test_family_serving_tuning_table():
     """Each north-star family has its own ladder tuning, and the bars
     artifact records the per-family frac targets."""

@@ -1,0 +1,356 @@
+"""Speculative decoding (engine/spec.py + models/*.verify_forward +
+engine/core.py _spec_phase): prompt-lookup drafting, batched greedy
+verify, acceptance-adaptive k.
+
+The load-bearing contract is BIT-IDENTICAL greedy output: accept-
+longest-prefix against the target's own argmax means ``spec_mode=on``
+and ``off`` must produce the same token stream at temperature 0 across
+every model family — so the whole feature gates in tier-1 on CPU. The
+rest pins the scheduling edges: adaptive-k decay on incompressible
+prompts (the <5% overhead story), exact max_tokens boundaries
+mid-verify, injected verify-failure fallback with page accounting, and
+the >=1.5 accepted-tokens-per-dispatch proxy on the repetitive
+workload."""
+
+import asyncio
+
+import numpy as np
+import pytest
+
+from dynamo_tpu.engine.config import EngineConfig, ModelSpec
+from dynamo_tpu.engine.core import InferenceEngine
+from dynamo_tpu.engine.spec import PromptLookupDrafter, SlotSpec
+from dynamo_tpu.runtime.context import Context
+from dynamo_tpu.runtime.faults import FAULTS
+
+pytestmark = pytest.mark.integration
+
+TINY_GQA = ModelSpec(
+    name="tiny-test", vocab_size=272, hidden_size=32, intermediate_size=64,
+    num_layers=2, num_heads=4, num_kv_heads=2, head_dim=8, dtype="float32",
+)
+FAMILIES = {
+    "gqa": (TINY_GQA, 272),
+    "mla": (ModelSpec.tiny_deepseek(), 96),
+    "gptoss": (ModelSpec.tiny_gpt_oss(), 96),
+}
+
+
+def _cfg(spec_mode: str = "off", **kw) -> EngineConfig:
+    base = dict(
+        page_size=4, num_pages=256, max_pages_per_seq=64,
+        max_decode_slots=2, prefill_buckets=(16, 32, 64),
+        decode_steps_per_dispatch=2, pipeline_decode=True,
+        spec_mode=spec_mode, spec_reprobe_tokens=16,
+    )
+    base.update(kw)
+    return EngineConfig(**base)
+
+
+def _repetitive(vocab: int, n: int, seed: int = 0) -> list[int]:
+    rng = np.random.default_rng(seed)
+    base = rng.integers(3, vocab, 12).tolist()
+    return (base * ((n // len(base)) + 1))[:n]
+
+
+async def _gen(engine, prompt, n, temperature=0.0):
+    out, reasons = [], []
+    async for item in engine.generate(
+        {"token_ids": list(prompt),
+         "stop_conditions": {"max_tokens": n, "ignore_eos": True},
+         "sampling": {"temperature": temperature}},
+        Context(),
+    ):
+        assert not item.get("error"), item
+        out.extend(item["token_ids"])
+        if item.get("finish_reason") is not None:
+            reasons.append(item["finish_reason"])
+    return out, reasons
+
+
+# ----------------------------------------------------------- drafter unit
+
+
+def test_drafter_longest_ngram_prior_occurrence():
+    d = PromptLookupDrafter(1, 3)
+    d.extend([1, 2, 3, 4, 1, 2, 3])
+    # suffix [1,2,3] matched at its PRIOR occurrence (pos 0) -> continues
+    # with what followed it there
+    assert d.propose(2) == [4, 1]
+    assert d.propose(5) == [4, 1, 2, 3]
+    assert d.propose(0) == []
+    # no match anywhere: empty draft
+    d2 = PromptLookupDrafter(2, 3)
+    d2.extend([1, 2, 3, 4, 5])
+    assert d2.propose(4) == []
+    # 1-gram fallback picks the most recent prior occurrence
+    d3 = PromptLookupDrafter(1, 3)
+    d3.extend([7, 8, 7, 9, 7])
+    assert d3.propose(1) == [9]  # pos 2's continuation, not pos 0's
+
+
+def test_slot_spec_adaptive_k_decay_and_reprobe():
+    st = SlotSpec(
+        drafter=PromptLookupDrafter(1, 4), k_max=8, alpha=0.5,
+        reprobe_tokens=16,
+    )
+    assert st.k == 8 and st.active
+    # four straight misses (rejections or no-match) park the slot
+    for _ in range(4):
+        st.observe(0, 0)
+    assert st.k == 0 and not st.active
+    # parked: emitted tokens count down to a k=1 reprobe
+    st.on_tokens(15)
+    assert not st.active
+    st.on_tokens(1)
+    assert st.k == 1 and st.active
+    # a successful probe climbs back toward k_max
+    st.observe(1, 1)
+    assert st.k >= 4
+    # verify-fault disable is permanent for the slot
+    st.disable()
+    st.observe(8, 8)
+    assert st.k <= st.k_max * st.ewma  # ewma path still moves...
+    st.ewma = 1.0
+    assert st.disabled and st.k == 0  # ...but disabled pins k at 0
+
+
+# --------------------------------------------------- greedy golden suite
+
+
+@pytest.mark.parametrize("fam", sorted(FAMILIES))
+async def test_greedy_goldens_bit_identical_spec_on_vs_off(fam):
+    """The headline contract: identical greedy token streams with
+    spec_mode on vs off, per family — on the repetitive workload (spec
+    engages, accepts drafts) AND an incompressible one (k decays)."""
+    spec, vocab = FAMILIES[fam]
+    rng = np.random.default_rng(3)
+    prompts = [
+        _repetitive(vocab, 40),
+        rng.integers(3, vocab, 40).tolist(),  # incompressible
+    ]
+    outs: dict[str, list] = {}
+    for mode in ("off", "ngram"):
+        engine = InferenceEngine(spec, _cfg(mode))
+        await engine.start()
+        outs[mode] = [await _gen(engine, p, 28) for p in prompts]
+        if mode == "ngram":
+            assert engine.spec_verifies > 0, "spec never engaged"
+            assert engine.allocator.active_pages == 0
+        await engine.close()
+    assert outs["ngram"] == outs["off"]
+
+
+async def test_chunked_prefill_spec_and_migration_continuity():
+    """A chunked-prefill prompt + spec decode + the migration resume
+    shape: generate half on engine A (spec on), resume on engine B with
+    prompt+generated as the new prompt (exactly what frontend/migration
+    re-drives after a worker kill — the resumed history CONTAINS the
+    drafted tokens), and the stitched stream must equal one uninterrupted
+    spec-off generation."""
+    prompt = _repetitive(272, 48)  # > max_prefill_chunk_tokens below
+    cfg_kw = dict(max_prefill_chunk_tokens=16, prefill_buckets=(16, 32, 64))
+    ref_engine = InferenceEngine(TINY_GQA, _cfg("off", **cfg_kw))
+    await ref_engine.start()
+    full, _ = await _gen(ref_engine, prompt, 24)
+    await ref_engine.close()
+
+    a = InferenceEngine(TINY_GQA, _cfg("ngram", **cfg_kw))
+    await a.start()
+    part1, _ = await _gen(a, prompt, 10)
+    await a.close()
+
+    b = InferenceEngine(TINY_GQA, _cfg("ngram", **cfg_kw))
+    await b.start()
+    part2, _ = await _gen(b, prompt + part1, 14)
+    assert b.allocator.active_pages == 0
+    await b.close()
+    assert part1 + part2 == full
+
+
+async def test_mixed_spec_and_nonspec_slots_one_engine():
+    """Greedy (spec-managed) and sampled (burst-managed) slots share one
+    engine cycle; the greedy stream stays golden."""
+    engine = InferenceEngine(TINY_GQA, _cfg("ngram"))
+    await engine.start()
+    greedy_prompt = _repetitive(272, 40)
+    sampled_prompt = _repetitive(272, 24, seed=5)
+    (greedy_out, _), (sampled_out, _) = await asyncio.gather(
+        _gen(engine, greedy_prompt, 24),
+        _gen(engine, sampled_prompt, 24, temperature=0.8),
+    )
+    assert len(greedy_out) == 24 and len(sampled_out) == 24
+    assert engine.spec_verifies > 0
+    await engine.close()
+
+    off = InferenceEngine(TINY_GQA, _cfg("off"))
+    await off.start()
+    ref, _ = await _gen(off, greedy_prompt, 24)
+    await off.close()
+    assert greedy_out == ref
+
+
+# ------------------------------------------------- boundaries + fallback
+
+
+async def test_max_tokens_boundary_exact_mid_verify():
+    """A verify whose accepted prefix crosses the token budget finishes
+    at the EXACT boundary token — no overshoot into the rejected tail,
+    same stream as spec-off (satellite: packed verify must respect
+    max_tokens mid-burst)."""
+    prompt = _repetitive(272, 40)
+    for n in (1, 3, 7):
+        outs = {}
+        for mode in ("off", "ngram"):
+            engine = InferenceEngine(TINY_GQA, _cfg(mode))
+            await engine.start()
+            toks, reasons = await _gen(engine, prompt, n)
+            assert len(toks) == n, (mode, n, toks)
+            assert reasons[-1] == "length"
+            assert engine.allocator.active_pages == 0
+            outs[mode] = toks
+            await engine.close()
+        assert outs["ngram"] == outs["off"]
+
+
+async def test_deadline_mid_generation_cancels_spec_slot():
+    """An expiring end-to-end deadline stops a spec-managed slot through
+    the same cancel path bursts use: the stream ends 'cancelled' with no
+    page leak (satellite: deadline respected mid-burst)."""
+    import time
+
+    # context big enough (1024) that the decode budget can't beat the
+    # deadline to the finish even at full spec acceptance speed
+    engine = InferenceEngine(
+        TINY_GQA,
+        _cfg("ngram", page_size=16, max_pages_per_seq=64, num_pages=512),
+    )
+    await engine.start()
+    ctx = Context("spec-deadline", deadline=time.monotonic() + 0.5)
+    got: list[int] = []
+    reason = None
+    async for item in engine.generate(
+        {"token_ids": _repetitive(272, 40),
+         "stop_conditions": {"max_tokens": 100000, "ignore_eos": True},
+         "sampling": {"temperature": 0.0}},
+        ctx,
+    ):
+        got.extend(item.get("token_ids") or ())
+        reason = item.get("finish_reason")
+        if reason is not None:
+            break
+    assert reason == "cancelled"
+    # let the step loop finish releasing the cancelled slot
+    for _ in range(250):
+        if engine.allocator.active_pages == 0:
+            break
+        await asyncio.sleep(0.02)
+    assert engine.allocator.active_pages == 0
+    await engine.close()
+
+
+async def test_spec_verify_fault_falls_back_without_corruption():
+    """Injected engine.spec_verify failure: the affected slot falls back
+    to non-spec decode with NO client-visible error, the SAME greedy
+    stream, and no page leak (page-accounting assertion)."""
+    prompt = _repetitive(272, 40)
+    off = InferenceEngine(TINY_GQA, _cfg("off"))
+    await off.start()
+    ref, _ = await _gen(off, prompt, 24)
+    await off.close()
+
+    FAULTS.configure("engine.spec_verify:error@1.0x1", seed=11)
+    try:
+        engine = InferenceEngine(TINY_GQA, _cfg("ngram"))
+        await engine.start()
+        got, reasons = await _gen(engine, prompt, 24)
+        assert got == ref
+        assert reasons[-1] == "length"
+        # the fault fired before any verify completed, and the slot
+        # never speculated again
+        assert engine.spec_verifies == 0
+        assert engine.allocator.active_pages == 0
+        snap = FAULTS.snapshot()
+        assert snap["trips"].get("engine.spec_verify:error") == 1, snap
+        await engine.close()
+    finally:
+        FAULTS.configure("")
+
+
+# --------------------------------------------- adaptive k + perf proxies
+
+
+async def test_adaptive_k_decays_on_incompressible_prompt():
+    """Random-token prompts: the drafter's spurious matches get
+    rejected, the EWMA parks the slot at k=0 within a handful of
+    verifies, and the total dispatch overhead vs spec-off stays small
+    (the <5% step-time overhead criterion, measured in dispatch counts
+    — exact on CPU where wall time is noise)."""
+    rng = np.random.default_rng(2)
+    prompt = rng.integers(3, 272, 48).tolist()
+    counts = {}
+    outs = {}
+    for mode in ("off", "ngram"):
+        engine = InferenceEngine(TINY_GQA, _cfg(mode))
+        await engine.start()
+        outs[mode], _ = await _gen(engine, prompt, 48)
+        counts[mode] = engine.dispatches
+        if mode == "ngram":
+            # parked fast: a few decay verifies + at most the periodic
+            # k=1 reprobes across 48 tokens
+            assert engine.spec_verifies <= 10, engine.spec_snapshot()
+        await engine.close()
+    assert outs["ngram"] == outs["off"]
+    assert counts["ngram"] <= counts["off"] + 10, counts
+
+
+def test_accepted_tokens_per_dispatch_meets_bar():
+    """The CPU step-count proxy for the >=1.5x per-stream claim: on the
+    repetitive/agentic workload at concurrency 1, each verify dispatch
+    lands >= 1.5 tokens (accepted drafts + the emitted target) vs the
+    1.0/dispatch non-spec baseline — via the bench.py measurement that
+    writes the artifact fields."""
+    import bench
+
+    out = bench.spec_decode_measurement(
+        TINY_GQA, 16, on_tpu=False, family="gqa", concurrencies=(1,),
+        reqs_per_stream=1,
+    )
+    r1 = out["rungs"][0]
+    assert r1["concurrency"] == 1
+    assert r1["accepted_tokens_per_dispatch"] >= 1.5, out
+    assert out["accepted_tokens_per_dispatch"] >= 1.5
+    assert 0.0 < out["acceptance_rate"] <= 1.0
+
+
+# ------------------------------------------------ observability surfaces
+
+
+async def test_spec_phases_metrics_and_snapshot(monkeypatch):
+    """spec.* profile phases accumulate (profile_engine attribution
+    consumes them), spec_snapshot carries the counters, and the
+    dynamo_spec_tokens_total counter rides every /metrics exposition."""
+    from benchmarks.profile_engine import spec_attribution
+    from dynamo_tpu.runtime.metrics import MetricsRegistry
+
+    monkeypatch.setenv("DYNAMO_ENGINE_PROFILE", "1")
+    engine = InferenceEngine(TINY_GQA, _cfg("ngram"))
+    await engine.start()
+    await _gen(engine, _repetitive(272, 40), 32)
+    snap = engine.profile_snapshot()
+    counters = engine.spec_snapshot()
+    await engine.close()
+    for phase in ("spec.draft", "spec.verify", "spec.rollback"):
+        assert snap.get(phase, {}).get("calls", 0) > 0, (phase, snap)
+    assert counters["verifies"] > 0
+    assert counters["drafted"] == (
+        counters["accepted"] + counters["rejected"]
+    )
+    attr = spec_attribution(snap, counters)
+    assert attr["accepted_tokens_per_dispatch"] is not None
+    assert attr["accepted_tokens_per_dispatch"] >= 1.0
+    assert attr["nonspec_baseline_tokens_per_dispatch"] == 1.0
+    assert attr["verify_s"] > 0
+    # global provider: any registry's exposition carries the counter
+    text = MetricsRegistry().exposition().decode()
+    assert "dynamo_spec_tokens_total" in text

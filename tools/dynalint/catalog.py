@@ -33,6 +33,10 @@ FAULT_SITES: dict[str, str] = {
     "engine.compile": "engine/core.py precompile — slow/failing shape "
                       "warmup (serving must come up and eat the compile "
                       "at first use)",
+    "engine.spec_verify": "engine/core.py speculative verify — dispatch "
+                          "failure must fall back to non-spec decode for "
+                          "the affected slots (pages rolled back, no "
+                          "client-visible error)",
     "disagg.pull": "disagg/transfer.py KV pull — transfer plane failure",
 }
 
@@ -68,6 +72,10 @@ PROFILE_PHASES: dict[str, str] = {
                         "phase sums",
     "dispatch.dispatches": "jitted device programs issued (count)",
     "dispatch.compile": "backend compile events since engine build",
+    "spec.draft": "prompt-lookup drafting over spec-managed slots",
+    "spec.verify": "packed speculative-verify dispatch + target sync",
+    "spec.rollback": "page release of rejected draft tails (and the "
+                     "injected-verify-failure fallback)",
 }
 
 # metric name (without the dynamo_ prefix MetricsRegistry adds) -> meaning
@@ -86,4 +94,7 @@ METRIC_NAMES: dict[str, str] = {
     "hub_elections_total": "hub replica election rounds by outcome "
                            "(won/lost/pre_lost)",
     "hub_term": "current fencing epoch (election term) per hub replica",
+    "spec_tokens_total": "speculative draft tokens by verify outcome "
+                         "(accepted | rejected) — the live acceptance "
+                         "rate of prompt-lookup decoding",
 }
