@@ -18,6 +18,7 @@ router / planner stack as the mocker.
 from __future__ import annotations
 
 import asyncio
+import collections
 import contextlib
 import logging
 import os
@@ -48,6 +49,8 @@ from dynamo_tpu.runtime.context import (
     ServiceUnavailable,
 )
 from dynamo_tpu.runtime.faults import FAULTS
+from dynamo_tpu.runtime import tracing
+from dynamo_tpu.runtime.flight import FLIGHT, emit_request_spans
 from dynamo_tpu.tokens import TokenBlockSequence
 
 log = logging.getLogger("dynamo.engine")
@@ -247,6 +250,15 @@ class InferenceEngine:
         # attribute compiles that happened on THIS engine's watch
         self.dispatches = 0
         self._compile_base = compile_snapshot()
+        # worker telemetry feeds (engine/telemetry.py EngineCollector):
+        # the step thread only appends to bounded deques / bumps ints;
+        # the collector turns them into /metrics histograms+counters
+        self.step_times: collections.deque = collections.deque(maxlen=4096)
+        self.burst_fills: collections.deque = collections.deque(maxlen=4096)
+        self.admission_rejects = {
+            "draining": 0, "saturated": 0, "deadline": 0,
+        }
+        self.telemetry = None  # EngineCollector, attached by the worker
 
     def _prof_add(self, name: str, dt: float) -> None:
         """Accumulate one timed event into the phase profiler (no-op
@@ -604,6 +616,8 @@ class InferenceEngine:
     async def close(self) -> None:
         self._closed = True
         self._wake.set()
+        if self.telemetry is not None:
+            await self.telemetry.close()
         if self._thread is not None and self._thread.is_alive():
             # the thread exits at the next step boundary
             await asyncio.to_thread(self._thread.join, 10.0)
@@ -626,6 +640,7 @@ class InferenceEngine:
         if self._draining:
             # SIGTERM drain: typed refusal rides the transport as a
             # retryable 503-mappable error (another worker may accept)
+            self.admission_rejects["draining"] += 1
             raise ServiceUnavailable(
                 "worker draining", retry_after_s=1.0
             )
@@ -633,11 +648,13 @@ class InferenceEngine:
             self.config.max_waiting
             and self._waiting.qsize() >= self.config.max_waiting
         ):
+            self.admission_rejects["saturated"] += 1
             raise ServiceUnavailable(
                 f"engine saturated ({self._waiting.qsize()} waiting)",
                 retry_after_s=0.5,
             )
         if context.deadline_expired:
+            self.admission_rejects["deadline"] += 1
             raise DeadlineExceeded(
                 f"request {context.id} deadline passed before admission"
             )
@@ -704,9 +721,13 @@ class InferenceEngine:
                 yield {"token_ids": [], "finish_reason": "length"}
                 return
             try:
-                disagg["_staged_kv"] = await asyncio.to_thread(
-                    lambda: pull_kv_blocks(kvp, mesh=self.mesh)
-                )
+                # one span per KV staging attempt: the disagg hop is the
+                # classic "why was THIS request slow" suspect, so its
+                # duration (and failure) joins the request's trace
+                with tracing.span("disagg.pull", request_id=context.id):
+                    disagg["_staged_kv"] = await asyncio.to_thread(
+                        lambda: pull_kv_blocks(kvp, mesh=self.mesh)
+                    )
             except Exception as e:  # noqa: BLE001
                 # transfer-plane failure (prefill worker died between
                 # export and pull, link cut, injected disagg.pull fault):
@@ -779,42 +800,82 @@ class InferenceEngine:
                 # saturation bounce; TTL reclaim is the backstop
                 except Exception:  # noqa: BLE001
                     pass
+            self.admission_rejects["saturated"] += 1
             raise ServiceUnavailable(
                 f"engine saturated ({self._waiting.qsize()} waiting)",
                 retry_after_s=0.5,
             )
+        # flight-recorder timeline + worker-side trace identity: the
+        # caller's span (bound by the transport, or live in-context for
+        # in-proc calls) parents this request's worker.request span; the
+        # step thread records lifecycle events against the timeline and
+        # the spans are derived + emitted at finish (runtime/flight.py)
+        caller_tc = tracing.current_trace() or tracing.parse_traceparent(
+            context.headers.get(tracing.TRACEPARENT)
+        )
+        wr_tc = caller_tc.child() if caller_tc else tracing.new_trace()
+        FLIGHT.start(
+            context.id, trace=wr_tc,
+            parent_span_id=caller_tc.span_id if caller_tc else None,
+            model=self.spec.name, prompt_tokens=len(token_ids),
+        )
         out_q: asyncio.Queue = asyncio.Queue()
         self._waiting.put_nowait(
             _Waiting(request, context, out_q, enq_t=time.perf_counter())
         )
         self._wake.set()
         deadline_hit = False
-        while True:
-            # after the deadline every wait is bounded (2s per item): a
-            # stuck step must not turn a deadline into a hang (the Orca
-            # stuck-request-stalls-the-batch failure mode)
-            remaining = 2.0 if deadline_hit else context.remaining_s()
-            if remaining is None:
-                item = await out_q.get()
-            else:
-                try:
-                    item = await asyncio.wait_for(out_q.get(), remaining)
-                except asyncio.TimeoutError:
-                    if deadline_hit:
-                        yield {"token_ids": [], "finish_reason": "cancelled",
-                               "error": "deadline exceeded"}
-                        return
-                    # end-to-end deadline passed mid-generation: stop the
-                    # slot (the step loop finishes it as 'cancelled')
-                    deadline_hit = True
-                    context.stop_generating()
-                    self._wake.set()
-                    continue
-            if item is None:
-                return
-            yield item
-            if item.get("finish_reason") is not None:
-                return
+        finish_reason: str | None = None
+        finish_error: str | None = None
+        n_generated = 0
+        try:
+            while True:
+                # after the deadline every wait is bounded (2s per item):
+                # a stuck step must not turn a deadline into a hang (the
+                # Orca stuck-request-stalls-the-batch failure mode)
+                remaining = 2.0 if deadline_hit else context.remaining_s()
+                if remaining is None:
+                    item = await out_q.get()
+                else:
+                    try:
+                        item = await asyncio.wait_for(out_q.get(), remaining)
+                    except asyncio.TimeoutError:
+                        if deadline_hit:
+                            finish_reason = "cancelled"
+                            finish_error = "deadline exceeded"
+                            yield {"token_ids": [],
+                                   "finish_reason": "cancelled",
+                                   "error": "deadline exceeded"}
+                            return
+                        # end-to-end deadline passed mid-generation: stop
+                        # the slot (the step loop finishes it as
+                        # 'cancelled')
+                        deadline_hit = True
+                        context.stop_generating()
+                        self._wake.set()
+                        continue
+                if item is None:
+                    return
+                n_generated += len(item.get("token_ids") or ())
+                # record BEFORE the yield: downstream operators stop
+                # iterating once they see the finish item, so this
+                # generator may never be resumed past it (it gets a
+                # GeneratorExit at the yield instead)
+                if item.get("finish_reason") is not None:
+                    finish_reason = item["finish_reason"]
+                    finish_error = item.get("error")
+                yield item
+                if finish_reason is not None:
+                    return
+        finally:
+            tl = FLIGHT.finish(
+                context.id,
+                finish_reason or "abandoned",  # consumer broke the stream
+                error=finish_error,
+                generated=n_generated,
+            )
+            if tl is not None:
+                emit_request_spans(tl)
 
     # -- step loop ---------------------------------------------------------
 
@@ -829,7 +890,12 @@ class InferenceEngine:
                     # every-in-flight-then-keep-serving recovery below is
                     # exactly what the fault exercises; delay = stalled step
                     FAULTS.fire_sync("engine.step")
+                step_t0 = time.perf_counter()
                 did_work = self._step()
+                if did_work:
+                    # telemetry feed: work cycles only (idle polls would
+                    # drown the latency histogram in wake-timeout noise)
+                    self.step_times.append(time.perf_counter() - step_t0)
                 if not did_work:
                     self._wake.clear()
                     if (
@@ -1054,6 +1120,7 @@ class InferenceEngine:
             if not decoding and n_admitted >= cold_cap:
                 break  # stagger the cold wave (convoy breaker)
             waiting = self._waiting.get_nowait()
+            FLIGHT.event(waiting.context.id, "admit")
             if self._profiling:
                 waiting.admit_t = time.perf_counter()
                 if waiting.enq_t:
@@ -1701,6 +1768,7 @@ class InferenceEngine:
         # long prompt: remaining chunks advance on subsequent steps,
         # interleaved with decode (_step)
         end = start_pos + chunk_max
+        FLIGHT.event(waiting.context.id, "prefill_chunk")
         logits = self._run_prefill_chunk(sp, token_ids, start_pos, end)
         self._partial = _PartialPrefill(
             slot_idx, waiting, seq, sp, token_ids, end, max_tokens
@@ -2276,6 +2344,7 @@ class InferenceEngine:
                 time.perf_counter() - slot.prefill_done_t,
             )
             slot.prefill_done_t = 0.0
+        FLIGHT.event(slot.context.id, "first_token")
         slot.seq.append(tok)
         slot.last_token = tok
         slot.first_pending = False
@@ -2386,6 +2455,7 @@ class InferenceEngine:
             self._publish_metrics()
             return
         end = min(p.done + self._prefill_chunk_max(), len(p.token_ids))
+        FLIGHT.event(p.waiting.context.id, "prefill_chunk")
         logits = self._run_prefill_chunk(p.sp, p.token_ids, p.done, end)
         p.done = end
         if end == len(p.token_ids):
@@ -2512,6 +2582,9 @@ class InferenceEngine:
         )
         slot.seq.append(first_token)
         self._slots[slot_idx] = slot
+        # the remote prefill already produced the first token: this is
+        # the request's decode start for the flight timeline/spans
+        FLIGHT.event(waiting.context.id, "disagg_resume")
         self._publish_metrics()
 
     # -- speculative decoding (runs in thread) -----------------------------
@@ -2637,6 +2710,13 @@ class InferenceEngine:
                             slot.pages.truncate(base_pages)
                         )
                         slot.spec.disable()
+                        # fault trips land on the affected timelines: the
+                        # flight recorder is where "this request went
+                        # non-spec mid-stream" becomes explainable
+                        FLIGHT.event(
+                            slot.context.id, "fault",
+                            site="engine.spec_verify",
+                        )
                 log.warning(
                     "spec verify fault (%s): %d slot(s) fall back to "
                     "non-spec decode", e, len(ready),
@@ -2701,6 +2781,7 @@ class InferenceEngine:
         self.spec_drafted += drafted
         self.spec_accepted += n_acc
         self.spec_rejected += drafted - n_acc
+        FLIGHT.event(slot.context.id, "spec_verify", accepted=n_acc)
         if drafted:
             SPEC_TOKENS.labels(outcome="accepted").inc(n_acc)
             SPEC_TOKENS.labels(outcome="rejected").inc(drafted - n_acc)
@@ -3111,6 +3192,12 @@ class InferenceEngine:
                 slot.spec.on_tokens(len(toks))
             self._maybe_seal(slot)
         self._drain_offload()
+        if burst:
+            # telemetry feed: tokens this dispatch actually landed across
+            # all participating slots (stops cut bursts short)
+            self.burst_fills.append(
+                sum(len(toks) for toks, _f in burst.values())
+            )
 
         # phase 2: stream tokens, finish slots
         for i, (toks, finish) in burst.items():
@@ -3201,6 +3288,7 @@ class InferenceEngine:
                 time.perf_counter() - slot.prefill_done_t,
             )
             slot.prefill_done_t = 0.0
+        FLIGHT.event(slot.context.id, "first_token")
         finish = self._accept_token(slot, tok)
         if finish is not None:
             # release resources BEFORE posting the finish item, so a client

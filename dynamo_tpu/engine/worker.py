@@ -206,6 +206,22 @@ async def launch_engine_worker(
             # the operator's call
             await _withdraw_and_begin_drain(drt, engine, served)
             yield {"ok": True, "inflight": engine.inflight()}
+        elif request.get("op") == "timeline":
+            # flight recorder (runtime/flight.py): one request's full
+            # event timeline by id, or the summary view (active + recent
+            # + retained errors/slowest) — the live "why was THIS
+            # request slow" query, also fanned out by the frontend's
+            # GET /debug/timeline route
+            from dynamo_tpu.runtime.flight import FLIGHT
+
+            try:
+                n = int(request.get("n") or 16)
+            except (TypeError, ValueError):
+                n = 16
+            yield {
+                "ok": True,
+                **FLIGHT.snapshot(request.get("request_id"), n=n),
+            }
         elif request.get("op") == "cache_status":
             yield {
                 "ok": True,
@@ -228,6 +244,12 @@ async def launch_engine_worker(
     wid = served.instance.instance_id
     engine.events = KvEventPublisher(drt.hub, comp_path, wid).start()
     engine.metrics = WorkerMetricsPublisher(drt.hub, comp_path, wid).start()
+    # worker telemetry registry (engine/telemetry.py): periodic sampler
+    # feeding step/burst histograms + pool/queue gauges onto every
+    # /metrics surface — closed via engine.close()
+    from dynamo_tpu.engine.telemetry import EngineCollector
+
+    engine.telemetry = EngineCollector(engine).start()
     await engine.start()
     if health is not None:
         health.register(served)
@@ -453,8 +475,16 @@ async def _amain(args: argparse.Namespace) -> None:
                 timeout_s=args.health_timeout,
             ),
         )
+        # a registry on the status server turns its /metrics on; the
+        # exposition also renders every registered global provider —
+        # the engine telemetry registry first among them — so operators
+        # scrape worker step/pool/queue metrics here (ref
+        # system_status_server.rs + metrics.rs)
+        from dynamo_tpu.runtime.metrics import MetricsRegistry
+
         status_server = await SystemStatusServer(
-            health=health, port=args.health_port
+            health=health, metrics=MetricsRegistry(),
+            port=args.health_port,
         ).start()
         print(f"SYSTEM_STATUS_PORT={status_server.port}", flush=True)
 

@@ -16,6 +16,7 @@ AsyncEngineContext.stop_generating).
 from __future__ import annotations
 
 import asyncio
+import contextlib
 import json
 import logging
 import time
@@ -79,6 +80,7 @@ class HttpFrontend:
                 web.post("/v1/embeddings", self.embeddings),
                 web.get("/v1/models", self.models),
                 web.post("/clear_kv_blocks", self.clear_kv_blocks),
+                web.get("/debug/timeline", self.debug_timeline),
                 web.get("/health", self.health),
                 web.get("/live", self.health),
                 web.get("/ready", self.health),
@@ -149,17 +151,22 @@ class HttpFrontend:
         return pipe, None
 
     def _traced_context(self, request: web.Request) -> Context:
-        """Per-request Context joined to the client's W3C trace (or a new
-        one); the traceparent rides Context.headers to workers
-        (runtime/tracing.py). Every request gets an END-TO-END DEADLINE
-        (default ``request_timeout_s``; ``x-dyn-timeout-ms`` tightens it),
-        propagated frontend -> migration -> worker so no failure chain can
-        cost a client more than its budget."""
+        """Per-request Context joined to the route's server span (the
+        ``http.request`` span the caller opened after ``bind_trace``, so
+        its traceparent continues the client's W3C trace or starts a new
+        one); the traceparent rides Context.headers to workers, where the
+        transport client re-stamps it with its own ``transport.call``
+        span at send time (runtime/tracing.py). Every request gets an
+        END-TO-END DEADLINE (default ``request_timeout_s``;
+        ``x-dyn-timeout-ms`` tightens it), propagated frontend ->
+        migration -> worker so no failure chain can cost a client more
+        than its budget."""
         headers: dict[str, str] = {}
-        incoming = request.headers.get(tracing.TRACEPARENT)
-        if incoming:
-            headers[tracing.TRACEPARENT] = incoming
-        tracing.ensure_trace(headers)
+        cur = tracing.current_trace()
+        if cur is None:
+            cur = tracing.ensure_trace(headers)
+        else:
+            headers[tracing.TRACEPARENT] = cur.to_traceparent()
         timeout_s = self.request_timeout_s
         raw = request.headers.get(TIMEOUT_HEADER)
         if raw:
@@ -219,6 +226,9 @@ class HttpFrontend:
                                        "on every worker", "admin"),
                 "/health": op("Liveness", "ops", method="get"),
                 "/metrics": op("Prometheus exposition", "ops", method="get"),
+                "/debug/timeline": op(
+                    "Flight-recorder timelines from every worker", "ops",
+                    method="get"),
             },
         }
         return web.json_response(spec)
@@ -269,16 +279,33 @@ class HttpFrontend:
             self._m_requests.labels(str(body.get("model")), route, str(err.status)).inc()
             return err
         model = pipe.card.name
-        ctx = self._traced_context(request)
+        # server span for the whole route handling (admission through
+        # stream completion), child of the client's traceparent when one
+        # came in — the root of this request's frontend-side span tree.
+        # bind_trace also CLEARS any binding a previous request left on
+        # this keep-alive connection's task.
+        tracing.bind_trace(request.headers)
+        with tracing.span("http.request", route=route, model=model):
+            ctx = self._traced_context(request)
+            return await self._serve_completions(
+                request, body, pipe, route, chat=chat, ctx=ctx
+            )
+
+    async def _serve_completions(
+        self, request: web.Request, body: dict, pipe: ModelPipeline,
+        route: str, *, chat: bool, ctx: Context,
+    ) -> web.StreamResponse:
+        model = pipe.card.name
         t_start = time.monotonic()
         self._m_inflight.labels(model).inc()
         try:
             try:
                 # CPU-bound render+tokenize runs on the compute pool, not
                 # the serving event loop (ref compute/pool.rs)
-                preprocessed = await self._compute.run(
-                    pipe.preprocessor.preprocess, body
-                )
+                with tracing.span("http.preprocess"):
+                    preprocessed = await self._compute.run(
+                        pipe.preprocessor.preprocess, body
+                    )
             except ValueError as e:
                 self._m_requests.labels(model, route, "400").inc()
                 return _error(400, str(e))
@@ -497,12 +524,24 @@ class HttpFrontend:
             "top_p": body.get("top_p"),
         }
         chat_body = {k: v for k, v in chat_body.items() if v is not None}
-        ctx = self._traced_context(request)
+        tracing.bind_trace(request.headers)
+        with tracing.span("http.request", route="responses", model=model):
+            ctx = self._traced_context(request)
+            return await self._serve_responses(
+                request, body, pipe, chat_body, ctx
+            )
+
+    async def _serve_responses(
+        self, request: web.Request, body: dict, pipe: ModelPipeline,
+        chat_body: dict, ctx: Context,
+    ) -> web.StreamResponse:
+        model = pipe.card.name
         rid = f"resp_{ctx.id}"
         try:
-            preprocessed = await self._compute.run(
-                pipe.preprocessor.preprocess, chat_body
-            )
+            with tracing.span("http.preprocess"):
+                preprocessed = await self._compute.run(
+                    pipe.preprocessor.preprocess, chat_body
+                )
         except ValueError as e:
             return _error(400, str(e))
         prompt_tokens = len(preprocessed["token_ids"])
@@ -593,15 +632,10 @@ class HttpFrontend:
             },
         })
 
-    async def clear_kv_blocks(self, request: web.Request) -> web.Response:
-        """Admin: evict every worker's inactive prefix-cache pages (ref
-        http/service/clear_kv_blocks.rs -> worker admin endpoints)."""
-        if self._drt is None:
-            return _error(501, "admin plane unavailable (no runtime handle)")
-        results: dict[str, Any] = {}
-        # discover every component exposing an admin endpoint — NOT via
-        # model cards: prefill workers register no card but do register
-        # admin (disagg deployments must clear both pools)
+    async def _admin_components(self) -> list[tuple[str, str]]:
+        """Discover every component exposing an admin endpoint — NOT via
+        model cards: prefill workers register no card but do register
+        admin (disagg deployments must reach both pools)."""
         instance_keys = await self._drt.hub.get_prefix("v1/instances/")
         admin_components: set[tuple[str, str]] = set()
         for key in instance_keys:
@@ -609,7 +643,71 @@ class HttpFrontend:
             # v1/instances/{ns}/{component}/{endpoint}/{instance}
             if len(parts) >= 6 and parts[4] == "admin":
                 admin_components.add((parts[2], parts[3]))
-        for ns, comp in sorted(admin_components):
+        return sorted(admin_components)
+
+    async def debug_timeline(self, request: web.Request) -> web.Response:
+        """Flight-recorder query: fan ``{"op": "timeline"}`` out to every
+        worker's admin endpoint and merge the answers — by request id
+        (``?request_id=``) for one full per-request event timeline
+        (admission -> phase transitions -> finish, with trace_id), or
+        without for each worker's summary view (active + recent tail +
+        retained errors/slowest). The HTTP face of runtime/flight.py."""
+        if self._drt is None:
+            return _error(501, "admin plane unavailable (no runtime handle)")
+        request_id = request.query.get("request_id")
+        try:
+            n = int(request.query.get("n") or 16)
+        except ValueError:
+            return _error(400, "n must be an integer")
+        results: dict[str, Any] = {}
+        for ns, comp in await self._admin_components():
+            ep = self._drt.namespace(ns).component(comp).endpoint("admin")
+            client = await ep.client().start()
+            try:
+                try:
+                    await client.wait_for_instances(1, timeout=2)
+                except TimeoutError:
+                    results[f"{ns}/{comp}"] = {"error": "no admin instances"}
+                    continue
+                workers: dict[str, Any] = {}
+                for inst in client.instances():
+                    try:
+                        # aclosing: breaking out of the stream must
+                        # close the generator IN THIS TASK, so its
+                        # transport.call span ends here instead of at
+                        # GC finalization (where the contextvar binding
+                        # would leak and mis-parent the next hop's span)
+                        async with contextlib.aclosing(
+                            client.call_instance(
+                                inst.instance_id,
+                                {"op": "timeline",
+                                 "request_id": request_id, "n": n},
+                                # bounded admin budget (DL008): one
+                                # wedged worker must not hang the fan-out
+                                Context(deadline=time.monotonic() + 10.0),
+                            )
+                        ) as stream:
+                            async for item in stream:
+                                workers[f"{inst.instance_id:x}"] = item
+                                break
+                    except (StreamError, DeadlineExceeded) as e:
+                        workers[f"{inst.instance_id:x}"] = {
+                            "error": str(e)
+                        }
+                results[f"{ns}/{comp}"] = workers
+            finally:
+                await client.close()
+        return web.json_response(
+            {"request_id": request_id, "results": results}
+        )
+
+    async def clear_kv_blocks(self, request: web.Request) -> web.Response:
+        """Admin: evict every worker's inactive prefix-cache pages (ref
+        http/service/clear_kv_blocks.rs -> worker admin endpoints)."""
+        if self._drt is None:
+            return _error(501, "admin plane unavailable (no runtime handle)")
+        results: dict[str, Any] = {}
+        for ns, comp in await self._admin_components():
             ep = self._drt.namespace(ns).component(comp).endpoint("admin")
             client = await ep.client().start()
             try:
@@ -621,15 +719,22 @@ class HttpFrontend:
                 acks = 0
                 for inst in client.instances():
                     try:
-                        async for item in client.call_instance(
-                            inst.instance_id, {"op": "clear_kv_blocks"},
-                            # bounded admin budget: one wedged worker must
-                            # not hang the whole fan-out (DL008)
-                            Context(deadline=time.monotonic() + 10.0),
-                        ):
-                            if isinstance(item, dict) and item.get("ok"):
-                                acks += 1
-                            break
+                        # aclosing: same early-break contract as
+                        # debug_timeline — close the stream in-task so
+                        # the transport.call span/context unwind here
+                        async with contextlib.aclosing(
+                            client.call_instance(
+                                inst.instance_id,
+                                {"op": "clear_kv_blocks"},
+                                # bounded admin budget: one wedged worker
+                                # must not hang the whole fan-out (DL008)
+                                Context(deadline=time.monotonic() + 10.0),
+                            )
+                        ) as stream:
+                            async for item in stream:
+                                if isinstance(item, dict) and item.get("ok"):
+                                    acks += 1
+                                break
                     except (StreamError, DeadlineExceeded):
                         pass
                 results[f"{ns}/{comp}"] = {"workers_cleared": acks}
@@ -660,7 +765,16 @@ class HttpFrontend:
         # same trace + end-to-end deadline contract as the generation
         # routes (dynalint DL008: a deadline-less root here left every
         # embedding fan-out unbounded)
-        ctx = self._traced_context(request)
+        tracing.bind_trace(request.headers)
+        with tracing.span(
+            "http.request", route="embeddings", model=pipe.card.name
+        ):
+            ctx = self._traced_context(request)
+            return await self._serve_embeddings(pipe, inputs, ctx)
+
+    async def _serve_embeddings(
+        self, pipe: ModelPipeline, inputs: list, ctx: Context
+    ) -> web.Response:
         data = []
         for i, text in enumerate(inputs):
             token_ids = pipe.preprocessor.tokenizer.encode(text)

@@ -35,6 +35,7 @@ import logging
 import random
 from typing import Any, AsyncIterator
 
+from dynamo_tpu.runtime import tracing
 from dynamo_tpu.runtime.context import (
     Context,
     DeadlineExceeded,
@@ -169,7 +170,17 @@ class Migration:
             if retry:
                 STATS["migrations"] += 1
                 STATS["resumed_tokens"] += len(generated)
-                await asyncio.sleep(delay)
+                # the BACKOFF joins the request's trace — the invisible
+                # "request went quiet" gap after a stream death. The
+                # re-driven attempt itself shows up as the NEXT
+                # transport.call span in the same trace (this span's
+                # sibling), so the trace reads: call -> resume wait ->
+                # call.
+                with tracing.span(
+                    "migration.resume", attempt=attempt,
+                    resumed_tokens=len(generated),
+                ):
+                    await asyncio.sleep(delay)
                 # resume: prompt = original + generated so far; shrink budget
                 stop = dict(request.get("stop_conditions") or {})
                 max_tokens = stop.get("max_tokens")

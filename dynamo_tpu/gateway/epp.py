@@ -37,7 +37,9 @@ from aiohttp import web
 
 from dynamo_tpu.kv_router.protocols import RouterConfig
 from dynamo_tpu.kv_router.router import KvRouter
+from dynamo_tpu.runtime import tracing
 from dynamo_tpu.runtime.component import INSTANCE_ROOT, Instance
+from dynamo_tpu.runtime.metrics import MetricsRegistry
 
 log = logging.getLogger("dynamo.gateway.epp")
 
@@ -51,10 +53,13 @@ class _PrefixCache:
     watch delivery); the TTL bounds staleness when the watch stream is
     down and the hub only answers plain RPCs."""
 
-    def __init__(self, hub, prefix: str, ttl_s: float):
+    def __init__(self, hub, prefix: str, ttl_s: float, on_lookup=None):
         self.hub = hub
         self.prefix = prefix
         self.ttl_s = ttl_s
+        # observability hook: called with "hit" | "miss" per get() (the
+        # EPP bridges it into dynamo_epp_cache_lookups_total)
+        self.on_lookup = on_lookup
         self._snap: dict[str, Any] | None = None
         self._expiry = 0.0
         # invalidation generation: a watch event arriving WHILE a scan
@@ -68,7 +73,10 @@ class _PrefixCache:
         self.scans = 0  # hub round-trips actually paid (observability)
 
     async def get(self) -> dict[str, Any]:
-        if self._snap is not None and time.monotonic() < self._expiry:
+        hit = self._snap is not None and time.monotonic() < self._expiry
+        if self.on_lookup is not None:
+            self.on_lookup("hit" if hit else "miss")
+        if hit:
             return self._snap
         if self._refill is None or self._refill.done():
             self._refill = asyncio.get_running_loop().create_task(
@@ -133,18 +141,35 @@ class EndpointPicker:
         self._tokenizers: dict[str, Any] = {}
         self._runner: web.AppRunner | None = None
         self.picks = 0
+        # pick-path telemetry (complements PR 9's hub_scans healthz
+        # field): pick latency histogram + per-cache hit/miss counters,
+        # served on this process's /metrics route
+        self.metrics = MetricsRegistry()
+        self._m_pick = self.metrics.histogram(
+            "epp_pick_seconds", "EPP pick-path latency",
+            buckets=(0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05,
+                     0.1, 0.25, 0.5, 1.0),
+        )
+        self._m_cache = self.metrics.counter(
+            "epp_cache_lookups_total",
+            "pick-path prefix-cache lookups", ["cache", "outcome"],
+        )
         # pick-path caches: model cards (tokenizer resolution) and
         # instance records (winner address) — both watch-invalidated
         # with a TTL backstop, so a steady-state pick touches the hub
         # zero times (tests/test_gateway_epp.py micro-benchmark)
         from dynamo_tpu.frontend.model_card import MDC_ROOT
 
-        self._cards = _PrefixCache(drt.hub, MDC_ROOT + "/", card_ttl_s)
+        self._cards = _PrefixCache(
+            drt.hub, MDC_ROOT + "/", card_ttl_s,
+            on_lookup=lambda o: self._m_cache.labels("cards", o).inc(),
+        )
         self._instances = _PrefixCache(
             drt.hub,
             f"{INSTANCE_ROOT}/{namespace}/{target_component}/"
             f"{target_endpoint}/",
             card_ttl_s,
+            on_lookup=lambda o: self._m_cache.labels("instances", o).inc(),
         )
         self._watch_tasks: list[asyncio.Task] = []
 
@@ -163,6 +188,7 @@ class EndpointPicker:
         app = web.Application()
         app.router.add_post("/pick", self._pick)
         app.router.add_get("/healthz", self._healthz)
+        app.router.add_get("/metrics", self._metrics)
         self._runner = web.AppRunner(app)
         await self._runner.setup()
         site = web.TCPSite(self._runner, self.host, self.port)
@@ -229,7 +255,28 @@ class EndpointPicker:
             "hub_scans": self._cards.scans + self._instances.scans,
         })
 
+    async def _metrics(self, _req: web.Request) -> web.Response:
+        return web.Response(
+            body=self.metrics.exposition(),
+            content_type="text/plain",
+            charset="utf-8",
+        )
+
     async def _pick(self, req: web.Request) -> web.Response:
+        """One routing decision. Joined to the caller's W3C trace when a
+        ``traceparent`` header rides along (the GIE ext-proc forwards
+        request headers), so the pick shows up in the same trace as the
+        completion it routed; latency lands in dynamo_epp_pick_seconds
+        either way."""
+        t0 = time.monotonic()
+        tracing.bind_trace(req.headers)
+        with tracing.span("epp.pick"):
+            try:
+                return await self._pick_inner(req)
+            finally:
+                self._m_pick.observe(time.monotonic() - t0)
+
+    async def _pick_inner(self, req: web.Request) -> web.Response:
         try:
             body = await req.json()
         # dynalint: disable=DL003 -- mapped to a typed 400 response; the

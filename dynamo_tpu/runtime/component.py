@@ -217,26 +217,36 @@ class Client:
     async def call_instance(
         self, instance_id: int, payload: Any, context: Context
     ) -> AsyncIterator[Any]:
-        """Issue a streaming call to a specific instance."""
+        """Issue a streaming call to a specific instance. The whole
+        stream runs under a ``transport.call`` span — dispatch through
+        end-of-stream — whose context the wire hop propagates, so the
+        worker's spans nest directly beneath it (runtime/tracing.py)."""
+        from dynamo_tpu.runtime import tracing
+
         inst = self._instances.get(instance_id)
         if inst is None:
             raise StreamError(f"instance {instance_id:x} not found for {self.endpoint.path}")
-        if inst.transport == "local":
-            handler = self._drt.local_registry.get(inst.wire_path)
-            if handler is None:
-                raise StreamError(f"local instance {instance_id:x} has no handler")
-            async for item in call_local(handler, payload, context):
-                yield item
-            return
-        ch = await self._channel(inst)
-        try:
-            async for item in ch.call(inst.wire_path, payload, context):
-                yield item
-        except StreamError:
-            # connection-level death: drop the channel so the next call redials
-            self._channels.pop(instance_id, None)
-            await ch.close()
-            raise
+        with tracing.span(
+            "transport.call",
+            endpoint=self.endpoint.path, instance=f"{instance_id:x}",
+        ):
+            if inst.transport == "local":
+                handler = self._drt.local_registry.get(inst.wire_path)
+                if handler is None:
+                    raise StreamError(f"local instance {instance_id:x} has no handler")
+                async for item in call_local(handler, payload, context):
+                    yield item
+                return
+            ch = await self._channel(inst)
+            try:
+                async for item in ch.call(inst.wire_path, payload, context):
+                    yield item
+            except StreamError:
+                # connection-level death: drop the channel so the next
+                # call redials
+                self._channels.pop(instance_id, None)
+                await ch.close()
+                raise
 
     async def _channel(self, inst: Instance) -> InstanceChannel:
         ch = self._channels.get(inst.instance_id)

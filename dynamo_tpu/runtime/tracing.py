@@ -8,14 +8,20 @@ log records carrying trace_id/span_id/parent; when an OTLP endpoint is
 configured (``DYN_OTLP_ENDPOINT`` or ``set_otlp_endpoint``), the same
 spans also batch to ``{endpoint}/v1/traces`` as OTLP/HTTP JSON — the
 opentelemetry package is not required; the request body is built by
-hand to the OTLP spec, so any standard collector ingests it. The
-``traceparent`` header follows https://www.w3.org/TR/trace-context/
-(version 00) so external clients and proxies interoperate.
+hand to the OTLP spec, so any standard collector ingests it. With
+``DYN_TRACE_FILE`` set (or ``set_trace_file``), every span record also
+appends to that JSONL file — the artifact the e2e trace tests and the
+flight-recorder docs parse. The ``traceparent`` header follows
+https://www.w3.org/TR/trace-context/ (version 00) so external clients
+and proxies interoperate.
 
-Propagation: the frontend extracts/creates a traceparent per request and
-stashes it in Context.headers; the transport carries headers to workers
-(runtime/transport.py frame field); workers bind the trace with
-``bind_trace(context.headers)`` so their spans join the request's trace.
+Propagation: the frontend extracts the incoming trace (``bind_trace``)
+and opens its server span; the transport client stamps ITS span's
+traceparent onto the wire headers at send time; the worker binds the
+caller's span context and the engine emits the request-lifecycle spans
+under it (runtime/flight.py). Every emitted span name is catalogued in
+tools/dynalint/catalog.py SPAN_NAMES (dynalint DL006 enforces the sync,
+like fault sites and metric names).
 """
 
 from __future__ import annotations
@@ -53,19 +59,32 @@ class TraceContext:
         return f"00-{self.trace_id}-{self.span_id}-{flags}"
 
     def child(self) -> "TraceContext":
-        return TraceContext(self.trace_id, _new_span_id(), self.sampled)
+        return TraceContext(self.trace_id, new_span_id(), self.sampled)
 
 
-def _new_span_id() -> str:
+def new_span_id() -> str:
     return secrets.token_hex(8)
 
 
 def new_trace() -> TraceContext:
-    return TraceContext(secrets.token_hex(16), _new_span_id())
+    return TraceContext(secrets.token_hex(16), new_span_id())
+
+
+_HEX = frozenset("0123456789abcdef")
+
+
+def _lower_hex(s: str) -> bool:
+    """W3C trace-context requires LOWERCASE hex; uppercase is malformed."""
+    return bool(s) and all(c in _HEX for c in s)
 
 
 def parse_traceparent(header: str | None) -> TraceContext | None:
-    """W3C header -> TraceContext; None on absent/malformed."""
+    """W3C header -> TraceContext; None on absent/malformed.
+
+    Spec-compliant rejection set (https://www.w3.org/TR/trace-context/):
+    wrong field count/length, non-hex or UPPERCASE hex in any field,
+    version ``ff`` (explicitly forbidden), and all-zero trace/span ids.
+    """
     if not header:
         return None
     parts = header.strip().split("-")
@@ -74,18 +93,26 @@ def parse_traceparent(header: str | None) -> TraceContext | None:
     version, trace_id, span_id, flags = parts
     if (
         len(version) != 2 or len(trace_id) != 32 or len(span_id) != 16
+        or len(flags) != 2
+        or not _lower_hex(version) or not _lower_hex(trace_id)
+        or not _lower_hex(span_id) or not _lower_hex(flags)
+        or version == "ff"  # forbidden by the spec
         or trace_id == "0" * 32 or span_id == "0" * 16
     ):
         return None
-    try:
-        sampled = bool(int(flags, 16) & 1)
-    except ValueError:
-        return None
-    return TraceContext(trace_id.lower(), span_id.lower(), sampled)
+    sampled = bool(int(flags, 16) & 1)
+    return TraceContext(trace_id, span_id, sampled)
 
 
 def current_trace() -> TraceContext | None:
     return _current.get()
+
+
+def set_current(tc: TraceContext | None) -> None:
+    """Explicitly (re)bind the current trace context. Prefer ``span()`` /
+    ``bind_trace``; this is the escape hatch for code that manages span
+    identities by hand (flight-recorder span derivation)."""
+    _current.set(tc)
 
 
 def ensure_trace(headers: dict[str, str] | None = None) -> TraceContext:
@@ -99,19 +126,81 @@ def ensure_trace(headers: dict[str, str] | None = None) -> TraceContext:
     return tc
 
 
-def bind_trace(headers: dict[str, str] | None) -> TraceContext | None:
-    """Worker side: join the caller's trace from propagated headers."""
+def bind_trace(headers) -> TraceContext | None:
+    """Server side: join the CALLER's span context from propagated
+    headers — the parsed context becomes current (the remote parent), so
+    the first ``span()`` opened here is its direct child and the cross-
+    process parent chain has no unemitted gap. Absent or malformed
+    headers CLEAR the binding: a task reused across requests (keep-alive
+    HTTP connections, transport reader loops) must not leak the previous
+    request's trace into the next."""
     tc = parse_traceparent((headers or {}).get(TRACEPARENT))
-    if tc is not None:
-        tc = tc.child()
-        _current.set(tc)
+    _current.set(tc)
     return tc
+
+
+def _record_span(
+    name: str,
+    tc: TraceContext,
+    parent_span_id: str | None,
+    start_ns: int,
+    end_ns: int,
+    attrs: dict | None,
+    error: str | None,
+) -> None:
+    """Single emission chokepoint: JSONL log record + optional trace file
+    + optional OTLP batch. Never raises (tracing must not take serving
+    down)."""
+    record = {
+        "span": name,
+        "trace_id": tc.trace_id,
+        "span_id": tc.span_id,
+        "parent_span_id": parent_span_id,
+        "duration_ms": round((end_ns - start_ns) / 1e6, 3),
+        **(attrs or {}),
+    }
+    if error:
+        record["error"] = error
+    line = json.dumps(record)
+    log.info("%s", line)
+    if _file_sink() is not None:
+        try:
+            with _trace_file_lock:
+                # re-read under the lock: a concurrent set_trace_file
+                # may have closed the handle _file_sink() returned
+                if _trace_file is not None:
+                    _trace_file.write(line + "\n")
+                    _trace_file.flush()
+        except (OSError, ValueError):  # disk full / closed file: drop,
+            pass  # keep serving
+    exporter = _exporter()
+    if exporter is not None:
+        exporter.enqueue(
+            name, tc, parent_span_id, start_ns, end_ns, attrs or {}, error
+        )
+
+
+def emit_span(
+    name: str,
+    tc: TraceContext,
+    *,
+    parent_span_id: str | None = None,
+    start_ns: int,
+    end_ns: int,
+    attrs: dict | None = None,
+    error: str | None = None,
+) -> None:
+    """Emit one already-timed span with an explicit identity — the
+    low-level API behind ``span()``, used where timings were recorded
+    off-thread (the engine's flight recorder derives request-lifecycle
+    spans from step-thread timestamps at finish)."""
+    _record_span(name, tc, parent_span_id, start_ns, end_ns, attrs, error)
 
 
 @contextlib.contextmanager
 def span(name: str, **attrs):
     """Timed span under the current trace, emitted as one JSONL record
-    (and to the OTLP exporter when configured)."""
+    (and to the trace file / OTLP exporter when configured)."""
     parent = _current.get()
     tc = parent.child() if parent else new_trace()
     token = _current.set(tc)
@@ -124,25 +213,55 @@ def span(name: str, **attrs):
         error = f"{type(e).__name__}: {e}"
         raise
     finally:
-        _current.reset(token)
-        dur_ms = round((time.monotonic() - t0) * 1e3, 3)
-        record = {
-            "span": name,
-            "trace_id": tc.trace_id,
-            "span_id": tc.span_id,
-            "parent_span_id": parent.span_id if parent else None,
-            "duration_ms": dur_ms,
-            **attrs,
-        }
-        if error:
-            record["error"] = error
-        log.info("%s", json.dumps(record))
-        exporter = _exporter()
-        if exporter is not None:
-            exporter.enqueue(
-                name, tc, parent, start_ns,
-                start_ns + int(dur_ms * 1e6), attrs, error,
-            )
+        try:
+            _current.reset(token)
+        except ValueError:
+            # abandoned-async-generator finalization runs in a fresh
+            # context (loop shutdown_asyncgens / GC hook); the token is
+            # foreign there. The binding we'd reset doesn't exist in
+            # this context anyway — emit the span and move on.
+            pass
+        end_ns = start_ns + int((time.monotonic() - t0) * 1e9)
+        _record_span(
+            name, tc, parent.span_id if parent else None,
+            start_ns, end_ns, attrs, error,
+        )
+
+
+# ------------------------------------------------------------ file sink
+
+_trace_file_lock = threading.Lock()
+_trace_file = None
+_trace_file_checked = False
+
+
+def set_trace_file(path: str | None):
+    """Install (or clear, with None) the process-wide span JSONL file."""
+    global _trace_file, _trace_file_checked
+    with _trace_file_lock:
+        if _trace_file is not None:
+            try:
+                _trace_file.close()
+            except OSError:
+                pass
+        _trace_file = open(path, "a") if path else None
+        _trace_file_checked = True
+    return _trace_file
+
+
+def _file_sink():
+    global _trace_file, _trace_file_checked
+    if not _trace_file_checked:
+        with _trace_file_lock:
+            if not _trace_file_checked:
+                _trace_file_checked = True
+                env = (os.environ.get("DYN_TRACE_FILE") or "").strip()
+                if env:
+                    try:
+                        _trace_file = open(env, "a")
+                    except OSError as e:
+                        log.warning("DYN_TRACE_FILE %r unusable: %s", env, e)
+    return _trace_file
 
 
 # ------------------------------------------------------------ OTLP export
@@ -152,7 +271,9 @@ class OtlpExporter:
     """Batching OTLP/HTTP JSON exporter (ref logging.rs otlp_exporter_
     enabled). Spans queue from any thread; a daemon thread batches and
     POSTs to ``{endpoint}/v1/traces``. Failures drop batches with a
-    warning — tracing must never take serving down."""
+    warning — tracing must never take serving down. ``close()`` drains
+    the queue AND joins the worker thread, so the final batch's POST
+    completes (or fails loudly) before shutdown proceeds."""
 
     def __init__(self, endpoint: str, *, service_name: str = "dynamo-tpu",
                  flush_interval_s: float = 1.0, max_batch: int = 256):
@@ -167,7 +288,8 @@ class OtlpExporter:
         )
         self._thread.start()
 
-    def enqueue(self, name, tc, parent, start_ns, end_ns, attrs, error):
+    def enqueue(self, name, tc, parent_span_id, start_ns, end_ns, attrs,
+                error):
         span = {
             "traceId": tc.trace_id,
             "spanId": tc.span_id,
@@ -183,8 +305,8 @@ class OtlpExporter:
                 {"code": 2, "message": error} if error else {"code": 1}
             ),
         }
-        if parent is not None:
-            span["parentSpanId"] = parent.span_id
+        if parent_span_id is not None:
+            span["parentSpanId"] = parent_span_id
         try:
             self._q.put_nowait(span)
         except queue.Full:
@@ -220,15 +342,21 @@ class OtlpExporter:
         urllib.request.urlopen(req, timeout=5).read()
 
     def _run(self) -> None:
-        while not self._stop.is_set():
-            spans = self._drain(self.flush_interval_s)
-            if not spans:
-                continue
-            try:
-                self._post(spans)
-            except Exception:  # noqa: BLE001
-                log.warning("OTLP export failed (%d spans dropped)",
-                            len(spans))
+        # loop until a stop is requested AND the queue has drained: the
+        # old exit-on-stop shape dropped whatever the final _drain had
+        # not yet POSTed (the in-flight-batch shutdown race)
+        while True:
+            spans = self._drain(
+                0.01 if self._stop.is_set() else self.flush_interval_s
+            )
+            if spans:
+                try:
+                    self._post(spans)
+                except Exception:  # noqa: BLE001
+                    log.warning("OTLP export failed (%d spans dropped)",
+                                len(spans))
+            elif self._stop.is_set():
+                return
 
     def flush(self, timeout: float = 5.0) -> None:
         """Best-effort synchronous drain — tests and shutdown ONLY.
@@ -248,9 +376,17 @@ class OtlpExporter:
         parking the loop (dynalint DL001)."""
         await asyncio.to_thread(self.flush, timeout)
 
-    def close(self) -> None:
-        self.flush()
+    def close(self, timeout: float = 5.0) -> None:
+        """Flush AND join: the worker thread drains the queue, finishes
+        its final POST, and exits before close() returns — queued spans
+        can no longer drop silently at shutdown (they either land at the
+        collector or log an export-failure warning)."""
         self._stop.set()
+        if self._thread.is_alive():
+            self._thread.join(timeout)
+            if self._thread.is_alive():  # pragma: no cover - wedged POST
+                log.warning("OTLP exporter did not drain within %.1fs",
+                            timeout)
 
 
 _otlp: OtlpExporter | None = None

@@ -307,7 +307,9 @@ class InstanceChannel:
                         "req": req_id,
                         "path": path,
                         "payload": payload,
-                        # remaining deadline budget rides the headers
+                        # remaining deadline budget + the live trace
+                        # context ride the headers (context.wire_headers
+                        # stamps the sender's current span)
                         "headers": context.wire_headers(),
                     },
                 )

@@ -115,6 +115,63 @@ async def test_epp_picks_kv_warm_worker_with_gie_header():
         await drt.close()
 
 
+async def test_epp_metrics_expose_pick_latency_and_cache_outcomes():
+    """The EPP /metrics surface (PR-10 satellite): every pick lands in
+    dynamo_epp_pick_seconds, and pick-path prefix-cache lookups count
+    hits vs misses per cache — the scrapeable complement of the
+    hub_scans healthz field."""
+    drt = DistributedRuntime(InMemoryHub())
+    cfg = MockEngineConfig(block_size=4, speedup_ratio=1000.0)
+    await launch_mock_worker(drt, "dyn", "backend", "generate", cfg)
+    epp = await EndpointPicker(
+        drt, namespace="dyn", target_component="backend",
+        config=RouterConfig(block_size=4), host="127.0.0.1", port=0,
+    ).start()
+    base = f"http://127.0.0.1:{epp.port}"
+    try:
+        import asyncio
+
+        async with aiohttp.ClientSession() as sess:
+            ok = 0
+            for _ in range(100):
+                async with sess.post(
+                    f"{base}/pick", json={"token_ids": [1, 2, 3, 4]}
+                ) as r:
+                    if r.status == 200:
+                        ok += 1
+                if ok >= 3:
+                    break
+                await asyncio.sleep(0.05)
+            assert ok >= 3
+            async with sess.get(f"{base}/metrics") as r:
+                assert r.status == 200
+                text = await r.text()
+        lines = text.splitlines()
+        count = next(
+            ln for ln in lines
+            if ln.startswith("dynamo_epp_pick_seconds_count")
+        )
+        # every pick attempt observed (failed 503 probes count too —
+        # latency of a bad pick is still pick latency)
+        assert float(count.split()[-1]) >= 3
+        hits = [
+            ln for ln in lines
+            if ln.startswith("dynamo_epp_cache_lookups_total")
+            and 'outcome="hit"' in ln
+        ]
+        misses = [
+            ln for ln in lines
+            if ln.startswith("dynamo_epp_cache_lookups_total")
+            and 'outcome="miss"' in ln
+        ]
+        # first instance resolution misses (cold cache), repeats hit
+        assert any(float(ln.split()[-1]) > 0 for ln in misses), text
+        assert any(float(ln.split()[-1]) > 0 for ln in hits), text
+    finally:
+        await epp.close()
+        await drt.close()
+
+
 async def test_epp_503_when_no_workers():
     drt = DistributedRuntime(InMemoryHub())
     epp = await EndpointPicker(

@@ -1,0 +1,216 @@
+"""Cross-process e2e trace: one HTTP request through the real frontend
+and a real engine-worker SUBPROCESS yields one trace_id spanning both
+processes, with parent linkage across the wire hop; the worker's status
+server exposes the telemetry registry on /metrics under live traffic."""
+
+import asyncio
+import json
+import os
+import subprocess
+import sys
+import time
+
+import aiohttp
+import pytest
+
+from dynamo_tpu.frontend.http import HttpFrontend
+from dynamo_tpu.frontend.watcher import ModelManager, ModelWatcher
+from dynamo_tpu.runtime import tracing
+from dynamo_tpu.runtime.distributed import DistributedRuntime
+
+pytestmark = pytest.mark.integration
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _spawn_hub(env):
+    p = subprocess.Popen(
+        [sys.executable, "-m", "dynamo_tpu.runtime.hub_server",
+         "--port", "0"],
+        stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True,
+        cwd=REPO, env=env,
+    )
+    line = p.stdout.readline()
+    assert "DYNAMO_HUB=" in line, line
+    return p, line.strip().split("=", 1)[1]
+
+
+def _spawn_worker(env, hub_addr):
+    p = subprocess.Popen(
+        [sys.executable, "-m", "dynamo_tpu.engine.worker",
+         "--hub", hub_addr, "--model", "tiny-test",
+         "--page-size", "4", "--num-pages", "256",
+         "--max-pages-per-seq", "32", "--max-decode-slots", "4",
+         "--router-mode", "round_robin", "--health-port", "0"],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        cwd=REPO, env=env,
+    )
+    status_port = None
+    deadline = time.time() + 120
+    lines = []
+    while time.time() < deadline:
+        line = p.stdout.readline()
+        if not line:
+            raise RuntimeError(
+                f"worker exited rc={p.poll()}:\n" + "".join(lines[-30:])
+            )
+        lines.append(line)
+        if line.startswith("SYSTEM_STATUS_PORT="):
+            status_port = int(line.strip().split("=", 1)[1])
+        if line.startswith("ENGINE_READY"):
+            return p, status_port
+    raise RuntimeError("worker not ready in 120s")
+
+
+def _read_spans(path):
+    if not os.path.exists(path):
+        return []
+    return [json.loads(ln) for ln in open(path) if ln.strip()]
+
+
+async def test_single_trace_spans_frontend_and_worker_processes(tmp_path):
+    from dynamo_tpu.runtime.hub_client import RemoteHub
+
+    worker_spans = tmp_path / "worker-spans.jsonl"
+    frontend_spans = tmp_path / "frontend-spans.jsonl"
+    env = {
+        **os.environ,
+        "PYTHONPATH": REPO,
+        "JAX_PLATFORMS": "cpu",
+        # the worker's span sink: the file this test parses for the
+        # worker-side half of the trace
+        "DYN_TRACE_FILE": str(worker_spans),
+    }
+    hub_p, hub_addr = _spawn_hub(env)
+    worker_p = None
+    handles = None
+    tracing.set_trace_file(str(frontend_spans))
+    try:
+        worker_p, status_port = await asyncio.to_thread(
+            _spawn_worker, env, hub_addr
+        )
+        assert status_port, "worker printed no SYSTEM_STATUS_PORT"
+        hub = await RemoteHub.connect(hub_addr)
+        drt = DistributedRuntime(hub)
+        manager = ModelManager()
+        watcher = await ModelWatcher(drt, manager).start()
+        await watcher.wait_for_model("tiny-test", timeout=20)
+        frontend = HttpFrontend(manager, host="127.0.0.1", port=0, drt=drt)
+        await frontend.start()
+        handles = (drt, watcher, frontend)
+        base = f"http://127.0.0.1:{frontend.port}"
+
+        tc = tracing.new_trace()
+        async with aiohttp.ClientSession() as sess:
+            async with sess.post(
+                f"{base}/v1/chat/completions",
+                json={"model": "tiny-test",
+                      "messages": [{"role": "user", "content": "trace me"}],
+                      "max_tokens": 8, "temperature": 0.0,
+                      "ignore_eos": True},
+                headers={tracing.TRACEPARENT: tc.to_traceparent()},
+            ) as r:
+                assert r.status == 200, await r.text()
+                body = await r.json()
+            assert body["usage"]["completion_tokens"] == 8
+
+            # spans land asynchronously after the stream ends: the
+            # worker emits at request finish, and the frontend's
+            # transport.call span closes when the abandoned stream
+            # generators finalize on the loop — poll both files
+            worker_ours: list = []
+            front_ours: list = []
+            for _ in range(200):
+                worker_ours = [
+                    s for s in _read_spans(worker_spans)
+                    if s["trace_id"] == tc.trace_id
+                ]
+                front_ours = [
+                    s for s in _read_spans(frontend_spans)
+                    if s["trace_id"] == tc.trace_id
+                ]
+                if (
+                    any(s["span"] == "worker.request"
+                        for s in worker_ours)
+                    and any(s["span"] == "transport.call"
+                            for s in front_ours)
+                ):
+                    break
+                await asyncio.sleep(0.05)
+            by_name = {s["span"]: s for s in front_ours + worker_ours}
+            # the expected cross-process span-name set
+            for name in ("http.request", "http.preprocess",
+                         "transport.call", "worker.request",
+                         "engine.queue_wait", "engine.prefill",
+                         "engine.decode"):
+                assert name in by_name, (
+                    f"{name} missing; frontend={[s['span'] for s in front_ours]} "
+                    f"worker={[s['span'] for s in worker_ours]}"
+                )
+            # frontend-side spans came from THIS process, worker-side
+            # spans from the subprocess — one trace across both
+            assert {s["span"] for s in worker_ours} >= {
+                "worker.request", "engine.queue_wait", "engine.prefill",
+                "engine.decode",
+            }
+            assert {s["span"] for s in front_ours} >= {
+                "http.request", "http.preprocess", "transport.call",
+            }
+            # parent linkage across the wire hop
+            assert by_name["http.request"]["parent_span_id"] == tc.span_id
+            assert (by_name["transport.call"]["parent_span_id"]
+                    == by_name["http.request"]["span_id"])
+            assert (by_name["worker.request"]["parent_span_id"]
+                    == by_name["transport.call"]["span_id"])
+            assert (by_name["engine.decode"]["parent_span_id"]
+                    == by_name["worker.request"]["span_id"])
+
+            # the worker status server's /metrics shows the telemetry
+            # registry populated by the live request (the collector
+            # samples on a ~1s interval — poll until it has)
+            text = ""
+            steps = 0.0
+            for _ in range(100):
+                async with sess.get(
+                    f"http://127.0.0.1:{status_port}/metrics"
+                ) as r:
+                    assert r.status == 200
+                    text = await r.text()
+                counts = [
+                    ln for ln in text.splitlines()
+                    if ln.startswith("dynamo_engine_step_seconds_count")
+                ]
+                steps = sum(float(ln.split()[-1]) for ln in counts)
+                if steps > 0:
+                    break
+                await asyncio.sleep(0.1)
+            assert steps > 0, "no step latencies recorded under live traffic"
+            assert "dynamo_engine_step_seconds_bucket" in text
+            assert any(
+                ln.startswith("dynamo_engine_pages{")
+                and 'state="free"' in ln
+                for ln in text.splitlines()
+            )
+            assert "dynamo_engine_slots_active" in text
+
+            # flight-recorder fan-out reaches the subprocess worker and
+            # returns the traced request's timeline
+            async with sess.get(f"{base}/debug/timeline") as r:
+                assert r.status == 200
+                summary = await r.json()
+            workers = next(iter(summary["results"].values()))
+            recents = next(iter(workers.values()))["recent"]
+            assert any(
+                e["trace_id"] == tc.trace_id for e in recents
+            ), recents
+    finally:
+        tracing.set_trace_file(None)
+        if handles is not None:
+            drt, watcher, frontend = handles
+            await frontend.stop()
+            await watcher.close()
+            await drt.close()
+        for p in (worker_p, hub_p):
+            if p is not None:
+                p.kill()
+                p.wait(timeout=10)

@@ -158,3 +158,45 @@ def test_otlp_spans_reach_local_collector():
     finally:
         tracing.set_otlp_endpoint(None)
         srv.shutdown()
+
+
+def test_otlp_close_delivers_final_batch():
+    """Shutdown-ordering regression (satellite): close() must JOIN the
+    export thread after draining, so spans enqueued right before close
+    reach the collector instead of dropping with the in-flight batch.
+    The old close() stopped the thread after a queue-empty check — the
+    final POST could still be cut off mid-flight."""
+    from dynamo_tpu.runtime import tracing
+
+    _Collector.received.clear()
+    srv = http.server.HTTPServer(("127.0.0.1", 0), _Collector)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    try:
+        # long flush interval: without the close-side drain, these spans
+        # would still be queued (or mid-POST) when the thread stops
+        exporter = tracing.OtlpExporter(
+            f"http://127.0.0.1:{srv.server_port}", flush_interval_s=30.0
+        )
+        for i in range(5):
+            with tracing.span("http.request", i=i):
+                pass
+        # route the spans to THIS exporter directly (the module-level
+        # exporter is unset in tests)
+        assert exporter._q.qsize() == 0  # spans went to the module hook
+        for i in range(5):
+            tc = tracing.new_trace()
+            exporter.enqueue("http.request", tc, None, 1, 2, {}, None)
+        exporter.close()
+        assert not exporter._thread.is_alive(), "close() must join"
+        got = [
+            s["name"]
+            for r in _Collector.received
+            for rs in r["body"]["resourceSpans"]
+            for ss in rs["scopeSpans"]
+            for s in ss["spans"]
+        ]
+        assert got.count("http.request") == 5, (
+            f"final batch dropped at close: {got}"
+        )
+    finally:
+        srv.shutdown()

@@ -227,6 +227,28 @@ def test_stale_catalog_entry_warns(tmp_path):
     assert any("ghost_metric_total" in w for w in warnings)
 
 
+def test_stale_span_catalog_entry_warns(tmp_path):
+    """SPAN_NAMES gets the same two-way discipline: a catalogued span no
+    code emits is stale (DL006 already fails the unknown-emitted
+    direction — see the dl006 fixture)."""
+    (tmp_path / "mod.py").write_text(
+        "tracing = None\n\ndef f():\n"
+        '    with tracing.span("http.request"):\n        pass\n'
+    )
+    fake_catalog = types.SimpleNamespace(
+        FAULT_SITES={},
+        METRIC_NAMES={},
+        SPAN_NAMES={"http.request": "", "ghost.span": ""},
+    )
+    findings, _s, warnings = run_paths(
+        [tmp_path], tmp_path, catalog=fake_catalog
+    )
+    assert not findings
+    assert any(
+        "span 'ghost.span'" in w and "never emitted" in w for w in warnings
+    )
+
+
 # ----------------------------------------------- wire schema (DL007) contract
 
 

@@ -21,10 +21,74 @@ def test_traceparent_roundtrip():
     assert parsed.sampled
 
 
+_T = "a" * 32  # valid trace id
+_S = "b" * 16  # valid span id
+
+# table-driven malformed corpus (W3C trace-context conformance): each
+# entry is (header, why it must be rejected)
+_MALFORMED = [
+    (None, "absent"),
+    ("", "empty"),
+    ("00-xyz", "wrong field count"),
+    ("zz", "garbage"),
+    (f"00-{'0' * 32}-{_S}-01", "all-zero trace id"),
+    (f"00-{_T}-{'0' * 16}-01", "all-zero span id"),
+    (f"00-{_T}-{_S}", "missing flags"),
+    (f"00-{_T}-{_S}-01-extra", "trailing field under version 00"),
+    (f"ff-{_T}-{_S}-01", "version ff is forbidden by the spec"),
+    (f"FF-{_T}-{_S}-01", "uppercase forbidden version"),
+    (f"00-{_T.upper()}-{_S}-01", "uppercase trace id"),
+    (f"00-{_T}-{_S.upper()}-01", "uppercase span id"),
+    (f"00-{_T}-{_S}-0G", "non-hex flags"),
+    (f"00-{_T}-{_S}-1", "short flags"),
+    (f"00-{_T}-{_S}-001", "long flags"),
+    (f"0x-{_T}-{_S}-01", "non-hex version"),
+    (f"00-{_T[:-1]}g-{_S}-01", "non-hex trace id"),
+    (f"00-{_T[:-1]}-{_S}-01", "short trace id"),
+    (f"00-{_T}-{_S[:-1]}-01", "short span id"),
+    (f"00-{_T}x-{_S}-01", "long trace id"),
+]
+
+
 def test_parse_rejects_malformed():
-    for bad in (None, "", "00-xyz", "00-" + "0" * 32 + "-" + "1" * 16 + "-01",
-                "00-" + "a" * 32 + "-" + "b" * 16, "zz"):
-        assert tracing.parse_traceparent(bad) is None
+    for bad, why in _MALFORMED:
+        assert tracing.parse_traceparent(bad) is None, (
+            f"{bad!r} should be rejected: {why}"
+        )
+
+
+def test_parse_accepts_valid_variants():
+    # future (non-ff) versions parse; flags bit 0 is the sampled flag
+    for hdr, sampled in (
+        (f"00-{_T}-{_S}-01", True),
+        (f"00-{_T}-{_S}-00", False),
+        (f"01-{_T}-{_S}-01", True),  # unknown future version, 4 fields
+        (f"  00-{_T}-{_S}-03  ", True),  # surrounding whitespace + flags
+    ):
+        tc = tracing.parse_traceparent(hdr)
+        assert tc is not None, hdr
+        assert (tc.trace_id, tc.span_id, tc.sampled) == (_T, _S, sampled)
+
+
+def test_bind_trace_binds_caller_span_and_clears():
+    """bind_trace installs the CALLER's exact span context (the remote
+    parent — span() then creates its child), and clears on absent or
+    malformed headers so keep-alive tasks can't leak the previous
+    request's trace."""
+    incoming = tracing.new_trace()
+    bound = tracing.bind_trace(
+        {tracing.TRACEPARENT: incoming.to_traceparent()}
+    )
+    assert bound == incoming  # no synthetic child hop
+    assert tracing.current_trace() == incoming
+    with tracing.span("http.request") as tc:
+        assert tc.trace_id == incoming.trace_id
+        assert tc.span_id != incoming.span_id
+    assert tracing.bind_trace({}) is None
+    assert tracing.current_trace() is None  # cleared, not left stale
+    tracing.bind_trace({tracing.TRACEPARENT: incoming.to_traceparent()})
+    assert tracing.bind_trace({tracing.TRACEPARENT: "ff-bad"}) is None
+    assert tracing.current_trace() is None
 
 
 def test_ensure_trace_continues_incoming():

@@ -78,6 +78,33 @@ PROFILE_PHASES: dict[str, str] = {
                      "injected-verify-failure fallback)",
 }
 
+# span name (runtime/tracing.py span()/emit_span()) -> what it times.
+# Same two-way discipline as FAULT_SITES/METRIC_NAMES (DL006): a span
+# name not catalogued here fails the scan (dashboards and the e2e trace
+# tests reference these exact strings), and a catalogued name no code
+# emits warns as stale. tests/test_observability.py asserts the whole
+# catalog is emitted by the instrumented smoke path.
+SPAN_NAMES: dict[str, str] = {
+    "http.request": "frontend route handling, admission -> stream "
+                    "complete (chat/completions/responses/embeddings)",
+    "http.preprocess": "render + tokenize on the compute pool",
+    "epp.pick": "EPP routing decision (tokenize, KV score, resolve)",
+    "transport.call": "client-side endpoint call, dispatch -> "
+                      "end-of-stream (runtime/component.py)",
+    "migration.resume": "backoff wait after a stream death; the "
+                        "re-driven attempt is the next transport.call "
+                        "span in the same trace (frontend/migration.py)",
+    "disagg.pull": "decode-side staging of remote prefill KV",
+    "worker.request": "worker-side request lifecycle, enqueue -> "
+                      "finish (runtime/flight.py, child of the "
+                      "caller's transport.call)",
+    "engine.queue_wait": "admission-queue wait, enqueue -> step-thread "
+                         "dequeue",
+    "engine.prefill": "admit -> first token (prefill chunk count attr)",
+    "engine.decode": "first token -> finish, aggregated per request",
+    "engine.spec": "speculative-verify activity, first -> last verify",
+}
+
 # metric name (without the dynamo_ prefix MetricsRegistry adds) -> meaning
 METRIC_NAMES: dict[str, str] = {
     "http_requests_total": "HTTP requests by model/route/status",
@@ -97,4 +124,28 @@ METRIC_NAMES: dict[str, str] = {
     "spec_tokens_total": "speculative draft tokens by verify outcome "
                          "(accepted | rejected) — the live acceptance "
                          "rate of prompt-lookup decoding",
+    # EPP pick-path telemetry (gateway/epp.py /metrics)
+    "epp_pick_seconds": "EPP pick-path latency histogram",
+    "epp_cache_lookups_total": "EPP prefix-cache lookups by cache "
+                               "(cards | instances) and outcome "
+                               "(hit | miss)",
+    # worker telemetry registry (engine/telemetry.py, on every /metrics
+    # surface incl. the worker status server)
+    "engine_step_seconds": "engine step-thread cycle latency histogram "
+                           "(work cycles only)",
+    "engine_burst_tokens": "tokens landed per processed decode burst",
+    "engine_pages": "KV page pool gauge by state "
+                    "(active | cached | free)",
+    "engine_slots_active": "decode slots currently running",
+    "engine_batch_occupancy": "active slots / max_decode_slots (0..1)",
+    "engine_waiting_requests": "admission queue depth",
+    "engine_dispatches_total": "jitted device programs issued",
+    "engine_admission_rejects_total": "requests refused at admission by "
+                                      "reason (draining | saturated | "
+                                      "deadline) — the 503/504 feeders",
+    "engine_dispatch_overhead_frac": "step-thread d2h-blocked fraction "
+                                     "of the sample window (0 unless "
+                                     "DYNAMO_ENGINE_PROFILE=1)",
+    "engine_spec_acceptance_rate": "cumulative speculative-draft "
+                                   "acceptance rate",
 }

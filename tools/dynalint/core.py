@@ -421,6 +421,7 @@ class ScanContext:
         # cross-file accumulators (runner-owned; rules append)
         self.used_fault_sites: set[str] = set()
         self.used_metric_names: set[str] = set()
+        self.used_span_names: set[str] = set()
         # per-file notices the runner surfaces (unused suppressions)
         self.warnings: list[str] = []
         # the project-wide symbol table / call graph; set by the runner
@@ -587,9 +588,11 @@ def run_paths(
     if any(p.is_dir() for p in paths):
         used_sites: set[str] = set()
         used_metrics: set[str] = set()
+        used_spans: set[str] = set()
         for ctx, _sup in ctxs:
             used_sites |= ctx.used_fault_sites
             used_metrics |= ctx.used_metric_names
+            used_spans |= ctx.used_span_names
         if rules is None or "DL006" in rules:
             for site in sorted(set(catalog.FAULT_SITES) - used_sites):
                 warnings.append(
@@ -601,6 +604,12 @@ def run_paths(
                 warnings.append(
                     f"catalog: metric {name!r} is documented but never "
                     f"registered (stale catalog entry?)"
+                )
+            span_catalog = set(getattr(catalog, "SPAN_NAMES", ()))
+            for name in sorted(span_catalog - used_spans):
+                warnings.append(
+                    f"catalog: span {name!r} is documented but never "
+                    f"emitted (stale catalog entry?)"
                 )
         if rules is None or "DL007" in rules:
             from tools.dynalint import wire

@@ -1,4 +1,5 @@
-"""DL006 fixture: fault-site and metric-name catalog conformance.
+"""DL006 fixture: fault-site, metric-name, and span-name catalog
+conformance.
 
 Scanned with the REAL catalog (tools/dynalint/catalog.py), so the clean
 cases must use real catalogued names.
@@ -6,6 +7,7 @@ cases must use real catalogued names.
 
 FAULTS = None
 metrics_registry = None
+tracing = None
 
 
 def known_sites_are_clean():
@@ -37,3 +39,26 @@ def unknown_metric():
     return metrics_registry.counter(  # EXPECT: DL006
         "http_request_total", "typo'd: orphans every dashboard", []
     )
+
+
+def known_span_is_clean():
+    with tracing.span("http.request", route="chat"):
+        pass
+    tracing.emit_span("worker.request", None, start_ns=0, end_ns=1)
+
+
+def unknown_span():
+    with tracing.span("http.requests"):  # EXPECT: DL006  (typo'd span)
+        pass
+
+
+def dynamic_span(name):
+    with tracing.span("engine." + name):  # EXPECT: DL006
+        pass
+
+
+def suppressed_span_negative():
+    # dynalint: disable=DL006 -- fixture: experimental span, catalogued
+    # in the next PR
+    with tracing.span("engine.experimental"):
+        pass

@@ -606,6 +606,9 @@ class CrossThreadMutation:
 
 _FIRE_ATTRS = {"fire", "fire_sync", "check", "fire_link", "link_blocked"}
 _METRIC_ATTRS = {"counter", "gauge", "histogram"}
+# tracing span emitters (runtime/tracing.py): with tracing.span("...")
+# context managers and explicit tracing.emit_span("...") emissions
+_SPAN_ATTRS = {"span", "emit_span"}
 
 
 class FaultSiteRegistry:
@@ -627,10 +630,19 @@ class FaultSiteRegistry:
     def check(self, ctx: ScanContext) -> Iterable[Finding]:
         fault_sites = set(ctx.catalog.FAULT_SITES)
         metric_names = set(ctx.catalog.METRIC_NAMES)
+        span_names = set(getattr(ctx.catalog, "SPAN_NAMES", ()))
         for node in ctx.nodes:
             if not isinstance(node, ast.Call):
                 continue
             func = node.func
+            if (
+                isinstance(func, ast.Name)
+                and func.id in _SPAN_ATTRS
+                and node.args
+            ):
+                # from-imported span()/emit_span()
+                yield from self._check_span(ctx, node, span_names)
+                continue
             if not isinstance(func, ast.Attribute):
                 continue
             recv = dotted(func.value) or ""
@@ -638,6 +650,12 @@ class FaultSiteRegistry:
                 yield from self._check_site(ctx, node, fault_sites)
             elif func.attr in _METRIC_ATTRS and node.args:
                 yield from self._check_metric(ctx, node, metric_names)
+            elif (
+                func.attr in _SPAN_ATTRS
+                and "tracing" in recv.lower()
+                and node.args
+            ):
+                yield from self._check_span(ctx, node, span_names)
 
     def _check_site(self, ctx, node, known) -> Iterable[Finding]:
         if not node.args:
@@ -689,6 +707,32 @@ class FaultSiteRegistry:
                 hint="add it to tools/dynalint/catalog.py METRIC_NAMES or "
                      "fix the typo",
                 context=qualname(node), detail=f"metric:{name}",
+            )
+
+    def _check_span(self, ctx, node, known) -> Iterable[Finding]:
+        arg = node.args[0]
+        if not (isinstance(arg, ast.Constant) and isinstance(arg.value, str)):
+            yield Finding(
+                rule=self.id, path=ctx.path,
+                line=node.lineno, col=node.col_offset,
+                message="span name must be a string literal so trace "
+                        "dashboards and the catalog can reference it",
+                hint="inline the span name",
+                context=qualname(node), detail="dynamic-span",
+            )
+            return
+        name = arg.value
+        ctx.used_span_names.add(name)
+        if name not in known:
+            yield Finding(
+                rule=self.id, path=ctx.path,
+                line=node.lineno, col=node.col_offset,
+                message=f"span {name!r} is not in the documented catalog "
+                        "— trace queries and the e2e span assertions "
+                        "reference catalogued names only",
+                hint="add it to tools/dynalint/catalog.py SPAN_NAMES or "
+                     "fix the typo",
+                context=qualname(node), detail=f"span:{name}",
             )
 
 
