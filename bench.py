@@ -690,6 +690,138 @@ def spec_decode_measurement(
     return asyncio.run(run())
 
 
+def guided_measurement(
+    spec, page_size: int, on_tpu: bool,
+    family: str = "gqa",
+    concurrency: int | None = None,
+    osl: int | None = None,
+) -> dict:
+    """Guided-decoding bench rung (ROADMAP #5 evidence): constrained vs
+    free ITL at MIXED concurrency through one real engine — half the
+    closed-loop streams carry a json_schema grammar, half decode free,
+    so both classes share the same engine cycles.
+
+    The headline ``masking_overhead_frac`` is PAIRED: median constrained
+    ITL over median free ITL *from the same mixed run* — the two classes
+    ride the same dispatches, so the ratio isolates exactly what masking
+    adds (host mask assembly + the on-device where) without CI wall-
+    clock noise. A separate all-free baseline run is recorded for
+    context (``free_itl_ms_baseline``), plus the grammar-compiler
+    micro-bench (compile ms per grammar, LRU hit rate) so mask-compile
+    cost is attributable in every artifact. Bar: masking ITL overhead
+    < 5% (judged on the CPU rung in tier-1 and re-judged on chip).
+    """
+    import asyncio
+
+    from dynamo_tpu.engine.config import EngineConfig
+    from dynamo_tpu.engine.core import InferenceEngine
+    from dynamo_tpu.guided import TokenVocab, grammar_from_request
+    from dynamo_tpu.runtime.context import Context
+
+    ISL = 48
+    OSL = osl or 64
+    N = concurrency or (8 if on_tpu else 4)
+    SLOTS = N
+    pps = (ISL + OSL + page_size - 1) // page_size + 2
+    cfg = EngineConfig(
+        page_size=page_size,
+        num_pages=SLOTS * pps + 64,
+        max_pages_per_seq=pps,
+        max_decode_slots=SLOTS,
+        prefill_buckets=(64, 128),
+        decode_steps_per_dispatch=1,
+        pipeline_decode=True,
+    )
+    vocab = TokenVocab.ascii_json(spec.vocab_size)
+    schema = {
+        "type": "object",
+        "properties": {
+            "answer": {"type": "string", "maxLength": 24},
+            "score": {"type": "integer"},
+            "tags": {"type": "array", "items": {"type": "string"},
+                     "maxItems": 4},
+        },
+        "required": ["answer", "score", "tags"],
+    }
+    grammar = grammar_from_request(
+        {"response_format": {"type": "json_schema",
+                             "json_schema": {"name": "bench",
+                                             "schema": schema}}}
+    )
+    rng = np.random.default_rng(0)
+    prompts = [rng.integers(3, spec.vocab_size, ISL).tolist()
+               for _ in range(N)]
+
+    async def run_mode(guided_streams: int) -> tuple[dict, dict | None]:
+        engine = InferenceEngine(spec, cfg, guided_vocab=vocab)
+        engine.precompile()
+        await engine.start()
+        itls: dict[str, list[float]] = {"guided": [], "free": []}
+
+        async def stream(sid: int):
+            is_guided = sid < guided_streams
+            req: dict = {
+                "token_ids": prompts[sid],
+                "stop_conditions": {"max_tokens": OSL},
+                "sampling": {"temperature": 0.7, "seed": sid + 1},
+            }
+            if is_guided:
+                req["guided"] = {**grammar, "prompt_len": ISL}
+            else:
+                req["stop_conditions"]["ignore_eos"] = True
+            last = None
+            async for item in engine.generate(req, Context(f"g{sid}")):
+                if item.get("token_ids"):
+                    now = time.perf_counter()
+                    if last is not None:
+                        itls["guided" if is_guided else "free"].append(
+                            (now - last) / len(item["token_ids"])
+                        )
+                    last = now
+
+        # warmup pass fills caches (grammar LRU + host glue), then the
+        # measured pass
+        await asyncio.gather(*(stream(s) for s in range(N)))
+        for v in itls.values():
+            v.clear()
+        await asyncio.gather(*(stream(s) for s in range(N)))
+        snap = engine.guided_snapshot()
+        await engine.close()
+
+        def ms(xs):
+            return round(float(np.median(xs)) * 1e3, 4) if xs else None
+
+        return {"guided_itl_ms": ms(itls["guided"]),
+                "free_itl_ms": ms(itls["free"]),
+                "guided_tokens": len(itls["guided"]),
+                "free_tokens": len(itls["free"])}, snap
+
+    async def run() -> dict:
+        mixed, snap = await run_mode(guided_streams=N // 2)
+        baseline, _ = await run_mode(guided_streams=0)
+        overhead = None
+        if mixed["guided_itl_ms"] and mixed["free_itl_ms"]:
+            overhead = round(
+                mixed["guided_itl_ms"] / mixed["free_itl_ms"] - 1.0, 4
+            )
+        return {
+            "mode": "guided mixed-concurrency ITL",
+            "family": family,
+            "isl": ISL, "osl": OSL, "concurrency": N,
+            "guided_streams": N // 2,
+            "grammar_kind": grammar["kind"],
+            **mixed,
+            "free_itl_ms_baseline": baseline["free_itl_ms"],
+            # the headline: constrained vs free slots SHARING the same
+            # engine cycles — what masking itself costs
+            "masking_overhead_frac": overhead,
+            "grammar_compiler": snap,
+            "bars": {"masking_itl_overhead_max": 0.05},
+        }
+
+    return asyncio.run(run())
+
+
 def raw_decode(
     spec: ModelSpec, B: int, page_size: int, pages_per_seq: int,
     repeats: int = 1,
@@ -854,6 +986,13 @@ def main() -> None:
         # vs spec-off per-stream tok/s + acceptance on the repetitive
         # synthetic workload, per family
         out["spec_decode"] = spec_decode_measurement(
+            spec, page_size, on_tpu, family=family
+        )
+    if os.environ.get("DYNAMO_BENCH_GUIDED", "1") not in ("0", "false"):
+        # guided decoding (ROADMAP #5): constrained vs free ITL at mixed
+        # concurrency + grammar-compiler cost, judged against the <5%
+        # masking-overhead bar
+        out["guided"] = guided_measurement(
             spec, page_size, on_tpu, family=family
         )
     # the OTHER flagship families' on-chip numbers ride in the same

@@ -91,6 +91,9 @@ async def _arun(args: argparse.Namespace) -> None:
                 # (recipes export SPEC_MODE -> --spec)
                 spec_mode=args.spec or env_cfg.spec_mode or "off",
                 spec_k_max=env_cfg.spec_k_max or 8,
+                # --guided beats DYN_GUIDED_MODE beats the "auto"
+                # default (recipes export GUIDED_MODE -> --guided)
+                guided_mode=args.guided or env_cfg.guided_mode or "auto",
             ),
             precompile=args.precompile,
         )
@@ -212,6 +215,10 @@ def _run_command(rest: list[str]) -> int:
                    help="out=engine: compile every serving shape before "
                         "serving (see worker --precompile); recipes turn "
                         "this on")
+    p.add_argument("--guided", default=None, choices=["auto", "off"],
+                   help="guided decoding: grammar-constrained sampling "
+                        "for response_format / forced tool_choice "
+                        "(default auto; DYN_GUIDED_MODE overrides)")
     p.add_argument("--spec", default=None, choices=["off", "ngram"],
                    help="out=engine: speculative decoding mode "
                         "(prompt-lookup drafter + batched verify; "

@@ -106,7 +106,11 @@ class DecodeWorkerHandler:
         self, request: dict[str, Any], context: Context
     ) -> AsyncIterator[dict[str, Any]]:
         token_ids = request.get("token_ids") or []
-        if self._should_remote(token_ids):
+        # guided requests prefill locally: the remote prefill worker
+        # samples the FIRST token, and conformance requires that sample
+        # to run under this request's grammar mask — keeping the whole
+        # constrained stream on one engine keeps the guarantee simple
+        if self._should_remote(token_ids) and not request.get("guided"):
             resumed = await self._remote_prefill(dict(request), context)
             if resumed is not None:
                 first_item, resume_request = resumed

@@ -315,6 +315,16 @@ class EngineConfig:
     # emitted tokens between k=1 reprobes while a slot is parked at k=0
     # (0 = never reprobe: once decayed, the request stays non-spec)
     spec_reprobe_tokens: int = 64
+    # guided decoding (guided/): "auto" serves grammar-constrained
+    # requests whenever the worker has a token vocabulary (single-host
+    # only — masks are not in the SPMD replay protocol); "off" rejects
+    # them with a typed error. DYN_GUIDED_MODE / --guided set this on
+    # workers.
+    guided_mode: str = "auto"  # "auto" | "off"
+    # compiled-grammar LRU entries per engine, keyed (grammar, vocab)
+    # like the persistent compile cache — agentic traffic reuses a
+    # handful of schemas, so steady state is all hits
+    guided_cache_entries: int = 32
     # sampling
     seed: int = 0
     # step-thread phase profiler (same switch as DYNAMO_ENGINE_PROFILE=1):

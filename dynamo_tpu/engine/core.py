@@ -38,8 +38,13 @@ from dynamo_tpu.engine.compile_cache import (
     maybe_enable_compile_cache,
 )
 from dynamo_tpu.engine.config import EngineConfig, ModelSpec
-from dynamo_tpu.engine.sampling import sample_tokens, token_logprobs
+from dynamo_tpu.engine.sampling import (
+    sample_tokens,
+    sample_tokens_masked,
+    token_logprobs,
+)
 from dynamo_tpu.engine.spec import SPEC_TOKENS, SlotSpec
+from dynamo_tpu.guided.runtime import GUIDED_REQUESTS
 from dynamo_tpu.kv_router.protocols import ForwardPassMetrics
 from dynamo_tpu.models import llama
 from dynamo_tpu.models.family import get_family
@@ -89,6 +94,10 @@ class _Slot:
     # k; None = this slot never speculates (spec off, temperature > 0,
     # logprobs requested)
     spec: SlotSpec | None = None
+    # guided decoding (guided/runtime.py): host-side grammar cursor; the
+    # step thread advances it as tokens land and ships its allowed-token
+    # mask into every sampling dispatch this slot participates in
+    guided: Any | None = None
 
 
 @dataclass
@@ -128,6 +137,7 @@ class InferenceEngine:
         transfer_source=None,
         kvbm=None,
         spmd=None,
+        guided_vocab=None,
     ):
         self.spec = spec
         self.transfer_source = transfer_source
@@ -223,6 +233,24 @@ class InferenceEngine:
         self.spec_drafted = 0  # draft tokens proposed into verifies
         self.spec_accepted = 0  # drafts the target's argmax confirmed
         self.spec_rejected = 0  # drafts cut by accept-longest-prefix
+        # guided decoding (guided/): grammar compiler + per-(grammar,
+        # vocab) mask cache. Needs a token vocabulary (the worker builds
+        # one from its tokenizer; tests/bench pass one explicitly) and is
+        # gated off under SPMD — the mask arrays are not in the follower
+        # replay protocol.
+        self._guided = None
+        if (
+            guided_vocab is not None
+            and self.config.guided_mode != "off"
+            and spmd is None
+        ):
+            from dynamo_tpu.guided.runtime import GrammarCompiler
+
+            self._guided = GrammarCompiler(
+                guided_vocab,
+                vocab_size=spec.vocab_size,
+                cache_entries=self.config.guided_cache_entries,
+            )
         self._partial: _PartialPrefill | None = None
         self._clear_cache_requested = False
         # dispatched-but-unprocessed decode bursts, oldest first (max
@@ -471,6 +499,32 @@ class InferenceEngine:
                     jax.block_until_ready(out)
 
                 timed(f"verify[{nrows}x{W}]", verify)
+                if self._guided is not None:
+                    # guided x spec: the MASKED verify program is its own
+                    # compiled shape per row tier — warm it too, or the
+                    # first constrained greedy request on a spec worker
+                    # eats the compile mid-serving
+                    def verify_masked(nrows=nrows, W=W):
+                        out, self.k_pages, self.v_pages, _ = (
+                            self.fam.verify(
+                                self.spec, self.params,
+                                jnp.zeros((nrows, W), jnp.int32),
+                                jnp.zeros(
+                                    (nrows, cfg.max_pages_per_seq),
+                                    jnp.int32,
+                                ),
+                                jnp.zeros((nrows,), jnp.int32),
+                                self.k_pages, self.v_pages,
+                                jnp.zeros((nrows,), jnp.int32),
+                                mesh=self.mesh,
+                                allowed=jnp.ones(
+                                    (nrows, W, self.spec.vocab_size), bool
+                                ),
+                            )
+                        )
+                        jax.block_until_ready(out)
+
+                    timed(f"verify_masked[{nrows}x{W}]", verify_masked)
 
         # first-token sample widths: packed-dispatch fused samples
         # (prefill_pack_size), the single-prompt program (1), and the
@@ -488,6 +542,44 @@ class InferenceEngine:
                 jax.block_until_ready(out)
 
             timed(f"sample[{w}]", sample)
+
+        # guided-decoding shapes (when this worker can serve them): the
+        # masked admission sample and the masked single-step burst — the
+        # exact programs a constrained slot dispatches, so the first
+        # guided request eats no compile either
+        if self._guided is not None:
+            V = self.spec.vocab_size
+
+            def masked_sample(w=B):
+                out = sample_tokens_masked(
+                    jnp.zeros((w, V), jnp.float32),
+                    jnp.ones((w, V), bool),
+                    jnp.zeros((w,), jnp.float32),
+                    jnp.zeros((w,), jnp.int32),
+                    jnp.ones((w,), jnp.float32),
+                    jnp.zeros((w,), jnp.uint32),
+                    jnp.zeros((w,), jnp.int32),
+                )
+                jax.block_until_ready(out)
+
+            timed(f"sample_masked[{B}]", masked_sample)
+
+            def masked_burst():
+                out, self.k_pages, self.v_pages = self.fam.decode_steps(
+                    self.spec, self.params, zB,
+                    jnp.zeros((B, cfg.max_pages_per_seq), jnp.int32),
+                    jnp.ones((B,), jnp.int32),
+                    self.k_pages, self.v_pages,
+                    jnp.zeros((B,), bool),
+                    jnp.zeros((B,), jnp.float32), zB,
+                    jnp.ones((B,), jnp.float32),
+                    jnp.zeros((B,), jnp.uint32), zB,
+                    n_steps=1, n_logprobs=0, mesh=self.mesh,
+                    allowed=jnp.ones((B, V), bool),
+                )
+                jax.block_until_ready(out)
+
+            timed(f"decode_masked[{B}x1]", masked_burst)
 
         total = sum(r["secs"] for r in report.values())
         compiles = sum(r["compiles"] for r in report.values())
@@ -698,6 +790,36 @@ class InferenceEngine:
             yield {"token_ids": [], "finish_reason": "error",
                    "error": f"prompt exceeds max context {self.config.max_context}"}
             return
+        if request.get("guided"):
+            # compile (or LRU-fetch) the grammar BEFORE admission, off
+            # the step thread: a bad grammar bounces here as a typed
+            # invalid_request (-> HTTP 400) with zero slots or pages
+            # touched, and a good one is a warm cache hit by the time
+            # _make_slot builds the per-slot cursor.
+            err = outcome = None
+            if self._guided is None:
+                outcome = "unavailable"
+                err = (
+                    "guided decoding unavailable on this worker "
+                    "(guided_mode=off, multi-host SPMD, or no tokenizer "
+                    "vocabulary)"
+                )
+            else:
+                try:
+                    with tracing.span(
+                        "engine.guided_compile", request_id=context.id
+                    ):
+                        await asyncio.to_thread(
+                            self._guided.compile, request["guided"]
+                        )
+                except Exception as e:  # noqa: BLE001
+                    outcome = "compile_error"
+                    err = f"guided grammar rejected: {e}"
+            if err is not None:
+                GUIDED_REQUESTS.labels(outcome=outcome).inc()
+                yield {"token_ids": [], "finish_reason": "error",
+                       "error": f"invalid_request: {err}"}
+                return
         disagg = request.get("disagg") or {}
         if disagg.get("mode") == "decode" and disagg.get("kv_transfer"):
             # Stage the remote KV payload HERE (event loop, thread pool),
@@ -1563,6 +1685,25 @@ class InferenceEngine:
         slot_spec = None
         if self._spec_on and temperature <= 0.0 and logprobs is None:
             slot_spec = SlotSpec.for_config(self.config)
+        guided_state = None
+        g = req.get("guided")
+        if g and self._guided is not None:
+            # per-slot grammar cursor (LRU-warm: generate() compiled it).
+            # End-of-stream ids join the mask at accepting states only —
+            # the grammar can't stop early and must stop when complete.
+            # prompt_len marks where the ORIGINAL prompt ended: tokens
+            # past it are completions a migration/disagg resume folded
+            # into the prompt, and the cursor advances over them so a
+            # resumed stream continues mid-grammar (continuity contract).
+            token_ids = req.get("token_ids") or []
+            guided_state = self._guided.state_for(
+                g,
+                eos_ids=(
+                    frozenset(req.get("eos_token_ids") or (2,))
+                    | frozenset(stop.get("stop_token_ids") or ())
+                ),
+                prefix_tokens=token_ids[int(g.get("prompt_len") or len(token_ids)):],
+            )
         return _Slot(
             request_id=waiting.context.id,
             context=waiting.context,
@@ -1584,6 +1725,7 @@ class InferenceEngine:
             logprobs=logprobs,
             admit_t=waiting.admit_t,
             spec=slot_spec,
+            guided=guided_state,
         )
 
     def _clamp_logprobs(self, n) -> int | None:
@@ -1912,6 +2054,12 @@ class InferenceEngine:
             and self.fam.supports_logprobs
         ):
             return True
+        if req.get("guided"):
+            # the FIRST sampled token must already respect the grammar's
+            # start state, and the automaton must advance on its host
+            # value before the next mask is built — the async path's
+            # deferred materialization breaks both
+            return True
         kvt = (req.get("disagg") or {}).get("kv_transfer") or {}
         return bool(
             kvt.get("do_remote_decode") and self.transfer_source is not None
@@ -1995,7 +2143,15 @@ class InferenceEngine:
                 [self._logits_row(r[3]) for r in recs],
                 on_device=self.spmd is None,
             )
-            sampled_dev = sample_tokens(stacked, *sample_args)
+            gmask = self._admission_guided_mask(
+                [r[2] for r in recs], stacked.shape[0]
+            )
+            if gmask is not None:
+                sampled_dev = sample_tokens_masked(
+                    stacked, jnp.asarray(gmask), *sample_args
+                )
+            else:
+                sampled_dev = sample_tokens(stacked, *sample_args)
             self.dispatches += 1
             # logprobs, when any admitted prompt wants them, batch over the
             # same stacked logits: one more fused sync, not one per record
@@ -2077,6 +2233,24 @@ class InferenceEngine:
                         {"token_ids": [], "finish_reason": "error",
                          "error": f"admission failed: {e}"},
                     )
+
+    def _admission_guided_mask(
+        self, slots: list, width: int
+    ) -> np.ndarray | None:
+        """[width, V] allowed mask for a first-token sample batch, or
+        None when no admitted slot is constrained (the all-free batch
+        then never pays the masked program). Free and padded rows are
+        all-True — identity under the mask."""
+        if not any(
+            s.guided is not None and s.guided.constraining for s in slots
+        ):
+            return None
+        with self._phase("guided.mask"):
+            allowed = np.ones((width, self.spec.vocab_size), bool)
+            for i, slot in enumerate(slots):
+                if slot.guided is not None and slot.guided.constraining:
+                    allowed[i] = slot.guided.mask()
+        return allowed
 
     def _admission_sample_inputs(self, slots: list, logits_rows: list,
                                  *, on_device: bool):
@@ -2603,6 +2777,11 @@ class InferenceEngine:
             ),
         }
 
+    def guided_snapshot(self) -> dict[str, Any] | None:
+        """Grammar compile-cache stats (compiles, hit rate, compile ms)
+        for bench/profile attribution; None when guided is off."""
+        return self._guided.snapshot() if self._guided is not None else None
+
     def _spec_managed(self, slot: _Slot) -> bool:
         """True while the slot takes the verify path INSTEAD of decode
         bursts. first_pending slots stay burst-managed: their first
@@ -2660,15 +2839,27 @@ class InferenceEngine:
                 draft = (
                     slot.spec.propose(k_cap) if k_cap > 0 else []
                 )
-                cands.append((i, slot, [int(t) for t in draft]))
+                draft = [int(t) for t in draft]
+                masks = None
+                if slot.guided is not None and slot.guided.constraining:
+                    # guided x spec: walk the draft on a SCRATCH cursor —
+                    # the grammar-legal prefix becomes the draft (an
+                    # off-grammar draft token could never be accepted
+                    # against masked verify logits anyway) and the
+                    # per-position masks ship into the verify dispatch.
+                    # The real cursor is untouched, so a rejected tail
+                    # needs no rollback by construction.
+                    with self._phase("guided.lookahead"):
+                        draft, masks = slot.guided.lookahead(draft)
+                cands.append((i, slot, draft, masks))
         if not cands:
             return False
 
         # page room for the fed token + drafts (same backpressure story
         # as _build_batch: OutOfPages trims the draft to the pages held;
         # a slot that can't even hold its fed token stalls this cycle)
-        ready: list[tuple[int, _Slot, list[int], int]] = []
-        for i, slot, draft in cands:
+        ready: list[tuple] = []
+        for i, slot, draft, masks in cands:
             m = 1 + len(draft)
             base_pages = slot.pages.num_pages
             while (slot.seq_len + m - 1) // cfg.page_size >= (
@@ -2692,7 +2883,9 @@ class InferenceEngine:
                     slot.spec.disable()
                 continue
             slot.stalled_steps = 0
-            ready.append((i, slot, draft[: m - 1], base_pages))
+            # page trimming only SHORTENS the draft; the lookahead masks
+            # are per-position prefixes, so they stay aligned
+            ready.append((i, slot, draft[: m - 1], base_pages, masks))
         if not ready:
             return False
 
@@ -2705,7 +2898,7 @@ class InferenceEngine:
                 FAULTS.fire_sync("engine.spec_verify")
             except Exception as e:  # noqa: BLE001
                 with self._phase("spec.rollback"):
-                    for _i, slot, _draft, base_pages in ready:
+                    for _i, slot, _draft, base_pages, _masks in ready:
                         self.allocator.release(
                             slot.pages.truncate(base_pages)
                         )
@@ -2735,25 +2928,38 @@ class InferenceEngine:
         bts = np.zeros((n, cfg.max_pages_per_seq), np.int32)
         starts = np.zeros((n,), np.int32)
         nts = np.zeros((n,), np.int32)
-        for r, (_i, slot, draft, _bp) in enumerate(ready):
+        allowed = None
+        if any(masks is not None for _i, _s, _d, _bp, masks in ready):
+            # [n, W, V] guided masks: row r position j constrains the
+            # target's choice AFTER consuming draft[:j] — so a rejected
+            # draft's correction token is itself grammar-legal. Free and
+            # padded rows stay all-True.
+            allowed = np.ones((n, W, self.spec.vocab_size), bool)
+        for r, (_i, slot, draft, _bp, masks) in enumerate(ready):
             row = [slot.last_token, *draft]
             tokens[r, : len(row)] = row
             bts[r, : slot.pages.num_pages] = slot.pages.pages
             starts[r] = slot.seq_len
             nts[r] = len(row)
+            if allowed is not None and masks is not None:
+                for j in range(min(len(row), len(masks))):
+                    allowed[r, j] = masks[j]
         with self._phase("spec.verify"):
             targets, self.k_pages, self.v_pages, dropped = self.fam.verify(
                 self.spec, self.params, jnp.asarray(tokens),
                 jnp.asarray(bts), jnp.asarray(starts),
                 self.k_pages, self.v_pages, jnp.asarray(nts),
                 mesh=self.mesh,
+                allowed=(
+                    jnp.asarray(allowed) if allowed is not None else None
+                ),
             )
             self.dispatches += 1
             self._note_moe_dropped(dropped)
             with self._phase("dispatch.d2h_wait"):
                 targets = np.asarray(targets)
         self.spec_verifies += 1
-        for r, (i, slot, draft, _bp) in enumerate(ready):
+        for r, (i, slot, draft, _bp, _masks) in enumerate(ready):
             if self._slots[i] is not slot:
                 continue  # defensive: slot replaced mid-phase
             self._process_verify(i, slot, draft, targets[r])
@@ -2832,8 +3038,18 @@ class InferenceEngine:
         before the host reads it — cycles track device time, not the d2h
         round-trip. Stops are detected up to depth bursts late (discarded
         garbage, as with mid-burst EOS); cancels and admin ops flush the
-        pipeline first (_step)."""
-        if self.config.pipeline_decode:
+        pipeline first (_step).
+
+        Guided slots opt the engine out of pipelining for the cycles
+        they are live: a pipelined burst would dispatch with a mask
+        computed BEFORE the in-flight burst's tokens advanced the host
+        automaton — a stale mask is a broken guarantee. Free-only
+        batches keep the full pipeline."""
+        if self.config.pipeline_decode and self._guided_live():
+            if self._pipeline:
+                with self._phase("flush"):
+                    self._flush_pipeline()
+        elif self.config.pipeline_decode:
             with self._phase("build_batch"):
                 batch = self._build_batch(self._pipeline)
             if batch is None:
@@ -2873,6 +3089,17 @@ class InferenceEngine:
             self._process_burst({"batch": batch, "results": results})
         self._eager_readmit(
             before - sum(s is not None for s in self._slots)
+        )
+
+    def _guided_live(self) -> bool:
+        """True while any live slot is grammar-constrained (those cycles
+        run the synchronous dispatch-process schedule)."""
+        return any(
+            s is not None
+            and s.guided is not None
+            and s.guided.constraining
+            and not s.context.is_stopped
+            for s in self._slots
         )
 
     def _flush_pipeline(self) -> None:
@@ -2934,6 +3161,12 @@ class InferenceEngine:
                 n_burst = max(
                     1, min(n_burst, capacity - slot.seq_len - int(extra[i]))
                 )
+                if slot.guided is not None and slot.guided.constraining:
+                    # a constrained slot's mask is valid for exactly ONE
+                    # token (the host automaton advances as tokens land),
+                    # so the whole batch runs single-step — constrained
+                    # and free slots still share the one dispatch
+                    n_burst = 1
 
         for i, slot in enumerate(self._slots):
             if slot is None:
@@ -2996,8 +3229,30 @@ class InferenceEngine:
         )
         n_lp = min(20, self.spec.vocab_size - 1) if wants_lp else 0
 
+        # guided-decoding constraint mask for this burst: None unless a
+        # participating slot is constrained (the all-free fast path pays
+        # nothing — the unmasked program dispatches unchanged)
+        allowed = None
+        if any(
+            active[i]
+            and self._slots[i].guided is not None
+            and self._slots[i].guided.constraining
+            for i in range(B)
+        ):
+            with self._phase("guided.mask"):
+                allowed = np.ones((B, self.spec.vocab_size), bool)
+                for i in range(B):
+                    slot = self._slots[i]
+                    if (
+                        active[i]
+                        and slot.guided is not None
+                        and slot.guided.constraining
+                    ):
+                        allowed[i] = slot.guided.mask()
+
         return {
             "n_burst": n_burst,
+            "allowed": allowed,
             "n_lp": n_lp,
             "active": active,
             "participants": {
@@ -3093,6 +3348,7 @@ class InferenceEngine:
                     jnp.asarray(mask), ap["dev"][jnp.asarray(idx)], tokens_in
                 )
         self.dispatches += 1
+        allowed = batch.get("allowed")
         result = self.fam.decode_steps(
             self.spec,
             self.params,
@@ -3110,6 +3366,7 @@ class InferenceEngine:
             n_steps=batch["n_burst"],
             n_logprobs=batch["n_lp"],
             mesh=self.mesh,
+            allowed=jnp.asarray(allowed) if allowed is not None else None,
         )
         if batch["n_lp"] > 0:
             sampled, lp, top_i, top_v, self.k_pages, self.v_pages = result
@@ -3231,13 +3488,43 @@ class InferenceEngine:
         slot.generated += 1
         slot.remaining -= 1
         slot.last_token = tok
+        if slot.guided is not None and not slot.guided.advance(tok):
+            # defensive: every sampling path this slot touches is masked,
+            # so an off-grammar token marks an unmasked escape hatch —
+            # fail OPEN (free decoding, outcome=violation at finish)
+            # rather than wedging or erroring a live stream
+            log.warning(
+                "guided slot %s emitted off-grammar token %d; "
+                "constraint released", slot.request_id, tok,
+            )
         if (
             not slot.ignore_eos
-            and slot.generated >= slot.min_tokens
             and tok in slot.eos_ids
+            and (
+                slot.generated >= slot.min_tokens
+                # a completed grammar leaves ONLY eos legal — honoring
+                # min_tokens here would stream eos padding at the client
+                # (done + not violated = eos landed on an accepting
+                # state; an off-grammar eos keeps min_tokens semantics)
+                or (
+                    slot.guided is not None
+                    and slot.guided.done
+                    and not slot.guided.violated
+                )
+            )
         ):
             return "stop"
-        if tok in slot.stop_token_ids and slot.generated >= slot.min_tokens:
+        if tok in slot.stop_token_ids and (
+            slot.generated >= slot.min_tokens
+            # stop tokens are folded into the grammar cursor's eos set
+            # (_make_slot), so a completed grammar overrides min_tokens
+            # here exactly as on the eos branch above
+            or (
+                slot.guided is not None
+                and slot.guided.done
+                and not slot.guided.violated
+            )
+        ):
             return "stop"
         if slot.remaining <= 0:
             return "length"
@@ -3312,6 +3599,20 @@ class InferenceEngine:
             if error:
                 item["error"] = error
             self._post(slot.out_q, item)
+        if slot.guided is not None:
+            # "ok" strictly means conformance DELIVERED: the grammar
+            # reached acceptance before the stream ended. max_tokens or
+            # a stop sequence can cut a legally-masked stream mid-
+            # grammar — that is "truncated" (the client got a prefix,
+            # not a document), and cancels/engine errors are "aborted";
+            # neither may inflate the conformance count.
+            if slot.guided.violated:
+                outcome = "violation"
+            elif reason in ("stop", "length"):
+                outcome = "ok" if slot.guided.conformant else "truncated"
+            else:
+                outcome = "aborted"
+            GUIDED_REQUESTS.labels(outcome=outcome).inc()
         pages, slot.pages.pages = slot.pages.pages, []
         self.allocator.release(pages)
         self._slots[slot_idx] = None

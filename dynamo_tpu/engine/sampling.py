@@ -37,8 +37,7 @@ def token_logprobs(
     return picked, top_ids.astype(jnp.int32), top_vals
 
 
-@partial(jax.jit, donate_argnums=())
-def sample_tokens(
+def _sample_tokens_impl(
     logits: jax.Array,  # [B, V] f32
     temperature: jax.Array,  # [B]
     top_k: jax.Array,  # [B] int32 (0 = off)
@@ -104,3 +103,32 @@ def sample_tokens(
         jnp.any(temperature > 0.0), draw, lambda lg: greedy, logits_kp
     )
     return jnp.where(temperature <= 0.0, greedy, sampled).astype(jnp.int32)
+
+
+sample_tokens = jax.jit(_sample_tokens_impl, donate_argnums=())
+
+
+@partial(jax.jit, donate_argnums=())
+def sample_tokens_masked(
+    logits: jax.Array,  # [B, V] f32
+    allowed: jax.Array,  # [B, V] bool: per-slot grammar-allowed tokens
+    temperature: jax.Array,  # [B]
+    top_k: jax.Array,  # [B] int32
+    top_p: jax.Array,  # [B] f32
+    seeds: jax.Array,  # [B] uint32
+    steps: jax.Array,  # [B] int32
+) -> jax.Array:
+    """sample_tokens under a guided-decoding constraint mask.
+
+    Disallowed tokens drop to NEG_INF BEFORE the greedy argmax and the
+    temperature/top-k/top-p pipeline, so both greedy and sampled draws
+    can only land on grammar-legal tokens (guided/runtime.py guarantees
+    each constrained row keeps at least one True). Free slots ride the
+    same batch with all-True rows — the where() is identity for them —
+    and an ALL-free batch never calls this jit at all (the engine passes
+    no mask), so unguided serving pays nothing.
+    """
+    return _sample_tokens_impl(
+        jnp.where(allowed, logits, NEG_INF),
+        temperature, top_k, top_p, seeds, steps,
+    )

@@ -114,9 +114,27 @@ async def launch_engine_worker(
             namespace=kvbm_ns,
         )
 
+    guided_vocab = None
+    if cfg.guided_mode != "off" and spmd is None:
+        # guided decoding needs the token -> surface-string table; build
+        # it once from the SAME tokenizer the frontend registers for
+        # this model, so the mask automaton and the detokenizer agree
+        try:
+            from dynamo_tpu.frontend.tokenizer import load_tokenizer
+            from dynamo_tpu.guided import TokenVocab
+
+            guided_vocab = TokenVocab.from_tokenizer(
+                load_tokenizer(tokenizer), spec.vocab_size
+            )
+        except Exception as e:  # noqa: BLE001
+            log.warning(
+                "guided decoding disabled: vocab build failed (%s)", e
+            )
+
     engine = InferenceEngine(
         spec, cfg, mesh=mesh, params=params,
         transfer_source=transfer_source, kvbm=kvbm, spmd=spmd,
+        guided_vocab=guided_vocab,
     )
 
     if precompile:
@@ -364,6 +382,10 @@ async def _amain(args: argparse.Namespace) -> None:
         env_cfg.spec_mode or "off"
     )
     spec_k_max = args.spec_k_max or env_cfg.spec_k_max or 8
+    # guided decoding: CLI flag > DYN_GUIDED_MODE > default auto
+    guided_mode = args.guided if args.guided is not None else (
+        env_cfg.guided_mode or "auto"
+    )
 
     ecfg = EngineConfig(
         page_size=args.page_size,
@@ -386,6 +408,7 @@ async def _amain(args: argparse.Namespace) -> None:
         spec_k_max=spec_k_max,
         spec_ngram_min=args.spec_ngram_min,
         spec_ngram_max=args.spec_ngram_max,
+        guided_mode=guided_mode,
     )
     spmd_leader = None
     if args.mirror == "follower":
@@ -678,6 +701,13 @@ def main() -> None:
                    help="shortest suffix n-gram the drafter matches")
     p.add_argument("--spec-ngram-max", type=int, default=4,
                    help="longest suffix n-gram (tried first)")
+    p.add_argument("--guided", default=None, choices=["auto", "off"],
+                   help="guided decoding: 'auto' (default) serves "
+                        "response_format / forced tool_choice with "
+                        "on-device grammar masks (schema-conformant "
+                        "output guaranteed at any temperature); 'off' "
+                        "rejects guided requests. Default from "
+                        "DYN_GUIDED_MODE, else auto")
     p.add_argument("--precompile", action=argparse.BooleanOptionalAction,
                    default=False,
                    help="compile every serving shape (prefill buckets x "

@@ -313,6 +313,31 @@ class HttpFrontend:
             deltas = pipe.generate(preprocessed, ctx)
             timed = self._timed_stream(deltas, model, t_start)
 
+            if preprocessed.get("guided"):
+                # worker-side grammar rejections (compile fault, guided
+                # decoding unavailable) arrive as the FIRST stream item,
+                # typed "invalid_request:". Peek it so they map to a
+                # real 400 instead of a 200 that immediately errors —
+                # the invalid-schema-must-never-500-mid-stream contract.
+                try:
+                    first = await timed.__anext__()
+                except StopAsyncIteration:
+                    first = None
+                err = (
+                    str(first.get("error") or "")
+                    if isinstance(first, dict)
+                    and first.get("finish_reason") == "error" else ""
+                )
+                if err.startswith("invalid_request:"):
+                    ctx.stop_generating()
+                    msg = err[len("invalid_request:"):].strip()
+                    self._m_requests.labels(model, route, "400").inc()
+                    self._audit(
+                        route, model, ctx, body, 400, t_start, error=msg
+                    )
+                    return _error(400, msg)
+                timed = self._rechain(first, timed)
+
             # streamed requests: observe the delta stream so the audit
             # record carries real output tokens / finish reason, and a
             # mid-stream failure (delivered to the client as an SSE error
@@ -403,6 +428,14 @@ class HttpFrontend:
         finally:
             self._m_inflight.labels(model).dec()
             self._m_duration.labels(model).observe(time.monotonic() - t_start)
+
+    @staticmethod
+    async def _rechain(first, rest):
+        """Put a peeked item back in front of its stream."""
+        if first is not None:
+            yield first
+        async for d in rest:
+            yield d
 
     @staticmethod
     async def _observe_for_audit(stream, state: dict):

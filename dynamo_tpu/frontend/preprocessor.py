@@ -63,12 +63,26 @@ class OpenAIPreprocessor:
 
     def _tool_config(self, request: dict[str, Any] | None):
         """Jail only when the model has a parser AND the request brought
-        tools (ref preprocessor.rs:629 jail application)."""
+        tools (ref preprocessor.rs:629 jail application). Every
+        tool_choice shape except "none" flows through: "auto" (parse if
+        the model calls), "required" and named functions (generation is
+        grammar-FORCED into a call — _guided_spec — and the jail/parser
+        consume the guaranteed output)."""
         if self._tool_cfg is None or not request or not request.get("tools"):
             return None
         if request.get("tool_choice") == "none":
             return None
         return self._tool_cfg
+
+    def _guided_spec(self, request: dict[str, Any]) -> dict[str, Any] | None:
+        """Grammar selection for guided decoding (guided/schema.py):
+        forced tool calls win over response_format over
+        nvext.guided_regex; None when nothing constrains generation.
+        Raises ValueError (GrammarError) -> a typed 400 at the edge —
+        an unsupported schema must never become a mid-stream 500."""
+        from dynamo_tpu.guided.schema import grammar_from_request
+
+        return grammar_from_request(request, tool_cfg=self._tool_cfg)
 
     def _reasoning(self):
         from dynamo_tpu.parsers import make_reasoning_parser
@@ -202,6 +216,7 @@ class OpenAIPreprocessor:
 
     def preprocess(self, request: dict[str, Any]) -> dict[str, Any]:
         """OpenAI chat/completions request (dict) -> PreprocessedRequest."""
+        guided = self._guided_spec(request)
         request, images = self._flatten_content(request)
         if images and not self.mm_tokens_per_image:
             raise ValueError(
@@ -267,6 +282,10 @@ class OpenAIPreprocessor:
             if isinstance(request.get("nvext"), dict)
             else [],
             logprobs=logprobs,
+            guided=(
+                {**guided, "prompt_len": len(token_ids)}
+                if guided is not None else None
+            ),
         )
         if images:
             # image refs ride to the MultimodalEncode operator, which

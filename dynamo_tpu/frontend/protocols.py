@@ -39,6 +39,7 @@ def make_preprocessed_request(
     eos_token_ids: list[int] | None = None,
     annotations: list[str] | None = None,
     logprobs: int | None = None,  # None=off, N=top-N alternatives
+    guided: dict[str, Any] | None = None,  # grammar spec (guided/schema.py)
 ) -> dict[str, Any]:
     return {
         "token_ids": token_ids,
@@ -65,6 +66,11 @@ def make_preprocessed_request(
         "estimated_prefix_hit_num_blocks": None,
         "annotations": annotations or [],
         "disagg": None,
+        # guided decoding: {"kind", "regex", "key", "prompt_len"} — the
+        # grammar the engine compiles to token masks; prompt_len marks
+        # the original prompt end so resume paths can advance the
+        # automaton over already-generated tokens
+        "guided": guided,
     }
 
 

@@ -120,6 +120,75 @@ def _check_messages(body: dict) -> None:
         )
 
 
+_RESPONSE_FORMAT_TYPES = {"text", "json_object", "json_schema"}
+
+
+def _check_response_format(body: dict) -> None:
+    """Structural checks for the guided-decoding surface: a malformed
+    ``response_format`` must 400 at the edge, not surface as a 500 (or
+    worse, be silently dropped) once the stream is running."""
+    rf = body.get("response_format")
+    if rf is None:
+        return
+    if not isinstance(rf, dict):
+        _fail("'response_format' must be an object", "response_format")
+    t = rf.get("type")
+    if t not in _RESPONSE_FORMAT_TYPES:
+        _fail(
+            f"'response_format.type' must be one of "
+            f"{sorted(_RESPONSE_FORMAT_TYPES)}",
+            "response_format.type",
+        )
+    if t == "json_schema":
+        js = rf.get("json_schema")
+        if not isinstance(js, dict):
+            _fail(
+                "'response_format.json_schema' must be an object",
+                "response_format.json_schema",
+            )
+        if not isinstance(js.get("schema"), dict):
+            _fail(
+                "'response_format.json_schema.schema' must be an object",
+                "response_format.json_schema.schema",
+            )
+
+
+def _check_tool_choice(body: dict) -> None:
+    tc = body.get("tool_choice")
+    if tc is None:
+        return
+    if isinstance(tc, str):
+        if tc not in ("none", "auto", "required"):
+            _fail(
+                "'tool_choice' must be 'none', 'auto', 'required' or a "
+                "named function object",
+                "tool_choice",
+            )
+        if tc == "required" and not body.get("tools"):
+            _fail("'tool_choice: required' needs 'tools'", "tool_choice")
+        return
+    if not isinstance(tc, dict):
+        _fail("'tool_choice' must be a string or object", "tool_choice")
+    fn = tc.get("function")
+    name = fn.get("name") if isinstance(fn, dict) else None
+    if tc.get("type") != "function" or not isinstance(name, str) or not name:
+        _fail(
+            "'tool_choice' object must be "
+            "{'type': 'function', 'function': {'name': ...}}",
+            "tool_choice",
+        )
+    declared = {
+        (t.get("function") or {}).get("name")
+        for t in body.get("tools") or ()
+        if isinstance(t, dict)
+    }
+    if name not in declared:
+        _fail(
+            f"'tool_choice' names unknown tool {name!r}",
+            "tool_choice.function.name",
+        )
+
+
 def _check_tools(body: dict) -> None:
     tools = body.get("tools")
     if tools is None:
@@ -149,6 +218,8 @@ def validate_request(body: Any, kind: str) -> None:
     if kind == "chat":
         _check_messages(body)
         _check_tools(body)
+        _check_tool_choice(body)
+        _check_response_format(body)
         _check_common(body)
         lp = body.get("logprobs")
         if lp is not None and not isinstance(lp, bool):

@@ -85,18 +85,19 @@ class GqaFamily:
         )
 
     def verify(self, spec, params, tokens, bts, starts, k, v, ns,
-               mesh=None):
+               mesh=None, allowed=None):
         return self.m.verify_forward(
-            spec, params, tokens, bts, starts, k, v, ns, mesh=mesh
+            spec, params, tokens, bts, starts, k, v, ns, mesh=mesh,
+            allowed=allowed,
         )
 
     def decode_steps(self, spec, params, tokens, bts, lens, k, v, active,
                      temps, topk, topp, seeds, steps, *, n_steps, n_logprobs,
-                     mesh=None):
+                     mesh=None, allowed=None):
         return self.m.decode_steps(
             spec, params, tokens, bts, lens, k, v, active, temps, topk,
             topp, seeds, steps, n_steps=n_steps, n_logprobs=n_logprobs,
-            mesh=mesh,
+            mesh=mesh, allowed=allowed,
         )
 
     def extract_pages(self, k, v, page_ids):
@@ -162,18 +163,20 @@ class MlaFamily:
         return logits, cache, v, jnp.zeros((), jnp.int32)
 
     def verify(self, spec, params, tokens, bts, starts, k, v, ns,
-               mesh=None):
+               mesh=None, allowed=None):
         targets, cache = self.m.verify_forward(
-            spec, params, tokens, bts, starts, k, ns, mesh=mesh
+            spec, params, tokens, bts, starts, k, ns, mesh=mesh,
+            allowed=allowed,
         )
         return targets, cache, v, jnp.zeros((), jnp.int32)
 
     def decode_steps(self, spec, params, tokens, bts, lens, k, v, active,
                      temps, topk, topp, seeds, steps, *, n_steps, n_logprobs,
-                     mesh=None):
+                     mesh=None, allowed=None):
         result = self.m.decode_steps(
             spec, params, tokens, bts, lens, k, active, temps, topk, topp,
             seeds, steps, n_steps=n_steps, n_logprobs=n_logprobs, mesh=mesh,
+            allowed=allowed,
         )
         if n_logprobs > 0:
             out, lp, ti, tv, cache = result

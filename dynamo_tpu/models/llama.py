@@ -577,6 +577,7 @@ def verify_forward_impl(
     v_pages: jax.Array,
     num_tokens: jax.Array,  # [N] valid tokens per row (0 = padded row)
     mesh: Mesh | None = None,  # static
+    allowed: jax.Array | None = None,  # [N, W, V] bool: guided masks
 ) -> tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
     """Speculative-verify forward: N slots' (fed token + k drafts) in
     ONE short-prefill dispatch, with the target's greedy choice at EVERY
@@ -650,6 +651,11 @@ def verify_forward_impl(
         moe_dropped = moe_dropped + d
 
     logits = _logits(spec, params, x)  # [N, W, V]
+    if allowed is not None:
+        # guided decoding composes with speculation here: masking the
+        # VERIFY logits per position means a rejected draft's correction
+        # token is itself grammar-legal — conformance survives rejection
+        logits = jnp.where(allowed, logits, -1e30)
     targets = jnp.argmax(logits, axis=-1).astype(jnp.int32)
     return (
         _replicate(targets, mesh), k_pages, v_pages,
@@ -744,6 +750,7 @@ def decode_steps_impl(
     n_steps: int = 1,  # static: decode steps per dispatch
     n_logprobs: int = 0,  # static: 0=off, N=sampled+top-N logprobs
     mesh: Mesh | None = None,  # static
+    allowed: jax.Array | None = None,  # [B, V] bool: guided token masks
 ):
     """``n_steps`` decode iterations + on-device sampling in ONE dispatch.
 
@@ -757,6 +764,12 @@ def decode_steps_impl(
     host-side by discarding the tail. Sampling keys fold in the per-slot
     generated-count so bursts reproduce the per-request RNG stream exactly
     (engine/sampling.py contract).
+
+    ``allowed`` is the guided-decoding constraint mask: the host-side
+    automaton only advances as sampled tokens LAND, so the engine
+    dispatches masked bursts at n_steps=1 (the mask is per-position) —
+    a batch with no constrained slot passes None and compiles/runs the
+    unmasked program unchanged.
     """
     from dynamo_tpu.engine.sampling import sample_tokens, token_logprobs
 
@@ -771,6 +784,8 @@ def decode_steps_impl(
         logits, kp, vp = decode_forward_impl(
             spec, params, toks, block_tables, lens, kp, vp, active, mesh=mesh
         )
+        if allowed is not None:
+            logits = jnp.where(allowed, logits, -1e30)
         nxt = sample_tokens(
             logits, temperature, top_k, top_p, seeds, steps + i
         )

@@ -459,6 +459,7 @@ def verify_forward_impl(
     cache: jax.Array,  # donated
     num_tokens: jax.Array,  # [N] valid tokens per row (0 = padded row)
     mesh: Mesh | None = None,  # static
+    allowed: jax.Array | None = None,  # [N, W, V] bool: guided masks
 ) -> tuple[jax.Array, jax.Array]:
     """Speculative-verify forward for MLA (mirrors llama.verify_forward):
     token-granular latent writes — a verify starts mid-page, so the
@@ -507,6 +508,10 @@ def verify_forward_impl(
         x = x + _ffn(spec, li, lp, hh.reshape(N * W, -1)).reshape(N, W, -1)
 
     logits = _logits_all(spec, params, x)  # [N, W, V]
+    if allowed is not None:
+        # guided x spec: masked verify logits keep the correction token
+        # on-grammar even when every draft is rejected (llama mirror)
+        logits = jnp.where(allowed, logits, NEG_INF)
     targets = jnp.argmax(logits, axis=-1).astype(jnp.int32)
     return _replicate(targets, mesh), cache
 
@@ -583,6 +588,7 @@ def decode_steps_impl(
     n_steps: int = 1,
     n_logprobs: int = 0,  # static: 0=off, N=sampled+top-N logprobs
     mesh: Mesh | None = None,  # static
+    allowed: jax.Array | None = None,  # [B, V] bool: guided token masks
 ):
     """Fused multi-step MLA decode + on-device sampling (the serving hot
     loop; mirrors llama.decode_steps for the GQA family, including the
@@ -601,6 +607,8 @@ def decode_steps_impl(
             spec, params, toks, block_tables, lens, cache, active,
             mesh=mesh,
         )
+        if allowed is not None:
+            logits = jnp.where(allowed, logits, NEG_INF)
         nxt = sample_tokens(logits, temperature, top_k, top_p, seeds,
                             steps + i)
         nxt = jnp.where(active, nxt, toks)

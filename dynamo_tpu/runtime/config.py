@@ -70,6 +70,10 @@ class RuntimeConfig:
     # empty/0 falls through to the EngineConfig defaults ("off" / 8)
     spec_mode: str = ""
     spec_k_max: int = 0
+    # guided decoding default for engine workers (DYN_GUIDED_MODE;
+    # guided/): explicit --guided CLI flags win, empty falls through to
+    # the EngineConfig default ("auto")
+    guided_mode: str = ""
     # persistent XLA compilation cache dir (DYN_COMPILE_CACHE_DIR): a
     # restarted worker reloads its serving programs from disk instead of
     # paying cold-start TTFT recompiling them; empty = off. Honored by

@@ -52,6 +52,7 @@ Registered fault points (this PR):
     hub.dial / hub.call                                   (hub_client.py)
     hub.wal_append / hub.fsync                            (hub_store.py)
     engine.step / engine.admit / engine.spec_verify       (engine/core.py)
+    engine.guided_compile                                 (guided/runtime.py)
     disagg.pull                                           (disagg/transfer.py)
 
 Trip counters are exported on every ``/metrics`` surface as
@@ -94,6 +95,7 @@ KNOWN_SITES: frozenset[str] = frozenset({
     "engine.admit",
     "engine.compile",
     "engine.spec_verify",
+    "engine.guided_compile",
     "disagg.pull",
 })
 

@@ -14,6 +14,9 @@ ARGS=(run --in http --out engine --port "${PORT:-8000}")
 # SPEC_MODE=ngram: prompt-lookup speculative decoding (>=1.5x per-stream
 # tok/s on repetitive/agentic prompts; greedy output unchanged)
 [ -n "${SPEC_MODE:-}" ] && ARGS+=(--spec "$SPEC_MODE")
+# GUIDED_MODE=off disables guided decoding (response_format / forced
+# tool_choice grammar masks; default auto — also via DYN_GUIDED_MODE)
+[ -n "${GUIDED_MODE:-}" ] && ARGS+=(--guided "$GUIDED_MODE")
 if [ -n "${MODEL_PATH:-}" ]; then
   ARGS+=(--model-path "$MODEL_PATH")
 else

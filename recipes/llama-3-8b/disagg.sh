@@ -13,6 +13,9 @@ export DYN_COMPILE_CACHE_DIR="${DYN_COMPILE_CACHE_DIR-$HOME/.cache/dynamo-tpu/xl
 [ "${PRECOMPILE:-1}" = "1" ] && MODEL_ARGS+=(--precompile)
 # SPEC_MODE=ngram: prompt-lookup speculative decoding on the decode pool
 [ -n "${SPEC_MODE:-}" ] && MODEL_ARGS+=(--spec "$SPEC_MODE")
+# GUIDED_MODE=off disables guided decoding (guided requests always
+# prefill locally on the decode pool, so disagg composes cleanly)
+[ -n "${GUIDED_MODE:-}" ] && MODEL_ARGS+=(--guided "$GUIDED_MODE")
 
 python -m dynamo_tpu.runtime.hub_server --port 0 > /tmp/dyn-hub.out &
 HUB_PID=$!

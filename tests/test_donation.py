@@ -184,6 +184,23 @@ def test_sampling_does_not_donate_logits():
     assert not logits.is_deleted()
 
 
+def test_masked_sampling_does_not_donate_logits_or_mask():
+    """sample_tokens_masked (guided decoding) shares the sync-admission
+    contract: the stacked logits feed the batched logprob pass after
+    sampling, and the mask row for a slot is REUSED by the next burst
+    when the sampled token did not advance the automaton's state (e.g.
+    whitespace loops) — neither input may be invalidated."""
+    logits = jnp.zeros((B, SPEC.vocab_size), jnp.float32)
+    allowed = jnp.ones((B, SPEC.vocab_size), bool)
+    zB = jnp.zeros((B,), jnp.int32)
+    sampling.sample_tokens_masked(
+        logits, allowed, jnp.zeros((B,), jnp.float32), zB,
+        jnp.ones((B,), jnp.float32), jnp.zeros((B,), jnp.uint32), zB,
+    )
+    assert not logits.is_deleted()
+    assert not allowed.is_deleted()
+
+
 # --------------------------------------------------------------- inventory
 
 # module -> {jit name: "donates" | "read-only"}. A jit object in one of
@@ -216,6 +233,7 @@ AUDIT: dict = {
     },
     sampling: {
         "sample_tokens": "read-only",
+        "sample_tokens_masked": "read-only",
         "token_logprobs": "read-only",
     },
     kv_write: {

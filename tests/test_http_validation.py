@@ -133,3 +133,128 @@ async def test_malformed_bodies_are_4xx_at_the_edge():
         await frontend.stop()
         await watcher.close()
         await drt.close()
+
+
+# ------------------------------------------------- guided request surface
+
+
+async def test_guided_request_validation_and_conformance_over_http():
+    """The guided-decoding HTTP contract end to end: malformed or
+    unsupported response_format / tool_choice shapes are typed 400s
+    naming the param (previously the fields were SILENTLY DROPPED); a
+    supported schema serves 200 with content that parses against it;
+    and a worker-side grammar-compile fault maps to 400 — never a 500,
+    never a mid-stream surprise, no page leak."""
+    import json as _json
+    import sys
+
+    sys.path.insert(0, "tests")
+    from test_http_extras import _engine_stack
+
+    from dynamo_tpu.runtime.faults import FAULTS
+
+    drt, engine, watcher, frontend = await _engine_stack()
+    base = f"http://127.0.0.1:{frontend.port}"
+    msgs = [{"role": "user", "content": "json please"}]
+    try:
+        async with aiohttp.ClientSession() as sess:
+            # 1) malformed shapes: typed 400 at the edge, param named
+            for body, param in [
+                ({"response_format": {"type": "jsonish"}},
+                 "response_format.type"),
+                ({"response_format": {"type": "json_schema"}},
+                 "response_format.json_schema"),
+                ({"tool_choice": "always"}, "tool_choice"),
+                ({"tool_choice": {"type": "function",
+                                  "function": {"name": "ghost"}},
+                  "tools": [{"type": "function",
+                             "function": {"name": "real"}}]},
+                 "tool_choice.function.name"),
+            ]:
+                async with sess.post(
+                    f"{base}/v1/chat/completions",
+                    json={"model": "tiny-test", "messages": msgs, **body},
+                ) as r:
+                    assert r.status == 400, (body, await r.text())
+                    err = (await r.json())["error"]
+                    assert err["param"] == param, (err, param)
+
+            # 2) an UNSUPPORTED schema (outside the strict subset) is a
+            # 400 from the grammar compiler, not a 500 from the engine
+            async with sess.post(
+                f"{base}/v1/chat/completions",
+                json={"model": "tiny-test", "messages": msgs,
+                      "response_format": {"type": "json_schema",
+                                          "json_schema": {
+                                              "name": "bad",
+                                              "schema": {"$ref": "#/x"},
+                                          }}},
+            ) as r:
+                assert r.status == 400, await r.text()
+                assert "unsupported schema" in (
+                    (await r.json())["error"]["message"]
+                )
+
+            # 3) a supported schema serves conformant content at
+            # temperature > 0 (MockTokenizer is byte-level, so the
+            # chat content IS the constrained text)
+            schema = {"type": "object",
+                      "properties": {"flag": {"type": "boolean"}},
+                      "required": ["flag"]}
+            async with sess.post(
+                f"{base}/v1/chat/completions",
+                json={"model": "tiny-test", "messages": msgs,
+                      "max_tokens": 200, "temperature": 0.8, "seed": 5,
+                      "response_format": {
+                          "type": "json_schema",
+                          "json_schema": {"name": "t", "schema": schema},
+                      }},
+            ) as r:
+                assert r.status == 200, await r.text()
+                out = await r.json()
+            choice = out["choices"][0]
+            assert choice["finish_reason"] == "stop"
+            parsed = _json.loads(choice["message"]["content"])
+            assert set(parsed) == {"flag"}
+            assert isinstance(parsed["flag"], bool)
+
+            # 4) worker-side compile fault: 400 + no page leak, then the
+            # same request serves once the one-shot fault is spent
+            probe_schema = {"type": "object",
+                            "properties": {"http_fault_probe":
+                                           {"type": "boolean"}},
+                            "required": ["http_fault_probe"]}
+            body = {"model": "tiny-test", "messages": msgs,
+                    "max_tokens": 64,
+                    "response_format": {
+                        "type": "json_schema",
+                        "json_schema": {"name": "p",
+                                        "schema": probe_schema},
+                    }}
+            # trip counters are process-cumulative (test_guided.py trips
+            # this site too): assert the DELTA from this request
+            trips0 = FAULTS.snapshot()["trips"].get(
+                "engine.guided_compile:error", 0
+            )
+            FAULTS.configure("engine.guided_compile:error@1.0x1", seed=3)
+            try:
+                async with sess.post(
+                    f"{base}/v1/chat/completions", json=body
+                ) as r:
+                    assert r.status == 400, await r.text()
+                    msg = (await r.json())["error"]["message"]
+                    assert "guided grammar rejected" in msg
+                assert engine.allocator.active_pages == 0
+                assert FAULTS.snapshot()["trips"].get(
+                    "engine.guided_compile:error"
+                ) == trips0 + 1
+                async with sess.post(
+                    f"{base}/v1/chat/completions", json=body
+                ) as r:
+                    assert r.status == 200, await r.text()
+            finally:
+                FAULTS.configure("")
+    finally:
+        await frontend.stop()
+        await watcher.close()
+        await drt.close()

@@ -162,6 +162,27 @@ async def test_span_smoke_covers_catalog(tmp_path):
             ) as r:
                 assert r.status == 200, await r.text()
 
+            # 2b) guided coverage: a schema-constrained completion
+            # (engine.guided_compile span + the guided request counter);
+            # the worker built its mask vocab from the same mock
+            # tokenizer at launch
+            async with sess.post(
+                f"{base}/v1/chat/completions",
+                json={"model": "tiny-test",
+                      "messages": [{"role": "user", "content": "json"}],
+                      "max_tokens": 4, "temperature": 0.0,
+                      "response_format": {
+                          "type": "json_schema",
+                          "json_schema": {"name": "obs", "schema": {
+                              "type": "object",
+                              "properties": {"v": {"type": "integer"}},
+                              "required": ["v"],
+                          }},
+                      }},
+                headers=hdrs,
+            ) as r:
+                assert r.status == 200, await r.text()
+
             # 3) spec coverage: a repetitive greedy prompt straight at
             # the engine (prompt-lookup drafter verifies -> engine.spec)
             async for _ in engine.generate(

@@ -157,6 +157,33 @@ def test_spec_decode_artifact_schema():
     assert out["acceptance_rate"] == out["rungs"][0]["acceptance_rate"]
 
 
+def test_guided_rung_artifact_schema_and_overhead_bar():
+    """The guided-decoding bench rung (bench.guided_measurement):
+    constrained vs free ITL from ONE mixed run (paired medians over
+    shared engine cycles), the grammar-compiler micro-bench, and the
+    recorded <5% masking-overhead bar — met on the CPU rung."""
+    out = bench.guided_measurement(
+        TINY, page_size=16, on_tpu=False, family="gqa",
+        concurrency=2, osl=24,
+    )
+    assert out["mode"] == "guided mixed-concurrency ITL"
+    for key in ("guided_itl_ms", "free_itl_ms", "free_itl_ms_baseline",
+                "guided_tokens", "free_tokens", "grammar_kind",
+                "masking_overhead_frac", "grammar_compiler", "bars"):
+        assert key in out, key
+    assert out["bars"]["masking_itl_overhead_max"] == 0.05
+    comp = out["grammar_compiler"]
+    for key in ("compiles", "hits", "hit_rate", "compile_ms_total"):
+        assert key in comp, key
+    # mask-compile cost is attributable: the rung compiled (or shared)
+    # at least one grammar and the request path hit the cache
+    assert comp["compiles"] + comp["hits"] > 0
+    # the acceptance bar, judged on the CPU rung: paired medians over
+    # the SAME dispatches keep this stable
+    assert out["masking_overhead_frac"] is not None
+    assert out["masking_overhead_frac"] <= 0.05, out
+
+
 def test_family_serving_tuning_table():
     """Each north-star family has its own ladder tuning, and the bars
     artifact records the per-family frac targets."""

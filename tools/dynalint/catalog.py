@@ -37,6 +37,10 @@ FAULT_SITES: dict[str, str] = {
                           "failure must fall back to non-spec decode for "
                           "the affected slots (pages rolled back, no "
                           "client-visible error)",
+    "engine.guided_compile": "guided/runtime.py grammar compile — a "
+                             "failing grammar->mask compile must bounce "
+                             "the request as a typed 400 (no slot, no "
+                             "page, counter trip), never wedge a stream",
     "disagg.pull": "disagg/transfer.py KV pull — transfer plane failure",
 }
 
@@ -76,6 +80,10 @@ PROFILE_PHASES: dict[str, str] = {
     "spec.verify": "packed speculative-verify dispatch + target sync",
     "spec.rollback": "page release of rejected draft tails (and the "
                      "injected-verify-failure fallback)",
+    "guided.mask": "host-side [B, V] allowed-mask assembly for "
+                   "constrained slots (burst + admission sampling)",
+    "guided.lookahead": "scratch-cursor draft walk for guided x spec "
+                        "verify (per-position masks, no state mutation)",
 }
 
 # span name (runtime/tracing.py span()/emit_span()) -> what it times.
@@ -103,6 +111,9 @@ SPAN_NAMES: dict[str, str] = {
     "engine.prefill": "admit -> first token (prefill chunk count attr)",
     "engine.decode": "first token -> finish, aggregated per request",
     "engine.spec": "speculative-verify activity, first -> last verify",
+    "engine.guided_compile": "grammar -> token-mask automaton compile "
+                             "(or LRU fetch) before admission "
+                             "(engine/core.py generate)",
 }
 
 # metric name (without the dynamo_ prefix MetricsRegistry adds) -> meaning
@@ -124,6 +135,11 @@ METRIC_NAMES: dict[str, str] = {
     "spec_tokens_total": "speculative draft tokens by verify outcome "
                          "(accepted | rejected) — the live acceptance "
                          "rate of prompt-lookup decoding",
+    "guided_requests_total": "guided-decoding requests by outcome "
+                             "(ok | truncated | violation | aborted | "
+                             "compile_error | unavailable) — conformance "
+                             "delivered vs cut mid-grammar vs bounced at "
+                             "the grammar compiler",
     # EPP pick-path telemetry (gateway/epp.py /metrics)
     "epp_pick_seconds": "EPP pick-path latency histogram",
     "epp_cache_lookups_total": "EPP prefix-cache lookups by cache "

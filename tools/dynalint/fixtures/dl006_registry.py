@@ -29,6 +29,17 @@ def suppressed_negative():
     FAULTS.fire_sync("engine.experimental")
 
 
+def guided_names_are_clean():
+    # the guided-decoding registry additions resolve as known names in
+    # all three catalogs (fault site, metric, span)
+    FAULTS.fire_sync("engine.guided_compile")
+    metrics_registry.counter(
+        "guided_requests_total", "Guided-decoding requests.", ["outcome"]
+    )
+    with tracing.span("engine.guided_compile"):
+        pass
+
+
 def known_metric_is_clean():
     return metrics_registry.counter(
         "http_requests_total", "HTTP requests", ["model"]
