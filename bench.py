@@ -212,6 +212,44 @@ def aggregate_rung(reps: list[dict]) -> dict:
     return out
 
 
+def decode_step_bytes(
+    param_bytes: int, kv_per_token: float, batch: int, mean_ctx: float
+) -> int:
+    """Analytic HBM bytes ONE decode step moves at ``batch`` live slots
+    and ``mean_ctx`` tokens of context each: full param read + per-token
+    KV read over the context + the new token's KV write.
+
+    ``kv_per_token`` is priced from the ACTUAL pool arrays
+    (``jax.tree.leaves`` over the pools covers both plain arrays and
+    ops/quant.py QuantPools, where fp8 values + bf16 scales enter at
+    their true widths) — so the fp8-vs-bf16 ladder delta in the artifact
+    is attributable to pool dtype, not assumptions."""
+    return int(param_bytes + kv_per_token * (mean_ctx + 1) * batch)
+
+
+def attach_rung_roofline(
+    out_rungs: list[dict], param_bytes: int, kv_per_token: float,
+    isl: int, osl: int,
+) -> None:
+    """Per-rung bandwidth attribution (ROADMAP #2): analytic
+    ``bytes_per_step`` at the rung's batch and the achieved-HBM-bandwidth
+    estimate the median tok/s implies. steps/s = tok/s / concurrency
+    (every live slot lands one token per step), so
+    ``est_hbm_gbps = bytes_per_step * tok_s / concurrency / 1e9`` — on
+    CPU a sanity number, on chip the roofline-fraction feed for the
+    >=1.6x fp8 tok/s bar."""
+    mean_ctx = isl + osl / 2
+    for r in out_rungs:
+        bps = decode_step_bytes(
+            param_bytes, kv_per_token, r["concurrency"], mean_ctx
+        )
+        r["bytes_per_step"] = bps
+        r["est_hbm_gbps"] = round(
+            bps * r["output_tok_per_s"] / max(r["concurrency"], 1) / 1e9,
+            3,
+        )
+
+
 def frac_of_raw(serving: dict, raw_value: float, batch: int) -> tuple[float, int]:
     """Serving efficiency vs the raw-decode ceiling, from rung MEDIANS.
     Prefers the rung whose concurrency matches the raw-decode batch;
@@ -313,6 +351,21 @@ def serving_measurement(
     async def run() -> dict:
         engine = InferenceEngine(spec, cfg)
         await engine.start()
+        # pool/param byte totals for the per-rung roofline attribution —
+        # captured now because the live arrays are donated through every
+        # later dispatch. shape[1]/shape[-2] are num_pages/page_size on
+        # both plain pools and QuantPool (.shape delegates to the values)
+        pool_bytes = sum(
+            int(x.size) * x.dtype.itemsize
+            for x in jax.tree.leaves((engine.k_pages, engine.v_pages))
+        )
+        param_bytes = sum(
+            int(x.size) * x.dtype.itemsize
+            for x in jax.tree.leaves(engine.params)
+        )
+        kv_per_token = pool_bytes / (
+            engine.k_pages.shape[1] * engine.k_pages.shape[-2]
+        )
         rng = np.random.default_rng(0)
 
         async def one_rung(n_streams: int) -> dict:
@@ -455,10 +508,13 @@ def serving_measurement(
         dispatch = dispatch_attribution(snap, ladder_steps)
         overhead = dispatch_overhead(snap, ladder_s, ladder_steps)
         out_rungs = [aggregate_rung(reps) for reps in rep_rungs]
+        attach_rung_roofline(out_rungs, param_bytes, kv_per_token, ISL, OSL)
         best = max(out_rungs, key=lambda r: r["output_tok_per_s"])
         return {
             "mode": "closed-loop ladder",
             "family": family,
+            "kv_dtype": engine.kv_dtype,
+            "kv_bytes_per_token": round(kv_per_token, 2),
             "isl": ISL, "osl": OSL, "slots": SLOTS,
             "warmup_s": warm_s, "window_s": window_s,
             "repeats": repeats,
@@ -837,7 +893,15 @@ def raw_decode(
 
     key = jax.random.PRNGKey(0)
     params = fam.init_params(spec, key)
-    k_pages, v_pages = fam.init_cache(spec, num_pages, page_size)
+    from dynamo_tpu.ops.quant import resolve_kv_dtype
+
+    # DYN_KV_DTYPE=fp8 runs the whole raw ladder quantized: cache_bytes
+    # below then prices fp8 values + bf16 scales, so bytes_per_step and
+    # the roofline fraction in the artifact reflect the real traffic
+    kv_dtype = resolve_kv_dtype(None)
+    k_pages, v_pages = fam.init_cache(
+        spec, num_pages, page_size, kv_dtype=kv_dtype
+    )
     cache_bytes = sum(
         x.size * x.dtype.itemsize
         for x in jax.tree.leaves((k_pages, v_pages))
@@ -935,6 +999,7 @@ def raw_decode(
         "value": round(value, 2),
         "step_ms": round(step_ms, 3),
         "batch": B,
+        "kv_dtype": kv_dtype,
         "bytes_per_step_gb": round(bytes_per_step / 1e9, 3),
         "achieved_hbm_gbps": round(gbps, 1),
         "hbm_roofline_frac": round(gbps / peak, 3) if peak else None,

@@ -240,6 +240,13 @@ class EngineConfig:
     page_size: int = 16  # tokens per page (= router block_size granularity)
     num_pages: int = 2048  # HBM page budget (per shard)
     max_pages_per_seq: int = 64  # max context = page_size * this
+    # KV-cache storage dtype: "bf16" = unquantized pool in the model
+    # dtype (bit-identical serving), "fp8" = e4m3 values + per-page/head
+    # bf16 scales (ops/quant.py — halves decode HBM reads and the KVBM
+    # tier footprint; outputs drift within the tolerance goldens,
+    # tests/test_quant_goldens.py). "" = consult DYN_KV_DTYPE, default
+    # bf16; an explicit value here wins over the environment.
+    kv_dtype: str = ""
     # batching. None = auto-size from the page budget: enough slots that
     # decode batch, not slot count, is the limiter, while every slot can
     # still hold a full-length context out of the pool
@@ -353,6 +360,9 @@ class EngineConfig:
             self.max_decode_slots = max(
                 8, min(64, self.num_pages // max(1, self.max_pages_per_seq))
             )
+        from dynamo_tpu.ops.quant import resolve_kv_dtype
+
+        self.kv_dtype = resolve_kv_dtype(self.kv_dtype)
 
     @property
     def max_context(self) -> int:

@@ -186,12 +186,30 @@ class InferenceEngine:
             )
         self.params = params
 
+        # KV storage dtype (ops/quant.py): fp8 pools halve decode HBM
+        # reads and the KVBM tier footprint. Combinations whose pool
+        # plumbing is not quantization-aware yet fail LOUDLY here rather
+        # than corrupting state mid-serving.
+        self.kv_dtype = self.config.kv_dtype
+        if self.kv_dtype == "fp8":
+            if spmd is not None:
+                raise ValueError(
+                    "kv_dtype=fp8 is not in the SPMD follower replay "
+                    "protocol yet; run multi-host workers with bf16"
+                )
+            if self.config.pp > 1:
+                raise ValueError(
+                    "kv_dtype=fp8 does not support pipeline-parallel "
+                    "stages yet (parallel/pipeline.py writes unquantized "
+                    "pages); use bf16 with pp>1"
+                )
         # +1 page: index 0 is the trash page
         self.k_pages, self.v_pages = self.fam.init_cache(
-            spec, self.config.num_pages + 1, self.config.page_size
+            spec, self.config.num_pages + 1, self.config.page_size,
+            kv_dtype=self.kv_dtype,
         )
         if mesh is not None:
-            ks, vs = self.fam.cache_shardings(mesh)
+            ks, vs = self.fam.cache_shardings(mesh, self.kv_dtype)
             self.k_pages = jax.device_put(self.k_pages, ks)
             self.v_pages = jax.device_put(self.v_pages, vs)
 
@@ -1478,6 +1496,8 @@ class InferenceEngine:
             limit = needed_pages if full_prefix_ok else (n_tokens - 1) // page_size
             wanted = hashes[len(cached) : min(limit, len(hashes))]
             onboard = self.kvbm.get_consecutive(wanted)
+            if onboard and self.kv_dtype == "fp8":
+                onboard = self._validate_quant_blocks(onboard, wanted)
 
         sp = SeqPages(request_id=request_id)
         sp.pages = list(cached)
@@ -1538,6 +1558,69 @@ class InferenceEngine:
             if offload:
                 self._queue_offload(blk.sequence_hash, sp.pages[i], i)
 
+    def _validate_quant_blocks(self, blocks: list, hashes: list) -> list:
+        """Quantized-onboard guard: a tier block whose payload length is
+        wrong or whose SCALE bytes decode non-finite would dequantize a
+        whole page to NaN/inf and poison every later step — treat it (and
+        everything after: onboard prefixes are consecutive) as a tier
+        MISS, logged like the g4 corrupt-payload path, and EVICT it from
+        the local tiers so the next admission refetches (or genuinely
+        misses) instead of looping fetch->reject forever. ``engine.quant``
+        is the injectable fault site: chaos schedules corrupt the dequant
+        here to prove serving survives on a re-prefill.
+
+        Validation is per pool: only parts whose engine pool is actually
+        quantized carry a packed payload — MLA blocks ship an inert v
+        slot (family.MlaFamily.extract_pages) that must not be judged as
+        a payload."""
+        from dynamo_tpu.ops.quant import (
+            is_quant,
+            packed_block_ok,
+            packed_bytes_per_page,
+            packed_scale_bytes,
+        )
+
+        checks = []
+        for pool in (self.k_pages, self.v_pages):
+            if not is_quant(pool):
+                checks.append(None)  # inert slot: nothing to validate
+                continue
+            checks.append(
+                (packed_bytes_per_page(pool), packed_scale_bytes(pool))
+            )
+        for i, blk in enumerate(blocks):
+            bad = None
+            try:
+                if FAULTS.enabled:
+                    FAULTS.fire_sync("engine.quant")
+            except Exception as e:  # noqa: BLE001 - injected corruption
+                bad = f"injected dequant corruption: {e}"
+            if bad is None:
+                for part, chk in zip(blk, checks):
+                    if chk is not None and not packed_block_ok(
+                        (part,), chk[0], chk[1]
+                    ):
+                        bad = "payload length or scale bytes invalid"
+                        break
+            if bad is not None:
+                log.error(
+                    "kvbm quantized onboard: block %d/%d corrupt (%s); "
+                    "treating the remaining prefix as a miss",
+                    i, len(blocks), bad,
+                )
+                if self.kvbm is not None:
+                    sh = hashes[i] if i < len(hashes) else None
+                    if sh is not None:
+                        # G4 is shared/best-effort and left alone: a
+                        # re-fetch from remote re-validates here
+                        self.kvbm.host.remove(sh)
+                        if self.kvbm.disk is not None:
+                            self.kvbm.disk.remove(sh)
+                    with self.kvbm._lock:
+                        self.kvbm.stats.onboard_misses += 1
+                return blocks[:i]
+        return blocks
+
     def onboard_from_tiers(
         self, hashes: list[int], page_ids: np.ndarray, blocks=None
     ) -> None:
@@ -1563,16 +1646,29 @@ class InferenceEngine:
             else:
                 template = next(b for b in blocks if b is not None)
             if template is None:
-                shard = (
-                    self.k_pages.addressable_shards[0].data
-                    if not getattr(self.k_pages, "is_fully_addressable", True)
-                    else self.k_pages
-                )
-                zshape = (shard.shape[0], shard.shape[2], shard.shape[3],
-                          shard.shape[4])
-                template = (
-                    np.zeros(zshape, np.dtype(self.spec.dtype)),
-                ) * 2
+                if self.kv_dtype == "fp8":
+                    # packed quant block: zero bytes unpack to fp8 zeros
+                    # with zero scales — exact zero pages
+                    from dynamo_tpu.ops.quant import packed_bytes_per_page
+
+                    zshape = (
+                        self.k_pages.shape[0],
+                        packed_bytes_per_page(self.k_pages),
+                    )
+                    template = (np.zeros(zshape, np.uint8),) * 2
+                else:
+                    shard = (
+                        self.k_pages.addressable_shards[0].data
+                        if not getattr(
+                            self.k_pages, "is_fully_addressable", True
+                        )
+                        else self.k_pages
+                    )
+                    zshape = (shard.shape[0], shard.shape[2],
+                              shard.shape[3], shard.shape[4])
+                    template = (
+                        np.zeros(zshape, np.dtype(self.spec.dtype)),
+                    ) * 2
             blocks = [
                 b if b is not None else (np.zeros_like(np.asarray(template[0])),
                                          np.zeros_like(np.asarray(template[1])))
@@ -2657,6 +2753,10 @@ class InferenceEngine:
             num_tokens=len(token_ids),
             page_size=self.config.page_size,
         )
+        # ride the handshake params so the decode side can refuse a
+        # mismatched pool dtype before installing blocks (the packed fp8
+        # and bf16 block layouts are not interconvertible in insert_pages)
+        params["kv_dtype"] = self.kv_dtype
         pages, sp.pages = sp.pages, []  # ownership ends here (see _prefill)
         self.allocator.release(pages)
         item: dict[str, Any] = {
@@ -2701,6 +2801,17 @@ class InferenceEngine:
             k_blocks, v_blocks, meta = pull_kv_blocks(kvp, mesh=self.mesh)
         if int(meta.get("page_size", cfg.page_size)) != cfg.page_size:
             raise ValueError("page_size mismatch between prefill and decode")
+        export_dtype = str(kvp.get("kv_dtype", "bf16"))
+        if export_dtype != self.kv_dtype:
+            # fail the request here, with a message naming the knob, rather
+            # than letting insert_pages die on a shape error inside a
+            # donated jit (exports from pre-kv_dtype builds default bf16)
+            raise ValueError(
+                f"disagg kv_dtype mismatch: prefill exported {export_dtype} "
+                f"KV but this decode worker runs kv_dtype={self.kv_dtype} "
+                "(set DYN_KV_DTYPE / EngineConfig.kv_dtype identically on "
+                "both sides)"
+            )
 
         # multimodal resume: the sealed blocks hold IMAGE-conditioned KV —
         # hash them under the same image salt the prefill side used, or

@@ -82,6 +82,13 @@ _M_SPEC_ACCEPT = REGISTRY.gauge(
     "cumulative speculative-draft acceptance rate (NaN-free: 0 until "
     "the first verify)", ["engine"],
 )
+_M_KVBM_TIER = REGISTRY.gauge(
+    "kvbm_tier_bytes",
+    "KVBM tier footprint in bytes by tier (host | disk | remote — "
+    "remote counts this process's G4 writes); quantized blocks "
+    "(kv_dtype=fp8) land at packed fp8+scale width",
+    ["engine", "tier"],
+)
 
 _REJECT_REASONS = ("draining", "saturated", "deadline")
 _COLLECTOR_IDS = iter(range(1 << 30))
@@ -162,6 +169,9 @@ class EngineCollector:
             if delta > 0:
                 _M_REJECTS.labels(lbl, reason).inc(delta)
                 self._reject_base[reason] = cur
+        if eng.kvbm is not None:
+            for tier, nbytes in eng.kvbm.tier_bytes().items():
+                _M_KVBM_TIER.labels(lbl, tier).set(nbytes)
         judged = eng.spec_accepted + eng.spec_rejected
         _M_SPEC_ACCEPT.labels(lbl).set(
             eng.spec_accepted / judged if judged else 0.0

@@ -170,6 +170,21 @@ class KvBlockManager:
                 self.stats.onboard_misses += 1
         return out
 
+    def tier_bytes(self) -> dict[str, int]:
+        """Per-tier footprint for the ``dynamo_kvbm_tier_bytes{tier}``
+        gauge (engine/telemetry.py). host/disk are exact pool budgets in
+        use; remote is the bytes THIS process has written to G4 (the hub
+        store is shared, so a cluster-wide number needs the sum over
+        workers — which is how the gauge aggregates in Prometheus).
+        Quantized blocks (kv_dtype=fp8) show up here at packed width:
+        the tier-footprint halving is directly observable."""
+        out = {"host": self.host.used_bytes}
+        if self.disk is not None:
+            out["disk"] = self.disk.used_bytes
+        if self.remote is not None:
+            out["remote"] = self.remote.stored_bytes
+        return out
+
     def __contains__(self, sh: int) -> bool:
         # the remote tier is intentionally excluded: __contains__ backs the
         # advisory routing probe (engine prefix_hit_tokens) and must stay

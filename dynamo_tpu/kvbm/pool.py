@@ -186,6 +186,20 @@ class DiskBlockPool:
             return None
         return stacked[0], stacked[1]
 
+    def remove(self, sh: int) -> bool:
+        """Drop one block (quantized-onboard corruption eviction)."""
+        with self._lock:
+            nbytes = self._order.pop(sh, None)
+            if nbytes is None:
+                return False
+            self.used_bytes -= nbytes
+            try:
+                os.unlink(self._path(sh))
+            except OSError:
+                pass
+            self._save_index()
+            return True
+
     def clear(self) -> None:
         with self._lock:
             for sh in list(self._order):
@@ -224,6 +238,7 @@ class RemoteBlockPool:
         self.timeout_s = timeout_s
         self.bucket = f"{self.BUCKET}-{namespace}"
         self._written: set[int] = set()  # hashes this process has stored
+        self.stored_bytes = 0  # payload bytes behind _written (tier gauge)
         self._lock = threading.Lock()
 
     def _call(self, coro):
@@ -256,6 +271,8 @@ class RemoteBlockPool:
         )
         try:
             self._call(self.hub.put_object(self.bucket, self._name(sh), payload))
+            with self._lock:
+                self.stored_bytes += len(payload)
             return True
         except Exception:  # noqa: BLE001 - remote tier is best-effort
             log.warning("g4 put failed for %x", sh, exc_info=True)

@@ -60,11 +60,13 @@ class GqaFamily:
     def param_shardings(self, spec, mesh):
         return self.m.param_shardings(spec, mesh)
 
-    def cache_shardings(self, mesh):
-        return self.m.cache_shardings(mesh)
+    def cache_shardings(self, mesh, kv_dtype="bf16"):
+        return self.m.cache_shardings(mesh, kv_dtype)
 
-    def init_cache(self, spec, num_pages, page_size):
-        return self.m.init_cache(spec, num_pages, page_size)
+    def init_cache(self, spec, num_pages, page_size, kv_dtype="bf16"):
+        return self.m.init_cache(
+            spec, num_pages, page_size, kv_dtype=kv_dtype
+        )
 
     def prefill(self, spec, params, tokens, bt, start, k, v, n, mesh=None,
                 mm_embeds=None, mm_pos=None):
@@ -140,12 +142,17 @@ class MlaFamily:
     def param_shardings(self, spec, mesh):
         return self.m.param_shardings(spec, mesh)
 
-    def cache_shardings(self, mesh):
-        s = self.m.cache_shardings(mesh)
-        return s, s  # placeholder v_pages is replicated too
+    def cache_shardings(self, mesh, kv_dtype="bf16"):
+        s = self.m.cache_shardings(mesh, kv_dtype)
+        from jax.sharding import NamedSharding, PartitionSpec as P
 
-    def init_cache(self, spec, num_pages, page_size):
-        cache = self.m.init_cache(spec, num_pages, page_size)
+        # placeholder v_pages is a single replicated leaf either way
+        return s, NamedSharding(mesh, P())
+
+    def init_cache(self, spec, num_pages, page_size, kv_dtype="bf16"):
+        cache = self.m.init_cache(
+            spec, num_pages, page_size, kv_dtype=kv_dtype
+        )
         return cache, jnp.zeros((1,), jnp.int8)  # inert v_pages placeholder
 
     def prefill(self, spec, params, tokens, bt, start, k, v, n, mesh=None):
@@ -200,6 +207,13 @@ class MlaFamily:
 
 @jax.jit
 def _extract_latent(cache, page_ids):
+    from dynamo_tpu.ops.quant import is_quant, pack_pages
+
+    if is_quant(cache):
+        # fp8 cache: values + scales leave as ONE packed uint8 payload
+        # per (layer, page) — KVBM tiers/transfer carry exactly those
+        # bytes (see llama._extract_kv_pages_impl)
+        return pack_pages(cache, page_ids)
     return cache[:, page_ids]
 
 
@@ -208,6 +222,16 @@ def _extract_latent(cache, page_ids):
 # the cache's HBM footprint for the duration of the insert)
 @partial(jax.jit, donate_argnums=(0,))
 def _insert_latent_impl(cache, page_ids, blocks):
+    from dynamo_tpu.ops.quant import QuantPool, is_quant, unpack_pages
+
+    if is_quant(cache):
+        vals, scale = unpack_pages(
+            blocks, cache.vals.shape[2:], cache.scale.shape[2:]
+        )
+        return QuantPool(
+            cache.vals.at[:, page_ids].set(vals),
+            cache.scale.at[:, page_ids].set(scale),
+        )
     return cache.at[:, page_ids].set(blocks)
 
 

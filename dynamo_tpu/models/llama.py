@@ -26,8 +26,17 @@ from dynamo_tpu.engine.config import ModelSpec
 from dynamo_tpu.ops.attention import (
     causal_attention,
     decode_update_attention,
+    gather_ctx,
     gather_pages,
     page_tiles,
+)
+from dynamo_tpu.ops.quant import (
+    QuantPool,
+    init_quant_pool,
+    is_quant,
+    pack_pages,
+    quant_page_tiles,
+    unpack_pages,
 )
 
 TRASH_PAGE = 0  # reserved page index for padded-position scatters
@@ -126,14 +135,23 @@ def param_shardings(spec: ModelSpec, mesh: Mesh) -> Params:
     return out
 
 
-def cache_shardings(mesh: Mesh) -> tuple[NamedSharding, NamedSharding]:
-    """KV pages [L, pages, kv_heads, page_size, D]: shard kv_heads on tp."""
+def cache_shardings(
+    mesh: Mesh, kv_dtype: str = "bf16"
+) -> tuple[Any, Any]:
+    """KV pages [L, pages, kv_heads, page_size, D]: shard kv_heads on tp.
+    Quantized pools shard the scale leaf [L, pages, KH] on the same head
+    axis, so device_put with the QuantPool of shardings keeps values and
+    scales co-located per shard."""
     s = NamedSharding(mesh, P(None, None, "tp", None, None))
+    if kv_dtype == "fp8":
+        qs = QuantPool(s, NamedSharding(mesh, P(None, None, "tp")))
+        return qs, qs
     return s, s
 
 
 def init_cache(
-    spec: ModelSpec, num_pages: int, page_size: int, dtype=None
+    spec: ModelSpec, num_pages: int, page_size: int, dtype=None,
+    kv_dtype: str = "bf16",
 ) -> tuple[jax.Array, jax.Array]:
     """K and V page arrays [L, num_pages, kv_heads, page_size, head_dim].
 
@@ -144,6 +162,12 @@ def init_cache(
     decode attention is DMA-descriptor-bound, not bandwidth-bound: see
     ops/pallas/paged_attention_v3.py.) ``num_pages`` must already include
     the trash page (index 0).
+
+    ``kv_dtype="fp8"`` allocates QuantPools instead (ops/quant.py): fp8
+    values + bf16 per-page/head scales — half the HBM footprint and half
+    the decode read traffic; every writer quantizes, every reader
+    dequantizes, and the tolerance goldens (tests/test_quant_goldens.py)
+    bound the numeric drift.
     """
     from dynamo_tpu.ops.attention import pool_head_dim
 
@@ -166,7 +190,33 @@ def init_cache(
             "to disable)", spec.head_dim, pool_d, mib,
             pool_d / spec.head_dim,
         )
+    if kv_dtype == "fp8":
+        # scale per (layer, page, kv_head): the append-time amax rides
+        # the same page granularity every kernel DMAs at
+        return init_quant_pool(shape, 3), init_quant_pool(shape, 3)
     return jnp.zeros(shape, dtype), jnp.zeros(shape, dtype)
+
+
+def _set_page_tiles(
+    pool, li: int, safe_pg: jax.Array, arr: jax.Array, page_size: int,
+    valid_tok: jax.Array,  # [n_tiles, page] bool (True = real token)
+):
+    """Prefill page write for either pool form: plain pools scatter the
+    tiles as-is; QuantPools zero the padded rows, take one amax scale per
+    (page, head), and scatter fp8 values + scales. ``valid_tok`` marks
+    real tokens — garbage in a partial tail page must not inflate the
+    page scale (it is masked from attention and requantized over as
+    decode appends land)."""
+    tiles = page_tiles(arr, page_size, pool.shape[-1])
+    if is_quant(pool):
+        vals, s = quant_page_tiles(
+            tiles, valid_tok[:, None, :, None], (2, 3)
+        )
+        return QuantPool(
+            pool.vals.at[li, safe_pg].set(vals),
+            pool.scale.at[li, safe_pg].set(s),
+        )
+    return pool.at[li, safe_pg].set(tiles)
 
 
 # ---------------------------------------------------------------- layers
@@ -357,9 +407,7 @@ def prefill_forward_impl(
     safe_pg = jnp.where(
         page_starts < start_pos + num_tokens, pg_idx_raw, TRASH_PAGE
     )
-
-    def to_tiles(arr):  # [T, KH, D] -> [n_pg, KH, page, pool_d]
-        return page_tiles(arr, page_size, k_pages.shape[-1])
+    valid_tok = (idx < num_tokens).reshape(n_pg, page_size)
 
     x = params["embed"][tokens]  # [T, d]
     if mm_embeds is not None:
@@ -370,11 +418,25 @@ def prefill_forward_impl(
     for li, lp in enumerate(params["layers"]):
         h = rms_norm(x, lp["attn_norm"], spec.rms_eps)
         q, k, v = _attn_qkv(spec, lp, h, positions)
-        k_pages = k_pages.at[li, safe_pg].set(to_tiles(k))
-        v_pages = v_pages.at[li, safe_pg].set(to_tiles(v))
-        # [max_ctx, kvh, D] — sliced back to the model dim when padded
-        k_ctx = gather_pages(k_pages[li], block_table)[..., :spec.head_dim]
-        v_ctx = gather_pages(v_pages[li], block_table)[..., :spec.head_dim]
+        k_pages = _set_page_tiles(k_pages, li, safe_pg, k, page_size,
+                                  valid_tok)
+        v_pages = _set_page_tiles(v_pages, li, safe_pg, v, page_size,
+                                  valid_tok)
+        # [max_ctx, kvh, D] — sliced back to the model dim when padded,
+        # dequantized when the pool is fp8
+        k_ctx = gather_ctx(k_pages, li, block_table, spec.head_dim)
+        v_ctx = gather_ctx(v_pages, li, block_table, spec.head_dim)
+        if is_quant(k_pages):
+            # overlay the EXACT in-flight rows over the quantized
+            # read-back (the XLA mirror of the fused kernel's analytic
+            # new-token merge): this prefill's own tokens attend to each
+            # other at full precision; only the cached prefix pays fp8
+            k_ctx = k_ctx.at[positions].set(
+                k.astype(k_ctx.dtype), mode="drop"
+            )
+            v_ctx = v_ctx.at[positions].set(
+                v.astype(v_ctx.dtype), mode="drop"
+            )
         attn = causal_attention(
             q, k_ctx, v_ctx, positions, kv_len,
             window=spec.attn_window(li), sinks=lp.get("sinks"),
@@ -444,9 +506,9 @@ def prefill_forward_batch_impl(
     )
     valid_pg = page_starts < (start_pos + num_tokens)[:, None]
     safe_pg = jnp.where(valid_pg, pg_idx_raw, TRASH_PAGE).reshape(N * n_pg)
-
-    def to_tiles(arr):  # [N, T, KH, D] -> [N*n_pg, KH, page, pool_d]
-        return page_tiles(arr, page_size, k_pages.shape[-1])
+    valid_tok = (idx[None, :] < num_tokens[:, None]).reshape(
+        N * n_pg, page_size
+    )
 
     x = params["embed"][tokens]  # [N, T, d]
     kv_len = start_pos + num_tokens  # [N]
@@ -464,19 +526,30 @@ def prefill_forward_batch_impl(
         v = v.reshape(N, T, spec.num_kv_heads, spec.head_dim)
         q = jax.vmap(lambda a, p: rope_spec(spec, a, p))(q, positions)
         k = jax.vmap(lambda a, p: rope_spec(spec, a, p))(k, positions)
-        k_pages = k_pages.at[li, safe_pg].set(to_tiles(k))
-        v_pages = v_pages.at[li, safe_pg].set(to_tiles(v))
+        k_pages = _set_page_tiles(k_pages, li, safe_pg, k, page_size,
+                                  valid_tok)
+        v_pages = _set_page_tiles(v_pages, li, safe_pg, v, page_size,
+                                  valid_tok)
 
-        def one_attn(q_i, bt_i, pos_i, kvl_i, kp=k_pages, vp=v_pages, li=li,
-                     lp=lp):
-            k_ctx = gather_pages(kp[li], bt_i)[..., :spec.head_dim]
-            v_ctx = gather_pages(vp[li], bt_i)[..., :spec.head_dim]
+        def one_attn(q_i, bt_i, pos_i, kvl_i, k_i, v_i, kp=k_pages,
+                     vp=v_pages, li=li, lp=lp):
+            k_ctx = gather_ctx(kp, li, bt_i, spec.head_dim)
+            v_ctx = gather_ctx(vp, li, bt_i, spec.head_dim)
+            if is_quant(kp):
+                # exact in-flight rows over the quantized read-back
+                # (see prefill_forward_impl)
+                k_ctx = k_ctx.at[pos_i].set(
+                    k_i.astype(k_ctx.dtype), mode="drop"
+                )
+                v_ctx = v_ctx.at[pos_i].set(
+                    v_i.astype(v_ctx.dtype), mode="drop"
+                )
             return causal_attention(
                 q_i, k_ctx, v_ctx, pos_i, kvl_i,
                 window=spec.attn_window(li), sinks=lp.get("sinks"),
             )
 
-        attn = jax.vmap(one_attn)(q, block_tables, positions, kv_len)
+        attn = jax.vmap(one_attn)(q, block_tables, positions, kv_len, k, v)
         x = x + _o_proj(spec, lp, attn.reshape(N, T, -1))
         h = rms_norm(x, lp["mlp_norm"], spec.rms_eps)
         f, d = _ffn_counted(spec, lp, h.reshape(N * T, -1))
@@ -526,9 +599,7 @@ def prefill_forward_ring_impl(
     page_starts = jnp.arange(n_pg) * page_size
     pg_idx_raw = block_table[page_starts // page_size]
     safe_pg = jnp.where(page_starts < num_tokens, pg_idx_raw, TRASH_PAGE)
-
-    def to_tiles(arr):  # [T, KH, D] -> [n_pg, KH, page, pool_d]
-        return page_tiles(arr, page_size, k_pages.shape[-1])
+    valid_tok = (idx < num_tokens).reshape(n_pg, page_size)
 
     sp_spec = NamedSharding(mesh, P("sp", None))
     x = params["embed"][tokens]
@@ -538,8 +609,10 @@ def prefill_forward_ring_impl(
     for li, lp in enumerate(params["layers"]):
         h = rms_norm(x, lp["attn_norm"], spec.rms_eps)
         q, k, v = _attn_qkv(spec, lp, h, idx)
-        k_pages = k_pages.at[li, safe_pg].set(to_tiles(k))
-        v_pages = v_pages.at[li, safe_pg].set(to_tiles(v))
+        k_pages = _set_page_tiles(k_pages, li, safe_pg, k, page_size,
+                                  valid_tok)
+        v_pages = _set_page_tiles(v_pages, li, safe_pg, v, page_size,
+                                  valid_tok)
         attn = ring_attention(q, k, v, mesh=mesh)
         x = x + _o_proj(
             spec, lp, attn.reshape(T, spec.num_heads * spec.head_dim)
@@ -608,8 +681,10 @@ def verify_forward_impl(
     pg_idx_raw = jnp.take_along_axis(
         block_tables, positions // page_size, axis=1
     )
-    safe_pg = jnp.where(valid, pg_idx_raw, TRASH_PAGE).reshape(N * W)
-    offs = (positions % page_size).reshape(N * W)
+    safe_pg2 = jnp.where(valid, pg_idx_raw, TRASH_PAGE)  # [N, W]
+    offs2 = positions % page_size
+    safe_pg = safe_pg2.reshape(N * W)
+    offs = offs2.reshape(N * W)
 
     x = params["embed"][tokens]  # [N, W, d]
     kv_len = start_pos + num_tokens  # [N]
@@ -627,23 +702,44 @@ def verify_forward_impl(
         v = v.reshape(N, W, spec.num_kv_heads, spec.head_dim)
         q = jax.vmap(lambda a, p: rope_spec(spec, a, p))(q, positions)
         k = jax.vmap(lambda a, p: rope_spec(spec, a, p))(k, positions)
-        k_pages, v_pages = write_new_kv(
-            k_pages, v_pages,
-            k.reshape(N * W, spec.num_kv_heads, spec.head_dim),
-            v.reshape(N * W, spec.num_kv_heads, spec.head_dim),
-            safe_pg, offs, layer=li, mesh=mesh,
-        )
+        if is_quant(k_pages):
+            # quantized append is a page-granular RMW: a verify's W
+            # tokens often share a page, so land them one POSITION at a
+            # time (static W loop, distinct pages within each call) —
+            # the one-scatter fast path would lose same-page siblings
+            for w in range(W):
+                k_pages, v_pages = write_new_kv(
+                    k_pages, v_pages, k[:, w], v[:, w],
+                    safe_pg2[:, w], offs2[:, w], layer=li, mesh=mesh,
+                )
+        else:
+            k_pages, v_pages = write_new_kv(
+                k_pages, v_pages,
+                k.reshape(N * W, spec.num_kv_heads, spec.head_dim),
+                v.reshape(N * W, spec.num_kv_heads, spec.head_dim),
+                safe_pg, offs, layer=li, mesh=mesh,
+            )
 
-        def one_attn(q_i, bt_i, pos_i, kvl_i, kp=k_pages, vp=v_pages,
-                     li=li, lp=lp):
-            k_ctx = gather_pages(kp[li], bt_i)[..., :spec.head_dim]
-            v_ctx = gather_pages(vp[li], bt_i)[..., :spec.head_dim]
+        def one_attn(q_i, bt_i, pos_i, kvl_i, k_i, v_i, kp=k_pages,
+                     vp=v_pages, li=li, lp=lp):
+            k_ctx = gather_ctx(kp, li, bt_i, spec.head_dim)
+            v_ctx = gather_ctx(vp, li, bt_i, spec.head_dim)
+            if is_quant(kp):
+                # exact verify-window rows over the quantized read-back:
+                # the fed token + drafts judge each other at full
+                # precision, like the fused decode path's analytic merge
+                k_ctx = k_ctx.at[pos_i].set(
+                    k_i.astype(k_ctx.dtype), mode="drop"
+                )
+                v_ctx = v_ctx.at[pos_i].set(
+                    v_i.astype(v_ctx.dtype), mode="drop"
+                )
             return causal_attention(
                 q_i, k_ctx, v_ctx, pos_i, kvl_i,
                 window=spec.attn_window(li), sinks=lp.get("sinks"),
             )
 
-        attn = jax.vmap(one_attn)(q, block_tables, positions, kv_len)
+        attn = jax.vmap(one_attn)(q, block_tables, positions, kv_len, k, v)
         x = x + _o_proj(spec, lp, attn.reshape(N, W, -1))
         h = rms_norm(x, lp["mlp_norm"], spec.rms_eps)
         f, d = _ffn_counted(spec, lp, h.reshape(N * W, -1))
@@ -822,7 +918,14 @@ decode_steps = jax.jit(
 
 
 def _extract_kv_pages_impl(k_pages, v_pages, page_ids):
-    """Gather whole pages for transfer: -> [L, n, kvh, page, D] x2."""
+    """Gather whole pages for transfer: -> [L, n, kvh, page, D] x2.
+
+    QuantPool pools pack fp8 values + bf16 scales into ONE uint8 payload
+    per (layer, page) (ops/quant.pack_pages): KVBM tiers and the disagg
+    wire then carry exactly those bytes — half the footprint, no silent
+    upcast possible, and onboard re-materializes fp8 by bitcast."""
+    if is_quant(k_pages):
+        return pack_pages(k_pages, page_ids), pack_pages(v_pages, page_ids)
     return k_pages[:, page_ids], v_pages[:, page_ids]
 
 
@@ -831,7 +934,26 @@ extract_kv_pages = jax.jit(_extract_kv_pages_impl)
 
 def _insert_kv_pages_impl(k_pages, v_pages, page_ids, k_blocks, v_blocks):
     """Scatter transferred pages into the local pools (donated).
-    Blocks are page-major stacks [L, n, kvh, page, D]."""
+    Blocks are page-major stacks [L, n, kvh, page, D] — or packed uint8
+    [L, n, X] payloads when the pool is quantized (both engines of a
+    disagg pair must run the same kv_dtype)."""
+    if is_quant(k_pages):
+        kv_, ks_ = unpack_pages(
+            k_blocks, k_pages.vals.shape[2:], k_pages.scale.shape[2:]
+        )
+        vv_, vs_ = unpack_pages(
+            v_blocks, v_pages.vals.shape[2:], v_pages.scale.shape[2:]
+        )
+        return (
+            QuantPool(
+                k_pages.vals.at[:, page_ids].set(kv_),
+                k_pages.scale.at[:, page_ids].set(ks_),
+            ),
+            QuantPool(
+                v_pages.vals.at[:, page_ids].set(vv_),
+                v_pages.scale.at[:, page_ids].set(vs_),
+            ),
+        )
     return (
         k_pages.at[:, page_ids].set(k_blocks),
         v_pages.at[:, page_ids].set(v_blocks),

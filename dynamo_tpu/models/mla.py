@@ -47,6 +47,14 @@ from dynamo_tpu.engine.config import ModelSpec
 from dynamo_tpu.models.llama import (
     TRASH_PAGE, _logits, _replicate, rms_norm, rope_spec,
 )
+from dynamo_tpu.ops.quant import (
+    QuantPool,
+    gather_dequant_rows,
+    init_quant_pool,
+    is_quant,
+    quant_append_rows,
+    quant_page_tiles,
+)
 
 Params = dict[str, Any]
 
@@ -135,14 +143,45 @@ def init_params(spec: ModelSpec, key: jax.Array) -> Params:
 
 
 def init_cache(
-    spec: ModelSpec, num_pages: int, page_size: int, dtype=None
+    spec: ModelSpec, num_pages: int, page_size: int, dtype=None,
+    kv_dtype: str = "bf16",
 ) -> jax.Array:
     """Latent cache [L, num_pages, page_size, d_c + d_r] (page 0 = trash).
-    ONE array — MLA has no separate K and V pools."""
+    ONE array — MLA has no separate K and V pools. ``kv_dtype="fp8"``
+    allocates a QuantPool (ops/quant.py) with one bf16 scale per
+    (layer, page, ROW): with no head axis the row is the natural scale
+    unit, appends never requantize their neighbors, and the finer
+    granularity keeps the absorbed-attention drift inside the tolerance
+    goldens (a single per-page scale measured ~2x the greedy-token
+    disagreement on CPU)."""
     dtype = dtype or jnp.dtype(spec.dtype)
-    return jnp.zeros(
-        (spec.num_layers, num_pages, page_size, latent_dim(spec)), dtype
-    )
+    shape = (spec.num_layers, num_pages, page_size, latent_dim(spec))
+    if kv_dtype == "fp8":
+        return init_quant_pool(shape, 3)
+    return jnp.zeros(shape, dtype)
+
+
+def _set_latent_tiles(
+    cache, li: int, safe_pg: jax.Array, tiles: jax.Array,
+    valid_tok: jax.Array,  # [n_tiles, page] bool
+):
+    """Prefill latent page write for either cache form (the MLA analogue
+    of llama._set_page_tiles; one scale per row, amax over the latent
+    dim)."""
+    if is_quant(cache):
+        vals, s = quant_page_tiles(tiles, valid_tok[:, :, None], (2,))
+        return QuantPool(
+            cache.vals.at[li, safe_pg].set(vals),
+            cache.scale.at[li, safe_pg].set(s),
+        )
+    return cache.at[li, safe_pg].set(tiles.astype(cache.dtype))
+
+
+def _gather_rows_any(cache, li: int, block_table: jax.Array) -> jax.Array:
+    """[num_pages, page, D] + [P] -> [P*page, D], dequantized when fp8."""
+    if is_quant(cache):
+        return gather_dequant_rows(cache.layer(li), block_table)
+    return _gather_rows(cache[li], block_table)
 
 
 def param_shardings(spec: ModelSpec, mesh: Mesh) -> Params:
@@ -201,13 +240,15 @@ def param_shardings(spec: ModelSpec, mesh: Mesh) -> Params:
     return out
 
 
-def cache_shardings(mesh: Mesh) -> NamedSharding:
+def cache_shardings(mesh: Mesh, kv_dtype: str = "bf16"):
     """Latent cache [L, pages, page, d_c + d_r]: REPLICATED across the
     mesh. There is no head axis to split — the latent row is shared by
     every head — and at ~14x compression vs GQA the duplication is the
     cheap side of the trade (each rank attends against its local copy
-    with zero gather collectives in the decode hot loop)."""
-    return NamedSharding(mesh, P())
+    with zero gather collectives in the decode hot loop). Quantized
+    caches replicate both leaves."""
+    s = NamedSharding(mesh, P())
+    return QuantPool(s, s) if kv_dtype == "fp8" else s
 
 
 # --------------------------------------------------------------- pieces
@@ -351,6 +392,7 @@ def prefill_forward_impl(
     safe_pg = jnp.where(
         page_starts < start_pos + num_tokens, pg_idx, TRASH_PAGE
     )
+    valid_tok = (idx < num_tokens).reshape(n_pg, page_size)
     x = params["embed"][tokens]
     kv_len = start_pos + num_tokens
     max_ctx = block_table.shape[0] * page_size
@@ -359,10 +401,17 @@ def prefill_forward_impl(
         h = rms_norm(x, lp["attn_norm"], spec.rms_eps)
         q_nope, q_rope = _q_heads(spec, lp, h, positions)
         new_rows = _latent_row(spec, lp, h, positions)
-        cache = cache.at[li, safe_pg].set(
-            new_rows.reshape(n_pg, page_size, -1).astype(cache.dtype)
+        cache = _set_latent_tiles(
+            cache, li, safe_pg,
+            new_rows.reshape(n_pg, page_size, -1), valid_tok,
         )
-        rows = _gather_rows(cache[li], block_table)  # [max_ctx, D]
+        rows = _gather_rows_any(cache, li, block_table)  # [max_ctx, D]
+        if is_quant(cache):
+            # exact in-flight rows over the quantized read-back (the XLA
+            # mirror of the fused GQA kernel's analytic new-token merge)
+            rows = rows.at[positions].set(
+                new_rows.astype(rows.dtype), mode="drop"
+            )
         mask = (ctx_pos[None, :] <= positions[:, None]) & (
             ctx_pos[None, :] < kv_len
         )
@@ -421,19 +470,25 @@ def prefill_forward_batch_impl(
         new_rows = jax.vmap(
             lambda hh, pos: _latent_row(spec, lp, hh, pos)
         )(h, positions)  # [N, T, D]
-        cache = cache.at[li, safe_pg].set(
-            new_rows.reshape(N * n_pg, page_size, -1).astype(cache.dtype)
+        cache = _set_latent_tiles(
+            cache, li, safe_pg,
+            new_rows.reshape(N * n_pg, page_size, -1),
+            (idx[None, :] < num_tokens[:, None]).reshape(
+                N * n_pg, page_size
+            ),
         )
 
-        def one_attn(qn, qr, bt, pos, kvl, cache_l=cache[li], lp=lp):
-            rows = _gather_rows(cache_l, bt)  # [max_ctx, D]
+        def one_attn(qn, qr, bt, pos, kvl, nr, cache=cache, li=li, lp=lp):
+            rows = _gather_rows_any(cache, li, bt)  # [max_ctx, D]
+            if is_quant(cache):
+                rows = rows.at[pos].set(nr.astype(rows.dtype), mode="drop")
             mask = (ctx_pos[None, :] <= pos[:, None]) & (
                 ctx_pos[None, :] < kvl
             )
             return _absorbed_attention(spec, lp, qn, qr, rows, mask)
 
         attn = jax.vmap(one_attn)(
-            q_nope, q_rope, block_tables, positions, kv_len
+            q_nope, q_rope, block_tables, positions, kv_len, new_rows
         )  # [N, T, H, dv]
         x = x + attn.reshape(N, T, -1).astype(x.dtype) @ lp["wo"]
         hh = rms_norm(x, lp["mlp_norm"], spec.rms_eps)
@@ -489,19 +544,30 @@ def verify_forward_impl(
         new_rows = jax.vmap(
             lambda hh, pos: _latent_row(spec, lp, hh, pos)
         )(h, positions)  # [N, W, D]
-        cache = cache.at[li, safe_pg, offs].set(
-            new_rows.reshape(N * W, -1).astype(cache.dtype)
-        )
+        if is_quant(cache):
+            # per-row scales make this a plain scatter: every (page,
+            # offset) slot owns its scale, so same-page siblings never
+            # clash (unlike the GQA page RMW)
+            cache = quant_append_rows(
+                cache, new_rows.reshape(N * W, -1), safe_pg, offs, li
+            )
+        else:
+            cache = cache.at[li, safe_pg, offs].set(
+                new_rows.reshape(N * W, -1).astype(cache.dtype)
+            )
 
-        def one_attn(qn, qr, bt, pos, kvl, cache_l=cache[li], lp=lp):
-            rows = _gather_rows(cache_l, bt)  # [max_ctx, D]
+        def one_attn(qn, qr, bt, pos, kvl, nr, cache=cache, li=li, lp=lp):
+            rows = _gather_rows_any(cache, li, bt)  # [max_ctx, D]
+            if is_quant(cache):
+                # exact verify-window rows (llama mirror)
+                rows = rows.at[pos].set(nr.astype(rows.dtype), mode="drop")
             mask = (ctx_pos[None, :] <= pos[:, None]) & (
                 ctx_pos[None, :] < kvl
             )
             return _absorbed_attention(spec, lp, qn, qr, rows, mask)
 
         attn = jax.vmap(one_attn)(
-            q_nope, q_rope, block_tables, positions, kv_len
+            q_nope, q_rope, block_tables, positions, kv_len, new_rows
         )  # [N, W, H, dv]
         x = x + attn.reshape(N, W, -1).astype(x.dtype) @ lp["wo"]
         hh = rms_norm(x, lp["mlp_norm"], spec.rms_eps)
@@ -548,12 +614,24 @@ def decode_forward_impl(
         h = rms_norm(x, lp["attn_norm"], spec.rms_eps)
         q_nope, q_rope = _q_heads(spec, lp, h, positions)
         new_rows = _latent_row(spec, lp, h, positions)  # [B, D]
-        cache = cache.at[li, safe_page, offset].set(
-            new_rows.astype(cache.dtype)
-        )
-        rows = jax.vmap(lambda bt: _gather_rows(cache[li], bt))(
-            block_tables
-        )  # [B, max_ctx, D]
+        if is_quant(cache):
+            cache = quant_append_rows(
+                cache, new_rows, safe_page, offset, li
+            )
+        else:
+            cache = cache.at[li, safe_page, offset].set(
+                new_rows.astype(cache.dtype)
+            )
+        rows = jax.vmap(
+            lambda bt, cache=cache, li=li: _gather_rows_any(cache, li, bt)
+        )(block_tables)  # [B, max_ctx, D]
+        if is_quant(cache):
+            # exact new-token overlay: the decode query's own latent row
+            # (its strongest attention target) never pays fp8 error
+            max_ctx_i = rows.shape[1]
+            rows = rows.at[
+                jnp.arange(B), jnp.clip(positions, 0, max_ctx_i - 1)
+            ].set(new_rows.astype(rows.dtype))
         mask = ctx_pos[None, :] < seq_lens[:, None]  # [B, max_ctx]
         attn = jax.vmap(
             lambda qn, qr, r, m: _absorbed_attention(

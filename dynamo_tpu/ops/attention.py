@@ -120,6 +120,22 @@ def gather_pages(
     return toks.transpose(0, 2, 1, 3).reshape(P * page, H, D)
 
 
+def gather_ctx(pool, li: int, block_table: jax.Array, head_dim: int):
+    """One layer's context for a sequence, pool-form-agnostic: plain
+    arrays gather in the pool dtype; QuantPool (ops/quant.py) gathers
+    fp8 pages and dequantizes with the per-page/head scales. Sliced back
+    to the MODEL head dim when the pool is lane-padded. The single
+    gather used by every XLA attention site (prefill/verify/CPU decode),
+    so the fp8 gather/dequant path can't be missed by one of them."""
+    from dynamo_tpu.ops.quant import gather_dequant_pages, is_quant
+
+    if is_quant(pool):
+        return gather_dequant_pages(pool.layer(li), block_table)[
+            ..., :head_dim
+        ]
+    return gather_pages(pool[li], block_table)[..., :head_dim]
+
+
 def causal_attention(
     q: jax.Array,  # [T, heads, D]
     k: jax.Array,  # [S, kv_heads, D]
@@ -175,6 +191,7 @@ def paged_decode_attention(
     window: int = 0,
     sinks: jax.Array | None = None,  # [H]
     scale: float | None = None,  # softmax scale (default 1/sqrt(D))
+    new_kv: tuple | None = None,  # exact new-token rows (quant pools)
 ) -> jax.Array:
     """Decode-step attention: each query attends to its full paged context.
 
@@ -183,15 +200,39 @@ def paged_decode_attention(
     computes the same thing without materializing the gather. ``scale``
     overrides the 1/sqrt(q.shape[-1]) default — needed when q is
     zero-padded to a wider pool head dim (pool_head_dim) and the true
-    model D differs from the padded width.
+    model D differs from the padded width. ``k_pages``/``v_pages`` may be
+    QuantPool LAYER slices (ops/quant.py): the gather then dequantizes —
+    this is the XLA gather/dequant path for CPU and DYNAMO_PALLAS=0.
+
+    ``new_kv=(k_new, v_new)`` overlays the EXACT (unquantized) new-token
+    rows at position ``seq_lens - 1`` after the gather — the XLA mirror
+    of the fused kernel's analytic new-token merge: the decode query's
+    strongest key/value never pays quantization error. Quantized pools
+    only (the bf16 write is already exact).
     """
+    from dynamo_tpu.ops.quant import gather_dequant_pages, is_quant
+
     B, H, D = q.shape
     page_size = k_pages.shape[2]
     P = block_tables.shape[1]
     max_ctx = P * page_size
 
-    k = jax.vmap(lambda bt: gather_pages(k_pages, bt))(block_tables)
-    v = jax.vmap(lambda bt: gather_pages(v_pages, bt))(block_tables)
+    if is_quant(k_pages):
+        k = jax.vmap(lambda bt: gather_dequant_pages(k_pages, bt))(
+            block_tables
+        )
+        v = jax.vmap(lambda bt: gather_dequant_pages(v_pages, bt))(
+            block_tables
+        )
+        if new_kv is not None:
+            kn, vn = new_kv  # [B, KH, D] exact post-rope rows
+            rows = jnp.arange(B)
+            pos = jnp.clip(seq_lens - 1, 0, max_ctx - 1)
+            k = k.at[rows, pos].set(kn.astype(k.dtype))
+            v = v.at[rows, pos].set(vn.astype(v.dtype))
+    else:
+        k = jax.vmap(lambda bt: gather_pages(k_pages, bt))(block_tables)
+        v = jax.vmap(lambda bt: gather_pages(v_pages, bt))(block_tables)
     KH = k.shape[2]
     n_rep = H // KH
     k = repeat_kv(k, n_rep)  # [B, max_ctx, H, D]
@@ -302,14 +343,25 @@ def decode_update_attention(
 
     Returns ``(attn [B, H, D], k_pages, v_pages)`` — pools updated in
     place on the fused path (input/output aliasing + donation at the
-    model jit boundary)."""
+    model jit boundary). QuantPool pools (ops/quant.py, kv_dtype=fp8)
+    ride the same slots: the fused kernel dequantizes in-register and
+    quantizes the append in its staged RMW; the fallback composition is
+    the quantized scatter (write_new_kv) + gather/dequant attention."""
+    from dynamo_tpu.ops.quant import is_quant
+
     D = q.shape[-1]
     pool_d = k_pages.shape[-1]
     on_tpu = jax.default_backend() == "tpu"
+    quantized = is_quant(k_pages)
     fused_ok = (
         use_pallas()
         and use_fused_decode()
         and (not on_tpu or lane_aligned(pool_d))
+        # quantized pools under tp shard_map are not plumbed yet: the
+        # scale leaves would need their own specs — take the XLA path,
+        # which GSPMD partitions like any other gather/scatter
+        and not (quantized and mesh is not None
+                 and mesh.shape.get("tp", 1) > 1)
     )
     if fused_ok:
         from jax.sharding import PartitionSpec as P
@@ -377,9 +429,15 @@ def decode_update_attention(
         k_pages, v_pages, k_new, v_new, dst_page, dst_off,
         layer=layer, mesh=mesh,
     )
+    k_l = k_pages.layer(layer) if quantized else k_pages[layer]
+    v_l = v_pages.layer(layer) if quantized else v_pages[layer]
     attn = paged_decode_attention_auto(
-        q, k_pages[layer], v_pages[layer], block_tables, seq_lens,
+        q, k_l, v_l, block_tables, seq_lens,
         mesh=mesh, window=window, sinks=sinks,
+        # exact new-token overlay (quant only): the XLA mirror of the
+        # fused kernel's analytic merge — on the gather/dequant path the
+        # freshly-written row would otherwise read back quantized
+        new_kv=(k_new, v_new) if quantized else None,
     )
     return attn, k_pages, v_pages
 
@@ -395,6 +453,7 @@ def paged_decode_attention_auto(
     window: int = 0,
     sinks: jax.Array | None = None,
     _scale: float | None = None,  # internal: set by the pad recursion
+    new_kv: tuple | None = None,  # exact new-token rows (quant pools)
 ) -> jax.Array:
     """Dispatch: Pallas kernel on TPU, pure-JAX gather elsewhere.
 
@@ -413,16 +472,48 @@ def paged_decode_attention_auto(
     width — the padded dims multiply the pool's zero columns, so every
     score is unchanged — the softmax scale is pinned to the TRUE model
     dim, and the padded output columns are sliced off.
+
+    ``k_pages``/``v_pages`` may be QuantPool LAYER slices: the Pallas
+    route runs v3 with in-kernel dequant; the pure-JAX route gathers and
+    dequantizes (paged_decode_attention).
     """
+    from dynamo_tpu.ops.quant import is_quant
+
     D = q.shape[-1]
     pool_d = k_pages.shape[-1]
     if pool_d != D:
+        if new_kv is not None:
+            new_kv = tuple(pad_heads(x, pool_d) for x in new_kv)
         out = paged_decode_attention_auto(
             pad_heads(q, pool_d), k_pages, v_pages, block_tables, seq_lens,
             mesh, window=window, sinks=sinks, _scale=1.0 / float(D) ** 0.5,
+            new_kv=new_kv,
         )
         return out[..., :D]
     scale = _scale
+    if is_quant(k_pages) and use_pallas():
+        # quantized v3 (interpret off-TPU). Under a tp mesh, or on a real
+        # TPU with a lane-misaligned pool, the pure gather/dequant path
+        # below is the fallback — GSPMD partitions it without shard_map.
+        # The kernel reads the freshly-written row back at fp8 (it has no
+        # overlay input) — tolerance-level difference vs the fused path.
+        on_tpu = jax.default_backend() == "tpu"
+        tp = mesh is not None and mesh.shape.get("tp", 1) > 1
+        if not tp and (not on_tpu or lane_aligned(pool_d)):
+            from dynamo_tpu.ops.pallas.paged_attention_v3 import (
+                paged_decode_attention_v3,
+            )
+
+            return paged_decode_attention_v3(
+                q, k_pages.vals, v_pages.vals, block_tables, seq_lens,
+                window=window, sinks=sinks, scale=scale,
+                interpret=not on_tpu,
+                k_scale=k_pages.scale, v_scale=v_pages.scale,
+            )
+        return paged_decode_attention(
+            q, k_pages, v_pages, block_tables, seq_lens,
+            window=window, sinks=sinks, scale=scale, new_kv=new_kv,
+        )
     if use_pallas():
         from jax.sharding import PartitionSpec as P
 
@@ -472,5 +563,5 @@ def paged_decode_attention_auto(
         return kernel(*args)
     return paged_decode_attention(
         q, k_pages, v_pages, block_tables, seq_lens,
-        window=window, sinks=sinks, scale=scale,
+        window=window, sinks=sinks, scale=scale, new_kv=new_kv,
     )

@@ -44,6 +44,15 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from dynamo_tpu.ops.pallas.paged_attention_v3 import NEG_INF, _window_pages
+from dynamo_tpu.ops.quant import (
+    FP8_MAX,
+    QuantPool,
+    append_scale,
+    is_quant,
+    kt_scales_f,
+    quant_values,
+    rescale_factor,
+)
 
 
 def _fused_decode_kernel(
@@ -58,20 +67,32 @@ def _fused_decode_kernel(
     v_new_ref,  # [1, KH, D] VMEM
     k_pages_ref,  # [L, num_pages, KH, page, D] ANY/HBM (aliased out)
     v_pages_ref,
-    *rest,  # [sinks_ref,] o_ref, k_out_ref, v_out_ref, kv_buf, sems,
-    # stage_k, stage_v, rmw_sems
+    *rest,  # [kt_s, vt_s, old_ks, old_vs,] [sinks,] o_ref, k_out_ref,
+    # v_out_ref, [nks_ref, nvs_ref,] kv_buf, sems, stage_k, stage_v,
+    # rmw_sems
     layer: int,
     page_size: int,
     pages_per_seq: int,
     window_pages: int,
     window: int = 0,  # sliding window in tokens (0 = full attention)
     has_sinks: bool = False,
+    quantized: bool = False,  # fp8 pages + per-page/head scales
 ):
+    i = 0
+    if quantized:
+        # host-pregathered bf16 scales: per table page [1, P, KH] and the
+        # destination page's current scales [1, KH] — all indexing the
+        # kernel does on them is static (window chunk / whole block)
+        kt_s_ref, vt_s_ref, old_ks_ref, old_vs_ref = rest[:4]
+        i = 4
     if has_sinks:
-        sinks_ref, o_ref, k_out_ref, v_out_ref = rest[:4]
+        sinks_ref = rest[i]
+        i += 1
     else:
         sinks_ref = None
-        o_ref, k_out_ref, v_out_ref = rest[:3]
+    o_ref, k_out_ref, v_out_ref = rest[i: i + 3]
+    if quantized:
+        nks_ref, nvs_ref = rest[i + 3: i + 5]  # [1, KH] grown scales out
     kv_buf, sems, stage_k, stage_v, rmw_sems = rest[-5:]
     b = pl.program_id(0)
     nb = pl.num_programs(0)
@@ -174,8 +195,22 @@ def _fused_decode_kernel(
                 issue(nxt, b + 1, 0)
 
         wait(buf, b, c)
-        kf = kv_buf[buf, 0].reshape(Nw, D).astype(jnp.float32)
-        vf = kv_buf[buf, 1].reshape(Nw, D).astype(jnp.float32)
+        if quantized:
+            # upcast + dequant in-register BEFORE the flash chunk: the
+            # window's pages crossed HBM at 1 byte/elem; the f32 form
+            # only ever exists in VMEM. Scales index statically by the
+            # window chunk (host pre-gathered them by block table).
+            lo = c * Pw
+            hi = min(P, lo + Pw)
+            sk = kt_scales_f(kt_s_ref, lo, hi, Pw)  # [Pw, KH] f32
+            sv = kt_scales_f(vt_s_ref, lo, hi, Pw)
+            kf = kv_buf[buf, 0].astype(jnp.float32) * sk[:, :, None, None]
+            vf = kv_buf[buf, 1].astype(jnp.float32) * sv[:, :, None, None]
+            kf = kf.reshape(Nw, D)
+            vf = vf.reshape(Nw, D)
+        else:
+            kf = kv_buf[buf, 0].reshape(Nw, D).astype(jnp.float32)
+            vf = kv_buf[buf, 1].reshape(Nw, D).astype(jnp.float32)
         # the pool does NOT yet hold the new token, so every fetched
         # chunk can be fully masked (seq_len == 1) — sanitize V
         # unconditionally: garbage only ever multiplies 0-probability
@@ -237,8 +272,40 @@ def _fused_decode_kernel(
     row = (
         jax.lax.broadcasted_iota(jnp.int32, (1, page, 1), 1) == off
     )  # [1, page, 1]
-    stage_k[...] = jnp.where(row, k_new_ref[0][:, None, :], stage_k[...])
-    stage_v[...] = jnp.where(row, v_new_ref[0][:, None, :], stage_v[...])
+    if quantized:
+        # quantized staged RMW: the whole destination page is already in
+        # VMEM, so growing the scale costs one in-register requantize —
+        # new_scale = max(old, amax(row)/FP8_MAX) per head (rounded to
+        # the stored bf16), existing fp8 values re-encode by old/new,
+        # the new row quantizes under the grown scale, and the page DMAs
+        # back at fp8 width. Grown scales leave via a tiny [1, KH]
+        # output; the host scatters them into the scale pool (XLA) right
+        # after the pallas_call, inside the same jit.
+        kn = k_new_ref[0].astype(jnp.float32)  # [KH, D]
+        vn_r = v_new_ref[0].astype(jnp.float32)
+        oks = old_ks_ref[0].astype(jnp.float32)  # [KH]
+        ovs = old_vs_ref[0].astype(jnp.float32)
+        nks = append_scale(oks, kn)
+        nvs = append_scale(ovs, vn_r)
+        page_k = stage_k[...].astype(jnp.float32) * rescale_factor(
+            oks, nks
+        )[:, None, None]
+        page_v = stage_v[...].astype(jnp.float32) * rescale_factor(
+            ovs, nvs
+        )[:, None, None]
+        row_k = quant_values(kn, nks[:, None])[:, None, :]
+        row_v = quant_values(vn_r, nvs[:, None])[:, None, :]
+        stage_k[...] = jnp.clip(
+            jnp.where(row, row_k, page_k), -FP8_MAX, FP8_MAX
+        ).astype(stage_k.dtype)
+        stage_v[...] = jnp.clip(
+            jnp.where(row, row_v, page_v), -FP8_MAX, FP8_MAX
+        ).astype(stage_v.dtype)
+        nks_ref[0] = nks.astype(nks_ref.dtype)
+        nvs_ref[0] = nvs.astype(nvs_ref.dtype)
+    else:
+        stage_k[...] = jnp.where(row, k_new_ref[0][:, None, :], stage_k[...])
+        stage_v[...] = jnp.where(row, v_new_ref[0][:, None, :], stage_v[...])
     rmw_out(0, stage_k).start()
     rmw_out(1, stage_v).start()
 
@@ -278,12 +345,20 @@ def fused_decode_attention(
 
     Returns ``(attn_out [B, H, D], k_pages, v_pages)`` with the new rows
     written in place (pools input/output-aliased; pair with donation at
-    the jit boundary above).
+    the jit boundary above). ``k_pages``/``v_pages`` may be
+    ``QuantPool`` (fp8 values + bf16 per-page/head scales): the kernel
+    then dequantizes window chunks in-register and quantizes the append
+    inside the staged RMW — HBM reads per step drop to fp8 width.
     """
+    quantized = is_quant(k_pages)
     B, H, D = q.shape
     _, _, KH, page_size, _ = k_pages.shape
     G = H // KH
     P = block_tables.shape[1]
+    # dtype-aware window sizing (ROADMAP #1 tuning note): itemsize is the
+    # POOL's — at fp8 each VMEM byte holds twice the resident window of
+    # bf16, so the slot budget buys 2x window pages instead of half-empty
+    # slots
     Pw = window_pages_override or _window_pages(
         KH, page_size, D, k_pages.dtype.itemsize, P
     )
@@ -300,6 +375,7 @@ def fused_decode_attention(
         window_pages=Pw,
         window=window,
         has_sinks=has_sinks,
+        quantized=quantized,
     )
     in_specs = [
         pl.BlockSpec(
@@ -315,12 +391,55 @@ def fused_decode_attention(
         pl.BlockSpec(memory_space=pltpu.ANY),  # k_pages
         pl.BlockSpec(memory_space=pltpu.ANY),  # v_pages
     ]
-    inputs = [
-        block_tables.astype(jnp.int32), seq_lens.astype(jnp.int32),
-        dst_page.astype(jnp.int32), dst_off.astype(jnp.int32),
-        q4, k_new.astype(k_pages.dtype), v_new.astype(v_pages.dtype),
-        k_pages, v_pages,
-    ]
+    if quantized:
+        k_vals, k_scale = k_pages
+        v_vals, v_scale = v_pages
+        # new rows stay UNQUANTIZED: the analytic new-token merge is
+        # exact, and the staged RMW quantizes them under the grown scale
+        # an append at row 0 means the page was just ACQUIRED — feed the
+        # RMW a zero old-scale so the previous occupant's leftover scale
+        # never ratchets into this occupancy (ops/quant.quant_append_rows
+        # applies the same reset; the two paths must share the bits)
+        held = (dst_off != 0)[:, None]  # [B, 1]
+        inputs = [
+            block_tables.astype(jnp.int32), seq_lens.astype(jnp.int32),
+            dst_page.astype(jnp.int32), dst_off.astype(jnp.int32),
+            q4, k_new, v_new, k_vals, v_vals,
+            # host-gathered scales: dynamic page indexing happens in XLA,
+            # the kernel's own scale indexing is fully static
+            k_scale[layer][block_tables],  # [B, P, KH]
+            v_scale[layer][block_tables],
+            # [B, KH] dst page's current scale (zeroed when fresh)
+            jnp.where(held, k_scale[layer, dst_page], 0),
+            jnp.where(held, v_scale[layer, dst_page], 0),
+        ]
+        in_specs += [
+            pl.BlockSpec(
+                (1, P, KH), lambda b, *_: (b, 0, 0),
+                memory_space=pltpu.VMEM,
+            ),
+            pl.BlockSpec(
+                (1, P, KH), lambda b, *_: (b, 0, 0),
+                memory_space=pltpu.VMEM,
+            ),
+            pl.BlockSpec(
+                (1, KH), lambda b, *_: (b, 0), memory_space=pltpu.VMEM
+            ),
+            pl.BlockSpec(
+                (1, KH), lambda b, *_: (b, 0), memory_space=pltpu.VMEM
+            ),
+        ]
+        pool_dtype = k_vals.dtype
+        k_pages_op, v_pages_op = k_vals, v_vals
+    else:
+        inputs = [
+            block_tables.astype(jnp.int32), seq_lens.astype(jnp.int32),
+            dst_page.astype(jnp.int32), dst_off.astype(jnp.int32),
+            q4, k_new.astype(k_pages.dtype), v_new.astype(v_pages.dtype),
+            k_pages, v_pages,
+        ]
+        pool_dtype = k_pages.dtype
+        k_pages_op, v_pages_op = k_pages, v_pages
     if has_sinks:
         in_specs.append(
             pl.BlockSpec(
@@ -328,37 +447,64 @@ def fused_decode_attention(
             )
         )
         inputs.append(sinks.astype(jnp.float32).reshape(KH * G, 1))
+    out_specs = [
+        pl.BlockSpec(
+            (1, KH, G, D), lambda b, *_: (b, 0, 0, 0),
+            memory_space=pltpu.VMEM,
+        ),
+        pl.BlockSpec(memory_space=pltpu.ANY),  # k_pages out
+        pl.BlockSpec(memory_space=pltpu.ANY),  # v_pages out
+    ]
+    out_shape = [
+        jax.ShapeDtypeStruct((B, KH, G, D), q.dtype),
+        jax.ShapeDtypeStruct(k_pages_op.shape, pool_dtype),
+        jax.ShapeDtypeStruct(v_pages_op.shape, pool_dtype),
+    ]
+    if quantized:
+        out_specs += [
+            pl.BlockSpec(
+                (1, KH), lambda b, *_: (b, 0), memory_space=pltpu.VMEM
+            ),
+            pl.BlockSpec(
+                (1, KH), lambda b, *_: (b, 0), memory_space=pltpu.VMEM
+            ),
+        ]
+        out_shape += [
+            jax.ShapeDtypeStruct((B, KH), k_scale.dtype),
+            jax.ShapeDtypeStruct((B, KH), v_scale.dtype),
+        ]
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=4,
         grid=(B,),
         in_specs=in_specs,
-        out_specs=[
-            pl.BlockSpec(
-                (1, KH, G, D), lambda b, *_: (b, 0, 0, 0),
-                memory_space=pltpu.VMEM,
-            ),
-            pl.BlockSpec(memory_space=pltpu.ANY),  # k_pages out
-            pl.BlockSpec(memory_space=pltpu.ANY),  # v_pages out
-        ],
+        out_specs=out_specs,
         scratch_shapes=[
-            pltpu.VMEM((2, 2, Pw, KH, page_size, D), k_pages.dtype),
+            pltpu.VMEM((2, 2, Pw, KH, page_size, D), pool_dtype),
             pltpu.SemaphoreType.DMA((2, 2, Pw)),
-            pltpu.VMEM((KH, page_size, D), k_pages.dtype),  # stage_k
-            pltpu.VMEM((KH, page_size, D), v_pages.dtype),  # stage_v
+            pltpu.VMEM((KH, page_size, D), pool_dtype),  # stage_k
+            pltpu.VMEM((KH, page_size, D), pool_dtype),  # stage_v
             pltpu.SemaphoreType.DMA((2, 2)),  # rmw in/out x k/v
         ],
     )
     # operand numbering includes the 4 scalar-prefetch args:
-    # 4=q 5=k_new 6=v_new 7=k_pages 8=v_pages [9=sinks] -> outputs 1, 2
-    out, k_out, v_out = pl.pallas_call(
+    # 4=q 5=k_new 6=v_new 7=k_pages 8=v_pages [9-12=scales] [then sinks]
+    # -> outputs 1, 2 (the value pools; grown scales leave as outputs
+    # 3/4 and are scattered into the scale pool below, same jit)
+    results = pl.pallas_call(
         kernel,
         grid_spec=grid_spec,
-        out_shape=[
-            jax.ShapeDtypeStruct((B, KH, G, D), q.dtype),
-            jax.ShapeDtypeStruct(k_pages.shape, k_pages.dtype),
-            jax.ShapeDtypeStruct(v_pages.shape, v_pages.dtype),
-        ],
+        out_shape=out_shape,
         input_output_aliases={7: 1, 8: 2},
         interpret=interpret,
     )(*inputs)
+    if quantized:
+        out, k_out, v_out, nks, nvs = results
+        k_pool = QuantPool(
+            k_out, k_scale.at[layer, dst_page].set(nks)
+        )
+        v_pool = QuantPool(
+            v_out, v_scale.at[layer, dst_page].set(nvs)
+        )
+        return out.reshape(B, H, D), k_pool, v_pool
+    out, k_out, v_out = results
     return out.reshape(B, H, D), k_out, v_out

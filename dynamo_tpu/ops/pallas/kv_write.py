@@ -202,12 +202,25 @@ def write_new_kv(
     (ops/attention.pool_head_dim zero-padding for lane alignment) gets
     the new rows zero-padded to the pool width — which is also what
     keeps this on the DMA-kernel path for e.g. D=64 models.
+
+    QuantPool pools (ops/quant.py) take the quantized append: gather the
+    destination pages, grow their per-head scales by the new rows,
+    requantize + splice, scatter back (same codec math as the fused
+    kernel's staged RMW). Rows must target distinct pages — same-page
+    groups (speculative verify) append one position at a time.
     """
     from dynamo_tpu.ops.attention import lane_aligned, pad_heads, use_pallas
+    from dynamo_tpu.ops.quant import is_quant, quant_append_rows
 
     if k_pages.shape[-1] != k_new.shape[-1]:
         k_new = pad_heads(k_new, k_pages.shape[-1])
         v_new = pad_heads(v_new, v_pages.shape[-1])
+
+    if is_quant(k_pages):
+        return (
+            quant_append_rows(k_pages, k_new, dst_page, dst_off, layer),
+            quant_append_rows(v_pages, v_new, dst_page, dst_off, layer),
+        )
 
     if (
         lane_aligned(k_pages.shape[-1])

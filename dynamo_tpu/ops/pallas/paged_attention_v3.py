@@ -62,6 +62,13 @@ _WINDOW_SLOT_BYTES = 4 * 1024 * 1024
 
 
 def _window_pages(KH: int, page: int, D: int, itemsize: int, P: int) -> int:
+    """Pages per window chunk for the slot budget. DTYPE-AWARE on
+    purpose (ROADMAP #1 tuning note): ``itemsize`` must be the POOL
+    dtype's — an fp8 pool (ops/quant.py) packs twice the pages of bf16
+    into the same VMEM slot, doubling the resident window (and the
+    back-to-back DMA issue burst) instead of wasting half the slot. The
+    f32 working forms are per-CHUNK temporaries already covered by the
+    ~6x headroom above and do not cap the window."""
     per_page = KH * page * D * itemsize
     return max(1, min(P, _WINDOW_SLOT_BYTES // per_page))
 
@@ -74,18 +81,25 @@ def _decode_kernel_v3(
     q_ref,  # [1, KH, G, D] VMEM (this sequence's query heads, pre-scaled)
     k_pages_ref,  # [num_pages, KH, page, D] ANY/HBM
     v_pages_ref,
-    *rest,  # [sinks_ref [KH*G, 1] f32 VMEM when has_sinks,] o_ref, kv_buf, sems
+    *rest,  # [kt_s_ref, vt_s_ref [1, P, KH] when quantized,]
+    # [sinks_ref [KH*G, 1] f32 VMEM when has_sinks,] o_ref, kv_buf, sems
     page_size: int,
     pages_per_seq: int,
     window_pages: int,
     window: int = 0,  # sliding window in tokens (0 = full attention)
     has_sinks: bool = False,  # per-head sink logits in the softmax denom
+    quantized: bool = False,  # fp8 pages + host-pregathered bf16 scales
 ):
+    i = 0
+    if quantized:
+        kt_s_ref, vt_s_ref = rest[:2]
+        i = 2
     if has_sinks:
-        sinks_ref, o_ref, kv_buf, sems = rest
+        sinks_ref = rest[i]
+        i += 1
     else:
         sinks_ref = None
-        o_ref, kv_buf, sems = rest
+    o_ref, kv_buf, sems = rest[i: i + 3]
     b = pl.program_id(0)
     nb = pl.num_programs(0)
     P, Pw = pages_per_seq, window_pages
@@ -180,9 +194,24 @@ def _decode_kernel_v3(
                 issue(nxt, b + 1, 0)
 
         wait(buf, b, c)
-        kf = kv_buf[buf, 0].reshape(Nw, D).astype(jnp.float32)
-        vf = kv_buf[buf, 1].reshape(Nw, D).astype(jnp.float32)
-        if window or n_chunks > 1:
+        if quantized:
+            # dequant in-register (mirrors fused_decode): per-page/head
+            # scales were host-gathered by block table, so this indexes
+            # statically by the unrolled chunk
+            from dynamo_tpu.ops.quant import kt_scales_f
+
+            lo = c * Pw
+            hi = min(P, lo + Pw)
+            sk = kt_scales_f(kt_s_ref, lo, hi, Pw)  # [Pw, KH] f32
+            sv = kt_scales_f(vt_s_ref, lo, hi, Pw)
+            kf = kv_buf[buf, 0].astype(jnp.float32) * sk[:, :, None, None]
+            vf = kv_buf[buf, 1].astype(jnp.float32) * sv[:, :, None, None]
+            kf = kf.reshape(Nw, D)
+            vf = vf.reshape(Nw, D)
+        else:
+            kf = kv_buf[buf, 0].reshape(Nw, D).astype(jnp.float32)
+            vf = kv_buf[buf, 1].reshape(Nw, D).astype(jnp.float32)
+        if quantized or window or n_chunks > 1:
             # Only these shapes can SKIP fetches (chunk_live) and hence
             # read UNINITIALIZED VMEM: garbage K only feeds masked score
             # columns (where -> NEG_INF), but a non-finite V would turn
@@ -241,7 +270,7 @@ def v3_supported(k_pages: jax.Array, block_tables: jax.Array) -> bool:
 @functools.partial(jax.jit, static_argnames=("interpret", "window"))
 def paged_decode_attention_v3(
     q: jax.Array,  # [B, H, D]
-    k_pages: jax.Array,  # [num_pages, KH, page, D]
+    k_pages: jax.Array,  # [num_pages, KH, page, D] (fp8 when k_scale set)
     v_pages: jax.Array,
     block_tables: jax.Array,  # [B, P] int32
     seq_lens: jax.Array,  # [B] int32 (length INCLUDING the new token)
@@ -252,8 +281,13 @@ def paged_decode_attention_v3(
     scale: float | None = None,  # softmax scale; default 1/sqrt(D). The
     # caller overrides when q/pool are zero-padded past the true model
     # dim (ops/attention.pool_head_dim) so scores keep the real 1/sqrt(D)
+    k_scale: jax.Array | None = None,  # [num_pages, KH] bf16 fp8 scales
+    v_scale: jax.Array | None = None,  # (ops/quant.py layer slice)
 ) -> jax.Array:
-    """Decode attention over the page-major paged cache."""
+    """Decode attention over the page-major paged cache. With
+    ``k_scale``/``v_scale`` the pages are fp8 (ops/quant.py QuantPool
+    layer slices) and the kernel dequantizes window chunks in-register —
+    this is the quantized fallback path for ``DYNAMO_FUSED_DECODE=0``."""
     B, H, D = q.shape
     _, KH, page_size, _ = k_pages.shape
     G = H // KH
@@ -263,6 +297,7 @@ def paged_decode_attention_v3(
         scale = 1.0 / jnp.sqrt(jnp.asarray(D, jnp.float32))
     q4 = (q.reshape(B, KH, G, D).astype(jnp.float32) * scale).astype(q.dtype)
     has_sinks = sinks is not None
+    quantized = k_scale is not None
 
     kernel = functools.partial(
         _decode_kernel_v3,
@@ -271,6 +306,7 @@ def paged_decode_attention_v3(
         window_pages=Pw,
         window=window,
         has_sinks=has_sinks,
+        quantized=quantized,
     )
     in_specs = [
         pl.BlockSpec(
@@ -282,6 +318,17 @@ def paged_decode_attention_v3(
     ]
     inputs = [block_tables.astype(jnp.int32), seq_lens.astype(jnp.int32),
               q4, k_pages, v_pages]
+    if quantized:
+        # host-gathered per-table-page scales: the kernel's own scale
+        # indexing stays static (same contract as fused_decode)
+        for sc in (k_scale, v_scale):
+            in_specs.append(
+                pl.BlockSpec(
+                    (1, P, KH), lambda b, *_: (b, 0, 0),
+                    memory_space=pltpu.VMEM,
+                )
+            )
+            inputs.append(sc[block_tables])
     if has_sinks:
         # already the [KH*G, 1] f32 column the flash merge consumes: an
         # IN-kernel (KH, G) -> (KH*G, 1) reshape is a vector layout cast

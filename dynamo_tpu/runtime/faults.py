@@ -96,6 +96,7 @@ KNOWN_SITES: frozenset[str] = frozenset({
     "engine.compile",
     "engine.spec_verify",
     "engine.guided_compile",
+    "engine.quant",
     "disagg.pull",
 })
 

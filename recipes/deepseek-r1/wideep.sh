@@ -41,6 +41,7 @@ COMMON=("${MODEL_ARGS[@]}" --model-name "${MODEL:-deepseek-r1}"
         --max-decode-slots "$SLOTS" --decode-steps-per-dispatch "$BURST")
 # serving default: compile every shape at startup (PRECOMPILE=0 skips)
 [ "$PRECOMPILE" = "1" ] && COMMON+=(--precompile)
+# DYN_KV_DTYPE=fp8: quantized latent cache (per-row scales); default bf16
 # SPEC_MODE=ngram: prompt-lookup speculative decoding (decode pool)
 [ -n "${SPEC_MODE:-}" ] && COMMON+=(--spec "$SPEC_MODE")
 

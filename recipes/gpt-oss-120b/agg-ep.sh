@@ -33,6 +33,8 @@ else
 fi
 # serving default: compile every shape at startup (PRECOMPILE=0 skips)
 [ "$PRECOMPILE" = "1" ] && MODEL_ARGS+=(--precompile)
+# DYN_KV_DTYPE=fp8: quantized KV cache (throughput mode; default bf16
+# is bit-identical serving)
 # SPEC_MODE=ngram: prompt-lookup speculative decoding (agentic tool-call
 # loops are exactly where the n-gram drafter wins)
 [ -n "${SPEC_MODE:-}" ] && MODEL_ARGS+=(--spec "$SPEC_MODE")

@@ -44,6 +44,8 @@ COMMON=(--tp "$TP" --page-size "$PAGE" --num-pages "$NUM_PAGES"
         --model-name "${MODEL:-llama-3-70b}")
 # serving default: compile every shape at startup (PRECOMPILE=0 skips)
 [ "$PRECOMPILE" = "1" ] && COMMON+=(--precompile)
+# DYN_KV_DTYPE=fp8: quantized KV cache — BOTH pools must match (the
+# transfer plane carries packed fp8 payloads); default bf16
 # SPEC_MODE=ngram: prompt-lookup speculative decoding (decode pool)
 [ -n "${SPEC_MODE:-}" ] && COMMON+=(--spec "$SPEC_MODE")
 MH=()

@@ -11,6 +11,8 @@ MODEL_ARGS=(--model "${MODEL:-llama-3-8b}")
 # DYN_COMPILE_CACHE_DIR= disables the cache, PRECOMPILE=0 the warmup
 export DYN_COMPILE_CACHE_DIR="${DYN_COMPILE_CACHE_DIR-$HOME/.cache/dynamo-tpu/xla-cache}"
 [ "${PRECOMPILE:-1}" = "1" ] && MODEL_ARGS+=(--precompile)
+# DYN_KV_DTYPE=fp8: quantized KV cache — BOTH pools must match (packed
+# fp8 payloads cross the transfer plane); default bf16
 # SPEC_MODE=ngram: prompt-lookup speculative decoding on the decode pool
 [ -n "${SPEC_MODE:-}" ] && MODEL_ARGS+=(--spec "$SPEC_MODE")
 # GUIDED_MODE=off disables guided decoding (guided requests always

@@ -234,6 +234,34 @@ async def test_disagg_matches_aggregated_greedy():
     assert dec.allocator.active_pages == 0
 
 
+async def test_disagg_kv_dtype_mismatch_rejected_loudly():
+    """A bf16 prefill worker paired with an fp8 decode worker must fail the
+    request with an error naming the knob — not die on a shape error inside
+    the decode worker's donated insert jit."""
+    prompt = list(range(40, 40 + 23))
+    drt = DistributedRuntime(InMemoryHub())
+    pre, _ = await launch_engine_worker(
+        drt, spec=SPEC, engine_config=engine_config(), model_name="tiny-test",
+        mode="prefill",
+    )
+    dec, _ = await launch_engine_worker(
+        drt, spec=SPEC, engine_config=engine_config(kv_dtype="fp8"),
+        model_name="tiny-test", mode="decode", always_remote_prefill=True,
+    )
+    handler = dec.frontdoor
+    await handler.wait_for_prefill_pool()
+    try:
+        _, items = await collect(handler.generate(request(prompt), Context()))
+        errs = [i for i in items if i.get("finish_reason") == "error"]
+        assert errs, f"expected an error item, got {items}"
+        assert "kv_dtype mismatch" in errs[-1].get("error", "")
+    finally:
+        await pre.close()
+        await dec.close()
+        await drt.close()
+    assert dec.allocator.active_pages == 0
+
+
 async def test_prefill_death_mid_kv_transfer_completes_with_continuity():
     """Migration × disagg (robustness PR): the prefill worker dies
     mid-KV-handoff — the remote first token was emitted but the KV pull

@@ -76,6 +76,28 @@ async def test_precompile_then_mixed_isl_batch_zero_new_compiles():
     await engine.close()
 
 
+async def test_fp8_engine_precompile_then_zero_new_compiles():
+    """Satellite of the fp8 KV-cache PR: precompile() dispatches against
+    the LIVE pools, so a kv_dtype=fp8 engine's warmup walks the same
+    shape grid over QuantPool programs — warmed fp8 serving must also
+    do ZERO new compiles (the quantized pools ride the existing
+    donated argument slots; a pytree mismatch would show up here as a
+    retrace)."""
+    engine = InferenceEngine(ModelSpec.tiny(), _cfg(kv_dtype="fp8"))
+    assert engine.kv_dtype == "fp8"
+    report = engine.precompile()
+    assert report, "precompile produced no shapes"
+    await _serve(engine, [5, 12, 20], "warm-fp8")
+    c0, _s0 = compile_snapshot()
+    await _serve(engine, [7, 14, 25], "mixed-fp8")
+    c1, _s1 = compile_snapshot()
+    assert c1 - c0 == 0, (
+        f"{c1 - c0} compiles during warmed fp8 serving — a shape "
+        "escaped the precompile set"
+    )
+    await engine.close()
+
+
 async def test_spec_engine_precompile_then_zero_new_compiles():
     """Satellite of the speculative-decoding PR: precompile() walks the
     verify-shape grid (power-of-two row counts x the static k+1 width),

@@ -21,6 +21,7 @@ import pytest
 from dynamo_tpu.engine import sampling
 from dynamo_tpu.engine.config import ModelSpec
 from dynamo_tpu.models import family, llama, mla
+from dynamo_tpu.ops import quant
 from dynamo_tpu.ops.pallas import fused_decode, kv_write
 
 PJIT_TYPE = type(jax.jit(lambda x: x))
@@ -54,7 +55,19 @@ def _mla_args():
 
 
 def _deleted(arrs) -> list[bool]:
-    return [a.is_deleted() for a in arrs]
+    # tree.leaves flattens QuantPool pools into (vals, scale) leaves, so
+    # "donated" means EVERY leaf is — a donated value pool with a copied
+    # scale buffer still fails
+    return [a.is_deleted() for a in jax.tree.leaves(list(arrs))]
+
+
+def _gqa_quant_args():
+    params = llama.init_params(SPEC, jax.random.PRNGKey(0))
+    k, v = llama.init_cache(SPEC, NUM_PAGES, PAGE, kv_dtype="fp8")
+    bt = np.zeros((B, PPS), np.int32)
+    for i in range(B):
+        bt[i] = np.arange(1 + i * PPS, 1 + (i + 1) * PPS)
+    return params, k, v, jnp.asarray(bt)
 
 
 def test_gqa_prefill_donates_pools():
@@ -201,6 +214,104 @@ def test_masked_sampling_does_not_donate_logits_or_mask():
     assert not allowed.is_deleted()
 
 
+# --------------------------------------------- quantized pools (fp8 KV)
+# The same donation discipline over QuantPool pytrees: BOTH leaves (fp8
+# values and bf16 scales) must be donated by every hot jit that updates
+# the cache — a copied scale buffer is small but a copied value pool is
+# the multi-GB bug the audit exists for (and the behavioral check below
+# catches either, per _deleted).
+
+
+def test_gqa_quant_prefill_and_verify_donate_both_leaves():
+    params, k, v, bt = _gqa_quant_args()
+    tokens = jnp.zeros((8,), jnp.int32)
+    _logits, k2, v2, _ = llama.prefill_forward(
+        SPEC, params, tokens, bt[0], jnp.asarray(0, jnp.int32), k, v,
+        jnp.asarray(8, jnp.int32),
+    )
+    assert _deleted([k, v]) == [True] * 4  # vals + scale, k and v
+    assert quant.is_quant(k2) and quant.is_quant(v2)
+    tokens2 = jnp.zeros((B, 3), jnp.int32)
+    _targets, k3, v3, _ = llama.verify_forward(
+        SPEC, params, tokens2, bt, jnp.zeros((B,), jnp.int32), k2, v2,
+        jnp.zeros((B,), jnp.int32),
+    )
+    assert _deleted([k2, v2]) == [True] * 4
+
+
+def test_gqa_quant_decode_steps_donates_both_leaves():
+    params, k, v, bt = _gqa_quant_args()
+    zB = jnp.zeros((B,), jnp.int32)
+    _out, k2, v2 = llama.decode_steps(
+        SPEC, params, zB, bt, jnp.ones((B,), jnp.int32), k, v,
+        jnp.zeros((B,), bool), jnp.zeros((B,), jnp.float32), zB,
+        jnp.ones((B,), jnp.float32), jnp.zeros((B,), jnp.uint32), zB,
+        n_steps=2,
+    )
+    assert _deleted([k, v]) == [True] * 4
+    assert not bt.is_deleted()
+
+
+def test_quant_fused_decode_kernel_donates_value_pools():
+    _params, k, v, bt = _gqa_quant_args()
+    q = jnp.zeros((B, SPEC.num_heads, SPEC.head_dim), jnp.float32)
+    kn = jnp.zeros((B, SPEC.num_kv_heads, SPEC.head_dim), jnp.float32)
+    _o, k2, v2 = fused_decode.fused_decode_attention(
+        q, k, v, kn, kn, bt, jnp.ones((B,), jnp.int32),
+        jnp.zeros((B,), jnp.int32), jnp.zeros((B,), jnp.int32),
+        layer=0, interpret=True,
+    )
+    # donate_argnums=(1, 2) covers the whole QuantPool pytree: values
+    # alias through the pallas_call, scales through the XLA scatter
+    assert _deleted([k, v]) == [True] * 4
+    assert not q.is_deleted()
+
+
+def test_mla_quant_forwards_donate_cache_leaves():
+    params, _c, bt = _mla_args()
+    cache = mla.init_cache(MLA_SPEC, NUM_PAGES, PAGE, kv_dtype="fp8")
+    tokens = jnp.zeros((8,), jnp.int32)
+    _logits, cache2 = mla.prefill_forward(
+        MLA_SPEC, params, tokens, bt[0], jnp.asarray(0, jnp.int32),
+        cache, jnp.asarray(8, jnp.int32),
+    )
+    assert _deleted([cache]) == [True, True]
+    tokens2 = jnp.zeros((B, 3), jnp.int32)
+    _targets, cache3 = mla.verify_forward(
+        MLA_SPEC, params, tokens2, bt, jnp.zeros((B,), jnp.int32),
+        cache2, jnp.zeros((B,), jnp.int32),
+    )
+    assert _deleted([cache2]) == [True, True]
+    zB = jnp.zeros((B,), jnp.int32)
+    _out = mla.decode_steps(
+        MLA_SPEC, params, zB, bt, jnp.ones((B,), jnp.int32), cache3,
+        jnp.zeros((B,), bool), jnp.zeros((B,), jnp.float32), zB,
+        jnp.ones((B,), jnp.float32), jnp.zeros((B,), jnp.uint32), zB,
+        n_steps=1,
+    )
+    assert _deleted([cache3]) == [True, True]
+
+
+def test_quant_insert_donates_extract_does_not():
+    _params, k, v, _bt = _gqa_quant_args()
+    ids = jnp.asarray([1, 2], jnp.int32)
+    kb, vb = llama.extract_kv_pages(k, v, ids)
+    assert kb.dtype == jnp.uint8  # packed fp8+scale payload
+    assert _deleted([k, v]) == [False] * 4  # extract is read-only
+    k2, v2 = llama.insert_kv_pages(k, v, ids, kb, vb)
+    assert _deleted([k, v]) == [True] * 4
+
+
+def test_mla_quant_latent_insert_donates_extract_does_not():
+    cache = mla.init_cache(MLA_SPEC, NUM_PAGES, PAGE, kv_dtype="fp8")
+    ids = jnp.asarray([1, 2], jnp.int32)
+    blocks = family._extract_latent(cache, ids)
+    assert blocks.dtype == jnp.uint8
+    assert _deleted([cache]) == [False, False]
+    _cache2 = family._insert_latent(cache, ids, np.asarray(blocks))
+    assert _deleted([cache]) == [True, True]
+
+
 # --------------------------------------------------------------- inventory
 
 # module -> {jit name: "donates" | "read-only"}. A jit object in one of
@@ -242,6 +353,10 @@ AUDIT: dict = {
     fused_decode: {
         "fused_decode_attention": "donates",
     },
+    # ops/quant.py holds codec MATH that traces into its callers' jits;
+    # a jit object appearing there must take an explicit donation
+    # decision here like everywhere else
+    quant: {},
 }
 
 

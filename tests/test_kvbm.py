@@ -187,6 +187,182 @@ async def test_kvbm_output_parity_with_and_without():
     await with_kvbm.close()
 
 
+# ----------------------------------------------- quantized (fp8) blocks
+
+
+def fp8_block(num_layers=2, nbytes_per_page=130, fill=3):
+    """A fake PACKED quantized block pair: uint8 [L, X] per page, exactly
+    the payload llama.extract_kv_pages emits for a QuantPool (fp8 value
+    bytes ++ bf16 scale bytes). Byte payloads are what the tiers must
+    preserve EXACTLY — any dtype coercion shows up as corruption."""
+    k = np.arange(
+        num_layers * nbytes_per_page, dtype=np.uint8
+    ).reshape(num_layers, nbytes_per_page)
+    return (k + fill) % 251, (k + fill + 100) % 251
+
+
+def test_quantized_blocks_roundtrip_host_and_disk(tmp_path):
+    """fp8 payload + scales survive host AND disk tiers byte-exactly (no
+    silent upcast: the pools only ever see uint8)."""
+    mgr = KvBlockManager(KvbmConfig(
+        host_bytes=1 << 20, disk_bytes=1 << 20,
+        disk_dir=str(tmp_path / "kv"),
+    ))
+    k, v = fp8_block()
+    mgr.offer(11, k, v)
+    got = mgr.get(11)
+    assert got[0].dtype == np.uint8 and got[1].dtype == np.uint8
+    np.testing.assert_array_equal(got[0], k)
+    np.testing.assert_array_equal(got[1], v)
+    # force the disk path: push straight to G3, then onboard
+    mgr2 = KvBlockManager(KvbmConfig(
+        host_bytes=1 << 20, disk_bytes=1 << 20,
+        disk_dir=str(tmp_path / "kv2"),
+    ))
+    mgr2.disk.put(12, k, v)
+    got = mgr2.get(12)
+    assert got[0].dtype == np.uint8
+    np.testing.assert_array_equal(got[0], k)
+    np.testing.assert_array_equal(got[1], v)
+    assert mgr2.stats.onboard_hits_disk == 1
+
+
+async def test_quantized_blocks_roundtrip_remote_tier():
+    """G4: the packed uint8 payload round-trips the hub object store's
+    single-dtype header byte-exactly, cross-manager."""
+    import asyncio
+
+    from dynamo_tpu.runtime.hub import InMemoryHub
+
+    hub = InMemoryHub()
+    loop = asyncio.get_running_loop()
+    cfg = KvbmConfig(host_bytes=1 << 20, remote_max_blocks=8)
+    a = KvBlockManager(cfg, hub=hub, loop=loop, namespace="q")
+    b = KvBlockManager(cfg, hub=hub, loop=loop, namespace="q")
+    k, v = fp8_block()
+    await asyncio.to_thread(a.offer, 0xF8, k, v)
+    got = None
+    for _ in range(100):
+        got = await asyncio.to_thread(b.get, 0xF8)
+        if got is not None:
+            break
+        await asyncio.sleep(0.02)
+    assert got is not None
+    assert got[0].dtype == np.uint8 and got[1].dtype == np.uint8
+    np.testing.assert_array_equal(got[0], k)
+    np.testing.assert_array_equal(got[1], v)
+    # the footprint gauge counts this process's G4 writes
+    assert a.tier_bytes()["remote"] > 0
+
+
+async def test_fp8_engine_offload_onboard_and_corrupt_scale_miss():
+    """End-to-end quantized KVBM: an fp8 engine's sealed pages offload as
+    packed blocks, onboard after G1 eviction with identical outputs, and
+    a block whose SCALE bytes decode non-finite is treated as a tier
+    MISS (truncating the consecutive prefix) instead of poisoning a
+    page — the g4 corrupt-payload posture, at the dequant boundary."""
+    import jax.numpy as jnp
+
+    import ml_dtypes
+
+    kvbm = KvBlockManager(KvbmConfig(host_bytes=1 << 20))
+    engine = InferenceEngine(
+        SPEC, small_config(kv_dtype="fp8"), kvbm=kvbm
+    )
+    assert engine.kv_dtype == "fp8"
+    prompt = list(range(30, 30 + 13))
+    want = await run(engine, prompt)
+    engine.offload.flush()
+    assert kvbm.stats.offloaded >= 3
+
+    # offloaded blocks are PACKED uint8 payloads of the quantized width
+    from dynamo_tpu.ops.quant import packed_bytes_per_page
+
+    sh = next(iter(kvbm.host._blocks))
+    blk_k, blk_v = kvbm.host.get(sh)
+    assert blk_k.dtype == np.uint8
+    assert blk_k.shape == (
+        engine.k_pages.shape[0], packed_bytes_per_page(engine.k_pages)
+    )
+
+    engine.allocator.clear_cache()
+    got = await run(engine, prompt)
+    assert got == want  # tier round-trip preserves fp8 + scales exactly
+    assert kvbm.stats.onboard_hits_host >= 3
+
+    # corrupted-scale guard: NaN out one block's scale bytes — the
+    # validator must cut the prefix THERE and count a miss
+    good = (blk_k.copy(), blk_v.copy())
+    bad_k = blk_k.copy()
+    nan_bf16 = np.array([np.nan], dtype=ml_dtypes.bfloat16).view(np.uint8)
+    bad_k[0, -2:] = nan_bf16
+    misses0 = kvbm.stats.onboard_misses
+    kept = engine._validate_quant_blocks(
+        [good, (bad_k, blk_v), good], [0x111, sh, 0x222]
+    )
+    assert len(kept) == 1  # the corrupt block and everything after drop
+    assert kvbm.stats.onboard_misses == misses0 + 1
+    # the corrupt block was EVICTED from the host tier: the next admission
+    # refetches (or genuinely misses) instead of looping fetch->reject
+    assert kvbm.host.get(sh) is None
+    # wrong payload length is equally a miss (hash absent from tiers: the
+    # eviction is a tolerated no-op)
+    kept = engine._validate_quant_blocks([(blk_k[:, :-1], blk_v)], [0x333])
+    assert kept == []
+    await engine.close()
+
+
+async def test_fp8_mla_engine_onboard_not_rejected():
+    """MLA blocks carry an inert v slot (the latent IS the cache); the
+    quantized-onboard validator must judge only the parts whose engine
+    pool is actually quantized, or every MLA+fp8 onboard is spuriously
+    rejected as corrupt (prefix reuse silently dead for the family)."""
+    kvbm = KvBlockManager(KvbmConfig(host_bytes=1 << 20))
+    engine = InferenceEngine(
+        ModelSpec.tiny_deepseek(), small_config(kv_dtype="fp8"), kvbm=kvbm
+    )
+    prompt = list(range(30, 30 + 13))
+    want = await run(engine, prompt)
+    engine.offload.flush()
+    assert kvbm.stats.offloaded >= 3
+
+    engine.allocator.clear_cache()
+    misses0 = kvbm.stats.onboard_misses
+    got = await run(engine, prompt)
+    assert got == want
+    assert kvbm.stats.onboard_hits_host >= 3
+    assert kvbm.stats.onboard_misses == misses0  # no spurious corruption
+    await engine.close()
+
+
+async def test_kvbm_tier_bytes_gauge_exported():
+    """dynamo_kvbm_tier_bytes{tier} renders on the PR 10 telemetry
+    registry with the pools' live byte footprints."""
+    from dynamo_tpu.engine.telemetry import REGISTRY, EngineCollector
+
+    kvbm = KvBlockManager(KvbmConfig(host_bytes=1 << 20))
+    engine = InferenceEngine(SPEC, small_config(), kvbm=kvbm)
+    await engine.start()
+    try:
+        await run(engine, list(range(30, 43)))
+        engine.offload.flush()
+        assert kvbm.tier_bytes()["host"] > 0
+        collector = EngineCollector(engine)
+        collector.sample()
+        text = REGISTRY.exposition().decode()
+        line = next(
+            ln for ln in text.splitlines()
+            if ln.startswith("dynamo_kvbm_tier_bytes{")
+            and 'tier="host"' in ln
+            and f'engine="{collector.label}"' in ln
+        )
+        assert float(line.split()[-1]) == float(
+            kvbm.tier_bytes()["host"]
+        )
+    finally:
+        await engine.close()
+
+
 async def test_g4_remote_tier_cross_worker():
     """G4 (hub object store): a block offloaded by one manager onboards on
     ANOTHER manager sharing the hub — the cross-worker prefix story the

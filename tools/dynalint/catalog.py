@@ -41,6 +41,10 @@ FAULT_SITES: dict[str, str] = {
                              "failing grammar->mask compile must bounce "
                              "the request as a typed 400 (no slot, no "
                              "page, counter trip), never wedge a stream",
+    "engine.quant": "engine/core.py quantized-onboard validation — "
+                    "corrupt fp8 tier block (bad scale bytes): must be "
+                    "treated as a tier miss + re-prefill, never a "
+                    "NaN-poisoned page",
     "disagg.pull": "disagg/transfer.py KV pull — transfer plane failure",
 }
 
@@ -164,4 +168,8 @@ METRIC_NAMES: dict[str, str] = {
                                      "DYNAMO_ENGINE_PROFILE=1)",
     "engine_spec_acceptance_rate": "cumulative speculative-draft "
                                    "acceptance rate",
+    "kvbm_tier_bytes": "KVBM tier footprint gauge by tier "
+                       "(host | disk | remote) — quantized blocks "
+                       "(kv_dtype=fp8) land at packed fp8+scale width, "
+                       "so the tier halving vs bf16 is observable here",
 }
