@@ -303,8 +303,28 @@ class EngineConfig:
     # admission queue bound: a request arriving with this many already
     # waiting is refused with ServiceUnavailable (-> migration re-drives
     # on another worker, or HTTP 503 + Retry-After when none can take it)
-    # instead of queueing unboundedly behind a saturated engine. 0 = off.
+    # instead of queueing unboundedly behind a saturated engine — unless
+    # a LOWER-priority waiting entry can be shed in its place
+    # (engine/tenancy.py shed policy: lowest priority class, most-over-
+    # quota tenant, newest entry). The 503's Retry-After derives from
+    # live queue depth x recent step time, not a constant. 0 = off.
     max_waiting: int = 0
+    # per-tenant fairness + quotas (engine/tenancy.py): quota spec
+    # string ("tenantA:weight=4,rate=1000,burst=2000;*:rate=200") or an
+    # already-parsed {tenant: TenantQuota} dict. "" = consult
+    # DYN_TENANT_QUOTAS, default unmetered equal-weight tenants (the
+    # weighted-fair queue still applies; buckets are wide open).
+    tenants: str | dict = ""
+    # priority preemption: when an interactive request cannot admit
+    # (no free slot, or the prompt cannot get pages), pause a BATCH
+    # stream — over-quota tenants preferred, newest admission first;
+    # an in-quota batch stream is still fair game when it is the only
+    # thing standing between an interactive user and a slot (class
+    # priority outranks quota standing). The victim's KV seals +
+    # offloads through the KVBM host tier, its slot/pages free, and it
+    # re-enqueues for a transparent resume (bit-identical greedy
+    # continuation). False = interactive waits like everyone else.
+    preemption: bool = True
     # speculative decoding (ROADMAP #6; engine/spec.py): "ngram" turns on
     # the prompt-lookup drafter + batched verify for greedy, logprob-free
     # slots — each verify dispatch lands 1..spec_k_max+1 tokens instead
@@ -363,6 +383,13 @@ class EngineConfig:
         from dynamo_tpu.ops.quant import resolve_kv_dtype
 
         self.kv_dtype = resolve_kv_dtype(self.kv_dtype)
+        if isinstance(self.tenants, str):
+            import os
+
+            from dynamo_tpu.engine.tenancy import parse_tenant_quotas
+
+            spec = self.tenants or os.environ.get("DYN_TENANT_QUOTAS", "")
+            self.tenants = parse_tenant_quotas(spec)
 
     @property
     def max_context(self) -> int:

@@ -44,6 +44,7 @@ from dynamo_tpu.engine.sampling import (
     token_logprobs,
 )
 from dynamo_tpu.engine.spec import SPEC_TOKENS, SlotSpec
+from dynamo_tpu.engine.tenancy import TenantScheduler
 from dynamo_tpu.guided.runtime import GUIDED_REQUESTS
 from dynamo_tpu.kv_router.protocols import ForwardPassMetrics
 from dynamo_tpu.models import llama
@@ -51,7 +52,9 @@ from dynamo_tpu.models.family import get_family
 from dynamo_tpu.runtime.context import (
     Context,
     DeadlineExceeded,
+    OverQuota,
     ServiceUnavailable,
+    tenancy_from_headers,
 )
 from dynamo_tpu.runtime.faults import FAULTS
 from dynamo_tpu.runtime import tracing
@@ -98,6 +101,13 @@ class _Slot:
     # step thread advances it as tokens land and ships its allowed-token
     # mask into every sampling dispatch this slot participates in
     guided: Any | None = None
+    # tenancy (engine/tenancy.py): who this stream belongs to + its
+    # priority class, and the original request dict so a preemption can
+    # rebuild a resume request (prompt + generated, shrunk budget)
+    tenant: str = "default"
+    priority: str = "interactive"
+    request: dict[str, Any] | None = None
+    admitted_seq: int = 0  # monotonic admission order (preempt newest first)
 
 
 @dataclass
@@ -107,6 +117,23 @@ class _Waiting:
     out_q: asyncio.Queue
     enq_t: float = 0.0  # perf_counter at enqueue (admit-wait attribution)
     admit_t: float = 0.0  # perf_counter when the step thread dequeued it
+    # tenancy routing keys (read by TenantScheduler): priority class
+    # picks the lane group, tenant the lane, cost the WFQ vtime advance
+    tenant: str = "default"
+    priority: str = "interactive"
+    cost: float = 1.0
+    # True when generate() charged the tenant's bucket for this entry —
+    # a bounce (shed, step-loop failure) refunds ONLY charged entries
+    # (preemption resumes re-enter uncharged)
+    charged: bool = False
+    # admission passes this entry bounced on OutOfPages and was
+    # requeued (page backpressure at admission = WAIT, like decode
+    # backpressure): bounded so a pool that can never fit the prompt
+    # still errors instead of parking forever
+    page_stalls: int = 0
+
+
+_REQUEUED = object()  # _prefill sentinel: entry went back to the queue
 
 
 @dataclass
@@ -220,7 +247,13 @@ class InferenceEngine:
             on_evict=self._on_evict,
         )
         self._slots: list[_Slot | None] = [None] * self.config.max_decode_slots
-        self._waiting: queue.Queue[_Waiting] = queue.Queue()
+        # fair admission (engine/tenancy.py): weighted-fair per-tenant
+        # lanes + token buckets replacing the old single FIFO — same
+        # qsize/empty/put_nowait/get_nowait surface the sweeps use
+        self._waiting: TenantScheduler = TenantScheduler(
+            self.config.tenants if isinstance(self.config.tenants, dict)
+            else None
+        )
         self._seed_counter = self.config.seed
         self._thread: threading.Thread | None = None
         self._loop: asyncio.AbstractEventLoop | None = None
@@ -232,8 +265,15 @@ class InferenceEngine:
             spmd.on_sync_request = self._wake.set
         self._closed = False
         # SIGTERM drain: stop admitting (generate refuses with
-        # ServiceUnavailable) while in-flight slots run to completion
+        # ServiceUnavailable) while in-flight slots run to completion;
+        # the deadline (when known) prices the refusal's Retry-After
         self._draining = False
+        self._drain_deadline: float | None = None
+        # priority preemption (overload plane): paused-batch-stream
+        # counters by reason, sampled into
+        # dynamo_engine_preemptions_total{reason} (engine/telemetry.py)
+        self.preemptions: dict[str, int] = {}
+        self._admit_seq = 0  # monotonic admission order for victim ranking
         # disagg KV pulls that failed and fell back to a local prefill
         self.disagg_fallbacks = 0
         self.steps = 0
@@ -303,6 +343,7 @@ class InferenceEngine:
         self.burst_fills: collections.deque = collections.deque(maxlen=4096)
         self.admission_rejects = {
             "draining": 0, "saturated": 0, "deadline": 0,
+            "over_quota": 0, "shed": 0,
         }
         self.telemetry = None  # EngineCollector, attached by the worker
 
@@ -703,17 +744,61 @@ class InferenceEngine:
             and not self._closed
         )
 
-    def begin_drain(self) -> None:
+    def begin_drain(self, deadline_s: float | None = None) -> None:
         """Graceful-drain entry (worker SIGTERM path): refuse NEW requests
         with ServiceUnavailable — retryable, so the frontend's migration
         operator re-drives them on a live worker — while admitted work
-        runs to completion. The step loop keeps running until close()."""
+        runs to completion. The step loop keeps running until close().
+        ``deadline_s``: seconds until the drain force-cancels; refusals
+        carry it as Retry-After so clients come back when this worker is
+        actually gone (or its replacement is up), not at a constant."""
         self._draining = True
+        if deadline_s is not None:
+            self._drain_deadline = time.monotonic() + max(deadline_s, 0.0)
         self._wake.set()
 
     @property
     def draining(self) -> bool:
         return self._draining
+
+    def _drain_retry_after(self) -> float:
+        """Retry-After for draining refusals: the remaining drain window
+        when known (clamped to [1, 60]), else the 1 s legacy hint."""
+        if self._drain_deadline is None:
+            return 1.0
+        return min(max(self._drain_deadline - time.monotonic(), 1.0), 60.0)
+
+    def _saturation_retry_after(self) -> float:
+        """Retry-After for saturation bounces, derived from LIVE state:
+        queue depth x recent mean step time / slot count estimates how
+        long until this backlog drains a slot's worth of work. Clamped
+        to [0.25, 30] so a cold engine (no step samples yet) still gives
+        a sane hint."""
+        depth = self._waiting.qsize()
+        samples = list(self.step_times)[-64:]
+        mean_step = (sum(samples) / len(samples)) if samples else 0.05
+        est = depth * mean_step / max(len(self._slots), 1)
+        return min(max(est, 0.25), 30.0)
+
+    def _request_tenancy(
+        self, request: dict[str, Any], context: Context
+    ) -> tuple[str, str]:
+        """(tenant, priority) for one request: validated wire headers
+        first (the frontend edge stamped them into Context.headers),
+        request-dict fields as the direct-caller fallback."""
+        from dynamo_tpu.runtime.context import PRIORITY_HEADER, TENANT_HEADER
+
+        tenant, priority = tenancy_from_headers(context.headers)
+        if TENANT_HEADER not in context.headers and request.get("tenant"):
+            tenant = str(request["tenant"])
+        if (
+            PRIORITY_HEADER not in context.headers
+            and request.get("priority") in ("interactive", "batch")
+        ):
+            priority = str(request["priority"])
+        # cardinality bound: past the dynamic-tenant cap, fresh ids
+        # collapse into the shared overflow tenant (engine/tenancy.py)
+        return self._waiting.resolve(tenant), priority
 
     def inflight(self) -> int:
         """Admitted-but-unfinished work (drain-completion signal)."""
@@ -747,21 +832,27 @@ class InferenceEngine:
             yield {"token_ids": [], "finish_reason": "error",
                    "error": "engine closed"}
             return
+        tenant, priority = self._request_tenancy(request, context)
         if self._draining:
             # SIGTERM drain: typed refusal rides the transport as a
-            # retryable 503-mappable error (another worker may accept)
+            # retryable 503-mappable error (another worker may accept);
+            # Retry-After prices the remaining drain window when known
             self.admission_rejects["draining"] += 1
             raise ServiceUnavailable(
-                "worker draining", retry_after_s=1.0
+                "worker draining", retry_after_s=self._drain_retry_after()
             )
         if (
             self.config.max_waiting
             and self._waiting.qsize() >= self.config.max_waiting
+            and not self._waiting.sheddable_below(priority)
         ):
+            # full queue and nothing outranked: bounce NOW, before any
+            # expensive staging; with a sheddable lower-priority entry
+            # present the enqueue-point check below does the shed
             self.admission_rejects["saturated"] += 1
             raise ServiceUnavailable(
                 f"engine saturated ({self._waiting.qsize()} waiting)",
-                retry_after_s=0.5,
+                retry_after_s=self._saturation_retry_after(),
             )
         if context.deadline_expired:
             self.admission_rejects["deadline"] += 1
@@ -808,6 +899,23 @@ class InferenceEngine:
             yield {"token_ids": [], "finish_reason": "error",
                    "error": f"prompt exceeds max context {self.config.max_context}"}
             return
+        # token-bucket quota (engine/tenancy.py): charged with the
+        # request's full token cost (prompt + decode budget) BEFORE any
+        # staging. Over-quota is a typed, non-retryable bounce whose
+        # Retry-After comes from bucket state — HTTP maps it to 429.
+        # (Preemption resumes re-enter via the internal queue, never
+        # here, so a paused stream is not double-charged.)
+        cost = float(
+            len(token_ids) + self._decode_budget(request, len(token_ids))
+        )
+        quota_retry = self._waiting.charge(tenant, cost)
+        if quota_retry is not None:
+            self.admission_rejects["over_quota"] += 1
+            raise OverQuota(
+                f"tenant {tenant!r} over token quota "
+                f"(cost {cost:.0f} tokens)",
+                retry_after_s=quota_retry,
+            )
         if request.get("guided"):
             # compile (or LRU-fetch) the grammar BEFORE admission, off
             # the step thread: a bad grammar bounces here as a typed
@@ -835,6 +943,8 @@ class InferenceEngine:
                     err = f"guided grammar rejected: {e}"
             if err is not None:
                 GUIDED_REQUESTS.labels(outcome=outcome).inc()
+                # zero service rendered: the quota charge comes back
+                self._waiting.refund(tenant, cost)
                 yield {"token_ids": [], "finish_reason": "error",
                        "error": f"invalid_request: {err}"}
                 return
@@ -856,7 +966,10 @@ class InferenceEngine:
             }
             if self._decode_budget(request, len(token_ids)) <= 1:
                 # the remote-prefill token (already emitted by the handler)
-                # was the whole budget; don't pull KV we'd never use
+                # was the whole budget; don't pull KV we'd never use —
+                # and THIS engine rendered no service, so its charge
+                # comes back (the prefill worker billed its own side)
+                self._waiting.refund(tenant, cost)
                 await asyncio.to_thread(release_kv_blocks, kvp)
                 yield {"token_ids": [], "finish_reason": "length"}
                 return
@@ -904,6 +1017,8 @@ class InferenceEngine:
                         )
                     request["stop_conditions"] = stop
                 if len(token_ids) >= self.config.max_context:
+                    # zero service on this engine: refund the charge
+                    self._waiting.refund(tenant, cost)
                     yield {"token_ids": [], "finish_reason": "error",
                            "error": f"prompt exceeds max context "
                                     f"{self.config.max_context}"}
@@ -914,6 +1029,7 @@ class InferenceEngine:
             # that parked in an await above (e.g. the disagg KV pull)
             # while the engine closed must error, not enqueue into a
             # queue no step thread will ever read
+            self._waiting.refund(tenant, cost)
             yield {"token_ids": [], "finish_reason": "error",
                    "error": "engine closed"}
             return
@@ -923,28 +1039,45 @@ class InferenceEngine:
         ):
             # re-check at the enqueue: the awaits above (start, disagg KV
             # pull) let a burst of concurrent admissions pass the early
-            # check together and blow past the bound
-            if disagg.get("mode") == "decode" and disagg.get("kv_transfer"):
-                # the bounce must not strand the pulled payload or leave
-                # the prefill worker's exported pages pinned to TTL
-                self._drop_staged_kv(request)
-                from dynamo_tpu.disagg.transfer import release_kv_blocks
+            # check together and blow past the bound. Shedding policy
+            # (engine/tenancy.py): bounce the lowest-priority most-over-
+            # quota NEWEST waiting entry in this request's favor when one
+            # ranks below it — degradation by priority, not arrival order.
+            victim = self._waiting.shed_victim(priority)
+            if victim is not None:
+                self.admission_rejects["shed"] += 1
+                # zero service rendered: the victim's bucket charge
+                # comes back (its client retries and is re-charged)
+                self._refund_if_charged(victim)
+                self._release_waiting_disagg(victim)
+                FLIGHT.event(victim.context.id, "shed")
+                self._post(
+                    victim.out_q,
+                    {"_shed": self._saturation_retry_after()},
+                )
+            else:
+                if disagg.get("mode") == "decode" and disagg.get("kv_transfer"):
+                    # the bounce must not strand the pulled payload or leave
+                    # the prefill worker's exported pages pinned to TTL
+                    self._drop_staged_kv(request)
+                    from dynamo_tpu.disagg.transfer import release_kv_blocks
 
-                kvp = {
-                    k: v for k, v in disagg["kv_transfer"].items()
-                    if k != "first_token"
-                }
-                try:
-                    await asyncio.to_thread(release_kv_blocks, kvp)
-                # dynalint: disable=DL003 -- best-effort unpin before the
-                # saturation bounce; TTL reclaim is the backstop
-                except Exception:  # noqa: BLE001
-                    pass
-            self.admission_rejects["saturated"] += 1
-            raise ServiceUnavailable(
-                f"engine saturated ({self._waiting.qsize()} waiting)",
-                retry_after_s=0.5,
-            )
+                    kvp = {
+                        k: v for k, v in disagg["kv_transfer"].items()
+                        if k != "first_token"
+                    }
+                    try:
+                        await asyncio.to_thread(release_kv_blocks, kvp)
+                    # dynalint: disable=DL003 -- best-effort unpin before the
+                    # saturation bounce; TTL reclaim is the backstop
+                    except Exception:  # noqa: BLE001
+                        pass
+                self.admission_rejects["saturated"] += 1
+                self._waiting.refund(tenant, cost)
+                raise ServiceUnavailable(
+                    f"engine saturated ({self._waiting.qsize()} waiting)",
+                    retry_after_s=self._saturation_retry_after(),
+                )
         # flight-recorder timeline + worker-side trace identity: the
         # caller's span (bound by the transport, or live in-context for
         # in-proc calls) parents this request's worker.request span; the
@@ -961,7 +1094,10 @@ class InferenceEngine:
         )
         out_q: asyncio.Queue = asyncio.Queue()
         self._waiting.put_nowait(
-            _Waiting(request, context, out_q, enq_t=time.perf_counter())
+            _Waiting(
+                request, context, out_q, enq_t=time.perf_counter(),
+                tenant=tenant, priority=priority, cost=cost, charged=True,
+            )
         )
         self._wake.set()
         deadline_hit = False
@@ -996,6 +1132,16 @@ class InferenceEngine:
                         continue
                 if item is None:
                     return
+                if "_shed" in item:
+                    # this request was shed from the waiting queue in a
+                    # higher-priority arrival's favor: surface it as the
+                    # retryable typed refusal (another worker may take
+                    # it; the frontend maps exhaustion to 503)
+                    finish_reason = "shed"
+                    raise ServiceUnavailable(
+                        "shed under overload (outranked while waiting)",
+                        retry_after_s=float(item["_shed"]),
+                    )
                 n_generated += len(item.get("token_ids") or ())
                 # record BEFORE the yield: downstream operators stop
                 # iterating once they see the finish item, so this
@@ -1070,8 +1216,8 @@ class InferenceEngine:
                 for i, slot in enumerate(self._slots):
                     if slot is not None:
                         self._finish(i, slot, "error", error="engine step failure")
-                while not self._waiting.empty():
-                    w = self._waiting.get_nowait()
+                for w in self._waiting.drain():
+                    self._refund_if_charged(w)
                     self._drop_staged_kv(w.request)
                     self._post(
                         w.out_q,
@@ -1105,8 +1251,7 @@ class InferenceEngine:
             for i, slot in enumerate(self._slots):
                 if slot is not None:
                     self._finish(i, slot, "error", error="engine closed")
-            while not self._waiting.empty():
-                w = self._waiting.get_nowait()
+            for w in self._waiting.drain():
                 self._drop_staged_kv(w.request)
                 self._post(
                     w.out_q,
@@ -1192,10 +1337,14 @@ class InferenceEngine:
         if self._spec_on:
             did |= self._spec_phase()
 
-        # 2) one decode step over active slots
+        # 2) one decode step over active slots. _decode_step reports
+        # whether it actually dispatched/processed anything: an
+        # all-stalled batch (every slot waiting on pages) must NOT spin
+        # this loop hot — it would burn a core AND exhaust the
+        # MAX_STALL patience budget in ~0.2s instead of seconds, erroring
+        # page-stalled streams preemption could still save
         if any(s is not None for s in self._slots):
-            self._decode_step()
-            did = True
+            did |= self._decode_step()
         elif self._pipeline:
             # every participant finished early (e.g. lazy-materialized
             # first tokens exhausting 1-token budgets): drain stale bursts
@@ -1249,6 +1398,11 @@ class InferenceEngine:
                 ),
                 None,
             )
+            if free_idx is None and not self._waiting.empty():
+                # no free slot for a waiting INTERACTIVE request: pause
+                # an over-quota batch stream instead of making the
+                # interactive user wait out the batch tenant's backlog
+                free_idx = self._preempt_for_admission(reserved)
             if free_idx is None or self._waiting.empty():
                 break
             cost = len(
@@ -1259,7 +1413,12 @@ class InferenceEngine:
                 break  # first admission always proceeds
             if not decoding and n_admitted >= cold_cap:
                 break  # stagger the cold wave (convoy breaker)
-            waiting = self._waiting.get_nowait()
+            try:
+                waiting = self._waiting.get_nowait()
+            except queue.Empty:
+                # a concurrent shed (event loop) emptied the queue
+                # between the check and the dequeue
+                break
             FLIGHT.event(waiting.context.id, "admit")
             if self._profiling:
                 waiting.admit_t = time.perf_counter()
@@ -1275,6 +1434,14 @@ class InferenceEngine:
                 )
             else:
                 out = self._prefill_safe(free_idx, waiting)
+                if out is _REQUEUED:
+                    # page backpressure: the entry went back to its
+                    # lane; nothing else can admit this pass either
+                    # (the pool is the shared constraint) — retry next
+                    # step. NOT counted as work: when the whole engine
+                    # is page-stalled the loop must pace on the idle
+                    # wait, not hot-spin OutOfPages retries.
+                    break
                 if isinstance(out, dict):
                     preps.append(out)
                     reserved.add(free_idx)
@@ -1342,6 +1509,161 @@ class InferenceEngine:
             if self._admit_phase():
                 self.eager_readmits += 1
 
+    # -- priority preemption (runs in thread) ------------------------------
+
+    def _preempt_for_admission(self, reserved: set[int]) -> int | None:
+        """Slot-pressure preemption: the head of the waiting queue is
+        interactive and no slot is free — pause a batch stream and hand
+        its slot to the admission loop. Returns the freed index, or
+        None (no eligible victim / preemption off / head not
+        interactive)."""
+        if not self.config.preemption:
+            return None
+        head = self._waiting.peek()
+        if head is None or head.priority != "interactive":
+            return None
+        return self._preempt_batch_slot(
+            reason="interactive_admission", reserved=reserved
+        )
+
+    def _victim_slot(self) -> tuple[int, _Slot] | None:
+        """Preemption victim policy: batch-class slots only, over-quota
+        tenants first, newest admission first (the oldest batch stream
+        keeps its progress). Slots whose resume would not be a plain
+        text re-prefill (guided/multimodal/disagg) and slots with their
+        first token still in flight are not eligible."""
+        best: tuple[tuple[int, int], int, _Slot] | None = None
+        for i, slot in enumerate(self._slots):
+            if slot is None or slot.priority != "batch":
+                continue
+            if slot.first_pending or slot.context.is_stopped:
+                continue
+            if slot.request is None or slot.remaining < 1:
+                continue
+            req = slot.request
+            if req.get("guided") or req.get("multimodal") or req.get("disagg"):
+                continue
+            over = self._waiting.tenant_over_quota(slot.tenant)
+            key = (0 if over else 1, -slot.admitted_seq)
+            if best is None or key < best[0]:
+                best = (key, i, slot)
+        if best is None:
+            return None
+        return best[1], best[2]
+
+    def _preempt_batch_slot(
+        self, *, reason: str, reserved: set[int] | None = None,
+        free_slot_ok: bool = True,
+    ) -> int | None:
+        """Pause one batch stream to make room (slots AND pages):
+
+        1. fire the ``engine.preempt`` fault site (an injected error
+           skips the preemption — serving degrades to waiting, never
+           breaks);
+        2. flush the decode pipeline + land admission waves so slot
+           state is exact (in-flight bursts reference the victim's
+           pages);
+        3. seal the victim's complete blocks and force-offload them
+           through the KVBM G1->G2 host-tier path (depth filter
+           bypassed: the resume must be able to onboard even after G1
+           eviction);
+        4. release pages + slot, and re-enqueue ``prompt + generated``
+           with the shrunk budget as a batch-lane waiting entry — the
+           client stream pauses, then resumes bit-identically (greedy)
+           through the normal prefix-cache/KVBM admission path, exactly
+           the migration-resume continuity contract.
+
+        Returns the freed slot index (also when the flush alone freed
+        one — then nobody pays), or None."""
+        victim = self._victim_slot()
+        if victim is None:
+            return None
+        if FAULTS.enabled:
+            try:
+                FAULTS.fire_sync("engine.preempt")
+            except Exception as e:  # noqa: BLE001 - injected failure
+                log.warning(
+                    "engine.preempt fault: skipping preemption (%s)", e
+                )
+                FLIGHT.event(
+                    victim[1].context.id, "fault", site="engine.preempt"
+                )
+                return None
+        with self._phase("preempt"):
+            self._flush_pipeline()
+            self._materialize_waves(force=True)
+            if free_slot_ok:
+                # slot-pressure callers are satisfied by ANY free slot
+                # the flush produced. PAGE-pressure callers are not
+                # (free_slot_ok=False): the admitting request's own
+                # still-empty slot would match here and the preemption
+                # would silently no-op without freeing a single page.
+                free_idx = next(
+                    (
+                        i for i, s in enumerate(self._slots)
+                        if s is None and i not in (reserved or ())
+                    ),
+                    None,
+                )
+                if free_idx is not None:
+                    # the flush landed a finish: a slot freed itself
+                    return free_idx
+            i, slot = victim
+            if self._slots[i] is not slot or slot.context.is_stopped:
+                return None  # victim finished/cancelled during the flush
+            self._maybe_seal(slot)
+            if self.kvbm is not None and self.offload is not None:
+                queued = {(s, p) for s, p, _b in self._pending_offload}
+                for bi, (pg, h) in enumerate(
+                    zip(slot.pages.pages, slot.pages.hashes)
+                ):
+                    if h is not None and (h, pg) not in queued:
+                        self._pending_offload.append((h, pg, bi))
+            self._drain_offload()
+            resume = self._build_resume_request(slot)
+            FLIGHT.event(
+                slot.context.id, "preempt",
+                generated=slot.generated, reason=reason,
+            )
+            self.preemptions[reason] = self.preemptions.get(reason, 0) + 1
+            pages, slot.pages.pages = slot.pages.pages, []
+            self.allocator.release(pages)
+            self._slots[i] = None
+            self._waiting.put_nowait(_Waiting(
+                resume, slot.context, slot.out_q,
+                enq_t=time.perf_counter(),
+                tenant=slot.tenant, priority=slot.priority,
+                cost=float(len(resume["token_ids"]) + slot.remaining),
+            ))
+            self._publish_metrics()
+            log.info(
+                "preempted %s (tenant=%s, %d generated) for %s",
+                slot.request_id, slot.tenant, slot.generated, reason,
+            )
+            return i
+
+    @staticmethod
+    def _build_resume_request(slot: _Slot) -> dict[str, Any]:
+        """Resume request for a preempted stream: prompt + everything
+        already streamed becomes the new prompt (sealed blocks rehit the
+        prefix cache / KVBM tiers; only the unsealed tail re-prefills),
+        the decode budget shrinks to what was left, and the sampling
+        seed is pinned so the slot's RNG identity survives the pause."""
+        req = dict(slot.request or {})
+        req["token_ids"] = [int(t) for t in slot.seq.tokens()]
+        stop = dict(req.get("stop_conditions") or {})
+        stop["max_tokens"] = max(int(slot.remaining), 1)
+        if stop.get("min_tokens"):
+            stop["min_tokens"] = max(
+                int(stop["min_tokens"]) - slot.generated, 0
+            )
+        req["stop_conditions"] = stop
+        sampling = dict(req.get("sampling") or {})
+        sampling["seed"] = slot.sample_seed
+        req["sampling"] = sampling
+        req["disagg"] = None
+        return req
+
     def _spmd_sync_state(self) -> list[tuple]:
         """Quiesced KV snapshot for a rejoining follower, as a list of
         ``(page_ids, k, v)`` numpy chunks. Chunked at EXTRACTION, not
@@ -1381,10 +1703,40 @@ class InferenceEngine:
     def _peek_waiting_tokens(self) -> list | None:
         """Prompt tokens of the next waiting request without dequeuing (the
         step thread is the only consumer, so the head is stable)."""
-        with self._waiting.mutex:
-            if not self._waiting.queue:
-                return None
-            return self._waiting.queue[0].request.get("token_ids")
+        head = self._waiting.peek()
+        return None if head is None else head.request.get("token_ids")
+
+    def _refund_if_charged(self, waiting: _Waiting) -> None:
+        """Credit back a charged entry's quota when it is bounced with
+        ZERO service (admission page-pressure give-up, prefill failure):
+        a tenant must not burn bucket on requests it was never served —
+        without this, page-pressure episodes decay retryable errors
+        into 429s for metered tenants."""
+        if getattr(waiting, "charged", False):
+            waiting.charged = False  # at most one refund per entry
+            self._waiting.refund(waiting.tenant, waiting.cost)
+
+    def _release_waiting_disagg(self, waiting: _Waiting) -> None:
+        """Shed-victim cleanup (event-loop side): drop the staged KV
+        host copy AND best-effort unpin the prefill worker's exported
+        pages — the same must-not-pin-to-TTL contract the saturation
+        bounce path keeps for the incoming request."""
+        disagg = waiting.request.get("disagg") or {}
+        self._drop_staged_kv(waiting.request)
+        kvt = disagg.get("kv_transfer")
+        if disagg.get("mode") == "decode" and kvt:
+            from dynamo_tpu.disagg.transfer import release_kv_blocks
+            from dynamo_tpu.runtime.context import spawn
+
+            kvp = {k: v for k, v in kvt.items() if k != "first_token"}
+
+            async def _release() -> None:
+                try:
+                    await asyncio.to_thread(release_kv_blocks, kvp)
+                except Exception as e:  # noqa: BLE001 - TTL backstop
+                    log.debug("shed kv release failed (%s)", e)
+
+            spawn(_release(), name="shed-kv-release")
 
     @staticmethod
     def _drop_staged_kv(request: dict[str, Any]) -> None:
@@ -1404,8 +1756,10 @@ class InferenceEngine:
         """Per-request error isolation: a bad request must not kill the loop.
 
         Returns a prep dict (forward deferred to _run_packed_prefills), a
-        pending-admission record (ring path: forward already ran), or
-        None when handled fully (disagg resume, chunked start, error)."""
+        pending-admission record (ring path: forward already ran), the
+        ``_REQUEUED`` sentinel (OutOfPages backpressure: the entry went
+        back to its lane, the admission pass should stop), or None when
+        handled fully (disagg resume, chunked start, error)."""
         try:
             disagg = waiting.request.get("disagg") or {}
             if disagg.get("mode") == "decode" and disagg.get("kv_transfer"):
@@ -1414,6 +1768,7 @@ class InferenceEngine:
             return self._prefill(slot_idx, waiting)
         except Exception as e:  # noqa: BLE001
             log.exception("prefill failed for %s", waiting.context.id)
+            self._refund_if_charged(waiting)
             self._post(
                 waiting.out_q,
                 {"token_ids": [], "finish_reason": "error",
@@ -1800,6 +2155,7 @@ class InferenceEngine:
                 ),
                 prefix_tokens=token_ids[int(g.get("prompt_len") or len(token_ids)):],
             )
+        self._admit_seq += 1
         return _Slot(
             request_id=waiting.context.id,
             context=waiting.context,
@@ -1808,6 +2164,10 @@ class InferenceEngine:
             pages=sp,
             seq_len=seq_len,
             remaining=remaining,
+            tenant=waiting.tenant,
+            priority=waiting.priority,
+            request=req,
+            admitted_seq=self._admit_seq,
             temperature=temperature,
             top_k=int(self._opt(sampling, "top_k", 0)),
             top_p=float(self._opt(sampling, "top_p", 1.0)),
@@ -1890,18 +2250,65 @@ class InferenceEngine:
             token_ids, cfg.page_size, salt=mm["salt"] if mm else None
         )
         needed_pages = (len(token_ids) + cfg.page_size - 1) // cfg.page_size
+        sp = None
         try:
             sp = self._acquire_prompt_pages(
                 waiting.context.id, seq, needed_pages,
                 n_tokens=len(token_ids), full_prefix_ok=False,
             )
         except OutOfPages:
-            self._post(
-                waiting.out_q,
-                {"token_ids": [], "finish_reason": "error",
-                 "error": "kv pages exhausted"},
-            )
-            return None
+            # PAGE-pressure preemption: an interactive prompt that
+            # cannot get pages may pause a batch stream (its released
+            # pages become evictable/free) and retry ONCE — the other
+            # half of the overload contract, where the pool rather than
+            # the slot table is what the batch tenant exhausted
+            if (
+                cfg.preemption
+                and waiting.priority == "interactive"
+                and self._preempt_batch_slot(
+                    reason="interactive_pages", free_slot_ok=False
+                ) is not None
+            ):
+                try:
+                    sp = self._acquire_prompt_pages(
+                        waiting.context.id, seq, needed_pages,
+                        n_tokens=len(token_ids), full_prefix_ok=False,
+                    )
+                except OutOfPages:
+                    sp = None
+        if sp is None:
+            # page BACKPRESSURE, not a hard error: a neighbor finishing
+            # (or a later preemption) frees pages, so the entry waits in
+            # its lane exactly like a decode-stalled slot waits — the
+            # transparent-resume contract for preempted streams depends
+            # on this. Bounded patience (MAX_WAIT_PAGE_STALLS admission
+            # passes, ~2ms apart when the engine is otherwise idle), and
+            # a prompt that could NEVER fit errors immediately.
+            if needed_pages >= self.allocator.num_pages - 1:
+                self._refund_if_charged(waiting)
+                self._post(
+                    waiting.out_q,
+                    {"token_ids": [], "finish_reason": "error",
+                     "error": f"kv pages exhausted (prompt needs "
+                              f"{needed_pages} pages; pool can never "
+                              "hold it)"},
+                )
+                return None
+            if waiting.page_stalls >= 2000:
+                self._refund_if_charged(waiting)
+                self._post(
+                    waiting.out_q,
+                    {"token_ids": [], "finish_reason": "error",
+                     "error": "kv pages exhausted (admission waited "
+                              f"{waiting.page_stalls} passes)"},
+                )
+                return None
+            waiting.page_stalls += 1
+            # lane-head requeue with the vtime advance undone: a stall
+            # retry is zero service and must not burn fair share or
+            # drop behind later same-tenant arrivals
+            self._waiting.requeue(waiting)
+            return _REQUEUED
         start_pos = sp.cached_prefix_pages * cfg.page_size
         tail = len(token_ids) - start_pos
 
@@ -2085,6 +2492,7 @@ class InferenceEngine:
                 for p in group:
                     self.allocator.release(p["sp"].pages)
                     p["sp"].pages = []
+                    self._refund_if_charged(p["waiting"])
                     self._post(
                         p["waiting"].out_q,
                         {"token_ids": [], "finish_reason": "error",
@@ -2179,6 +2587,7 @@ class InferenceEngine:
             self._spmd_broken("prefill failed after publish", since=pmark)
             self.allocator.release(p["sp"].pages)
             p["sp"].pages = []
+            self._refund_if_charged(p["waiting"])
             self._post(
                 p["waiting"].out_q,
                 {"token_ids": [], "finish_reason": "error",
@@ -3131,7 +3540,7 @@ class InferenceEngine:
 
     # -- decode (runs in thread) -------------------------------------------
 
-    def _decode_step(self) -> None:
+    def _decode_step(self) -> bool:
         """One decode dispatch: ``decode_steps_per_dispatch`` model steps +
         on-device sampling fused into a single jit call (host dispatch and
         the device->host token sync amortize over the burst — the TPU
@@ -3155,8 +3564,16 @@ class InferenceEngine:
         they are live: a pipelined burst would dispatch with a mask
         computed BEFORE the in-flight burst's tokens advanced the host
         automaton — a stale mask is a broken guarantee. Free-only
-        batches keep the full pipeline."""
+        batches keep the full pipeline.
+
+        Returns True when device/stream work actually happened this
+        cycle; False when nothing could be built (every live slot
+        page-stalled or spec-managed) so the caller paces the loop with
+        the idle wait instead of spinning hot."""
         if self.config.pipeline_decode and self._guided_live():
+            # flush any in-flight bursts, then FALL THROUGH to the
+            # synchronous single-step schedule below (guided slots need
+            # fresh masks per dispatch)
             if self._pipeline:
                 with self._phase("flush"):
                     self._flush_pipeline()
@@ -3171,7 +3588,8 @@ class InferenceEngine:
                     self._eager_readmit(
                         before - sum(s is not None for s in self._slots)
                     )
-                return
+                    return True
+                return False
             with self._phase("dispatch"):
                 results = self._dispatch_burst(
                     batch, chain=self._pipeline or None
@@ -3188,11 +3606,11 @@ class InferenceEngine:
                 self._eager_readmit(
                     before - sum(s is not None for s in self._slots)
                 )
-            return
+            return True
         with self._phase("build_batch"):
             batch = self._build_batch(None)
         if batch is None:
-            return
+            return False
         before = sum(s is not None for s in self._slots)
         with self._phase("dispatch"):
             results = self._dispatch_burst(batch, chain=None)
@@ -3201,6 +3619,7 @@ class InferenceEngine:
         self._eager_readmit(
             before - sum(s is not None for s in self._slots)
         )
+        return True
 
     def _guided_live(self) -> bool:
         """True while any live slot is grammar-constrained (those cycles
@@ -3313,7 +3732,11 @@ class InferenceEngine:
                     # will free pages. Only give up after a long stall.
                     slot.stalled_steps += 1
                     if slot.stalled_steps > MAX_STALL:
-                        self._finish(i, slot, "error", error="kv pages exhausted")
+                        self._finish(
+                            i, slot, "error",
+                            error="kv pages exhausted (decode stalled "
+                                  f"{slot.stalled_steps} steps)",
+                        )
                     stalled = True
                     break
             if stalled:

@@ -90,7 +90,20 @@ _M_KVBM_TIER = REGISTRY.gauge(
     ["engine", "tier"],
 )
 
-_REJECT_REASONS = ("draining", "saturated", "deadline")
+_M_PREEMPT = REGISTRY.counter(
+    "engine_preemptions_total",
+    "batch streams paused to the host tier by reason "
+    "(interactive_admission | interactive_pages)",
+    ["engine", "reason"],
+)
+_M_TENANT_TOKENS = REGISTRY.counter(
+    "tenant_tokens_total",
+    "admission-charged token cost by tenant and outcome "
+    "(admitted | rejected | shed) — the live per-tenant quota picture",
+    ["engine", "tenant", "outcome"],
+)
+
+_REJECT_REASONS = ("draining", "saturated", "deadline", "over_quota", "shed")
 _COLLECTOR_IDS = iter(range(1 << 30))
 
 
@@ -117,6 +130,8 @@ class EngineCollector:
         # bounces) belong in the cumulative counters too.
         self._dispatch_base = 0
         self._reject_base = {k: 0 for k in engine.admission_rejects}
+        self._preempt_base: dict[str, int] = {}
+        self._tenant_base: dict[tuple[str, str], int] = {}
         self._d2h_base = self._d2h_secs()
         self._t_base = time.monotonic()
 
@@ -169,6 +184,21 @@ class EngineCollector:
             if delta > 0:
                 _M_REJECTS.labels(lbl, reason).inc(delta)
                 self._reject_base[reason] = cur
+        # overload-control plane: preemption counts (engine.preemptions)
+        # and per-tenant charged token cost (the fair-admission
+        # scheduler's token_counts feed, engine/tenancy.py)
+        for reason, cur in dict(eng.preemptions).items():
+            delta = cur - self._preempt_base.get(reason, 0)
+            if delta > 0:
+                _M_PREEMPT.labels(lbl, reason).inc(delta)
+                self._preempt_base[reason] = cur
+        counts = getattr(eng._waiting, "token_counts", None)
+        if counts:
+            for key, cur in dict(counts).items():
+                delta = cur - self._tenant_base.get(key, 0)
+                if delta > 0:
+                    _M_TENANT_TOKENS.labels(lbl, key[0], key[1]).inc(delta)
+                    self._tenant_base[key] = cur
         if eng.kvbm is not None:
             for tier, nbytes in eng.kvbm.tier_bytes().items():
                 _M_KVBM_TIER.labels(lbl, tier).set(nbytes)

@@ -409,6 +409,14 @@ async def _amain(args: argparse.Namespace) -> None:
         spec_ngram_min=args.spec_ngram_min,
         spec_ngram_max=args.spec_ngram_max,
         guided_mode=guided_mode,
+        # overload plane: CLI flag > DYN_TENANT_QUOTAS / YAML layer >
+        # unmetered; admission bound + preemption ride along
+        tenants=(
+            args.tenant_quotas if args.tenant_quotas is not None
+            else (env_cfg.tenant_quotas or "")
+        ),
+        max_waiting=args.max_waiting,
+        preemption=args.preemption,
     )
     spmd_leader = None
     if args.mirror == "follower":
@@ -573,16 +581,21 @@ def _install_drain_handler(drt, engine, served) -> None:
         pass  # non-unix event loop
 
 
-async def _withdraw_and_begin_drain(drt, engine, served) -> None:
+async def _withdraw_and_begin_drain(
+    drt, engine, served, deadline_s: float | None = None
+) -> None:
     """Steps 1-2 of the drain contract, shared by the SIGTERM path and the
     admin ``drain`` RPC: WITHDRAW the instance key from the hub (lease kept
     alive, so routers stop picking this worker within one watch event),
-    then STOP ADMITTING (new generates refuse with ServiceUnavailable)."""
+    then STOP ADMITTING (new generates refuse with ServiceUnavailable,
+    whose Retry-After is the remaining drain window when known)."""
     try:
         await drt.hub.delete(served.instance.path)
     except (ConnectionError, RuntimeError) as e:
         log.warning("drain: instance withdrawal failed (%s)", e)
-    engine.begin_drain()
+    engine.begin_drain(
+        drt.config.drain_timeout_s if deadline_s is None else deadline_s
+    )
 
 
 async def graceful_drain(
@@ -607,7 +620,7 @@ async def graceful_drain(
         "SIGTERM: graceful drain (%d in flight, timeout %.0fs)",
         engine.inflight(), timeout_s,
     )
-    await _withdraw_and_begin_drain(drt, engine, served)
+    await _withdraw_and_begin_drain(drt, engine, served, timeout_s)
     loop = asyncio.get_running_loop()
     deadline = loop.time() + timeout_s
     server = drt._server
@@ -701,6 +714,24 @@ def main() -> None:
                    help="shortest suffix n-gram the drafter matches")
     p.add_argument("--spec-ngram-max", type=int, default=4,
                    help="longest suffix n-gram (tried first)")
+    p.add_argument("--tenant-quotas", default=None,
+                   help="per-tenant fairness/quota spec "
+                        "('tenantA:weight=4,rate=1000,burst=2000;"
+                        "*:rate=200'); weight = fair share under "
+                        "contention, rate = token-bucket refill/s "
+                        "(over-quota requests get a typed 429 + "
+                        "Retry-After), '*' = default tenant. Default "
+                        "from DYN_TENANT_QUOTAS, else unmetered")
+    p.add_argument("--max-waiting", type=int, default=0,
+                   help="admission queue bound: beyond this the engine "
+                        "sheds lowest-priority waiting work or answers "
+                        "503 + live Retry-After (0 = unbounded)")
+    p.add_argument("--preemption", action=argparse.BooleanOptionalAction,
+                   default=True,
+                   help="pause batch streams (over-quota tenants "
+                        "first; KV offloaded to the host tier, "
+                        "transparently resumed) when an interactive "
+                        "request cannot admit")
     p.add_argument("--guided", default=None, choices=["auto", "off"],
                    help="guided decoding: 'auto' (default) serves "
                         "response_format / forced tool_choice with "

@@ -33,8 +33,11 @@ from dynamo_tpu.frontend.watcher import ModelManager, ModelPipeline
 from dynamo_tpu.runtime import tracing
 from dynamo_tpu.runtime.compute import ComputePool
 from dynamo_tpu.runtime.context import (
+    PRIORITY_HEADER,
+    TENANT_HEADER,
     Context,
     DeadlineExceeded,
+    OverQuota,
     ServiceUnavailable,
     StreamError,
     tighten_timeout_s,
@@ -160,8 +163,21 @@ class HttpFrontend:
         END-TO-END DEADLINE (default ``request_timeout_s``;
         ``x-dyn-timeout-ms`` tightens it), propagated frontend ->
         migration -> worker so no failure chain can cost a client more
-        than its budget."""
-        headers: dict[str, str] = {}
+        than its budget.
+
+        Tenancy (overload-control plane): the validated tenant id +
+        priority class (``x-dyn-tenant`` / ``x-dyn-priority`` /
+        api-key digest — frontend/validation.py validate_tenancy) are
+        stamped into the same baggage headers, so they travel EPP ->
+        transport -> worker and the engine's fair-admission layer sees
+        exactly what the edge authenticated. Raises
+        RequestValidationError (-> 400) on malformed tenancy headers."""
+        from dynamo_tpu.frontend.validation import validate_tenancy
+
+        tenant, priority = validate_tenancy(request.headers)
+        headers: dict[str, str] = {
+            TENANT_HEADER: tenant, PRIORITY_HEADER: priority,
+        }
         cur = tracing.current_trace()
         if cur is None:
             cur = tracing.ensure_trace(headers)
@@ -286,7 +302,12 @@ class HttpFrontend:
         # this keep-alive connection's task.
         tracing.bind_trace(request.headers)
         with tracing.span("http.request", route=route, model=model):
-            ctx = self._traced_context(request)
+            try:
+                ctx = self._traced_context(request)
+            except RequestValidationError as e:
+                # malformed tenancy header: typed 400 naming the header
+                self._m_requests.labels(model, route, "400").inc()
+                return _error(400, str(e), param=e.param)
             return await self._serve_completions(
                 request, body, pipe, route, chat=chat, ctx=ctx
             )
@@ -401,6 +422,18 @@ class HttpFrontend:
                     ),
                 )
                 return web.json_response(agg)
+        except OverQuota as e:
+            # the tenant's token bucket refused the request: typed 429
+            # whose Retry-After is the bucket's own deficit / refill
+            # estimate (engine/tenancy.py) — distinct from the 503 below
+            # because backing off is the CLIENT's job here, not ours
+            ctx.stop_generating()
+            self._m_requests.labels(model, route, "429").inc()
+            self._audit(route, model, ctx, body, 429, t_start, error=str(e))
+            return _error(
+                429, f"over quota: {e}", code="over_quota",
+                headers={"Retry-After": _retry_after_header(e.retry_after_s)},
+            )
         except (ServiceUnavailable, NoInstancesError) as e:
             # every worker draining/saturated (or none left) and the retry
             # budget exhausted: tell the client WHEN to come back instead
@@ -412,7 +445,7 @@ class HttpFrontend:
             self._audit(route, model, ctx, body, 503, t_start, error=str(e))
             return _error(
                 503, f"service unavailable: {e}", code="service_unavailable",
-                headers={"Retry-After": str(max(int(retry_after), 1))},
+                headers={"Retry-After": _retry_after_header(retry_after)},
             )
         except DeadlineExceeded as e:
             ctx.stop_generating()
@@ -559,10 +592,31 @@ class HttpFrontend:
         chat_body = {k: v for k, v in chat_body.items() if v is not None}
         tracing.bind_trace(request.headers)
         with tracing.span("http.request", route="responses", model=model):
-            ctx = self._traced_context(request)
-            return await self._serve_responses(
-                request, body, pipe, chat_body, ctx
-            )
+            try:
+                ctx = self._traced_context(request)
+            except RequestValidationError as e:
+                self._m_requests.labels(model, "responses", "400").inc()
+                return _error(400, str(e), param=e.param)
+            t_start = time.monotonic()
+            try:
+                return await self._serve_responses(
+                    request, body, pipe, chat_body, ctx
+                )
+            except OverQuota as e:
+                # same 429 accounting contract as the completions routes:
+                # counted + audited, never just silently returned
+                ctx.stop_generating()
+                self._m_requests.labels(model, "responses", "429").inc()
+                self._audit(
+                    "responses", model, ctx, body, 429, t_start,
+                    error=str(e),
+                )
+                return _error(
+                    429, f"over quota: {e}", code="over_quota",
+                    headers={
+                        "Retry-After": _retry_after_header(e.retry_after_s)
+                    },
+                )
 
     async def _serve_responses(
         self, request: web.Request, body: dict, pipe: ModelPipeline,
@@ -802,7 +856,10 @@ class HttpFrontend:
         with tracing.span(
             "http.request", route="embeddings", model=pipe.card.name
         ):
-            ctx = self._traced_context(request)
+            try:
+                ctx = self._traced_context(request)
+            except RequestValidationError as e:
+                return _error(400, str(e), param=e.param)
             return await self._serve_embeddings(pipe, inputs, ctx)
 
     async def _serve_embeddings(
@@ -872,6 +929,15 @@ class HttpFrontend:
             content_type="text/plain",
             charset="utf-8",
         )
+
+
+def _retry_after_header(retry_after_s: float) -> str:
+    """HTTP Retry-After is integer seconds: round UP so a 0.4 s hint
+    becomes 1, never 0 (a zero would read as 'retry immediately' and
+    defeat the backoff the hint exists to request)."""
+    import math
+
+    return str(max(int(math.ceil(retry_after_s)), 1))
 
 
 def _error(

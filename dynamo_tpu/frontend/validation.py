@@ -11,12 +11,25 @@ and the hot path stays allocation-light.
 
 from __future__ import annotations
 
+import hashlib
+import re
 from typing import Any
 
-__all__ = ["RequestValidationError", "validate_request"]
+__all__ = [
+    "RequestValidationError",
+    "validate_request",
+    "validate_tenancy",
+]
 
 _ROLES = {"system", "developer", "user", "assistant", "tool"}
 _CONTENT_PART_TYPES = {"text", "image_url", "video_url"}
+
+# tenancy edge validation (overload-control plane): the tenant id rides
+# wire headers, metric labels, and log lines — constrain it to a safe
+# charset/length HERE so a hostile header can't smuggle label injection
+# or unbounded cardinality into every downstream surface
+_TENANT_RE = re.compile(r"^[A-Za-z0-9._-]{1,64}$")
+_PRIORITIES = ("interactive", "batch")
 
 
 class RequestValidationError(ValueError):
@@ -207,6 +220,43 @@ def _check_tools(body: dict) -> None:
                 f"'{where}.function.name' is required",
                 f"{where}.function",
             )
+
+
+def validate_tenancy(headers: Any) -> tuple[str, str]:
+    """Validate + resolve the request's (tenant, priority) at the edge.
+
+    Sources, in precedence order: the explicit ``x-dyn-tenant`` header;
+    an ``Authorization`` bearer credential (hashed to a stable opaque
+    ``key-<digest>`` id so API-key traffic gets per-key fairness without
+    the key itself ever reaching headers/labels/logs); else the shared
+    ``default`` tenant. Priority comes from ``x-dyn-priority``
+    (``interactive`` | ``batch``; default interactive).
+
+    Raises RequestValidationError (-> HTTP 400 naming the header) on a
+    malformed tenant id or unknown priority class — a typo'd priority
+    must not silently demote (or promote) the request."""
+    tenant = (headers.get("x-dyn-tenant") or "").strip()
+    if tenant:
+        if not _TENANT_RE.match(tenant):
+            _fail(
+                "'x-dyn-tenant' must be 1-64 chars of [A-Za-z0-9._-]",
+                "x-dyn-tenant",
+            )
+    else:
+        auth = (headers.get("Authorization")
+                or headers.get("authorization") or "").strip()
+        if auth:
+            cred = auth.split(None, 1)[-1].encode()
+            tenant = "key-" + hashlib.sha256(cred).hexdigest()[:12]
+        else:
+            tenant = "default"
+    priority = (headers.get("x-dyn-priority") or "interactive").strip().lower()
+    if priority not in _PRIORITIES:
+        _fail(
+            f"'x-dyn-priority' must be one of {list(_PRIORITIES)}",
+            "x-dyn-priority",
+        )
+    return tenant, priority
 
 
 def validate_request(body: Any, kind: str) -> None:

@@ -35,10 +35,12 @@ from typing import Any
 
 from aiohttp import web
 
+from dynamo_tpu.gateway.breaker import BreakerBoard, BreakerConfig
 from dynamo_tpu.kv_router.protocols import RouterConfig
 from dynamo_tpu.kv_router.router import KvRouter
 from dynamo_tpu.runtime import tracing
 from dynamo_tpu.runtime.component import INSTANCE_ROOT, Instance
+from dynamo_tpu.runtime.faults import FAULTS
 from dynamo_tpu.runtime.metrics import MetricsRegistry
 
 log = logging.getLogger("dynamo.gateway.epp")
@@ -129,6 +131,7 @@ class EndpointPicker:
         host: str = "0.0.0.0",
         port: int = 9002,
         card_ttl_s: float = 2.0,
+        breaker_config: "BreakerConfig | None" = None,
     ):
         self.drt = drt
         self.namespace = namespace
@@ -153,6 +156,22 @@ class EndpointPicker:
         self._m_cache = self.metrics.counter(
             "epp_cache_lookups_total",
             "pick-path prefix-cache lookups", ["cache", "outcome"],
+        )
+        # per-instance circuit breakers (gateway/breaker.py): rolling
+        # error/latency scoring over reported pick outcomes; OPEN
+        # instances are excluded from picks, half-open probes re-admit
+        # recovered workers. State gauge: 0 closed / 1 half-open / 2 open.
+        self._m_breaker = self.metrics.gauge(
+            "epp_breaker_state",
+            "per-instance circuit-breaker state "
+            "(0 closed, 1 half-open, 2 open)", ["instance"],
+        )
+        self.breakers = BreakerBoard(
+            breaker_config or BreakerConfig(),
+            on_state=lambda iid, st: self._m_breaker.labels(
+                f"{iid:x}"
+            ).set(st),
+            on_forget=self._drop_breaker_series,
         )
         # pick-path caches: model cards (tokenizer resolution) and
         # instance records (winner address) — both watch-invalidated
@@ -187,6 +206,7 @@ class EndpointPicker:
         ]
         app = web.Application()
         app.router.add_post("/pick", self._pick)
+        app.router.add_post("/report", self._report)
         app.router.add_get("/healthz", self._healthz)
         app.router.add_get("/metrics", self._metrics)
         self._runner = web.AppRunner(app)
@@ -304,15 +324,49 @@ class EndpointPicker:
         rid = body.get("request_id", "epp")
         try:
             # decision-only probe: find + free, like the router service's
-            # best_worker endpoint (kv_router/service.py)
-            worker_id, overlap = self.kv.find_best_match(
-                rid, list(token_ids)
-            )
-            self.kv.free(rid)
+            # best_worker endpoint (kv_router/service.py). Breaker-
+            # ejected instances are excluded from the candidate set;
+            # a HALF-OPEN winner consumes a probe slot via allow() and,
+            # when its probe budget is spent, the pick re-runs with it
+            # excluded too (fail open when exclusions empty the pool).
+            if self.picks and self.picks % 256 == 0:
+                # periodic breaker GC: drop state (and gauge series) for
+                # instances that left the fleet, so worker churn cannot
+                # grow the board without bound
+                self.breakers.forget(self._live_instance_ids())
+            excluded = self.breakers.ejected()
+            # enough attempts to walk past every breaker-limited
+            # instance before fail-open kicks in — a constant cap would
+            # route to a disallowed worker while healthy ones remain
+            attempts = max(3, len(self._live_instance_ids()) + 1)
+            for _attempt in range(attempts):
+                worker_id, overlap = self.kv.find_best_match(
+                    rid, list(token_ids), exclude=excluded or None
+                )
+                self.kv.free(rid)
+                if worker_id in excluded or self.breakers.allow(worker_id):
+                    # in `excluded` means the exclusion was overridden
+                    # (it would have emptied the pool): serve fail-open
+                    break
+                excluded = set(excluded) | {worker_id}
         except Exception as e:  # noqa: BLE001 — no workers yet
             return web.json_response(
                 {"error": f"no routable worker: {e}"}, status=503
             )
+        if FAULTS.enabled:
+            try:
+                # chaos hook: an injected error at epp.breaker records a
+                # FAILURE outcome against the picked instance — a sick
+                # worker simulated at the scoring layer, so schedules can
+                # prove eject -> brownout -> half-open -> recovery
+                # without a genuinely broken engine
+                await FAULTS.fire("epp.breaker")
+            except Exception as e:  # noqa: BLE001 - injected outcome
+                log.warning(
+                    "epp.breaker fault: recording failure against %x "
+                    "(%s)", worker_id, e,
+                )
+                self.breakers.record(worker_id, ok=False)
         endpoint = await self._endpoint_of(worker_id)
         if endpoint is None:
             return web.json_response(
@@ -329,6 +383,74 @@ class EndpointPicker:
             },
             headers={"x-gateway-destination-endpoint": endpoint},
         )
+
+    def _drop_breaker_series(self, iid: int) -> None:
+        """Remove a departed instance's epp_breaker_state series — a
+        phantom 'open' gauge for a worker that no longer exists would
+        mislead every dashboard built on it."""
+        try:
+            self._m_breaker.remove(f"{iid:x}")
+        except KeyError:
+            pass  # series never materialized for this instance
+
+    def _live_instance_ids(self) -> set[int]:
+        """Worker ids the router currently schedules over (its instance
+        watch keeps this current) — the breaker board's membership."""
+        if self.kv is None:
+            return set()
+        return {w.worker_id for w in self.kv.scheduler.workers()}
+
+    async def _report(self, req: web.Request) -> web.Response:
+        """Outcome feedback for the circuit breakers: the gateway (or
+        any caller that acted on a /pick) posts what actually happened
+        to the routed request::
+
+            POST /report {"worker_id": N | "hex", "ok": bool,
+                          "latency_ms": float}
+
+        Errors and over-SLO latencies push the instance toward OPEN
+        (ejected from picks); successes close a half-open breaker."""
+        try:
+            body = await req.json()
+        # dynalint: disable=DL003 -- mapped to a typed 400 response
+        except Exception:  # noqa: BLE001
+            return web.json_response(
+                {"error": "body must be JSON"}, status=400
+            )
+        raw = body.get("worker_id")
+        try:
+            worker_id = int(raw, 16) if isinstance(raw, str) else int(raw)
+        except (TypeError, ValueError):
+            return web.json_response(
+                {"error": "worker_id must be an int or hex string"},
+                status=400,
+            )
+        ok = body.get("ok")
+        if not isinstance(ok, bool):
+            return web.json_response(
+                {"error": "ok must be a boolean"}, status=400
+            )
+        try:
+            latency_s = float(body.get("latency_ms") or 0.0) / 1000.0
+        except (TypeError, ValueError):
+            return web.json_response(
+                {"error": "latency_ms must be a number"}, status=400
+            )
+        if (
+            worker_id not in self._live_instance_ids()
+            and not self.breakers.knows(worker_id)
+        ):
+            # reports only mint breaker state for instances the router
+            # actually knows (or already-tracked ones mid-deregistration)
+            # — arbitrary caller-supplied ids must not grow the board
+            return web.json_response(
+                {"error": f"unknown worker {worker_id:x}"}, status=404
+            )
+        self.breakers.record(worker_id, ok, latency_s)
+        return web.json_response({
+            "worker_id": worker_id,
+            "state": self.breakers.state_name(worker_id),
+        })
 
     async def close(self) -> None:
         for t in self._watch_tasks:

@@ -31,8 +31,11 @@ import grpc
 from dynamo_tpu.frontend.protocols import new_request_id
 from dynamo_tpu.grpc import kserve_pb2 as pb
 from dynamo_tpu.runtime.context import (
+    PRIORITY_HEADER,
+    TENANT_HEADER,
     Context,
     DeadlineExceeded,
+    OverQuota,
     ServiceUnavailable,
     tighten_timeout_s,
 )
@@ -45,6 +48,32 @@ SERVICE = "inference.GRPCInferenceService"
 def _param_value(p: pb.InferParameter):
     which = p.WhichOneof("parameter_choice")
     return getattr(p, which) if which else None
+
+
+def _stamp_retry_after(grpc_ctx, retry_after_s: float) -> None:
+    """Retry-After passthrough for the gRPC surface: trailing metadata
+    ``retry-after`` in (fractional) seconds on UNAVAILABLE /
+    RESOURCE_EXHAUSTED aborts — same live-derived hint the HTTP
+    frontend sends as a header, so gRPC clients can back off exactly
+    as far instead of guessing."""
+    set_md = getattr(grpc_ctx, "set_trailing_metadata", None)
+    if callable(set_md):
+        try:
+            set_md((("retry-after", f"{max(retry_after_s, 0.0):g}"),))
+        except (TypeError, ValueError, RuntimeError):  # pragma: no cover
+            pass  # metadata is advisory; the abort still carries the code
+
+
+async def _abort_backpressure(grpc_ctx, e: Exception) -> None:
+    """Map a typed backpressure refusal to its gRPC status: quota ->
+    RESOURCE_EXHAUSTED (the 429 of this surface), draining/saturated ->
+    UNAVAILABLE (the 503); both carry the retry-after trailing hint."""
+    _stamp_retry_after(grpc_ctx, getattr(e, "retry_after_s", 1.0))
+    code = (
+        grpc.StatusCode.RESOURCE_EXHAUSTED
+        if isinstance(e, OverQuota) else grpc.StatusCode.UNAVAILABLE
+    )
+    await grpc_ctx.abort(code, str(e))
 
 
 def _text_output_response(
@@ -310,7 +339,22 @@ class KserveGrpcFrontend:
                 min(remaining, timeout_s) if timeout_s > 0 else remaining
             )
         deadline = time.monotonic() + timeout_s if timeout_s > 0 else None
-        return Context(request_id=rid, deadline=deadline)
+        # tenancy metadata (same edge contract as the HTTP frontend's
+        # validate_tenancy, over gRPC invocation metadata): validated
+        # here and stamped into the baggage headers the engine's
+        # fair-admission layer reads. Malformed values raise
+        # RequestValidationError (a ValueError) -> INVALID_ARGUMENT at
+        # the _parse_request call sites' existing mapping.
+        headers: dict[str, str] = {}
+        meta_fn = getattr(grpc_ctx, "invocation_metadata", None)
+        if callable(meta_fn):
+            from dynamo_tpu.frontend.validation import validate_tenancy
+
+            meta = {k.lower(): v for k, v in (meta_fn() or ())}
+            tenant, priority = validate_tenancy(meta)
+            headers[TENANT_HEADER] = tenant
+            headers[PRIORITY_HEADER] = priority
+        return Context(request_id=rid, headers=headers, deadline=deadline)
 
     @staticmethod
     def _apply_params(body: dict[str, Any], params: dict) -> dict[str, Any]:
@@ -375,7 +419,10 @@ class KserveGrpcFrontend:
                 "streaming=true requires the ModelStreamInfer RPC",
             )
         rid = req.id or new_request_id()
-        ctx = self._root_context(req, grpc_ctx, rid)
+        try:
+            ctx = self._root_context(req, grpc_ctx, rid)
+        except ValueError as e:  # malformed tenancy metadata
+            await grpc_ctx.abort(grpc.StatusCode.INVALID_ARGUMENT, str(e))
         if mode == "openai":
             try:
                 pre = pipe.preprocessor.preprocess(body)
@@ -399,10 +446,11 @@ class KserveGrpcFrontend:
                 await grpc_ctx.abort(
                     grpc.StatusCode.DEADLINE_EXCEEDED, str(e)
                 )
-            except ServiceUnavailable as e:
-                # draining/saturated worker, retries exhausted: the
-                # retryable status (HTTP 503 equivalent), not UNKNOWN
-                await grpc_ctx.abort(grpc.StatusCode.UNAVAILABLE, str(e))
+            except (ServiceUnavailable, OverQuota) as e:
+                # draining/saturated -> UNAVAILABLE (the 503 of this
+                # surface), tenant quota -> RESOURCE_EXHAUSTED (the
+                # 429); both carry retry-after trailing metadata
+                await _abort_backpressure(grpc_ctx, e)
             finally:
                 ctx.stop_generating()
             return _openai_response(req.model_name, rid, agg, final=True)
@@ -420,8 +468,8 @@ class KserveGrpcFrontend:
                     )
         except DeadlineExceeded as e:
             await grpc_ctx.abort(grpc.StatusCode.DEADLINE_EXCEEDED, str(e))
-        except ServiceUnavailable as e:
-            await grpc_ctx.abort(grpc.StatusCode.UNAVAILABLE, str(e))
+        except (ServiceUnavailable, OverQuota) as e:
+            await _abort_backpressure(grpc_ctx, e)
         finally:
             ctx.stop_generating()
         return _text_output_response(
@@ -439,7 +487,11 @@ class KserveGrpcFrontend:
             yield pb.ModelStreamInferResponse(error_message=str(e))
             return
         rid = req.id or new_request_id()
-        ctx = self._root_context(req, grpc_ctx, rid)
+        try:
+            ctx = self._root_context(req, grpc_ctx, rid)
+        except ValueError as e:  # malformed tenancy metadata
+            yield pb.ModelStreamInferResponse(error_message=str(e))
+            return
         streaming = streaming is not False  # stream RPC defaults to True
         if mode == "openai":
             # OpenAI-over-gRPC streaming: one chunk object per response,
@@ -491,9 +543,13 @@ class KserveGrpcFrontend:
                             req.model_name, rid, prev, final=True
                         )
                     )
-            except (DeadlineExceeded, ServiceUnavailable) as e:
-                # mid-stream 504/503: the stream protocol reports via
-                # error_message, mirroring the HTTP SSE error event
+            except (DeadlineExceeded, ServiceUnavailable, OverQuota) as e:
+                # mid-stream 504/503/429: the stream protocol reports
+                # via error_message, mirroring the HTTP SSE error event;
+                # backpressure refusals still land their retry hint as
+                # trailing metadata
+                if isinstance(e, (ServiceUnavailable, OverQuota)):
+                    _stamp_retry_after(grpc_ctx, e.retry_after_s)
                 yield pb.ModelStreamInferResponse(error_message=str(e))
             finally:
                 ctx.stop_generating()
@@ -534,7 +590,9 @@ class KserveGrpcFrontend:
                             token_ids=ids if mode == "tokens" else None,
                         )
                     )
-        except (DeadlineExceeded, ServiceUnavailable) as e:
+        except (DeadlineExceeded, ServiceUnavailable, OverQuota) as e:
+            if isinstance(e, (ServiceUnavailable, OverQuota)):
+                _stamp_retry_after(grpc_ctx, e.retry_after_s)
             yield pb.ModelStreamInferResponse(error_message=str(e))
         finally:
             # client disconnect mid-stream cancels the backend request

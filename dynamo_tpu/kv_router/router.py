@@ -174,12 +174,19 @@ class KvRouter:
     # -- the routing decision ---------------------------------------------
 
     def find_best_match(
-        self, request_id: str, token_ids: list[int], *, salt: str | None = None
+        self, request_id: str, token_ids: list[int], *,
+        salt: str | None = None, exclude: "set[int] | None" = None,
     ) -> tuple[int, int]:
         """Pick a worker for ``token_ids``; returns (worker_id, overlap_blocks).
 
         Registers the request in active-sequence tracking; callers MUST pair
         with ``free(request_id)`` when the stream ends.
+
+        ``exclude``: instance ids the caller's circuit breakers have
+        ejected (gateway/breaker.py) — dropped from the candidate set
+        unless that would leave NO candidates, in which case the
+        exclusion is ignored (fail open: a fully-browned-out pool still
+        routes rather than blackholing).
         """
         bs = self.config.block_size
         seq_hashes = compute_sequence_hashes(token_ids, bs, salt)
@@ -195,7 +202,9 @@ class KvRouter:
         for wid, (blocks, ptok) in self.sequences.loads().items():
             self.scheduler.set_predicted_load(wid, blocks, ptok)
 
-        worker_id, overlap = self.scheduler.schedule(request_blocks, overlaps)
+        worker_id, overlap = self.scheduler.schedule(
+            request_blocks, overlaps, exclude=exclude
+        )
         self.sequences.add_request(
             request_id,
             worker_id,

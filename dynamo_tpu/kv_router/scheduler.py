@@ -148,10 +148,19 @@ class KvScheduler:
         return list(self._states.values())
 
     def schedule(
-        self, request_blocks: int, overlaps: OverlapScores
+        self, request_blocks: int, overlaps: OverlapScores,
+        *, exclude: "set[int] | None" = None,
     ) -> tuple[int, int]:
-        """Pick (worker_id, overlap_blocks); raises if no workers known."""
+        """Pick (worker_id, overlap_blocks); raises if no workers known.
+
+        ``exclude`` (circuit-breaker ejections) narrows the candidate
+        set — unless it would empty it, in which case every worker
+        stays eligible (fail open rather than blackhole)."""
         workers = self.workers()
+        if exclude:
+            kept = [w for w in workers if w.worker_id not in exclude]
+            if kept:
+                workers = kept
         if not workers:
             raise LookupError("no workers registered with scheduler")
         return self.selector.select(workers, request_blocks, overlaps, self.config)

@@ -79,6 +79,11 @@ class RuntimeConfig:
     # paying cold-start TTFT recompiling them; empty = off. Honored by
     # every engine process (engine/compile_cache.py).
     compile_cache_dir: str = ""
+    # per-tenant fairness quotas for engine workers (DYN_TENANT_QUOTAS;
+    # engine/tenancy.py grammar:
+    # "tenantA:weight=4,rate=1000,burst=2000;*:rate=200"). Explicit
+    # --tenant-quotas CLI flags win; empty = unmetered equal weights.
+    tenant_quotas: str = ""
 
     extra: dict[str, Any] = field(default_factory=dict)
 

@@ -76,10 +76,45 @@ class DeadlineExceeded(RuntimeError):
     mode deadlines exist to prevent. HTTP maps it to 504."""
 
 
+class OverQuota(RuntimeError):
+    """The tenant's token bucket cannot cover this request (engine
+    admission quota, engine/tenancy.py). NOT a StreamError: the quota is
+    a policy decision about this tenant's traffic, so migrating to
+    another worker would just burn its bucket there too — the client
+    must back off. HTTP maps it to 429 with ``Retry-After`` computed
+    from live bucket state (deficit / refill rate)."""
+
+    def __init__(self, message: str, retry_after_s: float = 1.0):
+        super().__init__(message)
+        self.retry_after_s = retry_after_s
+
+
 # Remaining request budget in milliseconds, attached to the wire headers at
 # send time (relative, so no cross-host clock sync needed) and rebuilt into
 # an absolute monotonic deadline on the receiving side.
 DEADLINE_HEADER = "x-dyn-deadline-ms"
+
+# Tenancy baggage (overload-control plane): stamped into Context.headers
+# at the serving edge (validated there — see frontend/validation.py
+# validate_tenancy), carried through EPP -> transport -> worker like any
+# other baggage header, and read by the engine's fair-admission layer.
+TENANT_HEADER = "x-dyn-tenant"
+PRIORITY_HEADER = "x-dyn-priority"
+
+
+def tenancy_from_headers(
+    headers: dict[str, str] | None,
+) -> tuple[str, str]:
+    """(tenant, priority) from wire headers, defaulted for untagged
+    traffic (direct engine callers, pre-tenancy clients): tenant
+    "default", priority "interactive" — untagged traffic must never be
+    easier to shed than tagged interactive traffic."""
+    h = headers or {}
+    tenant = (h.get(TENANT_HEADER) or "default").strip() or "default"
+    priority = (h.get(PRIORITY_HEADER) or "interactive").strip().lower()
+    if priority not in ("interactive", "batch"):
+        priority = "interactive"
+    return tenant, priority
 
 
 def tighten_timeout_s(default_s: float, raw_ms: Any) -> float:

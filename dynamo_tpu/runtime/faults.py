@@ -97,6 +97,8 @@ KNOWN_SITES: frozenset[str] = frozenset({
     "engine.spec_verify",
     "engine.guided_compile",
     "engine.quant",
+    "engine.preempt",
+    "epp.breaker",
     "disagg.pull",
 })
 

@@ -56,14 +56,20 @@ class _ConnLost(Exception):
 
 
 class NotLeader(Exception):
-    """A write landed on a replicated-hub follower. ``leader`` is the
-    current leader's address when known, None mid-election. _call follows
-    the redirect transparently; this only escapes to callers when the
-    cluster stays leaderless past the reconnect window."""
+    """A write landed on a replicated-hub follower (or bounced
+    ``no_quorum``/``unavailable``). ``leader`` is the current leader's
+    address when known, None mid-election. ``retry_after_s`` is the
+    server-supplied backoff hint when the bounce carried one — honored
+    by _call ahead of its own jittered exponential backoff. _call
+    follows the redirect transparently; this only escapes to callers
+    when the cluster stays leaderless past the reconnect window."""
 
-    def __init__(self, leader: str | None):
+    def __init__(
+        self, leader: str | None, retry_after_s: float | None = None
+    ):
         super().__init__(leader or "<no leader>")
         self.leader = leader
+        self.retry_after_s = retry_after_s
 
 
 class RemoteHub(Hub):
@@ -289,7 +295,7 @@ class RemoteHub(Hub):
                 raise KeyExists(msg.get("key"))
             if msg.get("error") == "not_leader":
                 raise NotLeader(msg.get("leader"))
-            if msg.get("error") == "no_quorum":
+            if msg.get("error") in ("no_quorum", "unavailable"):
                 # the leader logged the write but could not commit it to a
                 # majority (mid-partition): retryable exactly like a
                 # mid-election bounce — chase until the cluster converges.
@@ -297,8 +303,14 @@ class RemoteHub(Hub):
                 # once stragglers ack, so a retried non-idempotent create
                 # can see KeyExists for its own write — the same
                 # at-least-once exposure the reconnect path documents
-                # (publish stays exactly-once via pub_id dedup).
-                raise NotLeader(None)
+                # (publish stays exactly-once via pub_id dedup). A
+                # server-supplied retry_after hint (election/lease scale)
+                # rides along and takes precedence over our own backoff.
+                hint = msg.get("retry_after")
+                raise NotLeader(
+                    None,
+                    retry_after_s=float(hint) if hint is not None else None,
+                )
             raise RuntimeError(f"hub error for {op}: {msg.get('error')}")
         return msg.get("result")
 
@@ -348,10 +360,24 @@ class RemoteHub(Hub):
                         f"(op {op!r})"
                     )
                 await self._redirect(e.leader)
-                await asyncio.sleep(
-                    min(0.05 * (2 ** (hops - 1)), 0.5)
-                    * (0.5 + random.random())
-                )
+                hint = e.retry_after_s
+                if hint:
+                    # server-supplied hint (no_quorum/unavailable
+                    # bounces): the server KNOWS its election/lease
+                    # timescale — honor it (lightly jittered so a
+                    # thundering herd of bounced writers still spreads),
+                    # bounded by the remaining failover window
+                    await asyncio.sleep(
+                        min(
+                            float(hint) * (0.9 + 0.2 * random.random()),
+                            max(deadline - time.monotonic(), 0.0),
+                        )
+                    )
+                else:
+                    await asyncio.sleep(
+                        min(0.05 * (2 ** (hops - 1)), 0.5)
+                        * (0.5 + random.random())
+                    )
             except ConnectionError:
                 if not self._reconnect or self._closed:
                     raise

@@ -414,6 +414,13 @@ class ReplicatedHubServer(HubServer):
     def _leader_hint(self) -> str | None:
         return self.replica.leader_addr
 
+    def _retry_after_hint(self) -> float | None:
+        # quorum loss heals on the election/lease timescale: a partition
+        # must first expire the old lease, then a pre-vote + vote round
+        # completes within ~a heartbeat of it — so lease_s is the
+        # earliest a retry can plausibly commit
+        return max(self.replica.lease_s, 0.25)
+
     async def _commit_barrier(self, seq: int) -> None:
         # ack only once THIS op's records (up to its own post-log
         # position) are on a majority — never the live wal_seq, which

@@ -236,9 +236,18 @@ class HubServer:
             await send({"id": mid, "ok": False, "error": "key_exists", "key": str(e)})
         except NoQuorum as e:
             # the write is logged locally but NOT majority-replicated: the
-            # client must treat it as not-committed and retry elsewhere
+            # client must treat it as not-committed and retry elsewhere.
+            # retry_after: the server's own estimate of when quorum can
+            # plausibly be back (election/lease scale) — clients honor it
+            # before their own jittered exponential backoff.
             log.warning("hub write %r failed commit quorum: %s", op, e)
-            await send({"id": mid, "ok": False, "error": "no_quorum"})
+            bounce: dict[str, Any] = {
+                "id": mid, "ok": False, "error": "no_quorum",
+            }
+            hint = self._retry_after_hint()
+            if hint is not None:
+                bounce["retry_after"] = hint
+            await send(bounce)
         except HubFenced:
             # fenced at commit time: this replica was deposed while the
             # write was in flight — bounce like any follower would
@@ -260,6 +269,12 @@ class HubServer:
     def _leader_hint(self) -> str | None:
         """Hook: current leader address for not_leader bounces (the
         replicated server reports its replica's view)."""
+        return None
+
+    def _retry_after_hint(self) -> float | None:
+        """Hook: seconds until a ``no_quorum`` bounce is worth retrying
+        (the replicated server derives it from its election/lease
+        scale). None = send no hint; clients use their own backoff."""
         return None
 
     async def _commit_barrier(self, seq: int) -> None:

@@ -29,6 +29,7 @@ from dynamo_tpu.runtime import framing
 from dynamo_tpu.runtime.context import (
     Context,
     DeadlineExceeded,
+    OverQuota,
     ServiceUnavailable,
     StreamError,
     deadline_from_headers,
@@ -215,6 +216,17 @@ class EndpointServer:
                             "retry_after": e.retry_after_s})
             except (ConnectionError, RuntimeError):
                 pass
+        except OverQuota as e:
+            # tenant quota refusal: typed so the client side re-raises
+            # OverQuota (NOT retryable — migration must not burn the
+            # tenant's bucket on every other worker too) and the
+            # frontend maps it to 429 + Retry-After
+            try:
+                await send({"kind": "err", "req": req_id, "error": str(e),
+                            "code": "over_quota",
+                            "retry_after": e.retry_after_s})
+            except (ConnectionError, RuntimeError):
+                pass
         except DeadlineExceeded as e:
             try:
                 await send({"kind": "err", "req": req_id, "error": str(e),
@@ -337,6 +349,11 @@ class InstanceChannel:
                     if code == "unavailable":
                         raise ServiceUnavailable(
                             msg.get("error", "worker unavailable"),
+                            retry_after_s=float(msg.get("retry_after") or 1.0),
+                        )
+                    if code == "over_quota":
+                        raise OverQuota(
+                            msg.get("error", "tenant over quota"),
                             retry_after_s=float(msg.get("retry_after") or 1.0),
                         )
                     if code == "deadline":

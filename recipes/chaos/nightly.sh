@@ -18,8 +18,10 @@ echo "chaos soak: DYN_SOAK_SECS=$DYN_SOAK_SECS" \
 exec python -m pytest -q -p no:cacheprovider \
   tests/test_faults.py \
   tests/test_fault_tolerance.py \
+  tests/test_overload.py \
   "tests/test_soak.py::test_soak_worker_sigkill_churn" \
   "tests/test_soak.py::test_soak_leader_hub_sigkill_recovery" \
+  "tests/test_overload.py::test_soak_overload_quota_storm" \
   "tests/test_hub_replication.py::test_kill9_leader_delete_data_dir_chaos" \
   "tests/test_hub_replication.py::test_partition_matrix_invariants" \
   "$@"

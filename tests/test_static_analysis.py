@@ -296,7 +296,7 @@ def test_wire_schema_covers_expected_channels():
     assert "clear_kv_blocks" in committed["channels"]["worker.admin"]
     err = committed["transport_err_codes"]
     assert set(err["emitted"]) == set(err["handled"]) == {
-        "deadline", "unavailable"
+        "deadline", "unavailable", "over_quota"
     }
 
 

@@ -45,6 +45,16 @@ FAULT_SITES: dict[str, str] = {
                     "corrupt fp8 tier block (bad scale bytes): must be "
                     "treated as a tier miss + re-prefill, never a "
                     "NaN-poisoned page",
+    "engine.preempt": "engine/core.py priority preemption — an injected "
+                      "error SKIPS the preemption (the interactive "
+                      "request waits; the batch victim keeps running): "
+                      "serving degrades, never breaks, and page "
+                      "accounting stays clean",
+    "epp.breaker": "gateway/epp.py pick path — an injected error records "
+                   "a FAILURE outcome against the picked instance, so "
+                   "chaos schedules can drive a breaker through "
+                   "eject -> half-open -> recovery without a genuinely "
+                   "sick worker",
     "disagg.pull": "disagg/transfer.py KV pull — transfer plane failure",
 }
 
@@ -88,6 +98,8 @@ PROFILE_PHASES: dict[str, str] = {
                    "constrained slots (burst + admission sampling)",
     "guided.lookahead": "scratch-cursor draft walk for guided x spec "
                         "verify (per-position masks, no state mutation)",
+    "preempt": "priority preemption: pipeline flush + seal/offload + "
+               "resume-request rebuild for one paused batch stream",
 }
 
 # span name (runtime/tracing.py span()/emit_span()) -> what it times.
@@ -172,4 +184,16 @@ METRIC_NAMES: dict[str, str] = {
                        "(host | disk | remote) — quantized blocks "
                        "(kv_dtype=fp8) land at packed fp8+scale width, "
                        "so the tier halving vs bf16 is observable here",
+    # overload-control plane (engine/tenancy.py + gateway/breaker.py)
+    "engine_preemptions_total": "batch streams paused to the host tier "
+                                "by reason (interactive_admission | "
+                                "interactive_pages) — the priority-"
+                                "preemption activity counter",
+    "tenant_tokens_total": "admission-charged token cost by tenant and "
+                           "outcome (admitted | rejected | shed) — "
+                           "rejected feeds the 429s, shed the "
+                           "overload-policy bounces",
+    "epp_breaker_state": "per-instance circuit-breaker state gauge "
+                         "(0 closed, 1 half-open, 2 open) — a sick "
+                         "worker browning out is visible AS a brownout",
 }
