@@ -37,6 +37,7 @@ import time
 import numpy as np
 
 from benchmarks.loadgen import pct_ms
+from benchmarks.replay import load_trace, replay_trace, synthesize_trace
 from dynamo_tpu.kv_router.protocols import RouterConfig
 from dynamo_tpu.kv_router.router import KvPushRouter, KvRouter
 from dynamo_tpu.mocker.__main__ import launch_mock_worker
@@ -72,126 +73,17 @@ def build_workload(args, seed: int = 0) -> list[list[list[int]]]:
     return waves
 
 
-def synthesize_trace(
-    path: str, *, requests: int = 256, block_size: int = 16,
-    groups: int = 12, depth: int = 6, rate_per_s: float = 48.0,
-    osl: int = 8, seed: int = 0,
-) -> None:
-    """Write a mooncake-style JSONL trace: Poisson arrivals over a
-    radix-structured context tree (each group is a chain of shared
-    blocks; each request reuses a random-depth prefix of its group's
-    chain plus a unique tail block — the same shape the reference
-    synthesizer derives from the real mooncake trace)."""
-    rng = np.random.default_rng(seed)
-    t = 0.0
-    with open(path, "w") as f:
-        for i in range(requests):
-            g = int(rng.integers(0, groups))
-            keep = int(rng.integers(1, depth + 1))
-            hash_ids = [g * 1000 + d for d in range(keep)] + [10_000_000 + i]
-            input_length = len(hash_ids) * block_size
-            t += float(rng.exponential(1.0 / rate_per_s))
-            f.write(json.dumps({
-                "timestamp": int(t * 1000),
-                "input_length": input_length,
-                "output_length": osl,
-                "hash_ids": hash_ids,
-            }) + "\n")
-
-
-def load_trace(path: str, block_size: int) -> list[dict]:
-    """Parse a mooncake-style JSONL trace into replayable requests.
-    Tokens are derived deterministically from each hash id (one block of
-    ``block_size`` tokens per id), so equal hash_ids share prefixes
-    exactly as the trace's radix structure dictates."""
-    block_cache: dict[int, list[int]] = {}
-
-    def block(h: int) -> list[int]:
-        if h not in block_cache:
-            block_cache[h] = (
-                np.random.default_rng(h & 0x7FFFFFFF)
-                .integers(10, 30000, block_size)
-                .tolist()
-            )
-        return block_cache[h]
-
-    out = []
-    with open(path) as f:
-        for line in f:
-            line = line.strip()
-            if not line:
-                continue
-            rec = json.loads(line)
-            toks: list[int] = []
-            for h in rec["hash_ids"]:
-                toks.extend(block(h))
-            n = int(rec["input_length"])
-            if len(toks) < n:  # tail beyond the hashed blocks: unique
-                toks.extend(
-                    np.random.default_rng(len(out))
-                    .integers(10, 30000, n - len(toks))
-                    .tolist()
-                )
-            out.append({
-                "t_ms": int(rec["timestamp"]),
-                "token_ids": toks[:n],
-                "osl": int(rec.get("output_length", 8)),
-                "blocks": len(rec["hash_ids"]),
-            })
-    out.sort(key=lambda r: r["t_ms"])
-    return out
+# synthesize_trace / load_trace / the open-loop replay loop live in
+# benchmarks/replay.py (shared with dynamo_tpu/sim so the two harnesses
+# cannot drift on timestamp handling or percentile math)
 
 
 async def run_trace_mode(router_engine, trace, args, rate_scale: float = 1.0) -> dict:
     """Open-loop replay at the trace's timestamps (scaled)."""
-    results: list[dict] = []
-
-    async def one(rec: dict, idx: int):
-        req = {
-            "token_ids": rec["token_ids"],
-            "stop_conditions": {"max_tokens": rec["osl"], "ignore_eos": True},
-            "sampling": {"temperature": 0.0},
-        }
-        t0 = time.perf_counter()
-        ttft = cached = None
-        async for item in router_engine.generate(req, Context(f"tr-{idx}")):
-            if ttft is None and item.get("token_ids"):
-                ttft = time.perf_counter() - t0
-                cached = item.get("cached_blocks")
-        results.append({
-            "ttft": ttft,
-            "cached": cached or 0,
-            "blocks": rec["blocks"],
-        })
-
-    start = time.perf_counter()
-    tasks = []
-    for idx, rec in enumerate(trace):
-        target = rec["t_ms"] / 1000.0 / rate_scale
-        now = time.perf_counter() - start
-        if target > now:
-            await asyncio.sleep(target - now)
-        tasks.append(asyncio.create_task(one(rec, idx)))
-    await asyncio.gather(*tasks)
-    elapsed = time.perf_counter() - start
-
-    ttfts = [r["ttft"] for r in results if r["ttft"] is not None]
-    pct = pct_ms
-    total_blocks = sum(r["blocks"] for r in results)
-    return {
-        "requests": len(results),
-        "req_per_s": round(len(results) / elapsed, 2),
-        "ttft_ms_p50": pct(ttfts, 0.5),
-        "ttft_ms_p90": pct(ttfts, 0.9),
-        "ttft_ms_p99": pct(ttfts, 0.99),
-        "ttft_ms_mean": round(float(np.mean(ttfts)) * 1e3, 2),
-        # measured at the serving worker: blocks actually reused / blocks
-        # offered (the routing-quality number the reference's real-data
-        # benchmark reports as cache hit rate)
-        "prefix_hit_rate": round(
-            sum(r["cached"] for r in results) / max(total_blocks, 1), 4
-        ),
-    }
+    res = await replay_trace(
+        router_engine.generate, trace, rate_scale=rate_scale, id_prefix="tr"
+    )
+    return res.summary()
 
 
 def pareto_front(points: list[dict]) -> None:

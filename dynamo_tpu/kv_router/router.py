@@ -282,13 +282,36 @@ class KvPushRouter:
         self.push_router = push_router
         self.kv_router = kv_router
         self.salt = salt
+        # membership memo: update_workers walks scheduler/sequence/radix
+        # state for EVERY worker, and running it per request made fleet
+        # churn reconciliation an O(instances) tax on every pick at
+        # fleet scale (cluster sim finding) — skip it when nothing
+        # changed since the last request. The memo key covers BOTH the
+        # client's membership generation (bumped on every watch-driven
+        # instance add/remove) AND the scheduler's states_version: a
+        # dead worker's replayed metrics tail can re-create its
+        # scheduler state after the prune, and without the version in
+        # the key that zombie would stay routable until the next real
+        # membership change (exactly the 503 storm the churn soak
+        # caught when the memo was set-only).
+        self._members_gen_seen = -1
+        self._states_seen = -1
 
     async def generate(
         self, request: dict[str, Any], context: Context
     ) -> AsyncIterator[Any]:
         token_ids = request.get("token_ids") or []
-        # live membership reconciliation before deciding
-        self.kv_router.update_workers(self.push_router.client.instance_ids())
+        # live membership reconciliation before deciding (memoized: a
+        # no-change reconcile is two int compares)
+        client = self.push_router.client
+        sched = self.kv_router.scheduler
+        if (
+            client.membership_gen != self._members_gen_seen
+            or sched.states_version != self._states_seen
+        ):
+            self.kv_router.update_workers(client.instance_ids())
+            self._members_gen_seen = client.membership_gen
+            self._states_seen = sched.states_version
 
         pinned = request.get("backend_instance_id")
         # per-request cache-partition salt (multimodal: image digest) —

@@ -11,21 +11,98 @@ like a real paged engine.
 Request schema = the framework's PreprocessedRequest (see
 frontend/protocols): {"token_ids": [...], "stop_conditions": {"max_tokens"},
 "sampling": {...}, ...}. Responses: {"token_ids": [t], "finish_reason"}.
+
+CHAOS PARITY with the real engine (dynamo_tpu/sim rides this): one
+``DYN_FAULTS`` spec applies uniformly to real and mock fleets —
+
+- ``engine.admit`` fires at admission; an injected drop maps to the real
+  engine's retryable ``ServiceUnavailable`` contract (migration re-drives
+  on another instance), an injected error surfaces as-is;
+- ``engine.step`` fires per decode step; an injected failure fails the
+  in-flight stream with a ``finish_reason: "error"`` item — the real
+  engine's fail-everything-then-keep-serving shape — and the NEXT request
+  serves normally;
+- the ``x-dyn-deadline-ms`` contract holds: an admission whose deadline
+  already passed raises ``DeadlineExceeded`` (HTTP 504), and generation
+  is CUT at the deadline mid-decode with the real engine's
+  ``"deadline exceeded"`` error item;
+- admission is class-prioritized like engine/tenancy.py's scheduler:
+  ``x-dyn-priority: interactive`` waiters are admitted STRICTLY before
+  ``batch`` waiters, so fleet-scale tenant-storm scenarios exercise the
+  same SLO shape the real engine's fairness lanes provide.
 """
 
 from __future__ import annotations
 
 import asyncio
+import collections
 import random
 from dataclasses import dataclass
 from typing import Any, AsyncIterator
 
 from dynamo_tpu.kv_router.protocols import ForwardPassMetrics
 from dynamo_tpu.mocker.kv_manager import MockKvManager, NotEnoughBlocks
-from dynamo_tpu.runtime.context import Context
+from dynamo_tpu.runtime.context import (
+    Context,
+    DeadlineExceeded,
+    ServiceUnavailable,
+    tenancy_from_headers,
+)
+from dynamo_tpu.runtime.faults import FAULTS
+
 from dynamo_tpu.tokens import TokenBlockSequence
 
 __all__ = ["MockEngineConfig", "MockEngine"]
+
+
+class _PriorityGate:
+    """Class-prioritized admission slots: interactive waiters are granted
+    strictly before batch waiters (the mock analogue of the real engine's
+    TenantScheduler class ordering). FIFO within a class; slots released
+    by finished requests hand off directly to the head waiter."""
+
+    def __init__(self, slots: int):
+        self._free = slots
+        self._waiters: dict[str, collections.deque] = {
+            "interactive": collections.deque(),
+            "batch": collections.deque(),
+        }
+
+    def waiting(self) -> int:
+        return sum(len(q) for q in self._waiters.values())
+
+    async def acquire(self, priority: str) -> None:
+        q = self._waiters["interactive" if priority != "batch" else "batch"]
+        head_clear = not self._waiters["interactive"] and (
+            priority != "batch" or not self._waiters["batch"]
+        )
+        if self._free > 0 and head_clear:
+            self._free -= 1
+            return
+        fut = asyncio.get_running_loop().create_future()
+        q.append(fut)
+        try:
+            await fut
+        except asyncio.CancelledError:
+            if fut.done() and not fut.cancelled():
+                # the grant raced the cancel: hand the slot onward
+                self.release()
+            else:
+                try:
+                    q.remove(fut)
+                except ValueError:
+                    pass
+            raise
+
+    def release(self) -> None:
+        for cls in ("interactive", "batch"):
+            q = self._waiters[cls]
+            while q:
+                fut = q.popleft()
+                if not fut.done():
+                    fut.set_result(None)
+                    return
+        self._free += 1
 
 
 @dataclass
@@ -71,7 +148,7 @@ class MockEngine:
         self._rng = random.Random(self.config.seed)
         self._running = 0
         self._waiting = 0
-        self._admit = asyncio.Semaphore(self.config.max_batch_size)
+        self._admit = _PriorityGate(self.config.max_batch_size)
 
     # -- kv event plumbing -------------------------------------------------
 
@@ -124,11 +201,29 @@ class MockEngine:
         seq = TokenBlockSequence.from_tokens(token_ids, cfg.block_size)
         prefix_hashes = seq.sequence_hashes()
 
+        # -- admission: the real engine's contract, mock-sized ------------
+        # expired deadline bounces BEFORE taking a slot (HTTP 504), and an
+        # injected engine.admit drop behaves like the worker vanishing
+        # pre-admit (retryable ServiceUnavailable — migration re-drives)
+        if context.deadline_expired:
+            raise DeadlineExceeded(
+                f"request {context.id} deadline passed before admission"
+            )
+        if FAULTS.enabled:
+            try:
+                await FAULTS.fire("engine.admit")
+            except ConnectionError as e:
+                raise ServiceUnavailable(f"injected admit drop: {e}") from e
+        _tenant, priority = tenancy_from_headers(context.headers)
+
         self._waiting += 1
         self._publish_metrics()
         owned: list[int] = []  # block hashes this request holds a ref on
-        async with self._admit:  # continuous-batching admission
+        try:
+            await self._admit.acquire(priority)  # class-priority admission
+        finally:
             self._waiting -= 1
+        try:
             self._running += 1
             try:
                 # --- prefill ---------------------------------------------
@@ -163,6 +258,25 @@ class MockEngine:
                     if context.is_stopped:
                         yield {"token_ids": [], "finish_reason": "cancelled"}
                         return
+                    if context.deadline_expired:
+                        # generation CUT at the end-to-end deadline: the
+                        # real engine's mid-generation contract
+                        yield {"token_ids": [], "finish_reason": "error",
+                               "error": "deadline exceeded"}
+                        return
+                    if FAULTS.enabled:
+                        try:
+                            await FAULTS.fire("engine.step")
+                        except (ConnectionError, RuntimeError) as e:
+                            # the real engine fails every in-flight stream
+                            # on a step fault, then keeps serving — mirror
+                            # the per-stream half here
+                            yield {
+                                "token_ids": [],
+                                "finish_reason": "error",
+                                "error": f"injected step failure: {e}",
+                            }
+                            return
                     # batch pressure: decode step slows with concurrency
                     pressure = 1.0 + 0.02 * max(self._running - 1, 0)
                     await self._sleep(cfg.decode_step_s * pressure)
@@ -216,3 +330,5 @@ class MockEngine:
                 self._running -= 1
                 self.kv.free(owned)
                 self._publish_metrics()
+        finally:
+            self._admit.release()

@@ -160,6 +160,11 @@ class Client:
         self._ready = asyncio.Event()
         self._started = False
         self._events: asyncio.Event = asyncio.Event()  # set on any membership change
+        # monotonically bumped on every membership change: per-request
+        # "did anything change" checks compare this int instead of
+        # rebuilding and comparing the whole id set (O(instances) per
+        # pick at fleet scale — cluster sim finding)
+        self.membership_gen = 0
 
     async def start(self) -> "Client":
         if self._started:
@@ -180,6 +185,7 @@ class Client:
                     ch = self._channels.pop(iid, None)
                     if ch is not None:
                         await ch.close()
+                self.membership_gen += 1
                 self._ready.set()
                 self._events.set()
         except asyncio.CancelledError:

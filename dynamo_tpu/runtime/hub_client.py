@@ -47,8 +47,49 @@ from typing import Any, AsyncIterator
 from dynamo_tpu.runtime import framing
 from dynamo_tpu.runtime.faults import FAULTS
 from dynamo_tpu.runtime.hub import Hub, KeyExists, WatchEvent
+from dynamo_tpu.runtime.metrics import MetricsRegistry, register_registry
 
 log = logging.getLogger("dynamo.hub.client")
+
+# Failover observability, on every /metrics surface: a redirect-chase
+# storm during a hub failover (every client bouncing not_leader /
+# no_quorum around the replica ring) was previously only INFERRABLE from
+# latency — these counters make it a first-class signal the cluster sim
+# asserts on (dynamo_tpu/sim leader-kill / partition scenarios).
+_METRICS = MetricsRegistry()
+REDIRECTS = _METRICS.counter(
+    "hub_redirects_total",
+    "Hub client write bounces by reason "
+    "(not_leader | no_quorum | unavailable).",
+    ["reason"],
+)
+BACKOFF = _METRICS.histogram(
+    "hub_backoff_seconds",
+    "Seconds the hub client slept between redirect hops "
+    "(server-hinted and exponential backoff alike).",
+    buckets=(0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0),
+)
+register_registry("hub_client", _METRICS)
+
+
+def failover_stats() -> dict[str, float]:
+    """Live sample of the redirect counters by reason (plus the backoff
+    histogram's count/sum) — the sim's leader-kill and partition
+    scenarios diff this across a chaos window instead of scraping and
+    parsing their own /metrics exposition."""
+    out: dict[str, float] = {}
+    for metric in _METRICS.registry.collect():
+        if metric.name == "dynamo_hub_redirects":
+            for s in metric.samples:
+                if s.name.endswith("_total"):
+                    out[s.labels.get("reason", "?")] = s.value
+        elif metric.name == "dynamo_hub_backoff_seconds":
+            for s in metric.samples:
+                if s.name.endswith("_count"):
+                    out["backoff_count"] = s.value
+                elif s.name.endswith("_sum"):
+                    out["backoff_sum_s"] = round(s.value, 4)
+    return out
 
 
 class _ConnLost(Exception):
@@ -294,8 +335,10 @@ class RemoteHub(Hub):
             if msg.get("error") == "key_exists":
                 raise KeyExists(msg.get("key"))
             if msg.get("error") == "not_leader":
+                REDIRECTS.labels("not_leader").inc()
                 raise NotLeader(msg.get("leader"))
             if msg.get("error") in ("no_quorum", "unavailable"):
+                REDIRECTS.labels(msg["error"]).inc()
                 # the leader logged the write but could not commit it to a
                 # majority (mid-partition): retryable exactly like a
                 # mid-election bounce — chase until the cluster converges.
@@ -367,17 +410,17 @@ class RemoteHub(Hub):
                     # timescale — honor it (lightly jittered so a
                     # thundering herd of bounced writers still spreads),
                     # bounded by the remaining failover window
-                    await asyncio.sleep(
-                        min(
-                            float(hint) * (0.9 + 0.2 * random.random()),
-                            max(deadline - time.monotonic(), 0.0),
-                        )
+                    backoff = min(
+                        float(hint) * (0.9 + 0.2 * random.random()),
+                        max(deadline - time.monotonic(), 0.0),
                     )
                 else:
-                    await asyncio.sleep(
+                    backoff = (
                         min(0.05 * (2 ** (hops - 1)), 0.5)
                         * (0.5 + random.random())
                     )
+                BACKOFF.observe(backoff)
+                await asyncio.sleep(backoff)
             except ConnectionError:
                 if not self._reconnect or self._closed:
                     raise

@@ -1,0 +1,793 @@
+"""Named chaos scenarios: traffic + faults + continuously-asserted
+invariants over real control-plane components.
+
+Every scenario returns a dict with an ``invariants`` map ({name: {pass,
+...detail}}); the harness derives the verdict. AssertionError anywhere
+(including inside ``check_cluster_invariants``) is a failed invariant.
+
+Scenario ingredients are ALL production code paths: the quorum hub
+(runtime/hub_replica.py) with its fencing/commit machinery, the
+multi-address failover client (runtime/hub_client.py), the KV-aware
+router + EPP breakers (kv_router/, gateway/), the migration operator
+(frontend/migration.py), the planner's replica math (planner/core.py),
+and the ``DYN_FAULTS`` / ``transport.partition`` grammar
+(runtime/faults.py). Only the workers are mocks — time-dilated
+``MockEngine``s that honor the same fault sites and deadline contract
+as the real engine (mocker/engine.py chaos parity).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import random
+import tempfile
+import time
+from pathlib import Path
+
+import aiohttp
+
+from benchmarks.loadgen import pct_ms
+from benchmarks.replay import load_trace, replay_trace, synthesize_trace
+from dynamo_tpu.gateway.breaker import BreakerConfig
+from dynamo_tpu.gateway.epp import EndpointPicker
+from dynamo_tpu.kv_router.protocols import RouterConfig
+from dynamo_tpu.runtime.faults import FAULTS
+from dynamo_tpu.runtime.hub_client import RemoteHub, failover_stats
+from dynamo_tpu.sim import cluster as hubctl
+from dynamo_tpu.sim.harness import (
+    COMP,
+    EP,
+    NS,
+    MockFleet,
+    ProcReplicaCluster,
+    ReplicaCluster,
+    SimConfig,
+    migrations_snapshot,
+    telemetry_overhead,
+)
+
+log = logging.getLogger("dynamo.sim")
+
+
+def _inv(ok: bool, **detail) -> dict:
+    return {"pass": bool(ok), **detail}
+
+
+def _tmpdir(cfg: SimConfig, tag: str) -> Path:
+    """Scenario scratch under ONE run-scoped base dir. run_scenarios
+    pins cfg.data_dir for the whole run (and cleans it up on a passing
+    run); the mkdtemp branch only fires for direct scenario calls."""
+    if not cfg.data_dir:
+        cfg.data_dir = tempfile.mkdtemp(prefix="dynamo-sim-")
+    d = Path(cfg.data_dir) / tag
+    d.mkdir(parents=True, exist_ok=True)
+    return d
+
+
+def _mk_trace(cfg: SimConfig, tag: str, *, requests: int, rate: float,
+              osl: int | None = None, groups: int | None = None,
+              seed: int | None = None) -> list[dict]:
+    path = _tmpdir(cfg, "traces") / f"{tag}.jsonl"
+    synthesize_trace(
+        str(path), requests=requests, block_size=cfg.block_size,
+        groups=groups or max(12, cfg.workers // 8), rate_per_s=rate,
+        osl=osl or cfg.osl, seed=cfg.seed if seed is None else seed,
+    )
+    return load_trace(str(path), cfg.block_size)
+
+
+# -- pick_scaling ------------------------------------------------------------
+
+
+async def pick_scaling(cfg: SimConfig) -> dict:
+    """EPP pick latency vs instance count: the flatness bar. For each
+    fleet size, a fresh mock fleet registers against an in-memory hub,
+    the real EndpointPicker serves /pick over HTTP, and we measure the
+    full pick path (tokenless token_ids pick: KV score + instance
+    resolve + breaker walk) client-side. Steady-state picks must do ZERO
+    hub round-trips (hub_scans flat while picks grow) and the latency
+    curve must stay flat-ish as the fleet grows to 100s of instances."""
+    curve = []
+    rng = random.Random(cfg.seed)
+    for size in cfg.sizes():
+        fleet = await MockFleet(cfg, size).start()
+        epp = None
+        try:
+            epp = await EndpointPicker(
+                fleet.drt, namespace=NS, target_component=COMP,
+                target_endpoint=EP,
+                config=RouterConfig(block_size=cfg.block_size),
+                host="127.0.0.1", port=0,
+            ).start()
+            deadline = time.monotonic() + 20
+            while len(epp.kv.scheduler.workers()) < size:
+                if time.monotonic() > deadline:
+                    raise AssertionError(
+                        f"EPP saw {len(epp.kv.scheduler.workers())}/{size} "
+                        "workers"
+                    )
+                await asyncio.sleep(0.05)
+
+            prompts = [
+                [rng.randrange(10, 30000) for _ in range(cfg.block_size * 4)]
+                for _ in range(32)
+            ]
+            lats: list[float] = []
+            sem = asyncio.Semaphore(cfg.pick_concurrency)
+            url = f"http://127.0.0.1:{epp.port}"
+
+            async def one(i: int, sess):
+                async with sem:
+                    t0 = time.perf_counter()
+                    async with sess.post(f"{url}/pick", json={
+                        "token_ids": prompts[i % len(prompts)],
+                        "request_id": f"pk-{i}",
+                    }) as resp:
+                        assert resp.status == 200, await resp.text()
+                        await resp.json()
+                    lats.append(time.perf_counter() - t0)
+
+            async with aiohttp.ClientSession() as sess:
+                # warmup fills the pick-path caches (cards + instances)
+                for i in range(8):
+                    await one(i, sess)
+                lats.clear()
+                scans0 = epp._cards.scans + epp._instances.scans
+                await asyncio.gather(
+                    *(one(i, sess) for i in range(cfg.picks))
+                )
+                scans1 = epp._cards.scans + epp._instances.scans
+            curve.append({
+                "instances": size,
+                "picks": cfg.picks,
+                "pick_ms_p50": pct_ms(lats, 0.5),
+                "pick_ms_p90": pct_ms(lats, 0.9),
+                "pick_ms_p99": pct_ms(lats, 0.99),
+                "steady_state_hub_scans": scans1 - scans0,
+            })
+        finally:
+            if epp is not None:
+                await epp.close()
+            await fleet.close()
+    lo, hi = curve[0], curve[-1]
+    flat_ratio = hi["pick_ms_p50"] / max(lo["pick_ms_p50"], 1.0)
+    return {
+        "curve": curve,
+        "invariants": {
+            # the flatness bar: growing the fleet 4x must not grow the
+            # median pick more than ~3x (sub-linear; floor 1 ms so tiny
+            # absolute numbers don't flap the ratio)
+            "pick_latency_flat": _inv(
+                flat_ratio <= 3.0, ratio=round(flat_ratio, 2),
+                p50_small_ms=lo["pick_ms_p50"], p50_large_ms=hi["pick_ms_p50"],
+            ),
+            "zero_hub_roundtrips_steady_state": _inv(
+                all(c["steady_state_hub_scans"] == 0 for c in curve),
+                scans=[c["steady_state_hub_scans"] for c in curve],
+            ),
+        },
+    }
+
+
+# -- leader_kill -------------------------------------------------------------
+
+
+async def leader_kill(cfg: SimConfig) -> dict:
+    """SIGKILL the quorum leader mid-commit-storm (real subprocesses,
+    real kill -9). Writers hammer majority-committed puts through the
+    multi-address failover client; the kill lands a third of the way in.
+    Asserts: every ACKED write survives into the recovered cluster, the
+    unavailability window is bounded by election + reconnect scale, the
+    post-kill commit rate recovers, and the WAL invariant checker holds
+    across all three data dirs (including the corpse's)."""
+    base = _tmpdir(cfg, "leader_kill")
+    cl = await ProcReplicaCluster(cfg, base).start()
+    client = None
+    acked: list[tuple[float, str, float]] = []  # (t_done, key, latency)
+    failed: list[str] = []
+    stop = asyncio.Event()
+    writers: list[asyncio.Future] = []
+    redirects0 = failover_stats()
+    try:
+        leader = await cl.find_leader()
+        client = await RemoteHub.connect(
+            ",".join(cl.addrs), reconnect_window_s=20.0
+        )
+        t_start = time.monotonic()
+
+        async def writer(w: int):
+            i = 0
+            while not stop.is_set():
+                key = f"storm/{w}/{i}"
+                t0 = time.monotonic()
+                try:
+                    await client.put(key, i)
+                    acked.append((time.monotonic(), key, time.monotonic() - t0))
+                except (ConnectionError, RuntimeError) as e:
+                    failed.append(f"{key}: {e}")
+                    # a closed/unreachable client raises without ever
+                    # suspending — without this pause a failure path
+                    # that forgot us would busy-starve the event loop
+                    await asyncio.sleep(0.01)
+                i += 1
+
+        writers = [
+            asyncio.ensure_future(writer(w))
+            for w in range(cfg.storm_writers)
+        ]
+        kill_at = cfg.storm_duration_s * 0.35
+        await asyncio.sleep(kill_at)
+        t_kill = time.monotonic()
+        cl.sigkill(leader)
+        log.warning("sim: SIGKILLed hub leader %s mid-storm", leader)
+        await asyncio.sleep(cfg.storm_duration_s - kill_at)
+        stop.set()
+        await asyncio.gather(*writers, return_exceptions=True)
+
+        new_leader = await cl.find_leader()
+        assert new_leader != leader, "dead leader still answers as leader"
+        await client.put("post/recovery", 1)
+
+        # durability of the acked prefix: every write the client saw
+        # acked (majority-committed by contract) must be readable now
+        sample = acked if len(acked) <= 400 else random.Random(
+            cfg.seed
+        ).sample(acked, 400)
+        lost = []
+        for _t, key, _l in sample:
+            i = int(key.rsplit("/", 1)[1])
+            if await client.get(key) != i:
+                lost.append(key)
+
+        # throughput timeline around the kill
+        pre = [t for t, _k, _l in acked if t < t_kill]
+        post = [t for t, _k, _l in acked if t >= t_kill]
+        pre_rate = len(pre) / max(t_kill - t_start, 1e-9)
+        post_win = max(acked[-1][0] - t_kill, 1e-9) if acked else 1.0
+        post_rate = len(post) / post_win
+        # unavailability: the longest gap between consecutive acks that
+        # spans the kill moment
+        times = sorted([t for t, _k, _l in acked] + [t_kill])
+        outage = max(
+            (b - a for a, b in zip(times, times[1:])), default=0.0
+        )
+        outage_bound = cfg.lease_s * 12 + cfg.commit_timeout_s + 2.0
+        redirects = {
+            k: v - redirects0.get(k, 0.0)
+            for k, v in failover_stats().items()
+        }
+    finally:
+        # stop the storm FIRST: failure paths must not leave writer
+        # tasks looping against a closed client for the rest of the run
+        stop.set()
+        await asyncio.gather(*writers, return_exceptions=True)
+        if client is not None:
+            await client.close()
+        cl.terminate_all()
+    inv_detail = hubctl.check_cluster_invariants(cl.data_dirs())
+    return {
+        "commits_acked": len(acked),
+        "commit_rate_pre_kill": round(pre_rate, 1),
+        "commit_rate_post_kill": round(post_rate, 1),
+        "commit_ms_p50": pct_ms([x for _t, _k, x in acked], 0.5),
+        "commit_ms_p99": pct_ms([x for _t, _k, x in acked], 0.99),
+        "outage_s": round(outage, 3),
+        "committed_records": len(inv_detail["committed"]),
+        "client_failover": redirects,
+        "invariants": {
+            "cluster_invariants": _inv(True),  # checker above raised if not
+            "no_acked_write_lost": _inv(
+                not lost, lost=lost[:5], sampled=len(sample)
+            ),
+            "outage_bounded": _inv(
+                outage <= outage_bound,
+                outage_s=round(outage, 3), bound_s=outage_bound,
+            ),
+            "throughput_recovered": _inv(
+                post_rate >= 0.4 * pre_rate,
+                pre=round(pre_rate, 1), post=round(post_rate, 1),
+            ),
+            "write_failures_zero": _inv(
+                not failed, failures=failed[:5]
+            ),
+        },
+    }
+
+
+# -- partition ---------------------------------------------------------------
+
+
+async def partition(cfg: SimConfig) -> dict:
+    """Partition matrix during live traffic: a symmetric partition
+    isolates the leader mid-write-storm (the majority side must elect
+    and keep committing; no_quorum stalls bounded to the window), then a
+    one-way cut that must NOT depose the leader. Invariants via the
+    jepsen-style WAL checker: no dual-lead per term, no committed fork,
+    no seq gap — and every acked write survives the heals."""
+    base = _tmpdir(cfg, "partition")
+    cl = await ReplicaCluster(cfg, base).start()
+    client = None
+    acked: list[tuple[float, str, float]] = []
+    failed: list[str] = []
+    stop = asyncio.Event()
+    wt: asyncio.Future | None = None
+    windows: list[tuple[float, float]] = []  # (start, end) of chaos
+    redirects0 = failover_stats()
+    try:
+        leader = await cl.wait_leader()
+        client = await RemoteHub.connect(
+            ",".join(cl.addrs), reconnect_window_s=20.0
+        )
+
+        async def writer():
+            i = 0
+            while not stop.is_set():
+                key = f"part/{i}"
+                t0 = time.monotonic()
+                try:
+                    await client.put(key, i)
+                    acked.append(
+                        (time.monotonic(), key, time.monotonic() - t0)
+                    )
+                except (ConnectionError, RuntimeError) as e:
+                    failed.append(f"{key}: {e}")
+                i += 1
+                await asyncio.sleep(0.01)
+
+        wt = asyncio.ensure_future(writer())
+        await asyncio.sleep(0.5)
+
+        # round 1: symmetric partition cutting the leader off
+        t0 = time.monotonic()
+        FAULTS.configure(
+            hubctl.isolate_spec(leader.advertise, cl.addrs), seed=cfg.seed
+        )
+        try:
+            survivors = [r for r in cl.reps if r is not leader]
+            deadline = time.monotonic() + 15
+            while not any(r.hub.role == "leader" for r in survivors):
+                assert time.monotonic() < deadline, (
+                    "majority side failed to elect within 15s"
+                )
+                await asyncio.sleep(0.05)
+            await asyncio.sleep(cfg.partition_window_s)
+        finally:
+            FAULTS.clear()
+            windows.append((t0, time.monotonic()))
+        await cl.wait_leader()
+
+        # round 2: one-way cut (leader -> follower) must not depose
+        await asyncio.sleep(0.5)
+        new_leader = await cl.wait_leader()
+        follower = next(r for r in cl.reps if r is not new_leader)
+        t0 = time.monotonic()
+        FAULTS.configure(hubctl.partition_spec(
+            (new_leader.advertise, follower.advertise), one_way=True,
+        ), seed=cfg.seed + 1)
+        try:
+            await asyncio.sleep(cfg.partition_window_s)
+            leaders = [r for r in cl.reps if r.hub.role == "leader"]
+            one_way_stable = leaders == [new_leader]
+        finally:
+            FAULTS.clear()
+            windows.append((t0, time.monotonic()))
+
+        await cl.wait_leader()
+        await asyncio.sleep(0.5)
+        stop.set()
+        await asyncio.gather(wt, return_exceptions=True)
+
+        # acked durability after both heals
+        sample = acked if len(acked) <= 300 else random.Random(
+            cfg.seed
+        ).sample(acked, 300)
+        lost = [
+            key for _t, key, _l in sample
+            if await client.get(key) != int(key.rsplit("/", 1)[1])
+        ]
+        # no_quorum stalls bounded to the chaos windows: outside them
+        # (with slack for the failover tail) every commit is fast
+        slack = cfg.lease_s * 8 + cfg.commit_timeout_s
+        stalled_outside = [
+            key for t, key, lat in acked
+            if lat > 1.0 and not any(
+                s <= t <= e + slack for s, e in windows
+            )
+        ]
+    finally:
+        FAULTS.clear()
+        # failure paths included: the writer must not outlive the
+        # scenario and spin against a closed client
+        stop.set()
+        if wt is not None:
+            await asyncio.gather(wt, return_exceptions=True)
+        if client is not None:
+            await client.close()
+        dirs = cl.data_dirs()
+        await cl.stop_all()
+    inv_detail = hubctl.check_cluster_invariants(dirs)
+    return {
+        "commits_acked": len(acked),
+        "committed_records": len(inv_detail["committed"]),
+        "terms_seen": sorted(inv_detail["promotes"]),
+        "chaos_windows": [
+            [round(e - s, 2) for s, e in [w]][0] for w in windows
+        ],
+        # delta over the scenario, not process-lifetime absolutes — the
+        # redirect counters are process-global and earlier scenarios
+        # (leader_kill in an --scenario all run) already moved them
+        "client_failover": {
+            k: v - redirects0.get(k, 0.0)
+            for k, v in failover_stats().items()
+        },
+        "invariants": {
+            "cluster_invariants": _inv(True),
+            "no_acked_write_lost": _inv(
+                not lost, lost=lost[:5], sampled=len(sample)
+            ),
+            "one_way_keeps_leader": _inv(one_way_stable),
+            "stalls_bounded_to_partition": _inv(
+                not stalled_outside, stalled=stalled_outside[:5]
+            ),
+            "write_failures_zero": _inv(not failed, failures=failed[:5]),
+        },
+    }
+
+
+# -- churn -------------------------------------------------------------------
+
+
+async def churn(cfg: SimConfig) -> dict:
+    """Worker kill + rejoin waves under open-loop trace replay, through
+    the REAL client path (KV-aware routing + migration operator). The
+    acceptance bar from the soak tier, at fleet scale: ZERO
+    client-visible errors with migrations > 0 — every stream cut by a
+    kill wave must be transparently re-driven. The rejoin waves are
+    deliberate thundering herds (all replacements register at once).
+    Feeds the observed interval into the real SLA planner's replica math
+    and records its recommendation."""
+    fleet = await MockFleet(cfg, cfg.workers).start()
+    mig0 = migrations_snapshot()
+    killed = rejoined = 0
+    try:
+        engine = await fleet.client_path(migration=True)
+        trace = _mk_trace(
+            cfg, "churn", requests=cfg.trace_n(), rate=cfg.trace_rate()
+        )
+        replay_window = trace[-1]["t_ms"] / 1000.0 if trace else 1.0
+
+        async def chaos():
+            nonlocal killed, rejoined
+            waves = max(cfg.churn_waves, 1)
+            t_begin = time.monotonic()
+            for i in range(waves):
+                # absolute schedule: wave i lands at (i+0.5)/waves of
+                # the replay window regardless of how long earlier
+                # kills/rejoins took (cumulative sleeps would push late
+                # waves past the end of the replay onto an idle fleet)
+                target = t_begin + replay_window * (i + 0.5) / waves
+                await asyncio.sleep(max(target - time.monotonic(), 0.0))
+                k = max(1, int(len(fleet.alive_workers())
+                               * cfg.churn_kill_frac))
+                victims = await fleet.kill_wave(k)
+                killed += len(victims)
+                log.warning(
+                    "sim churn wave %d: killed %d workers (%d alive)",
+                    i, len(victims), len(fleet.alive_workers()),
+                )
+                await asyncio.sleep(0.2)
+                await fleet.rejoin_wave(len(victims))
+                rejoined += len(victims)
+
+        chaos_task = asyncio.ensure_future(chaos())
+        res = await replay_trace(
+            engine.generate, trace, id_prefix="churn"
+        )
+        await chaos_task
+        migrations = migrations_snapshot() - mig0
+        summary = res.summary()
+        itls = res.itls()
+        incomplete = [
+            r for r in res.results
+            if r["ttft"] is None and r["error"] is None
+        ]
+    finally:
+        await fleet.close()
+
+    # the real planner's replica math over the observed interval: would
+    # the SLA planner have scaled this fleet, given what the storm did?
+    from dynamo_tpu.planner.core import Metrics, PlannerConfig, SlaPlanner
+    from dynamo_tpu.planner.interpolation import (
+        DecodeInterpolator,
+        PrefillInterpolator,
+        synthetic_profile,
+    )
+
+    prof = synthetic_profile()
+    planner = SlaPlanner(
+        PlannerConfig(
+            ttft_sla_s=0.5, itl_sla_s=0.05,
+            adjustment_interval_s=max(res.elapsed_s, 1e-3),
+            predictor="constant", no_correction=True,
+            max_chip_budget=cfg.workers * 2,
+        ),
+        PrefillInterpolator(prof), DecodeInterpolator(prof),
+    )
+    isl_avg = (
+        sum(len(r["token_ids"]) for r in trace) / max(len(trace), 1)
+    )
+    planner.ingest(Metrics(
+        ttft=(summary["ttft_ms_p50"] or 0.0) / 1e3,
+        itl=(pct_ms(itls, 0.5) or 0.0) / 1e3,
+        num_req=float(len(trace)), isl=isl_avg, osl=float(cfg.osl),
+        request_duration=sum(
+            r["duration"] for r in res.results
+        ) / max(len(res.results), 1),
+    ))
+    n_p, n_d = planner.compute_replicas(
+        float(len(trace)), isl_avg, float(cfg.osl)
+    )
+
+    return {
+        **summary,
+        # offered = the trace's open-loop schedule; achieved = what the
+        # single replay process actually sustained (the gap is the
+        # one-router throughput cap — see ROADMAP)
+        "offered_req_per_s": round(cfg.trace_rate(), 1),
+        "dilated_offered_req_per_s": round(
+            cfg.trace_rate() * cfg.speedup, 1
+        ),
+        "dilated_req_per_s": round(summary["req_per_s"] * cfg.speedup, 1),
+        "workers": cfg.workers,
+        "killed": killed,
+        "rejoined": rejoined,
+        "migrations": migrations,
+        "itl_ms_p50": pct_ms(itls, 0.5),
+        "planner_recommendation": {"prefill": n_p, "decode": n_d},
+        "invariants": {
+            "zero_client_errors": _inv(
+                not res.errors, errors=res.errors[:5]
+            ),
+            "migrations_gt_zero": _inv(
+                migrations > 0, migrations=migrations
+            ),
+            "all_requests_completed": _inv(
+                not incomplete, incomplete=len(incomplete)
+            ),
+            "workers_actually_churned": _inv(killed > 0, killed=killed),
+        },
+    }
+
+
+# -- breaker_storm -----------------------------------------------------------
+
+
+async def breaker_storm(cfg: SimConfig) -> dict:
+    """Injected ``epp.breaker`` failures brown out picked instances:
+    breakers must OPEN (instances ejected from picks) while /pick stays
+    100% available (fail-open contract), then — after the fault clears
+    and /report feeds recoveries — every breaker must CLOSE again."""
+    size = min(cfg.workers, 16)
+    fleet = await MockFleet(cfg, size).start()
+    epp = None
+    storm_statuses: list[int] = []
+    try:
+        epp = await EndpointPicker(
+            fleet.drt, namespace=NS, target_component=COMP,
+            target_endpoint=EP,
+            config=RouterConfig(block_size=cfg.block_size),
+            host="127.0.0.1", port=0,
+            breaker_config=BreakerConfig(
+                window=16, min_samples=4, failure_threshold=0.5,
+                open_cooldown_s=0.2, half_open_probes=2, close_after=2,
+                probe_timeout_s=5.0,
+            ),
+        ).start()
+        deadline = time.monotonic() + 20
+        while len(epp.kv.scheduler.workers()) < size:
+            assert time.monotonic() < deadline, "EPP never saw the fleet"
+            await asyncio.sleep(0.05)
+        rng = random.Random(cfg.seed)
+        url = f"http://127.0.0.1:{epp.port}"
+
+        async def one_pick(sess, i: int) -> int:
+            async with sess.post(f"{url}/pick", json={
+                "token_ids": [
+                    rng.randrange(10, 30000)
+                    for _ in range(cfg.block_size * 2)
+                ],
+                "request_id": f"bs-{i}",
+            }) as resp:
+                await resp.read()
+                return resp.status
+
+        async with aiohttp.ClientSession() as sess:
+            # storm: every pick records an injected failure outcome
+            # against the chosen instance (the epp.breaker fault site)
+            FAULTS.configure("epp.breaker:error@1x200", seed=cfg.seed)
+            try:
+                for i in range(150):
+                    storm_statuses.append(await one_pick(sess, i))
+                    if len(epp.breakers.ejected()) >= max(size // 3, 1):
+                        break
+            finally:
+                FAULTS.clear()
+            ejected_peak = len(epp.breakers.ejected())
+
+            # recovery: keep picking (half-open probes re-admit) and
+            # report success for everything still tracked as ejected
+            deadline = time.monotonic() + 15
+            while epp.breakers.ejected() and time.monotonic() < deadline:
+                storm_statuses.append(await one_pick(sess, 10_000))
+                for iid in list(epp.breakers.ejected()):
+                    async with sess.post(f"{url}/report", json={
+                        "worker_id": f"{iid:x}", "ok": True,
+                        "latency_ms": 1.0,
+                    }) as resp:
+                        await resp.read()
+                await asyncio.sleep(0.05)
+            ejected_final = len(epp.breakers.ejected())
+    finally:
+        FAULTS.clear()
+        if epp is not None:
+            await epp.close()
+        await fleet.close()
+    return {
+        "fleet": size,
+        "picks": len(storm_statuses),
+        "ejected_peak": ejected_peak,
+        "ejected_after_recovery": ejected_final,
+        "invariants": {
+            "breakers_opened": _inv(
+                ejected_peak >= 1, ejected_peak=ejected_peak
+            ),
+            "breakers_recovered": _inv(
+                ejected_final == 0, still_open=ejected_final
+            ),
+            "pick_availability_100": _inv(
+                all(s == 200 for s in storm_statuses),
+                non_200=[s for s in storm_statuses if s != 200][:5],
+            ),
+        },
+    }
+
+
+# -- tenant_storm ------------------------------------------------------------
+
+
+async def tenant_storm(cfg: SimConfig) -> dict:
+    """A batch tenant floods the fleet while an interactive tenant keeps
+    its dribble of traffic: the mock engines' class-priority admission
+    (the parity mirror of engine/tenancy.py's lanes) must hold the
+    interactive TTFT SLO through the storm. Baseline first (interactive
+    alone), then the same interactive trace under the batch flood.
+
+    Runs on a slot-constrained sub-fleet at modest dilation so the storm
+    saturates WORKER SLOTS (the thing priority admission arbitrates)
+    rather than the harness event loop — at full fleet scale a single
+    replay process saturates on routing CPU first, which is a real
+    finding (see ROADMAP) but a different one."""
+    from dataclasses import replace
+
+    size = min(cfg.workers, 16)
+    storm_cfg = replace(
+        cfg, workers=size, speedup=4.0, max_batch_size=2,
+        trace_rate_per_s=size * 16.0,
+    )
+    fleet = await MockFleet(storm_cfg, size).start()
+    try:
+        engine = await fleet.client_path(migration=True)
+        n_int = max(cfg.trace_n() // 4, 24)
+        int_rate = storm_cfg.trace_rate() / 16.0
+        int_trace = _mk_trace(
+            storm_cfg, "tenant_int", requests=n_int, rate=int_rate,
+            seed=cfg.seed,
+        )
+        # storm length scales with the SUB-fleet (≈1.5s of flood at the
+        # storm rate), not the global worker count — a small --workers
+        # run must still saturate the slots it has, or the falsifiable
+        # batch_actually_stormed invariant correctly calls it out
+        n_batch = max(cfg.trace_n(), size * 25)
+        batch_trace = _mk_trace(
+            storm_cfg, "tenant_batch", requests=n_batch,
+            rate=storm_cfg.trace_rate(), osl=cfg.osl * 4,
+            seed=cfg.seed + 7,
+        )
+        # the contended phase replays a DIFFERENT interactive trace
+        # (fresh seed, same shape): re-running the baseline's exact
+        # tokens would ride the prefix caches the baseline just warmed
+        # and mask real contention in the SLO comparison
+        int_trace_cold = _mk_trace(
+            storm_cfg, "tenant_int_cold", requests=n_int, rate=int_rate,
+            seed=cfg.seed + 13,
+        )
+        hdr_int = {"x-dyn-tenant": "live", "x-dyn-priority": "interactive"}
+        hdr_batch = {"x-dyn-tenant": "bulk", "x-dyn-priority": "batch"}
+
+        base = await replay_trace(
+            engine.generate, int_trace, headers=hdr_int, id_prefix="tb",
+        )
+        base_sum = base.summary()
+
+        contended, flood = await asyncio.gather(
+            replay_trace(
+                engine.generate, int_trace_cold, headers=hdr_int,
+                id_prefix="ti",
+            ),
+            replay_trace(
+                engine.generate, batch_trace, headers=hdr_batch,
+                id_prefix="tf",
+            ),
+        )
+        cont_sum = contended.summary()
+        flood_sum = flood.summary()
+    finally:
+        await fleet.close()
+    slo_s = max(
+        cfg.slo_ttft_factor * (base_sum["ttft_ms_p50"] or 0.0) / 1e3,
+        cfg.slo_ttft_floor_s,
+    )
+    p99_s = (cont_sum["ttft_ms_p99"] or float("inf")) / 1e3
+    return {
+        "fleet": size,
+        "slots_per_worker": storm_cfg.max_batch_size,
+        "interactive_baseline": base_sum,
+        "interactive_contended": cont_sum,
+        "batch_flood": flood_sum,
+        "slo_ttft_ms": round(slo_s * 1e3, 1),
+        "invariants": {
+            "interactive_ttft_slo_held": _inv(
+                p99_s <= slo_s,
+                p99_ms=cont_sum["ttft_ms_p99"],
+                slo_ms=round(slo_s * 1e3, 1),
+            ),
+            "interactive_zero_errors": _inv(
+                not contended.errors, errors=contended.errors[:5]
+            ),
+            # falsifiable saturation check: if the flood never actually
+            # contended for slots (e.g. every request bounced), batch
+            # TTFT would sit at the uncontended baseline and the SLO
+            # invariant above would be passing against an idle fleet
+            "batch_actually_stormed": _inv(
+                not flood.errors
+                and (flood_sum["ttft_ms_p50"] or 0.0)
+                >= 2.0 * (base_sum["ttft_ms_p50"] or float("inf")),
+                batch_ttft_ms_p50=flood_sum["ttft_ms_p50"],
+                baseline_ttft_ms_p50=base_sum["ttft_ms_p50"],
+                flood_errors=len(flood.errors),
+            ),
+        },
+    }
+
+
+# -- telemetry overhead ------------------------------------------------------
+
+
+async def telemetry(cfg: SimConfig) -> dict:
+    """Span/metric emission overhead as a fraction of step time — the
+    'does observability self-DoS at fleet scale' check (ROADMAP #7 named
+    PR 10's telemetry volume as an open question)."""
+    out = telemetry_overhead(cfg)
+    return {
+        **out,
+        "invariants": {
+            # a real (undilated) engine step must spend <5% of its time
+            # on span+metric emission
+            "emission_under_5pct_of_real_step": _inv(
+                out["emission_frac_of_real_step"] < 0.05,
+                frac=out["emission_frac_of_real_step"],
+            ),
+        },
+    }
+
+
+SCENARIOS = {
+    "pick_scaling": pick_scaling,
+    "leader_kill": leader_kill,
+    "partition": partition,
+    "churn": churn,
+    "breaker_storm": breaker_storm,
+    "tenant_storm": tenant_storm,
+    "telemetry_overhead": telemetry,
+}

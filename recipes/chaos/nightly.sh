@@ -15,10 +15,25 @@ export DYN_TEST_TIMEOUT="${DYN_TEST_TIMEOUT:-$((${DYN_SOAK_SECS%.*} + 300))}"
 echo "chaos soak: DYN_SOAK_SECS=$DYN_SOAK_SECS" \
      "DYN_FAULTS=$DYN_FAULTS seed=$DYN_FAULTS_SEED"
 
+# cluster-scale chaos sim (dynamo_tpu/sim): the full scenario matrix at
+# 100s-of-workers scale — partitions, leader SIGKILL mid-commit-storm,
+# churn under trace replay, breaker + tenant storms — with the
+# saturation-curve artifact kept for trend review. Runs WITHOUT the
+# background DYN_FAULTS spec: scenarios own their fault schedules.
+DYN_FAULTS="" python -m dynamo_tpu.sim --scenario all \
+  --workers "${DYN_SIM_WORKERS:-200}" \
+  --seed "$DYN_FAULTS_SEED" \
+  --out "${DYN_SIM_OUT:-SIM_nightly.json}"
+
+# test_sim_full_matrix is deselected: the gating CLI run above IS the
+# full matrix (same code path), and the pytest copy would additionally
+# inherit the background DYN_FAULTS spec the scenarios must own
 exec python -m pytest -q -p no:cacheprovider \
+  --deselect "tests/test_cluster_sim.py::test_sim_full_matrix" \
   tests/test_faults.py \
   tests/test_fault_tolerance.py \
   tests/test_overload.py \
+  tests/test_cluster_sim.py \
   "tests/test_soak.py::test_soak_worker_sigkill_churn" \
   "tests/test_soak.py::test_soak_leader_hub_sigkill_recovery" \
   "tests/test_overload.py::test_soak_overload_quota_storm" \

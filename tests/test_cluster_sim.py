@@ -1,0 +1,286 @@
+"""Cluster chaos sim (dynamo_tpu/sim): tier-1 smoke + mocker chaos
+parity + hub-client failover metrics + the shared replay core.
+
+The smoke runs two real scenarios (one partition, one churn) against a
+small, heavily time-dilated fleet and asserts the SAME invariants the
+nightly 100s-of-workers matrix asserts — zero client-visible errors with
+migrations > 0 under churn, and the jepsen-style WAL checker over the
+partitioned quorum hub. The full matrix is ``test_sim_full_matrix``
+(slow, recipes/chaos/nightly.sh).
+"""
+
+import asyncio
+import time
+
+import pytest
+
+from benchmarks import replay, router_bench
+
+from dynamo_tpu.mocker.engine import MockEngine, MockEngineConfig
+from dynamo_tpu.runtime import framing
+from dynamo_tpu.runtime.context import (
+    Context,
+    DeadlineExceeded,
+    ServiceUnavailable,
+)
+from dynamo_tpu.runtime.faults import FAULTS
+from dynamo_tpu.runtime.hub_client import RemoteHub, failover_stats
+from dynamo_tpu.sim.harness import SimConfig, run_scenarios
+
+pytestmark = pytest.mark.integration
+
+
+def _smoke_cfg(**over) -> SimConfig:
+    base = dict(
+        workers=10, speedup=400.0, block_size=8, worker_blocks=1024,
+        trace_requests=160, churn_waves=2, churn_kill_frac=0.2,
+        lease_s=0.3, commit_timeout_s=1.0, partition_window_s=1.2,
+        storm_duration_s=3.0, picks=60, seed=3,
+    )
+    base.update(over)
+    return SimConfig(**base)
+
+
+# -- the tier-1 smoke: one partition + one churn scenario -------------------
+
+
+async def test_sim_smoke_partition_and_churn(tmp_path):
+    """<=16 mock workers, high speedup, invariants asserted, well under
+    the tier-1 budget. Churn must show ZERO client-visible errors with
+    migrations > 0; the partition scenario must pass the WAL invariant
+    checker (no dual-lead, no committed fork) with every acked write
+    durable across the heals."""
+    cfg = _smoke_cfg(data_dir=str(tmp_path))
+    artifact = await run_scenarios(cfg, ["partition", "churn"])
+    scen = artifact["scenarios"]
+
+    part = scen["partition"]
+    assert part["verdict"] == "pass", part
+    assert part["invariants"]["cluster_invariants"]["pass"]
+    assert part["invariants"]["no_acked_write_lost"]["pass"]
+    assert part["commits_acked"] > 0
+
+    ch = scen["churn"]
+    assert ch["verdict"] == "pass", ch
+    assert ch["errors"] == 0
+    assert ch["migrations"] > 0
+    assert ch["killed"] > 0 and ch["rejoined"] == ch["killed"]
+    assert ch["requests"] == cfg.trace_n()
+    # the dilated rate is the headline the artifact reports
+    assert ch["dilated_req_per_s"] > ch["req_per_s"]
+    assert artifact["verdict"] == "pass"
+
+
+# -- mocker chaos parity (one DYN_FAULTS spec for real AND mock fleets) ------
+
+
+def _eng(**over) -> MockEngine:
+    base = dict(
+        block_size=4, total_kv_blocks=256, speedup_ratio=1000.0, seed=1
+    )
+    base.update(over)
+    return MockEngine(MockEngineConfig(**base))
+
+
+async def test_mock_engine_rejects_expired_deadline_at_admission():
+    eng = _eng()
+    ctx = Context(deadline=time.monotonic() - 0.1)
+    with pytest.raises(DeadlineExceeded):
+        async for _ in eng.generate(
+            {"token_ids": [1, 2, 3], "stop_conditions": {"max_tokens": 4}},
+            ctx,
+        ):
+            pass
+    assert eng.kv.active_blocks == 0
+
+
+async def test_mock_engine_cuts_generation_at_deadline():
+    """Mid-decode deadline expiry ends the stream with the real engine's
+    'deadline exceeded' error item — not a hang, not a silent stop."""
+    eng = _eng(speedup_ratio=1.0, decode_step_s=0.02, prefill_base_s=0.0)
+    ctx = Context(deadline=time.monotonic() + 0.08)
+    out = [
+        x async for x in eng.generate(
+            {"token_ids": [1, 2, 3, 4],
+             "stop_conditions": {"max_tokens": 500, "ignore_eos": True}},
+            ctx,
+        )
+    ]
+    assert out[-1]["finish_reason"] == "error"
+    assert out[-1]["error"] == "deadline exceeded"
+    assert 0 < len(out) < 500
+    assert eng.kv.active_blocks == 0
+
+
+async def test_mock_engine_admit_fault_is_retryable_503():
+    """engine.admit:drop maps to ServiceUnavailable exactly like the
+    real engine (migration re-drives on another instance) — and the
+    fault exhausts, so the next admission serves."""
+    eng = _eng()
+    req = {"token_ids": [5, 6, 7], "stop_conditions": {"max_tokens": 2}}
+    FAULTS.configure("engine.admit:drop@1x1")
+    try:
+        with pytest.raises(ServiceUnavailable):
+            async for _ in eng.generate(req, Context()):
+                pass
+        out = [x async for x in eng.generate(req, Context())]
+        assert out[-1]["finish_reason"] in ("length", "stop")
+    finally:
+        FAULTS.clear()
+    assert eng.kv.active_blocks == 0
+
+
+async def test_mock_engine_step_fault_fails_stream_then_recovers():
+    """engine.step:error fails the in-flight stream with an error item
+    (the real engine's fail-then-keep-serving shape); the next request
+    on the same engine is clean, and no blocks leak."""
+    eng = _eng()
+    req = {"token_ids": [5, 6, 7],
+           "stop_conditions": {"max_tokens": 4, "ignore_eos": True}}
+    FAULTS.configure("engine.step:error@1x1")
+    try:
+        out = [x async for x in eng.generate(req, Context())]
+        assert out[-1]["finish_reason"] == "error"
+        assert "injected step failure" in out[-1]["error"]
+        out2 = [x async for x in eng.generate(req, Context())]
+        assert out2[-1]["finish_reason"] == "length"
+    finally:
+        FAULTS.clear()
+    assert eng.kv.active_blocks == 0
+
+
+async def test_mock_engine_interactive_admitted_before_batch():
+    """Class-priority admission parity: with every slot held, a waiting
+    interactive request is granted the freed slot ahead of a batch
+    request that queued FIRST."""
+    eng = _eng(max_batch_size=1, speedup_ratio=100.0, decode_step_s=0.01)
+    done: list[str] = []
+
+    async def run(tag: str, priority: str, tokens: int):
+        ctx = Context(headers={"x-dyn-priority": priority})
+        async for _ in eng.generate(
+            {"token_ids": [1, 2, 3],
+             "stop_conditions": {"max_tokens": tokens, "ignore_eos": True}},
+            ctx,
+        ):
+            pass
+        done.append(tag)
+
+    hog = asyncio.ensure_future(run("hog", "batch", 40))
+    await asyncio.sleep(0.02)  # the hog owns the only slot
+    batch = asyncio.ensure_future(run("batch", "batch", 2))
+    await asyncio.sleep(0.01)  # batch queues first...
+    inter = asyncio.ensure_future(run("interactive", "interactive", 2))
+    await asyncio.gather(hog, batch, inter)
+    assert done.index("interactive") < done.index("batch"), done
+
+
+# -- hub_client failover metrics --------------------------------------------
+
+
+async def test_hub_client_redirect_and_backoff_metrics():
+    """A not_leader bounce increments dynamo_hub_redirects_total{reason}
+    and the chase's sleep lands in dynamo_hub_backoff_seconds — the
+    redirect-chase storm is a first-class signal, not an inference."""
+
+    bounces = {"n": 0}
+
+    async def handle(reader, writer):
+        while True:
+            msg = await framing.read_frame(reader)
+            if msg is None:
+                break
+            if msg.get("op") == "put" and bounces["n"] < 2:
+                bounces["n"] += 1
+                await framing.write_frame(writer, {
+                    "id": msg["id"], "ok": False, "error": "not_leader",
+                    "leader": None,
+                })
+            else:
+                await framing.write_frame(writer, {
+                    "id": msg["id"], "ok": True, "result": None,
+                })
+        writer.close()
+
+    server = await asyncio.start_server(handle, "127.0.0.1", 0)
+    port = server.sockets[0].getsockname()[1]
+    before = failover_stats()
+    client = await RemoteHub.connect(
+        f"127.0.0.1:{port}", reconnect_window_s=10.0
+    )
+    try:
+        await client.put("k", 1)
+    finally:
+        await client.close()
+        server.close()
+        await server.wait_closed()
+    after = failover_stats()
+    assert after.get("not_leader", 0) - before.get("not_leader", 0) >= 2
+    assert after.get("backoff_count", 0) - before.get("backoff_count", 0) >= 2
+    assert after.get("backoff_sum_s", 0) > before.get("backoff_sum_s", 0)
+    # and it rides every /metrics surface via the global provider
+    from dynamo_tpu.runtime.metrics import MetricsRegistry
+
+    text = MetricsRegistry().exposition().decode()
+    assert "dynamo_hub_redirects_total" in text
+    assert "dynamo_hub_backoff_seconds" in text
+
+
+# -- shared replay core ------------------------------------------------------
+
+
+async def test_replay_module_is_the_single_source(tmp_path):
+    """router_bench and the sim must share ONE replay implementation —
+    the same function objects, so timestamp handling and percentile math
+    cannot drift — and a replay over a bare mock engine produces the
+    full summary schema."""
+    assert router_bench.load_trace is replay.load_trace
+    assert router_bench.synthesize_trace is replay.synthesize_trace
+
+    path = tmp_path / "t.jsonl"
+    replay.synthesize_trace(str(path), requests=24, block_size=4, osl=2,
+                            rate_per_s=500.0)
+    trace = replay.load_trace(str(path), 4)
+    assert len(trace) == 24
+    eng = _eng(speedup_ratio=2000.0)
+    res = await replay.replay_trace(eng.generate, trace, id_prefix="rp")
+    assert res.errors == []
+    s = res.summary()
+    assert s["requests"] == 24 and s["errors"] == 0
+    for key in ("req_per_s", "ttft_ms_p50", "ttft_ms_p99",
+                "ttft_ms_mean", "prefix_hit_rate"):
+        assert s[key] is not None
+    assert all(r["ttft"] is not None for r in res.results)
+
+    # error accounting: a dead-on-arrival deadline is a recorded error,
+    # not an exception out of the replay loop
+    res2 = await replay.replay_trace(
+        eng.generate, trace[:4],
+        headers={"x-dyn-deadline-ms": "0"}, id_prefix="rpx",
+    )
+    assert len(res2.errors) == 4
+
+
+# -- the full matrix (nightly chaos tier) ------------------------------------
+
+
+@pytest.mark.slow
+@pytest.mark.e2e
+async def test_sim_full_matrix(tmp_path):
+    """All scenarios at 100s-of-workers scale (recipes/chaos/nightly.sh
+    runs this; ``python -m dynamo_tpu.sim --scenario all --workers 200``
+    is the artifact-producing equivalent)."""
+    cfg = SimConfig(
+        workers=200, speedup=50.0, data_dir=str(tmp_path),
+        storm_duration_s=6.0, partition_window_s=2.5,
+    )
+    from dynamo_tpu.sim.scenarios import SCENARIOS
+
+    artifact = await run_scenarios(cfg, list(SCENARIOS))
+    failed = {
+        n: s for n, s in artifact["scenarios"].items()
+        if s["verdict"] != "pass"
+    }
+    assert not failed, failed
+    curve = artifact["scenarios"]["pick_scaling"]["curve"]
+    assert len(curve) >= 3 and curve[-1]["instances"] >= 200

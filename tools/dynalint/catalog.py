@@ -148,6 +148,15 @@ METRIC_NAMES: dict[str, str] = {
     "hub_elections_total": "hub replica election rounds by outcome "
                            "(won/lost/pre_lost)",
     "hub_term": "current fencing epoch (election term) per hub replica",
+    "hub_redirects_total": "hub client write bounces by reason "
+                           "(not_leader | no_quorum | unavailable) — a "
+                           "redirect-chase storm during failover is a "
+                           "first-class signal, not an inference from "
+                           "latency (sim leader-kill scenario asserts "
+                           "on it)",
+    "hub_backoff_seconds": "seconds the hub client slept between "
+                           "redirect hops (server-hinted and "
+                           "exponential backoff alike)",
     "spec_tokens_total": "speculative draft tokens by verify outcome "
                          "(accepted | rejected) — the live acceptance "
                          "rate of prompt-lookup decoding",
