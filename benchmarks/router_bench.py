@@ -32,14 +32,18 @@ from __future__ import annotations
 import argparse
 import asyncio
 import json
+import os
+import random
 import time
 
 import numpy as np
 
 from benchmarks.loadgen import pct_ms
 from benchmarks.replay import load_trace, replay_trace, synthesize_trace
-from dynamo_tpu.kv_router.protocols import RouterConfig
+from dynamo_tpu.kv_router.protocols import ForwardPassMetrics, RouterConfig
 from dynamo_tpu.kv_router.router import KvPushRouter, KvRouter
+from dynamo_tpu.kv_router.scheduler import DefaultWorkerSelector, KvScheduler
+from dynamo_tpu.kv_router.sharding import ShardMap
 from dynamo_tpu.mocker.__main__ import launch_mock_worker
 from dynamo_tpu.mocker.engine import MockEngineConfig
 from dynamo_tpu.runtime.context import Context
@@ -48,6 +52,11 @@ from dynamo_tpu.runtime.hub import InMemoryHub
 from dynamo_tpu.runtime.push import PushRouter, RouterMode
 
 NS, COMP, EP = "bench", "mock", "generate"
+
+# PR 14's measured single-router cap (SIM_r01.json churn scenario at 200
+# instances, full replay path): the routed-req/s baseline the war
+# bench's >=10x acceptance bar is anchored on (ROADMAP #7b).
+PR14_BASELINE_REQ_PER_S = 1000.0
 
 
 def build_workload(args, seed: int = 0) -> list[list[list[int]]]:
@@ -230,6 +239,319 @@ async def bench_trace(args) -> dict:
     return out
 
 
+# -- router data-plane war (ROUTER_r0x artifact) -----------------------------
+#
+# Three measurements attacking the three terms of the single-router cap
+# (ROADMAP #7b/c): the DECISION (O(instances) select + O(tokens) hashing
+# -> incremental selector + amortized hashing), the TRANSPORT (aiohttp
+# /pick overhead -> pickline), and SHARDING (prefix-hash shard map over
+# N full-state router processes). Each shard's state here is built from
+# the same synthetic event stream — the stand-in for N processes
+# consuming the same hub KV-event watch, which is what makes full-state
+# shards convergent in production.
+
+
+def build_router_state(
+    args, *, oracle: bool = False, hash_cache: bool = True,
+    use_approx: bool = False, seed: int = 0,
+) -> tuple[KvRouter, list[list[int]]]:
+    """A converged router over ``--instances`` synthetic workers plus a
+    prefix-structured request stream: the state an event watch produces,
+    fed directly (no hub, no loops) so the measurement isolates the
+    decision itself."""
+    from dynamo_tpu.tokens import compute_sequence_hashes
+
+    rng = random.Random(seed)
+    bs = args.block_size
+    cfg = RouterConfig(block_size=bs, use_approx=use_approx)
+    router = KvRouter(InMemoryHub(), "war/bench", cfg)  # never start()ed
+    if oracle:
+        router.scheduler = KvScheduler(
+            cfg, selector=DefaultWorkerSelector(random.Random(seed))
+        )
+    if not hash_cache:
+        router.hasher.max_entries = 0
+    workers = list(range(1, args.instances + 1))
+    router.scheduler.update_workers(workers)
+    for w in workers:
+        router.scheduler.update_metrics(ForwardPassMetrics(
+            worker_id=w,
+            active_kv_blocks=rng.randrange(0, args.worker_blocks // 4),
+            total_kv_blocks=args.worker_blocks,
+            waiting_requests=rng.randrange(0, 4),
+        ))
+    # radix residency: each prompt group's shared prefix lives on a few
+    # workers (the steady state KV events converge to)
+    prompts: list[list[int]] = []
+    for _g in range(args.groups):
+        prefix = [rng.randrange(10, 30000) for _ in range(bs * args.depth)]
+        hashes = compute_sequence_hashes(prefix, bs)
+        parents = [0] + hashes[:-1]
+        for w in rng.sample(workers, min(8, len(workers))):
+            for sh, parent in zip(hashes, parents):
+                router.tree._store(w, sh, parent)
+        prompts.append(prefix)
+    requests = [
+        prompts[rng.randrange(args.groups)]
+        + [rng.randrange(10, 30000) for _ in range(bs * 2)]
+        for _ in range(args.war_requests)
+    ]
+    return router, requests
+
+
+def _drive_picks(router: KvRouter, requests: list[list[int]],
+                 start: int = 0) -> dict:
+    """Run the full decision path (find + free) over ``requests``;
+    returns req/s + per-phase attribution from the router's counters."""
+    picks0, totals0 = router.picks, dict(router.pick_phase_totals)
+    hits0, misses0 = router.hasher.hits, router.hasher.misses
+    scans0 = router.scheduler.full_pick_scans
+    t0 = time.perf_counter()
+    for i, toks in enumerate(requests):
+        rid = f"war-{start + i}"
+        router.find_best_match(rid, toks)
+        router.free(rid)
+    busy_s = time.perf_counter() - t0
+    picks = router.picks - picks0
+    phases = {
+        k: round(1e6 * (router.pick_phase_totals[k] - totals0[k])
+                 / max(picks, 1), 2)
+        for k in totals0
+    }
+    return {
+        "picks": picks,
+        "busy_s": round(busy_s, 4),
+        "req_per_s": round(picks / max(busy_s, 1e-9), 1),
+        "pick_us_mean": round(1e6 * busy_s / max(picks, 1), 2),
+        "phase_us": phases,  # hash / overlap / select, per pick
+        # window deltas — cumulative counters would fold the warm-up
+        # run's traffic into the measured window's numbers
+        "full_pick_scans": router.scheduler.full_pick_scans - scans0,
+        "hash_cache": {"hits": router.hasher.hits - hits0,
+                       "misses": router.hasher.misses - misses0},
+    }
+
+
+def war_decision(args) -> dict:
+    """Single-process decision throughput at ``--instances``: the PR 14
+    oracle configuration (full-fleet scan + uncached hashing) vs the
+    incremental selector with amortized hashing, phase-attributed."""
+    out = {}
+    for name, kw in (
+        ("oracle_nocache", dict(oracle=True, hash_cache=False)),
+        ("incremental_nocache", dict(hash_cache=False)),
+        ("incremental", dict()),
+    ):
+        router, requests = build_router_state(args, **kw)
+        _drive_picks(router, requests[: args.war_requests // 4])  # warm
+        out[name] = _drive_picks(router, requests, start=10**6)
+    out["speedup_vs_oracle"] = round(
+        out["incremental"]["req_per_s"]
+        / max(out["oracle_nocache"]["req_per_s"], 1e-9), 2,
+    )
+    return out
+
+
+async def war_transport(args) -> dict:
+    """/pick transport attribution over a REAL EndpointPicker: aiohttp
+    route vs the pickline persistent-connection fast path, same fleet,
+    same prompts — the gap is pure transport."""
+    import aiohttp
+
+    from dynamo_tpu.gateway.epp import EndpointPicker
+    from dynamo_tpu.gateway.pickline import PickLineClient
+
+    drt = DistributedRuntime(InMemoryHub())
+    n_workers = min(args.instances, 32)  # transport term, not fleet term
+    for _w in range(n_workers):
+        await launch_mock_worker(
+            drt, NS, COMP, EP,
+            MockEngineConfig(block_size=args.block_size,
+                             speedup_ratio=args.speedup),
+        )
+    epp = await EndpointPicker(
+        drt, namespace=NS, target_component=COMP, target_endpoint=EP,
+        config=RouterConfig(block_size=args.block_size),
+        host="127.0.0.1", port=0, pick_port=0,
+    ).start()
+    try:
+        deadline = time.monotonic() + 20
+        while len(epp.kv.scheduler.workers()) < n_workers:
+            assert time.monotonic() < deadline, "EPP never saw the fleet"
+            await asyncio.sleep(0.02)
+        rng = random.Random(args.seed if hasattr(args, "seed") else 0)
+        prompts = [
+            [rng.randrange(10, 30000)
+             for _ in range(args.block_size * args.depth)]
+            for _ in range(32)
+        ]
+        n = args.transport_picks
+
+        http_lats: list[float] = []
+        async with aiohttp.ClientSession() as sess:
+            url = f"http://127.0.0.1:{epp.port}/pick"
+            for i in range(n):
+                body = {"token_ids": prompts[i % 32],
+                        "request_id": f"wt-{i}"}
+                t0 = time.perf_counter()
+                async with sess.post(url, json=body) as resp:
+                    assert resp.status == 200, await resp.text()
+                    await resp.json()
+                http_lats.append(time.perf_counter() - t0)
+
+        cl = await PickLineClient("127.0.0.1", epp.pick_port).connect()
+        line_lats: list[float] = []
+        for i in range(n):
+            body = {"token_ids": prompts[i % 32], "request_id": f"wl-{i}"}
+            t0 = time.perf_counter()
+            r = await cl.pick(body)
+            assert r["status"] == 200, r
+            line_lats.append(time.perf_counter() - t0)
+        await cl.close()
+        decision_us = 1e6 * sum(
+            epp.kv.pick_phase_totals.values()
+        ) / max(epp.kv.picks, 1)
+        return {
+            "picks_each": n,
+            "aiohttp_ms_p50": pct_ms(http_lats, 0.5),
+            "aiohttp_ms_p90": pct_ms(http_lats, 0.9),
+            "pickline_ms_p50": pct_ms(line_lats, 0.5),
+            "pickline_ms_p90": pct_ms(line_lats, 0.9),
+            "decision_us_mean": round(decision_us, 1),
+            "transport_displaced_frac": round(
+                1.0 - pct_ms(line_lats, 0.5)
+                / max(pct_ms(http_lats, 0.5), 1e-9), 3,
+            ),
+        }
+    finally:
+        await epp.close()
+        await drt.close()
+
+
+def war_sharded(args) -> dict:
+    """Prefix-hash sharding: the same request stream split by ShardMap
+    over N full-state routers, each built from the SAME synthetic event
+    stream (the same-hub-watch convergence property). Each shard's
+    partition runs in isolation and its busy time is recorded; the
+    aggregate is total picks / max(shard busy) — the parallel-equivalent
+    wall clock, exact because shards share no state and no locks (and
+    honest on this 1-core container, where concurrent shard processes
+    would just timeslice). Divergence asserts: every shard's radix
+    digest identical (convergent event-sourced state), every shard's
+    OPTIMISTIC (approx-indexer) prefix set disjoint (one prefix's picks
+    land on one shard, so its TTL state has exactly one home)."""
+    import hashlib
+
+    shard_counts = [int(s) for s in args.shards.split(",")]
+    runs = []
+    for n_shards in shard_counts:
+        smap = ShardMap(n_shards, args.block_size)
+        routers = []
+        for shard in range(n_shards):
+            # seed is SHARED: every shard consumes the same event stream
+            router, requests = build_router_state(
+                args, use_approx=True, seed=args.instances,
+            )
+            routers.append((router, requests))
+        # all shards were built from one seed => identical requests
+        requests = routers[0][1]
+        parts: dict[int, list[list[int]]] = {s: [] for s in range(n_shards)}
+        for toks in requests:
+            parts[smap.shard_for(toks)].append(toks)
+        shard_stats = []
+        for shard, (router, _reqs) in enumerate(routers):
+            res = _drive_picks(router, parts[shard], start=shard * 10**6)
+            res["shard"] = shard
+            shard_stats.append(res)
+        total_picks = sum(s["picks"] for s in shard_stats)
+        slowest = max(s["busy_s"] for s in shard_stats)
+        digests = [
+            hashlib.sha256(
+                json.dumps(r.tree.snapshot(), sort_keys=True).encode()
+            ).hexdigest()[:16]
+            for r, _ in routers
+        ]
+        approx_sets = [
+            {sh for (_w, sh) in r.approx._deadlines} for r, _ in routers
+        ]
+        disjoint = all(
+            not (approx_sets[i] & approx_sets[j])
+            for i in range(n_shards) for j in range(i + 1, n_shards)
+        )
+        runs.append({
+            "shards": n_shards,
+            "picks": total_picks,
+            "aggregate_req_per_s": round(
+                total_picks / max(slowest, 1e-9), 1
+            ),
+            "balance": round(
+                min(s["picks"] for s in shard_stats)
+                / max(max(s["picks"] for s in shard_stats), 1), 3,
+            ),
+            "per_shard": shard_stats,
+            "radix_digests_identical": len(set(digests)) == 1,
+            "approx_state_disjoint": disjoint,
+        })
+    base = runs[0]["aggregate_req_per_s"]
+    return {
+        "method": "per-shard busy time measured in isolation; "
+                  "aggregate = total picks / max shard busy (exact for "
+                  "share-nothing shards; measured on "
+                  f"{os.cpu_count()} core(s))",
+        "runs": runs,
+        "scaling": {
+            str(r["shards"]): round(r["aggregate_req_per_s"] / base, 2)
+            for r in runs
+        },
+    }
+
+
+async def war(args) -> dict:
+    # prefix diversity floor: the shard map partitions PREFIX GROUPS, so
+    # a handful of groups over 4 shards is lumpy by construction — real
+    # routed traffic has thousands of distinct preambles
+    args.groups = max(args.groups, 256)
+    decision = war_decision(args)
+    transport = await war_transport(args)
+    sharded = war_sharded(args)
+    inc = decision["incremental"]["req_per_s"]
+    max_shards = max(r["shards"] for r in sharded["runs"])
+    top = next(r for r in sharded["runs"] if r["shards"] == max_shards)
+    bars = {
+        # the acceptance bars (ISSUE 15): >=10x the PR 14 single-router
+        # cap, near-linear >=4-shard scaling, zero prefix-state
+        # divergence, and the decision stays full-fleet-scan-free
+        "decision_10x_pr14_baseline": inc >= 10 * PR14_BASELINE_REQ_PER_S,
+        "zero_full_fleet_scans": (
+            decision["incremental"]["full_pick_scans"] == 0
+        ),
+        "shard_scaling_near_linear": (
+            sharded["scaling"][str(max_shards)] >= 0.75 * max_shards
+        ),
+        "zero_cross_shard_divergence": (
+            top["radix_digests_identical"] and top["approx_state_disjoint"]
+        ),
+        "pickline_displaces_transport": (
+            transport["pickline_ms_p50"] < transport["aiohttp_ms_p50"]
+        ),
+    }
+    return {
+        "schema": "dynamo-router-war/v1",
+        "config": {
+            "instances": args.instances, "block_size": args.block_size,
+            "groups": args.groups, "depth": args.depth,
+            "war_requests": args.war_requests,
+            "shard_counts": args.shards,
+            "pr14_baseline_req_per_s": PR14_BASELINE_REQ_PER_S,
+        },
+        "decision": decision,
+        "transport": transport,
+        "sharded": sharded,
+        "bars": bars,
+        "verdict": "pass" if all(bars.values()) else "fail",
+    }
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser("router prefix-ratio benchmark")
     p.add_argument("--workers", type=int, default=4)
@@ -250,7 +572,32 @@ def main(argv=None) -> int:
     p.add_argument("--sweep", default=None,
                    help="comma-separated rate multipliers, e.g. 0.5,1,2,4: "
                         "replay at each and mark the Pareto front")
+    p.add_argument("--war", action="store_true",
+                   help="router data-plane war bench: decision + "
+                        "transport + sharding attribution -> the "
+                        "ROUTER_r0x artifact")
+    p.add_argument("--instances", type=int, default=200,
+                   help="[war] synthetic worker count for the decision "
+                        "bench")
+    p.add_argument("--depth", type=int, default=8,
+                   help="[war] shared-prefix depth in blocks")
+    p.add_argument("--war-requests", type=int, default=4000,
+                   help="[war] picks per decision configuration")
+    p.add_argument("--transport-picks", type=int, default=300,
+                   help="[war] picks per transport configuration")
+    p.add_argument("--shards", default="1,2,4",
+                   help="[war] comma-separated shard counts to sweep")
+    p.add_argument("--out", default=None,
+                   help="[war] also write the artifact JSON to this path")
     args = p.parse_args(argv)
+    if args.war:
+        out = asyncio.run(war(args))
+        print(json.dumps(out))
+        if args.out:
+            with open(args.out, "w") as f:
+                json.dump(out, f, indent=1)
+                f.write("\n")
+        return 0 if out["verdict"] == "pass" else 1
     if args.trace:
         print(json.dumps(asyncio.run(bench_trace(args))))
     else:

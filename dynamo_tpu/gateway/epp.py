@@ -38,6 +38,7 @@ from aiohttp import web
 from dynamo_tpu.gateway.breaker import BreakerBoard, BreakerConfig
 from dynamo_tpu.kv_router.protocols import RouterConfig
 from dynamo_tpu.kv_router.router import KvRouter
+from dynamo_tpu.kv_router.sharding import shards_from_env
 from dynamo_tpu.runtime import tracing
 from dynamo_tpu.runtime.component import INSTANCE_ROOT, Instance
 from dynamo_tpu.runtime.faults import FAULTS
@@ -132,6 +133,9 @@ class EndpointPicker:
         port: int = 9002,
         card_ttl_s: float = 2.0,
         breaker_config: "BreakerConfig | None" = None,
+        pick_port: int | None = None,
+        shard_id: int = 0,
+        shards: int = 1,
     ):
         self.drt = drt
         self.namespace = namespace
@@ -140,6 +144,16 @@ class EndpointPicker:
         self.config = config
         self.host = host
         self.port = port
+        # pickline fast path: persistent-connection newline-JSON picks
+        # (gateway/pickline.py); None = disabled, 0 = ephemeral port
+        self.pick_port = pick_port
+        self._pickline = None
+        # prefix-hash sharding (kv_router/sharding.py ShardMap): which
+        # shard of the routing data plane this process serves — purely
+        # observational here (the map lives at the dispatcher), exported
+        # as the dynamo_router_shard_id gauge
+        self.shard_id = shard_id
+        self.shards = shards
         self.kv: KvRouter | None = None
         self._tokenizers: dict[str, Any] = {}
         self._runner: web.AppRunner | None = None
@@ -193,6 +207,7 @@ class EndpointPicker:
         self._watch_tasks: list[asyncio.Task] = []
 
     async def start(self) -> "EndpointPicker":
+        from dynamo_tpu.kv_router.router import ROUTER_SHARD_GAUGE
         from dynamo_tpu.runtime.context import spawn
 
         self.kv = await KvRouter(
@@ -200,10 +215,18 @@ class EndpointPicker:
             f"{self.namespace}/{self.target_component}",
             self.config,
         ).start()
+        ROUTER_SHARD_GAUGE.set(self.shard_id)
         self._watch_tasks = [
             spawn(self._cards.watch(), name="epp-cards-watch"),
             spawn(self._instances.watch(), name="epp-instances-watch"),
         ]
+        if self.pick_port is not None:
+            from dynamo_tpu.gateway.pickline import PickLineServer
+
+            self._pickline = await PickLineServer(
+                self, host=self.host, port=self.pick_port,
+            ).start()
+            self.pick_port = self._pickline.port
         app = web.Application()
         app.router.add_post("/pick", self._pick)
         app.router.add_post("/report", self._report)
@@ -273,6 +296,9 @@ class EndpointPicker:
             # hub round-trips actually paid for cards/instances: with
             # the pick-path caches warm this stays flat while picks grow
             "hub_scans": self._cards.scans + self._instances.scans,
+            "shard": self.shard_id,
+            "shards": self.shards,
+            "pick_port": self.pick_port,
         })
 
     async def _metrics(self, _req: web.Request) -> web.Response:
@@ -281,6 +307,11 @@ class EndpointPicker:
             content_type="text/plain",
             charset="utf-8",
         )
+
+    def observe_pick(self, seconds: float) -> None:
+        """Record one pick-path latency (shared with the pickline
+        transport, which has no aiohttp middleware to hook)."""
+        self._m_pick.observe(seconds)
 
     async def _pick(self, req: web.Request) -> web.Response:
         """One routing decision. Joined to the caller's W3C trace when a
@@ -305,21 +336,27 @@ class EndpointPicker:
             return web.json_response(
                 {"error": "body must be JSON"}, status=400
             )
+        status, payload, headers = await self.pick_decision(body)
+        return web.json_response(payload, status=status, headers=headers)
+
+    async def pick_decision(
+        self, body: dict
+    ) -> tuple[int, dict, dict]:
+        """ONE routing decision from a parsed /pick body — the shared
+        core of the aiohttp route and the pickline fast path. Returns
+        (http_status, response_payload, response_headers)."""
         token_ids = body.get("token_ids")
         if token_ids is None:
             prompt = body.get("prompt")
             if not isinstance(prompt, str):
-                return web.json_response(
-                    {"error": "one of token_ids or prompt is required"},
-                    status=400,
-                )
+                return 400, {
+                    "error": "one of token_ids or prompt is required"
+                }, {}
             tok = await self._tokenizer_for(body.get("model"))
             if tok is None:
-                return web.json_response(
-                    {"error": f"no model card named "
-                              f"{body.get('model')!r}"},
-                    status=404,
-                )
+                return 404, {
+                    "error": f"no model card named {body.get('model')!r}"
+                }, {}
             token_ids = tok.encode(prompt)
         rid = body.get("request_id", "epp")
         try:
@@ -350,9 +387,7 @@ class EndpointPicker:
                     break
                 excluded = set(excluded) | {worker_id}
         except Exception as e:  # noqa: BLE001 — no workers yet
-            return web.json_response(
-                {"error": f"no routable worker: {e}"}, status=503
-            )
+            return 503, {"error": f"no routable worker: {e}"}, {}
         if FAULTS.enabled:
             try:
                 # chaos hook: an injected error at epp.breaker records a
@@ -369,20 +404,19 @@ class EndpointPicker:
                 self.breakers.record(worker_id, ok=False)
         endpoint = await self._endpoint_of(worker_id)
         if endpoint is None:
-            return web.json_response(
-                {"error": f"worker {worker_id:x} has no registered "
-                          "instance"},
-                status=503,
-            )
+            return 503, {
+                "error": f"worker {worker_id:x} has no registered "
+                         "instance"
+            }, {}
         self.picks += 1
-        return web.json_response(
-            {
-                "worker_id": worker_id,
-                "endpoint": endpoint,
-                "overlap_blocks": overlap,
-            },
-            headers={"x-gateway-destination-endpoint": endpoint},
-        )
+        payload = {
+            "worker_id": worker_id,
+            "endpoint": endpoint,
+            "overlap_blocks": overlap,
+        }
+        if self.shards > 1:
+            payload["shard"] = self.shard_id
+        return 200, payload, {"x-gateway-destination-endpoint": endpoint}
 
     def _drop_breaker_series(self, iid: int) -> None:
         """Remove a departed instance's epp_breaker_state series — a
@@ -455,6 +489,8 @@ class EndpointPicker:
     async def close(self) -> None:
         for t in self._watch_tasks:
             t.cancel()
+        if self._pickline is not None:
+            await self._pickline.close()
         if self.kv is not None:
             await self.kv.save_snapshot()
             await self.kv.close()
@@ -479,13 +515,97 @@ async def _amain(args: argparse.Namespace) -> None:
         config=RouterConfig(block_size=args.block_size),
         host=args.host,
         port=args.port,
+        pick_port=args.pick_port if args.pick_port >= 0 else None,
+        shard_id=args.shard_id or 0,
+        shards=args.shards,
     ).start()
     print(f"DYNAMO_EPP={epp.host}:{epp.port}", flush=True)
+    if epp.pick_port is not None:
+        print(f"DYNAMO_EPP_PICK={epp.host}:{epp.pick_port}", flush=True)
     try:
         await asyncio.Event().wait()
     finally:
         await epp.close()
         await drt.close()
+
+
+def shard_child_argv(args: argparse.Namespace, shard_id: int) -> list[str]:
+    """argv for one spawned shard sibling: same deployment knobs, its
+    own --shard-id, and ports offset by shard id (0 stays 0 =
+    ephemeral). Split out so the supervisor's fan-out is unit-testable
+    without spawning anything."""
+    import sys
+
+    argv = [
+        sys.executable, "-m", "dynamo_tpu.gateway",
+        "--namespace", args.namespace,
+        "--component", args.component,
+        "--endpoint", args.endpoint,
+        "--block-size", str(args.block_size),
+        "--host", args.host,
+        "--port", str(args.port + shard_id if args.port else 0),
+        "--shards", str(args.shards),
+        "--shard-id", str(shard_id),
+    ]
+    if args.hub:
+        argv += ["--hub", args.hub]
+    if args.pick_port >= 0:
+        argv += ["--pick-port",
+                 str(args.pick_port + shard_id if args.pick_port else 0)]
+    return argv
+
+
+def _run_shard_supervisor(args: argparse.Namespace) -> int:
+    """``--shards N`` with no explicit --shard-id: spawn one EPP process
+    per shard (each running the FULL router state off the same hub
+    watch; dispatchers map picks to shards with
+    kv_router.sharding.ShardMap) and babysit them — one dying takes the
+    set down so the deployment restarts it whole. SIGTERM/SIGINT tear
+    the children down too: SIGTERM's default disposition would kill
+    only the supervisor and orphan the shards (observed live — orphans
+    held the ports and wedged the next deployment)."""
+    import signal
+    import subprocess
+
+    def _bail(_sig, _frm):
+        raise KeyboardInterrupt
+
+    signal.signal(signal.SIGTERM, _bail)
+    signal.signal(signal.SIGINT, _bail)
+    # spawn INSIDE the try: a Popen failing mid-fan-out (ENOMEM, exec
+    # error) must still tear down the shards already started, or they
+    # orphan holding the ports — the exact wedge this supervisor's
+    # SIGTERM handling exists to prevent
+    procs: list = []
+    rc = 0
+    try:
+        for i in range(args.shards):
+            procs.append(subprocess.Popen(shard_child_argv(args, i)))
+        while True:
+            for p in procs:
+                code = p.poll()
+                if code is not None:
+                    rc = code or 1
+                    raise KeyboardInterrupt
+            # dynalint: disable=DL001 -- supervisor entrypoint: runs
+            # INSTEAD of asyncio.run (no event loop exists in this
+            # process), purely babysitting shard subprocesses
+            time.sleep(0.5)
+    except KeyboardInterrupt:
+        pass
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.terminate()
+        for p in procs:
+            try:
+                p.wait(timeout=10)
+            # dynalint: disable=DL003 -- last-resort teardown: a shard
+            # that ignores SIGTERM for 10s gets SIGKILLed; escalation IS
+            # the handling
+            except Exception:  # noqa: BLE001
+                p.kill()
+    return rc
 
 
 def main(argv=None) -> int:
@@ -497,8 +617,20 @@ def main(argv=None) -> int:
     p.add_argument("--block-size", type=int, default=16)
     p.add_argument("--host", default="0.0.0.0")
     p.add_argument("--port", type=int, default=9002)
+    p.add_argument("--pick-port", type=int, default=-1,
+                   help="pickline fast-path port (0 = ephemeral; "
+                        "omit to disable)")
+    p.add_argument("--shards", type=int,
+                   default=shards_from_env(),
+                   help="prefix-hash shard count (DYN_ROUTER_SHARDS); "
+                        ">1 without --shard-id spawns one EPP process "
+                        "per shard")
+    p.add_argument("--shard-id", type=int, default=None,
+                   help="which shard THIS process serves (0-based)")
     args = p.parse_args(argv)
     logging.basicConfig(level=logging.INFO)
+    if args.shards > 1 and args.shard_id is None:
+        return _run_shard_supervisor(args)
     try:
         asyncio.run(_amain(args))
     except KeyboardInterrupt:

@@ -14,6 +14,7 @@ events.
 
 from __future__ import annotations
 
+import heapq
 import time
 from dataclasses import dataclass, field
 from typing import Iterable
@@ -62,21 +63,39 @@ class RadixTree:
         A worker scores ``k`` iff it holds blocks 1..k of the request prefix
         (consecutive from the start - partial interior hits don't help
         prefill skip).
+
+        Scores are recorded only at each worker's FINAL depth (when it
+        drops out of the walk, or once at the end for the survivors) —
+        the old per-depth rewrite (``scores[w] = depth`` for every alive
+        worker at every level) made the walk O(workers x depth), which at
+        fleet scale out-costed the set intersections it sat next to.
         """
         now = time.monotonic()
         scores: dict[int, int] = {}
         alive: set[int] | None = None
+        depth = 0  # depth the current ``alive`` set has fully matched
         total = 0
-        for depth, sh in enumerate(sequence_hashes, start=1):
-            total = depth
+        for sh in sequence_hashes:
+            total += 1
             node = self._nodes.get(sh)
             if node is None or not node.workers:
                 break
             if touch:
                 node.last_access = now
-            alive = node.workers if alive is None else (alive & node.workers)
-            if not alive:
-                break
+            if alive is None:
+                # reference, not copy: every later step derives NEW sets
+                # (&, -) rather than mutating this one
+                alive = node.workers
+            else:
+                survivors = alive & node.workers
+                if len(survivors) != len(alive):
+                    for w in alive - survivors:
+                        scores[w] = depth  # final depth: last level held
+                    alive = survivors
+                    if not alive:
+                        break
+            depth += 1
+        if alive:
             for w in alive:
                 scores[w] = depth
         return OverlapScores(scores=scores, total_blocks=total)
@@ -184,6 +203,15 @@ class ApproxKvIndexer:
         # latest deadline per (worker, sh): re-routing the same prefix
         # refreshes the TTL instead of leaving a stale earlier deadline.
         self._deadlines: dict[tuple[int, int], float] = {}
+        # lazy min-heap over (deadline, worker, sh). Each live key has
+        # exactly ONE heap entry: a TTL refresh only updates the dict,
+        # and when the (now stale-dated) entry reaches the heap top it
+        # is re-pushed at the refreshed deadline instead of removed — so
+        # a hot prefix re-routed every pick costs O(1) heap ops per TTL,
+        # not per pick, and expiry is O(expired log n) per find_matches
+        # instead of the full O(entries) scan the dict-walk version
+        # paid on EVERY call.
+        self._expiry_heap: list[tuple[float, int, int]] = []
 
     def find_matches(self, sequence_hashes: Iterable[int]) -> OverlapScores:
         self._expire()
@@ -193,18 +221,32 @@ class ApproxKvIndexer:
         self, worker_id: int, sequence_hashes: Iterable[int], parent_hashes: Iterable[int]
     ) -> None:
         now = time.monotonic()
+        deadline = now + self.ttl_s
+        deadlines = self._deadlines
         for sh, parent in zip(sequence_hashes, parent_hashes):
             self._tree._store(worker_id, sh, parent)
-            self._deadlines[(worker_id, sh)] = now + self.ttl_s
+            if (worker_id, sh) not in deadlines:
+                heapq.heappush(self._expiry_heap, (deadline, worker_id, sh))
+            deadlines[(worker_id, sh)] = deadline  # refresh: dict only
 
     def remove_worker(self, worker_id: int) -> None:
         self._tree.remove_worker(worker_id)
         for key in [k for k in self._deadlines if k[0] == worker_id]:
-            del self._deadlines[key]
+            del self._deadlines[key]  # heap entries expire lazily
 
     def _expire(self) -> None:
         now = time.monotonic()
-        for (worker, sh), deadline in list(self._deadlines.items()):
-            if deadline <= now:
-                self._tree._remove(worker, sh)
-                del self._deadlines[(worker, sh)]
+        heap = self._expiry_heap
+        deadlines = self._deadlines
+        while heap and heap[0][0] <= now:
+            deadline, worker, sh = heapq.heappop(heap)
+            current = deadlines.get((worker, sh))
+            if current is None:
+                continue  # worker removed: entry retired
+            if current > deadline:
+                # refreshed since this entry was dated: carry the key's
+                # single entry forward at its live deadline
+                heapq.heappush(heap, (current, worker, sh))
+                continue
+            self._tree._remove(worker, sh)
+            del deadlines[(worker, sh)]

@@ -132,6 +132,12 @@ class RouterConfig:
     overlap_weight: float = 1.0
     temperature: float = 0.0  # 0 => deterministic argmin
     block_size: int = 64
+    # incremental selection: lowest-load workers drawn from the
+    # scheduler's load index per pick (on top of the overlap-scored
+    # set). 2 = classic power-of-two-choices; higher widens the
+    # temperature>0 sampling pool. The temperature-0 argmin is
+    # bit-identical to the full-fleet oracle scan for ANY k >= 1.
+    candidate_k: int = 8
     # replica sync / snapshots
     snapshot_threshold: int = 1_000_000  # events between radix snapshots
     # approx indexer

@@ -17,8 +17,10 @@ from __future__ import annotations
 import asyncio
 import json
 import logging
+import time
 from typing import Any, AsyncIterator
 
+from dynamo_tpu.kv_router.hashing import PrefixHashCache
 from dynamo_tpu.kv_router.indexer import ApproxKvIndexer, OverlapScores, RadixTree
 from dynamo_tpu.kv_router.protocols import (
     KV_EVENT_SUBJECT,
@@ -31,11 +33,37 @@ from dynamo_tpu.kv_router.scheduler import KvScheduler
 from dynamo_tpu.kv_router.sequence import ActiveSequencesMultiWorker
 from dynamo_tpu.runtime.context import Context
 from dynamo_tpu.runtime.hub import Hub
-from dynamo_tpu.tokens import compute_sequence_hashes
+from dynamo_tpu.runtime.metrics import MetricsRegistry, register_registry
 
 log = logging.getLogger("dynamo.kv.router")
 
 RADIX_STATE_BUCKET = "kv-router-state"
+# seconds between full prediction refolds in find_best_match — the
+# healing backstop for leaked active-sequence state (force-expiry is
+# 600 s; a few seconds of stale deprioritization is noise against it)
+PREDICTION_SWEEP_S = 5.0
+
+# pick-phase telemetry on every /metrics surface (PR 10 registry
+# pattern): where the routing decision spends its time — the attribution
+# ROUTER_r0x artifacts and the Grafana router panels read. Buckets sized
+# for a decision measured in microseconds, not request latencies.
+_REG = MetricsRegistry()
+_PICK_SECONDS = _REG.histogram(
+    "router_pick_seconds",
+    "KV routing decision latency by phase (hash | overlap | select)",
+    ["phase"],
+    buckets=(0.000005, 0.00001, 0.000025, 0.00005, 0.0001, 0.00025,
+             0.0005, 0.001, 0.0025, 0.005, 0.01, 0.05),
+)
+_PH_HASH = _PICK_SECONDS.labels("hash")
+_PH_OVERLAP = _PICK_SECONDS.labels("overlap")
+_PH_SELECT = _PICK_SECONDS.labels("select")
+ROUTER_SHARD_GAUGE = _REG.gauge(
+    "router_shard_id",
+    "prefix-hash shard this router process serves (0-based; 0 when "
+    "unsharded)",
+)
+register_registry("kv_router", _REG)
 
 
 class KvRouter:
@@ -54,6 +82,22 @@ class KvRouter:
         self.approx = ApproxKvIndexer(self.config.approx_ttl_s)
         self.scheduler = KvScheduler(self.config)
         self.sequences = ActiveSequencesMultiWorker()
+        # amortized prefix hashing: repeated preambles skip the
+        # O(tokens) chained rehash (DYN_ROUTER_HASH_CACHE bounds it)
+        self.hasher = PrefixHashCache.from_env()
+        # per-phase attribution (seconds + picks), the in-process
+        # counterpart of the dynamo_router_pick_seconds histogram —
+        # benches read deltas of this without scraping /metrics
+        self.pick_phase_totals = {"hash": 0.0, "overlap": 0.0,
+                                  "select": 0.0}
+        self.picks = 0
+        # periodic full prediction refold (see find_best_match): heals
+        # scheduler state for workers whose tracked sequences
+        # force-expired without a lifecycle event (a caller that died
+        # before free()) — without it a leaked stale-high prediction
+        # deprioritizes its worker indefinitely, since the per-worker
+        # incremental updates only fire when that worker is touched
+        self._pred_sweep_at = 0.0
         self._tasks: list[asyncio.Task] = []
         self._started = False
         # retention-boundary accounting: the snapshot records the last
@@ -189,38 +233,71 @@ class KvRouter:
         routes rather than blackholing).
         """
         bs = self.config.block_size
-        seq_hashes = compute_sequence_hashes(token_ids, bs, salt)
+        # rare O(instances) prediction sweep (time-bounded, NOT
+        # per-pick): refold every worker's tracked load so force-expired
+        # leaked sequences heal even for workers no lifecycle event
+        # touches. The steady-state pick still never walks the fleet.
+        now = time.monotonic()
+        if now >= self._pred_sweep_at:
+            self._pred_sweep_at = now + PREDICTION_SWEEP_S
+            for wid, (blocks, ptok) in self.sequences.loads().items():
+                self.scheduler.set_predicted_load(wid, blocks, ptok)
+        t0 = time.perf_counter()
+        seq_hashes = self.hasher.sequence_hashes(token_ids, bs, salt)
         request_blocks = max(len(token_ids) // bs, 1)
 
+        t1 = time.perf_counter()
         overlaps = self.tree.find_matches(seq_hashes)
         if self.config.use_approx:
             approx_overlaps = self.approx.find_matches(seq_hashes)
             for wid, score in approx_overlaps.scores.items():
                 overlaps.scores[wid] = max(overlaps.scores.get(wid, 0), score)
 
-        # fold local predictions into scheduler state
-        for wid, (blocks, ptok) in self.sequences.loads().items():
-            self.scheduler.set_predicted_load(wid, blocks, ptok)
-
+        # NOTE: predictions are NOT folded here — the scheduler's view
+        # is updated incrementally at sequence lifecycle points
+        # (_push_predicted below), so the pick never pays an
+        # O(instances) prediction sweep.
+        t2 = time.perf_counter()
         worker_id, overlap = self.scheduler.schedule(
             request_blocks, overlaps, exclude=exclude
         )
+        t3 = time.perf_counter()
         self.sequences.add_request(
             request_id,
             worker_id,
             blocks=request_blocks - overlap,
             prefill_tokens=max(len(token_ids) - overlap * bs, 0),
         )
+        self._push_predicted(worker_id)
         if self.config.use_approx:
             parents = [0] + seq_hashes[:-1]
             self.approx.process_routing_decision(worker_id, seq_hashes, parents)
+        totals = self.pick_phase_totals
+        totals["hash"] += t1 - t0
+        totals["overlap"] += t2 - t1
+        totals["select"] += t3 - t2
+        self.picks += 1
+        _PH_HASH.observe(t1 - t0)
+        _PH_OVERLAP.observe(t2 - t1)
+        _PH_SELECT.observe(t3 - t2)
         return worker_id, overlap
+
+    def _push_predicted(self, worker_id: int | None) -> None:
+        """Refresh the scheduler's predicted load for ONE worker — the
+        only one a lifecycle event (route / prefill-done / free) can
+        have changed."""
+        if worker_id is not None:
+            blocks, ptok = self.sequences.load_of(worker_id)
+            self.scheduler.set_predicted_load(worker_id, blocks, ptok)
 
     def mark_prefill_done(self, request_id: str) -> None:
         self.sequences.mark_prefill_done(request_id)
+        self._push_predicted(self.sequences.worker_of(request_id))
 
     def free(self, request_id: str) -> None:
+        wid = self.sequences.worker_of(request_id)
         self.sequences.free(request_id)
+        self._push_predicted(wid)
 
     # -- snapshots ---------------------------------------------------------
 

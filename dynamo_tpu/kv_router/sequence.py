@@ -105,6 +105,18 @@ class ActiveSequencesMultiWorker:
     def worker_of(self, request_id: str) -> int | None:
         return self._request_worker.get(request_id)
 
+    def load_of(self, worker_id: int) -> tuple[int, int]:
+        """(active_blocks, prefill_tokens) of ONE worker — the per-pick
+        prediction feed: the router updates only the worker a lifecycle
+        event touched, instead of folding every worker's load into the
+        scheduler per pick (which made predictions an O(instances) tax
+        on the decision)."""
+        seqs = self._workers.get(worker_id)
+        if seqs is None:
+            return (0, 0)
+        seqs.expire()
+        return (seqs.active_blocks, seqs.prefill_tokens)
+
     def loads(self) -> dict[int, tuple[int, int]]:
         """worker_id -> (active_blocks, prefill_tokens)."""
         out = {}

@@ -88,6 +88,8 @@ async def pick_scaling(cfg: SimConfig) -> dict:
     resolve + breaker walk) client-side. Steady-state picks must do ZERO
     hub round-trips (hub_scans flat while picks grow) and the latency
     curve must stay flat-ish as the fleet grows to 100s of instances."""
+    from dynamo_tpu.gateway.pickline import PickLineClient
+
     curve = []
     rng = random.Random(cfg.seed)
     for size in cfg.sizes():
@@ -98,7 +100,7 @@ async def pick_scaling(cfg: SimConfig) -> dict:
                 fleet.drt, namespace=NS, target_component=COMP,
                 target_endpoint=EP,
                 config=RouterConfig(block_size=cfg.block_size),
-                host="127.0.0.1", port=0,
+                host="127.0.0.1", port=0, pick_port=0,
             ).start()
             deadline = time.monotonic() + 20
             while len(epp.kv.scheduler.workers()) < size:
@@ -134,17 +136,56 @@ async def pick_scaling(cfg: SimConfig) -> dict:
                     await one(i, sess)
                 lats.clear()
                 scans0 = epp._cards.scans + epp._instances.scans
+                picks0 = epp.kv.picks
+                phases0 = dict(epp.kv.pick_phase_totals)
+                full_scans0 = epp.kv.scheduler.full_pick_scans
                 await asyncio.gather(
                     *(one(i, sess) for i in range(cfg.picks))
                 )
                 scans1 = epp._cards.scans + epp._instances.scans
+            # per-phase decision attribution (hash/overlap/select) over
+            # the measured window — the rest of the client-observed pick
+            # latency is transport + HTTP plumbing (ROADMAP #7c)
+            dp = max(epp.kv.picks - picks0, 1)
+            phase_us = {
+                k: round(
+                    1e6 * (epp.kv.pick_phase_totals[k] - phases0[k]) / dp,
+                    2,
+                )
+                for k in phases0
+            }
+            # the pickline fast path over the same prompts: persistent
+            # connection, pipelined by the same concurrency semaphore
+            line = await PickLineClient(
+                "127.0.0.1", epp.pick_port
+            ).connect()
+            line_lats: list[float] = []
+
+            async def one_line(i: int):
+                async with sem:
+                    t0 = time.perf_counter()
+                    r = await line.pick({
+                        "token_ids": prompts[i % len(prompts)],
+                        "request_id": f"pl-{i}",
+                    })
+                    assert r["status"] == 200, r
+                    line_lats.append(time.perf_counter() - t0)
+
+            await asyncio.gather(*(one_line(i) for i in range(cfg.picks)))
+            await line.close()
             curve.append({
                 "instances": size,
                 "picks": cfg.picks,
                 "pick_ms_p50": pct_ms(lats, 0.5),
                 "pick_ms_p90": pct_ms(lats, 0.9),
                 "pick_ms_p99": pct_ms(lats, 0.99),
+                "pickline_ms_p50": pct_ms(line_lats, 0.5),
+                "pickline_ms_p99": pct_ms(line_lats, 0.99),
+                "decision_phase_us": phase_us,
                 "steady_state_hub_scans": scans1 - scans0,
+                "full_fleet_scans": (
+                    epp.kv.scheduler.full_pick_scans - full_scans0
+                ),
             })
         finally:
             if epp is not None:
@@ -165,6 +206,19 @@ async def pick_scaling(cfg: SimConfig) -> dict:
             "zero_hub_roundtrips_steady_state": _inv(
                 all(c["steady_state_hub_scans"] == 0 for c in curve),
                 scans=[c["steady_state_hub_scans"] for c in curve],
+            ),
+            # the incremental selector's contract at fleet scale: no
+            # pick ever falls back to an O(instances) full-fleet scan
+            "zero_full_fleet_scans": _inv(
+                all(c["full_fleet_scans"] == 0 for c in curve),
+                scans=[c["full_fleet_scans"] for c in curve],
+            ),
+            # the pickline transport must beat the aiohttp route it
+            # displaces at the largest fleet
+            "pickline_beats_http": _inv(
+                hi["pickline_ms_p50"] <= hi["pick_ms_p50"],
+                pickline_ms=hi["pickline_ms_p50"],
+                http_ms=hi["pick_ms_p50"],
             ),
         },
     }

@@ -236,3 +236,34 @@ async def test_router_trace_replay_and_pareto(tmp_path):
     ]
     pareto_front(pts)
     assert [p["pareto"] for p in pts] == [False, True, True]
+
+
+async def test_router_war_bench_smoke():
+    """The ISSUE 15 war bench end to end at toy scale: artifact schema,
+    per-phase attribution, shard divergence asserts, and the zero-full-
+    scan + divergence bars (throughput bars are only meaningful at the
+    full --instances 200 run that writes ROUTER_r0x.json)."""
+    import argparse
+
+    from benchmarks.router_bench import war
+
+    args = argparse.Namespace(
+        instances=24, block_size=8, groups=8, depth=4, war_requests=240,
+        transport_picks=20, shards="1,2", speedup=1000.0,
+        worker_blocks=512,
+    )
+    out = await war(args)
+    assert out["schema"] == "dynamo-router-war/v1"
+    for cfgname in ("oracle_nocache", "incremental_nocache", "incremental"):
+        d = out["decision"][cfgname]
+        assert d["picks"] == 240
+        assert set(d["phase_us"]) == {"hash", "overlap", "select"}
+    assert out["decision"]["incremental"]["full_pick_scans"] == 0
+    assert out["decision"]["oracle_nocache"]["full_pick_scans"] > 0
+    assert out["bars"]["zero_full_fleet_scans"]
+    assert out["bars"]["zero_cross_shard_divergence"]
+    assert out["transport"]["pickline_ms_p50"] is not None
+    runs = {r["shards"]: r for r in out["sharded"]["runs"]}
+    assert runs[2]["radix_digests_identical"]
+    assert runs[2]["approx_state_disjoint"]
+    assert runs[1]["picks"] == runs[2]["picks"]

@@ -336,3 +336,280 @@ async def test_epp_card_add_and_remove_invalidate_within_window():
     finally:
         await epp.close()
         await drt.close()
+
+
+# ----------------------------------------------- pickline fast path
+
+
+async def test_pickline_fast_path_matches_http_pick():
+    """The persistent-connection pickline transport serves the SAME
+    decision as POST /pick (one pick_decision core, two transports):
+    pipelined picks answer in order with id echo, a malformed line gets
+    an in-band 400 without killing the connection, and the latency
+    histogram records both transports."""
+    import asyncio
+
+    from dynamo_tpu.gateway.pickline import PickLineClient
+
+    drt = DistributedRuntime(InMemoryHub())
+    cfg = MockEngineConfig(block_size=4, speedup_ratio=1000.0)
+    for _ in range(3):
+        await launch_mock_worker(drt, "dyn", "backend", "generate", cfg)
+    epp = await EndpointPicker(
+        drt, namespace="dyn", target_component="backend",
+        config=RouterConfig(block_size=4), host="127.0.0.1", port=0,
+        pick_port=0, shard_id=1, shards=2,
+    ).start()
+    try:
+        deadline = 100
+        while len(epp.kv.scheduler.workers()) < 3 and deadline:
+            await asyncio.sleep(0.02)
+            deadline -= 1
+        assert epp.pick_port, "pickline never started"
+        cl = await PickLineClient("127.0.0.1", epp.pick_port).connect()
+        toks = list(range(16))
+        rs = await asyncio.gather(*(
+            cl.pick({"token_ids": toks, "request_id": f"pl-{i}"})
+            for i in range(8)
+        ))
+        assert all(r["status"] == 200 for r in rs)
+        assert all(r["endpoint"] and "worker_id" in r for r in rs)
+        # sharded processes stamp their shard id on the payload
+        assert all(r["shard"] == 1 for r in rs)
+        # ids echo back in request order
+        assert [r["id"] for r in rs] == sorted(r["id"] for r in rs)
+
+        # same decision as the HTTP route (fresh rid; temp-0 determinism)
+        async with aiohttp.ClientSession() as sess:
+            async with sess.post(
+                f"http://127.0.0.1:{epp.port}/pick",
+                json={"token_ids": toks},
+            ) as r:
+                http_body = await r.json()
+        assert http_body["worker_id"] == rs[0]["worker_id"]
+
+        # a malformed request body answers 400 in-band, connection lives
+        bad = await cl.pick({"token_ids": "not-a-list"})
+        assert bad["status"] == 503  # scheduler bounced the bad tokens
+        ok = await cl.pick({"token_ids": toks})
+        assert ok["status"] == 200
+        await cl.close()
+
+        # both transports observed into the pick histogram
+        async with aiohttp.ClientSession() as sess:
+            async with sess.get(
+                f"http://127.0.0.1:{epp.port}/metrics"
+            ) as r:
+                text = await r.text()
+        assert "dynamo_epp_pick_seconds" in text
+        assert "dynamo_router_pick_seconds" in text
+        assert 'dynamo_router_shard_id 1.0' in text
+    finally:
+        await epp.close()
+        await drt.close()
+
+
+async def test_pickline_malformed_line_keeps_connection():
+    import asyncio
+    import json as _json
+
+    from dynamo_tpu.gateway.pickline import PickLineServer
+
+    class FakePicker:
+        async def pick_decision(self, body):
+            return 200, {"worker_id": 1, "echo": body.get("x")}, {}
+
+        def observe_pick(self, s):
+            pass
+
+    srv = await PickLineServer(FakePicker(), port=0).start()
+    try:
+        reader, writer = await asyncio.open_connection(
+            "127.0.0.1", srv.port
+        )
+        writer.write(b"this is not json\n")
+        writer.write(_json.dumps({"id": 7, "x": "y"}).encode() + b"\n")
+        await writer.drain()
+        bad = _json.loads(await reader.readline())
+        good = _json.loads(await reader.readline())
+        assert bad["status"] == 400 and bad["id"] is None
+        assert good == {"id": 7, "status": 200, "worker_id": 1,
+                        "echo": "y"}
+        writer.close()
+    finally:
+        await srv.close()
+
+
+def test_shard_child_argv_fanout():
+    """The --shards supervisor's child argv: explicit shard ids, ports
+    offset per shard, deployment knobs forwarded."""
+    import argparse
+
+    from dynamo_tpu.gateway.epp import shard_child_argv
+
+    args = argparse.Namespace(
+        hub="h:1", namespace="n", component="c", endpoint="e",
+        block_size=16, host="0.0.0.0", port=9100, pick_port=9200,
+        shards=4,
+    )
+    argv2 = shard_child_argv(args, 2)
+    assert argv2[1:3] == ["-m", "dynamo_tpu.gateway"]
+    s = " ".join(argv2)
+    assert "--shard-id 2" in s and "--shards 4" in s
+    assert "--port 9102" in s and "--pick-port 9202" in s
+    assert "--hub h:1" in s
+    # port 0 (ephemeral) stays 0 for every shard
+    args.port, args.pick_port = 0, 0
+    s0 = " ".join(shard_child_argv(args, 3))
+    assert "--port 0" in s0 and "--pick-port 0" in s0
+
+
+async def test_pickline_client_close_fails_pending_picks():
+    """Review regression: close() cancels the rx task; in-flight pick()
+    callers must get ConnectionError, not hang forever."""
+    import asyncio
+
+    async def silent(reader, writer):
+        await reader.read()  # never answers
+
+    srv = await asyncio.start_server(silent, "127.0.0.1", 0)
+    port = srv.sockets[0].getsockname()[1]
+    from dynamo_tpu.gateway.pickline import PickLineClient
+
+    cl = await PickLineClient("127.0.0.1", port).connect()
+    try:
+        task = asyncio.ensure_future(cl.pick({"token_ids": [1, 2]}))
+        await asyncio.sleep(0.05)
+        await cl.close()
+        with pytest.raises(ConnectionError):
+            await asyncio.wait_for(task, 5)
+    finally:
+        srv.close()
+        await srv.wait_closed()
+
+
+async def test_pickline_decision_error_is_in_band_500():
+    """Review regression: an unexpected pick_decision failure answers an
+    in-band 500 — the connection (and pipelined neighbors) survive."""
+    import asyncio
+    import json as _json
+
+    from dynamo_tpu.gateway.pickline import PickLineServer
+
+    class FlakyPicker:
+        def __init__(self):
+            self.calls = 0
+
+        async def pick_decision(self, body):
+            self.calls += 1
+            if self.calls == 1:
+                raise RuntimeError("boom")
+            return 200, {"worker_id": 7}, {}
+
+        def observe_pick(self, s):
+            pass
+
+    srv = await PickLineServer(FlakyPicker(), port=0).start()
+    try:
+        reader, writer = await asyncio.open_connection(
+            "127.0.0.1", srv.port
+        )
+        writer.write(b'{"id": 1}\n{"id": 2}\n')
+        await writer.drain()
+        r1 = _json.loads(await reader.readline())
+        r2 = _json.loads(await reader.readline())
+        assert r1["status"] == 500 and "boom" in r1["error"]
+        assert r2 == {"id": 2, "status": 200, "worker_id": 7}
+        writer.close()
+    finally:
+        await srv.close()
+
+
+async def test_pickline_unserializable_body_does_not_desync():
+    """Review regression: a body json.dumps rejects must fail THAT call
+    without enqueueing an orphan future — the next pick on the same
+    connection still gets ITS OWN response."""
+    import asyncio
+    import json as _json
+
+    from dynamo_tpu.gateway.pickline import PickLineClient
+
+    async def echo(reader, writer):
+        while True:
+            line = await reader.readline()
+            if not line:
+                break
+            body = _json.loads(line)
+            writer.write(_json.dumps(
+                {"id": body["id"], "status": 200, "tag": body["tag"]}
+            ).encode() + b"\n")
+            await writer.drain()
+        writer.close()
+
+    srv = await asyncio.start_server(echo, "127.0.0.1", 0)
+    port = srv.sockets[0].getsockname()[1]
+    cl = await PickLineClient("127.0.0.1", port).connect()
+    try:
+        with pytest.raises(TypeError):
+            await cl.pick({"tag": b"bytes are not json"})
+        r = await asyncio.wait_for(cl.pick({"tag": "ok"}), 5)
+        assert r["status"] == 200 and r["tag"] == "ok"
+    finally:
+        await cl.close()
+        srv.close()
+        await srv.wait_closed()
+
+
+async def test_pickline_pick_after_server_hangup_raises():
+    """Review regression: once the server hangs up (rx loop saw EOF and
+    drained), a later pick() must raise ConnectionError immediately —
+    not enqueue a future nothing will ever resolve and hang."""
+    import asyncio
+
+    from dynamo_tpu.gateway.pickline import PickLineClient
+
+    async def hangup(reader, writer):
+        writer.close()
+
+    srv = await asyncio.start_server(hangup, "127.0.0.1", 0)
+    port = srv.sockets[0].getsockname()[1]
+    cl = await PickLineClient("127.0.0.1", port).connect()
+    try:
+        # wait for the rx loop to observe the EOF
+        for _ in range(100):
+            if cl._closed:
+                break
+            await asyncio.sleep(0.01)
+        assert cl._closed
+        with pytest.raises(ConnectionError):
+            await asyncio.wait_for(cl.pick({"token_ids": [1]}), 5)
+    finally:
+        await cl.close()
+        srv.close()
+        await srv.wait_closed()
+
+
+async def test_pickline_server_close_with_live_peer_returns():
+    """Review regression: close() must actively close accepted
+    connections — pickline peers are long-lived by design, and on
+    py3.12.1+ Server.wait_closed() blocks until every handler ends."""
+    import asyncio
+
+    from dynamo_tpu.gateway.pickline import PickLineClient, PickLineServer
+
+    class P:
+        async def pick_decision(self, body):
+            return 200, {"worker_id": 1}, {}
+
+        def observe_pick(self, s):
+            pass
+
+    srv = await PickLineServer(P(), port=0).start()
+    cl = await PickLineClient("127.0.0.1", srv.port).connect()
+    r = await cl.pick({"token_ids": [1]})
+    assert r["status"] == 200
+    assert len(srv._conns) == 1
+    # the client stays connected; close() must not wait on it
+    await asyncio.wait_for(srv.close(), 5)
+    assert not srv._conns
+    await cl.close()

@@ -167,6 +167,15 @@ METRIC_NAMES: dict[str, str] = {
                              "the grammar compiler",
     # EPP pick-path telemetry (gateway/epp.py /metrics)
     "epp_pick_seconds": "EPP pick-path latency histogram",
+    # KV-router data plane (kv_router/router.py, on every /metrics
+    # surface via the module registry)
+    "router_pick_seconds": "KV routing decision latency by phase "
+                           "(hash | overlap | select) — the per-pick "
+                           "attribution the ROUTER_r0x artifacts and "
+                           "router panels read",
+    "router_shard_id": "prefix-hash shard this router process serves "
+                       "(0-based; 0 when unsharded) — joins a shard's "
+                       "metrics to its slice of the shard map",
     "epp_cache_lookups_total": "EPP prefix-cache lookups by cache "
                                "(cards | instances) and outcome "
                                "(hit | miss)",
