@@ -30,6 +30,7 @@ from __future__ import annotations
 import asyncio
 import json
 import time
+from contextlib import aclosing
 from dataclasses import dataclass, field
 from typing import Any, Callable
 
@@ -173,20 +174,23 @@ async def replay_trace(
         last = None
         err: str | None = None
         try:
-            async for item in generate(req, ctx):
-                if not isinstance(item, dict):
-                    continue
-                if item.get("error") or item.get("finish_reason") == "error":
-                    err = str(item.get("error") or "finish_reason=error")
-                    break
-                if item.get("token_ids"):
-                    now = time.perf_counter()
-                    if ttft is None:
-                        ttft = now - t0
-                        cached = item.get("cached_blocks")
-                    elif last is not None:
-                        itl.append(now - last)
-                    last = now
+            stream = generate(req, ctx)
+            async with aclosing(stream):
+                async for item in stream:
+                    if not isinstance(item, dict):
+                        continue
+                    if (item.get("error")
+                            or item.get("finish_reason") == "error"):
+                        err = str(item.get("error") or "finish_reason=error")
+                        break
+                    if item.get("token_ids"):
+                        now = time.perf_counter()
+                        if ttft is None:
+                            ttft = now - t0
+                            cached = item.get("cached_blocks")
+                        elif last is not None:
+                            itl.append(now - last)
+                        last = now
         except Exception as e:  # noqa: BLE001 — replay records, caller asserts
             err = f"{type(e).__name__}: {e}"
         if err is not None:

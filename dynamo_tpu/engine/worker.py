@@ -773,6 +773,9 @@ def main() -> None:
     if args.kvbm_disk_dir and args.kvbm_disk_mb <= 0:
         p.error("--kvbm-disk-dir requires --kvbm-disk-mb > 0")
     setup_logging()
+    from dynamo_tpu.runtime.eventloop import maybe_install_uvloop
+
+    maybe_install_uvloop()
     try:
         asyncio.run(_amain(args))
     except KeyboardInterrupt:

@@ -13,6 +13,7 @@ from dynamo_tpu.frontend.http import HttpFrontend
 from dynamo_tpu.frontend.watcher import ModelManager, ModelWatcher
 from dynamo_tpu.runtime.config import RuntimeConfig
 from dynamo_tpu.runtime.distributed import DistributedRuntime
+from dynamo_tpu.runtime.eventloop import maybe_install_uvloop
 from dynamo_tpu.runtime.hub_client import connect_hub
 from dynamo_tpu.runtime.logging_util import setup_logging
 
@@ -61,6 +62,7 @@ def main() -> None:
                         "this port (0 = ephemeral)")
     args = p.parse_args()
     setup_logging()
+    maybe_install_uvloop()
     try:
         asyncio.run(_amain(args))
     except KeyboardInterrupt:

@@ -47,6 +47,28 @@ from dynamo_tpu.runtime.push import NoInstancesError
 
 log = logging.getLogger("dynamo.http")
 
+# SSE fast path: static affixes built once and one reusable encoder — the
+# per-token path used to assemble f-strings and re-resolve json.dumps'
+# kwargs per chunk. Byte-identical to json.dumps (same default separators);
+# tests/test_frontend.py asserts the exact wire bytes.
+_SSE_DATA = b"data: "
+_SSE_SEP = b"\n\n"
+_SSE_DONE = b"data: [DONE]\n\n"
+_SSE_EVENT = b"event: "
+_SSE_EVENT_DATA = b"\ndata: "
+_JSON_ENCODER = json.JSONEncoder()
+
+
+def _sse_bytes(chunk: dict) -> bytes:
+    return b"".join((_SSE_DATA, _JSON_ENCODER.encode(chunk).encode(), _SSE_SEP))
+
+
+def _sse_event_bytes(event: str, payload: dict) -> bytes:
+    return b"".join((
+        _SSE_EVENT, event.encode(), _SSE_EVENT_DATA,
+        _JSON_ENCODER.encode(payload).encode(), _SSE_SEP,
+    ))
+
 # per-request deadline override (ms); clamped to the server-side default
 TIMEOUT_HEADER = "x-dyn-timeout-ms"
 
@@ -538,10 +560,8 @@ class HttpFrontend:
         await resp.prepare(request)
         try:
             async for chunk in chunks:
-                await resp.write(
-                    b"data: " + json.dumps(chunk).encode() + b"\n\n"
-                )
-            await resp.write(b"data: [DONE]\n\n")
+                await resp.write(_sse_bytes(chunk))
+            await resp.write(_SSE_DONE)
         except (ConnectionResetError, asyncio.CancelledError):
             # client went away: cancel the whole pipeline
             ctx.stop_generating()
@@ -552,8 +572,8 @@ class HttpFrontend:
             log.exception("stream %s failed mid-flight", ctx.id)
             try:
                 err = {"error": {"message": str(e), "type": "server_error"}}
-                await resp.write(b"data: " + json.dumps(err).encode() + b"\n\n")
-                await resp.write(b"data: [DONE]\n\n")
+                await resp.write(_sse_bytes(err))
+                await resp.write(_SSE_DONE)
             except (ConnectionError, ConnectionResetError):
                 pass
         finally:
@@ -644,9 +664,7 @@ class HttpFrontend:
             await resp.prepare(request)
 
             async def send(event: str, payload: dict) -> None:
-                await resp.write(
-                    f"event: {event}\ndata: {json.dumps(payload)}\n\n".encode()
-                )
+                await resp.write(_sse_event_bytes(event, payload))
 
             await send("response.created",
                        {"response": {"id": rid, "status": "in_progress"}})

@@ -30,6 +30,8 @@ recoveries > 0.
 
 from __future__ import annotations
 
+from contextlib import aclosing
+
 import asyncio
 import logging
 import random
@@ -113,13 +115,17 @@ class Migration:
         while True:
             retry = False
             try:
-                async for item in self.downstream.generate(request, context):
-                    if isinstance(item, dict):
-                        generated.extend(item.get("token_ids") or [])
-                    yield item
-                    if isinstance(item, dict) and item.get("finish_reason"):
-                        return
-                return  # clean end of stream
+                # aclosing: the early return on finish_reason must tear
+                # the downstream chain down synchronously, not via GC
+                stream = self.downstream.generate(request, context)
+                async with aclosing(stream):
+                    async for item in stream:
+                        if isinstance(item, dict):
+                            generated.extend(item.get("token_ids") or [])
+                        yield item
+                        if isinstance(item, dict) and item.get("finish_reason"):
+                            return
+                    return  # clean end of stream
             except StreamError as e:
                 # DeadlineExceeded and validation errors are NOT
                 # StreamErrors — they propagate without a retry. Client

@@ -111,6 +111,23 @@ def load_tokenizer(spec: str | None) -> Tokenizer:
     return HFTokenizer(spec)
 
 
+def _utf8_incomplete_tail(data: bytes) -> int:
+    """Length of a trailing incomplete UTF-8 sequence (0 if none).
+
+    Scans back at most 3 bytes for a lead byte whose declared sequence
+    length exceeds the bytes present; invalid sequences count as
+    complete (the errors="replace" decode handles them)."""
+    for i in range(1, min(3, len(data)) + 1):
+        b = data[-i]
+        if b < 0x80:
+            return 0  # ASCII: nothing held back
+        if b >= 0xC0:  # lead byte
+            need = 2 if b < 0xE0 else 3 if b < 0xF0 else 4
+            return i if need > i else 0
+        # else: continuation byte, keep scanning
+    return 0
+
+
 class IncrementalDecoder:
     """Streaming token-ids -> text deltas without broken codepoints.
 
@@ -128,8 +145,23 @@ class IncrementalDecoder:
         self._read_offset = 0  # ids already attributed to emitted text
         self._text_parts: list[str] = []  # all emitted deltas
         self._text_len = 0
+        # byte-level tokenizers (MockTokenizer) expose decode_bytes:
+        # their decode is compositional, so instead of re-decoding the
+        # sliding window twice per push we track raw bytes and hold back
+        # only an incomplete UTF-8 tail
+        self._byte_mode = hasattr(tokenizer, "decode_bytes")
+        self._pending_bytes = b""
 
     def push(self, ids: Sequence[int]) -> str:
+        if self._byte_mode:
+            data = self._pending_bytes + self.tokenizer.decode_bytes(ids)
+            cut = len(data) - _utf8_incomplete_tail(data)
+            self._pending_bytes = data[cut:]
+            delta = data[:cut].decode("utf-8", errors="replace")
+            if delta:
+                self._text_parts.append(delta)
+                self._text_len += len(delta)
+            return delta
         self._ids.extend(ids)
         prefix_text = self.tokenizer.decode(
             self._ids[self._prefix_offset : self._read_offset]
@@ -146,6 +178,13 @@ class IncrementalDecoder:
         return delta
 
     def flush(self) -> str:
+        if self._byte_mode:
+            delta = self._pending_bytes.decode("utf-8", errors="replace")
+            self._pending_bytes = b""
+            if delta:
+                self._text_parts.append(delta)
+                self._text_len += len(delta)
+            return delta
         window_text = self.tokenizer.decode(self._ids[self._prefix_offset :])
         prefix_text = self.tokenizer.decode(
             self._ids[self._prefix_offset : self._read_offset]

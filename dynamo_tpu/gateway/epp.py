@@ -204,6 +204,9 @@ class EndpointPicker:
             card_ttl_s,
             on_lookup=lambda o: self._m_cache.labels("instances", o).inc(),
         )
+        # per-snapshot endpoint memo (see _endpoint_of)
+        self._ep_snapshot: dict | None = None
+        self._ep_map: dict[int, str] = {}
         self._watch_tasks: list[asyncio.Task] = []
 
     async def start(self) -> "EndpointPicker":
@@ -279,10 +282,20 @@ class EndpointPicker:
         # watch deliveries) — one refetch before answering 503
         for attempt in range(2):
             entries = await self._instances.get()
-            for _key, raw in entries.items():
-                inst = Instance.from_dict(raw)
-                if inst.instance_id == worker_id:
-                    return f"{inst.host}:{inst.port}"
+            # memoized per snapshot object: re-parsing every Instance
+            # dict on every pick made endpoint resolution an
+            # O(instances) tax on the decision hot path
+            if entries is not self._ep_snapshot:
+                self._ep_map = {}
+                for raw in entries.values():
+                    inst = Instance.from_dict(raw)
+                    self._ep_map[inst.instance_id] = (
+                        f"{inst.host}:{inst.port}"
+                    )
+                self._ep_snapshot = entries
+            endpoint = self._ep_map.get(worker_id)
+            if endpoint is not None:
+                return endpoint
             if attempt == 0:
                 self._instances.invalidate()
         return None
@@ -631,6 +644,9 @@ def main(argv=None) -> int:
     logging.basicConfig(level=logging.INFO)
     if args.shards > 1 and args.shard_id is None:
         return _run_shard_supervisor(args)
+    from dynamo_tpu.runtime.eventloop import maybe_install_uvloop
+
+    maybe_install_uvloop()
     try:
         asyncio.run(_amain(args))
     except KeyboardInterrupt:

@@ -14,6 +14,8 @@ kv_router.rs:66-71).
 
 from __future__ import annotations
 
+from contextlib import aclosing
+
 import asyncio
 import json
 import logging
@@ -396,7 +398,11 @@ class KvPushRouter:
         # estimates are systematically wrong for image traffic
         req_salt = (request.get("multimodal") or {}).get("salt") or self.salt
         if pinned is not None:
-            worker_id, overlap = pinned, 0
+            # the pick already happened upstream (EPP / gateway): route
+            # straight to it, and keep the picker's overlap estimate if
+            # it sent one instead of stomping it to 0
+            worker_id = pinned
+            overlap = int(request.get("estimated_prefix_hit_num_blocks") or 0)
         else:
             worker_id, overlap = self.kv_router.find_best_match(
                 context.id, token_ids, salt=req_salt
@@ -405,13 +411,15 @@ class KvPushRouter:
         request["estimated_prefix_hit_num_blocks"] = overlap
         first = True
         try:
-            async for item in self.push_router.generate(
+            stream = self.push_router.generate(
                 request, context, instance_id=worker_id
-            ):
-                if first:
-                    first = False
-                    self.kv_router.mark_prefill_done(context.id)
-                yield item
+            )
+            async with aclosing(stream):
+                async for item in stream:
+                    if first:
+                        first = False
+                        self.kv_router.mark_prefill_done(context.id)
+                    yield item
         finally:
             self.kv_router.free(context.id)
 

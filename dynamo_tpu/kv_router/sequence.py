@@ -26,42 +26,75 @@ class _ActiveSeq:
 
 @dataclass
 class ActiveSequences:
-    """Per-worker tracker of requests the router has dispatched."""
+    """Per-worker tracker of requests the router has dispatched.
+
+    Totals are maintained incrementally: ``load_of`` feeds every pick's
+    prediction (per candidate, per lifecycle event), so recomputing
+    ``sum()`` over the in-flight set there made prediction cost grow
+    with backlog depth — the deeper the queue, the slower every pick,
+    which is exactly the throughput cliff the stream-plane replay bench
+    measured past ~1k in-flight.
+    """
 
     force_expiry_s: float = 600.0
     _seqs: dict[str, _ActiveSeq] = field(default_factory=dict)
+    _blocks_total: int = 0
+    _prefill_total: int = 0
+    # earliest force-expiry among tracked seqs; expire() is a no-op int
+    # compare until the clock passes it. May go stale (point at a seq
+    # already removed) — that only costs one extra scan, never a miss.
+    _soonest_expiry: float = float("inf")
 
     def add(self, request_id: str, blocks: int, prefill_tokens: int) -> None:
         now = time.monotonic()
+        old = self._seqs.get(request_id)
+        if old is not None:  # re-add replaces: back out the old totals
+            self._blocks_total -= old.blocks
+            self._prefill_total -= old.prefill_tokens
+        expires = now + self.force_expiry_s
         self._seqs[request_id] = _ActiveSeq(
-            request_id, blocks, prefill_tokens, now, now + self.force_expiry_s
+            request_id, blocks, prefill_tokens, now, expires
         )
+        self._blocks_total += blocks
+        self._prefill_total += prefill_tokens
+        if expires < self._soonest_expiry:
+            self._soonest_expiry = expires
 
     def mark_prefill_done(self, request_id: str) -> None:
         seq = self._seqs.get(request_id)
         if seq is not None:
+            self._prefill_total -= seq.prefill_tokens
             seq.prefill_tokens = 0
 
     def add_decode_block(self, request_id: str) -> None:
         seq = self._seqs.get(request_id)
         if seq is not None:
             seq.blocks += 1
+            self._blocks_total += 1
 
     def remove(self, request_id: str) -> None:
-        self._seqs.pop(request_id, None)
+        seq = self._seqs.pop(request_id, None)
+        if seq is not None:
+            self._blocks_total -= seq.blocks
+            self._prefill_total -= seq.prefill_tokens
 
     def expire(self) -> None:
         now = time.monotonic()
+        if now < self._soonest_expiry:
+            return
         for rid in [r for r, s in self._seqs.items() if s.expires <= now]:
-            del self._seqs[rid]
+            self.remove(rid)
+        self._soonest_expiry = min(
+            (s.expires for s in self._seqs.values()), default=float("inf")
+        )
 
     @property
     def active_blocks(self) -> int:
-        return sum(s.blocks for s in self._seqs.values())
+        return self._blocks_total
 
     @property
     def prefill_tokens(self) -> int:
-        return sum(s.prefill_tokens for s in self._seqs.values())
+        return self._prefill_total
 
     @property
     def num_requests(self) -> int:

@@ -16,6 +16,7 @@ from dynamo_tpu.kv_router.publisher import KvEventPublisher, WorkerMetricsPublis
 from dynamo_tpu.mocker.engine import MockEngine, MockEngineConfig
 from dynamo_tpu.runtime.config import RuntimeConfig
 from dynamo_tpu.runtime.distributed import DistributedRuntime
+from dynamo_tpu.runtime.eventloop import maybe_install_uvloop
 from dynamo_tpu.runtime.hub_client import connect_hub
 from dynamo_tpu.runtime.logging_util import setup_logging
 
@@ -109,6 +110,7 @@ def main() -> None:
                    help="give each worker a distinct data_parallel_rank")
     args = p.parse_args()
     setup_logging()
+    maybe_install_uvloop()
     try:
         asyncio.run(_amain(args))
     except KeyboardInterrupt:

@@ -125,6 +125,14 @@ class MockEngineConfig:
     # MockTokenizer makes output text == prompt text, which E2E tests use
     # to drive the tool-call/reasoning parser paths deterministically
     echo_prompt: bool = False
+    # sim-pacing granularity: 0 sleeps exactly once per simulated step
+    # (one asyncio timer each). At high speedup ratios those dilated
+    # sleeps are single-digit µs and the timer bookkeeping costs more
+    # than the wait itself, throttling throughput benches below the
+    # plumbing they measure — set >0 to accumulate dilated time as debt
+    # and pay one real sleep per `sleep_granularity_s` of it instead
+    # (aggregate pacing preserved; per-step interleaving coarsened)
+    sleep_granularity_s: float = 0.0
 
 
 class MockEngine:
@@ -147,6 +155,7 @@ class MockEngine:
         )
         self._rng = random.Random(self.config.seed)
         self._running = 0
+        self._sleep_debt = 0.0
         self._waiting = 0
         self._admit = _PriorityGate(self.config.max_batch_size)
 
@@ -173,7 +182,15 @@ class MockEngine:
             )
 
     async def _sleep(self, seconds: float) -> None:
-        await asyncio.sleep(seconds / max(self.config.speedup_ratio, 1e-9))
+        delay = seconds / max(self.config.speedup_ratio, 1e-9)
+        gran = self.config.sleep_granularity_s
+        if gran <= 0.0:
+            await asyncio.sleep(delay)
+            return
+        self._sleep_debt += delay
+        if self._sleep_debt >= gran:
+            debt, self._sleep_debt = self._sleep_debt, 0.0
+            await asyncio.sleep(debt)
 
     # -- the engine --------------------------------------------------------
 

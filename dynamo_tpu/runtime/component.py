@@ -11,12 +11,14 @@ scheme :76-78) and component/client.rs (Client/InstanceSource).
 
 from __future__ import annotations
 
+from contextlib import aclosing
+
 import asyncio
 import logging
 from dataclasses import dataclass, field
 from typing import TYPE_CHECKING, Any, AsyncIterator
 
-from dynamo_tpu.runtime.context import Context, StreamError
+from dynamo_tpu.runtime.context import Context, StreamError, spawn
 from dynamo_tpu.runtime.transport import Handler, InstanceChannel, call_local
 
 if TYPE_CHECKING:
@@ -39,6 +41,9 @@ class Instance:
     port: int
     transport: str = "tcp"  # "tcp" | "local"
     metadata: dict[str, Any] = field(default_factory=dict)
+    # unix-socket path of the worker's EndpointServer, "" if not listening
+    # on one; co-located clients prefer it (transport.py InstanceChannel)
+    uds: str = ""
 
     @property
     def path(self) -> str:
@@ -64,13 +69,14 @@ class Instance:
             "port": self.port,
             "transport": self.transport,
             "metadata": self.metadata,
+            "uds": self.uds,
         }
 
     @classmethod
     def from_dict(cls, d: dict[str, Any]) -> "Instance":
         return cls(**{k: d[k] for k in (
             "instance_id", "namespace", "component", "endpoint",
-            "host", "port", "transport", "metadata",
+            "host", "port", "transport", "metadata", "uds",
         ) if k in d})
 
 
@@ -156,6 +162,7 @@ class Client:
         self.endpoint = endpoint
         self._instances: dict[int, Instance] = {}
         self._channels: dict[int, InstanceChannel] = {}
+        self._dials: dict[int, asyncio.Task] = {}  # single-flight, by iid
         self._watch_task: asyncio.Task | None = None
         self._ready = asyncio.Event()
         self._started = False
@@ -179,9 +186,20 @@ class Client:
                 if ev.kind == "put" and ev.value:
                     inst = Instance.from_dict(ev.value)
                     self._instances[inst.instance_id] = inst
+                    if inst.transport == "tcp" and self._drt.config.prewarm_dials:
+                        # warm the pool at discovery so the instance's
+                        # first request doesn't pay the dial (cold-vs-warm
+                        # TTFT delta: benchmarks/stream_bench.py)
+                        spawn(
+                            self._prewarm(inst),
+                            name=f"prewarm-{inst.instance_id:x}",
+                        )
                 elif ev.kind == "delete":
                     iid = int(ev.key.rsplit("/", 1)[-1], 16)
                     self._instances.pop(iid, None)
+                    dial = self._dials.pop(iid, None)
+                    if dial is not None:
+                        dial.cancel()
                     ch = self._channels.pop(iid, None)
                     if ch is not None:
                         await ch.close()
@@ -240,13 +258,17 @@ class Client:
                 handler = self._drt.local_registry.get(inst.wire_path)
                 if handler is None:
                     raise StreamError(f"local instance {instance_id:x} has no handler")
-                async for item in call_local(handler, payload, context):
-                    yield item
+                local_stream = call_local(handler, payload, context)
+                async with aclosing(local_stream):
+                    async for item in local_stream:
+                        yield item
                 return
             ch = await self._channel(inst)
             try:
-                async for item in ch.call(inst.wire_path, payload, context):
-                    yield item
+                stream = ch.call(inst.wire_path, payload, context)
+                async with aclosing(stream):
+                    async for item in stream:
+                        yield item
             except StreamError:
                 # connection-level death: drop the channel so the next
                 # call redials
@@ -254,20 +276,56 @@ class Client:
                 await ch.close()
                 raise
 
+    async def _prewarm(self, inst: Instance) -> None:
+        try:
+            await self._channel(inst)
+        except (StreamError, asyncio.CancelledError):
+            # best effort: the first real call redials (and migration
+            # re-drives if the instance is truly gone)
+            pass
+
     async def _channel(self, inst: Instance) -> InstanceChannel:
         ch = self._channels.get(inst.instance_id)
-        if ch is None or not ch.connected:
-            ch = InstanceChannel(inst.host, inst.port)
-            try:
-                await ch.connect(self._drt.config.connect_timeout_s)
-            except (OSError, asyncio.TimeoutError) as e:
-                raise StreamError(f"connect to {inst.host}:{inst.port} failed: {e}") from e
-            self._channels[inst.instance_id] = ch
+        if ch is not None and ch.connected:
+            return ch
+        # single-flight per instance id: two concurrent first calls used to
+        # both dial, with the loser's socket leaking unclosed
+        dial = self._dials.get(inst.instance_id)
+        if dial is None:
+            dial = asyncio.ensure_future(self._dial(inst))
+            self._dials[inst.instance_id] = dial
+            dial.add_done_callback(
+                lambda _t, iid=inst.instance_id: self._dials.pop(iid, None)
+            )
+        # shield: a cancelled caller must not kill the shared dial the
+        # other waiters (or the warm pool) are relying on
+        try:
+            return await asyncio.shield(dial)
+        except asyncio.CancelledError:
+            if dial.cancelled():
+                # the dial itself was torn down (instance deleted mid-dial):
+                # surface a retryable stream death, not caller cancellation
+                raise StreamError(
+                    f"instance {inst.instance_id:x} went away mid-dial"
+                ) from None
+            raise
+
+    async def _dial(self, inst: Instance) -> InstanceChannel:
+        ch = InstanceChannel(inst.host, inst.port, uds=inst.uds)
+        try:
+            await ch.connect(self._drt.config.connect_timeout_s)
+        except (OSError, asyncio.TimeoutError) as e:
+            await ch.close()
+            raise StreamError(f"connect to {inst.host}:{inst.port} failed: {e}") from e
+        self._channels[inst.instance_id] = ch
         return ch
 
     async def close(self) -> None:
         if self._watch_task is not None:
             self._watch_task.cancel()
+        for dial in list(self._dials.values()):
+            dial.cancel()
+        self._dials.clear()
         for ch in self._channels.values():
             await ch.close()
         self._channels.clear()

@@ -44,6 +44,14 @@ class RuntimeConfig:
     host: str = "127.0.0.1"  # address workers advertise for their TCP listener
     request_timeout_s: float = 600.0
     connect_timeout_s: float = 5.0
+    # pre-dial worker channels on instance discovery (DYN_PREWARM_DIALS):
+    # the first request to a fresh worker doesn't pay the TCP dial
+    prewarm_dials: bool = True
+    # directory for workers' unix-socket listeners (DYN_UDS_DIR): when set,
+    # each EndpointServer also listens on a socket there and co-located
+    # clients dial it instead of TCP; empty = TCP only. Coalescing/corking
+    # knobs (DYN_STREAM_COALESCE / DYN_STREAM_CORK) live in transport.py.
+    uds_dir: str = ""
 
     # leases / health
     lease_ttl_s: float = 10.0

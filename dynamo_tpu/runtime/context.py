@@ -164,14 +164,38 @@ class Context:
         self._stopped = asyncio.Event()
         self._killed = asyncio.Event()
         self._children: list[Context] = []
+        # sync callbacks fired once on the stopped edge — lets hot paths
+        # (one per in-flight wire call) observe cancellation without
+        # parking a watcher task each on ``stopped()``
+        self._stop_cbs: list = []
 
     # -- cancellation ------------------------------------------------------
 
     def stop_generating(self) -> None:
         """Graceful cancel: finish the current step, emit no more tokens."""
+        first = not self._stopped.is_set()
         self._stopped.set()
+        if first and self._stop_cbs:
+            cbs, self._stop_cbs = self._stop_cbs, []
+            for cb in cbs:
+                cb()
         for c in self._children:
             c.stop_generating()
+
+    def add_stop_callback(self, cb) -> None:
+        """Register a sync callback for the stopped edge (fires
+        immediately if already stopped). Pair with
+        ``remove_stop_callback`` when the interest ends."""
+        if self._stopped.is_set():
+            cb()
+            return
+        self._stop_cbs.append(cb)
+
+    def remove_stop_callback(self, cb) -> None:
+        try:
+            self._stop_cbs.remove(cb)
+        except ValueError:
+            pass
 
     def kill(self) -> None:
         """Hard cancel: abandon the request immediately."""

@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import os
 import random
 from typing import Any
 
@@ -111,9 +112,18 @@ class DistributedRuntime:
 
     # -- endpoint serving --------------------------------------------------
 
+    _uds_seq = 0
+
     async def _endpoint_server(self) -> EndpointServer:
         if self._server is None:
-            self._server = EndpointServer(host=self.config.host)
+            uds_path = None
+            if self.config.uds_dir:
+                DistributedRuntime._uds_seq += 1
+                uds_path = os.path.join(
+                    self.config.uds_dir,
+                    f"dyn-{os.getpid()}-{DistributedRuntime._uds_seq}.sock",
+                )
+            self._server = EndpointServer(host=self.config.host, uds_path=uds_path)
             await self._server.start()
         return self._server
 
@@ -151,6 +161,7 @@ class DistributedRuntime:
                 port=server.port,
                 transport="tcp",
                 metadata=metadata,
+                uds=server.uds_path or "",
             )
             server.register(inst.wire_path, handler)
         await self.hub.put(inst.path, inst.to_dict(), lease_id=lease)

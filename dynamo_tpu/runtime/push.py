@@ -9,6 +9,8 @@ decides whether to retry.
 
 from __future__ import annotations
 
+from contextlib import aclosing
+
 import enum
 import random
 from typing import Any, AsyncIterator
@@ -67,5 +69,7 @@ class PushRouter:
         """Route and stream. ``instance_id`` forces direct mode for this call
         (ref: PreprocessedRequest.backend_instance_id override)."""
         target = self.select(instance_id)
-        async for item in self.client.call_instance(target, request, context):
-            yield item
+        stream = self.client.call_instance(target, request, context)
+        async with aclosing(stream):
+            async for item in stream:
+                yield item

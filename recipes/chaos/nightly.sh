@@ -25,6 +25,15 @@ DYN_FAULTS="" python -m dynamo_tpu.sim --scenario all \
   --seed "$DYN_FAULTS_SEED" \
   --out "${DYN_SIM_OUT:-SIM_nightly.json}"
 
+# stream-plane war: full micro/golden/dial/replay/churn matrix with the
+# throughput + frames-per-token + bytes-reduction bars enforced via the
+# bench's own exit code (non-zero on any failed bar). Runs WITHOUT the
+# background DYN_FAULTS spec for the same reason as the sim: the churn
+# scenario owns its kill schedule, and injected transport faults would
+# turn the zero-client-errors bar into a coin flip.
+DYN_FAULTS="" python -m benchmarks.stream_bench --war \
+  --out "${DYN_STREAM_OUT:-STREAM_nightly.json}"
+
 # test_sim_full_matrix is deselected: the gating CLI run above IS the
 # full matrix (same code path), and the pytest copy would additionally
 # inherit the background DYN_FAULTS spec the scenarios must own

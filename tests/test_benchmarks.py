@@ -267,3 +267,31 @@ async def test_router_war_bench_smoke():
     assert runs[2]["radix_digests_identical"]
     assert runs[2]["approx_state_disjoint"]
     assert runs[1]["picks"] == runs[2]["picks"]
+
+
+def test_stream_war_bench_smoke(tmp_path):
+    """The ISSUE 16 stream-plane war bench end to end at toy scale
+    (--smoke): artifact schema, the structural bars (frame coalescing,
+    golden identity, corked-drain discipline, zero replay errors), and
+    the per-plane replay summaries. Throughput bars are only meaningful
+    at the full --war run that writes STREAM_r0x.json."""
+    from benchmarks.stream_bench import main
+
+    out_path = tmp_path / "stream_smoke.json"
+    assert main(["--smoke", "--out", str(out_path)]) == 0
+    out = json.loads(out_path.read_text())
+    assert out["schema"] == "dynamo-stream-war/v1"
+    assert out["verdict"] == "pass"
+    w = out["micro"]["war"]
+    # the tentpole's micro-guard: coalescing collapses frames (< 1 frame
+    # per token) and corked writes drain less than once per flush window
+    assert w["frames_per_token"] <= 0.5
+    assert w["drains"] < w["flushes"]
+    assert out["micro"]["bytes_per_token_reduction"] >= 2.0
+    assert out["goldens"]["identical"]
+    for plane in ("baseline", "war"):
+        r = out["replay"][plane]
+        assert r["errors"] == 0
+        assert r["pass_req_per_s"], plane
+    assert out["churn"]["errors"] == 0
+    assert out["churn"]["migrations"] > 0

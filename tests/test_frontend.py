@@ -275,14 +275,18 @@ async def test_http_chat_completion_streaming_sse():
             ) as r:
                 assert r.status == 200
                 assert r.headers["Content-Type"].startswith("text/event-stream")
-                chunks = []
-                async for line in r.content:
-                    line = line.decode().strip()
-                    if line.startswith("data: "):
-                        payload = line[6:]
-                        if payload == "[DONE]":
-                            break
-                        chunks.append(json.loads(payload))
+                raw = await r.content.read()
+        chunks = []
+        for line in raw.decode().split("\n\n"):
+            line = line.strip()
+            if line.startswith("data: ") and line[6:] != "[DONE]":
+                chunks.append(json.loads(line[6:]))
+        # the SSE fast path (prebuilt affixes + reusable encoder,
+        # frontend/http.py _sse_bytes) must be byte-identical to the
+        # reference per-chunk json.dumps assembly it replaced
+        assert raw == b"".join(
+            b"data: " + json.dumps(c).encode() + b"\n\n" for c in chunks
+        ) + b"data: [DONE]\n\n"
         assert chunks[0]["choices"][0]["delta"].get("role") == "assistant"
         assert chunks[-1].get("usage", {}).get("completion_tokens") == 4
         data_chunks = [c for c in chunks if c["choices"]]

@@ -787,7 +787,9 @@ async def test_leaked_prediction_heals_via_periodic_sweep():
     # never freed: the prediction is live in the scheduler
     assert router.scheduler._states[wid].predicted_active_blocks > 0
     # force-expire the tracked sequence and make the sweep due
-    router.sequences._workers[wid]._seqs["leak"].expires = 0.0
+    seqs = router.sequences._workers[wid]
+    seqs._seqs["leak"].expires = 0.0
+    seqs._soonest_expiry = 0.0  # expiry is lazily gated on this watermark
     router._pred_sweep_at = 0.0
     other = router.find_best_match("next", [99] * 32)[0]
     router.free("next")

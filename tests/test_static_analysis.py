@@ -296,8 +296,19 @@ def test_wire_schema_covers_expected_channels():
     assert "clear_kv_blocks" in committed["channels"]["worker.admin"]
     err = committed["transport_err_codes"]
     assert set(err["emitted"]) == set(err["handled"]) == {
-        "deadline", "unavailable", "over_quota"
+        "deadline", "unavailable", "over_quota", "stream"
     }
+    frames = committed["stream_frames"]
+    # every emitted frame kind has an rx dispatch; "req" is legacy-only
+    # (handled for old clients, never sent by the compact-id client)
+    assert set(frames["emitted"]) == {
+        "open", "cancel", "data", "end", "err"
+    }
+    assert set(frames["handled"]) == set(frames["emitted"]) | {"req"}
+    assert "req" in frames["notes"]
+    # coalescing is part of the catalogued protocol, not an impl detail
+    assert "payloads" in frames["emitted"]["data"]
+    assert "ch" in frames["emitted"]["open"]
 
 
 def test_missing_dispatcher_anchor_is_a_finding(tmp_path):

@@ -1,0 +1,606 @@
+"""Stream-plane tests (ISSUE 16): corked/coalesced token framing, compact
+channel ids, warm pooled dials, bounded rx queues, and torn-frame
+robustness.
+
+The contract under test: the corked/coalesced fast path must be
+OBSERVATIONALLY IDENTICAL to the old frame-per-item path — same items in
+the same order, same error placement, same cancel and mid-stream-death
+semantics — while collapsing the per-token write+drain round-trips into
+one flush per event-loop tick.
+"""
+
+import asyncio
+import os
+import struct
+
+import pytest
+
+from dynamo_tpu.runtime import framing, transport
+from dynamo_tpu.runtime.component import Instance
+from dynamo_tpu.runtime.config import RuntimeConfig
+from dynamo_tpu.runtime.context import (
+    Context,
+    ServiceUnavailable,
+    StreamError,
+)
+from dynamo_tpu.runtime.distributed import DistributedRuntime
+from dynamo_tpu.runtime.hub_client import RemoteHub
+from dynamo_tpu.runtime.hub_server import HubServer
+from dynamo_tpu.runtime.transport import EndpointServer, InstanceChannel
+
+pytestmark = pytest.mark.unit
+
+
+# ------------------------------------------------------------ helpers
+
+
+async def _tcp_pair(**cfg_kwargs):
+    """HubServer + worker/client DistributedRuntimes over real TCP."""
+    server = HubServer(port=0)
+    await server.start()
+    addr = f"127.0.0.1:{server.port}"
+    cfg = RuntimeConfig(hub_address=addr, **cfg_kwargs)
+    worker = DistributedRuntime(await RemoteHub.connect(addr), cfg)
+    client = DistributedRuntime(await RemoteHub.connect(addr), cfg)
+    return server, worker, client
+
+
+async def _close_pair(server, worker, client):
+    await client.close()
+    await worker.close()
+    await server.stop()
+
+
+async def _collect(server_coalesce: bool, handler, payload=None, first_n=None):
+    """Serve ``handler`` on a raw EndpointServer (coalescing on/off) and
+    collect (items, exception) from one InstanceChannel call."""
+    srv = EndpointServer(coalesce=server_coalesce)
+    srv.register("ep", handler)
+    host, port = await srv.start()
+    ch = InstanceChannel(host, port)
+    await ch.connect()
+    items, exc = [], None
+    try:
+        async for item in ch.call("ep", payload, Context()):
+            items.append(item)
+            if first_n is not None and len(items) >= first_n:
+                break
+    except Exception as e:  # noqa: BLE001 - the exception IS the golden
+        exc = e
+    await ch.close()
+    await srv.stop(drain=False)
+    return items, exc
+
+
+# ------------------------------------- tentpole: corked-writes micro-guard
+
+
+async def test_decode_burst_coalesces_frames_and_avoids_drains():
+    """Tier-1 micro-guard: a 64-item decode burst on one stream must ship
+    as coalesced data frames (frames/token <= 0.5) with <1 drain per
+    flush window — not 64 write+drain round-trips."""
+    n_items = 64
+
+    async def burst(request, context):
+        for i in range(n_items):
+            yield {"token_ids": [i], "text": f"t{i}"}
+
+    srv = EndpointServer()
+    assert srv.coalesce and srv.cork  # defaults on
+    srv.register("ep", burst)
+    host, port = await srv.start()
+    ch = InstanceChannel(host, port)
+    await ch.connect()
+    transport.reset_stream_stats()
+    got = [x async for x in ch.call("ep", None, Context())]
+    assert len(got) == n_items
+    stats = transport.stream_stats()
+    assert stats["data_items"] == n_items
+    # coalescing bar (acceptance: frames/token <= 0.5; a back-to-back
+    # burst collapses far below that)
+    assert stats["data_frames"] / n_items <= 0.5, stats
+    # corking bar: drains only on backpressure — a localhost burst has
+    # none, so strictly fewer drains than flush windows (here: zero)
+    assert stats["flushes"] >= 1
+    assert stats["drains"] < stats["flushes"], stats
+    assert stats["drains"] == 0, stats
+    await ch.close()
+    await srv.stop(drain=False)
+
+
+async def test_frame_writer_single_flush_per_tick():
+    """FrameWriter buffers feeds within a tick and writes once."""
+    rx = asyncio.StreamReader()
+
+    class _Proto(asyncio.Protocol):
+        pass
+
+    loop = asyncio.get_running_loop()
+    server = await asyncio.start_server(
+        lambda r, w: None, "127.0.0.1", 0
+    )
+    host, port = server.sockets[0].getsockname()[:2]
+    _reader, writer = await asyncio.open_connection(host, port)
+    writes = []
+    orig_write = writer.write
+    writer.write = lambda data: (writes.append(len(data)), orig_write(data))
+    fw = framing.FrameWriter(writer)
+    for i in range(32):
+        fw.feed({"kind": "data", "ch": 1, "payload": i})
+    assert writes == []  # corked: nothing hit the transport yet
+    await asyncio.sleep(0)  # let the call_soon tick run
+    assert len(writes) == 1 and fw.flushes == 1 and fw.frames == 32
+    # uncorked writer: one write per frame (the legacy baseline shape)
+    writes.clear()
+    fw2 = framing.FrameWriter(writer, cork=False)
+    for i in range(4):
+        await fw2.send({"kind": "data", "ch": 1, "payload": i})
+    assert len(writes) == 4 and fw2.drains == 4
+    writer.close()
+    server.close()
+    del rx, _Proto, loop
+
+
+# --------------------------------- tentpole: coalesced-vs-uncoalesced goldens
+
+
+async def test_golden_item_order_identical():
+    n = 200
+
+    async def gen(request, context):
+        for i in range(n):
+            yield {"seq": i, "text": f"tok-{i}"}
+            if i % 17 == 0:
+                await asyncio.sleep(0)  # mix tick boundaries into the burst
+
+    a, ea = await _collect(True, gen)
+    b, eb = await _collect(False, gen)
+    assert ea is None and eb is None
+    assert a == b == [{"seq": i, "text": f"tok-{i}"} for i in range(n)]
+
+
+async def test_golden_error_placement_identical():
+    """Items yielded before a handler error arrive before the error —
+    with coalescing, pending items must flush ahead of the err frame."""
+
+    async def boom(request, context):
+        for i in range(5):
+            yield {"seq": i}
+        raise ValueError("boom")
+
+    a, ea = await _collect(True, boom)
+    b, eb = await _collect(False, boom)
+    assert a == b == [{"seq": i} for i in range(5)]
+    assert type(ea) is type(eb) is RuntimeError
+    assert str(ea) == str(eb) == "ValueError('boom')"
+
+
+async def test_golden_typed_error_identical():
+    async def refuse(request, context):
+        yield {"seq": 0}
+        raise ServiceUnavailable("saturated", retry_after_s=2.5)
+
+    a, ea = await _collect(True, refuse)
+    b, eb = await _collect(False, refuse)
+    assert a == b == [{"seq": 0}]
+    for e in (ea, eb):
+        assert isinstance(e, ServiceUnavailable)
+        assert e.retry_after_s == 2.5
+
+
+async def test_handler_stream_error_stays_retryable():
+    """A StreamError raised IN the handler keeps its retryable typing
+    across the wire (code="stream"), matching local dispatch — the
+    migration operator re-drives it instead of surfacing RuntimeError."""
+
+    async def die(request, context):
+        yield {"seq": 0}
+        raise StreamError("engine lost")
+
+    a, ea = await _collect(True, die)
+    b, eb = await _collect(False, die)
+    assert a == b == [{"seq": 0}]
+    for e in (ea, eb):
+        assert type(e) is StreamError
+        assert "engine lost" in str(e)
+
+
+async def test_golden_cancel_semantics_identical():
+    """Consumer break -> cancel frame -> handler observes stop, both modes."""
+
+    async def run(coalesce: bool):
+        stopped = asyncio.Event()
+
+        async def slow(request, context):
+            try:
+                for i in range(10_000):
+                    if context.is_stopped:
+                        return
+                    yield {"seq": i}
+                    await asyncio.sleep(0.005)
+            finally:
+                stopped.set()
+
+        items, exc = await _collect(coalesce, slow, first_n=3)
+        assert exc is None
+        await asyncio.wait_for(stopped.wait(), 5)
+        return items
+
+    a = await run(True)
+    b = await run(False)
+    assert a == b == [{"seq": i} for i in range(3)]
+
+
+async def test_golden_midstream_death_then_migration_continuity():
+    """Mid-stream worker death surfaces StreamError at the same item
+    boundary semantics, and a Migration-wrapped router re-drives to a
+    live worker with the resume prompt: the merged stream is the full
+    token sequence, coalesced or not."""
+    from dynamo_tpu.frontend.migration import Migration
+    from dynamo_tpu.runtime.push import PushRouter, RouterMode
+
+    total = 12
+
+    async def run(coalesce: bool):
+        os.environ["DYN_STREAM_COALESCE"] = "1" if coalesce else "0"
+        try:
+            server, worker_a, worker_b = await _tcp_pair(prewarm_dials=False)
+            client_drt = DistributedRuntime(
+                await RemoteHub.connect(f"127.0.0.1:{server.port}"),
+                RuntimeConfig(hub_address=f"127.0.0.1:{server.port}",
+                              prewarm_dials=False),
+            )
+
+            def make_gen(slow: bool):
+                async def gen(request, context):
+                    start = len(request.get("token_ids") or [])
+                    stop = request.get("stop_conditions") or {}
+                    for i in range(stop.get("max_tokens", total)):
+                        tok = start + i
+                        yield {"token_ids": [tok], "text": f"t{tok}"}
+                        if slow:
+                            await asyncio.sleep(0.02)
+                    yield {"token_ids": [], "finish_reason": "stop"}
+
+                return gen
+
+            # worker A is slow (it will die mid-stream); B finishes the job
+            ep_a = worker_a.namespace("ns").component("w").endpoint("gen")
+            await ep_a.serve(make_gen(slow=True))
+            ep_c = client_drt.namespace("ns").component("w").endpoint("gen")
+            router = await PushRouter.from_endpoint(ep_c, RouterMode.ROUND_ROBIN)
+            await router.client.wait_for_instances(1, timeout=5)
+            mig = Migration(router, migration_limit=6, retry_delay_s=0.01,
+                            backoff_max_s=0.02)
+
+            toks = []
+            ctx = Context()
+            request = {"token_ids": [], "stop_conditions": {"max_tokens": total}}
+            killed = False
+            async for item in mig.generate(request, ctx):
+                toks.extend(item.get("token_ids") or [])
+                if not killed and len(toks) >= 3:
+                    killed = True
+                    # crash A, then bring up B to take the migration
+                    await worker_a._server.stop(drain=False)
+                    ep_b = worker_b.namespace("ns").component("w").endpoint("gen")
+                    await ep_b.serve(make_gen(slow=False))
+            await client_drt.close()
+            await _close_pair(server, worker_a, worker_b)
+            return toks
+        finally:
+            os.environ.pop("DYN_STREAM_COALESCE", None)
+
+    a = await run(True)
+    b = await run(False)
+    # continuity golden: no dropped or duplicated tokens, either mode
+    assert a == b == list(range(total))
+
+
+# ----------------------------------------- tentpole: compact ids + handshake
+
+
+async def test_open_handshake_uses_compact_channel_ids():
+    """The wire carries small int ``ch`` ids on per-token frames, not the
+    32-hex uuid req id; headers cross once, at open."""
+    seen = []
+
+    async def spy(request, context):
+        yield {"ok": True}
+
+    srv = EndpointServer()
+    srv.register("ep", spy)
+    host, port = await srv.start()
+
+    reader, writer = await asyncio.open_connection(host, port)
+    await framing.write_frame(writer, {
+        "kind": "open", "ch": 1, "req": "a" * 32, "path": "ep",
+        "payload": None, "headers": {},
+    })
+    frames = []
+    while True:
+        msg = await asyncio.wait_for(framing.read_frame(reader), 5)
+        frames.append(msg)
+        if msg["kind"] in ("end", "err"):
+            break
+    assert [f["kind"] for f in frames] == ["data", "end"]
+    for f in frames:
+        assert f["ch"] == 1
+        assert "req" not in f  # uuid never re-sent on the stream
+    writer.close()
+    await srv.stop(drain=False)
+    del seen, spy
+
+
+async def test_legacy_req_frames_still_served():
+    """Pre-open peers speak {"kind": "req"} and get req-stamped,
+    uncoalesced replies (rolling-upgrade compatibility)."""
+
+    async def gen(request, context):
+        for i in range(3):
+            yield i
+
+    srv = EndpointServer(coalesce=True)
+    srv.register("ep", gen)
+    host, port = await srv.start()
+    reader, writer = await asyncio.open_connection(host, port)
+    await framing.write_frame(writer, {
+        "kind": "req", "req": "r1", "path": "ep", "payload": None,
+        "headers": {},
+    })
+    frames = []
+    while True:
+        msg = await asyncio.wait_for(framing.read_frame(reader), 5)
+        frames.append(msg)
+        if msg["kind"] == "end":
+            break
+    assert [f.get("req") for f in frames] == ["r1"] * 4
+    assert [f.get("payload") for f in frames[:3]] == [0, 1, 2]
+    assert all("payloads" not in f for f in frames)
+    writer.close()
+    await srv.stop(drain=False)
+
+
+# -------------------------------------------- satellite 1: single-flight dial
+
+
+async def test_channel_dial_race_single_flight(monkeypatch):
+    """Two concurrent first calls to a fresh instance dial exactly once
+    (the loser used to leak its socket)."""
+    server, worker, client_drt = await _tcp_pair(prewarm_dials=False)
+    try:
+        async def h(request, context):
+            yield "ok"
+
+        ep_w = worker.namespace("ns").component("c").endpoint("g")
+        await ep_w.serve(h)
+        client = await client_drt.namespace("ns").component("c").endpoint(
+            "g").client().start()
+        insts = await client.wait_for_instances(1, timeout=5)
+        iid = insts[0].instance_id
+
+        dials = {"n": 0}
+        orig_connect = InstanceChannel.connect
+
+        async def counted_connect(self, timeout=5.0):
+            dials["n"] += 1
+            await asyncio.sleep(0.05)  # widen the race window
+            await orig_connect(self, timeout)
+
+        monkeypatch.setattr(InstanceChannel, "connect", counted_connect)
+
+        async def one_call():
+            return [x async for x in client.call_instance(iid, {}, Context())]
+
+        r1, r2 = await asyncio.gather(one_call(), one_call())
+        assert r1 == r2 == ["ok"]
+        assert dials["n"] == 1, f"dial race: {dials['n']} dials"
+        assert len(client._channels) == 1
+    finally:
+        await _close_pair(server, worker, client_drt)
+
+
+async def test_prewarm_dials_on_discovery():
+    """With prewarm on (default), discovery alone opens the channel —
+    the first request doesn't pay the dial."""
+    server, worker, client_drt = await _tcp_pair()
+    try:
+        async def h(request, context):
+            yield "ok"
+
+        ep_w = worker.namespace("ns").component("c").endpoint("g")
+        await ep_w.serve(h)
+        client = await client_drt.namespace("ns").component("c").endpoint(
+            "g").client().start()
+        insts = await client.wait_for_instances(1, timeout=5)
+        iid = insts[0].instance_id
+        for _ in range(100):  # give the spawned prewarm task a beat
+            if iid in client._channels and client._channels[iid].connected:
+                break
+            await asyncio.sleep(0.02)
+        assert iid in client._channels and client._channels[iid].connected
+    finally:
+        await _close_pair(server, worker, client_drt)
+
+
+# ---------------------------------------------- satellite 2: bounded rx queue
+
+
+async def test_stalled_consumer_applies_backpressure():
+    """A stalled client consumer must cap BOTH the client rx queue and the
+    worker's production (TCP backpressure), instead of ballooning an
+    unbounded asyncio.Queue."""
+    total = 128
+    payload = "x" * (64 * 1024)
+    produced = {"n": 0}
+
+    async def firehose(request, context):
+        for i in range(total):
+            produced["n"] = i + 1
+            yield {"seq": i, "blob": payload}
+
+    srv = EndpointServer()
+    srv.register("ep", firehose)
+    host, port = await srv.start()
+    ch = InstanceChannel(host, port)
+    ch.rx_max_items = 4
+    ch.rx_max_bytes = 256 * 1024
+    await ch.connect()
+
+    got = []
+    stream = ch.call("ep", None, Context())
+    async for item in stream:
+        got.append(item)
+        break  # stall: stop consuming with the stream open
+    await asyncio.sleep(0.5)  # let the producer run into the wall
+    q = next(iter(ch._queues.values()))
+    # client-side: rx loop parked at the high-water mark, queue bounded
+    assert q._q.qsize() <= ch.rx_max_items + 1, q._q.qsize()
+    # overshoot is at most one coalesced frame (the coalescer's byte cap
+    # keeps frames near FrameWriter.high_water even for fat payloads)
+    assert q._bytes <= ch.rx_max_bytes + 3 * len(payload)
+    # worker-side: the handler is stalled in fw backpressure, far from done
+    assert produced["n"] < total, "producer ran unbounded despite stall"
+    # resume: drain the rest; the stream completes intact
+    async for item in stream:
+        got.append(item)
+    assert [g["seq"] for g in got] == list(range(total))
+    assert produced["n"] == total
+    await ch.close()
+    await srv.stop(drain=False)
+
+
+# ------------------------------------------- satellite 3: torn-frame handling
+
+
+async def test_framing_partial_length_header_is_clean_eof():
+    reader = asyncio.StreamReader()
+    reader.feed_data(b"\x00\x01")  # 2 of 4 length bytes
+    reader.feed_eof()
+    assert await framing.read_frame(reader) is None
+
+
+async def test_framing_truncated_body_is_clean_eof():
+    reader = asyncio.StreamReader()
+    reader.feed_data(struct.pack(">I", 100) + b"short")
+    reader.feed_eof()
+    assert await framing.read_frame(reader) is None
+
+
+async def test_framing_oversize_frame_rejected():
+    reader = asyncio.StreamReader()
+    reader.feed_data(struct.pack(">I", framing.MAX_FRAME + 1))
+    with pytest.raises(ValueError, match="frame too large"):
+        await framing.read_frame(reader)
+
+
+def test_frame_feeder_reassembles_across_arbitrary_chunk_splits():
+    """FrameFeeder (the chunked-rx parser both rx loops use) must emit
+    the same frame sequence no matter where the kernel splits reads —
+    including splits inside the length header and inside a body."""
+    frames = [{"kind": "data", "ch": i, "payload": "x" * (i * 7)}
+              for i in range(5)]
+    wire = b"".join(framing.pack(f) for f in frames)
+    for step in (1, 2, 3, 5, 11, len(wire)):
+        feeder = framing.FrameFeeder()
+        got = []
+        for off in range(0, len(wire), step):
+            got.extend(feeder.feed(wire[off:off + step]))
+        assert [m for m, _ in got] == frames, f"chunk step {step}"
+        # on-wire sizes account for every byte exactly once
+        assert sum(n for _, n in got) == len(wire)
+        assert feeder.pending_bytes == 0
+
+
+def test_frame_feeder_holds_partial_tail_and_rejects_oversize():
+    feeder = framing.FrameFeeder()
+    wire = framing.pack({"kind": "end", "ch": 1})
+    assert feeder.feed(wire[:5]) == []
+    assert feeder.pending_bytes == 5
+    got = feeder.feed(wire[5:])
+    assert [m for m, _ in got] == [{"kind": "end", "ch": 1}]
+    with pytest.raises(ValueError, match="frame too large"):
+        feeder.feed(struct.pack(">I", framing.MAX_FRAME + 1))
+
+
+async def test_server_survives_garbage_then_serves_valid_connection():
+    """Garbage bytes drop THAT connection; the accept loop keeps serving
+    well-formed peers (length-prefixed framing can't resync mid-stream)."""
+
+    async def h(request, context):
+        yield "fine"
+
+    srv = EndpointServer()
+    srv.register("ep", h)
+    host, port = await srv.start()
+
+    # 1: torn header at EOF
+    _r, w = await asyncio.open_connection(host, port)
+    w.write(b"\x00\x02")
+    w.close()
+    # 2: oversize frame
+    _r, w = await asyncio.open_connection(host, port)
+    w.write(struct.pack(">I", framing.MAX_FRAME + 7) + b"\xff" * 16)
+    await w.drain()
+    w.close()
+    # 3: garbage that parses as length+body but not as a msgpack dict
+    _r, w = await asyncio.open_connection(host, port)
+    w.write(struct.pack(">I", 1) + b"\x01")  # msgpack int 1, not a dict
+    await w.drain()
+    w.close()
+    await asyncio.sleep(0.05)
+
+    # the server must still serve a valid peer
+    ch = InstanceChannel(host, port)
+    await ch.connect()
+    out = [x async for x in ch.call("ep", None, Context())]
+    assert out == ["fine"]
+    await ch.close()
+    await srv.stop(drain=False)
+
+
+# --------------------------------------------------- UDS co-located fast path
+
+
+async def test_uds_endpoint_roundtrip(tmp_path):
+    server = HubServer(port=0)
+    await server.start()
+    addr = f"127.0.0.1:{server.port}"
+    cfg = RuntimeConfig(hub_address=addr, uds_dir=str(tmp_path))
+    worker = DistributedRuntime(await RemoteHub.connect(addr), cfg)
+    client_drt = DistributedRuntime(await RemoteHub.connect(addr), cfg)
+    try:
+        async def h(request, context):
+            yield {"via": "uds?"}
+
+        ep_w = worker.namespace("ns").component("c").endpoint("g")
+        await ep_w.serve(h)
+        client = await client_drt.namespace("ns").component("c").endpoint(
+            "g").client().start()
+        insts = await client.wait_for_instances(1, timeout=5)
+        inst = insts[0]
+        assert inst.uds and os.path.exists(inst.uds)
+        out = [x async for x in client.call_instance(
+            inst.instance_id, {}, Context())]
+        assert out == [{"via": "uds?"}]
+        ch = client._channels[inst.instance_id]
+        sock = ch._writer.get_extra_info("socket")
+        import socket as _socket
+
+        assert sock.family == _socket.AF_UNIX
+    finally:
+        await client_drt.close()
+        await worker.close()
+        await server.stop()
+    assert not os.path.exists(cfg.uds_dir + "/")  or True
+    # socket file is unlinked on server stop
+    assert not any(p.suffix == ".sock" for p in tmp_path.iterdir())
+
+
+def test_instance_uds_field_roundtrips_and_tolerates_absence():
+    inst = Instance(1, "ns", "c", "e", "h", 1, "tcp", {}, uds="/tmp/x.sock")
+    assert Instance.from_dict(inst.to_dict()).uds == "/tmp/x.sock"
+    # old registrations without the field still parse
+    d = inst.to_dict()
+    del d["uds"]
+    assert Instance.from_dict(d).uds == ""

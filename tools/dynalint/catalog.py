@@ -165,6 +165,17 @@ METRIC_NAMES: dict[str, str] = {
                              "compile_error | unavailable) — conformance "
                              "delivered vs cut mid-grammar vs bounced at "
                              "the grammar compiler",
+    # stream plane (runtime/transport.py, every /metrics surface via the
+    # module registry)
+    "transport_frames_total": "data-plane frames sent by kind "
+                              "(open | data | end | err | cancel) — a "
+                              "coalesced data frame counts ONCE however "
+                              "many payloads it carries, so frames/token "
+                              "< 1 is the coalescing win the STREAM_r0x "
+                              "artifacts assert",
+    "transport_flush_bytes": "bytes handed to the transport per corked "
+                             "flush (batch-size histogram of the "
+                             "one-flush-per-tick writer)",
     # EPP pick-path telemetry (gateway/epp.py /metrics)
     "epp_pick_seconds": "EPP pick-path latency histogram",
     # KV-router data plane (kv_router/router.py, on every /metrics

@@ -96,6 +96,21 @@ TOOLING_OPS: dict[str, str] = {
 # one op's schema.
 ENVELOPE_FIELDS = frozenset({"op", "id"})
 
+# The request/response stream plane (runtime/transport.py) speaks
+# ``{"kind": ...}`` frames rather than ops; per-kind extraction is scoped
+# to THIS path only — frame-shaped dict literals elsewhere (benches,
+# tests, goldens) are fixtures, not protocol.
+STREAM_FRAME_PATH = "dynamo_tpu/runtime/transport.py"
+
+# Frame kinds a peer deliberately handles with no in-tree sender, with the
+# written reason (rendered into wire_schema.json + docs/PROTOCOL.md).
+LEGACY_FRAME_KINDS: dict[str, str] = {
+    "req": "legacy pre-compact-id request frame (uuid stream ids, "
+           "headers on every frame); still served so old clients keep "
+           "working, but the client now opens streams with "
+           '{"kind": "open"}',
+}
+
 # Client-call attribute names that are generic hub senders: the value is
 # the positional index of the op string literal (the replica's peer-RPC
 # helper takes the peer address first), and keyword args are the fields.
@@ -139,6 +154,9 @@ class WireSchema:
         self.channels: dict[str, dict[str, OpInfo]] = {}
         self.err_emitted: dict[str, list[_Site]] = {}
         self.err_handled: dict[str, list[_Site]] = {}
+        # stream plane: frame kind -> {"fields": set, "sites": [_Site]}
+        self.frame_emitted: dict[str, dict] = {}
+        self.frame_handled: dict[str, list[_Site]] = {}
         self.missing_anchors: list[tuple[str, str]] = []
 
     def op(self, channel: str, op: str) -> OpInfo:
@@ -170,10 +188,24 @@ class WireSchema:
                     entry["note"] = note
                 ops[op_name] = entry
             channels[channel] = ops
+        stream_frames: dict = {
+            "emitted": {
+                kind: sorted(ent["fields"])
+                for kind, ent in sorted(self.frame_emitted.items())
+            },
+            "handled": sorted(self.frame_handled),
+        }
+        notes = {
+            k: v for k, v in sorted(LEGACY_FRAME_KINDS.items())
+            if k in self.frame_handled or k in self.frame_emitted
+        }
+        if notes:
+            stream_frames["notes"] = notes
         return {
             "version": 1,
             "tool": "dynalint",
             "channels": channels,
+            "stream_frames": stream_frames,
             "transport_err_codes": {
                 "emitted": sorted(self.err_emitted),
                 "handled": sorted(self.err_handled),
@@ -464,6 +496,71 @@ def _extract_err_codes(schema: WireSchema, ctx: "ScanContext") -> None:
             )
 
 
+def _extract_stream_frames(schema: WireSchema, ctx: "ScanContext") -> None:
+    """Stream-plane ``{"kind": ...}`` frames (STREAM_FRAME_PATH only).
+
+    Emitted: every dict literal with a constant ``kind`` value, with the
+    other literal keys as its fields (``ch``/``req`` ride in via the
+    reply-envelope ``update()`` and are documented as envelope, not
+    per-kind fields). Handled: ``== "lit"`` / ``!= "lit"`` compares of a
+    kind variable (assigned from ``msg.get("kind")`` or ``msg["kind"]``)
+    or of the access itself, plus ``in ("end", "err")`` membership."""
+    if ctx.path != STREAM_FRAME_PATH:
+        return
+    kind_vars: set[str] = set()
+    for node in ctx.nodes:
+        if isinstance(node, ast.Dict):
+            kv = {}
+            for k, v in zip(node.keys, node.values):
+                key = _str_const(k)
+                if key is not None:
+                    kv[key] = v
+            kind = _str_const(kv.get("kind"))
+            if kind is not None:
+                ent = schema.frame_emitted.setdefault(
+                    kind, {"fields": set(), "sites": []}
+                )
+                ent["fields"] |= set(kv) - {"kind"}
+                ent["sites"].append(_Site(ctx.path, node))
+        elif (
+            isinstance(node, ast.Assign)
+            and len(node.targets) == 1
+            and isinstance(node.targets[0], ast.Name)
+        ):
+            for probe in (_get_call_field, _subscript_field):
+                _recv, field = probe(node.value)
+                if field == "kind":
+                    kind_vars.add(node.targets[0].id)
+    for node in ctx.nodes:
+        if not (isinstance(node, ast.Compare) and len(node.ops) == 1):
+            continue
+        is_kind = (
+            isinstance(node.left, ast.Name) and node.left.id in kind_vars
+        )
+        if not is_kind:
+            for probe in (_get_call_field, _subscript_field):
+                _recv, field = probe(node.left)
+                if field == "kind":
+                    is_kind = True
+        if not is_kind:
+            continue
+        if isinstance(node.ops[0], (ast.Eq, ast.NotEq)):
+            lit = _str_const(node.comparators[0])
+            if lit is not None:
+                schema.frame_handled.setdefault(lit, []).append(
+                    _Site(ctx.path, node)
+                )
+        elif isinstance(node.ops[0], ast.In) and isinstance(
+            node.comparators[0], (ast.Tuple, ast.List, ast.Set)
+        ):
+            for elt in node.comparators[0].elts:
+                lit = _str_const(elt)
+                if lit is not None:
+                    schema.frame_handled.setdefault(lit, []).append(
+                        _Site(ctx.path, node)
+                    )
+
+
 def extract(project: "ProjectIndex") -> WireSchema:
     """Build the wire schema for one ProjectIndex (memoized on it)."""
     cached = getattr(project, "_wire_schema", None)
@@ -489,6 +586,7 @@ def extract(project: "ProjectIndex") -> WireSchema:
                     _extract_dispatcher(schema, ctx, info.node, "hub")
         _extract_senders(schema, ctx)
         _extract_err_codes(schema, ctx)
+        _extract_stream_frames(schema, ctx)
     scanned_paths = {ctx.path for ctx in project.contexts}
     schema.missing_anchors = [
         (path, qual)
@@ -572,6 +670,21 @@ def check_project(project: "ProjectIndex") -> Iterable[Finding]:
                          "exception) or reuse an existing code",
                     context=site.qualname, detail=f"errcode:{code}",
                 )
+    if schema.frame_handled:
+        for kind, ent in sorted(schema.frame_emitted.items()):
+            if kind in schema.frame_handled:
+                continue
+            for site in ent["sites"]:
+                yield Finding(
+                    rule="DL007", path=site.path, line=site.line,
+                    col=site.col,
+                    message=f"stream frame kind {kind!r} is emitted but "
+                            "no rx path dispatches it — the peer drops "
+                            "the frame on the floor",
+                    hint="handle the kind in the rx dispatch, or fix the "
+                         "kind string (then --update-wire-schema)",
+                    context=site.qualname, detail=f"framekind:{kind}",
+                )
 
 
 def unsent_op_warnings(project: "ProjectIndex") -> list[str]:
@@ -599,6 +712,15 @@ def unsent_op_warnings(project: "ProjectIndex") -> list[str]:
                 f"wire: transport err code {code!r} is handled at "
                 f"{site.path}:{site.line} but never emitted — stale "
                 "client mapping?"
+            )
+    for kind in sorted(set(schema.frame_handled) - set(schema.frame_emitted)):
+        if schema.frame_emitted and kind not in LEGACY_FRAME_KINDS:
+            site = schema.frame_handled[kind][0]
+            out.append(
+                f"wire: stream frame kind {kind!r} is handled at "
+                f"{site.path}:{site.line} but never emitted — dead rx "
+                "branch? (annotate LEGACY_FRAME_KINDS in "
+                "tools/dynalint/wire.py with a reason if deliberate)"
             )
     return out
 
@@ -670,6 +792,12 @@ def _diff_schema(committed: dict, extracted: dict) -> list[tuple[str, str]]:
                     out.append((f"{ch}:{op}:sites",
                                 f"op {op!r} ({ch}) sender/handler sites "
                                 "changed"))
+    c_sf = committed.get("stream_frames", {})
+    e_sf = extracted.get("stream_frames", {})
+    if c_sf != e_sf:
+        out.append(("streamframes",
+                    f"stream frame kinds changed: committed {c_sf}, "
+                    f"extracted {e_sf}"))
     c_err = committed.get("transport_err_codes", {})
     e_err = extracted.get("transport_err_codes", {})
     if c_err != e_err:
@@ -746,6 +874,34 @@ def render_protocol_md(canonical: dict) -> str:
             lines.append(
                 f"| `{op}` | {fields} | {handlers} | {senders} | "
                 f"{e.get('note', '')} |"
+            )
+        lines.append("")
+    sf = canonical.get("stream_frames", {})
+    if sf:
+        lines.append("## Stream frames (request/response data plane)")
+        lines.append("")
+        lines.append(
+            "Length-prefixed msgpack frames on the worker transport "
+            "(runtime/transport.py). Every frame after `open` carries the "
+            "compact integer stream id `ch` (legacy `req` streams echo "
+            "the uuid `req` instead) — that reply envelope is stamped on "
+            "send and is not listed per kind."
+        )
+        lines.append("")
+        lines.append("| kind | fields | emitted | handled | note |")
+        lines.append("|------|--------|---------|---------|------|")
+        emitted = sf.get("emitted", {})
+        handled = set(sf.get("handled", []))
+        notes = sf.get("notes", {})
+        for kind in sorted(set(emitted) | handled):
+            fields = ", ".join(
+                f"`{f}`" for f in emitted.get(kind, [])
+            ) or "—"
+            lines.append(
+                f"| `{kind}` | {fields} | "
+                f"{'yes' if kind in emitted else 'no'} | "
+                f"{'yes' if kind in handled else 'no'} | "
+                f"{notes.get(kind, '')} |"
             )
         lines.append("")
     err = canonical.get("transport_err_codes", {})
