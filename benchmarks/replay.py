@@ -29,6 +29,7 @@ from __future__ import annotations
 
 import asyncio
 import json
+import math
 import time
 from contextlib import aclosing
 from dataclasses import dataclass, field
@@ -42,6 +43,7 @@ from dynamo_tpu.runtime.context import Context, deadline_from_headers
 
 __all__ = [
     "synthesize_trace",
+    "synthesize_wave_trace",
     "load_trace",
     "replay_trace",
     "summarize",
@@ -74,6 +76,55 @@ def synthesize_trace(
                 "output_length": osl,
                 "hash_ids": hash_ids,
             }) + "\n")
+
+
+def synthesize_wave_trace(
+    path: str, *, duration_s: float = 12.0, base_rate: float = 12.0,
+    peak_rate: float = 40.0, spike_rate: float = 0.0,
+    spike_start_frac: float = 0.55, spike_dur_frac: float = 0.12,
+    block_size: int = 16, groups: int = 12, depth: int = 6,
+    osl: int = 8, seed: int = 0,
+) -> None:
+    """Diurnal wave + flash spike: a non-homogeneous Poisson trace for
+    the autoscaler scenarios. The rate follows one raised-cosine cycle
+    from ``base_rate`` up to ``peak_rate`` (peaking mid-trace — the
+    morning ramp and evening trough of a serving fleet compressed into
+    ``duration_s``), with an optional flash-crowd window adding
+    ``spike_rate`` on top for ``spike_dur_frac`` of the trace starting
+    at ``spike_start_frac``. Arrivals come from Lewis-Shedler thinning,
+    so inter-arrival statistics stay honestly Poisson at every instant.
+    Request shapes (radix prefix groups) match ``synthesize_trace``."""
+    rng = np.random.default_rng(seed)
+
+    def rate(t: float) -> float:
+        r = base_rate + (peak_rate - base_rate) * 0.5 * (
+            1.0 - math.cos(2.0 * math.pi * t / duration_s)
+        )
+        s0 = spike_start_frac * duration_s
+        if spike_rate > 0 and s0 <= t < s0 + spike_dur_frac * duration_s:
+            r += spike_rate
+        return r
+
+    rate_max = max(base_rate, peak_rate) + max(spike_rate, 0.0)
+    t = 0.0
+    i = 0
+    with open(path, "w") as f:
+        while True:
+            t += float(rng.exponential(1.0 / rate_max))
+            if t >= duration_s:
+                break
+            if rng.random() > rate(t) / rate_max:
+                continue  # thinned
+            g = int(rng.integers(0, groups))
+            keep = int(rng.integers(1, depth + 1))
+            hash_ids = [g * 1000 + d for d in range(keep)] + [10_000_000 + i]
+            f.write(json.dumps({
+                "timestamp": int(t * 1000),
+                "input_length": len(hash_ids) * block_size,
+                "output_length": osl,
+                "hash_ids": hash_ids,
+            }) + "\n")
+            i += 1
 
 
 def load_trace(path: str, block_size: int) -> list[dict]:

@@ -40,6 +40,7 @@ from dynamo_tpu.kv_router.protocols import RouterConfig
 from dynamo_tpu.kv_router.router import KvRouter
 from dynamo_tpu.kv_router.sharding import shards_from_env
 from dynamo_tpu.runtime import tracing
+from dynamo_tpu.runtime.context import TENANT_HEADER
 from dynamo_tpu.runtime.component import INSTANCE_ROOT, Instance
 from dynamo_tpu.runtime.faults import FAULTS
 from dynamo_tpu.runtime.metrics import MetricsRegistry
@@ -389,9 +390,18 @@ class EndpointPicker:
             # instance before fail-open kicks in — a constant cap would
             # route to a disallowed worker while healthy ones remain
             attempts = max(3, len(self._live_instance_ids()) + 1)
+            # tenant tag for cluster-level steering: an explicit body
+            # field wins, else the forwarded request headers (the GIE
+            # ext-proc sends them along). Absent tag = no steering.
+            tenant = (
+                body.get("tenant")
+                or (body.get("headers") or {}).get(TENANT_HEADER)
+                or None
+            )
             for _attempt in range(attempts):
                 worker_id, overlap = self.kv.find_best_match(
-                    rid, list(token_ids), exclude=excluded or None
+                    rid, list(token_ids), exclude=excluded or None,
+                    tenant=tenant,
                 )
                 self.kv.free(rid)
                 if worker_id in excluded or self.breakers.allow(worker_id):

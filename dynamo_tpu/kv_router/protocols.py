@@ -143,3 +143,12 @@ class RouterConfig:
     # approx indexer
     approx_ttl_s: float = 120.0
     use_approx: bool = False
+    # cluster-level tenant steering (kv_router/steering.py): a hot
+    # tenant (> steer_hot_rate_per_s sustained picks/s) with more than
+    # steer_max_share of its recent picks on one worker gets that worker
+    # excluded (fail-open), spreading affinity instead of pinning.
+    # Only engages for requests that carry a tenant tag.
+    steer_enabled: bool = True
+    steer_half_life_s: float = 10.0
+    steer_hot_rate_per_s: float = 2.0
+    steer_max_share: float = 0.5

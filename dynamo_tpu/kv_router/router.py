@@ -33,7 +33,8 @@ from dynamo_tpu.kv_router.protocols import (
 )
 from dynamo_tpu.kv_router.scheduler import KvScheduler
 from dynamo_tpu.kv_router.sequence import ActiveSequencesMultiWorker
-from dynamo_tpu.runtime.context import Context
+from dynamo_tpu.kv_router.steering import SteeringConfig, TenantSteering
+from dynamo_tpu.runtime.context import TENANT_HEADER, Context
 from dynamo_tpu.runtime.hub import Hub
 from dynamo_tpu.runtime.metrics import MetricsRegistry, register_registry
 
@@ -87,6 +88,16 @@ class KvRouter:
         # amortized prefix hashing: repeated preambles skip the
         # O(tokens) chained rehash (DYN_ROUTER_HASH_CACHE bounds it)
         self.hasher = PrefixHashCache.from_env()
+        # cluster-level tenant steering (only consulted for tenant-
+        # tagged picks; untagged traffic keeps the oracle-identical path)
+        self.steering = (
+            TenantSteering(SteeringConfig(
+                half_life_s=self.config.steer_half_life_s,
+                hot_rate_per_s=self.config.steer_hot_rate_per_s,
+                max_share=self.config.steer_max_share,
+            ))
+            if self.config.steer_enabled else None
+        )
         # per-phase attribution (seconds + picks), the in-process
         # counterpart of the dynamo_router_pick_seconds histogram —
         # benches read deltas of this without scraping /metrics
@@ -214,6 +225,8 @@ class KvRouter:
         for gone in self.tree.workers() - live:
             self.tree.remove_worker(gone)
             self.approx.remove_worker(gone)
+            if self.steering is not None:
+                self.steering.forget_worker(gone)
         self.scheduler.update_workers(worker_ids)
         self.sequences.update_workers(worker_ids)
 
@@ -222,6 +235,7 @@ class KvRouter:
     def find_best_match(
         self, request_id: str, token_ids: list[int], *,
         salt: str | None = None, exclude: "set[int] | None" = None,
+        tenant: str | None = None,
     ) -> tuple[int, int]:
         """Pick a worker for ``token_ids``; returns (worker_id, overlap_blocks).
 
@@ -233,6 +247,11 @@ class KvRouter:
         unless that would leave NO candidates, in which case the
         exclusion is ignored (fail open: a fully-browned-out pool still
         routes rather than blackholing).
+
+        ``tenant``: tenancy tag for cluster-level steering — a hot
+        tenant concentrated on one worker gets that worker added to the
+        exclusions (same fail-open semantics) so affinity spreads
+        instead of pinning. None (untagged) never consults steering.
         """
         bs = self.config.block_size
         # rare O(instances) prediction sweep (time-bounded, NOT
@@ -259,11 +278,17 @@ class KvRouter:
         # is updated incrementally at sequence lifecycle points
         # (_push_predicted below), so the pick never pays an
         # O(instances) prediction sweep.
+        if tenant is not None and self.steering is not None:
+            steered = self.steering.exclusions(tenant)
+            if steered:
+                exclude = (set(exclude) | steered) if exclude else steered
         t2 = time.perf_counter()
         worker_id, overlap = self.scheduler.schedule(
             request_blocks, overlaps, exclude=exclude
         )
         t3 = time.perf_counter()
+        if tenant is not None and self.steering is not None:
+            self.steering.record(tenant, worker_id)
         self.sequences.add_request(
             request_id,
             worker_id,
@@ -404,8 +429,12 @@ class KvPushRouter:
             worker_id = pinned
             overlap = int(request.get("estimated_prefix_hit_num_blocks") or 0)
         else:
+            # tenant-tagged traffic engages cluster-level steering; the
+            # header is only present when a frontend/client set it, so
+            # untagged callers keep the oracle-identical pick path
+            tenant = (context.headers or {}).get(TENANT_HEADER) or None
             worker_id, overlap = self.kv_router.find_best_match(
-                context.id, token_ids, salt=req_salt
+                context.id, token_ids, salt=req_salt, tenant=tenant
             )
         request = dict(request)
         request["estimated_prefix_hit_num_blocks"] = overlap
@@ -425,14 +454,14 @@ class KvPushRouter:
 
     def best_worker_id(
         self, token_ids: list[int], request_id: str = "probe",
-        *, salt: str | None = None,
+        *, salt: str | None = None, tenant: str | None = None,
     ) -> tuple[int, int]:
         """Routing decision without dispatch (standalone router service
         API). ``salt``: per-request cache-partition salt (multimodal
         image digest) — must match the engine's block hashing or the
         overlap estimate is systematically wrong for image traffic."""
         wid, overlap = self.kv_router.find_best_match(
-            request_id, token_ids, salt=salt or self.salt
+            request_id, token_ids, salt=salt or self.salt, tenant=tenant
         )
         self.kv_router.free(request_id)
         return wid, overlap

@@ -116,6 +116,10 @@ class KubectlBackend:
         self._watch_task: asyncio.Task | None = None
         self._watch_proc: asyncio.subprocess.Process | None = None
         self._on_change = None
+        # multihost services seen by scale(): service -> hosts per group.
+        # The deployment watch stream can't observe Indexed Jobs, so
+        # running() takes the job-query path for these.
+        self._multihost: dict[str, int] = {}
 
     async def start_watch(self, on_change) -> None:
         """Informer-style observation: ONE long-lived
@@ -202,6 +206,25 @@ class KubectlBackend:
             delay = min(delay * 2, 30.0)
 
     def running(self, service: str) -> int:
+        hosts = self._multihost.get(service, 0)
+        if hosts > 1:
+            # one "replica" = one fully-ready group: count Jobs whose
+            # ready pods reach the group size
+            from dynamo_tpu.operator.manifests import (
+                GRAPH_LABEL, SERVICE_LABEL,
+            )
+
+            out = subprocess.run(
+                ["kubectl", "-n", self.namespace, "get", "jobs",
+                 "-l", f"{SERVICE_LABEL}={service},{GRAPH_LABEL}={self.graph}",
+                 "-o", "jsonpath={range .items[*]}{.status.ready}"
+                 "{\"\\n\"}{end}"],
+                capture_output=True, text=True,
+            )
+            return sum(
+                1 for tok in out.stdout.split()
+                if tok.isdigit() and int(tok) >= hosts
+            )
         if self._observed is not None:
             # watch mode: cache read, no subprocess. A deployment deleted
             # during a watch-stream gap may linger until the stream's
@@ -227,6 +250,19 @@ class KubectlBackend:
         return await asyncio.to_thread(subprocess.run, argv, **kw)
 
     async def scale(self, spec: ServiceSpec, replicas: int) -> None:
+        if spec.hosts > 1:
+            if not self.image:
+                # scale-only mode can't patch Indexed Jobs (completions
+                # are immutable); multihost requires managed mode
+                log.warning(
+                    "operator: cannot scale multihost service %r without "
+                    "an image (managed mode required)", spec.name,
+                )
+                return
+            self._multihost[spec.name] = spec.hosts
+            await self._scale_multihost(spec, replicas)
+            return
+        self._multihost.pop(spec.name, None)
         if self.image:
             import json
 
@@ -258,6 +294,67 @@ class KubectlBackend:
             check=False,
         )
 
+    async def _scale_multihost(self, spec: ServiceSpec, replicas: int) -> None:
+        """Converge the Indexed Job groups of a ``hosts > 1`` service.
+
+        ``apply`` covers create and replica growth, but Job pod templates
+        are immutable — a command/env/image change makes apply fail, and
+        the roll is an explicit delete + re-apply of the service's
+        groups (pods restart; the SPMD group must re-form anyway).
+        Scale-down GC deletes groups with index >= replicas by their
+        HOST_INDEX_LABEL, most-recent group names first being irrelevant
+        here: group identity is the index, so the highest indices go.
+        """
+        import json
+
+        from dynamo_tpu.operator.manifests import (
+            GRAPH_LABEL, HOST_INDEX_LABEL, SERVICE_LABEL,
+            multihost_group_name, render_multihost_bundle,
+        )
+
+        bundle = render_multihost_bundle(
+            spec, replicas, graph=self.graph, namespace=self.namespace,
+            image=self.image, hub=self.hub,
+            name_format=self.name_format, python=self.python,
+        )
+        sel = f"{SERVICE_LABEL}={spec.name},{GRAPH_LABEL}={self.graph}"
+        out = await self._kubectl(
+            ["kubectl", "-n", self.namespace, "apply", "-f", "-"],
+            input=json.dumps(bundle), text=True, check=False,
+            capture_output=True,
+        )
+        if out.returncode != 0 and "immutable" in (out.stderr or ""):
+            log.info("operator: rolling multihost service %r "
+                     "(job template changed)", spec.name)
+            await self._kubectl(
+                ["kubectl", "-n", self.namespace, "delete", "jobs",
+                 "-l", sel, "--ignore-not-found"],
+                check=False,
+            )
+            await self._kubectl(
+                ["kubectl", "-n", self.namespace, "apply", "-f", "-"],
+                input=json.dumps(bundle), text=True, check=False,
+            )
+        # GC groups beyond the desired replica count (apply never prunes)
+        out = await self._kubectl(
+            ["kubectl", "-n", self.namespace, "get", "jobs", "-l", sel,
+             "-o", "jsonpath={range .items[*]}"
+             f"{{.metadata.labels['{HOST_INDEX_LABEL}']}}{{\"\\n\"}}{{end}}"],
+            capture_output=True, text=True, check=False,
+        )
+        for tok in out.stdout.split():
+            if tok.isdigit() and int(tok) >= replicas:
+                name = multihost_group_name(
+                    spec.name, int(tok), self.name_format
+                )
+                log.info("operator: GC multihost group %r", name)
+                for kind in ("job", "service"):
+                    await self._kubectl(
+                        ["kubectl", "-n", self.namespace, "delete", kind,
+                         name, "--ignore-not-found"],
+                        check=False,
+                    )
+
     async def delete(self, spec: ServiceSpec) -> None:
         """Remove a service's objects (it left the graph resource).
         The Service is deleted unconditionally (--ignore-not-found):
@@ -270,6 +367,22 @@ class KubectlBackend:
                  "--ignore-not-found"],
                 check=False,
             )
+        # multihost groups (Indexed Jobs + headless Services) carry the
+        # service label — sweep them by selector; matches nothing for
+        # single-host services
+        self._multihost.pop(spec.name, None)
+        if self.image:
+            from dynamo_tpu.operator.manifests import (
+                GRAPH_LABEL, SERVICE_LABEL,
+            )
+
+            sel = f"{SERVICE_LABEL}={spec.name},{GRAPH_LABEL}={self.graph}"
+            for kind in ("jobs", "services"):
+                await self._kubectl(
+                    ["kubectl", "-n", self.namespace, "delete", kind,
+                     "-l", sel, "--ignore-not-found"],
+                    check=False,
+                )
 
     async def prune(self, current_services: set[str]) -> None:
         """Delete graph-labeled objects whose service left the resource
@@ -280,14 +393,18 @@ class KubectlBackend:
             return
         from dynamo_tpu.operator.manifests import GRAPH_LABEL, SERVICE_LABEL
 
-        out = await self._kubectl(
-            ["kubectl", "-n", self.namespace, "get", "deployments",
-             "-l", f"{GRAPH_LABEL}={self.graph}",
-             "-o", f"jsonpath={{range .items[*]}}"
-             f"{{.metadata.labels.{SERVICE_LABEL}}}{{\"\\n\"}}{{end}}"],
-            capture_output=True, text=True,
-        )
-        for svc_name in out.stdout.split():
+        found: set[str] = set()
+        # multihost groups live as Jobs, not Deployments — sweep both
+        for kind in ("deployments", "jobs"):
+            out = await self._kubectl(
+                ["kubectl", "-n", self.namespace, "get", kind,
+                 "-l", f"{GRAPH_LABEL}={self.graph}",
+                 "-o", f"jsonpath={{range .items[*]}}"
+                 f"{{.metadata.labels.{SERVICE_LABEL}}}{{\"\\n\"}}{{end}}"],
+                capture_output=True, text=True,
+            )
+            found.update(out.stdout.split())
+        for svc_name in sorted(found):
             if svc_name and svc_name not in current_services:
                 log.info("operator: pruning orphaned service %r", svc_name)
                 await self.delete(ServiceSpec(

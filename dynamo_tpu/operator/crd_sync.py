@@ -49,6 +49,7 @@ def services_from_crd(spec: dict) -> list[ServiceSpec]:
             role=svc.get("role", ""),
             port=int(svc.get("port", 0)),
             env={**base_env, **(svc.get("env") or {})},
+            hosts=int(svc.get("hosts", 1)),
         ))
     return out
 

@@ -34,6 +34,13 @@ class ServiceSpec:
     # + ClusterIP Service; env vars are injected into the container
     port: int = 0
     env: dict[str, str] = field(default_factory=dict)
+    # multihost (hosts > 1): ONE logical worker spanning N host pods —
+    # rendered as an Indexed Job + headless coordinator Service instead
+    # of a Deployment (deploy/k8s/worker-multihost.yaml is the golden
+    # shape); each replica is its own Job. ProcessBackend treats the
+    # service as single-host (the worker's --num-processes flag governs
+    # local multi-process runs).
+    hosts: int = 1
 
 
 @dataclass

@@ -20,10 +20,26 @@ from dynamo_tpu.operator.graph import ServiceSpec
 
 GRAPH_LABEL = "dynamo-graph"
 SERVICE_LABEL = "dynamo-service"
+# multihost: per-replica group index, stamped on the Job + headless
+# Service so scale-down / prune can GC groups by label
+HOST_INDEX_LABEL = "dynamo-host-index"
+# jax.distributed coordinator port on pod 0 of every multihost group
+# (deploy/k8s/worker-multihost.yaml)
+COORDINATOR_PORT = 9876
 
 
 def deployment_name(svc_name: str, name_format: str = "dynamo-{service}") -> str:
     return name_format.format(service=svc_name)
+
+
+def multihost_group_name(
+    svc_name: str, index: int, name_format: str = "dynamo-{service}"
+) -> str:
+    """Name of one multihost replica group (Indexed Job + headless
+    Service). Each replica of a ``hosts > 1`` service is its own group:
+    the coordinator DNS name is derived from the group name, so groups
+    cannot share a Job."""
+    return f"{deployment_name(svc_name, name_format)}-{index}"
 
 
 def deployment_manifest(
@@ -102,6 +118,127 @@ def service_manifest(
     }
 
 
+def multihost_manifests(
+    svc: ServiceSpec,
+    index: int,
+    *,
+    graph: str,
+    namespace: str,
+    image: str,
+    hub: str,
+    name_format: str = "dynamo-{service}",
+    python: str = "python",
+) -> list[dict[str, Any]]:
+    """One multihost replica group: headless coordinator Service +
+    Indexed Job spanning ``svc.hosts`` pods.
+
+    Mirrors deploy/k8s/worker-multihost.yaml (the golden shape, asserted
+    in tests/test_operator.py): pod 0 is the SPMD leader, the headless
+    Service gives it the stable DNS name ``{group}-0.{group}`` the
+    jax.distributed coordinator needs, and JOB_COMPLETION_INDEX (via the
+    downward-API annotation) becomes ``--process-id``. Multihost flags
+    are appended to the spec's own command so graph authors write the
+    same argv they would for a single-host worker.
+    """
+    base = deployment_name(svc.name, name_format)
+    name = multihost_group_name(svc.name, index, name_format)
+    labels = {
+        "app": base,  # shared across groups: a port Service (or operator
+        # queries) can still select every pod of the service
+        GRAPH_LABEL: graph,
+        SERVICE_LABEL: svc.name,
+        HOST_INDEX_LABEL: str(index),
+    }
+    if svc.role:
+        labels["dynamo-role"] = svc.role
+    coordinator = f"{name}-0.{name}:{COORDINATOR_PORT}"
+    env = [{"name": "DYNAMO_HUB", "value": hub}]
+    env += [{"name": k, "value": v} for k, v in sorted(svc.env.items())]
+    env.append({
+        "name": "JOB_COMPLETION_INDEX",
+        "valueFrom": {"fieldRef": {
+            "fieldPath":
+                "metadata.annotations"
+                "['batch.kubernetes.io/job-completion-index']",
+        }},
+    })
+    container: dict[str, Any] = {
+        "name": "worker",
+        "image": image,
+        "command": [
+            python, *svc.command,
+            "--coordinator-address", coordinator,
+            "--num-processes", str(svc.hosts),
+            # $(VAR) is expanded by the kubelet from the container env
+            "--process-id", "$(JOB_COMPLETION_INDEX)",
+        ],
+        "env": env,
+    }
+    if svc.port:
+        container["ports"] = [{"containerPort": svc.port}]
+    headless: dict[str, Any] = {
+        "apiVersion": "v1",
+        "kind": "Service",
+        "metadata": {"name": name, "namespace": namespace,
+                     "labels": dict(labels)},
+        "spec": {
+            "clusterIP": "None",  # headless: per-pod DNS for the coordinator
+            "selector": {"job-name": name},
+            "ports": [{"name": "coordinator", "port": COORDINATOR_PORT}],
+        },
+    }
+    job: dict[str, Any] = {
+        "apiVersion": "batch/v1",
+        "kind": "Job",
+        "metadata": {"name": name, "namespace": namespace,
+                     "labels": dict(labels)},
+        "spec": {
+            "completions": svc.hosts,
+            "parallelism": svc.hosts,
+            "completionMode": "Indexed",
+            "template": {
+                "metadata": {"labels": {**labels, "job-name": name}},
+                "spec": {
+                    "subdomain": name,  # pods resolvable via the headless svc
+                    "restartPolicy": "Never",
+                    "containers": [container],
+                },
+            },
+        },
+    }
+    return [headless, job]
+
+
+def render_multihost_bundle(
+    svc: ServiceSpec,
+    replicas: int,
+    *,
+    graph: str,
+    namespace: str,
+    image: str,
+    hub: str,
+    name_format: str = "dynamo-{service}",
+    python: str = "python",
+) -> dict[str, Any]:
+    """All replica groups of a multihost service as one ``v1 List``.
+    Scale-down GC (groups with index >= replicas) is the backend's job —
+    apply does not prune."""
+    items: list[dict[str, Any]] = []
+    for i in range(replicas):
+        items.extend(multihost_manifests(
+            svc, i, graph=graph, namespace=namespace, image=image,
+            hub=hub, name_format=name_format, python=python,
+        ))
+    if svc.port:
+        items.append(
+            service_manifest(
+                svc, graph=graph, namespace=namespace,
+                name_format=name_format,
+            )
+        )
+    return {"apiVersion": "v1", "kind": "List", "items": items}
+
+
 def render_bundle(
     svc: ServiceSpec,
     replicas: int,
@@ -114,7 +251,14 @@ def render_bundle(
     python: str = "python",
 ) -> dict[str, Any]:
     """Everything one service needs, as a single ``v1 List`` document
-    (what ``kubectl apply -f -`` consumes in one pass)."""
+    (what ``kubectl apply -f -`` consumes in one pass). Multihost
+    services (``hosts > 1``) render as Indexed Job groups instead of a
+    Deployment."""
+    if svc.hosts > 1:
+        return render_multihost_bundle(
+            svc, replicas, graph=graph, namespace=namespace, image=image,
+            hub=hub, name_format=name_format, python=python,
+        )
     items: list[dict[str, Any]] = [
         deployment_manifest(
             svc, replicas, graph=graph, namespace=namespace, image=image,

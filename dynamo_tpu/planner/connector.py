@@ -11,6 +11,7 @@ watches that key and converges actual to desired.
 
 from __future__ import annotations
 
+import asyncio
 import logging
 import time
 from dataclasses import asdict, dataclass
@@ -137,13 +138,30 @@ class ProcessConnector:
 
     async def set_replicas(self, desired: DesiredReplicas) -> None:
         self.history.append(desired)
+        retiring: list = []
         for role, want in (("prefill", desired.prefill),
                            ("decode", desired.decode)):
             pool = self._workers[role]
             while len(pool) < want:
                 pool.append(await self._spawn(role, len(pool)))
             while len(pool) > max(want, 0):
-                engine, served = pool.pop()
+                retiring.append(pool.pop())
+        if retiring:
+            # scale-down ordering (pick-during-scale-down race): withdraw
+            # EVERY retiring instance key before any worker dies, so a
+            # router that picked off its not-yet-updated watch copy still
+            # lands on a live handler. served.shutdown's withdraw grace
+            # covers the propagation window; the idle-wait below covers
+            # streams admitted inside it. Only then is the engine closed.
+            await asyncio.gather(
+                *(self.drt.hub.delete(served.instance.path)
+                  for _e, served in retiring)
+            )
+            for engine, served in retiring:
+                deadline = asyncio.get_running_loop().time() + 30.0
+                while (getattr(engine, "_running", 0) > 0
+                       and asyncio.get_running_loop().time() < deadline):
+                    await asyncio.sleep(0.005)
                 await served.shutdown(drain=True)
                 close = getattr(engine, "close", None)
                 if close is not None:

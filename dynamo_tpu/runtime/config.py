@@ -62,6 +62,13 @@ class RuntimeConfig:
     # in-flight requests finish before force-cancelling and exiting; keep
     # terminationGracePeriodSeconds comfortably above this
     drain_timeout_s: float = 30.0
+    # per-endpoint withdrawal grace (DYN_WITHDRAW_GRACE_S): after the
+    # instance key is deleted, the handler keeps serving this long so a
+    # router that picked inside the watch-propagation window still lands
+    # on a live worker instead of a corpse (scale-down drain contract).
+    # Default covers in-process/LAN watch propagation; raise it on
+    # clusters where router watch fan-out takes longer than this.
+    withdraw_grace_s: float = 0.01
 
     # http frontend
     http_port: int = 8000

@@ -183,8 +183,30 @@ class DistributedRuntime:
             (DistributedRuntime._instance_seq & 0xFF) << 8
         ) | random.randrange(256)
 
-    async def deregister_endpoint(self, served: ServedEndpoint, drain: bool = True) -> None:
+    async def deregister_endpoint(
+        self,
+        served: ServedEndpoint,
+        drain: bool = True,
+        grace_s: float | None = None,
+    ) -> None:
+        """Withdraw an instance: hub key first, handler last.
+
+        The ordering is the scale-down drain contract (ISSUE 17 ride-along):
+        routers route from a WATCHED copy of the instance set, so there is a
+        propagation window between the hub delete and every router observing
+        it. A pick made inside that window must still land on a live handler
+        — so with ``drain=True`` the wire-path handler stays registered for
+        ``grace_s`` after the key withdrawal (racing dispatches are served),
+        and only then is torn down. ``grace_s=None`` uses the runtime's
+        ``withdraw_grace_s``; mass teardown (``shutdown``) passes 0 because
+        the server-level drain already covers in-flight streams and the
+        whole process is exiting anyway.
+        """
         await self.hub.delete(served.instance.path)
+        if drain:
+            g = self.config.withdraw_grace_s if grace_s is None else grace_s
+            if g > 0:
+                await asyncio.sleep(g)
         if served.instance.transport == "local":
             self.local_registry.unregister(served.instance.wire_path)
         elif self._server is not None:
@@ -201,7 +223,7 @@ class DistributedRuntime:
             return
         self._closed = True
         for served in list(self._served):
-            await self.deregister_endpoint(served, drain=drain)
+            await self.deregister_endpoint(served, drain=drain, grace_s=0.0)
         if self._server is not None:
             await self._server.stop(drain=drain, timeout=drain_timeout)
         if self._keepalive_task is not None:

@@ -74,6 +74,23 @@ class SimConfig:
     # max(slo_ttft_factor * uncontended p50, slo_ttft_floor_s)
     slo_ttft_factor: float = 4.0
     slo_ttft_floor_s: float = 0.25
+    # autoscale scenario: diurnal wave + flash spike against the closed
+    # autoscaler loop. The scenario builds its OWN small fleets (slow
+    # engines so concurrency is visible demand), so these knobs are
+    # independent of the churn-scale ones above.
+    autoscale_duration_s: float = 12.0
+    autoscale_base_rate: float = 12.0  # wall req/s at the trough
+    autoscale_peak_rate: float = 40.0  # diurnal crest
+    autoscale_spike_factor: float = 10.0  # flash spike = factor * base
+    autoscale_tick_s: float = 0.3  # controller cadence (wall)
+    autoscale_lead_ticks: int = 3  # predictive pass forecast horizon
+    autoscale_start_workers: int = 2
+    autoscale_max_workers: int = 24
+    autoscale_slots: int = 2  # decode slots per worker
+    autoscale_speedup: float = 5.0
+    autoscale_osl: int = 40
+    autoscale_slo_ttft_s: float = 0.75  # wall TTFT p99 bar
+    autoscale_compare: bool = True  # also run the reactive baseline
     data_dir: str | None = None  # replica WALs; None = tempdir
 
     def trace_n(self) -> int:
@@ -134,7 +151,25 @@ class SimWorker:
             await self.events.close()
         if self.metrics is not None:
             await self.metrics.close()
-        await self.fleet.drt.deregister_endpoint(self.served)
+        # drain=False: a crash does not get the withdraw grace — the
+        # handler vanishes with the key, exactly like a dead process
+        await self.fleet.drt.deregister_endpoint(self.served, drain=False)
+
+    async def drain(self, timeout_s: float = 10.0) -> None:
+        """SIGTERM-shaped scale-down: withdraw the instance key FIRST
+        (routers stop picking; racing picks still land on the live
+        handler through the withdraw grace), then wait for in-flight
+        streams to finish before tearing the worker down — the sim twin
+        of the worker drain contract (zero client-visible errors)."""
+        await self.fleet.drt.deregister_endpoint(self.served, drain=True)
+        deadline = time.monotonic() + timeout_s
+        while self.engine._running > 0 and time.monotonic() < deadline:
+            await asyncio.sleep(0.005)
+        self.alive = False
+        if self.events is not None:
+            await self.events.close()
+        if self.metrics is not None:
+            await self.metrics.close()
 
 
 class MockFleet:

@@ -776,6 +776,27 @@ async def tenant_storm(cfg: SimConfig) -> dict:
         )
         cont_sum = contended.summary()
         flood_sum = flood.summary()
+
+        # cluster-level tenant steering: replay decision-only picks for
+        # a prompt with TOTAL prefix affinity (one warm radix group, so
+        # overlap-argmax wants exactly one worker). Untagged picks must
+        # pin that worker (the falsifiable control — steering never
+        # touches the untenanted path); the same picks tagged as a hot
+        # tenant must spread across several workers.
+        kv = fleet.kv_router
+        hot_toks = int_trace[0]["token_ids"]
+        pinned: set[int] = set()
+        steered: set[int] = set()
+        for i in range(64):
+            wid, _ = kv.find_best_match(f"pin-{i}", hot_toks)
+            kv.free(f"pin-{i}")
+            pinned.add(wid)
+        for i in range(64):
+            wid, _ = kv.find_best_match(
+                f"hot-{i}", hot_toks, tenant="hot-tenant"
+            )
+            kv.free(f"hot-{i}")
+            steered.add(wid)
     finally:
         await fleet.close()
     slo_s = max(
@@ -811,6 +832,14 @@ async def tenant_storm(cfg: SimConfig) -> dict:
                 baseline_ttft_ms_p50=base_sum["ttft_ms_p50"],
                 flood_errors=len(flood.errors),
             ),
+            # cluster-level steering: the hot tenant spreads across
+            # workers while untagged picks (the control) stay pinned to
+            # the affinity winner
+            "hot_tenant_spreads": _inv(
+                len(pinned) == 1 and len(steered) >= 2,
+                pinned_workers=len(pinned),
+                steered_workers=len(steered),
+            ),
         },
     }
 
@@ -836,6 +865,204 @@ async def telemetry(cfg: SimConfig) -> dict:
     }
 
 
+# -- autoscale ---------------------------------------------------------------
+
+
+def _autoscale_config(cfg: SimConfig, *, lead_ticks: int) -> "AutoscalerConfig":
+    from dynamo_tpu.autoscaler import AutoscalerConfig
+
+    tick = cfg.autoscale_tick_s
+    return AutoscalerConfig(
+        slots_per_worker=cfg.autoscale_slots,
+        target_occupancy=0.75,
+        min_workers=cfg.autoscale_start_workers,
+        max_workers=cfg.autoscale_max_workers,
+        scale_up_at=0.85,
+        scale_down_at=0.5,
+        up_cooldown_s=1.5 * tick,
+        down_cooldown_s=8.0 * tick,
+        max_step_up=4,
+        max_step_down=2,
+        predict_ahead_ticks=lead_ticks,
+        predictor="holt",
+        tick_interval_s=tick,
+    )
+
+
+async def _autoscale_pass(
+    cfg: SimConfig, trace: list[dict], *, lead_ticks: int, tag: str
+) -> dict:
+    """One full closed loop over the wave trace: small slow fleet, live
+    hub-fed telemetry, the real control law, SimBackend actuation.
+    Returns the replay summary + per-tick capacity accounting."""
+    import dataclasses
+
+    from dynamo_tpu.autoscaler import (
+        AutoscaleController,
+        FleetTelemetry,
+        SimBackend,
+    )
+
+    fcfg = dataclasses.replace(
+        cfg,
+        workers=cfg.autoscale_start_workers,
+        speedup=cfg.autoscale_speedup,
+        max_batch_size=cfg.autoscale_slots,
+    )
+    tick = cfg.autoscale_tick_s
+    fleet = await MockFleet(fcfg, fcfg.workers).start()
+    backend = SimBackend(fleet)
+    tel = FleetTelemetry(
+        fleet.hub, f"{NS}/{COMP}", stale_after_s=max(1.0, 4 * tick)
+    ).start()
+    ctrl = AutoscaleController(
+        _autoscale_config(cfg, lead_ticks=lead_ticks), tel, backend,
+        initial_workers=cfg.autoscale_start_workers,
+    )
+    samples: list[tuple[float, int]] = []  # (demand, alive workers)
+    stop = asyncio.Event()
+
+    async def drive():
+        while not stop.is_set():
+            await ctrl.tick()
+            samples.append(
+                (tel.signal().demand, len(fleet.alive_workers()))
+            )
+            await asyncio.sleep(tick)
+
+    try:
+        engine = await fleet.client_path(migration=True)
+        mig0 = migrations_snapshot()
+        driver = asyncio.ensure_future(drive())
+        res = await replay_trace(engine.generate, trace, id_prefix=tag)
+        # tail: keep the loop ticking past the trough so the down-
+        # cooldown expires and scale-down actually happens in-scenario
+        await asyncio.sleep(12 * tick)
+        stop.set()
+        await driver
+        migrations = migrations_snapshot() - mig0
+    finally:
+        await ctrl.close()
+        await tel.close()
+        await fleet.close()
+
+    slots = cfg.autoscale_slots
+    deficit = sum(
+        max(0.0, d - w * slots) * tick for d, w in samples
+    )
+    peak_demand = max((d for d, _ in samples), default=0.0)
+    report = ctrl.report()
+    return {
+        **res.summary(),
+        "migrations": migrations,
+        "peak_demand": round(peak_demand, 1),
+        "deficit_area": round(deficit, 2),
+        "max_workers_seen": max((w for _, w in samples), default=0),
+        "final_workers": report["final"]["workers"],
+        "spawned": backend.spawned,
+        "drained": backend.drained,
+        "errors_detail": res.errors[:5],
+        "autoscaler": report,
+    }
+
+
+async def autoscale(cfg: SimConfig) -> dict:
+    """The closed-loop SLA autoscaler under a diurnal wave + 10x flash
+    spike, actuated in the live sim fleet (SimBackend spawn/drain over
+    the real runtime). Acceptance (ISSUE 17): interactive TTFT p99
+    within SLO on the predictive pass, ZERO client-visible errors while
+    replicas scale down through the drain contract, plans converge
+    within bounded ticks, over-provisioning bounded, and the predictive
+    pre-scaler measurably beats the reactive baseline on capacity
+    deficit (the queue the fleet was short, integrated over time)."""
+    import math as _math
+
+    wave_path = _tmpdir(cfg, "autoscale") / "wave.jsonl"
+    from benchmarks.replay import synthesize_wave_trace
+
+    synthesize_wave_trace(
+        str(wave_path),
+        duration_s=cfg.autoscale_duration_s,
+        base_rate=cfg.autoscale_base_rate,
+        peak_rate=cfg.autoscale_peak_rate,
+        spike_rate=cfg.autoscale_spike_factor * cfg.autoscale_base_rate,
+        block_size=cfg.block_size,
+        osl=cfg.autoscale_osl,
+        seed=cfg.seed,
+    )
+    trace = load_trace(str(wave_path), cfg.block_size)
+
+    predictive = await _autoscale_pass(
+        cfg, trace, lead_ticks=cfg.autoscale_lead_ticks, tag="as-pred"
+    )
+    reactive = None
+    if cfg.autoscale_compare:
+        reactive = await _autoscale_pass(
+            cfg, trace, lead_ticks=0, tag="as-react"
+        )
+
+    acfg = _autoscale_config(cfg, lead_ticks=cfg.autoscale_lead_ticks)
+    needed_peak = _math.ceil(
+        predictive["peak_demand"]
+        / (cfg.autoscale_slots * acfg.target_occupancy)
+    )
+    slo_ms = cfg.autoscale_slo_ttft_s * 1e3
+    invariants = {
+        "ttft_slo_held": _inv(
+            (predictive["ttft_ms_p99"] or 0.0) <= slo_ms,
+            ttft_ms_p99=predictive["ttft_ms_p99"], slo_ms=slo_ms,
+        ),
+        "zero_client_errors_during_scaling": _inv(
+            predictive["errors"] == 0 and predictive["drained"] > 0,
+            errors=predictive["errors_detail"],
+            drained=predictive["drained"],
+        ),
+        "fleet_actually_scaled": _inv(
+            predictive["spawned"] > 0 and predictive["drained"] > 0,
+            spawned=predictive["spawned"], drained=predictive["drained"],
+        ),
+        "overprovisioning_bounded": _inv(
+            predictive["max_workers_seen"]
+            <= min(needed_peak + acfg.max_step_up, acfg.max_workers),
+            max_workers_seen=predictive["max_workers_seen"],
+            needed_at_peak=needed_peak,
+        ),
+        "convergence_bounded": _inv(
+            predictive["autoscaler"]["converge_ticks_max"] <= 3
+            and not predictive["autoscaler"]["unconverged"],
+            converge_ticks_max=(
+                predictive["autoscaler"]["converge_ticks_max"]
+            ),
+        ),
+    }
+    if reactive is not None:
+        # the margin: predictive's capacity deficit must be at most 70%
+        # of reactive's — unless predictive's own deficit is already
+        # below the control loop's resolution (one bounded step of
+        # capacity held for the pre-scale horizon). On a calm host the
+        # reactive pass can actuate fast enough to incur ~zero deficit;
+        # demanding a 30% win over noise turns the gate into a coin
+        # flip, while a predictive deficit under the noise floor means
+        # pre-scaling delivered everything the spike could ask of it.
+        noise_floor = (
+            acfg.max_step_up * cfg.autoscale_tick_s
+            * max(cfg.autoscale_lead_ticks, 1)
+        )
+        invariants["predictive_beats_reactive"] = _inv(
+            predictive["deficit_area"]
+            <= max(0.7 * reactive["deficit_area"], noise_floor),
+            predictive_deficit=predictive["deficit_area"],
+            reactive_deficit=reactive["deficit_area"],
+            noise_floor=round(noise_floor, 2),
+        )
+    return {
+        "trace_requests": len(trace),
+        "predictive": predictive,
+        "reactive": reactive,
+        "invariants": invariants,
+    }
+
+
 SCENARIOS = {
     "pick_scaling": pick_scaling,
     "leader_kill": leader_kill,
@@ -844,4 +1071,5 @@ SCENARIOS = {
     "breaker_storm": breaker_storm,
     "tenant_storm": tenant_storm,
     "telemetry_overhead": telemetry,
+    "autoscale": autoscale,
 }

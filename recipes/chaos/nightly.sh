@@ -25,6 +25,16 @@ DYN_FAULTS="" python -m dynamo_tpu.sim --scenario all \
   --seed "$DYN_FAULTS_SEED" \
   --out "${DYN_SIM_OUT:-SIM_nightly.json}"
 
+# closed-loop autoscaler proof: diurnal wave + 10x flash spike, the
+# predictive pre-scaling pass against a reactive baseline over the SAME
+# trace. Invariants — TTFT SLO held, zero client errors while scaling,
+# bounded overprovisioning and convergence, predictive beats reactive
+# on capacity-deficit area — gate via the sim's exit code; the artifact
+# is kept for trend review next to the committed AUTOSCALE_r01.json.
+DYN_FAULTS="" python -m dynamo_tpu.sim --scenario autoscale \
+  --seed "$DYN_FAULTS_SEED" \
+  --out "${DYN_AUTOSCALE_OUT:-AUTOSCALE_nightly.json}"
+
 # stream-plane war: full micro/golden/dial/replay/churn matrix with the
 # throughput + frames-per-token + bytes-reduction bars enforced via the
 # bench's own exit code (non-zero on any failed bar). Runs WITHOUT the

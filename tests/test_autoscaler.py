@@ -1,0 +1,387 @@
+"""Closed-loop SLA autoscaler (ISSUE 17): control law, telemetry,
+predictor, controller loop, tenant steering, and the tier-1 time-dilated
+sim smoke of plan -> actuate -> drain with zero client errors.
+
+The full diurnal/spike proof (predictive vs reactive over the same wave
+trace) is the nightly ``--scenario autoscale`` run and the committed
+AUTOSCALE_r01.json artifact; the smoke here runs the same scenario code
+path on a tiny fleet in a few seconds.
+"""
+
+import asyncio
+
+import pytest
+
+from dynamo_tpu.autoscaler import (
+    AutoscaleController,
+    AutoscalerConfig,
+    DemandSignal,
+    FleetTelemetry,
+    PlanEngine,
+)
+from dynamo_tpu.kv_router.protocols import ForwardPassMetrics
+from dynamo_tpu.kv_router.steering import SteeringConfig, TenantSteering
+
+# ---------------------------------------------------------- control law
+
+
+def _cfg(**over) -> AutoscalerConfig:
+    base = dict(
+        slots_per_worker=4, target_occupancy=0.75,
+        min_workers=1, max_workers=32,
+        scale_up_at=0.85, scale_down_at=0.5,
+        up_cooldown_s=10.0, down_cooldown_s=60.0,
+        max_step_up=4, max_step_down=2,
+    )
+    base.update(over)
+    return AutoscalerConfig(**base)
+
+
+def test_plan_engine_scales_up_bounded_and_clamped():
+    eng = PlanEngine(_cfg(), initial_workers=1)
+    # demand 100 wants ceil(100 / (4 * 0.75)) = 34 -> clamped to 32, but
+    # one plan moves at most max_step_up
+    plan = eng.plan(DemandSignal(demand=100.0), now=0.0)
+    assert plan is not None and plan.workers == 5  # 1 + 4
+    assert plan.revision == 1 and "workers 1->5" in plan.reason
+    # up-cooldown: an immediate retry holds
+    assert eng.plan(DemandSignal(demand=100.0), now=1.0) is None
+    # after the cooldown it steps again, still bounded
+    plan = eng.plan(DemandSignal(demand=100.0), now=11.0)
+    assert plan is not None and plan.workers == 9
+    # walk to the ceiling: never exceeds max_workers
+    t = 11.0
+    while True:
+        t += 10.0
+        p = eng.plan(DemandSignal(demand=1000.0), now=t)
+        if p is None:
+            break
+    assert eng.current()[0] == 32
+
+
+def test_plan_engine_hysteresis_dead_band_holds():
+    # 2 workers * 4 slots * 0.75 occupancy sizes for demand 6; util at
+    # demand 6 is 0.75 — inside the (0.5, 0.85) dead band from both sides
+    eng = PlanEngine(_cfg(), initial_workers=2)
+    for t in range(100):
+        assert eng.plan(DemandSignal(demand=6.0), now=float(t * 20)) is None
+    assert eng.current()[0] == 2
+
+
+def test_plan_engine_downscale_cooldown_and_recent_up_guard():
+    eng = PlanEngine(_cfg(), initial_workers=8)
+    # low demand, but a recent UPSCALE blocks removal for down_cooldown_s
+    plan = eng.plan(DemandSignal(demand=40.0), now=0.0)  # 8 -> 12
+    assert plan is not None and plan.workers == 12
+    assert eng.plan(DemandSignal(demand=1.0), now=30.0) is None
+    # past the down cooldown: bounded step down
+    plan = eng.plan(DemandSignal(demand=1.0), now=61.0)
+    assert plan is not None and plan.workers == 10
+    # and the down cooldown now applies to the NEXT removal
+    assert eng.plan(DemandSignal(demand=1.0), now=90.0) is None
+    plan = eng.plan(DemandSignal(demand=1.0), now=122.0)
+    assert plan is not None and plan.workers == 8
+
+
+def test_plan_engine_router_shards_track_planned_workers():
+    eng = PlanEngine(
+        _cfg(max_workers=64, workers_per_router_shard=8,
+             max_router_shards=8),
+        initial_workers=1,
+    )
+    t, shards_seen = 0.0, set()
+    for _ in range(30):
+        p = eng.plan(DemandSignal(demand=1000.0), now=t)
+        t += 20.0
+        if p is not None:
+            shards_seen.add(p.router_shards)
+    workers, _prefill, shards = eng.current()
+    assert workers == 64
+    # 64 workers / 8 per shard at 0.75 occupancy -> ceil(64/6) = 11 -> 8
+    assert shards == 8 and max(shards_seen) == 8
+
+
+def test_scaled_config_dilates_time_constants_only():
+    cfg = _cfg(up_cooldown_s=15.0, down_cooldown_s=120.0,
+               tick_interval_s=5.0)
+    s = cfg.scaled(10.0)
+    assert s.up_cooldown_s == 1.5 and s.down_cooldown_s == 12.0
+    assert s.tick_interval_s == 0.5
+    assert s.max_step_up == cfg.max_step_up
+    assert s.slots_per_worker == cfg.slots_per_worker
+
+
+# ------------------------------------------------------------- telemetry
+
+
+def test_fleet_telemetry_aggregates_and_expires_stale():
+    t = [0.0]
+    tel = FleetTelemetry(hub=None, component_path="ns/comp",
+                         stale_after_s=1.0, clock=lambda: t[0])
+    tel.ingest(ForwardPassMetrics(worker_id=1, running_requests=3,
+                                  waiting_requests=2,
+                                  prefill_tokens_queued=100))
+    tel.ingest(ForwardPassMetrics(worker_id=2, running_requests=1))
+    sig = tel.signal()
+    assert sig.demand == 6.0 and sig.prefill_queue_tokens == 100.0
+    assert sig.workers_observed == 2
+
+    # worker 2 goes quiet (drained/crashed); worker 1 keeps reporting
+    t[0] = 0.8
+    tel.ingest(ForwardPassMetrics(worker_id=1, running_requests=3,
+                                  waiting_requests=2))
+    t[0] = 1.5
+    sig = tel.signal()
+    assert sig.workers_observed == 1
+    assert sig.demand == 5.0  # the corpse's last report is not demand
+
+
+# ------------------------------------------------------------- predictor
+
+
+def test_predictors_forecast_ahead():
+    from dynamo_tpu.planner.predictor import make_predictor
+
+    # damped-trend Holt: on a clean ramp the k-ahead forecast leads the
+    # last observation — that lead is what pre-scales the diurnal rise
+    holt = make_predictor("holt", window_size=64)
+    for i in range(40):
+        holt.observe(10.0 + 2.0 * i)
+    last = 10.0 + 2.0 * 39
+    ahead = holt.predict_ahead(3)
+    assert ahead > last
+    assert ahead == pytest.approx(last + 3 * 2.0, rel=0.25)
+
+    # seasonal: after two full cycles the phase forecast tracks the
+    # cycle, not the global mean
+    period = 8
+    seasonal = make_predictor("seasonal", period=period)
+    wave = [float(10 + (50 if (i % period) == 4 else 0)) for i in range(48)]
+    for x in wave:
+        seasonal.observe(x)
+    # last observed index is 47 (phase 7); the spike phase (4) is 5
+    # steps ahead, the quiet phase 0 is next
+    assert seasonal.predict_ahead(5) == pytest.approx(60.0, abs=8.0)
+    assert seasonal.predict_ahead(1) == pytest.approx(10.0, abs=8.0)
+
+    ar = make_predictor("ar", window_size=64)
+    for i in range(40):
+        ar.observe(10.0 + 2.0 * i)
+    assert ar.predict_ahead(3) >= 0.0
+
+
+# ------------------------------------------------------------ controller
+
+
+class _FakeBackend:
+    """Synchronous actuator with a configurable convergence lag."""
+
+    def __init__(self, lag_ticks: int = 0):
+        self.lag = lag_ticks
+        self.applied: list[tuple[int, int, int]] = []
+        self._target = (1, 0, 1)
+        self._pending: list[tuple[int, int, int]] = []
+
+    async def apply(self, plan) -> None:
+        self.applied.append(plan.counts())
+        self._pending = [plan.counts()] * (self.lag + 1)
+
+    async def observed(self):
+        if self._pending:
+            self._target = self._pending.pop(0)
+        return self._target
+
+
+async def test_controller_plans_actuates_and_converges():
+    t = [0.0]
+    tel = FleetTelemetry(hub=None, component_path="ns/c",
+                         stale_after_s=1e9, clock=lambda: t[0])
+    cfg = _cfg(up_cooldown_s=0.0, down_cooldown_s=0.0,
+               predict_ahead_ticks=2, tick_interval_s=1.0)
+    be = _FakeBackend()
+    ctl = AutoscaleController(cfg, tel, be, initial_workers=1,
+                              clock=lambda: t[0])
+    for i in range(12):
+        tel.ingest(ForwardPassMetrics(
+            worker_id=1, running_requests=4 * (i + 1)))
+        await ctl.tick()
+        t[0] += 1.0
+    assert ctl.plans, "rising demand must emit plans"
+    assert be.applied and be.applied[-1][0] > 1
+    rep = ctl.report()
+    assert rep["plans"] == len(ctl.plans)
+    assert rep["final"]["workers"] == ctl.engine.current()[0]
+    assert rep["converge_ticks_max"] >= 1 and not rep["unconverged"]
+    # the predictor matured forecasts against observed demand
+    assert ctl.forecast_errors, "pre-scale forecasts must be scored"
+    assert rep["forecast_mae"] is not None
+
+
+# -------------------------------------------------------- tenant steering
+
+
+def test_tenant_steering_spreads_hot_tenant_and_forgets_workers():
+    t = [0.0]
+    st = TenantSteering(
+        SteeringConfig(half_life_s=10.0, hot_rate_per_s=2.0, max_share=0.5),
+        clock=lambda: t[0],
+    )
+    # cold tenant: a few picks on one worker, no steering
+    for _ in range(3):
+        st.record("cold", 7)
+    assert st.exclusions("cold") == set()
+    assert st.exclusions("unknown") == set()
+
+    # hot tenant pinned on worker 7: rate over the bar, share 100%
+    for _ in range(60):
+        st.record("hot", 7)
+    assert st.rate("hot") > 2.0
+    assert st.exclusions("hot") == {7}
+
+    # picks then spread: no worker over max_share -> no exclusions
+    for _ in range(60):
+        st.record("hot", 8)
+    for _ in range(60):
+        st.record("hot", 9)
+    assert st.exclusions("hot") == set()
+
+    # churn: a departed worker's credits vanish
+    st.forget_worker(9)
+    assert 9 not in st.snapshot().get("hot", {})
+
+    # decay: the tenant cools off and steering disengages
+    for _ in range(200):
+        st.record("spiky", 3)
+    assert st.exclusions("spiky") == {3}
+    t[0] += 120.0
+    assert st.exclusions("spiky") == set()
+
+
+def test_router_pick_tenant_tagged_spreads_untagged_unchanged():
+    """Tenant-tagged picks engage steering (a hot pinned tenant gets
+    spread); tenant=None never consults it — the temperature-0 pick
+    stays oracle-identical for untagged traffic (the parity property
+    test_kv_router.py asserts)."""
+    from dynamo_tpu.kv_router.protocols import RouterConfig
+    from dynamo_tpu.kv_router.router import KvRouter
+    from dynamo_tpu.runtime.hub import InMemoryHub
+
+    r = KvRouter(InMemoryHub(), "ns/comp",
+                 RouterConfig(block_size=4, steer_enabled=True))
+    r.update_workers([1, 2])
+    toks = list(range(16))
+    # hammer one tenant; steering must eventually mark its pinned
+    # worker excluded and the picks must spread to both workers
+    picked = set()
+    for i in range(100):
+        wid, _ = r.find_best_match(f"w{i}", toks, tenant="hot")
+        picked.add(wid)
+        r.free(f"w{i}")
+    assert r.steering is not None
+    assert picked == {1, 2}, "hot tenant must spread, not pin"
+    # the untagged pick path never consults steering
+    wid, _ = r.find_best_match("probe", toks)
+    assert wid in (1, 2)
+    r.free("probe")
+
+
+# ------------------------------------- scale-down race (bugfix ride-along)
+
+
+async def test_pick_during_scale_down_lands_on_live_handler():
+    """Regression for the scale-to-zero race: withdrawal deletes the hub
+    instance key FIRST, but routers pick from a watched copy — a pick
+    made inside the propagation window must still land on a live
+    handler. deregister_endpoint keeps the wire path registered for the
+    withdraw grace, so the racing dispatch is served instead of dying on
+    an unknown-path error."""
+    from dynamo_tpu.runtime.context import Context
+    from dynamo_tpu.runtime.distributed import DistributedRuntime
+    from dynamo_tpu.runtime.hub import InMemoryHub
+
+    async def handler(request, context):
+        yield {"ok": True}
+
+    drt = DistributedRuntime(InMemoryHub())
+    ep = drt.namespace("ns").component("comp").endpoint("generate")
+    served = await ep.serve(handler)
+    client = await ep.client().start()
+    insts = await client.wait_for_instances(1, timeout=5)
+    iid = insts[0].instance_id
+    stale_inst = client._instances[iid]
+
+    # scale-down starts: the key is withdrawn, then the handler drains
+    dereg = asyncio.get_running_loop().create_task(
+        drt.deregister_endpoint(served, drain=True, grace_s=0.5)
+    )
+    # wait for the hub delete (the moment a router COULD still pick the
+    # worker from its stale watched copy)
+    for _ in range(100):
+        if await drt.hub.get(served.instance.path) is None:
+            break
+        await asyncio.sleep(0.01)
+    assert await drt.hub.get(served.instance.path) is None
+
+    # the racing pick: a router whose watched copy hasn't caught up yet
+    # still holds the instance — its dispatch must land on the live
+    # handler, not die on an unknown wire path
+    client._instances[iid] = stale_inst
+    out = [
+        item
+        async for item in client.call_instance(iid, {}, Context())
+    ]
+    assert out == [{"ok": True}]
+    client._instances.pop(iid, None)
+
+    await dereg
+    # after the grace the handler really is gone — even a still-stale
+    # router now gets a hard error instead of a hung dispatch
+    client._instances[iid] = stale_inst
+    with pytest.raises(Exception):
+        async for _ in client.call_instance(iid, {}, Context()):
+            pass
+    await drt.close()
+
+
+# ------------------------------------------- tier-1 sim smoke (<= ~5 s)
+
+
+async def test_autoscale_sim_smoke(tmp_path):
+    """Time-dilated closed loop on a tiny fleet: the real scenario code
+    path (wave trace -> FleetTelemetry -> PlanEngine -> SimBackend
+    spawn/drain) with the compare pass disabled. Asserts the same
+    invariants the nightly diurnal run gates on: zero client-visible
+    errors while the fleet scales both ways, bounded over-provisioning,
+    bounded convergence."""
+    from dynamo_tpu.sim.harness import SimConfig, run_scenarios
+
+    cfg = SimConfig(
+        workers=2, speedup=30.0, block_size=8, worker_blocks=512,
+        seed=5, data_dir=str(tmp_path),
+        autoscale_duration_s=2.5,
+        autoscale_base_rate=8.0,
+        autoscale_peak_rate=40.0,
+        autoscale_spike_factor=4.0,
+        autoscale_tick_s=0.15,
+        autoscale_lead_ticks=2,
+        autoscale_start_workers=1,
+        autoscale_max_workers=10,
+        autoscale_slots=2,
+        autoscale_speedup=8.0,
+        autoscale_osl=16,
+        autoscale_slo_ttft_s=2.0,
+        autoscale_compare=False,
+    )
+    artifact = await run_scenarios(cfg, ["autoscale"])
+    sc = artifact["scenarios"]["autoscale"]
+    assert sc["verdict"] == "pass", sc
+    inv = sc["invariants"]
+    for name in (
+        "ttft_slo_held",
+        "zero_client_errors_during_scaling",
+        "fleet_actually_scaled",
+        "overprovisioning_bounded",
+        "convergence_bounded",
+    ):
+        assert inv[name]["pass"], (name, inv[name])
+    assert "predictive_beats_reactive" not in inv  # compare pass disabled

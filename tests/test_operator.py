@@ -606,6 +606,253 @@ def test_crd_sync_mirrors_spec_and_pushes_status(tmp_path, monkeypatch):
     asyncio.run(run())
 
 
+def test_helm_charts_match_kustomize_base():
+    """Helm packaging parity: the crds chart is byte-identical to
+    deploy/k8s/crd.yaml, and the platform chart rendered at DEFAULT
+    values reproduces every kustomize base document exactly — so the
+    two install paths can never drift. Renders with `helm template`
+    when the binary exists; otherwise substitutes the chart's
+    (deliberately minimal) values templating in pure Python."""
+    import pathlib
+    import re
+    import shutil
+
+    import yaml
+
+    root = pathlib.Path(__file__).resolve().parent.parent / "deploy"
+    helm_root = root / "helm"
+
+    for chart in ("crds", "platform"):
+        meta = yaml.safe_load((helm_root / chart / "Chart.yaml").read_text())
+        assert meta["apiVersion"] == "v2" and meta["name"], chart
+
+    # CRD chart: exact copy of the kustomize base CRD
+    assert (helm_root / "crds" / "templates" / "crd.yaml").read_text() == \
+        (root / "k8s" / "crd.yaml").read_text()
+
+    values = yaml.safe_load((helm_root / "platform" / "values.yaml")
+                            .read_text())
+
+    def flatten(prefix, v, out):
+        if isinstance(v, dict):
+            for k, sub in v.items():
+                flatten(f"{prefix}.{k}" if prefix else k, sub, out)
+        else:
+            out[prefix] = v
+
+    flat: dict = {}
+    flatten("", values, flat)
+
+    def render_template(text: str) -> str:
+        def sub(m):
+            key = m.group(1)
+            assert key in flat, f"template references unknown value {key}"
+            return str(flat[key])
+
+        out = re.sub(r"\{\{\s*\.Values\.([\w.]+)\s*\}\}", sub, text)
+        assert "{{" not in out, (
+            "platform chart uses templating beyond .Values substitution; "
+            "extend this fallback renderer"
+        )
+        return out
+
+    tpl_dir = helm_root / "platform" / "templates"
+    base_files = ("hub", "operator", "frontend", "worker", "prefill",
+                  "planner")
+    assert {p.stem for p in tpl_dir.glob("*.yaml")} == set(base_files)
+    for name in base_files:
+        base_docs = [
+            d for d in yaml.safe_load_all(
+                (root / "k8s" / f"{name}.yaml").read_text()
+            ) if d
+        ]
+        helm_docs = [
+            d for d in yaml.safe_load_all(
+                render_template((tpl_dir / f"{name}.yaml").read_text())
+            ) if d
+        ]
+        assert helm_docs == base_docs, f"{name}: helm/kustomize drift"
+
+    # with the real renderer available, the full `helm template` output
+    # must contain exactly the base documents too
+    if not shutil.which("helm"):
+        pytest.skip("helm binary not on PATH; pure-Python parity only")
+    out = subprocess.run(
+        ["helm", "template", "dynamo", str(helm_root / "platform")],
+        capture_output=True, text=True,
+    )
+    assert out.returncode == 0, out.stderr
+    rendered = [d for d in yaml.safe_load_all(out.stdout) if d]
+    want = []
+    for name in base_files:
+        want.extend(
+            d for d in yaml.safe_load_all(
+                (root / "k8s" / f"{name}.yaml").read_text()
+            ) if d
+        )
+    key = lambda d: (d["kind"], d["metadata"]["name"])  # noqa: E731
+    assert sorted(map(key, rendered)) == sorted(map(key, want))
+    by_key = {key(d): d for d in rendered}
+    for doc in want:
+        assert by_key[key(doc)] == doc, key(doc)
+
+
+def test_multihost_render_matches_golden():
+    """``hosts > 1`` renders an Indexed Job + headless coordinator
+    Service per replica group, golden-tested against
+    deploy/k8s/worker-multihost.yaml: every structural field the SPMD
+    bootstrap depends on (Indexed completion mode, completions ==
+    parallelism == hosts, headless clusterIP + job-name selector,
+    subdomain, the JOB_COMPLETION_INDEX downward-API annotation, and the
+    ``{group}-0.{group}:9876`` coordinator DNS form) must match the
+    hand-written manifest."""
+    import pathlib
+
+    import yaml
+
+    from dynamo_tpu.operator.manifests import (
+        COORDINATOR_PORT, render_bundle,
+    )
+
+    golden = pathlib.Path(__file__).resolve().parent.parent / "deploy" / \
+        "k8s" / "worker-multihost.yaml"
+    docs = [d for d in yaml.safe_load_all(golden.read_text()) if d]
+    gold_svc = next(d for d in docs if d["kind"] == "Service")
+    gold_job = next(d for d in docs if d["kind"] == "Job")
+    gold_pod = gold_job["spec"]["template"]
+    gold_env = {
+        e["name"]: e
+        for e in gold_pod["spec"]["containers"][0]["env"]
+    }
+
+    svc = ServiceSpec(
+        name="worker-mh", replicas=1, hosts=2, role="decode",
+        command=["-m", "dynamo_tpu.engine.worker",
+                 "--model-path", "/models/llama-3-70b", "--tp", "16"],
+    )
+    bundle = render_bundle(
+        svc, 1, graph="g1", namespace="prod", image="dynamo-tpu:latest",
+        hub="hub:7440",
+    )
+    ksvc = next(i for i in bundle["items"] if i["kind"] == "Service")
+    job = next(i for i in bundle["items"] if i["kind"] == "Job")
+    group = job["metadata"]["name"]
+
+    # headless coordinator Service: same shape as the golden
+    assert ksvc["spec"]["clusterIP"] == gold_svc["spec"]["clusterIP"]
+    assert ksvc["spec"]["ports"] == gold_svc["spec"]["ports"]
+    assert COORDINATOR_PORT == gold_svc["spec"]["ports"][0]["port"]
+    assert set(ksvc["spec"]["selector"]) == set(gold_svc["spec"]["selector"])
+    assert ksvc["spec"]["selector"]["job-name"] == group
+    assert ksvc["metadata"]["name"] == group  # subdomain == service name
+
+    # Indexed Job: one pod per host, all in lockstep
+    assert job["spec"]["completionMode"] == gold_job["spec"]["completionMode"]
+    assert job["spec"]["completions"] == job["spec"]["parallelism"] == \
+        svc.hosts == gold_job["spec"]["completions"]
+    pod = job["spec"]["template"]
+    assert pod["spec"]["subdomain"] == group
+    assert pod["spec"]["restartPolicy"] == gold_pod["spec"]["restartPolicy"]
+    assert pod["metadata"]["labels"]["job-name"] == group
+
+    # downward-API index -> --process-id, exactly the golden's fieldRef
+    env = {e["name"]: e for e in pod["spec"]["containers"][0]["env"]}
+    assert env["JOB_COMPLETION_INDEX"]["valueFrom"] == \
+        gold_env["JOB_COMPLETION_INDEX"]["valueFrom"]
+
+    # multihost flags appended to the spec's own argv, coordinator DNS
+    # in the golden's {group}-0.{group}:{port} form
+    cmd = pod["spec"]["containers"][0]["command"]
+    gold_args = gold_pod["spec"]["containers"][0]["args"][0]
+    for flag in ("--coordinator-address", "--num-processes", "--process-id"):
+        assert flag in cmd and flag in gold_args
+    coord = cmd[cmd.index("--coordinator-address") + 1]
+    assert coord == f"{group}-0.{group}:{COORDINATOR_PORT}"
+    assert f"dynamo-worker-mh-0.dynamo-worker-mh:{COORDINATOR_PORT}" \
+        in gold_args
+    assert cmd[cmd.index("--num-processes") + 1] == str(svc.hosts)
+    assert cmd[cmd.index("--process-id") + 1] == "$(JOB_COMPLETION_INDEX)"
+
+    # replica groups are distinct Jobs with distinct coordinator domains
+    bundle2 = render_bundle(
+        svc, 2, graph="g1", namespace="prod", image="dynamo-tpu:latest",
+        hub="hub:7440",
+    )
+    jobs = [i for i in bundle2["items"] if i["kind"] == "Job"]
+    svcs = [i for i in bundle2["items"] if i["kind"] == "Service"]
+    assert len(jobs) == 2 and len(svcs) == 2
+    assert len({j["metadata"]["name"] for j in jobs}) == 2
+    idx = {j["metadata"]["labels"]["dynamo-host-index"] for j in jobs}
+    assert idx == {"0", "1"}
+
+
+def test_kubectl_backend_multihost_roll_and_gc(tmp_path, monkeypatch):
+    """Multihost convergence through kubectl: scale() applies the Job
+    groups, GCs groups beyond the replica count by HOST_INDEX_LABEL,
+    rolls (delete + re-apply) when apply hits Job template immutability,
+    and running() counts only fully-ready groups."""
+    import json
+
+    from dynamo_tpu.operator.backends import KubectlBackend
+
+    stub = tmp_path / "kubectl"
+    logf = tmp_path / "calls.log"
+    stdinf = tmp_path / "stdin.json"
+    modef = tmp_path / "mode"
+    modef.write_text("ok")
+    stub.write_text(
+        "#!/bin/sh\n"
+        f'printf \'%s \' "$@" >> "{logf}"; printf \'\\n\' >> "{logf}"\n'
+        'case "$*" in\n'
+        # 3 existing groups (indices 0..2) -> GC everything >= replicas
+        "  *get*jobs*-l*host-index*) printf '0\\n1\\n2\\n' ;;\n"
+        # per-group ready pod counts: one full group, one partial
+        "  *get*jobs*-l*status.ready*) printf '2\\n1\\n' ;;\n"
+        f'  *apply*) cat > "{stdinf}"\n'
+        f'    if [ "$(cat {modef})" = "immutable" ]; then\n'
+        "      echo 'Job.batch invalid: field is immutable' >&2; exit 1\n"
+        "    fi ;;\n"
+        "esac\n"
+    )
+    stub.chmod(0o755)
+    monkeypatch.setenv("PATH", f"{tmp_path}:{os.environ.get('PATH', '')}")
+
+    be = KubectlBackend(namespace="prod", image="dynamo:v1",
+                        hub="hub:9000", graph="g1")
+    svc = ServiceSpec(name="mh", replicas=2, hosts=2,
+                      command=["-m", "dynamo_tpu.engine.worker"])
+    asyncio.run(be.scale(svc, 2))
+    bundle = json.loads(stdinf.read_text())
+    kinds = [i["kind"] for i in bundle["items"]]
+    assert kinds.count("Job") == 2 and kinds.count("Service") == 2
+    calls = logf.read_text().splitlines()
+    # group index 2 exceeded replicas=2 -> GC'd; 0 and 1 kept
+    assert any("delete job dynamo-mh-2" in c for c in calls), calls
+    assert any("delete service dynamo-mh-2" in c for c in calls)
+    assert not any("delete job dynamo-mh-0" in c for c in calls)
+    assert not any("delete job dynamo-mh-1" in c for c in calls)
+
+    # running(): only the fully-ready group (2/2 pods) counts
+    assert be.running("mh") == 1
+
+    # template change: apply rejected as immutable -> delete jobs, re-apply
+    logf.write_text("")
+    modef.write_text("immutable")
+    asyncio.run(be.scale(svc, 2))
+    calls = logf.read_text().splitlines()
+    assert any("delete jobs -l" in c for c in calls), calls
+    assert sum("apply -f -" in c for c in calls) == 2
+
+    # delete(): sweeps the service's labeled jobs + services
+    logf.write_text("")
+    modef.write_text("ok")
+    asyncio.run(be.delete(svc))
+    calls = logf.read_text().splitlines()
+    assert any("delete jobs -l dynamo-service=mh" in c for c in calls)
+    assert any("delete services -l dynamo-service=mh" in c for c in calls)
+    asyncio.run(be.close())
+
+
 def test_kustomize_tree_renders_full_stack():
     """Installable bundle (VERDICT r4 missing #1): the base kustomization
     lists every stack component, all manifests parse, the CRD schema

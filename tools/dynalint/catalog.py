@@ -225,4 +225,22 @@ METRIC_NAMES: dict[str, str] = {
     "epp_breaker_state": "per-instance circuit-breaker state gauge "
                          "(0 closed, 1 half-open, 2 open) — a sick "
                          "worker browning out is visible AS a brownout",
+    # closed-loop SLA autoscaler (autoscaler/metrics.py, on the /metrics
+    # surface of whatever process hosts the controller)
+    "autoscaler_plan_revisions_total": "ScalePlans emitted (each revision "
+                                       "is one actuated fleet change)",
+    "autoscaler_actuation_seconds": "backend.apply latency histogram — "
+                                    "plan emission to acknowledged "
+                                    "actuation",
+    "autoscaler_replicas_desired": "latest plan's target per dimension "
+                                   "(workers | prefill | router_shards)",
+    "autoscaler_replicas_actual": "backend-observed replicas per "
+                                  "dimension — desired vs actual gap is "
+                                  "the convergence debt",
+    "autoscaler_predictor_error": "matured forecast error (predicted - "
+                                  "observed demand) at the pre-scale "
+                                  "horizon; systematic bias here means "
+                                  "the predictor is mis-tuned",
+    "autoscaler_convergence_ticks": "ticks from plan emission until "
+                                    "observed counts matched it",
 }
