@@ -110,6 +110,7 @@ class AutoscaleController:
             workers_observed=sig.workers_observed,
             prefill_observed=sig.prefill_observed,
             live_workers_reporting=sig.live_workers_reporting,
+            quarantined_workers=sig.quarantined_workers,
         )
         plan = self.engine.plan(plan_sig, self.clock())
         if plan is not None:

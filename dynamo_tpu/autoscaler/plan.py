@@ -96,6 +96,10 @@ class DemandSignal:
     workers_observed: int = 0
     prefill_observed: int = 0
     live_workers_reporting: int = 0  # telemetry coverage, for the plan note
+    # soft-withdrawn (quarantined) workers: alive but excluded from
+    # routing — zero effective capacity, so the law holds replacements
+    # on top of its load-based target (gray-failure immunity)
+    quarantined_workers: int = 0
 
 
 @dataclass
@@ -139,6 +143,13 @@ class PlanEngine:
             "prefill": _DimState(initial_prefill),
             "shards": _DimState(initial_shards),
         }
+        # quarantine replacement overlay: replicas held ON TOP of the
+        # load-based workers target, one per quarantined worker. Kept
+        # outside _DimState on purpose — replacing withdrawn capacity is
+        # not load-driven scaling, so it bypasses the hysteresis band
+        # and both cooldowns, and unwinds instantly on re-admission
+        # without burning the downscale cooldown.
+        self._quarantine_overlay = 0
 
     # -- single-dimension law ---------------------------------------------
 
@@ -202,6 +213,18 @@ class PlanEngine:
         )
         if r:
             reasons.append(r)
+        overlay = min(
+            max(int(sig.quarantined_workers), 0),
+            cfg.max_workers - workers,
+        )
+        if overlay != self._quarantine_overlay:
+            reasons.append(
+                f"workers quarantine overlay "
+                f"{self._quarantine_overlay}->{overlay} "
+                f"({sig.quarantined_workers} quarantined)"
+            )
+            self._quarantine_overlay = overlay
+        workers += self._quarantine_overlay
         prefill, r = self._step(
             "prefill", sig.prefill_queue_tokens,
             cfg.prefill_tokens_per_worker,
@@ -232,12 +255,13 @@ class PlanEngine:
                 "prefill_queue_tokens": round(sig.prefill_queue_tokens, 1),
                 "workers_observed": sig.workers_observed,
                 "reporting": sig.live_workers_reporting,
+                "quarantined": sig.quarantined_workers,
             },
         )
 
     def current(self) -> tuple[int, int, int]:
         return (
-            self._dims["workers"].current,
+            self._dims["workers"].current + self._quarantine_overlay,
             self._dims["prefill"].current,
             self._dims["shards"].current,
         )

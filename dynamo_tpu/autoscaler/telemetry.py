@@ -42,6 +42,12 @@ class FleetTelemetry:
         self.clock = clock
         self._latest: dict[int, tuple[float, ForwardPassMetrics]] = {}
         self._task: asyncio.Task | None = None
+        # soft-withdrawn workers (gray-failure quarantine): alive and
+        # possibly still reporting metrics, but zero routable capacity.
+        # Fed from instance-card state (runtime/health.py quarantine
+        # metadata) by whoever watches the cards — the controller plans
+        # a replacement per entry.
+        self._quarantined: set[int] = set()
 
     def start(self) -> "FleetTelemetry":
         if self._task is None:
@@ -68,6 +74,13 @@ class FleetTelemetry:
         """Direct feed for tests/dryruns (no hub round-trip)."""
         self._latest[m.worker_id] = (self.clock(), m)
 
+    def set_quarantined(self, worker_ids) -> None:
+        """Replace the quarantined-worker set (from instance cards)."""
+        self._quarantined = set(worker_ids)
+
+    def quarantined(self) -> set[int]:
+        return set(self._quarantined)
+
     def _fresh(self) -> list[ForwardPassMetrics]:
         cutoff = self.clock() - self.stale_after_s
         dead = [w for w, (ts, _) in self._latest.items() if ts < cutoff]
@@ -87,6 +100,7 @@ class FleetTelemetry:
             ),
             workers_observed=len(fresh),
             live_workers_reporting=len(fresh),
+            quarantined_workers=len(self._quarantined),
         )
 
     async def close(self) -> None:

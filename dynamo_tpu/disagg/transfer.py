@@ -36,6 +36,8 @@ from typing import Callable
 
 import numpy as np
 
+from dynamo_tpu.runtime.integrity import kv_checksum, verify_checksum
+
 log = logging.getLogger("dynamo.disagg.transfer")
 
 _LEN = struct.Struct(">Q")
@@ -268,6 +270,12 @@ class KvTransferSource:
                 return params
         k_blocks = np.asarray(k_blocks)
         v_blocks = np.asarray(v_blocks)
+        # stamp the content checksum at export time: corruption while the
+        # blocks sit parked in the export table is caught too, not just
+        # wire corruption (device exports stamp lazily at serve time — a
+        # checksum here would force a D2H copy for transfers that may
+        # never take the host path)
+        meta["checksum"] = kv_checksum(k_blocks, v_blocks)
         with self._lock:
             self._exports[tid] = _Export(
                 k=k_blocks, v=v_blocks, meta=meta, on_done=on_done
@@ -356,6 +364,8 @@ class KvTransferSource:
                 "v_shape": list(v_np.shape),
                 **e.meta,
             }
+            if "checksum" not in header:  # device export on host fallback
+                header["checksum"] = kv_checksum(kb, vb)
             writer.write(json.dumps(header).encode() + b"\n")
             writer.write(_LEN.pack(len(kb)))
             writer.write(kb)
@@ -568,10 +578,14 @@ def pull_kv_blocks(
             buf = f.read(n)
             if len(buf) != n:
                 raise ConnectionError("short read in kv transfer")
-            return np.frombuffer(buf, dtype=dtype).reshape(shape)
+            # corrupt fault = bits flipped on the wire / in the NIC; the
+            # checksum below must catch it before the bytes become KV
+            buf = FAULTS.corrupt_bytes("disagg.pull", buf)
+            return buf, np.frombuffer(buf, dtype=dtype).reshape(shape)
 
-        k = read_block(header["k_shape"])
-        v = read_block(header["v_shape"])
+        kb, k = read_block(header["k_shape"])
+        vb, v = read_block(header["v_shape"])
+        verify_checksum(header.get("checksum"), kb, vb, path="disagg.pull")
         meta = {k_: header[k_] for k_ in ("num_tokens", "page_size") if k_ in header}
         return k, v, meta
 

@@ -57,6 +57,7 @@ from dynamo_tpu.runtime.context import (
     tenancy_from_headers,
 )
 from dynamo_tpu.runtime.faults import FAULTS
+from dynamo_tpu.runtime.integrity import verify_resume_tokens
 from dynamo_tpu.runtime import tracing
 from dynamo_tpu.runtime.flight import FLIGHT, emit_request_spans
 from dynamo_tpu.tokens import TokenBlockSequence
@@ -341,6 +342,10 @@ class InferenceEngine:
         # the collector turns them into /metrics histograms+counters
         self.step_times: collections.deque = collections.deque(maxlen=4096)
         self.burst_fills: collections.deque = collections.deque(maxlen=4096)
+        # degradation fingerprint: EWMA of work-cycle step latency (ms),
+        # published in ForwardPassMetrics and scored peer-relative by the
+        # fleet-side DegradationDetector (runtime/health.py)
+        self.step_time_ewma_ms = 0.0
         self.admission_rejects = {
             "draining": 0, "saturated": 0, "deadline": 0,
             "over_quota": 0, "shed": 0,
@@ -688,6 +693,7 @@ class InferenceEngine:
                     waiting_requests=self._waiting.qsize(),
                     running_requests=sum(s is not None for s in self._slots),
                     moe_dropped_slots=self.moe_dropped_slots,
+                    step_time_ms=self.step_time_ewma_ms,
                 )
             )
 
@@ -869,6 +875,11 @@ class InferenceEngine:
                 # surfacing a non-retryable 500
                 raise ServiceUnavailable(f"injected admit drop: {e}") from e
         await self.start()
+        # migration resume prompts arrive stamped with a token checksum;
+        # a mismatch (bit flip in transit) raises IntegrityError — a
+        # StreamError — so the migration operator re-drives from its
+        # pristine copy instead of this engine prefilling poison
+        verify_resume_tokens(request)
         token_ids = list(request.get("token_ids") or [])
         if not token_ids:
             yield {"token_ids": [], "finish_reason": "error",
@@ -1171,17 +1182,30 @@ class InferenceEngine:
         while not self._closed:
             try:
                 step_mark = self._spmd_mark()
-                if FAULTS.enabled:
+                if FAULTS.enabled and (
+                    self._partial is not None
+                    or not self._waiting.empty()
+                    or any(s is not None for s in self._slots)
+                ):
                     # engine.step error lands INSIDE this try: the fail-
                     # every-in-flight-then-keep-serving recovery below is
-                    # exactly what the fault exercises; delay = stalled step
+                    # exactly what the fault exercises; delay = stalled
+                    # step. Idle cycles don't fire: a device step only
+                    # happens when there is work, and an idle trip would
+                    # silently consume limit-based specs (xN) before any
+                    # request is in flight.
                     FAULTS.fire_sync("engine.step")
                 step_t0 = time.perf_counter()
                 did_work = self._step()
                 if did_work:
                     # telemetry feed: work cycles only (idle polls would
                     # drown the latency histogram in wake-timeout noise)
-                    self.step_times.append(time.perf_counter() - step_t0)
+                    dt = time.perf_counter() - step_t0
+                    self.step_times.append(dt)
+                    self.step_time_ewma_ms = (
+                        dt * 1000.0 if self.step_time_ewma_ms == 0.0
+                        else 0.8 * self.step_time_ewma_ms + 0.2 * dt * 1000.0
+                    )
                 if not did_work:
                     self._wake.clear()
                     if (

@@ -44,6 +44,7 @@ from dynamo_tpu.runtime.context import (
     ServiceUnavailable,
     StreamError,
 )
+from dynamo_tpu.runtime.integrity import token_checksum
 
 log = logging.getLogger("dynamo.migration")
 
@@ -192,11 +193,19 @@ class Migration:
                 max_tokens = stop.get("max_tokens")
                 if max_tokens is not None:
                     stop["max_tokens"] = max(max_tokens - len(generated), 1)
+                resume_tokens = (
+                    list(request.get("token_ids") or []) + generated
+                )
                 request = {
                     **request,
-                    "token_ids": list(request.get("token_ids") or []) + generated,
+                    "token_ids": resume_tokens,
                     "stop_conditions": stop,
                     "backend_instance_id": None,  # re-route freely
+                    # end-to-end integrity stamp: the receiving engine
+                    # verifies the resume prompt arrived bit-identical —
+                    # a corrupted resume raises IntegrityError back here
+                    # and re-drives from this (pristine) request
+                    "token_checksum": token_checksum(resume_tokens),
                 }
                 generated = []
                 # fresh child context: the old request id may be poisoned on

@@ -43,6 +43,7 @@ from dynamo_tpu.runtime import tracing
 from dynamo_tpu.runtime.context import TENANT_HEADER
 from dynamo_tpu.runtime.component import INSTANCE_ROOT, Instance
 from dynamo_tpu.runtime.faults import FAULTS
+from dynamo_tpu.runtime.health import DegradationDetector, is_quarantined
 from dynamo_tpu.runtime.metrics import MetricsRegistry
 
 log = logging.getLogger("dynamo.gateway.epp")
@@ -208,6 +209,13 @@ class EndpointPicker:
         # per-snapshot endpoint memo (see _endpoint_of)
         self._ep_snapshot: dict | None = None
         self._ep_map: dict[int, str] = {}
+        # gray-failure plane: quarantined instance ids (from the card
+        # metadata the health plane flips) and peer-relative straggler
+        # scoring over the step_time_ms fingerprints workers publish —
+        # both join the breaker exclude= set, inheriting its fail-open
+        self._quarantined_ids: set[int] = set()
+        self.degradation = DegradationDetector()
+        self.degradation.export_metrics()
         self._watch_tasks: list[asyncio.Task] = []
 
     async def start(self) -> "EndpointPicker":
@@ -277,23 +285,38 @@ class EndpointPicker:
             self._tokenizers[tok_name] = load_tokenizer(tok_name)
         return self._tokenizers[tok_name]
 
+    def _refresh_instance_memo(self, entries: dict) -> None:
+        # memoized per snapshot object: re-parsing every Instance dict on
+        # every pick made endpoint resolution an O(instances) tax on the
+        # decision hot path. The same parse harvests quarantine flags.
+        if entries is self._ep_snapshot:
+            return
+        self._ep_map = {}
+        self._quarantined_ids = set()
+        for raw in entries.values():
+            inst = Instance.from_dict(raw)
+            self._ep_map[inst.instance_id] = f"{inst.host}:{inst.port}"
+            if is_quarantined(inst):
+                self._quarantined_ids.add(inst.instance_id)
+        self._ep_snapshot = entries
+
+    async def _gray_excluded(self) -> set[int]:
+        """Soft-withdrawn capacity: quarantined instance cards plus
+        workers the DegradationDetector scores as stragglers. Joined to
+        the breaker exclusions, so the scheduler's fail-open (serve
+        SOMEONE rather than no one) covers gray failures too."""
+        self._refresh_instance_memo(await self._instances.get())
+        if self.kv is not None:
+            for w in self.kv.scheduler.workers():
+                self.degradation.observe(w.worker_id, w.metrics.step_time_ms)
+        return self._quarantined_ids | set(self.degradation.degraded())
+
     async def _endpoint_of(self, worker_id: int) -> str | None:
         # second attempt after a forced re-scan: the router may know a
         # winner the cached snapshot predates (fresh worker between
         # watch deliveries) — one refetch before answering 503
         for attempt in range(2):
-            entries = await self._instances.get()
-            # memoized per snapshot object: re-parsing every Instance
-            # dict on every pick made endpoint resolution an
-            # O(instances) tax on the decision hot path
-            if entries is not self._ep_snapshot:
-                self._ep_map = {}
-                for raw in entries.values():
-                    inst = Instance.from_dict(raw)
-                    self._ep_map[inst.instance_id] = (
-                        f"{inst.host}:{inst.port}"
-                    )
-                self._ep_snapshot = entries
+            self._refresh_instance_memo(await self._instances.get())
             endpoint = self._ep_map.get(worker_id)
             if endpoint is not None:
                 return endpoint
@@ -385,7 +408,9 @@ class EndpointPicker:
                 # instances that left the fleet, so worker churn cannot
                 # grow the board without bound
                 self.breakers.forget(self._live_instance_ids())
-            excluded = self.breakers.ejected()
+            excluded = set(self.breakers.ejected()) | (
+                await self._gray_excluded()
+            )
             # enough attempts to walk past every breaker-limited
             # instance before fail-open kicks in — a constant cap would
             # route to a disallowed worker while healthy ones remain

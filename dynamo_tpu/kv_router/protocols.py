@@ -112,6 +112,11 @@ class ForwardPassMetrics:
     # dense models — see models/moe.py capacity semantics)
     moe_dropped_slots: int = 0
     data_parallel_rank: int = 0
+    # rolling (EWMA) wall-clock decode-step latency in ms: the worker's
+    # degradation fingerprint. Peer-RELATIVE — the DegradationDetector
+    # scores it against the fleet median, so absolute speed (hardware
+    # generation, sim time dilation) cancels out; 0 = not yet measured
+    step_time_ms: float = 0.0
 
     @property
     def kv_usage(self) -> float:

@@ -400,6 +400,10 @@ class KvPushRouter:
         # caught when the memo was set-only).
         self._members_gen_seen = -1
         self._states_seen = -1
+        # soft-withdrawn (quarantined) instance ids, recomputed on the
+        # same memo: a quarantine republish is a card put, which bumps
+        # membership_gen, so this set is never stale
+        self._quarantined: set[int] = set()
 
     async def generate(
         self, request: dict[str, Any], context: Context
@@ -413,7 +417,14 @@ class KvPushRouter:
             client.membership_gen != self._members_gen_seen
             or sched.states_version != self._states_seen
         ):
+            from dynamo_tpu.runtime.health import is_quarantined
+
             self.kv_router.update_workers(client.instance_ids())
+            self._quarantined = {
+                inst.instance_id
+                for inst in client.instances()
+                if is_quarantined(inst)
+            }
             self._members_gen_seen = client.membership_gen
             self._states_seen = sched.states_version
 
@@ -433,8 +444,12 @@ class KvPushRouter:
             # header is only present when a frontend/client set it, so
             # untagged callers keep the oracle-identical pick path
             tenant = (context.headers or {}).get(TENANT_HEADER) or None
+            # quarantined instances are soft-withdrawn: excluded from the
+            # pick with the scheduler's fail-open semantics (a fully
+            # quarantined pool still routes rather than blackholing)
             worker_id, overlap = self.kv_router.find_best_match(
-                context.id, token_ids, salt=req_salt, tenant=tenant
+                context.id, token_ids, salt=req_salt, tenant=tenant,
+                exclude=self._quarantined or None,
             )
         request = dict(request)
         request["estimated_prefix_hit_num_blocks"] = overlap

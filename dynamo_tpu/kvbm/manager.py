@@ -22,7 +22,17 @@ from dataclasses import dataclass, field
 
 import numpy as np
 
-from dynamo_tpu.kvbm.pool import DiskBlockPool, HostBlockPool, RemoteBlockPool
+from dynamo_tpu.kvbm.pool import (
+    DiskBlockPool,
+    HostBlockPool,
+    RemoteBlockPool,
+    _corrupt_block,
+)
+from dynamo_tpu.runtime.integrity import (
+    IntegrityError,
+    kv_checksum,
+    verify_checksum,
+)
 
 log = logging.getLogger("dynamo.kvbm")
 
@@ -65,12 +75,19 @@ class KvBlockManager:
         self.disk: DiskBlockPool | None = None
         if self.config.disk_bytes > 0 and self.config.disk_dir:
             self.disk = DiskBlockPool(self.config.disk_dir, self.config.disk_bytes)
+        # content checksums for blocks currently in G2, stamped at
+        # offer/promotion, verified on every host hit; pruned on eviction
+        # so the map tracks pool occupancy (G3/G4 carry their own crc in
+        # the disk index / object header — they survive restarts)
+        self._checksums: dict[int, int] = {}
+
+        def _evict_host(sh: int, k: np.ndarray, v: np.ndarray) -> None:
+            self._checksums.pop(sh, None)
+            if self.disk is not None:
+                self.disk.put(sh, k, v)
+
         # G2 evictions cascade down to G3 when the disk tier exists
-        self.host = HostBlockPool(
-            self.config.host_bytes,
-            on_evict=(lambda sh, k, v: self.disk.put(sh, k, v))
-            if self.disk is not None else None,
-        )
+        self.host = HostBlockPool(self.config.host_bytes, on_evict=_evict_host)
         self.stats = KvbmStats()
         self._lock = threading.Lock()
         # G4 writes go through a dedicated best-effort writer: a slow/hung
@@ -100,6 +117,7 @@ class KvBlockManager:
         k = np.ascontiguousarray(k)
         v = np.ascontiguousarray(v)
         if self.host.put(sh, k, v):
+            self._checksums[sh] = kv_checksum(k, v)
             with self._lock:
                 self.stats.offloaded += 1
         if self._remote_q is not None:
@@ -110,17 +128,38 @@ class KvBlockManager:
             except queue.Full:
                 pass
 
+    def _promote(self, sh: int, k: np.ndarray, v: np.ndarray) -> None:
+        """Lift a verified lower-tier block into G2, stamping its crc so
+        later host hits verify against the same content."""
+        if self.host.put(sh, k, v):
+            self._checksums[sh] = kv_checksum(k, v)
+
     def _get_local(self, sh: int):
         """G2 then G3, with promotion; no hub I/O."""
         blk = self.host.get(sh)
         if blk is not None:
-            with self._lock:
-                self.stats.onboard_hits_host += 1
-            return blk
+            blk = _corrupt_block("kvbm.onboard", blk[0], blk[1])
+            try:
+                verify_checksum(
+                    self._checksums.get(sh), blk[0], blk[1], path="kvbm.host"
+                )
+            except IntegrityError:
+                # DRAM rot (or injected flip): drop the poisoned block and
+                # fall through to the lower tiers / a re-prefill miss
+                log.warning(
+                    "kvbm host block %016x failed checksum; evicting", sh
+                )
+                self.host.remove(sh)
+                self._checksums.pop(sh, None)
+                blk = None
+            if blk is not None:
+                with self._lock:
+                    self.stats.onboard_hits_host += 1
+                return blk
         if self.disk is not None:
             blk = self.disk.get(sh)
             if blk is not None:
-                self.host.put(sh, blk[0], blk[1])
+                self._promote(sh, blk[0], blk[1])
                 with self._lock:
                     self.stats.onboard_hits_disk += 1
                 return blk
@@ -134,7 +173,7 @@ class KvBlockManager:
         if self.remote is not None:
             blk = self.remote.get(sh)
             if blk is not None:
-                self.host.put(sh, blk[0], blk[1])
+                self._promote(sh, blk[0], blk[1])
                 with self._lock:
                     self.stats.onboard_hits_remote += 1
                 return blk
@@ -160,7 +199,7 @@ class KvBlockManager:
             fetched = self.remote.get_many(list(hashes[i:]))
             while i < len(hashes) and hashes[i] in fetched:
                 blk = fetched[hashes[i]]
-                self.host.put(hashes[i], blk[0], blk[1])
+                self._promote(hashes[i], blk[0], blk[1])
                 with self._lock:
                     self.stats.onboard_hits_remote += 1
                 out.append(blk)
@@ -193,5 +232,6 @@ class KvBlockManager:
 
     def clear(self) -> None:
         self.host.clear()
+        self._checksums.clear()
         if self.disk is not None:
             self.disk.clear()

@@ -19,7 +19,30 @@ from typing import Callable
 
 import numpy as np
 
+from dynamo_tpu.runtime.integrity import (
+    IntegrityError,
+    kv_checksum,
+    verify_checksum,
+)
+
 log = logging.getLogger("dynamo.kvbm.pool")
+
+
+def _corrupt_block(site: str, k: np.ndarray, v: np.ndarray):
+    """Chaos hook: run the k-block bytes through the ``corrupt`` fault at
+    ``site`` (no-op unless a corrupt rule is armed). Returns a fresh pair
+    when bits flipped, the originals otherwise."""
+    from dynamo_tpu.runtime.faults import FAULTS
+
+    if not FAULTS.enabled:
+        return k, v
+    kb = np.ascontiguousarray(k).tobytes()
+    # dynalint: disable=DL006 -- wrapper forwards its caller's literal
+    # site (every _corrupt_block() call site is catalog-checked)
+    flipped = FAULTS.corrupt_bytes(site, kb)
+    if flipped is kb:
+        return k, v
+    return np.frombuffer(flipped, dtype=k.dtype).reshape(k.shape), v
 
 
 class HostBlockPool:
@@ -100,6 +123,9 @@ class DiskBlockPool:
         self.capacity_bytes = capacity_bytes
         self.used_bytes = 0
         self._order: OrderedDict[int, int] = OrderedDict()  # sh -> nbytes
+        # sh -> content checksum; None for blocks indexed by a pre-checksum
+        # build (verify trivially until rewritten)
+        self._crc: dict[int, int | None] = {}
         self._lock = threading.Lock()
         os.makedirs(directory, exist_ok=True)
         self._load_index()
@@ -121,15 +147,20 @@ class DiskBlockPool:
                 entries = json.load(f)
         except (OSError, json.JSONDecodeError):
             return
-        for sh, nbytes in entries:
+        for entry in entries:
+            # entries were [sh, nbytes] before checksums; [sh, nbytes, crc]
+            # now — read both so an upgraded build opens an old index
+            sh, nbytes = entry[0], entry[1]
             if os.path.exists(self._path(sh)):
                 self._order[sh] = nbytes
+                self._crc[sh] = entry[2] if len(entry) > 2 else None
                 self.used_bytes += nbytes
         # the byte budget may have shrunk since the index was written:
         # evict LRU entries until we fit
         shrunk = False
         while self.used_bytes > self.capacity_bytes and self._order:
             esh, en = self._order.popitem(last=False)
+            self._crc.pop(esh, None)
             self.used_bytes -= en
             shrunk = True
             try:
@@ -143,7 +174,10 @@ class DiskBlockPool:
         path = os.path.join(self.dir, self.INDEX)
         try:
             with open(path, "w") as f:
-                json.dump(list(self._order.items()), f)
+                json.dump(
+                    [[sh, n, self._crc.get(sh)] for sh, n in self._order.items()],
+                    f,
+                )
         except OSError:
             log.warning("could not persist kvbm disk index", exc_info=True)
 
@@ -157,17 +191,22 @@ class DiskBlockPool:
                 return True
             while self.used_bytes + nbytes > self.capacity_bytes and self._order:
                 esh, en = self._order.popitem(last=False)
+                self._crc.pop(esh, None)
                 self.used_bytes -= en
                 try:
                     os.unlink(self._path(esh))
                 except OSError:
                     pass
+            stacked = np.stack([k, v])
             try:
-                np.save(self._path(sh), np.stack([k, v]))
+                np.save(self._path(sh), stacked)
             except OSError:
                 log.warning("kvbm disk write failed", exc_info=True)
                 return False
             self._order[sh] = nbytes
+            # checksum the exact bytes get() reads back (the stacked file
+            # layout), so a torn write or at-rest flip fails verification
+            self._crc[sh] = kv_checksum(stacked)
             self.used_bytes += nbytes
             self._save_index()
         return True
@@ -182,14 +221,25 @@ class DiskBlockPool:
         except OSError:
             with self._lock:
                 nbytes = self._order.pop(sh, 0)
+                self._crc.pop(sh, None)
                 self.used_bytes -= nbytes
             return None
-        return stacked[0], stacked[1]
+        k, v = _corrupt_block("kvbm.onboard", stacked[0], stacked[1])
+        try:
+            verify_checksum(self._crc.get(sh), k, v, path="kvbm.disk")
+        except IntegrityError:
+            # poisoned at rest (or on the read path): evict the block and
+            # report a tier miss — the engine re-prefills, never decodes it
+            log.warning("kvbm disk block %016x failed checksum; evicting", sh)
+            self.remove(sh)
+            return None
+        return k, v
 
     def remove(self, sh: int) -> bool:
         """Drop one block (quantized-onboard corruption eviction)."""
         with self._lock:
             nbytes = self._order.pop(sh, None)
+            self._crc.pop(sh, None)
             if nbytes is None:
                 return False
             self.used_bytes -= nbytes
@@ -208,6 +258,7 @@ class DiskBlockPool:
                 except OSError:
                     pass
             self._order.clear()
+            self._crc.clear()
             self.used_bytes = 0
             self._save_index()
 
@@ -262,13 +313,12 @@ class RemoteBlockPool:
             if len(self._written) >= self.max_blocks:
                 return False
             self._written.add(sh)
+        kb, vb = k.tobytes(), v.tobytes()
         header = json.dumps({
             "shape": list(k.shape), "dtype": k.dtype.name,
+            "checksum": kv_checksum(kb, vb),
         }).encode()
-        payload = (
-            len(header).to_bytes(4, "big") + header
-            + k.tobytes() + v.tobytes()
-        )
+        payload = len(header).to_bytes(4, "big") + header + kb + vb
         try:
             self._call(self.hub.put_object(self.bucket, self._name(sh), payload))
             with self._lock:
@@ -297,6 +347,18 @@ class RemoteBlockPool:
         body = data[4 + hlen:]
         if len(body) < 2 * n:
             raise ValueError("g4 payload shorter than header claims")
+        from dynamo_tpu.runtime.faults import FAULTS
+
+        if FAULTS.enabled:
+            # corrupt fault on the KV body only (a flipped header byte
+            # would surface as a JSON error, a different failure mode)
+            body = FAULTS.corrupt_bytes("kvbm.onboard", body)
+        # verify the exact body slice we are about to reinterpret as KV;
+        # IntegrityError propagates to get()/get_many(), which treat any
+        # decode failure as a tier miss — the poison is never onboarded
+        verify_checksum(
+            header.get("checksum"), body[: 2 * n], path="kvbm.remote"
+        )
         k = np.frombuffer(body[:n], dtype=dtype).reshape(shape)
         v = np.frombuffer(body[n : 2 * n], dtype=dtype).reshape(shape)
         return k, v

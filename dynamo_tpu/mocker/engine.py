@@ -37,6 +37,7 @@ from __future__ import annotations
 import asyncio
 import collections
 import random
+import time
 from dataclasses import dataclass
 from typing import Any, AsyncIterator
 
@@ -133,6 +134,11 @@ class MockEngineConfig:
     # and pay one real sleep per `sleep_granularity_s` of it instead
     # (aggregate pacing preserved; per-step interleaving coarsened)
     sleep_granularity_s: float = 0.0
+    # identity this engine presents to the fault registry: many mock
+    # workers share one process (and thus one FAULTS), so per-instance
+    # fault scoping (``engine.step:delay=80ms~10.0.0.3:*``) needs each
+    # engine to say who it is on every fire. "" = unscoped rules only.
+    fault_instance: str = ""
 
 
 class MockEngine:
@@ -158,6 +164,11 @@ class MockEngine:
         self._sleep_debt = 0.0
         self._waiting = 0
         self._admit = _PriorityGate(self.config.max_batch_size)
+        # degradation fingerprint: EWMA of MEASURED wall-clock decode-step
+        # time (ms). Measured, not modeled — an injected per-instance
+        # delay fault shows up here exactly like a thermal-throttled chip,
+        # and peer-relative scoring makes the sim's time dilation cancel
+        self.step_time_ewma_ms = 0.0
 
     # -- kv event plumbing -------------------------------------------------
 
@@ -178,6 +189,7 @@ class MockEngine:
                     waiting_requests=self._waiting,
                     running_requests=self._running,
                     data_parallel_rank=self.config.data_parallel_rank,
+                    step_time_ms=self.step_time_ewma_ms,
                 )
             )
 
@@ -228,7 +240,9 @@ class MockEngine:
             )
         if FAULTS.enabled:
             try:
-                await FAULTS.fire("engine.admit")
+                await FAULTS.fire(
+                    "engine.admit", instance=cfg.fault_instance
+                )
             except ConnectionError as e:
                 raise ServiceUnavailable(f"injected admit drop: {e}") from e
         _tenant, priority = tenancy_from_headers(context.headers)
@@ -281,9 +295,15 @@ class MockEngine:
                         yield {"token_ids": [], "finish_reason": "error",
                                "error": "deadline exceeded"}
                         return
+                    step_t0 = time.perf_counter()
                     if FAULTS.enabled:
                         try:
-                            await FAULTS.fire("engine.step")
+                            # instance= scopes sticky per-worker faults
+                            # (a delay here is the measured fingerprint's
+                            # whole point: it lands in step_time_ewma_ms)
+                            await FAULTS.fire(
+                                "engine.step", instance=cfg.fault_instance
+                            )
                         except (ConnectionError, RuntimeError) as e:
                             # the real engine fails every in-flight stream
                             # on a step fault, then keeps serving — mirror
@@ -297,6 +317,11 @@ class MockEngine:
                     # batch pressure: decode step slows with concurrency
                     pressure = 1.0 + 0.02 * max(self._running - 1, 0)
                     await self._sleep(cfg.decode_step_s * pressure)
+                    dt_ms = (time.perf_counter() - step_t0) * 1000.0
+                    self.step_time_ewma_ms = (
+                        dt_ms if self.step_time_ewma_ms == 0.0
+                        else 0.8 * self.step_time_ewma_ms + 0.2 * dt_ms
+                    )
                     if cfg.echo_prompt and token_ids:
                         # replay the prompt once, then stop cleanly
                         tok = (

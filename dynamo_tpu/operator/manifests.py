@@ -32,6 +32,28 @@ def deployment_name(svc_name: str, name_format: str = "dynamo-{service}") -> str
     return name_format.format(service=svc_name)
 
 
+def probe_manifests(port: int) -> dict[str, Any]:
+    """Kubelet probes against the worker's SystemStatusServer routes
+    (runtime/health.py ``/live`` + ``/ready``), in the exact shape the
+    hand-written deploy/k8s worker/prefill manifests carry: readiness
+    gates traffic on the canary loop reporting every endpoint ready,
+    liveness restarts a pod whose process (or engine watchdog) wedged.
+    Gray failures are deliberately NOT a liveness matter — a degraded
+    or quarantined worker still answers ``/live``; eviction is the
+    control plane's quarantine path, not a kubelet restart loop."""
+    return {
+        "readinessProbe": {
+            "httpGet": {"path": "/ready", "port": port},
+            "initialDelaySeconds": 30,
+            "periodSeconds": 10,
+        },
+        "livenessProbe": {
+            "httpGet": {"path": "/live", "port": port},
+            "periodSeconds": 15,
+        },
+    }
+
+
 def multihost_group_name(
     svc_name: str, index: int, name_format: str = "dynamo-{service}"
 ) -> str:
@@ -78,6 +100,7 @@ def deployment_manifest(
     }
     if svc.port:
         container["ports"] = [{"containerPort": svc.port}]
+        container.update(probe_manifests(svc.port))
     return {
         "apiVersion": "apps/v1",
         "kind": "Deployment",
@@ -176,6 +199,7 @@ def multihost_manifests(
     }
     if svc.port:
         container["ports"] = [{"containerPort": svc.port}]
+        container.update(probe_manifests(svc.port))
     headless: dict[str, Any] = {
         "apiVersion": "v1",
         "kind": "Service",

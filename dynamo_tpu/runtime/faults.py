@@ -12,23 +12,44 @@ space kill -9 can't reach (slow fsync, lossy links, flaky admission).
 
 Spec grammar (``DYN_FAULTS`` env var, or the worker admin ``faults`` RPC)::
 
-    site:action[=param][@prob][xN][,site:action...]
+    site:action[=param][@prob][xN][~instance][,site:action...]
 
     transport.send:drop@0.02          2% of sends die like a cut connection
     hub.fsync:delay=50ms              every WAL fsync takes +50ms
     engine.step:error@0.001           1-in-1000 steps raises (recovery path)
     disagg.pull:error@1x1             the first KV pull fails, then clean
+    disagg.pull:corrupt=3x1           flip 3 bits in the first pulled KV
+                                      payload (checksum detection path)
+    engine.step:delay=80ms~10.0.0.3:*   sticky per-instance degradation:
+                                      only the worker whose fault identity
+                                      matches the fnmatch pattern slows
+                                      down (the gray-failure straggler)
     transport.partition:drop=A|B      bidirectional partition between the
                                       address pair A and B
     transport.partition:drop=A>B      one-way partition: traffic A -> B is
                                       cut (B never hears A; A still hears B)
 
 Actions:
-    drop   raise ``FaultDrop`` (a ConnectionResetError): the site behaves
-           exactly as if the peer vanished — existing except-clauses and
-           migration/retry paths handle it with zero special-casing.
-    delay  sleep ``param`` (``50ms``/``0.2s``/bare seconds) at the site.
-    error  raise ``FaultInjected`` (a RuntimeError): an internal failure.
+    drop     raise ``FaultDrop`` (a ConnectionResetError): the site behaves
+             exactly as if the peer vanished — existing except-clauses and
+             migration/retry paths handle it with zero special-casing.
+    delay    sleep ``param`` (``50ms``/``0.2s``/bare seconds) at the site.
+    error    raise ``FaultInjected`` (a RuntimeError): an internal failure.
+    corrupt  flip ``param`` bits (default 1, positive integer) at seeded
+             positions in the payload a ``corrupt_bytes()`` call site
+             hands over — silent data corruption on the wire/tier, which
+             ONLY the receiver's content checksum can catch
+             (runtime/integrity.py). Never raises at the site.
+
+Instance scoping (``~pattern``): a rule suffixed with ``~pattern`` only
+fires for call sites whose fault identity matches the fnmatch pattern.
+Workers set their identity once via ``FAULTS.set_instance(addr)`` (or the
+``DYN_FAULT_INSTANCE`` env var); multi-worker processes (the cluster sim)
+pass ``instance=`` per call instead. A scoped rule is STICKY: the same
+worker degrades on every matching fire, which is the gray-failure
+straggler shape — one slow replica in an otherwise healthy fleet.
+Unscoped rules fire for everyone, scoped rules never fire for callers
+with no identity.
 
 Partitions are address-pair scoped: the ``transport.partition`` site takes
 a ``drop`` action whose param names the pair (``A|B`` symmetric, ``A>B``
@@ -47,13 +68,17 @@ function of (spec, seed, call index at that site), independent of thread
 interleavings or what other sites are doing. The same spec + seed replays
 the same fault schedule; tests assert this (tests/test_faults.py).
 
-Registered fault points (this PR):
+Registered fault points (see tools/dynalint/catalog.py for the full,
+drift-checked catalog):
     transport.connect / transport.send / transport.recv   (transport.py)
     hub.dial / hub.call                                   (hub_client.py)
     hub.wal_append / hub.fsync                            (hub_store.py)
     engine.step / engine.admit / engine.spec_verify       (engine/core.py)
     engine.guided_compile                                 (guided/runtime.py)
     disagg.pull                                           (disagg/transfer.py)
+    kvbm.onboard                                          (kvbm/manager.py)
+    migration.resume                                      (frontend/migration.py)
+    health.canary                                         (runtime/health.py)
 
 Trip counters are exported on every ``/metrics`` surface as
 ``dynamo_fault_trips_total{site,action}`` (runtime/metrics.py global
@@ -100,6 +125,9 @@ KNOWN_SITES: frozenset[str] = frozenset({
     "engine.preempt",
     "epp.breaker",
     "disagg.pull",
+    "kvbm.onboard",
+    "migration.resume",
+    "health.canary",
 })
 
 
@@ -126,11 +154,16 @@ def _parse_duration(text: str) -> float:
 @dataclass
 class FaultRule:
     site: str
-    action: str  # drop | delay | error
+    action: str  # drop | delay | error | corrupt
     prob: float = 1.0
     delay_s: float = 0.0
     limit: int = 0  # max trips; 0 = unbounded
     trips: int = 0
+    # corrupt rules: bits to flip per trip (seeded positions)
+    flips: int = 1
+    # instance scoping: fnmatch pattern over the caller's fault identity;
+    # "" = unscoped (fires for everyone)
+    instance: str = ""
     # partition rules only (site transport.partition): the address pair.
     # ``one_way`` cuts src->dst traffic only; symmetric cuts both ways.
     src: str | None = None
@@ -139,6 +172,11 @@ class FaultRule:
 
     def is_partition(self) -> bool:
         return self.dst is not None
+
+    def instance_matches(self, instance: str) -> bool:
+        if not self.instance:
+            return True
+        return bool(instance) and fnmatch.fnmatchcase(instance, self.instance)
 
     def link_matches(self, src: str, dst: str) -> bool:
         if self.one_way:
@@ -160,10 +198,14 @@ class FaultRule:
             out += f"={self.src}{'>' if self.one_way else '|'}{self.dst}"
         elif self.action == "delay":
             out += f"={self.delay_s * 1000:g}ms"
+        elif self.action == "corrupt" and self.flips != 1:
+            out += f"={self.flips}"
         if self.prob != 1.0:
             out += f"@{self.prob:g}"
         if self.limit:
             out += f"x{self.limit}"
+        if self.instance:
+            out += f"~{self.instance}"
         return out
 
 
@@ -177,6 +219,14 @@ def parse_spec(spec: str) -> list[FaultRule]:
         site, _, rest = entry.partition(":")
         if not rest:
             raise ValueError(f"fault entry {entry!r}: want site:action")
+        instance = ""
+        if "~" in rest:
+            rest, _, instance = rest.rpartition("~")
+            instance = instance.strip()
+            if not instance:
+                raise ValueError(
+                    f"fault entry {entry!r}: ~ needs an instance pattern"
+                )
         limit = 0
         m = re.search(r"x(\d+)$", rest)
         if m:
@@ -188,7 +238,7 @@ def parse_spec(spec: str) -> list[FaultRule]:
             prob = float(p)
         action, _, param = rest.partition("=")
         action = action.strip()
-        if action not in ("drop", "delay", "error"):
+        if action not in ("drop", "delay", "error", "corrupt"):
             raise ValueError(f"fault entry {entry!r}: unknown action {action!r}")
         site = site.strip()
         if site == "transport.partition":
@@ -196,6 +246,13 @@ def parse_spec(spec: str) -> list[FaultRule]:
                 raise ValueError(
                     f"fault entry {entry!r}: partition wants "
                     "transport.partition:drop=A|B (or A>B one-way)"
+                )
+            if instance:
+                # partitions are already address-pair scoped; a ~instance
+                # suffix on top is contradictory, not composable
+                raise ValueError(
+                    f"fault entry {entry!r}: partitions are address-pair "
+                    "scoped; ~instance is not valid on them"
                 )
             if limit:
                 # a partition is link STATE probed by traffic, not a
@@ -217,12 +274,34 @@ def parse_spec(spec: str) -> list[FaultRule]:
                 src=src.strip(), dst=dst.strip(), one_way=one_way,
             ))
             continue
-        delay_s = _parse_duration(param) if param else 0.0
-        if action == "delay" and not delay_s:
-            raise ValueError(f"fault entry {entry!r}: delay needs =duration")
+        flips = 1
+        delay_s = 0.0
+        if action == "corrupt":
+            # typed param validation: the only meaningful corrupt param is
+            # a positive bit-flip count — "50ms", "0", "-2" or random text
+            # would silently mean "1 flip" and make the schedule lie
+            if param:
+                try:
+                    flips = int(param)
+                except ValueError:
+                    raise ValueError(
+                        f"fault entry {entry!r}: corrupt wants a positive "
+                        f"integer bit-flip count, not {param!r}"
+                    ) from None
+                if flips <= 0:
+                    raise ValueError(
+                        f"fault entry {entry!r}: corrupt bit-flip count "
+                        "must be >= 1"
+                    )
+        else:
+            delay_s = _parse_duration(param) if param else 0.0
+            if action == "delay" and not delay_s:
+                raise ValueError(
+                    f"fault entry {entry!r}: delay needs =duration"
+                )
         rules.append(FaultRule(
             site=site, action=action, prob=prob,
-            delay_s=delay_s, limit=limit,
+            delay_s=delay_s, limit=limit, flips=flips, instance=instance,
         ))
     return rules
 
@@ -235,15 +314,23 @@ class FaultRegistry:
     production overhead is negligible.
     """
 
-    def __init__(self, spec: str = "", seed: int = 0):
+    def __init__(self, spec: str = "", seed: int = 0, instance: str = ""):
         self._lock = threading.Lock()
         self.enabled = False
         self.seed = seed
+        # process-default fault identity for ~instance-scoped rules;
+        # per-call instance= overrides it (multi-worker sim processes)
+        self.instance = instance
         self._rules: dict[str, list[FaultRule]] = {}
         self._rngs: dict[str, random.Random] = {}
         self.trip_counts: dict[tuple[str, str], int] = {}
         if spec:
             self.configure(spec, seed)
+
+    def set_instance(self, instance: str) -> None:
+        """Declare this process's fault identity (worker advertise
+        address) so ``~instance``-scoped rules can target it."""
+        self.instance = instance or ""
 
     # -- configuration -----------------------------------------------------
 
@@ -288,12 +375,21 @@ class FaultRegistry:
             rng = self._rngs[site] = random.Random(f"{self.seed}:{site}")
         return rng
 
-    def decide(self, site: str) -> FaultRule | None:
+    def decide(
+        self,
+        site: str,
+        instance: str | None = None,
+        kinds: tuple[str, ...] | None = None,
+    ) -> FaultRule | None:
         """One decision draw at ``site``; returns the rule to apply (and
         counts the trip) or None. Deterministic per (spec, seed, site,
-        call index)."""
+        call index). ``instance`` is the caller's fault identity for
+        ``~``-scoped rules (defaults to the process identity); ``kinds``
+        restricts which actions this call site can apply (payload sites
+        draw corrupt rules via ``corrupt_bytes``, never ``fire``)."""
         if not self.enabled:
             return None
+        who = self.instance if instance is None else instance
         with self._lock:
             rules = self._rules.get(site)
             if not rules:
@@ -303,6 +399,10 @@ class FaultRegistry:
             for rule in rules:
                 if rule.is_partition():
                     continue  # pair-scoped: only link_blocked matches these
+                if kinds is not None and rule.action not in kinds:
+                    continue
+                if not rule.instance_matches(who):
+                    continue
                 if rule.limit and rule.trips >= rule.limit:
                     continue
                 if self._site_rng(site).random() < rule.prob:
@@ -355,10 +455,12 @@ class FaultRegistry:
             raise FaultDrop(f"injected drop at {rule.site}")
         raise FaultInjected(f"injected error at {rule.site}")
 
-    def fire_sync(self, site: str) -> None:
+    _FIRE_KINDS = ("drop", "delay", "error")
+
+    def fire_sync(self, site: str, instance: str | None = None) -> None:
         """Blocking fault point (step thread, WAL append, transfer pull).
         Event-loop call sites must use the async ``fire`` instead."""
-        rule = self.decide(site)
+        rule = self.decide(site, instance=instance, kinds=self._FIRE_KINDS)
         if rule is None:
             return
         if rule.action == "delay":
@@ -369,15 +471,41 @@ class FaultRegistry:
             return
         self._raise(rule)
 
-    async def fire(self, site: str) -> None:
+    async def fire(self, site: str, instance: str | None = None) -> None:
         """Async fault point (event-loop call sites)."""
-        rule = self.decide(site)
+        rule = self.decide(site, instance=instance, kinds=self._FIRE_KINDS)
         if rule is None:
             return
         if rule.action == "delay":
             await asyncio.sleep(rule.delay_s)
             return
         self._raise(rule)
+
+    def corrupt_bytes(
+        self, site: str, data, instance: str | None = None
+    ) -> bytes:
+        """Payload fault point: when a ``corrupt`` rule trips at ``site``,
+        return a copy of ``data`` with ``flips`` bits flipped at seeded
+        positions; otherwise return ``data`` unchanged. The flip positions
+        are a pure function of (seed, site, trip index), so a red chaos
+        run replays bit-for-bit. Call sites place this where the payload
+        crosses a process boundary — the receiver's content checksum
+        (runtime/integrity.py) is the detection under test."""
+        rule = self.decide(site, instance=instance, kinds=("corrupt",))
+        if rule is None:
+            return data
+        buf = bytearray(data)
+        if not buf:
+            return data
+        rng = random.Random(f"{self.seed}:{site}:corrupt:{rule.trips}")
+        for _ in range(rule.flips):
+            i = rng.randrange(len(buf))
+            buf[i] ^= 1 << rng.randrange(8)
+        log.warning(
+            "fault injected: %s flipped %d bit(s) across %d bytes (trip %d)",
+            rule.spec(), rule.flips, len(buf), rule.trips,
+        )
+        return bytes(buf)
 
     # -- observability -----------------------------------------------------
 
@@ -418,6 +546,7 @@ class FaultRegistry:
 FAULTS = FaultRegistry(
     os.environ.get("DYN_FAULTS", ""),
     seed=int(os.environ.get("DYN_FAULTS_SEED", "0") or 0),
+    instance=os.environ.get("DYN_FAULT_INSTANCE", ""),
 )
 
 

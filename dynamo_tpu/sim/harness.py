@@ -91,6 +91,23 @@ class SimConfig:
     autoscale_osl: int = 40
     autoscale_slo_ttft_s: float = 0.75  # wall TTFT p99 bar
     autoscale_compare: bool = True  # also run the reactive baseline
+    # worker ForwardPassMetrics publish cadence (wall s); the gray
+    # scenario shrinks it so degradation fingerprints propagate fast
+    # enough to meet its dilated detection budget
+    metrics_interval_s: float = 0.25
+    # gray_failure scenario: one worker degraded to ``gray_slowdown``x
+    # step time via a sticky per-instance delay fault must be detected
+    # peer-relatively, quarantined within ``gray_detect_budget_s``
+    # DILATED seconds, excluded by routers, replaced by the autoscaler,
+    # and re-admitted after it heals — with zero client-visible errors.
+    # Builds its OWN small, mildly-dilated fleet (like autoscale).
+    gray_workers: int = 6
+    gray_speedup: float = 5.0
+    gray_slowdown: float = 10.0
+    gray_requests: int = 36  # per traffic phase (baseline / degraded / after)
+    gray_rate_per_s: float = 40.0
+    gray_osl: int = 6
+    gray_detect_budget_s: float = 5.0  # dilated seconds
     data_dir: str | None = None  # replica WALs; None = tempdir
 
     def trace_n(self) -> int:
@@ -125,19 +142,37 @@ class SimWorker:
         self.served = None
         self.events: KvEventPublisher | None = None
         self.metrics: WorkerMetricsPublisher | None = None
+        # gray-failure state: a quarantined worker is ALIVE (card stays
+        # in the hub, flagged) but cuts its in-flight streams so the
+        # migration operator re-drives them on healthy peers
+        self.quarantined = False
+        self.served_requests = 0
 
     @property
     def wid(self) -> int:
         return self.served.instance.instance_id if self.served else 0
 
+    @property
+    def fault_instance(self) -> str:
+        """Identity this worker presents to ``~instance``-scoped faults."""
+        return self.engine.config.fault_instance
+
     def handler(self):
         async def _serve(request, context):
             if not self.alive:
                 raise StreamError(f"sim worker {self.wid:x} is dead")
+            self.served_requests += 1
             async for item in self.engine.generate(request, context):
                 if not self.alive:
                     raise StreamError(
                         f"sim worker {self.wid:x} killed mid-stream"
+                    )
+                if self.quarantined:
+                    # proactive migration off gray capacity: the stream
+                    # dies with the peer-vanished contract the migration
+                    # operator already re-drives
+                    raise StreamError(
+                        f"sim worker {self.wid:x} quarantined mid-stream"
                     )
                 yield item
         return _serve
@@ -201,6 +236,11 @@ class MockFleet:
             max_batch_size=self.cfg.max_batch_size,
             speedup_ratio=self.cfg.speedup,
             seed=self.cfg.seed * 100003 + i,
+            # per-worker fault identity: many sim workers share one
+            # process (one FAULTS registry), so ~instance-scoped rules
+            # (the sticky gray-failure straggler) need each engine to
+            # say who it is on every fire
+            fault_instance=f"sim-w{i}",
         ))
         w = SimWorker(self, engine)
         ep = self.drt.namespace(NS).component(COMP).endpoint(EP)
@@ -211,7 +251,8 @@ class MockFleet:
         comp_path = f"{NS}/{COMP}"
         w.events = KvEventPublisher(self.drt.hub, comp_path, w.wid).start()
         w.metrics = WorkerMetricsPublisher(
-            self.drt.hub, comp_path, w.wid
+            self.drt.hub, comp_path, w.wid,
+            interval_s=self.cfg.metrics_interval_s,
         ).start()
         engine.events = w.events
         engine.metrics = w.metrics
@@ -260,6 +301,30 @@ class MockFleet:
         # thundering-herd shape on purpose: all replacements register at
         # once (hub put + event/metrics stream (re)subscription each)
         await asyncio.gather(*(self.launch_worker() for _ in range(k)))
+
+    async def quarantine_worker(self, w: SimWorker, reason: str) -> None:
+        """Soft-withdraw a gray worker: its instance card stays in the
+        hub flagged ``quarantined`` (routers exclude it through the
+        exclude= fail-open path, the autoscaler counts it as zero
+        capacity), and its in-flight streams are cut so the migration
+        operator re-drives them on healthy peers."""
+        from dynamo_tpu.runtime.health import count_quarantine, quarantined_card
+
+        w.quarantined = True
+        count_quarantine(reason)
+        card = quarantined_card(w.served.instance, reason)
+        # plain put (no lease arg): the key keeps its existing binding to
+        # the worker's lease, so worker death still removes the card
+        await self.drt.hub.put(card.path, card.to_dict())
+
+    async def readmit_worker(self, w: SimWorker) -> None:
+        """Lift a quarantine: republish the clean card; routers pick the
+        worker again and the autoscaler's replacement overlay unwinds."""
+        from dynamo_tpu.runtime.health import admitted_card
+
+        w.quarantined = False
+        card = admitted_card(w.served.instance)
+        await self.drt.hub.put(card.path, card.to_dict())
 
     async def client_path(
         self, *, migration: bool = True, **mig_kwargs

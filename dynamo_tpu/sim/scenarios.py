@@ -1063,6 +1063,248 @@ async def autoscale(cfg: SimConfig) -> dict:
     }
 
 
+# -- gray_failure ------------------------------------------------------------
+
+
+async def gray_failure(cfg: SimConfig) -> dict:
+    """One worker degrades GRAY — 10x step time via a sticky per-instance
+    ``engine.step:delay`` fault, still answering everything — and the
+    self-healing plane must catch it without any absolute threshold:
+    peer-relative degradation scoring over the step-time fingerprints in
+    ForwardPassMetrics flags it, quarantine soft-withdraws it (card stays
+    in the hub, flagged), routers exclude it fail-open, in-flight streams
+    migrate off through the existing re-drive path, the autoscaler counts
+    it as zero capacity and spawns a replacement, and healing (the fault
+    cleared + clean fingerprints) re-admits it and unwinds the
+    replacement. Acceptance (ISSUE 18): quarantined within the dilated
+    detection budget, ZERO client-visible errors end to end, TTFT p99
+    back under the healthy baseline x1.5 after quarantine, desired
+    workers +1 while quarantined."""
+    import dataclasses
+
+    from dynamo_tpu.autoscaler import (
+        AutoscaleController,
+        AutoscalerConfig,
+        FleetTelemetry,
+        SimBackend,
+    )
+    from dynamo_tpu.runtime.context import Context
+    from dynamo_tpu.runtime.health import DegradationDetector, is_quarantined
+
+    n = cfg.gray_workers
+    fcfg = dataclasses.replace(
+        cfg,
+        workers=n,
+        speedup=cfg.gray_speedup,
+        max_batch_size=4,
+        metrics_interval_s=0.05,
+    )
+    fleet = await MockFleet(fcfg, n).start()
+    tel = FleetTelemetry(
+        fleet.hub, f"{NS}/{COMP}", stale_after_s=2.0
+    ).start()
+    backend = SimBackend(fleet)
+    ctrl = AutoscaleController(
+        AutoscalerConfig(
+            # demand never drives scaling here: capacity per worker is
+            # set far above the offered load, so the ONLY mover is the
+            # quarantine replacement overlay
+            slots_per_worker=64,
+            min_workers=n, max_workers=n + 2,
+            up_cooldown_s=0.05, down_cooldown_s=60.0,
+            tick_interval_s=0.05, predict_ahead_ticks=0,
+        ),
+        tel, backend, initial_workers=n,
+    )
+    detector = DegradationDetector(tolerance=3.0, min_peers=3)
+    mig0 = migrations_snapshot()
+    victim = fleet.workers[1]
+    quarantined_at: list[float] = []
+    readmitted: list[float] = []
+    desired_peak = n
+    stop = asyncio.Event()
+
+    def _by_wid(wid: int) -> "object | None":
+        for w in fleet.workers:
+            if w.wid == wid:
+                return w
+        return None
+
+    async def watchdog():
+        """The fleet-side gray-failure plane: score fingerprints, flip
+        cards. Same observe path the EPP uses (scheduler worker states
+        fed by the kv_metrics subscription)."""
+        nonlocal desired_peak
+        while not stop.is_set():
+            if fleet.kv_router is not None:
+                for ws in fleet.kv_router.scheduler.workers():
+                    detector.observe(ws.worker_id, ws.metrics.step_time_ms)
+            scores = detector.scores()
+            for wid, s in scores.items():
+                w = _by_wid(wid)
+                if w is None or not w.alive:
+                    continue
+                if s >= detector.tolerance and not w.quarantined:
+                    await fleet.quarantine_worker(w, "degraded")
+                    quarantined_at.append(time.monotonic())
+                    tel.set_quarantined({wid})
+                    log.warning(
+                        "sim gray: worker %x quarantined (score %.1f)",
+                        wid, s,
+                    )
+                elif w.quarantined and s < detector.tolerance:
+                    await fleet.readmit_worker(w)
+                    readmitted.append(time.monotonic())
+                    tel.set_quarantined(set())
+                    log.warning(
+                        "sim gray: worker %x re-admitted (score %.1f)",
+                        wid, s,
+                    )
+            await ctrl.tick()
+            desired_peak = max(desired_peak, ctrl.engine.current()[0])
+            await asyncio.sleep(0.02)
+
+    async def probe_victim():
+        """Keep the victim decoding so its fingerprint reflects reality
+        (a gray worker is degraded, not idle)."""
+        k = 0
+        while not stop.is_set():
+            k += 1
+            ctx = Context(request_id=f"gray-probe-{k}")
+            try:
+                async for _ in victim.engine.generate(
+                    {"token_ids": [7, 8, 9],
+                     "stop_conditions": {"max_tokens": 4,
+                                         "ignore_eos": True}},
+                    ctx,
+                ):
+                    pass
+            except Exception as exc:  # noqa: BLE001 — probe loss not the SUT
+                log.debug("sim gray: probe request failed "
+                          "(expected while degraded): %s", exc)
+            await asyncio.sleep(0.02)
+
+    try:
+        engine = await fleet.client_path(migration=True)
+        rate, reqs, osl = cfg.gray_rate_per_s, cfg.gray_requests, cfg.gray_osl
+
+        # phase A: healthy baseline
+        base = (await replay_trace(
+            engine.generate,
+            _mk_trace(cfg, "gray-base", requests=reqs, rate=rate, osl=osl,
+                      groups=n, seed=cfg.seed),
+            id_prefix="gray-base",
+        )).summary()
+
+        driver = asyncio.ensure_future(watchdog())
+        prober = asyncio.ensure_future(probe_victim())
+
+        # degrade ONE worker: sticky per-instance delay, sized to take
+        # its dilated step time to gray_slowdown x the fleet's
+        step_s = victim.engine.config.decode_step_s / cfg.gray_speedup
+        delay_ms = (cfg.gray_slowdown - 1.0) * step_s * 1000.0
+        FAULTS.configure(
+            f"engine.step:delay={delay_ms:g}ms~{victim.fault_instance}"
+        )
+        t_degrade = time.monotonic()
+
+        # phase B: traffic THROUGH the degradation + detection window
+        degraded = (await replay_trace(
+            engine.generate,
+            _mk_trace(cfg, "gray-deg", requests=reqs, rate=rate, osl=osl,
+                      groups=n, seed=cfg.seed + 1),
+            id_prefix="gray-deg",
+        )).summary()
+        budget_wall = cfg.gray_detect_budget_s / cfg.gray_speedup
+        deadline = t_degrade + 3 * budget_wall
+        while not quarantined_at and time.monotonic() < deadline:
+            await asyncio.sleep(0.01)
+
+        # phase C: post-quarantine — victim excluded, replacement live
+        served_before = victim.served_requests
+        after = (await replay_trace(
+            engine.generate,
+            _mk_trace(cfg, "gray-after", requests=reqs, rate=rate, osl=osl,
+                      groups=n, seed=cfg.seed + 2),
+            id_prefix="gray-after",
+        )).summary()
+        victim_served_after_q = victim.served_requests - served_before
+        desired_while_q = ctrl.engine.current()[0]
+
+        # heal: clear the fault; the probe loop refreshes the fingerprint
+        # and the watchdog re-admits on score decay
+        FAULTS.clear()
+        heal_deadline = time.monotonic() + 20 * budget_wall
+        while not readmitted and time.monotonic() < heal_deadline:
+            await asyncio.sleep(0.01)
+        await ctrl.tick()  # unwind the replacement overlay
+        desired_final = ctrl.engine.current()[0]
+        victim_card = await fleet.hub.get(victim.served.instance.path)
+
+        stop.set()
+        await prober
+        await driver
+        migrations = migrations_snapshot() - mig0
+    finally:
+        FAULTS.clear()
+        stop.set()
+        await ctrl.close()
+        await tel.close()
+        await fleet.close()
+
+    detect_dilated_s = (
+        (quarantined_at[0] - t_degrade) * cfg.gray_speedup
+        if quarantined_at else None
+    )
+    errors = base["errors"] + degraded["errors"] + after["errors"]
+    base_p99 = base["ttft_ms_p99"] or 0.0
+    after_p99 = after["ttft_ms_p99"] or 0.0
+    return {
+        "workers": n,
+        "slowdown": cfg.gray_slowdown,
+        "detect_dilated_s": (
+            round(detect_dilated_s, 3) if detect_dilated_s else None
+        ),
+        "baseline_ttft_ms_p99": base_p99,
+        "degraded_ttft_ms_p99": degraded["ttft_ms_p99"],
+        "after_ttft_ms_p99": after_p99,
+        "migrations": migrations,
+        "victim_served_after_quarantine": victim_served_after_q,
+        "desired_while_quarantined": desired_while_q,
+        "desired_final": desired_final,
+        "spawned": backend.spawned,
+        "invariants": {
+            "quarantined_within_budget": _inv(
+                detect_dilated_s is not None
+                and detect_dilated_s <= cfg.gray_detect_budget_s,
+                detect_dilated_s=detect_dilated_s,
+                budget_dilated_s=cfg.gray_detect_budget_s,
+            ),
+            "zero_client_errors": _inv(errors == 0, errors=errors),
+            "ttft_recovered_after_quarantine": _inv(
+                after_p99 <= 1.5 * base_p99,
+                after_ms=after_p99, baseline_ms=base_p99,
+            ),
+            "victim_excluded_while_quarantined": _inv(
+                victim_served_after_q == 0,
+                served=victim_served_after_q,
+            ),
+            "autoscaler_replaced_quarantined": _inv(
+                desired_while_q == n + 1 and backend.spawned >= 1,
+                desired_while_quarantined=desired_while_q,
+                spawned=backend.spawned,
+            ),
+            "readmitted_and_unwound": _inv(
+                bool(readmitted)
+                and not is_quarantined(victim_card or {})
+                and desired_final == n,
+                readmitted=bool(readmitted),
+                desired_final=desired_final,
+            ),
+        },
+    }
+
+
 SCENARIOS = {
     "pick_scaling": pick_scaling,
     "leader_kill": leader_kill,
@@ -1072,4 +1314,5 @@ SCENARIOS = {
     "tenant_storm": tenant_storm,
     "telemetry_overhead": telemetry,
     "autoscale": autoscale,
+    "gray_failure": gray_failure,
 }

@@ -35,6 +35,18 @@ DYN_FAULTS="" python -m dynamo_tpu.sim --scenario autoscale \
   --seed "$DYN_FAULTS_SEED" \
   --out "${DYN_AUTOSCALE_OUT:-AUTOSCALE_nightly.json}"
 
+# gray-failure gate: one worker degrades 10x WITHOUT dying. Invariants
+# — peer-relative degradation scoring flags it within the dilated
+# budget, the victim is quarantined (soft-withdrawn, lease kept), zero
+# client errors throughout, in-flight work migrates off, the autoscaler
+# spawns a replacement, and a recovered victim re-admits after N clean
+# SDC canaries — gate via the sim's exit code. The scenario matrix run
+# above includes gray_failure too; this dedicated run keeps its own
+# artifact for trend review and stays red-bisectable on its own.
+DYN_FAULTS="" python -m dynamo_tpu.sim --scenario gray_failure \
+  --seed "$DYN_FAULTS_SEED" \
+  --out "${DYN_GRAY_OUT:-GRAY_nightly.json}"
+
 # stream-plane war: full micro/golden/dial/replay/churn matrix with the
 # throughput + frames-per-token + bytes-reduction bars enforced via the
 # bench's own exit code (non-zero on any failed bar). Runs WITHOUT the
@@ -51,6 +63,7 @@ exec python -m pytest -q -p no:cacheprovider \
   --deselect "tests/test_cluster_sim.py::test_sim_full_matrix" \
   tests/test_faults.py \
   tests/test_fault_tolerance.py \
+  tests/test_integrity.py \
   tests/test_overload.py \
   tests/test_cluster_sim.py \
   "tests/test_soak.py::test_soak_worker_sigkill_churn" \

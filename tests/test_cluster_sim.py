@@ -71,6 +71,30 @@ async def test_sim_smoke_partition_and_churn(tmp_path):
     assert artifact["verdict"] == "pass"
 
 
+async def test_sim_smoke_gray_failure(tmp_path):
+    """Gray-failure smoke (ISSUE 18): a worker degraded to 10x step time
+    by a sticky per-instance delay fault must be quarantined within the
+    dilated detection budget with ZERO client-visible errors, excluded
+    from routing while quarantined, replaced by the autoscaler (+1
+    desired), and re-admitted once it heals — well under the tier-1
+    budget (the fleet is small and mildly dilated)."""
+    cfg = _smoke_cfg(
+        data_dir=str(tmp_path), gray_requests=24, gray_rate_per_s=60.0
+    )
+    artifact = await run_scenarios(cfg, ["gray_failure"])
+    out = artifact["scenarios"]["gray_failure"]
+    assert out["verdict"] == "pass", out
+    inv = out["invariants"]
+    assert inv["quarantined_within_budget"]["pass"], inv
+    assert out["detect_dilated_s"] <= cfg.gray_detect_budget_s
+    assert inv["zero_client_errors"]["pass"], inv
+    assert inv["ttft_recovered_after_quarantine"]["pass"], inv
+    assert out["victim_served_after_quarantine"] == 0
+    assert out["desired_while_quarantined"] == out["workers"] + 1
+    assert out["spawned"] >= 1
+    assert out["desired_final"] == out["workers"]
+
+
 # -- mocker chaos parity (one DYN_FAULTS spec for real AND mock fleets) ------
 
 

@@ -246,19 +246,32 @@ def test_manifest_render():
     assert {"name": "DYNAMO_HUB", "value": "hub:9000"} in c["env"]
     assert {"name": "DYN_LOG", "value": "info"} in c["env"]
     assert c["ports"] == [{"containerPort": 8000}]
+    # kubelet probes against the SystemStatusServer routes, in the same
+    # golden shape deploy/k8s/worker.yaml carries (ISSUE 18 satellite)
+    assert c["readinessProbe"] == {
+        "httpGet": {"path": "/ready", "port": 8000},
+        "initialDelaySeconds": 30,
+        "periodSeconds": 10,
+    }
+    assert c["livenessProbe"] == {
+        "httpGet": {"path": "/live", "port": 8000},
+        "periodSeconds": 15,
+    }
     assert ksvc["kind"] == "Service"
     assert ksvc["spec"]["selector"] == {"app": "dynamo-frontend"}
     assert ksvc["spec"]["ports"] == [{"port": 8000, "targetPort": 8000}]
 
-    # portless service: Deployment only, no ports key
+    # portless service: Deployment only, no ports key, no probes (no
+    # status server to probe)
     worker = ServiceSpec(name="decode", replicas=1, command=["-m", "w"])
     bundle = render_bundle(
         worker, 2, graph="g1", namespace="prod", image="dynamo:v1",
         hub="hub:9000",
     )
     assert len(bundle["items"]) == 1
-    assert "ports" not in bundle["items"][0]["spec"]["template"]["spec"][
-        "containers"][0]
+    c2 = bundle["items"][0]["spec"]["template"]["spec"]["containers"][0]
+    assert "ports" not in c2
+    assert "readinessProbe" not in c2 and "livenessProbe" not in c2
 
 
 def test_kubectl_backend_managed_apply_and_delete(tmp_path, monkeypatch):

@@ -56,6 +56,18 @@ FAULT_SITES: dict[str, str] = {
                    "eject -> half-open -> recovery without a genuinely "
                    "sick worker",
     "disagg.pull": "disagg/transfer.py KV pull — transfer plane failure",
+    "kvbm.onboard": "kvbm/pool.py + manager.py tier block on receipt — "
+                    "silent bit flips in offloaded KV (corrupt action): "
+                    "checksum must catch it as a tier miss, never decode "
+                    "a poisoned page",
+    "migration.resume": "runtime/integrity.py resume-prompt intake — "
+                        "corrupt the migrated token ids on the wire: the "
+                        "checksum mismatch must re-drive the migration, "
+                        "never prefill a poisoned prompt",
+    "health.canary": "runtime/health.py SDC canary — corrupt the "
+                     "known-answer probe's output tokens: the golden "
+                     "mismatch must quarantine the worker "
+                     "(dynamo_worker_quarantines_total{reason=\"sdc\"})",
 }
 
 # engine step-thread profiler phase names (engine/core.py _phase /

@@ -597,8 +597,8 @@ def run_paths(
             for site in sorted(set(catalog.FAULT_SITES) - used_sites):
                 warnings.append(
                     f"catalog: fault site {site!r} is documented but no "
-                    f"faults.fire()/fire_sync() call uses it "
-                    f"(stale catalog entry?)"
+                    f"faults fire()/fire_sync()/corrupt_bytes() call uses "
+                    f"it (stale catalog entry?)"
                 )
             for name in sorted(set(catalog.METRIC_NAMES) - used_metrics):
                 warnings.append(

@@ -605,6 +605,10 @@ class CrossThreadMutation:
 # --------------------------------------------------------------------------
 
 _FIRE_ATTRS = {"fire", "fire_sync", "check", "fire_link", "link_blocked"}
+# corruption injectors (runtime/faults.py corrupt_bytes, runtime/
+# integrity.py corrupt_token_ids): same site-literal contract as fire —
+# a typo'd site means the chaos schedule flips no bits and tests nothing
+_CORRUPT_FNS = {"corrupt_bytes", "corrupt_token_ids"}
 _METRIC_ATTRS = {"counter", "gauge", "histogram"}
 # tracing span emitters (runtime/tracing.py): with tracing.span("...")
 # context managers and explicit tracing.emit_span("...") emissions
@@ -643,10 +647,20 @@ class FaultSiteRegistry:
                 # from-imported span()/emit_span()
                 yield from self._check_span(ctx, node, span_names)
                 continue
+            if (
+                isinstance(func, ast.Name)
+                and func.id in _CORRUPT_FNS
+                and node.args
+            ):
+                # from-imported corrupt_token_ids()/corrupt_bytes()
+                yield from self._check_site(ctx, node, fault_sites)
+                continue
             if not isinstance(func, ast.Attribute):
                 continue
             recv = dotted(func.value) or ""
-            if func.attr in _FIRE_ATTRS and "faults" in recv.lower():
+            if (
+                func.attr in _FIRE_ATTRS or func.attr in _CORRUPT_FNS
+            ) and "faults" in recv.lower():
                 yield from self._check_site(ctx, node, fault_sites)
             elif func.attr in _METRIC_ATTRS and node.args:
                 yield from self._check_metric(ctx, node, metric_names)
