@@ -929,6 +929,9 @@ def _extract_kv_pages_impl(k_pages, v_pages, page_ids):
     return k_pages[:, page_ids], v_pages[:, page_ids]
 
 
+# dynalint: disable=DL012 -- read-only gather: the live pools must
+# survive the call (the extracted pages ship over the disagg wire while
+# the source engine keeps serving from the same pools)
 extract_kv_pages = jax.jit(_extract_kv_pages_impl)
 
 

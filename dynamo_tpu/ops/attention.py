@@ -76,6 +76,9 @@ def pool_head_dim(head_dim: int) -> int:
     if env in ("0", "false", "off", "no"):
         return head_dim
     force = env in ("1", "true", "on", "force")
+    # dynalint: disable=DL014 -- layout probe, not a dispatch site: the
+    # unpadded layout's XLA fallback is counted where it is taken
+    # (note_fallback at the attention/kv_write dispatchers)
     if force or (use_pallas() and jax.default_backend() == "tpu"):
         return -(-head_dim // 128) * 128
     return head_dim
@@ -403,6 +406,9 @@ def decode_update_attention(
             ]
             if sinks is not None:
                 in_specs.append(P("tp"))
+            # dynalint: disable=DL013 -- array pools only: fused_ok
+            # excludes quantized+tp (scale leaves unspecced), and that
+            # exclusion is counted (note_fallback quant_tp_shardmap)
             kernel = compat_shard_map(
                 kernel,
                 mesh=mesh,
@@ -422,6 +428,29 @@ def decode_update_attention(
             args = args + (sinks,)
         attn, k_pages, v_pages = kernel(*args)
         return attn[..., :D], k_pages, v_pages
+
+    from dynamo_tpu.ops.fallback import note_fallback
+
+    if quantized and mesh is not None and mesh.shape.get("tp", 1) > 1:
+        # THE ROADMAP #7 residue: fp8 + tp>1 cannot ride the fused
+        # kernel's shard_map (scale leaves lack specs) — now it counts
+        # itself instead of silently costing 3x. Checked FIRST: this is
+        # the intrinsic blocker (it forces XLA even where Pallas and
+        # fused decode are available), so it wins attribution over the
+        # environmental reasons below.
+        note_fallback("quant_tp_shardmap",
+                      detail="decode_update_attention: fp8 pool under "
+                             "tp shard_map takes the XLA scatter+gather")
+    elif not use_pallas():
+        note_fallback("no_pallas_backend", expected=True,
+                      detail="decode_update_attention: scatter+gather")
+    elif not use_fused_decode():
+        note_fallback("fused_decode_disabled", expected=True,
+                      detail="decode_update_attention: DYNAMO_FUSED_DECODE=0")
+    else:
+        note_fallback("lane_misaligned",
+                      detail=f"decode_update_attention: pool head dim "
+                             f"{pool_d} not lane-aligned on TPU")
 
     from dynamo_tpu.ops.pallas.kv_write import write_new_kv
 
@@ -510,6 +539,13 @@ def paged_decode_attention_auto(
                 interpret=not on_tpu,
                 k_scale=k_pages.scale, v_scale=v_pages.scale,
             )
+        from dynamo_tpu.ops.fallback import note_fallback
+
+        note_fallback(
+            "quant_tp_shardmap" if tp else "lane_misaligned",
+            detail="paged_decode_attention_auto: quantized "
+                   "gather/dequant path",
+        )
         return paged_decode_attention(
             q, k_pages, v_pages, block_tables, seq_lens,
             window=window, sinks=sinks, scale=scale, new_kv=new_kv,
@@ -550,6 +586,9 @@ def paged_decode_attention_auto(
             ]
             if sinks is not None:
                 in_specs.append(P("tp"))  # per-query-head sinks
+            # dynalint: disable=DL013 -- array layer slices only: the
+            # quantized form is diverted above (v3 kernel, or the
+            # counted gather/dequant fallback) before this shard_map
             kernel = compat_shard_map(
                 kernel,
                 mesh=mesh,
@@ -561,6 +600,10 @@ def paged_decode_attention_auto(
         if sinks is not None:
             args = args + (sinks,)
         return kernel(*args)
+    from dynamo_tpu.ops.fallback import note_fallback
+
+    note_fallback("no_pallas_backend", expected=True,
+                  detail="paged_decode_attention_auto: pure-JAX gather")
     return paged_decode_attention(
         q, k_pages, v_pages, block_tables, seq_lens,
         window=window, sinks=sinks, scale=scale, new_kv=new_kv,

@@ -231,6 +231,9 @@ def write_new_kv(
         if mesh is not None and mesh.shape.get("tp", 1) > 1:
             from jax.sharding import PartitionSpec as P
 
+            # dynalint: disable=DL013 -- array pools only: the
+            # quantized append returned above (quant_append_rows)
+            # before this shard_map
             kernel = compat_shard_map(
                 kernel,
                 mesh=mesh,
@@ -249,6 +252,18 @@ def write_new_kv(
                 check_vma=False,
             )
         return kernel(k_pages, v_pages, k_new, v_new, dst_page, dst_off)
+    from dynamo_tpu.ops.fallback import note_fallback
+
+    if jax.default_backend() == "tpu":
+        # off-TPU the XLA scatter is the intended path; on a real TPU
+        # landing here means the DMA append kernel was available in
+        # principle but gated off
+        note_fallback(
+            "lane_misaligned"
+            if not lane_aligned(k_pages.shape[-1]) else "no_pallas_backend",
+            detail="write_new_kv: XLA scatter append",
+            expected=not use_pallas(),
+        )
     return (
         k_pages.at[layer, dst_page, :, dst_off].set(
             k_new.astype(k_pages.dtype)

@@ -267,6 +267,9 @@ def v3_supported(k_pages: jax.Array, block_tables: jax.Array) -> bool:
     return lane_aligned(k_pages.shape[-1])
 
 
+# dynalint: disable=DL012 -- read-only attention: the kernel gathers
+# from the pools and returns attention output; the pools stay live in
+# the caller's decode state
 @functools.partial(jax.jit, static_argnames=("interpret", "window"))
 def paged_decode_attention_v3(
     q: jax.Array,  # [B, H, D]

@@ -232,7 +232,7 @@ def _logits_local(spec: ModelSpec, pp_params, x, tp_size: int):
 # ------------------------------------------------------------ pp decode
 
 
-@partial(jax.jit, static_argnames=("spec", "mesh"))
+@partial(jax.jit, static_argnames=("spec", "mesh"), donate_argnums=(5, 6))
 def pp_decode_step(
     spec: ModelSpec,
     pp_params: Params,
@@ -357,7 +357,7 @@ def pp_decode_step(
 # ------------------------------------------------------------ pp prefill
 
 
-@partial(jax.jit, static_argnames=("spec", "mesh"))
+@partial(jax.jit, static_argnames=("spec", "mesh"), donate_argnums=(4, 5))
 def pp_prefill(
     spec: ModelSpec,
     pp_params: Params,

@@ -15,6 +15,14 @@ export DYN_TEST_TIMEOUT="${DYN_TEST_TIMEOUT:-$((${DYN_SOAK_SECS%.*} + 300))}"
 echo "chaos soak: DYN_SOAK_SECS=$DYN_SOAK_SECS" \
      "DYN_FAULTS=$DYN_FAULTS seed=$DYN_FAULTS_SEED"
 
+# static-analysis gate first: the full dynalint suite (DL001–DL015,
+# incl. the JAX hot-path layer) plus the SARIF artifact for
+# code-scanning upload. Cheapest red in the pipeline — fail before the
+# soaks burn their hours.
+python -m tools.dynalint --no-external
+python -m tools.dynalint --no-external --format=sarif \
+  > "${DYN_SARIF_OUT:-dynalint_nightly.sarif}"
+
 # cluster-scale chaos sim (dynamo_tpu/sim): the full scenario matrix at
 # 100s-of-workers scale — partitions, leader SIGKILL mid-commit-storm,
 # churn under trace replay, breaker + tenant storms — with the

@@ -144,6 +144,32 @@ SPAN_NAMES: dict[str, str] = {
                              "(engine/core.py generate)",
 }
 
+# step-thread / hot-loop roots for the DL010 host-sync analysis, spelled
+# "path/suffix.py::Qualified.name". The jit registry ALSO discovers hot
+# roots structurally (any ``threading.Thread(target=...)`` entry point);
+# this catalog pins the ones the serving SLO actually rides on, so a
+# refactor that loses the structural marker still keeps the closure rooted.
+HOT_PATH_ROOTS: dict[str, str] = {
+    "dynamo_tpu/engine/core.py::InferenceEngine._thread_loop":
+        "the engine step thread — owns the device; every unaccounted "
+        "host<->device sync here is serial time added to EVERY decode "
+        "step (the BENCH_r05 dispatch-overhead gap lives here)",
+}
+
+# capability gates whose False branch downgrades a fused/quantized path
+# to a slower generic one. DL014 requires the downgrade branch to account
+# for itself (ops.fallback.note_fallback / a log call) — ROADMAP #7's
+# "fp8 + tp>1 silently takes the XLA path" is the incident class.
+FALLBACK_GATES: dict[str, str] = {
+    "use_pallas": "ops/attention.py — Pallas kernels enabled "
+                  "(DYNAMO_PALLAS / on-TPU default)",
+    "use_fused_decode": "ops/attention.py — fused decode-update kernel "
+                        "enabled (DYNAMO_FUSED_DECODE)",
+    "lane_aligned": "ops/attention.py — pool head dim fills full TPU "
+                    "lanes (128); misaligned pools take the XLA path",
+    "supports_fused": "generic capability probe spelling",
+}
+
 # metric name (without the dynamo_ prefix MetricsRegistry adds) -> meaning
 METRIC_NAMES: dict[str, str] = {
     "http_requests_total": "HTTP requests by model/route/status",
@@ -221,6 +247,16 @@ METRIC_NAMES: dict[str, str] = {
                                      "DYNAMO_ENGINE_PROFILE=1)",
     "engine_spec_acceptance_rate": "cumulative speculative-draft "
                                    "acceptance rate",
+    # fused-kernel fallback accounting (ops/fallback.py, on every
+    # /metrics surface via the module registry)
+    "fused_fallback_total": "fused/quantized fast-path downgrades by "
+                            "reason (quant_tp_shardmap | lane_misaligned "
+                            "| no_pallas_backend | fused_decode_disabled) "
+                            "— counted at TRACE time, so each compiled "
+                            "specialization bumps it once, not once per "
+                            "step; nonzero quant_tp_shardmap on a TP>1 "
+                            "fp8 deployment is the ROADMAP #7 silent "
+                            "XLA-path regression made visible",
     "kvbm_tier_bytes": "KVBM tier footprint gauge by tier "
                        "(host | disk | remote) — quantized blocks "
                        "(kv_dtype=fp8) land at packed fp8+scale width, "

@@ -43,15 +43,29 @@ DEFAULT_PATHS = ["dynamo_tpu", "tools", "tests/hub_cluster.py"]
 DEFAULT_PROTOCOL_MD = "docs/PROTOCOL.md"
 
 
-def changed_files(root: Path) -> set[str] | None:
+def changed_files(
+    root: Path, scope: tuple[Path, ...] = ()
+) -> set[str] | None:
     """Repo-relative paths the git working tree touches (staged,
-    unstaged, and untracked), or None when git is unavailable."""
+    unstaged, and untracked), or None when git is unavailable.
+
+    ``scope`` narrows the git query to the configured scan paths: a
+    dirty ``deploy/`` file must read as "no SCANNED file changed", not
+    as a repo-wide dirty state that withholds every finding."""
+    specs: list[str] = []
+    for p in scope:
+        try:
+            specs.append(str(p.relative_to(root)) if p.is_absolute()
+                         else str(p))
+        except ValueError:  # outside the repo: git can't scope to it
+            return None
     try:
         # -uall: a brand-new directory must list its files individually
         # (plain porcelain collapses them to "?? dir/", which would
         # silently withhold every finding inside it)
         proc = subprocess.run(
-            ["git", "status", "--porcelain", "-uall"],
+            ["git", "status", "--porcelain", "-uall",
+             *(["--", *specs] if specs else [])],
             cwd=root, capture_output=True, text=True, timeout=30,
         )
     except (OSError, subprocess.TimeoutExpired):
@@ -79,6 +93,66 @@ def changed_files(root: Path) -> set[str] | None:
                 path = path.strip('"')
         out.add(path)
     return out
+
+
+def render_sarif(findings) -> str:
+    """SARIF 2.1.0 document for code-scanning upload: one run, the full
+    rule catalog in tool.driver.rules, stable partialFingerprints (the
+    finding's line-independent fingerprint, so annotations track across
+    rebases the same way the baseline does)."""
+    rule_ids = sorted(RULES)
+    sarif_rules = []
+    for rid in rule_ids:
+        rule = RULES[rid]
+        doc = (rule.__doc__ or "").strip().splitlines()
+        sarif_rules.append({
+            "id": rid,
+            "name": rule.name,
+            "shortDescription": {"text": doc[0] if doc else rule.name},
+            "fullDescription": {"text": " ".join(
+                line.strip() for line in doc
+            ).strip()},
+            "defaultConfiguration": {"level": "error"},
+        })
+    results = []
+    for f in findings:
+        msg = f.message + (f"  [fix: {f.hint}]" if f.hint else "")
+        results.append({
+            "ruleId": f.rule,
+            "ruleIndex": rule_ids.index(f.rule),
+            "level": "error",
+            "message": {"text": msg},
+            "locations": [{
+                "physicalLocation": {
+                    "artifactLocation": {
+                        "uri": f.path,
+                        "uriBaseId": "SRCROOT",
+                    },
+                    "region": {
+                        "startLine": f.line,
+                        "startColumn": f.col + 1,
+                    },
+                },
+            }],
+            "partialFingerprints": {
+                "dynalintFingerprint/v1": f.fingerprint,
+            },
+        })
+    return json.dumps({
+        "$schema": ("https://raw.githubusercontent.com/oasis-tcs/"
+                    "sarif-spec/master/Schemata/sarif-schema-2.1.0.json"),
+        "version": "2.1.0",
+        "runs": [{
+            "tool": {"driver": {
+                "name": "dynalint",
+                "informationUri":
+                    "https://example.invalid/dynamo-tpu/tools/dynalint",
+                "rules": sarif_rules,
+            }},
+            "originalUriBaseIds": {"SRCROOT": {"uri": "file:///"}},
+            "results": results,
+        }],
+    }, indent=2)
 
 
 def render_github(f) -> str:
@@ -124,9 +198,11 @@ def main(argv: list[str] | None = None) -> int:
     ap.add_argument("--json", action="store_true", dest="as_json",
                     help="machine-readable findings on stdout")
     ap.add_argument("--format", default="text",
-                    choices=["text", "github"],
-                    help="finding output format: text (default) or "
-                         "github (Actions ::error annotations)")
+                    choices=["text", "github", "sarif"],
+                    help="finding output format: text (default), github "
+                         "(Actions ::error annotations), or sarif "
+                         "(one SARIF 2.1.0 document for code-scanning "
+                         "upload)")
     ap.add_argument("--changed-only", action="store_true",
                     help="scan the full scope (interprocedural passes "
                          "need it) but report only findings in files the "
@@ -212,21 +288,26 @@ def main(argv: list[str] | None = None) -> int:
     new, grandfathered, stale = baseline_mod.split(findings, base)
 
     if args.changed_only:
-        changed = changed_files(REPO_ROOT)
+        changed = changed_files(REPO_ROOT, tuple(paths))
         if changed is None:
             print("dynalint: --changed-only needs git; reporting all "
                   "findings", file=sys.stderr)
         else:
             before = len(new)
-            # project-level rules (DL007) attribute findings to the
-            # OTHER side of the drift — the sender file or the committed
-            # catalog — which may not be the file that was edited;
-            # withholding those would let a protocol break commit
+            # project-level rules (DL007/DL015) attribute findings to
+            # the OTHER side of the drift/inversion — the sender file or
+            # the committed catalog — which may not be the file that was
+            # edited; withholding those would let a protocol break commit
             new = [
                 f for f in new
                 if f.path in changed or f.rule in PROJECT_RULES
             ]
-            if before != len(new):
+            if not changed:
+                print("dynalint: --changed-only: no file in the scan "
+                      "scope is dirty; per-file findings withheld "
+                      "(project-level rules still report)",
+                      file=sys.stderr)
+            elif before != len(new):
                 print(f"dynalint: --changed-only: {before - len(new)} "
                       "finding(s) in untouched files withheld",
                       file=sys.stderr)
@@ -247,6 +328,10 @@ def main(argv: list[str] | None = None) -> int:
             "suppressed": len(suppressed),
             "warnings": warnings,
         }, indent=2))
+    elif args.format == "sarif":
+        print(render_sarif(new))
+        for w in warnings:
+            print(f"dynalint: warning: {w}", file=sys.stderr)
     else:
         for f in new:
             print(render_github(f) if args.format == "github"
@@ -272,11 +357,12 @@ def main(argv: list[str] | None = None) -> int:
 
     rc = 1 if new else 0
 
-    # --json promises exactly one parseable document on stdout; external
-    # tools write their own stdout, so they only chain in text mode
+    # --json/--format=sarif promise exactly one parseable document on
+    # stdout; external tools write their own stdout, so they only chain
+    # in text mode
     if (
         rc == 0 and not args.no_external and not args.update_baseline
-        and not args.as_json
+        and not args.as_json and args.format != "sarif"
     ):
         ruff_rc = _run_external(
             "ruff", ["ruff", "check", *[str(p) for p in args.paths]]

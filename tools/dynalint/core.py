@@ -30,6 +30,12 @@ WIRE_PRIMITIVES = frozenset({
     "create_connection", "drain",
 })
 
+# JAX tracing wrappers the jit registry indexes. ``shard_map`` includes the
+# repo's 0.4.x compat shim (ops/shard.py), imported as ``compat_shard_map``
+# at every call site.
+JIT_WRAPPERS = frozenset({"jit", "pjit"})
+SHARD_MAP_WRAPPERS = frozenset({"shard_map", "compat_shard_map"})
+
 
 @dataclass
 class Finding:
@@ -205,7 +211,7 @@ class FunctionInfo:
 
     __slots__ = (
         "path", "qualname", "node", "is_async", "params", "cls",
-        "calls", "has_request_context",
+        "calls", "has_request_context", "return_call_names",
     )
 
     def __init__(self, path: str, qual: str, node, cls: str | None):
@@ -221,6 +227,9 @@ class FunctionInfo:
         # calls made DIRECTLY by this function (nested defs excluded: their
         # bodies only run when the nested function itself is called)
         self.calls: list[tuple[str, ast.Call]] = []
+        # dotted names of calls appearing inside a ``return`` expression —
+        # the seed observations for the device-returning closure (DL010)
+        self.return_call_names: set[str] = set()
         self.has_request_context = any(
             _is_request_context_param(a)
             for a in (*args.posonlyargs, *args.args, *args.kwonlyargs)
@@ -248,6 +257,153 @@ def _is_request_context_param(arg: ast.arg) -> bool:
     return (name or "").rsplit(".", 1)[-1] == "Context"
 
 
+def _const_int_tuple(node: ast.AST | None) -> tuple[int, ...] | None:
+    """``donate_argnums=(5, 6)`` / ``static_argnums=0`` -> (5, 6) / (0,).
+    None when absent or not a literal (dynamic specs can't be indexed)."""
+    if node is None:
+        return None
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        return (node.value,)
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out: list[int] = []
+        for e in node.elts:
+            if not (isinstance(e, ast.Constant) and isinstance(e.value, int)):
+                return None
+            out.append(e.value)
+        return tuple(out)
+    return None
+
+
+def _const_str_tuple(node: ast.AST | None) -> tuple[str, ...] | None:
+    if node is None:
+        return None
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return (node.value,)
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out: list[str] = []
+        for e in node.elts:
+            if not (isinstance(e, ast.Constant) and isinstance(e.value, str)):
+                return None
+            out.append(e.value)
+        return tuple(out)
+    return None
+
+
+def _kw(call: ast.Call, name: str) -> ast.AST | None:
+    for kw in call.keywords:
+        if kw.arg == name:
+            return kw.value
+    return None
+
+
+class JitInfo:
+    """One ``jax.jit``/``pjit``-wrapped callable in the jit registry.
+
+    Two shapes, both indexed: module-level assignment
+    (``decode_steps = jax.jit(decode_steps_impl, donate_argnums=(5, 6))``)
+    and decorator (``@jax.jit`` / ``@(functools.)partial(jax.jit, ...)``).
+    ``donate_argnums``/``static_argnums``/``static_argnames`` are the
+    literal values when literal, else None (unknown)."""
+
+    __slots__ = (
+        "path", "name", "context", "line", "col", "kind", "wrapped",
+        "donate_argnums", "static_argnums", "static_argnames",
+        "wrapped_fn",
+    )
+
+    def __init__(self, path: str, name: str, context: str, line: int,
+                 col: int, kind: str, wrapped: str | None,
+                 donate_argnums, static_argnums, static_argnames):
+        self.path = path
+        self.name = name  # the callable's public (call-site) name
+        self.context = context  # enclosing qualname of the definition
+        self.line = line
+        self.col = col
+        self.kind = kind  # "assign" | "decorator"
+        self.wrapped = wrapped  # dotted name of the wrapped impl (assign)
+        self.donate_argnums = donate_argnums
+        self.static_argnums = static_argnums
+        self.static_argnames = static_argnames
+        # resolved at finalize(): the wrapped FunctionInfo when findable
+        self.wrapped_fn: FunctionInfo | None = None
+
+
+class ShardMapSite:
+    """One ``shard_map``/``compat_shard_map`` call site (incl. the repo's
+    ops/shard.py compat shim) with its declared specs, for DL013."""
+
+    __slots__ = (
+        "path", "context", "line", "col", "node",
+        "in_specs", "out_specs", "wrapped",
+    )
+
+    def __init__(self, path: str, context: str, node: ast.Call):
+        self.path = path
+        self.context = context
+        self.node = node
+        self.line = node.lineno
+        self.col = node.col_offset
+        self.in_specs = _kw(node, "in_specs")
+        self.out_specs = _kw(node, "out_specs")
+        self.wrapped = node.args[0] if node.args else _kw(node, "f")
+
+
+def _extract_jit_assign(node: ast.Assign, path: str) -> JitInfo | None:
+    """``name = jax.jit(impl, static_argnums=..., donate_argnums=...)``."""
+    if len(node.targets) != 1 or not isinstance(node.targets[0], ast.Name):
+        return None
+    call = node.value
+    if not isinstance(call, ast.Call):
+        return None
+    last = (dotted(call.func) or "").rsplit(".", 1)[-1]
+    if last not in JIT_WRAPPERS:
+        return None
+    wrapped = dotted(call.args[0]) if call.args else None
+    return JitInfo(
+        path=path, name=node.targets[0].id, context=qualname(node),
+        line=node.lineno, col=node.col_offset, kind="assign",
+        wrapped=wrapped,
+        donate_argnums=_const_int_tuple(_kw(call, "donate_argnums")),
+        static_argnums=_const_int_tuple(_kw(call, "static_argnums")),
+        static_argnames=_const_str_tuple(_kw(call, "static_argnames")),
+    )
+
+
+def _extract_jit_decorator(node, path: str) -> JitInfo | None:
+    """``@jax.jit`` / ``@partial(jax.jit, static_argnames=(...))`` on a
+    def: the decorated function IS the jitted callable."""
+    for dec in node.decorator_list:
+        last = (dotted(dec) or "").rsplit(".", 1)[-1]
+        kw_src: ast.Call | None = None
+        if isinstance(dec, ast.Call):
+            if last in JIT_WRAPPERS:
+                kw_src = dec  # @jax.jit(static_argnums=...)
+            elif last == "partial" and dec.args:
+                inner = (dotted(dec.args[0]) or "").rsplit(".", 1)[-1]
+                if inner in JIT_WRAPPERS:
+                    kw_src = dec  # @partial(jax.jit, ...): kwargs on partial
+                else:
+                    continue
+            else:
+                continue
+        elif last not in JIT_WRAPPERS:
+            continue
+        return JitInfo(
+            path=path, name=node.name, context=qualname(node),
+            # anchor at the DECORATOR: that is where donation/static
+            # declarations live, and where a suppression comment lands
+            line=dec.lineno, col=dec.col_offset, kind="decorator",
+            wrapped=node.name,
+            donate_argnums=_const_int_tuple(
+                _kw(kw_src, "donate_argnums") if kw_src else None),
+            static_argnums=_const_int_tuple(
+                _kw(kw_src, "static_argnums") if kw_src else None),
+            static_argnames=_const_str_tuple(
+                _kw(kw_src, "static_argnames") if kw_src else None),
+        )
+    return None
+
+
 class ProjectIndex:
     """Project-wide symbol table + call graph, built once per scan.
 
@@ -264,6 +420,15 @@ class ProjectIndex:
         self.contexts: list["ScanContext"] = []
         self._wire_tainted: set[tuple[str, str]] = set()
         self.context_callee_names: set[str] = set()
+        # -- the jit registry (DL010-DL015 substrate) ----------------------
+        self.jits: dict[tuple[str, str], JitInfo] = {}  # (path, name)
+        self.jit_names: dict[str, list[JitInfo]] = {}
+        self.shard_maps: list[ShardMapSite] = []
+        # hot closure: functions transitively reachable from a step-thread
+        # root (threading.Thread targets + catalog.HOT_PATH_ROOTS)
+        self.hot: set[tuple[str, str]] = set()
+        self._thread_root_specs: list[tuple] = []
+        self._device_returning: set[tuple[str, str]] = set()
 
     def add_file(self, ctx: "ScanContext") -> None:
         self.contexts.append(ctx)
@@ -284,15 +449,58 @@ class ProjectIndex:
                 info = FunctionInfo(ctx.path, qual, node, cls)
                 by_node[node] = info
                 self.functions[(ctx.path, qual)] = info
+                if isinstance(node, ast.FunctionDef):
+                    jit = _extract_jit_decorator(node, ctx.path)
+                    if jit is not None:
+                        self.jits[(ctx.path, jit.name)] = jit
+            elif isinstance(node, ast.Assign):
+                jit = _extract_jit_assign(node, ctx.path)
+                if jit is not None:
+                    self.jits[(ctx.path, jit.name)] = jit
             elif isinstance(node, ast.Call):
                 fn = enclosing_function(node)
                 while isinstance(fn, ast.Lambda):
                     fn = enclosing_function(fn)
                 info = by_node.get(fn)
-                if info is not None:
-                    name = dotted(node.func)
-                    if name:
-                        info.calls.append((name, node))
+                name = dotted(node.func)
+                if info is not None and name:
+                    info.calls.append((name, node))
+                    for p in parents(node):
+                        if p is fn:
+                            break
+                        if isinstance(p, ast.Return):
+                            info.return_call_names.add(name)
+                            break
+                last = (name or "").rsplit(".", 1)[-1]
+                if last in SHARD_MAP_WRAPPERS:
+                    self.shard_maps.append(
+                        ShardMapSite(ctx.path, qualname(node), node)
+                    )
+                elif last == "Thread":
+                    self._note_thread_target(ctx.path, node)
+
+    def _note_thread_target(self, path: str, node: ast.Call) -> None:
+        """``threading.Thread(target=self.X / target=fn)``: X/fn is a hot
+        root — a dedicated worker thread's entry point (the engine's step
+        thread is ``Thread(target=self._thread_loop)``)."""
+        for kw in node.keywords:
+            if kw.arg != "target":
+                continue
+            v = kw.value
+            if (
+                isinstance(v, ast.Attribute)
+                and isinstance(v.value, ast.Name)
+                and v.value.id == "self"
+            ):
+                cls = None
+                for p in parents(node):
+                    if isinstance(p, ast.ClassDef):
+                        cls = p.name
+                        break
+                if cls:
+                    self._thread_root_specs.append((path, f"{cls}.{v.attr}"))
+            elif isinstance(v, ast.Name):
+                self._thread_root_specs.append((path, v.id))
 
     def finalize(self) -> None:
         self.by_name.clear()
@@ -304,6 +512,133 @@ class ProjectIndex:
             if info.has_request_context and not info.name.startswith("__")
         }
         self._compute_wire_taint()
+        self.jit_names.clear()
+        for (path, _name), jit in self.jits.items():
+            self.jit_names.setdefault(jit.name, []).append(jit)
+            if jit.wrapped:
+                last = jit.wrapped.rsplit(".", 1)[-1]
+                jit.wrapped_fn = self.functions.get((path, last))
+                if jit.wrapped_fn is None:
+                    cands = self.by_name.get(last, [])
+                    if len(cands) == 1:
+                        jit.wrapped_fn = cands[0]
+        self._compute_hot()
+        self._compute_device_returning()
+
+    # -- hot closure (step-thread reachability) -----------------------------
+
+    # a bare name with more candidate definitions than this is too generic
+    # to propagate hotness through (put/get/run smear the whole project)
+    _HOT_FANOUT_CAP = 6
+
+    # method names every stdlib type answers: ``payload.encode()`` must
+    # not make VitEncoder.encode hot just because both spell "encode"
+    _HOT_GENERIC_METHODS = frozenset({
+        "encode", "decode", "items", "keys", "values", "join", "read",
+        "write", "close", "copy", "update", "strip", "split", "append",
+        "pop", "clear", "add", "remove", "result", "set",
+    })
+
+    def _hot_roots(self) -> set[tuple[str, str]]:
+        roots = {
+            key for key in self._thread_root_specs if key in self.functions
+        }
+        catalog = None
+        if self.contexts:
+            catalog = self.contexts[0].catalog
+        for spec in getattr(catalog, "HOT_PATH_ROOTS", {}) or {}:
+            # "path/suffix.py::Qual.name" — suffix-matched so the catalog
+            # entry survives a directory move
+            suffix, _, qual = spec.partition("::")
+            for (path, q) in self.functions:
+                if q == qual and path.endswith(suffix):
+                    roots.add((path, q))
+        return roots
+
+    def _compute_hot(self) -> None:
+        hot = self.hot
+        hot.clear()
+        frontier = list(self._hot_roots())
+        while frontier:
+            key = frontier.pop()
+            if key in hot:
+                continue
+            hot.add(key)
+            info = self.functions[key]
+            for name, _ in info.calls:
+                last = name.rsplit(".", 1)[-1]
+                if (
+                    "." in name
+                    and name != f"self.{last}"
+                    and last in self._HOT_GENERIC_METHODS
+                ):
+                    continue
+                cands = self._resolve(info, name)
+                if not cands or len(cands) > self._HOT_FANOUT_CAP:
+                    continue
+                for c in cands:
+                    # async callees don't run on the step thread (calling
+                    # one from it would be its own bug)
+                    if c.is_async:
+                        continue
+                    # a closure can only be called from inside the scope
+                    # that defines it — by-name resolution from anywhere
+                    # else is always a false edge
+                    if enclosing_function(c.node) is not None and not (
+                        c.path == info.path
+                        and c.qualname.startswith(info.qualname + ".")
+                    ):
+                        continue
+                    k2 = (c.path, c.qualname)
+                    if k2 not in hot:
+                        frontier.append(k2)
+
+    def is_hot(self, info: FunctionInfo | None) -> bool:
+        """Is this function transitively reachable from a step-thread
+        root (Thread target or catalogued hot-loop entry)?"""
+        return info is not None and (info.path, info.qualname) in self.hot
+
+    # -- device-returning closure (DL010 taint) -----------------------------
+
+    def _compute_device_returning(self) -> None:
+        dr = self._device_returning
+        dr.clear()
+        for key, info in self.functions.items():
+            if any(
+                n.rsplit(".", 1)[-1] in self.jit_names
+                for n in info.return_call_names
+            ):
+                dr.add(key)
+        changed = True
+        while changed:
+            changed = False
+            for key, info in self.functions.items():
+                if key in dr:
+                    continue
+                for name in info.return_call_names:
+                    cands = self._resolve(info, name)
+                    # same unanimity rule as the wire taint
+                    if cands and all(
+                        (c.path, c.qualname) in dr for c in cands
+                    ):
+                        dr.add(key)
+                        changed = True
+                        break
+
+    def is_device_call(
+        self, caller: FunctionInfo | None, name: str
+    ) -> bool:
+        """Does calling ``name`` from ``caller`` return device values (a
+        jit-registry callable, or a function that transitively returns
+        one — e.g. the model-family adapter methods)?"""
+        if name.rsplit(".", 1)[-1] in self.jit_names:
+            return True
+        if caller is None:
+            return False
+        cands = self._resolve(caller, name)
+        return bool(cands) and all(
+            (c.path, c.qualname) in self._device_returning for c in cands
+        )
 
     # -- wire taint ---------------------------------------------------------
 

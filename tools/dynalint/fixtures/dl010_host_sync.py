@@ -1,0 +1,44 @@
+"""DL010 fixture: host↔device syncs on the step-thread hot path.
+
+``_loop`` is a ``threading.Thread`` target, so it (and everything it
+calls) is hot; ``decode_step`` is jit-registered, so its results are
+device-tainted. Unaccounted syncs on tainted values flag; the same sync
+wrapped in the accounted-phase idiom (``self._phase("...d2h...")``) or
+carrying a reasoned suppression does not, and neither does any of it on
+a function no thread ever targets.
+"""
+import threading
+
+import jax
+
+
+def _impl(x):
+    return x * 2
+
+
+decode_step = jax.jit(_impl)
+
+
+class Engine:
+    def start(self):
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+
+    def _phase(self, name):
+        ...
+
+    def _loop(self):
+        logits = decode_step(1)
+        jax.device_get(logits)  # EXPECT: DL010
+        val = float(logits)  # EXPECT: DL010
+        with self._phase("dispatch.d2h_wait"):
+            host = jax.device_get(logits)  # accounted sync: clean
+        # dynalint: disable=DL010 -- deliberate warm-up barrier: runs
+        # once before the loop admits traffic
+        jax.block_until_ready(logits)
+        return val, host
+
+    def off_thread(self):
+        # not hot: no thread targets this method
+        logits = decode_step(2)
+        return float(logits)

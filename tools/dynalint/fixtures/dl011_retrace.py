@@ -1,0 +1,36 @@
+"""DL011 fixture: retrace hazards around the jit boundary.
+
+Inside a jit-wrapped body, Python branching on a traced parameter's
+VALUE flags; branching on its structure (``.shape``, ``len``,
+``is None``, ``is_quant``) does not. At call sites, feeding a
+``static_argnames`` parameter a per-call-varying expression
+(``len(...)``, ``.shape``, arithmetic) flags; literals are clean.
+"""
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+@functools.partial(jax.jit, static_argnames=("n_steps",))
+def step(tokens, n_steps):
+    if tokens > 0:  # EXPECT: DL011
+        return tokens + n_steps
+    while tokens:  # EXPECT: DL011
+        tokens = tokens - 1
+    if tokens.shape[0] > 4:  # structural (.shape): clean
+        return tokens * 2
+    if tokens is None:  # pytree-structure check: clean
+        return jnp.zeros(())
+    if len(tokens) > 2:  # structural (len): clean
+        return tokens
+    return tokens
+
+
+def caller(batch):
+    a = step(batch, n_steps=4)  # literal static: clean
+    b = step(batch, n_steps=len(batch))  # EXPECT: DL011
+    # dynalint: disable=DL011 -- bucketed upstream: cfg.bucket_for pins
+    # the value to a fixed set, so the retrace count is bounded
+    c = step(batch, n_steps=batch.shape[0] + 1)
+    return a, b, c

@@ -1,0 +1,44 @@
+"""DL012 fixture: donation misuse around the jit boundary.
+
+Registry level: a jit whose signature takes pool-sized buffers
+(k_pages/v_pages) without donating them flags at the jit definition.
+Call level: reading a donated buffer after the call flags; rebinding it
+from the call's result in the same statement is the safe idiom and is
+clean.
+"""
+import jax
+
+
+def _step_impl(tokens, k_pages, v_pages):
+    return tokens, k_pages, v_pages
+
+
+decode_steps = jax.jit(_step_impl, donate_argnums=(1, 2))  # donated: clean
+
+
+def _gather_impl(k_pages, v_pages, ids):
+    return k_pages, v_pages
+
+
+extract = jax.jit(_gather_impl)  # EXPECT: DL012
+
+
+class Runner:
+    def ok(self, toks):
+        # rebind-in-statement: the donated names are the targets
+        toks, self.k_pages, self.v_pages = decode_steps(
+            toks, self.k_pages, self.v_pages
+        )
+        return toks
+
+    def bad(self, toks):
+        out = decode_steps(toks, self.k_pages, self.v_pages)  # EXPECT: DL012
+        stale = self.k_pages
+        return out, stale
+
+    def rollback(self, toks):
+        # dynalint: disable=DL012 -- double-buffered: the donated pool
+        # is the PREVIOUS generation; reading it is the rollback path
+        out = decode_steps(toks, self.k_pages, self.v_pages)
+        prev = self.k_pages
+        return out, prev

@@ -1,0 +1,57 @@
+"""DL014 fixture: capability-gated downgrades that account for nothing.
+
+A gate built from a catalogued capability probe (use_pallas /
+lane_aligned) whose fallback branch neither calls ``note_fallback`` nor
+logs flags. The same shape with the downgrade counted, logged, or
+suppressed with the measurement contract does not.
+"""
+import logging
+
+from dynamo_tpu.ops.fallback import note_fallback
+
+log = logging.getLogger(__name__)
+
+
+def use_pallas():
+    return False
+
+
+def lane_aligned(d):
+    return d % 128 == 0
+
+
+def fast(x):
+    return x
+
+
+def slow(x):
+    return x
+
+
+def dispatch_bad(x):
+    if use_pallas():  # EXPECT: DL014
+        return fast(x)
+    return slow(x)
+
+
+def dispatch_counted(x):
+    if use_pallas():
+        return fast(x)
+    note_fallback("no_pallas_backend", expected=True)
+    return slow(x)
+
+
+def dispatch_logged(x, d):
+    ok = lane_aligned(d)
+    if not ok:
+        log.warning("lane-misaligned pool: XLA path")
+        return slow(x)
+    return fast(x)
+
+
+def dispatch_bench(x):
+    # dynalint: disable=DL014 -- bench harness: the caller records
+    # which path it measured, a counter here would double-book
+    if use_pallas():
+        return fast(x)
+    return slow(x)

@@ -1,0 +1,55 @@
+"""DL015 fixture: threading locks across await; lock-order inversion.
+
+A SYNC ``with <lock>:`` whose body awaits, inside ``async def``, flags
+(asyncio.Lock via ``async with`` is DL009's beat — this is the
+threading.Lock shape that freezes the loop). Two functions taking the
+same two locks in opposite orders flag at both inner acquisition sites.
+"""
+import asyncio
+import threading
+
+
+class Pools:
+    def __init__(self):
+        self._alloc_lock = threading.Lock()
+        self._tier_lock = threading.Lock()
+        self._io_lock = threading.Lock()
+
+    async def drain(self):
+        with self._alloc_lock:  # EXPECT: DL015
+            await asyncio.sleep(0.1)
+        return None
+
+    async def snapshot(self):
+        # safe shape: snapshot under the lock, await after release
+        with self._alloc_lock:
+            n = 1
+        await asyncio.sleep(0)
+        return n
+
+    async def bootstrap(self):
+        # dynalint: disable=DL015 -- startup-only: runs before the loop
+        # serves traffic, nothing can contend yet
+        with self._io_lock:
+            await asyncio.sleep(0)
+
+    def promote(self):
+        with self._alloc_lock:
+            with self._tier_lock:  # EXPECT: DL015
+                return 1
+
+    def evict(self):
+        with self._tier_lock:
+            with self._alloc_lock:  # EXPECT: DL015
+                return 2
+
+    def stats(self):
+        # consistent order (matches promote): clean
+        with self._alloc_lock:
+            with self._io_lock:
+                return 3
+
+    def totals(self):
+        with self._alloc_lock:
+            with self._io_lock:
+                return 4

@@ -1167,6 +1167,15 @@ class LockAcrossAwait:
                 return
 
 
+from tools.dynalint.jaxrules import (  # noqa: E402 - rules need Finding etc.
+    DonationAudit,
+    HostSyncInHotPath,
+    LockDiscipline,
+    RetraceHazard,
+    SilentFallback,
+    SpecCoverage,
+)
+
 RULES = {
     r.id: r
     for r in (
@@ -1179,8 +1188,14 @@ RULES = {
         WireSchemaDrift(),
         DeadlineTaint(),
         LockAcrossAwait(),
+        HostSyncInHotPath(),
+        RetraceHazard(),
+        DonationAudit(),
+        SpecCoverage(),
+        SilentFallback(),
+        LockDiscipline(),
     )
 }
 
 # rules that run ONCE over the whole ProjectIndex instead of per file
-PROJECT_RULES = ("DL007",)
+PROJECT_RULES = ("DL007", "DL015")
