@@ -28,7 +28,6 @@ import json
 import logging
 import socket
 import struct
-import threading
 import time
 import uuid
 from dataclasses import dataclass, field
@@ -36,6 +35,7 @@ from typing import Callable
 
 import numpy as np
 
+from dynamo_tpu.runtime import race
 from dynamo_tpu.runtime.integrity import kv_checksum, verify_checksum
 
 log = logging.getLogger("dynamo.disagg.transfer")
@@ -63,7 +63,7 @@ class _Export:
 
 # in-process registry: source_uid -> KvTransferSource (zero-copy fast path)
 _LOCAL_SOURCES: dict[str, "KvTransferSource"] = {}
-_LOCAL_LOCK = threading.Lock()
+_LOCAL_LOCK = race.Lock("disagg.local_sources.lock")
 
 
 def shard_layout(x) -> tuple[int, list[tuple[int, object]]] | None:
@@ -118,7 +118,7 @@ class KvTransferSource:
         self.ttl_s = ttl_s
         self.uid = uuid.uuid4().hex
         self._exports: dict[str, _Export] = {}
-        self._lock = threading.Lock()
+        self._lock = race.Lock("disagg.source.lock")
         self._server: asyncio.AbstractServer | None = None
         self._gc_task: asyncio.Task | None = None
         self._want_device = device_transfer
@@ -402,7 +402,7 @@ class KvTransferSource:
 
 # PJRT transfer connections, one per source address (dialing is expensive)
 _DEVICE_CONNS: dict[str, object] = {}
-_DEVICE_CONNS_LOCK = threading.Lock()
+_DEVICE_CONNS_LOCK = race.Lock("disagg.device_conns.lock")
 
 
 def _tcp_request(addr: str, obj: dict, timeout: float = 10.0) -> dict:
@@ -491,10 +491,13 @@ def _pull_device(params: dict, mesh=None) -> tuple[object, object, dict]:
                 jax.ShapeDtypeStruct(tuple(params["v_shape"]), dt, sharding=sh),
             ],
         )
-        # dynalint: disable=DL010 -- deliberate landing barrier: the
-        # source's blocks can only be released once the DMA pull has
-        # materialized here; this runs on the transfer worker, not the
-        # engine step thread
+        # dynalint: disable=DL010 -- verified-safe deliberate landing
+        # barrier: HB edge is block_until_ready(k, v) -> release_kv_blocks
+        # (program order on the transfer worker thread); the source may
+        # reuse its pages the moment release lands, so the pull MUST have
+        # materialized first. Runs on the transfer worker, never the
+        # engine step thread or the event loop (see
+        # tools/dynarace/SUPPRESSIONS_AUDIT.md).
         jax.block_until_ready((k, v))
         release_kv_blocks(params)
         return k, v, meta
@@ -512,8 +515,11 @@ def _pull_device(params: dict, mesh=None) -> tuple[object, object, dict]:
         )
         k_parts.append(kp)
         v_parts.append(vp)
-    # dynalint: disable=DL010 -- deliberate landing barrier (sharded
-    # variant): every per-device part must land before release
+    # dynalint: disable=DL010 -- verified-safe deliberate landing barrier
+    # (sharded variant): same HB edge as above — every per-device part
+    # must land before release_kv_blocks lets the source recycle pages;
+    # program order on the transfer worker supplies the edge (see
+    # tools/dynarace/SUPPRESSIONS_AUDIT.md).
     jax.block_until_ready((k_parts, v_parts))
     ndim = len(params["k_shape"])
     pspec = PartitionSpec(*(

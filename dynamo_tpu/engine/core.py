@@ -58,7 +58,7 @@ from dynamo_tpu.runtime.context import (
 )
 from dynamo_tpu.runtime.faults import FAULTS
 from dynamo_tpu.runtime.integrity import verify_resume_tokens
-from dynamo_tpu.runtime import tracing
+from dynamo_tpu.runtime import race, tracing
 from dynamo_tpu.runtime.flight import FLIGHT, emit_request_spans
 from dynamo_tpu.tokens import TokenBlockSequence
 
@@ -259,7 +259,7 @@ class InferenceEngine:
         self._thread: threading.Thread | None = None
         self._loop: asyncio.AbstractEventLoop | None = None
         self._loop_thread: int | None = None
-        self._wake = threading.Event()
+        self._wake = race.Event("engine.wake")
         if spmd is not None:
             # a rejoining follower parks until the step loop serves its
             # state sync; wake an idle loop the moment one arrives
@@ -718,6 +718,7 @@ class InferenceEngine:
     def _post(self, q: asyncio.Queue, item: Any) -> None:
         """Thread-safe queue put: compute threads must not touch asyncio
         primitives directly."""
+        race.release(q, "engine.out_q")
         if self._loop is None or threading.get_ident() == self._loop_thread:
             q.put_nowait(item)
         else:
@@ -732,6 +733,7 @@ class InferenceEngine:
             self._thread = threading.Thread(
                 target=self._thread_loop, name="engine-step", daemon=True
             )
+            race.fork(self._thread)
             self._thread.start()
         return self
 
@@ -781,6 +783,7 @@ class InferenceEngine:
         to [0.25, 30] so a cold engine (no step samples yet) still gives
         a sane hint."""
         depth = self._waiting.qsize()
+        race.read("engine.step_times")
         samples = list(self.step_times)[-64:]
         mean_step = (sum(samples) / len(samples)) if samples else 0.05
         est = depth * mean_step / max(len(self._slots), 1)
@@ -822,6 +825,8 @@ class InferenceEngine:
         if self._thread is not None and self._thread.is_alive():
             # the thread exits at the next step boundary
             await asyncio.to_thread(self._thread.join, 10.0)
+            if not self._thread.is_alive():
+                race.join(self._thread)
         if self.offload is not None:
             # blocking join (may wait on an in-flight DMA) — keep it off
             # the event loop
@@ -1123,9 +1128,11 @@ class InferenceEngine:
                 remaining = 2.0 if deadline_hit else context.remaining_s()
                 if remaining is None:
                     item = await out_q.get()
+                    race.acquire(out_q, "engine.out_q")
                 else:
                     try:
                         item = await asyncio.wait_for(out_q.get(), remaining)
+                        race.acquire(out_q, "engine.out_q")
                     except asyncio.TimeoutError:
                         if deadline_hit:
                             finish_reason = "cancelled"
@@ -1201,6 +1208,7 @@ class InferenceEngine:
                     # telemetry feed: work cycles only (idle polls would
                     # drown the latency histogram in wake-timeout noise)
                     dt = time.perf_counter() - step_t0
+                    race.write("engine.step_times")
                     self.step_times.append(dt)
                     self.step_time_ewma_ms = (
                         dt * 1000.0 if self.step_time_ewma_ms == 0.0
@@ -4010,6 +4018,7 @@ class InferenceEngine:
         if burst:
             # telemetry feed: tokens this dispatch actually landed across
             # all participating slots (stops cut bursts short)
+            race.write("engine.burst_fills")
             self.burst_fills.append(
                 sum(len(toks) for toks, _f in burst.values())
             )

@@ -22,6 +22,7 @@ import logging
 import time
 
 from dynamo_tpu.runtime import metrics as metrics_mod
+from dynamo_tpu.runtime import race
 from dynamo_tpu.runtime.metrics import MetricsRegistry
 
 log = logging.getLogger("dynamo.engine.telemetry")
@@ -156,11 +157,13 @@ class EngineCollector:
         eng = self.engine
         lbl = self.label
         # drain the step/burst observation deques (step thread appends)
+        race.read("engine.step_times")
         while eng.step_times:
             try:
                 _M_STEP.labels(lbl).observe(eng.step_times.popleft())
             except IndexError:  # pragma: no cover - racing appender
                 break
+        race.read("engine.burst_fills")
         while eng.burst_fills:
             try:
                 _M_BURST.labels(lbl).observe(eng.burst_fills.popleft())

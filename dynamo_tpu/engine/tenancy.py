@@ -41,10 +41,11 @@ from __future__ import annotations
 
 import collections
 import queue as _queue
-import threading
 import time
 from dataclasses import dataclass
 from typing import Any, Callable, Iterable
+
+from dynamo_tpu.runtime import race
 
 PRIORITIES = ("interactive", "batch")
 DEFAULT_TENANT = "default"
@@ -185,7 +186,7 @@ class TenantScheduler:
     OVERFLOW_TENANT = "overflow"
 
     def __init__(self, quotas: dict[str, TenantQuota] | None = None):
-        self._lock = threading.Lock()
+        self._lock = race.Lock("tenancy.lock")
         self.quotas = dict(quotas or {})
         self._default_quota = self.quotas.pop("*", TenantQuota())
         self._buckets: dict[str, TokenBucket] = {}

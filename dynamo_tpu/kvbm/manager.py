@@ -28,6 +28,7 @@ from dynamo_tpu.kvbm.pool import (
     RemoteBlockPool,
     _corrupt_block,
 )
+from dynamo_tpu.runtime import race
 from dynamo_tpu.runtime.integrity import (
     IntegrityError,
     kv_checksum,
@@ -78,27 +79,41 @@ class KvBlockManager:
         # content checksums for blocks currently in G2, stamped at
         # offer/promotion, verified on every host hit; pruned on eviction
         # so the map tracks pool occupancy (G3/G4 carry their own crc in
-        # the disk index / object header — they survive restarts)
+        # the disk index / object header — they survive restarts).
+        # Guarded by _lock: the offload thread stamps (offer) while the
+        # step thread reads/pops (_get_local) — unguarded, a host hit
+        # could observe the block before its stamp and verify against
+        # None (a silent integrity-check skip). The lock is held across
+        # host.put/get AND the stamp so visibility and stamp are atomic.
         self._checksums: dict[int, int] = {}
 
         def _evict_host(sh: int, k: np.ndarray, v: np.ndarray) -> None:
-            self._checksums.pop(sh, None)
+            # runs inside host.put's eviction cascade with _lock already
+            # held by the offering thread — hence the RLock
+            with self._lock:
+                race.write("kvbm.checksums")
+                self._checksums.pop(sh, None)
             if self.disk is not None:
                 self.disk.put(sh, k, v)
 
         # G2 evictions cascade down to G3 when the disk tier exists
         self.host = HostBlockPool(self.config.host_bytes, on_evict=_evict_host)
         self.stats = KvbmStats()
-        self._lock = threading.Lock()
+        # lock ordering: manager lock OUTSIDE the pool locks, always —
+        # every host.put/get/remove below is entered with _lock held, so
+        # _evict_host's re-entrant acquire can never invert the order
+        self._lock = race.RLock("kvbm.manager.lock")
         # G4 writes go through a dedicated best-effort writer: a slow/hung
         # hub must not back up the offload thread and starve the purely
         # LOCAL host tier (offload.py's queue is bounded and drops)
         self._remote_q: queue.Queue | None = None
         if self.remote is not None:
-            self._remote_q = queue.Queue(maxsize=128)
-            threading.Thread(
+            self._remote_q = race.Queue("kvbm.remote_q", maxsize=128)
+            t = threading.Thread(
                 target=self._remote_writer, name="kvbm-g4-writer", daemon=True
-            ).start()
+            )
+            race.fork(t)
+            t.start()
 
     def _remote_writer(self) -> None:
         while True:
@@ -116,9 +131,10 @@ class KvBlockManager:
         """Write-through insert from a sealed G1 page."""
         k = np.ascontiguousarray(k)
         v = np.ascontiguousarray(v)
-        if self.host.put(sh, k, v):
-            self._checksums[sh] = kv_checksum(k, v)
-            with self._lock:
+        with self._lock:
+            race.write("kvbm.checksums")
+            if self.host.put(sh, k, v):
+                self._checksums[sh] = kv_checksum(k, v)
                 self.stats.offloaded += 1
         if self._remote_q is not None:
             # queue for G4 so OTHER workers can onboard this prefix;
@@ -131,31 +147,37 @@ class KvBlockManager:
     def _promote(self, sh: int, k: np.ndarray, v: np.ndarray) -> None:
         """Lift a verified lower-tier block into G2, stamping its crc so
         later host hits verify against the same content."""
-        if self.host.put(sh, k, v):
-            self._checksums[sh] = kv_checksum(k, v)
+        with self._lock:
+            race.write("kvbm.checksums")
+            if self.host.put(sh, k, v):
+                self._checksums[sh] = kv_checksum(k, v)
 
     def _get_local(self, sh: int):
         """G2 then G3, with promotion; no hub I/O."""
-        blk = self.host.get(sh)
-        if blk is not None:
-            blk = _corrupt_block("kvbm.onboard", blk[0], blk[1])
-            try:
-                verify_checksum(
-                    self._checksums.get(sh), blk[0], blk[1], path="kvbm.host"
-                )
-            except IntegrityError:
-                # DRAM rot (or injected flip): drop the poisoned block and
-                # fall through to the lower tiers / a re-prefill miss
-                log.warning(
-                    "kvbm host block %016x failed checksum; evicting", sh
-                )
-                self.host.remove(sh)
-                self._checksums.pop(sh, None)
-                blk = None
+        with self._lock:
+            race.read("kvbm.checksums")
+            blk = self.host.get(sh)
             if blk is not None:
-                with self._lock:
+                blk = _corrupt_block("kvbm.onboard", blk[0], blk[1])
+                try:
+                    verify_checksum(
+                        self._checksums.get(sh), blk[0], blk[1],
+                        path="kvbm.host",
+                    )
+                except IntegrityError:
+                    # DRAM rot (or injected flip): drop the poisoned
+                    # block and fall through to the lower tiers / a
+                    # re-prefill miss
+                    log.warning(
+                        "kvbm host block %016x failed checksum; evicting",
+                        sh,
+                    )
+                    self.host.remove(sh)
+                    self._checksums.pop(sh, None)
+                    blk = None
+                if blk is not None:
                     self.stats.onboard_hits_host += 1
-                return blk
+                    return blk
         if self.disk is not None:
             blk = self.disk.get(sh)
             if blk is not None:
@@ -231,7 +253,9 @@ class KvBlockManager:
         return sh in self.host or (self.disk is not None and sh in self.disk)
 
     def clear(self) -> None:
-        self.host.clear()
-        self._checksums.clear()
+        with self._lock:
+            race.write("kvbm.checksums")
+            self.host.clear()
+            self._checksums.clear()
         if self.disk is not None:
             self.disk.clear()

@@ -16,9 +16,12 @@ import threading
 
 import numpy as np
 
+from dynamo_tpu.runtime import race
+
 log = logging.getLogger("dynamo.kvbm.offload")
 
 _STOP = object()
+_FLUSH = object()
 
 
 def to_local_np(arr) -> np.ndarray:
@@ -57,7 +60,7 @@ def to_local_np(arr) -> np.ndarray:
 class OffloadEngine:
     def __init__(self, manager, *, max_queue: int = 64):
         self.manager = manager
-        self._q: queue.Queue = queue.Queue(maxsize=max_queue)
+        self._q: queue.Queue = race.Queue("kvbm.offload_q", maxsize=max_queue)
         self._thread: threading.Thread | None = None
         self.dropped = 0  # batches skipped under backpressure
 
@@ -66,6 +69,7 @@ class OffloadEngine:
             self._thread = threading.Thread(
                 target=self._run, name="kvbm-offload", daemon=True
             )
+            race.fork(self._thread)
             self._thread.start()
         return self
 
@@ -79,14 +83,16 @@ class OffloadEngine:
 
     def flush(self, timeout: float = 10.0) -> None:
         """Wait until everything queued so far has been offered (tests)."""
-        done = threading.Event()
-        self._q.put((done, None, None))
+        done = race.Event("kvbm.offload_flush")
+        self._q.put((_FLUSH, done, None))
         done.wait(timeout)
 
     def close(self) -> None:
         if self._thread is not None:
             self._q.put((_STOP, None, None))
             self._thread.join(timeout=5)
+            if not self._thread.is_alive():
+                race.join(self._thread)
             self._thread = None
 
     def _run(self) -> None:
@@ -94,8 +100,8 @@ class OffloadEngine:
             hashes, kb, vb = self._q.get()
             if hashes is _STOP:
                 return
-            if isinstance(hashes, threading.Event):
-                hashes.set()
+            if hashes is _FLUSH:
+                kb.set()
                 continue
             try:
                 # to_local_np blocks until the async device->host copy lands

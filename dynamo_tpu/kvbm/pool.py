@@ -13,12 +13,12 @@ from __future__ import annotations
 import json
 import logging
 import os
-import threading
 from collections import OrderedDict
 from typing import Callable
 
 import numpy as np
 
+from dynamo_tpu.runtime import race
 from dynamo_tpu.runtime.integrity import (
     IntegrityError,
     kv_checksum,
@@ -57,7 +57,7 @@ class HostBlockPool:
         self.capacity_bytes = capacity_bytes
         self.used_bytes = 0
         self._blocks: OrderedDict[int, tuple[np.ndarray, np.ndarray]] = OrderedDict()
-        self._lock = threading.Lock()
+        self._lock = race.Lock("kvbm.host_pool.lock")
         # demotion hook: evicted blocks cascade to the next tier (G3)
         self._on_evict = on_evict
 
@@ -126,7 +126,7 @@ class DiskBlockPool:
         # sh -> content checksum; None for blocks indexed by a pre-checksum
         # build (verify trivially until rewritten)
         self._crc: dict[int, int | None] = {}
-        self._lock = threading.Lock()
+        self._lock = race.Lock("kvbm.disk_pool.lock")
         os.makedirs(directory, exist_ok=True)
         self._load_index()
 
@@ -290,7 +290,7 @@ class RemoteBlockPool:
         self.bucket = f"{self.BUCKET}-{namespace}"
         self._written: set[int] = set()  # hashes this process has stored
         self.stored_bytes = 0  # payload bytes behind _written (tier gauge)
-        self._lock = threading.Lock()
+        self._lock = race.Lock("kvbm.remote_tier.lock")
 
     def _call(self, coro):
         fut = self._asyncio.run_coroutine_threadsafe(coro, self.loop)

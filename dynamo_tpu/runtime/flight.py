@@ -26,11 +26,10 @@ trace per request without the step thread ever touching contextvars.
 from __future__ import annotations
 
 import heapq
-import threading
 import time
 from typing import Any
 
-from dynamo_tpu.runtime import tracing
+from dynamo_tpu.runtime import race, tracing
 
 __all__ = ["FlightRecorder", "Timeline", "FLIGHT", "emit_request_spans"]
 
@@ -120,7 +119,7 @@ class FlightRecorder:
 
     def __init__(self, capacity: int = 128, keep_errors: int = 32,
                  keep_slow: int = 32):
-        self._lock = threading.Lock()
+        self._lock = race.Lock("flight.lock")
         self._active: dict[str, Timeline] = {}
         self._recent: list[Timeline] = []
         self._capacity = capacity
@@ -144,6 +143,7 @@ class FlightRecorder:
             tl.sampled = trace.sampled
             tl.parent_span_id = parent_span_id
         with self._lock:
+            race.write("flight.timeline")
             self._seq += 1
             tl.seq = self._seq
             self._active[request_id] = tl
@@ -154,6 +154,7 @@ class FlightRecorder:
         caller may be a step-thread path racing a finished stream)."""
         now = time.monotonic()
         with self._lock:
+            race.write("flight.timeline")
             tl = self._active.get(request_id)
             if tl is None:
                 return
@@ -176,6 +177,7 @@ class FlightRecorder:
         closed timeline (None when the id is unknown / already closed)."""
         now = time.monotonic()
         with self._lock:
+            race.write("flight.timeline")
             tl = self._active.pop(request_id, None)
             if tl is None:
                 return None
@@ -199,28 +201,45 @@ class FlightRecorder:
 
     # -- queries (event loop / admin) -------------------------------------
 
-    def lookup(self, request_id: str) -> Timeline | None:
-        with self._lock:
-            tl = self._active.get(request_id)
-            if tl is not None:
-                return tl
-            for bucket in (self._recent, self._errors,
-                           [t for _d, _s, t in self._slow]):
-                for tl in reversed(bucket):
-                    if tl.request_id == request_id:
-                        return tl
+    def _lookup_locked(self, request_id: str) -> Timeline | None:
+        tl = self._active.get(request_id)
+        if tl is not None:
+            return tl
+        for bucket in (self._recent, self._errors,
+                       [t for _d, _s, t in self._slow]):
+            for tl in reversed(bucket):
+                if tl.request_id == request_id:
+                    return tl
         return None
+
+    def lookup(self, request_id: str) -> Timeline | None:
+        """Find a timeline by id. An ACTIVE result is still being
+        mutated by the step thread — callers that serialize it must use
+        :meth:`snapshot`, which renders under the recorder lock."""
+        with self._lock:
+            return self._lookup_locked(request_id)
 
     def snapshot(self, request_id: str | None = None,
                  n: int = 16) -> dict[str, Any]:
         """Admin-op payload: one full timeline (by request id), or the
-        summary view (active + recent tail + retained errors/slowest)."""
+        summary view (active + recent tail + retained errors/slowest).
+
+        The by-id render happens UNDER the recorder lock: an active
+        timeline's event list (and the coalesced tail event's dict) is
+        still being mutated by the step thread, so serializing it
+        outside the lock races ``event()`` — ``dict.update`` on the
+        tail entry while ``to_dict`` iterates it can raise and, short
+        of that, tears the event. (This was a real pre-dynarace bug.)
+        """
         if request_id:
-            tl = self.lookup(request_id)
-            if tl is None:
-                return {"found": False, "request_id": request_id}
-            return {"found": True, "timeline": tl.to_dict()}
+            with self._lock:
+                race.read("flight.timeline")
+                tl = self._lookup_locked(request_id)
+                if tl is None:
+                    return {"found": False, "request_id": request_id}
+                return {"found": True, "timeline": tl.to_dict()}
         with self._lock:
+            race.read("flight.timeline")
             slowest = sorted(self._slow, key=lambda it: -it[0])
             return {
                 "active": [t.summary() for t in self._active.values()],

@@ -51,6 +51,7 @@ from typing import Any
 
 import msgpack
 
+from dynamo_tpu.runtime import race
 from dynamo_tpu.runtime.context import spawn
 from dynamo_tpu.runtime.faults import FAULTS
 from dynamo_tpu.runtime.hub import InMemoryHub, _Lease
@@ -254,6 +255,7 @@ class HubStore:
         thread keep writing through its fd into an inode the inline
         path already renamed onto hub.snap — corrupting the live
         snapshot."""
+        race.acquire(self, "hub.snapshot")
         new_gen = self.gen + 1
         state = dict(state, gen=new_gen)
         # NOT with_suffix: that would REPLACE ".snap" ("hub.tmp7") and
@@ -581,6 +583,7 @@ class DurableHub(InMemoryHub):
         self.wal_seq += 1
         self._recent.append((self.wal_seq, rec))
         if self._capture_log is not None:
+            race.write("hub.capture_log")
             self._capture_log.append(rec)
         for q in self._repl_listeners:
             try:
@@ -642,7 +645,11 @@ class DurableHub(InMemoryHub):
             ):
                 state = self._state()
                 pending: list[dict[str, Any]] = []
+                race.write("hub.capture_log")
                 self._capture_log = pending
+                # the to_thread dispatch is the HB edge carrying the
+                # captured ``state`` into the snapshot worker thread
+                race.release(self.store, "hub.snapshot")
                 try:
                     tmp, new_gen = await asyncio.to_thread(
                         self.store.write_snapshot_tmp, state

@@ -1,0 +1,117 @@
+"""dynarace annotation shim: the package-side half of tools/dynarace.
+
+Production code annotates its synchronization vocabulary through this
+module — ``race.Lock(name)`` / ``race.Queue(name)`` / ``race.Event(name)``
+factories for the primitives themselves, ``race.release/acquire`` for
+ad-hoc happens-before edges (asyncio hand-offs, ``asyncio.to_thread``
+boundaries), ``race.fork/join`` around thread lifecycles, and
+``race.read/write`` for the catalogued shared state in
+``tools/dynarace/registry.py``.
+
+**Disabled (the default, ``DYN_RACE`` unset): everything here is a
+no-op.** The factories return the plain stdlib objects (same types, zero
+wrapper overhead on every subsequent acquire/put/set), and the annotate
+functions are a shared ``_noop`` — one dict lookup and an empty call.
+Nothing under ``tools/`` is imported. A tier-1 test
+(tests/test_dynarace.py) asserts both properties: the import graph stays
+clean and the disabled-path annotation cost is noise.
+
+**Enabled (``DYN_RACE=1``):** the factories return instrumented wrappers
+and the annotate functions feed the vector-clock happens-before detector
+(tools/dynarace/detector.py). With ``DYN_RACE_SCHED=<seed>`` also set,
+the wrappers additionally run the seeded deterministic schedule explorer
+(tools/dynarace/sched.py): replayable yield points at sync boundaries,
+biased toward just-released locks and just-put queue items.
+
+``tools.dynarace`` lives in the repo checkout, not in the installed
+package; if it is missing while ``DYN_RACE=1``, the shim warns once and
+stays no-op — the flag is a dev/CI affordance, never a hard dependency.
+
+Annotation discipline (docs/CONCURRENCY.md):
+
+- annotate at per-step / per-request granularity, never per token;
+- every ``race.read/write`` state string must be catalogued in
+  tools/dynarace/registry.py ``SHARED_STATE`` (two-way, test-enforced
+  against dynalint's catalog like the DL006 fault sites);
+- every named ``race.Lock/Queue/Event`` must be catalogued in
+  ``SYNC_POINTS``.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import queue
+import threading
+from typing import Any
+
+__all__ = [
+    "ENABLED",
+    "Event",
+    "Lock",
+    "Queue",
+    "RLock",
+    "acquire",
+    "fork",
+    "join",
+    "read",
+    "release",
+    "write",
+]
+
+ENABLED = os.environ.get("DYN_RACE", "") == "1"
+
+
+def _noop(*_args: Any, **_kwargs: Any) -> None:
+    return None
+
+
+# annotate functions (rebound below when enabled). Call through the
+# module attribute (``race.write(...)``) so enabling rebinds every site.
+read = _noop  # read(state: str) — catalogued shared-state read
+write = _noop  # write(state: str) — catalogued shared-state write
+acquire = _noop  # acquire(token, site) — HB edge: token's clock -> me
+release = _noop  # release(token, site) — HB edge: me -> token's clock
+fork = _noop  # fork(thread) — call in the parent just before .start()
+join = _noop  # join(thread) — call in the parent after .join() returns
+
+
+def Lock(name: str = "") -> "threading.Lock":  # noqa: N802 - factory
+    """A ``threading.Lock`` (instrumented under DYN_RACE=1)."""
+    return threading.Lock()
+
+
+def RLock(name: str = "") -> "threading.RLock":  # noqa: N802 - factory
+    """A ``threading.RLock`` (instrumented under DYN_RACE=1)."""
+    return threading.RLock()
+
+
+def Event(name: str = "") -> "threading.Event":  # noqa: N802 - factory
+    """A ``threading.Event`` (instrumented under DYN_RACE=1)."""
+    return threading.Event()
+
+
+def Queue(name: str = "", maxsize: int = 0) -> "queue.Queue":  # noqa: N802
+    """A ``queue.Queue`` (instrumented under DYN_RACE=1)."""
+    return queue.Queue(maxsize=maxsize)
+
+
+if ENABLED:  # pragma: no cover - exercised via subprocess tests
+    try:
+        from tools.dynarace import runtime as _rt
+    except Exception:  # noqa: BLE001 - installed package without tools/
+        logging.getLogger("dynamo.race").warning(
+            "DYN_RACE=1 but tools.dynarace is not importable; "
+            "race annotations stay no-op"
+        )
+    else:
+        read = _rt.read
+        write = _rt.write
+        acquire = _rt.acquire
+        release = _rt.release
+        fork = _rt.fork
+        join = _rt.join
+        Lock = _rt.Lock  # noqa: F811 - deliberate enable-time rebind
+        RLock = _rt.RLock  # noqa: F811
+        Event = _rt.Event  # noqa: F811
+        Queue = _rt.Queue  # noqa: F811

@@ -23,6 +23,18 @@ python -m tools.dynalint --no-external
 python -m tools.dynalint --no-external --format=sarif \
   > "${DYN_SARIF_OUT:-dynalint_nightly.sarif}"
 
+# dynarace tier: vector-clock happens-before detection over the
+# concurrency-heavy test set, then an 8-seed deterministic schedule
+# sweep (seeded perturbation at every instrumented sync boundary —
+# same seed replays the same interleaving). Exit-code gated: any new
+# unsuppressed DR001/DR002/DR003 race fails the nightly before the
+# soaks run; the SARIF artifact sits next to dynalint's for upload.
+# DYN_FAULTS cleared: injected transport faults would perturb the
+# pass/fail of the underlying tests, not the race detection itself.
+DYN_FAULTS="" python -m tools.dynarace \
+  --sweep "${DYN_RACE_SWEEP:-8}" \
+  --sarif-out "${DYN_RACE_SARIF_OUT:-dynarace_nightly.sarif}"
+
 # cluster-scale chaos sim (dynamo_tpu/sim): the full scenario matrix at
 # 100s-of-workers scale — partitions, leader SIGKILL mid-commit-storm,
 # churn under trace replay, breaker + tenant storms — with the

@@ -409,7 +409,12 @@ async def test_mixed_tenant_overload_acceptance():
             assert first_seen is not None
             contended.append(first_seen)
         p99_contended = max(contended)
-        assert p99_contended <= max(2 * p50_uncontended, 0.35), (
+        # dynarace's schedule explorer (DYN_RACE_SCHED) injects seeded
+        # sleeps at every sync boundary; under perturbation the ordering
+        # invariants below still hold but wall-clock SLO bars do not —
+        # dilate the TTFT bound instead of skipping the assertion.
+        dilate = 10.0 if os.environ.get("DYN_RACE_SCHED") else 1.0
+        assert p99_contended <= dilate * max(2 * p50_uncontended, 0.35), (
             f"interactive TTFT not held: contended {contended} vs "
             f"uncontended p50 {p50_uncontended:.4f}"
         )

@@ -1,0 +1,112 @@
+"""Shared SARIF 2.1.0 emitter for the repo's analysis tools.
+
+Lifted out of tools/dynalint/cli.py (PR 19's ``--format=sarif``) so
+dynalint (static findings) and dynarace (dynamic race reports) emit the
+same document shape for code-scanning upload: one run, the full rule
+catalog under ``tool.driver.rules``, results with physical locations,
+and stable ``partialFingerprints`` (each tool's line-independent
+fingerprint, so alerts track across rebases the way the baselines do).
+
+Both callers adapt their native finding type into :class:`SarifResult`;
+nothing here imports either tool.
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass, field
+
+SCHEMA_URI = (
+    "https://raw.githubusercontent.com/oasis-tcs/"
+    "sarif-spec/master/Schemata/sarif-schema-2.1.0.json"
+)
+
+
+@dataclass
+class SarifRule:
+    """One catalog entry for ``tool.driver.rules``."""
+
+    id: str
+    name: str
+    short: str
+    full: str
+    level: str = "error"
+
+
+@dataclass
+class SarifResult:
+    """One finding with its physical location and fingerprint."""
+
+    rule_id: str
+    message: str
+    uri: str  # repo-relative path
+    line: int  # 1-based
+    col: int  # 1-based
+    fingerprint: str
+    level: str = "error"
+    # extra location frames (e.g. the OTHER side of a race), rendered
+    # as additional locations on the same result
+    related: list[tuple[str, int, str]] = field(default_factory=list)
+
+
+def _location(uri: str, line: int, col: int, message: str | None = None):
+    loc: dict = {
+        "physicalLocation": {
+            "artifactLocation": {"uri": uri, "uriBaseId": "SRCROOT"},
+            "region": {"startLine": max(int(line), 1),
+                       "startColumn": max(int(col), 1)},
+        },
+    }
+    if message:
+        loc["message"] = {"text": message}
+    return loc
+
+
+def render(
+    tool_name: str,
+    info_uri: str,
+    rules: list[SarifRule],
+    results: list[SarifResult],
+    fingerprint_key: str,
+) -> str:
+    """One SARIF 2.1.0 document as an indented JSON string."""
+    rule_index = {r.id: i for i, r in enumerate(rules)}
+    sarif_rules = [
+        {
+            "id": r.id,
+            "name": r.name,
+            "shortDescription": {"text": r.short},
+            "fullDescription": {"text": r.full},
+            "defaultConfiguration": {"level": r.level},
+        }
+        for r in rules
+    ]
+    sarif_results = []
+    for f in results:
+        entry = {
+            "ruleId": f.rule_id,
+            "ruleIndex": rule_index.get(f.rule_id, -1),
+            "level": f.level,
+            "message": {"text": f.message},
+            "locations": [_location(f.uri, f.line, f.col)],
+            "partialFingerprints": {fingerprint_key: f.fingerprint},
+        }
+        if f.related:
+            entry["relatedLocations"] = [
+                _location(uri, line, 1, msg)
+                for uri, line, msg in f.related
+            ]
+        sarif_results.append(entry)
+    return json.dumps({
+        "$schema": SCHEMA_URI,
+        "version": "2.1.0",
+        "runs": [{
+            "tool": {"driver": {
+                "name": tool_name,
+                "informationUri": info_uri,
+                "rules": sarif_rules,
+            }},
+            "originalUriBaseIds": {"SRCROOT": {"uri": "file:///"}},
+            "results": sarif_results,
+        }],
+    }, indent=2)

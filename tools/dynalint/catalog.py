@@ -170,6 +170,42 @@ FALLBACK_GATES: dict[str, str] = {
     "supports_fused": "generic capability probe spelling",
 }
 
+# cross-thread shared state the concurrency tooling tracks, spelled
+# "owner.attr". This is the SAME registry as tools/dynarace/registry.py
+# SHARED_STATE — dynalint's static DL005 layer and dynarace's dynamic
+# happens-before layer must agree on what the cross-thread state IS, so
+# the two copies are test-enforced identical (tests/test_dynarace.py,
+# the DL006 fault-site discipline). DL005 findings whose attribute
+# matches a catalogued suffix cite the entry's documented discipline.
+SHARED_STATE: dict[str, str] = {
+    "engine.step_times": (
+        "engine/core.py step-latency deque — step thread appends, "
+        "telemetry sampler (event loop) drains via popleft; GIL-atomic "
+        "bounded deque, no lock (suppressed, see suppressions.py)"
+    ),
+    "engine.burst_fills": (
+        "engine/core.py burst-fill deque — same single-appender/"
+        "single-drainer deque discipline as engine.step_times"
+    ),
+    "flight.timeline": (
+        "runtime/flight.py timeline ring (events/attrs/retention "
+        "buckets) — step thread and event loop both enter; EVERY access "
+        "must hold FlightRecorder._lock (flight.lock), including "
+        "snapshot reads (the pre-dynarace snapshot-outside-lock race)"
+    ),
+    "kvbm.checksums": (
+        "kvbm/manager.py block-checksum dict — offload thread stamps on "
+        "offer, step thread reads on onboard and pops on corruption; "
+        "guarded by kvbm.manager.lock (the pre-dynarace unguarded-dict "
+        "race)"
+    ),
+    "hub.capture_log": (
+        "runtime/hub_store.py compaction capture list — event-loop-only "
+        "mutation; the snapshot worker thread sees state only through "
+        "the hub.snapshot to_thread hand-off edge"
+    ),
+}
+
 # metric name (without the dynamo_ prefix MetricsRegistry adds) -> meaning
 METRIC_NAMES: dict[str, str] = {
     "http_requests_total": "HTTP requests by model/route/status",

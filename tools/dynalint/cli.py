@@ -96,63 +96,36 @@ def changed_files(
 
 
 def render_sarif(findings) -> str:
-    """SARIF 2.1.0 document for code-scanning upload: one run, the full
-    rule catalog in tool.driver.rules, stable partialFingerprints (the
-    finding's line-independent fingerprint, so annotations track across
-    rebases the same way the baseline does)."""
-    rule_ids = sorted(RULES)
-    sarif_rules = []
-    for rid in rule_ids:
+    """SARIF 2.1.0 document for code-scanning upload, via the shared
+    tools/_sarif.py emitter (dynarace emits the same shape): one run,
+    the full rule catalog in tool.driver.rules, stable
+    partialFingerprints (the finding's line-independent fingerprint, so
+    annotations track across rebases the same way the baseline does)."""
+    from tools import _sarif
+
+    rules = []
+    for rid in sorted(RULES):
         rule = RULES[rid]
         doc = (rule.__doc__ or "").strip().splitlines()
-        sarif_rules.append({
-            "id": rid,
-            "name": rule.name,
-            "shortDescription": {"text": doc[0] if doc else rule.name},
-            "fullDescription": {"text": " ".join(
-                line.strip() for line in doc
-            ).strip()},
-            "defaultConfiguration": {"level": "error"},
-        })
-    results = []
-    for f in findings:
-        msg = f.message + (f"  [fix: {f.hint}]" if f.hint else "")
-        results.append({
-            "ruleId": f.rule,
-            "ruleIndex": rule_ids.index(f.rule),
-            "level": "error",
-            "message": {"text": msg},
-            "locations": [{
-                "physicalLocation": {
-                    "artifactLocation": {
-                        "uri": f.path,
-                        "uriBaseId": "SRCROOT",
-                    },
-                    "region": {
-                        "startLine": f.line,
-                        "startColumn": f.col + 1,
-                    },
-                },
-            }],
-            "partialFingerprints": {
-                "dynalintFingerprint/v1": f.fingerprint,
-            },
-        })
-    return json.dumps({
-        "$schema": ("https://raw.githubusercontent.com/oasis-tcs/"
-                    "sarif-spec/master/Schemata/sarif-schema-2.1.0.json"),
-        "version": "2.1.0",
-        "runs": [{
-            "tool": {"driver": {
-                "name": "dynalint",
-                "informationUri":
-                    "https://example.invalid/dynamo-tpu/tools/dynalint",
-                "rules": sarif_rules,
-            }},
-            "originalUriBaseIds": {"SRCROOT": {"uri": "file:///"}},
-            "results": results,
-        }],
-    }, indent=2)
+        rules.append(_sarif.SarifRule(
+            id=rid, name=rule.name,
+            short=doc[0] if doc else rule.name,
+            full=" ".join(line.strip() for line in doc).strip(),
+        ))
+    results = [
+        _sarif.SarifResult(
+            rule_id=f.rule,
+            message=f.message + (f"  [fix: {f.hint}]" if f.hint else ""),
+            uri=f.path, line=f.line, col=f.col + 1,
+            fingerprint=f.fingerprint,
+        )
+        for f in findings
+    ]
+    return _sarif.render(
+        "dynalint",
+        "https://example.invalid/dynamo-tpu/tools/dynalint",
+        rules, results, "dynalintFingerprint/v1",
+    )
 
 
 def render_github(f) -> str:

@@ -501,16 +501,31 @@ class CrossThreadMutation:
                     out.setdefault(attr, []).append((name, node))
             return out
 
+        # the dynarace registry (mirrored in catalog.SHARED_STATE) keyed
+        # by attribute suffix: a flagged attr that is tracked dynamically
+        # cites its documented discipline in the finding
+        tracked = {
+            key.rsplit(".", 1)[-1]: (key, desc)
+            for key, desc in ctx.catalog.SHARED_STATE.items()
+        }
         tw, aw = writes(thread_world), writes(async_world)
         for attr in sorted(set(tw) & set(aw)):
             a_method, a_node = aw[attr][0]
             t_method = tw[attr][0][0]
+            message = (
+                f"self.{attr} rebound from both the step thread "
+                f"({t_method}) and a coroutine ({a_method}) with "
+                "no lock/queue mediation"
+            )
+            if attr.lstrip("_") in tracked:
+                key, desc = tracked[attr.lstrip("_")]
+                message += (
+                    f" (dynarace-tracked as {key!r}: {desc})"
+                )
             yield Finding(
                 rule=self.id, path=ctx.path,
                 line=a_node.lineno, col=a_node.col_offset,
-                message=f"self.{attr} rebound from both the step thread "
-                        f"({t_method}) and a coroutine ({a_method}) with "
-                        "no lock/queue mediation",
+                message=message,
                 hint="route one side through a queue/call_soon_threadsafe, "
                      "guard both with a lock, or make one side read-only",
                 context=f"{cls.name}", detail=attr,

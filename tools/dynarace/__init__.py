@@ -1,0 +1,26 @@
+"""dynarace: happens-before race detector + deterministic schedule
+explorer for the repo's step-thread/event-loop concurrency model.
+
+Two layers, both keyed to the same instrumentation vocabulary
+(``dynamo_tpu/runtime/race.py`` shim, no-op unless ``DYN_RACE=1``):
+
+1. **Vector-clock happens-before detection** (detector.py): every
+   instrumented lock/queue/event/thread operation maintains vector
+   clocks; every ``race.read/write`` on a catalogued shared state
+   (registry.py) is checked against the last conflicting access — a
+   write racing a read/write with no happens-before edge is reported
+   with both stack pairs and a line-independent fingerprint, gated
+   through the same baseline/suppression discipline as dynalint.
+
+2. **Seeded deterministic schedule exploration** (sched.py,
+   ``DYN_RACE_SCHED=<seed>``): replayable yield points at instrumented
+   boundaries, biased toward just-released locks and just-put queue
+   items (loom/rr-style), so order-dependent bugs surface on a named
+   seed instead of once-per-thousand chaos runs. The yield-point trace
+   is a pure function of (seed, site, kind, occurrence index): the same
+   seed replays the same perturbation.
+
+Entry points: ``python -m tools.dynarace`` (the nightly gate: race
+detection + N-seed schedule sweep over the concurrency test subset),
+and in-process via ``tools.dynarace.runtime`` for regression tests.
+"""

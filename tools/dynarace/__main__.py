@@ -1,0 +1,5 @@
+import sys
+
+from tools.dynarace.cli import main
+
+sys.exit(main())

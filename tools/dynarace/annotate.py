@@ -1,0 +1,25 @@
+"""Annotation façade — re-exports the in-package shim.
+
+Instrumented production code imports ``dynamo_tpu.runtime.race`` (so
+the installed package never depends on ``tools/``); tests and tooling
+may prefer this spelling:
+
+    from tools.dynarace import annotate
+    annotate.write("engine.step_times")
+
+Both names bind the SAME functions: no-ops unless ``DYN_RACE=1``.
+"""
+
+from dynamo_tpu.runtime.race import (  # noqa: F401
+    ENABLED,
+    Event,
+    Lock,
+    Queue,
+    RLock,
+    acquire,
+    fork,
+    join,
+    read,
+    release,
+    write,
+)

@@ -1,0 +1,289 @@
+"""dynarace CLI: the nightly race gate.
+
+``python -m tools.dynarace`` runs, in order:
+
+1. **Race detection** — the concurrency test subset once under
+   ``DYN_RACE=1``: every process (the pytest process AND the hub/sim
+   subprocesses it spawns) dumps a vector-clock race report into a
+   scratch directory; reports aggregate, dedup by fingerprint, and gate
+   against the committed baseline (tools/dynarace/baseline.json —
+   policy: EMPTY; suppressions with written HB justifications live in
+   suppressions.py, not here).
+2. **Seeded schedule sweep** (``--sweep N``) — the sweep subset once
+   per seed with ``DYN_RACE_SCHED=<seed>`` also set, so order-dependent
+   bugs surface on a NAMED seed. A red seed is replayed with exactly
+   ``DYN_RACE=1 DYN_RACE_SCHED=<seed> pytest <test>``.
+
+Exit code 0 = no test failure, no unsuppressed/unbaselined race across
+every run. ``--sarif-out`` additionally writes a SARIF 2.1.0 artifact
+via the shared tools/_sarif.py emitter (the same shape dynalint
+uploads).
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import time
+from pathlib import Path
+
+from tools.dynarace import registry
+
+REPO_ROOT = Path(__file__).resolve().parents[2]
+DEFAULT_BASELINE = Path(__file__).resolve().parent / "baseline.json"
+
+# the concurrency tier the detector rides on: hub replication smoke,
+# overload acceptance (step thread vs admission vs preemption), fault
+# injection, cluster-sim smoke
+DETECT_TESTS = [
+    "tests/test_hub_replication.py::test_election_smoke",
+    "tests/test_hub_replication.py::test_replication_smoke",
+    "tests/test_overload.py::test_mixed_tenant_overload_acceptance",
+    "tests/test_overload.py::"
+    "test_preempted_stream_onboards_from_host_tier_after_g1_evict",
+    "tests/test_faults.py",
+    "tests/test_cluster_sim.py::test_sim_smoke_partition_and_churn",
+]
+# the per-seed sweep subset: kept tight so an 8-seed sweep stays
+# affordable — election/commit ordering + the engine admission/
+# preemption path are where seeded reordering has caught bugs
+SWEEP_TESTS = [
+    "tests/test_hub_replication.py::test_election_smoke",
+    "tests/test_overload.py::test_mixed_tenant_overload_acceptance",
+]
+
+RULE_DOCS = {
+    "DR001": ("write-write-race",
+              "two writes to a catalogued shared state with no "
+              "happens-before edge between them"),
+    "DR002": ("write-read-race",
+              "a read of a catalogued shared state unordered with the "
+              "last write"),
+    "DR003": ("read-write-race",
+              "a write to a catalogued shared state unordered with a "
+              "prior read"),
+}
+
+
+def _race_key(race: dict) -> str:
+    return race["fingerprint"]
+
+
+def _race_site(race: dict, side: str) -> tuple[str, int]:
+    """(repo-relative-ish path, line) of one side's innermost frame."""
+    stack = race.get(side, {}).get("stack") or ["<unknown>:0 in ?"]
+    head = stack[0]
+    path, _, rest = head.partition(":")
+    try:
+        line = int(rest.split(" ", 1)[0])
+    except ValueError:
+        line = 1
+    return path, line
+
+
+def run_pytest(
+    tests: list[str],
+    report_dir: str,
+    seed: str | None,
+    timeout: float,
+    extra_env: dict[str, str] | None = None,
+) -> int:
+    env = dict(os.environ)
+    env["DYN_RACE"] = "1"
+    env["DYN_RACE_REPORT"] = report_dir
+    env.pop("DYN_RACE_SCHED", None)
+    if seed is not None:
+        env["DYN_RACE_SCHED"] = seed
+    env.update(extra_env or {})
+    proc = subprocess.run(
+        [sys.executable, "-m", "pytest", "-q", "-p", "no:cacheprovider",
+         *tests],
+        cwd=REPO_ROOT, env=env, timeout=timeout,
+    )
+    return proc.returncode
+
+
+def collect_reports(report_dir: str) -> tuple[list[dict], list[dict], int]:
+    """(unsuppressed races, suppressed races, ops) aggregated over every
+    per-process report in the directory, fingerprint-deduped."""
+    races: dict[str, dict] = {}
+    suppressed: dict[str, dict] = {}
+    ops = 0
+    for path in sorted(glob.glob(os.path.join(report_dir, "race_*.json"))):
+        try:
+            doc = json.loads(Path(path).read_text())
+        except (OSError, json.JSONDecodeError):
+            continue
+        ops += int(doc.get("ops", 0))
+        for r in doc.get("races", []):
+            races.setdefault(_race_key(r), r)
+        for r in doc.get("suppressed", []):
+            suppressed.setdefault(_race_key(r), r)
+    return list(races.values()), list(suppressed.values()), ops
+
+
+def render_text(race: dict) -> str:
+    lines = [
+        f"{race['rule']} race on {race['state']!r} "
+        f"[{race['fingerprint']}]",
+        f"  prior   ({race['prior'].get('thread', '?')}):",
+        *(f"    {fr}" for fr in race["prior"].get("stack", [])),
+        f"  current ({race['current'].get('thread', '?')}):",
+        *(f"    {fr}" for fr in race["current"].get("stack", [])),
+    ]
+    return "\n".join(lines)
+
+
+def render_sarif(races: list[dict]) -> str:
+    from tools import _sarif
+
+    rules = [
+        _sarif.SarifRule(id=rid, name=name, short=doc, full=doc)
+        for rid, (name, doc) in sorted(RULE_DOCS.items())
+    ]
+    results = []
+    for r in races:
+        uri, line = _race_site(r, "current")
+        p_uri, p_line = _race_site(r, "prior")
+        state = r["state"]
+        desc = registry.SHARED_STATE.get(state, "")
+        results.append(_sarif.SarifResult(
+            rule_id=r["rule"],
+            message=(
+                f"data race on {state!r}: this access has no "
+                f"happens-before edge to the conflicting access on "
+                f"thread {r['prior'].get('thread', '?')!r}. {desc}"
+            ),
+            uri=uri, line=line, col=1,
+            fingerprint=r["fingerprint"],
+            related=[(p_uri, p_line,
+                      f"conflicting access "
+                      f"({r['prior'].get('thread', '?')})")],
+        ))
+    return _sarif.render(
+        "dynarace",
+        "https://example.invalid/dynamo-tpu/tools/dynarace",
+        rules, results, "dynaraceFingerprint/v1",
+    )
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m tools.dynarace",
+        description="Happens-before race gate for dynamo-tpu.",
+    )
+    ap.add_argument("tests", nargs="*", default=None,
+                    help="pytest node ids for the detect pass "
+                         "(default: the concurrency tier)")
+    ap.add_argument("--sweep", type=int, default=0, metavar="N",
+                    help="additionally run the sweep subset under N "
+                         "schedule seeds (seed-base..seed-base+N-1)")
+    ap.add_argument("--seed-base", type=int, default=0)
+    ap.add_argument("--sweep-tests", nargs="*", default=None,
+                    help="pytest node ids for the per-seed sweep "
+                         "(default: election smoke + overload "
+                         "acceptance)")
+    ap.add_argument("--baseline", default=str(DEFAULT_BASELINE))
+    ap.add_argument("--no-baseline", action="store_true")
+    ap.add_argument("--sarif-out", default=None, metavar="PATH",
+                    help="also write a SARIF 2.1.0 artifact of the "
+                         "unsuppressed races")
+    ap.add_argument("--show-suppressed", action="store_true")
+    ap.add_argument("--timeout", type=float,
+                    default=float(os.environ.get("DYN_RACE_TIMEOUT",
+                                                 "1800")),
+                    help="per-pytest-run timeout (seconds)")
+    args = ap.parse_args(argv)
+
+    detect_tests = args.tests or DETECT_TESTS
+    sweep_tests = (args.sweep_tests if args.sweep_tests is not None
+                   else SWEEP_TESTS)
+
+    t0 = time.monotonic()
+    rc = 0
+    all_races: dict[str, dict] = {}
+    all_suppressed: dict[str, dict] = {}
+    total_ops = 0
+
+    with tempfile.TemporaryDirectory(prefix="dynarace_") as tmp:
+        print(f"dynarace: detect pass over {len(detect_tests)} "
+              f"node(s)", file=sys.stderr)
+        detect_dir = os.path.join(tmp, "detect")
+        test_rc = run_pytest(detect_tests, detect_dir, None, args.timeout)
+        if test_rc != 0:
+            print(f"dynarace: detect-pass pytest failed (rc={test_rc})",
+                  file=sys.stderr)
+            rc = 1
+        races, suppressed, ops = collect_reports(detect_dir)
+        for r in races:
+            all_races.setdefault(_race_key(r), r)
+        for r in suppressed:
+            all_suppressed.setdefault(_race_key(r), r)
+        total_ops += ops
+
+        for i in range(args.sweep):
+            seed = str(args.seed_base + i)
+            print(f"dynarace: schedule sweep seed={seed}",
+                  file=sys.stderr)
+            seed_dir = os.path.join(tmp, f"seed_{seed}")
+            test_rc = run_pytest(
+                sweep_tests, seed_dir, seed, args.timeout
+            )
+            if test_rc != 0:
+                print(
+                    f"dynarace: seed {seed} FAILED — replay with "
+                    f"DYN_RACE=1 DYN_RACE_SCHED={seed} python -m "
+                    f"pytest {' '.join(sweep_tests)}",
+                    file=sys.stderr,
+                )
+                rc = 1
+            races, suppressed, ops = collect_reports(seed_dir)
+            for r in races:
+                all_races.setdefault(_race_key(r), r)
+            for r in suppressed:
+                all_suppressed.setdefault(_race_key(r), r)
+            total_ops += ops
+
+    baseline_fps: set[str] = set()
+    if not args.no_baseline:
+        try:
+            doc = json.loads(Path(args.baseline).read_text())
+            baseline_fps = {e["fingerprint"]
+                            for e in doc.get("findings", [])}
+        except (OSError, json.JSONDecodeError):
+            pass
+    new = [r for fp, r in sorted(all_races.items())
+           if fp not in baseline_fps]
+
+    for r in new:
+        print(render_text(r))
+    if args.show_suppressed:
+        for r in sorted(all_suppressed.values(),
+                        key=lambda x: x["fingerprint"]):
+            print(f"[suppressed: {r.get('suppressed_reason', '')[:80]}]")
+            print(render_text(r))
+    if args.sarif_out:
+        Path(args.sarif_out).write_text(render_sarif(new))
+        print(f"dynarace: SARIF artifact -> {args.sarif_out}",
+              file=sys.stderr)
+
+    dt = time.monotonic() - t0
+    print(
+        f"dynarace: {len(new)} unsuppressed race(s), "
+        f"{len(all_races) - len(new)} baselined, "
+        f"{len(all_suppressed)} suppressed over {total_ops} "
+        f"instrumented ops in {dt:.1f}s",
+        file=sys.stderr,
+    )
+    if new:
+        rc = 1
+    return rc
+
+
+if __name__ == "__main__":  # pragma: no cover
+    sys.exit(main())

@@ -1,0 +1,313 @@
+"""Vector-clock happens-before race detector (FastTrack-lite).
+
+Model
+-----
+Each thread carries a vector clock ``C_t: tid -> int``. Each sync token
+(lock, queue, event, thread object, ad-hoc hand-off) carries a clock
+``L``. The instrumented operations maintain:
+
+- ``release(token)``: ``L := L ⊔ C_t``; then ``C_t[t] += 1`` (the
+  releasing thread's subsequent work is NOT ordered before the release).
+- ``acquire(token)``: ``C_t := C_t ⊔ L``.
+- ``fork(thread)``: release on the thread object; the child's first
+  instrumented operation acquires from it (detected lazily via
+  ``threading.current_thread()``).
+- ``join(thread)``: the parent acquires the child's final clock.
+
+Shared state uses last-access epochs: an access by thread ``t`` at
+clock value ``k = C_t[t]`` happens-before a later access by ``u`` iff
+``C_u[t] >= k``. Per catalogued state we keep the last write epoch and
+a read map; on each access the conflicting prior epochs are checked and
+violations recorded as races:
+
+- DR001 write-write  (two unordered writes)
+- DR002 write-read   (a read unordered with the last write)
+- DR003 read-write   (a write unordered with a prior read)
+
+Reports carry both sides' short stacks, the thread names, and a
+line-independent fingerprint ``sha1(rule|state|siteA|siteB)`` with
+sites normalized to ``path::function`` — DL005-style, so baselines and
+suppressions survive rebases.
+
+Everything is guarded by one internal (uninstrumented) lock; the
+detector never calls back into instrumented code.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import sys
+import threading
+from dataclasses import dataclass, field
+from typing import Any
+
+from tools.dynarace.suppressions import SUPPRESSED_STATES
+
+# frames from these path fragments are instrumentation, not evidence
+_SKIP_FRAGMENTS = ("tools/dynarace/", "dynamo_tpu/runtime/race.py")
+_STACK_DEPTH = 5
+
+
+def _site_stack() -> list[str]:
+    """Short stack of the instrumented call: up to _STACK_DEPTH frames
+    of ``path:line in func``, innermost first, skipping dynarace's own
+    frames. Cheap enough to capture at every catalogued access (this
+    only ever runs under DYN_RACE=1)."""
+    out: list[str] = []
+    f = sys._getframe(1)
+    while f is not None and len(out) < _STACK_DEPTH:
+        fn = f.f_code.co_filename.replace(os.sep, "/")
+        if not any(s in fn for s in _SKIP_FRAGMENTS):
+            short = "/".join(fn.rsplit("/", 3)[-3:])
+            out.append(f"{short}:{f.f_lineno} in {f.f_code.co_name}")
+        f = f.f_back
+    return out
+
+
+def _norm_site(stack: list[str]) -> str:
+    """Line-independent anchor of a stack: ``path::func`` of the
+    innermost non-instrumentation frame."""
+    if not stack:
+        return "<unknown>"
+    head = stack[0]
+    path, _, rest = head.partition(":")
+    func = rest.partition(" in ")[2]
+    return f"{path}::{func}"
+
+
+@dataclass
+class Access:
+    """One remembered shared-state access epoch."""
+
+    tid: int
+    clock: int  # the accessor's own component at access time
+    thread_name: str
+    stack: list[str] = field(default_factory=list)
+
+
+@dataclass
+class Race:
+    """One detected (or suppressed) race."""
+
+    rule: str  # DR001 | DR002 | DR003
+    state: str
+    prior: Access
+    current: Access
+    suppressed_reason: str | None = None
+
+    @property
+    def fingerprint(self) -> str:
+        a = _norm_site(self.prior.stack)
+        b = _norm_site(self.current.stack)
+        lo, hi = sorted((a, b))
+        raw = f"{self.rule}|{self.state}|{lo}|{hi}"
+        return hashlib.sha1(raw.encode()).hexdigest()[:12]
+
+    def to_dict(self) -> dict[str, Any]:
+        return {
+            "rule": self.rule,
+            "state": self.state,
+            "fingerprint": self.fingerprint,
+            "suppressed_reason": self.suppressed_reason,
+            "prior": {
+                "thread": self.prior.thread_name,
+                "stack": self.prior.stack,
+            },
+            "current": {
+                "thread": self.current.thread_name,
+                "stack": self.current.stack,
+            },
+        }
+
+    def render(self) -> str:
+        kind = {
+            "DR001": "write/write",
+            "DR002": "write/read",
+            "DR003": "read/write",
+        }[self.rule]
+        lines = [
+            f"{self.rule} {kind} race on {self.state!r} "
+            f"[{self.fingerprint}]",
+            f"  prior   ({self.prior.thread_name}):",
+            *(f"    {fr}" for fr in self.prior.stack),
+            f"  current ({self.current.thread_name}):",
+            *(f"    {fr}" for fr in self.current.stack),
+        ]
+        return "\n".join(lines)
+
+
+class _Var:
+    __slots__ = ("last_write", "reads")
+
+    def __init__(self) -> None:
+        self.last_write: Access | None = None
+        self.reads: dict[int, Access] = {}
+
+
+class Detector:
+    """Process-wide happens-before state. One instance per process
+    (module singleton in tools/dynarace/runtime.py)."""
+
+    def __init__(self) -> None:
+        # plain threading.Lock: never instrumented, never calls out
+        self._lock = threading.Lock()
+        self._clocks: dict[int, dict[int, int]] = {}  # tid -> VC
+        self._tokens: dict[int, dict[int, int]] = {}  # id(obj) -> VC
+        # strong refs so id() keys can't be reused under us
+        self._token_refs: dict[int, Any] = {}
+        self._vars: dict[str, _Var] = {}
+        self._races: list[Race] = []
+        self._seen_fps: set[str] = set()
+        self.ops = 0  # instrumented-operation counter (stats)
+
+    # -- clock plumbing ---------------------------------------------------
+
+    def _clock(self, tid: int) -> dict[int, int]:
+        c = self._clocks.get(tid)
+        if c is None:
+            c = {tid: 1}
+            # fork edge: a brand-new thread inherits the clock its
+            # parent released onto the Thread object before .start()
+            tok = self._tokens.get(id(threading.current_thread()))
+            if tok is not None:
+                for t, k in tok.items():
+                    if c.get(t, 0) < k:
+                        c[t] = k
+            self._clocks[tid] = c
+        return c
+
+    @staticmethod
+    def _merge(dst: dict[int, int], src: dict[int, int]) -> None:
+        for t, k in src.items():
+            if dst.get(t, 0) < k:
+                dst[t] = k
+
+    # -- sync operations --------------------------------------------------
+
+    def acquire(self, token: Any, site: str = "") -> None:
+        tid = threading.get_ident()
+        with self._lock:
+            self.ops += 1
+            c = self._clock(tid)
+            tok = self._tokens.get(id(token))
+            if tok is not None:
+                self._merge(c, tok)
+
+    def release(self, token: Any, site: str = "") -> None:
+        tid = threading.get_ident()
+        with self._lock:
+            self.ops += 1
+            c = self._clock(tid)
+            key = id(token)
+            tok = self._tokens.get(key)
+            if tok is None:
+                tok = {}
+                self._tokens[key] = tok
+                self._token_refs[key] = token
+            self._merge(tok, c)
+            c[tid] = c.get(tid, 0) + 1
+
+    def fork(self, thread: Any, site: str = "") -> None:
+        self.release(thread, site)
+
+    def join(self, thread: Any, site: str = "") -> None:
+        tid = threading.get_ident()
+        child = getattr(thread, "ident", None)
+        with self._lock:
+            self.ops += 1
+            c = self._clock(tid)
+            if child is not None and child in self._clocks:
+                self._merge(c, self._clocks[child])
+
+    # -- shared-state accesses --------------------------------------------
+
+    def _record(self, rule: str, state: str, prior: Access,
+                current: Access) -> None:
+        race = Race(rule, state, prior, current,
+                    suppressed_reason=SUPPRESSED_STATES.get(state))
+        if race.fingerprint in self._seen_fps:
+            return
+        self._seen_fps.add(race.fingerprint)
+        self._races.append(race)
+
+    @staticmethod
+    def _ordered(prior: Access, c: dict[int, int]) -> bool:
+        """prior happens-before the current thread's clock ``c``?"""
+        return c.get(prior.tid, 0) >= prior.clock
+
+    def read(self, state: str) -> None:
+        tid = threading.get_ident()
+        stack = _site_stack()
+        with self._lock:
+            self.ops += 1
+            c = self._clock(tid)
+            me = Access(tid, c.get(tid, 0), threading.current_thread().name,
+                        stack)
+            var = self._vars.setdefault(state, _Var())
+            w = var.last_write
+            if w is not None and w.tid != tid and not self._ordered(w, c):
+                self._record("DR002", state, w, me)
+            var.reads[tid] = me
+
+    def write(self, state: str) -> None:
+        tid = threading.get_ident()
+        stack = _site_stack()
+        with self._lock:
+            self.ops += 1
+            c = self._clock(tid)
+            me = Access(tid, c.get(tid, 0), threading.current_thread().name,
+                        stack)
+            var = self._vars.setdefault(state, _Var())
+            w = var.last_write
+            if w is not None and w.tid != tid and not self._ordered(w, c):
+                self._record("DR001", state, w, me)
+            for r in var.reads.values():
+                if r.tid != tid and not self._ordered(r, c):
+                    self._record("DR003", state, r, me)
+            var.last_write = me
+            # a write ordered after the reads subsumes them; racing reads
+            # were already recorded above
+            var.reads = {}
+
+    # -- reporting --------------------------------------------------------
+
+    def races(self, include_suppressed: bool = False) -> list[Race]:
+        with self._lock:
+            return [
+                r for r in self._races
+                if include_suppressed or r.suppressed_reason is None
+            ]
+
+    def reset(self) -> None:
+        """Drop races AND all clock/epoch state (regression tests run
+        several isolated workloads in one process)."""
+        with self._lock:
+            self._races.clear()
+            self._seen_fps.clear()
+            self._vars.clear()
+            self._clocks.clear()
+            self._tokens.clear()
+            self._token_refs.clear()
+            self.ops = 0
+
+    def report(self) -> dict[str, Any]:
+        with self._lock:
+            races = list(self._races)
+            ops = self.ops
+        return {
+            "tool": "dynarace",
+            "pid": os.getpid(),
+            "ops": ops,
+            "races": [r.to_dict() for r in races
+                      if r.suppressed_reason is None],
+            "suppressed": [r.to_dict() for r in races
+                           if r.suppressed_reason is not None],
+        }
+
+    def dump(self, path: str) -> None:
+        tmp = f"{path}.tmp"
+        with open(tmp, "w") as f:
+            json.dump(self.report(), f, indent=1)
+        os.replace(tmp, path)

@@ -1,0 +1,131 @@
+"""The reviewable concurrency registries dynarace checks against.
+
+Same discipline as tools/dynalint/catalog.py (DL006 fault sites): adding
+a tracked shared state or a named sync point is a two-line diff *here*
+plus the annotation in the code, so the concurrency surface shows up in
+review. Drift fails tests in both directions
+(tests/test_dynarace.py):
+
+- a ``race.read/write`` state string in the package that is not in
+  ``SHARED_STATE`` (untracked state), or a catalogued state no code
+  annotates (stale entry);
+- a named ``race.Lock/RLock/Queue/Event`` or ``race.release/acquire``
+  token site not in ``SYNC_POINTS``, or a catalogued sync point no code
+  declares;
+- ``SHARED_STATE`` out of sync with dynalint's copy
+  (tools/dynalint/catalog.py ``SHARED_STATE``, consumed by DL005) —
+  the static and dynamic layers must agree on what the cross-thread
+  state IS.
+
+Thread vocabulary (docs/CONCURRENCY.md): the **engine step thread**
+(``engine-step``, owns the device), the **KVBM offload/writer threads**
+(``kvbm-offload``, ``kvbm-g4-writer``), the **disagg transfer workers**,
+the **telemetry sampler** (an asyncio task on the event loop), and the
+**asyncio control plane** (frontend/hub/admin).
+"""
+
+from __future__ import annotations
+
+# state key -> who touches it, under what discipline. Keys are spelled
+# "owner.attr"; the attr suffix is what dynalint's DL005 sees.
+SHARED_STATE: dict[str, str] = {
+    "engine.step_times": (
+        "engine/core.py step-latency deque — step thread appends, "
+        "telemetry sampler (event loop) drains via popleft; GIL-atomic "
+        "bounded deque, no lock (suppressed, see suppressions.py)"
+    ),
+    "engine.burst_fills": (
+        "engine/core.py burst-fill deque — same single-appender/"
+        "single-drainer deque discipline as engine.step_times"
+    ),
+    "flight.timeline": (
+        "runtime/flight.py timeline ring (events/attrs/retention "
+        "buckets) — step thread and event loop both enter; EVERY access "
+        "must hold FlightRecorder._lock (flight.lock), including "
+        "snapshot reads (the pre-dynarace snapshot-outside-lock race)"
+    ),
+    "kvbm.checksums": (
+        "kvbm/manager.py block-checksum dict — offload thread stamps on "
+        "offer, step thread reads on onboard and pops on corruption; "
+        "guarded by kvbm.manager.lock (the pre-dynarace unguarded-dict "
+        "race)"
+    ),
+    "hub.capture_log": (
+        "runtime/hub_store.py compaction capture list — event-loop-only "
+        "mutation; the snapshot worker thread sees state only through "
+        "the hub.snapshot to_thread hand-off edge"
+    ),
+}
+
+# sync-point name -> what it mediates. These are the tokens vector-clock
+# edges flow through: named locks/queues/events plus ad-hoc release/
+# acquire pairs (asyncio hand-offs, to_thread boundaries, thread forks).
+SYNC_POINTS: dict[str, str] = {
+    "engine.wake": (
+        "engine/core.py step-thread wake Event — control plane (admit/"
+        "drain/close/spmd-sync) -> step thread"
+    ),
+    "engine.out_q": (
+        "engine/core.py per-request asyncio.Queue — step thread posts "
+        "token deltas + sentinels via call_soon_threadsafe (_post), the "
+        "generate() coroutine consumes; the release/acquire pair IS the "
+        "cross-world hand-off edge"
+    ),
+    "engine.step-thread": (
+        "engine/core.py step-thread lifecycle — fork at start() "
+        "(constructor state happens-before the loop), join at close()"
+    ),
+    "flight.lock": (
+        "runtime/flight.py FlightRecorder._lock — all timeline "
+        "mutation AND snapshot reads"
+    ),
+    "tenancy.lock": (
+        "engine/tenancy.py TenantScheduler._lock — admission lanes, "
+        "buckets, vtime clocks; event loop enqueues, step thread "
+        "dequeues"
+    ),
+    "kvbm.manager.lock": (
+        "kvbm/manager.py manager RLock — stats + block checksums; "
+        "re-entrant because host-pool eviction cascades re-enter "
+        "through on_evict while held"
+    ),
+    "kvbm.host_pool.lock": "kvbm/pool.py G2 host block pool LRU lock",
+    "kvbm.disk_pool.lock": "kvbm/pool.py G3 disk pool index lock",
+    "kvbm.remote_tier.lock": (
+        "kvbm/pool.py G4 remote tier bookkeeping lock"
+    ),
+    "kvbm.offload_q": (
+        "kvbm/offload.py sealed-page hand-off queue — step thread "
+        "submits, offload thread drains"
+    ),
+    "kvbm.offload_flush": (
+        "kvbm/offload.py flush() completion Event — offload thread "
+        "sets, caller waits"
+    ),
+    "kvbm.offload-thread": (
+        "kvbm/offload.py offload worker lifecycle (fork/join)"
+    ),
+    "kvbm.remote_q": (
+        "kvbm/manager.py G4 writer queue — offload thread enqueues, "
+        "g4-writer thread drains toward the hub"
+    ),
+    "kvbm.g4-writer-thread": (
+        "kvbm/manager.py G4 writer lifecycle (fork; daemon, never "
+        "joined)"
+    ),
+    "disagg.local_sources.lock": (
+        "disagg/transfer.py in-process source registry lock"
+    ),
+    "disagg.source.lock": (
+        "disagg/transfer.py per-source export-table lock — event loop "
+        "registers, transfer worker takes"
+    ),
+    "disagg.device_conns.lock": (
+        "disagg/transfer.py PJRT connection-cache lock"
+    ),
+    "hub.snapshot": (
+        "runtime/hub_store.py compaction to_thread boundary — loop "
+        "releases before dispatching write_snapshot_tmp to the worker "
+        "thread, the worker acquires on entry"
+    ),
+}

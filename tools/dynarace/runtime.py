@@ -1,0 +1,228 @@
+"""The enabled half of the dynarace shim: instrumented primitives bound
+into ``dynamo_tpu.runtime.race`` when ``DYN_RACE=1``.
+
+Ordering discipline (what makes the vector-clock math sound):
+
+- lock **release** edges are recorded *while still holding* the lock —
+  the clock merge must be visible before any contender can acquire;
+- lock **acquire** edges are recorded *after* the real acquire;
+- queue **put** records its release edge *before* the real put — the
+  consumer may dequeue the item before ``put`` even returns to us. (A
+  ``queue.Full`` bounce therefore leaves a spurious merge on the
+  channel clock: conservative — it can only mask, never fabricate, a
+  race.)
+- schedule yield points run *outside* any real lock/mutex, so a
+  perturbation sleep never serializes the thing it is perturbing.
+
+Report plumbing: when ``DYN_RACE_REPORT=<dir>`` is set, every process
+dumps ``race_<pid>.json`` into it at exit (hub replicas and sim workers
+are subprocesses — the CLI aggregates the directory). Likewise
+``DYN_RACE_TRACE=<dir>`` dumps ``trace_<pid>.txt`` when the schedule
+explorer is active.
+"""
+
+from __future__ import annotations
+
+import atexit
+import os
+import queue
+import threading
+from typing import Any
+
+from tools.dynarace.detector import Detector
+from tools.dynarace.sched import Schedule
+
+DETECTOR = Detector()
+SCHEDULE: Schedule | None = None
+_seed = os.environ.get("DYN_RACE_SCHED", "")
+if _seed:
+    SCHEDULE = Schedule(_seed)
+
+
+def _point(kind: str, site: str) -> None:
+    if SCHEDULE is not None:
+        SCHEDULE.point(kind, site)
+
+
+# -- annotate functions (bound by dynamo_tpu/runtime/race.py) --------------
+
+
+def read(state: str) -> None:
+    DETECTOR.read(state)
+
+
+def write(state: str) -> None:
+    DETECTOR.write(state)
+
+
+def acquire(token: Any, site: str = "") -> None:
+    DETECTOR.acquire(token, site)
+    _point("acquire", site or f"token@{id(token):x}")
+
+
+def release(token: Any, site: str = "") -> None:
+    DETECTOR.release(token, site)
+    _point("release", site or f"token@{id(token):x}")
+
+
+def fork(thread: "threading.Thread") -> None:
+    DETECTOR.fork(thread)
+    _point("fork", f"thread:{thread.name}")
+
+
+def join(thread: "threading.Thread") -> None:
+    DETECTOR.join(thread)
+
+
+# -- instrumented primitives -----------------------------------------------
+
+
+class Lock:
+    """Instrumented ``threading.Lock``."""
+
+    __slots__ = ("_lock", "name")
+
+    def __init__(self, name: str = ""):
+        self._lock = threading.Lock()
+        self.name = name or f"lock@{id(self):x}"
+
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        _point("acquire", self.name)
+        ok = self._lock.acquire(blocking, timeout)
+        if ok:
+            DETECTOR.acquire(self, self.name)
+        return ok
+
+    def release(self) -> None:
+        DETECTOR.release(self, self.name)
+        self._lock.release()
+        _point("release", self.name)
+
+    def locked(self) -> bool:
+        return self._lock.locked()
+
+    def __enter__(self) -> "Lock":
+        self.acquire()
+        return self
+
+    def __exit__(self, *_exc: Any) -> None:
+        self.release()
+
+
+class RLock:
+    """Instrumented ``threading.RLock``: only the outermost acquire/
+    release carry HB edges (inner recursion is same-thread program
+    order). ``_depth`` is mutated only while the lock is held, so it
+    needs no extra guard."""
+
+    __slots__ = ("_lock", "_depth", "name")
+
+    def __init__(self, name: str = ""):
+        self._lock = threading.RLock()
+        self._depth = 0
+        self.name = name or f"rlock@{id(self):x}"
+
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        if self._depth == 0:
+            _point("acquire", self.name)
+        ok = self._lock.acquire(blocking, timeout)
+        if ok:
+            self._depth += 1
+            if self._depth == 1:
+                DETECTOR.acquire(self, self.name)
+        return ok
+
+    def release(self) -> None:
+        if self._depth == 1:
+            DETECTOR.release(self, self.name)
+        self._depth -= 1
+        outermost = self._depth == 0
+        self._lock.release()
+        if outermost:
+            _point("release", self.name)
+
+    def __enter__(self) -> "RLock":
+        self.acquire()
+        return self
+
+    def __exit__(self, *_exc: Any) -> None:
+        self.release()
+
+
+class Event:
+    """Instrumented ``threading.Event``: ``set`` releases, a satisfied
+    ``wait`` acquires. ``clear`` is untracked (it removes no ordering)."""
+
+    __slots__ = ("_ev", "name")
+
+    def __init__(self, name: str = ""):
+        self._ev = threading.Event()
+        self.name = name or f"event@{id(self):x}"
+
+    def set(self) -> None:
+        DETECTOR.release(self, self.name)
+        self._ev.set()
+        _point("release", self.name)
+
+    def wait(self, timeout: float | None = None) -> bool:
+        ok = self._ev.wait(timeout)
+        if ok:
+            DETECTOR.acquire(self, self.name)
+        return ok
+
+    def is_set(self) -> bool:
+        return self._ev.is_set()
+
+    def clear(self) -> None:
+        self._ev.clear()
+
+
+class Queue(queue.Queue):
+    """Instrumented ``queue.Queue``: channel-granularity edges — a get
+    acquires the clock of EVERY prior put, not just its own item's.
+    Coarser than per-item tagging, strictly conservative (extra HB
+    edges can only hide races, never invent them), and cheap."""
+
+    def __init__(self, name: str = "", maxsize: int = 0):
+        super().__init__(maxsize=maxsize)
+        self.name = name or f"queue@{id(self):x}"
+
+    def put(self, item: Any, block: bool = True,
+            timeout: float | None = None) -> None:
+        DETECTOR.release(self, self.name)
+        super().put(item, block, timeout)
+        _point("put", self.name)
+
+    def get(self, block: bool = True,
+            timeout: float | None = None) -> Any:
+        item = super().get(block, timeout)
+        DETECTOR.acquire(self, self.name)
+        _point("got", self.name)
+        return item
+
+
+# -- per-process report/trace dump -----------------------------------------
+
+
+def _dump_at_exit() -> None:
+    report_dir = os.environ.get("DYN_RACE_REPORT", "")
+    if report_dir:
+        try:
+            os.makedirs(report_dir, exist_ok=True)
+            DETECTOR.dump(
+                os.path.join(report_dir, f"race_{os.getpid()}.json")
+            )
+        except OSError:
+            pass
+    trace_dir = os.environ.get("DYN_RACE_TRACE", "")
+    if trace_dir and SCHEDULE is not None:
+        try:
+            os.makedirs(trace_dir, exist_ok=True)
+            SCHEDULE.dump(
+                os.path.join(trace_dir, f"trace_{os.getpid()}.txt")
+            )
+        except OSError:
+            pass
+
+
+atexit.register(_dump_at_exit)

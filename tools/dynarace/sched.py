@@ -1,0 +1,93 @@
+"""Seeded deterministic schedule explorer.
+
+With ``DYN_RACE_SCHED=<seed>`` set (alongside ``DYN_RACE=1``), every
+instrumented sync boundary becomes a *yield point*: the wrapper calls
+``point(kind, site)`` and this module decides — as a pure function of
+``(seed, site, kind, n)`` where ``n`` is the occurrence index of that
+(site, kind) pair — whether to perturb the schedule there, and for how
+long. Same seed ⇒ same decisions ⇒ the same order-dependent bug
+surfaces again; a regression test replays the interleaving by exporting
+the seed.
+
+Bias (loom/rr-style): perturbation probability is highest *just after*
+a release-flavoured operation — a released lock, a just-put queue item,
+a just-set event — because that is the instant an adversarial scheduler
+would hand the CPU to the contending thread. Acquire-flavoured points
+get a low probability so waiters still make progress.
+
+The decision stream is also the **trace**: every point appends
+``site|kind|n|decision``, and ``dump()`` writes the lines sorted by
+(site, kind, n). For a fixed instrumented workload the per-(site, kind)
+operation counts are schedule-independent, so the dumped trace is
+byte-identical across runs with the same seed — the replay contract
+tests/test_dynarace.py guards with two subprocess runs.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import threading
+import time
+from typing import Iterable
+
+# kind -> perturbation probability numerator (out of 256)
+_BIAS = {
+    "release": 112,  # just released a lock / set an event
+    "put": 112,      # just put a queue item
+    "acquire": 24,   # about to take a lock
+    "got": 24,       # just dequeued
+    "fork": 64,      # just started a thread
+}
+_DEFAULT_BIAS = 24
+_MAX_SLEEP_S = 0.004
+
+
+class Schedule:
+    """One process's seeded perturbation state."""
+
+    def __init__(self, seed: str):
+        self.seed = seed
+        self._lock = threading.Lock()
+        self._counts: dict[tuple[str, str], int] = {}
+        self._trace: list[tuple[str, str, int, int]] = []
+
+    def point(self, kind: str, site: str) -> None:
+        key = (site, kind)
+        with self._lock:
+            n = self._counts.get(key, 0)
+            self._counts[key] = n + 1
+        h = hashlib.sha1(
+            f"{self.seed}|{site}|{kind}|{n}".encode()
+        ).digest()
+        go = 1 if h[0] < _BIAS.get(kind, _DEFAULT_BIAS) else 0
+        with self._lock:
+            self._trace.append((site, kind, n, go))
+        if go:
+            # 0.5ms..4ms, derived from the hash — long enough to let a
+            # contending OS thread run, short enough for <10s smokes.
+            # dynalint: disable=DL001 -- the blocking perturbation IS the
+            # schedule explorer's contract (DYN_RACE_SCHED test mode
+            # only; stalling the loop at a sync boundary is exactly the
+            # adversarial reordering being explored)
+            time.sleep((1 + h[1] % 8) * (_MAX_SLEEP_S / 8))
+        elif h[2] < 64:
+            # plain cooperative yield: cheap reordering pressure even
+            # where a sleep would be too heavy
+            # dynalint: disable=DL001 -- same DYN_RACE_SCHED-only
+            # contract as above (sleep(0) = cooperative yield)
+            time.sleep(0)
+
+    def trace_lines(self) -> Iterable[str]:
+        with self._lock:
+            entries = sorted(self._trace)
+        for site, kind, n, go in entries:
+            yield f"{site}|{kind}|{n}|{go}"
+
+    def dump(self, path: str) -> None:
+        tmp = f"{path}.tmp"
+        with open(tmp, "w") as f:
+            f.write(f"# dynarace schedule trace seed={self.seed}\n")
+            for line in self.trace_lines():
+                f.write(line + "\n")
+        os.replace(tmp, path)

@@ -1,0 +1,38 @@
+"""State-level race suppressions, each with a WRITTEN happens-before
+justification.
+
+A race on a state listed here is recorded as *suppressed* (counted,
+inspectable via ``--show-suppressed``), never gated on. The policy is
+dynalint's: a suppression without a real justification is worse than a
+finding, because it silences the NEXT race on the same state too —
+tests/test_dynarace.py enforces that every entry names its
+happens-before argument (the literal string "HB:" must appear) and that
+the committed fingerprint baseline stays EMPTY (suppressions carry the
+reasoning; the baseline grandfathers nothing).
+
+These are the audited survivors of the PR-20 suppression sweep (see
+tools/dynarace/SUPPRESSIONS_AUDIT.md): benign-by-construction patterns
+the vector-clock model cannot see an edge for, because the edge is the
+GIL plus a single-writer/single-reader protocol rather than a lock.
+"""
+
+from __future__ import annotations
+
+# state key (registry.SHARED_STATE) -> justification. Format: one
+# sentence of what races, then "HB: ..." naming why no ordering edge is
+# required for correctness.
+SUPPRESSED_STATES: dict[str, str] = {
+    "engine.step_times": (
+        "telemetry sampler drains the step-latency deque while the step "
+        "thread appends. HB: none required — collections.deque append/"
+        "popleft are GIL-atomic, the step thread is the only appender, "
+        "the sampler the only drainer, maxlen bounds loss, and a torn "
+        "window only shifts an observation into the next /metrics "
+        "scrape; no engine decision reads this state"
+    ),
+    "engine.burst_fills": (
+        "same sampler-vs-appender shape as engine.step_times. HB: same "
+        "justification — GIL-atomic bounded deque, single appender "
+        "(step thread), single drainer (sampler), observability-only"
+    ),
+}
